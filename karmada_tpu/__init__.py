@@ -1,0 +1,23 @@
+"""karmada-tpu: a TPU-native multi-cluster orchestration control plane.
+
+A brand-new framework with the capabilities of the karmada reference
+(Kubernetes multi-cluster orchestration: CRD-style data model, watch/reconcile
+controllers, a scheduler, capacity estimators), redesigned TPU-first: the
+replica-assignment hot path (filter/score/spread/divide, reference
+`pkg/scheduler/core/generic_scheduler.go:71-116`) runs as one batched,
+vmapped JAX program over dense (bindings x clusters) tensors on TPU,
+instead of one serial Go loop per binding.
+
+Layout (mirrors SURVEY.md layer map):
+  models/    L0 API data model (Cluster, PropagationPolicy, ResourceBinding, Work, ...)
+  store/     L0 object store + watch bus (etcd/apiserver semantics, in-proc)
+  ops/       solver kernels: serial golden path (numpy) + batched TPU path (JAX)
+  parallel/  device mesh, sharding, batching/padding discipline
+  scheduler/ L4 scheduling service (queues, batch window, patch-back)
+  estimator/ L4 capacity estimation (general math + accurate per-node tier)
+  interpreter/ L2 resource interpreter (GetReplicas/ReviseReplica/...)
+  controllers/ L3 propagation loop (detector, binding, execution, status, ...)
+  utils/     quantities, interning, workers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from karmada_tpu.estimator.general import GeneralEstimator, UNAUTHENTIC_REPLICA  # noqa: F401

@@ -1,0 +1,16 @@
+"""Solver kernels.
+
+  webster.py — exact Sainte-Laguë/Webster seat allocation (greedy golden path)
+  serial.py  — faithful serial re-implementation of the reference scheduling
+               pipeline (the control baseline; reference pkg/scheduler/core)
+  solver.py  — the TPU-native batched JAX program (the north star)
+  tensors.py — host-side interning/packing of objects into dense tensors
+"""
+
+from karmada_tpu.ops.webster import (  # noqa: F401
+    Party,
+    allocate_webster_seats,
+    dispense_by_weight,
+    fnv32a,
+    tiebreak_descending_by_uid,
+)

@@ -1,0 +1,2 @@
+from karmada_tpu.store.store import Event, ObjectStore, WatchBus  # noqa: F401
+from karmada_tpu.store.worker import AsyncWorker, Runtime  # noqa: F401

@@ -1,0 +1,206 @@
+"""In-process object store with apiserver semantics.
+
+The reference keeps all state in etcd behind a kube-apiserver and every
+component is an informer client (SURVEY.md §2.10). This store provides the
+same contract without Kubernetes: typed objects keyed by (kind, namespace,
+name), monotonically increasing resourceVersion, generation bumps on spec
+change, watch subscriptions with ADDED/MODIFIED/DELETED events, and
+finalizer-gated deletion.
+
+Thread-safe; watch delivery is synchronous into per-subscriber queues so a
+deterministic test pump and a threaded runtime can share the machinery.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from karmada_tpu.models.meta import TypedObject, new_uid, now
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class Event:
+    type: str  # ADDED | MODIFIED | DELETED
+    obj: TypedObject
+    old: Optional[TypedObject] = None
+
+    @property
+    def kind(self) -> str:
+        return self.obj.KIND
+
+
+class ConflictError(Exception):
+    """resourceVersion mismatch on update (optimistic concurrency)."""
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class AlreadyExistsError(Exception):
+    pass
+
+
+class WatchBus:
+    """Fan-out of store events to subscribers.
+
+    A subscriber is a callable invoked under no lock with each Event; the
+    runtime layer wraps these into worker queues.
+    """
+
+    def __init__(self) -> None:
+        self._subs: List[Tuple[Optional[str], Callable[[Event], None]]] = []
+        self._lock = threading.Lock()
+
+    def subscribe(self, handler: Callable[[Event], None], kind: Optional[str] = None) -> None:
+        with self._lock:
+            self._subs.append((kind, handler))
+
+    def publish(self, event: Event) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for kind, handler in subs:
+            if kind is None or kind == event.kind:
+                handler(event)
+
+
+class ObjectStore:
+    def __init__(self, bus: Optional[WatchBus] = None) -> None:
+        self._objects: Dict[Tuple[str, str, str], TypedObject] = {}
+        self._rv = 0
+        self._lock = threading.RLock()
+        self.bus = bus or WatchBus()
+
+    # -- internal ----------------------------------------------------------
+    def _key(self, obj: TypedObject) -> Tuple[str, str, str]:
+        return (obj.KIND, obj.metadata.namespace, obj.metadata.name)
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    # -- API ---------------------------------------------------------------
+    def create(self, obj: TypedObject) -> TypedObject:
+        with self._lock:
+            key = self._key(obj)
+            if key in self._objects:
+                raise AlreadyExistsError(f"{key} already exists")
+            obj = copy.deepcopy(obj)
+            if not obj.metadata.uid:
+                obj.metadata.uid = new_uid()
+            obj.metadata.creation_timestamp = now()
+            obj.metadata.generation = 1
+            obj.metadata.resource_version = self._next_rv()
+            self._objects[key] = obj
+            stored = copy.deepcopy(obj)
+        self.bus.publish(Event(ADDED, stored))
+        return stored
+
+    def get(self, kind: str, namespace: str, name: str) -> TypedObject:
+        with self._lock:
+            key = (kind, namespace, name)
+            if key not in self._objects:
+                raise NotFoundError(f"{key} not found")
+            return copy.deepcopy(self._objects[key])
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Optional[TypedObject]:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[TypedObject]:
+        with self._lock:
+            out = [
+                copy.deepcopy(o)
+                for (k, ns, _), o in sorted(self._objects.items())
+                if k == kind and (namespace is None or ns == namespace)
+            ]
+        return out
+
+    def update(self, obj: TypedObject, *, spec_changed: Optional[bool] = None) -> TypedObject:
+        """Optimistic-concurrency update. Bumps generation when the spec
+        changed (caller may force via spec_changed)."""
+        with self._lock:
+            key = self._key(obj)
+            if key not in self._objects:
+                raise NotFoundError(f"{key} not found")
+            old = self._objects[key]
+            if (
+                obj.metadata.resource_version
+                and obj.metadata.resource_version != old.metadata.resource_version
+            ):
+                raise ConflictError(
+                    f"{key}: rv {obj.metadata.resource_version} != {old.metadata.resource_version}"
+                )
+            obj = copy.deepcopy(obj)
+            obj.metadata.uid = old.metadata.uid
+            obj.metadata.creation_timestamp = old.metadata.creation_timestamp
+            if spec_changed is None:
+                spec_changed = getattr(obj, "spec", None) != getattr(old, "spec", None)
+            obj.metadata.generation = old.metadata.generation + (1 if spec_changed else 0)
+            obj.metadata.resource_version = self._next_rv()
+            # deletion in progress + finalizers drained -> actually delete
+            if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+                del self._objects[key]
+                stored = copy.deepcopy(obj)
+                old_copy = copy.deepcopy(old)
+                event = Event(DELETED, stored, old_copy)
+            else:
+                self._objects[key] = obj
+                stored = copy.deepcopy(obj)
+                old_copy = copy.deepcopy(old)
+                event = Event(MODIFIED, stored, old_copy)
+        self.bus.publish(event)
+        return stored
+
+    def mutate(self, kind: str, namespace: str, name: str, fn: Callable[[TypedObject], None],
+               retries: int = 8) -> TypedObject:
+        """Get-mutate-update with conflict retry (controller patch helper)."""
+        for _ in range(retries):
+            obj = self.get(kind, namespace, name)
+            fn(obj)
+            try:
+                return self.update(obj)
+            except ConflictError:
+                continue
+        raise ConflictError(f"mutate {kind}/{namespace}/{name}: too many conflicts")
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        """Finalizer-aware delete: marks deletionTimestamp; removal happens
+        once finalizers drain (or immediately when none)."""
+        with self._lock:
+            key = (kind, namespace, name)
+            if key not in self._objects:
+                raise NotFoundError(f"{key} not found")
+            obj = self._objects[key]
+            if obj.metadata.finalizers:
+                if obj.metadata.deletion_timestamp is None:
+                    obj.metadata.deletion_timestamp = now()
+                    obj.metadata.resource_version = self._next_rv()
+                    stored = copy.deepcopy(obj)
+                    event = Event(MODIFIED, stored)
+                else:
+                    return
+            else:
+                del self._objects[key]
+                obj.metadata.deletion_timestamp = obj.metadata.deletion_timestamp or now()
+                stored = copy.deepcopy(obj)
+                event = Event(DELETED, stored)
+        self.bus.publish(event)
+
+    def items(self) -> Iterator[TypedObject]:
+        with self._lock:
+            snapshot = [copy.deepcopy(o) for o in self._objects.values()]
+        return iter(snapshot)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._objects)

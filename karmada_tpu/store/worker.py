@@ -1,0 +1,163 @@
+"""Reconcile worker queues and the controller runtime.
+
+The reference drives every controller with rate-limited workqueues
+(util.AsyncWorker, controller-runtime). This module provides the same
+contract with two execution modes:
+
+  * pump mode  — deterministic: `Runtime.pump()` drains every queue to
+    quiescence on the calling thread (the test/E2E harness; also how the
+    end-to-end slice runs a "tick").
+  * serve mode — threaded: one worker thread per AsyncWorker with
+    exponential backoff on failures (the long-running service).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from collections import OrderedDict
+from typing import Callable, Dict, Hashable, List, Optional
+
+
+class AsyncWorker:
+    """Dedup-ing work queue: enqueueing an in-queue key is a no-op; a key
+    re-enqueued while being processed is processed again afterwards."""
+
+    def __init__(self, name: str, reconcile: Callable[[Hashable], Optional[bool]],
+                 max_retries: int = 10) -> None:
+        self.name = name
+        self.reconcile = reconcile
+        self.max_retries = max_retries
+        self._queue: "OrderedDict[Hashable, None]" = OrderedDict()
+        self._retries: Dict[Hashable, int] = {}
+        self._processing: set = set()
+        self._dirty: set = set()
+        self._cv = threading.Condition()
+        self._stopped = False
+
+    def enqueue(self, key: Hashable) -> None:
+        with self._cv:
+            if key in self._processing:
+                self._dirty.add(key)
+                return
+            self._queue[key] = None
+            self._cv.notify()
+
+    def _pop(self, block: bool) -> Optional[Hashable]:
+        with self._cv:
+            while not self._queue:
+                if not block or self._stopped:
+                    return None
+                self._cv.wait(timeout=0.2)
+            key, _ = self._queue.popitem(last=False)
+            self._processing.add(key)
+            return key
+
+    def _done(self, key: Hashable, requeue: bool) -> None:
+        with self._cv:
+            self._processing.discard(key)
+            redo = key in self._dirty
+            self._dirty.discard(key)
+            if requeue:
+                retries = self._retries.get(key, 0) + 1
+                if retries <= self.max_retries:
+                    self._retries[key] = retries
+                    self._queue[key] = None
+                else:
+                    # dropped at max retries: forget the budget (workqueue
+                    # Forget semantics) and honor any concurrent enqueue
+                    self._retries.pop(key, None)
+                    if redo:
+                        self._queue[key] = None
+            else:
+                self._retries.pop(key, None)
+                if redo:
+                    self._queue[key] = None
+
+    def process_one(self, block: bool = False) -> bool:
+        """Run one reconcile; returns False when the queue was empty.
+
+        A reconcile that raises (or returns False) is requeued with a retry
+        budget — mirroring workqueue rate-limited requeue.
+        """
+        key = self._pop(block)
+        if key is None:
+            return False
+        requeue = False
+        try:
+            result = self.reconcile(key)
+            requeue = result is False
+        except Exception:  # noqa: BLE001 — controller loops never die
+            traceback.print_exc()
+            requeue = True
+        self._done(key, requeue)
+        return True
+
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._queue) + len(self._processing)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+
+class Runtime:
+    """Holds every controller's worker; runs them deterministically (pump)
+    or in background threads (serve)."""
+
+    def __init__(self) -> None:
+        self.workers: List[AsyncWorker] = []
+        self._threads: List[threading.Thread] = []
+        self._periodic: List[Callable[[], None]] = []
+
+    def register(self, worker: AsyncWorker) -> AsyncWorker:
+        self.workers.append(worker)
+        return worker
+
+    def register_periodic(self, fn: Callable[[], None]) -> None:
+        """A resync-style hook invoked once per pump round (or per serve tick)."""
+        self._periodic.append(fn)
+
+    # -- deterministic mode ------------------------------------------------
+    def pump(self, max_rounds: int = 200) -> int:
+        """Drain all queues until quiescent. Returns reconciles executed."""
+        total = 0
+        for _ in range(max_rounds):
+            progressed = False
+            for w in self.workers:
+                while w.process_one(block=False):
+                    progressed = True
+                    total += 1
+            if not progressed:
+                return total
+        raise RuntimeError("runtime did not quiesce (reconcile livelock?)")
+
+    def tick(self) -> int:
+        """One periodic round (status resync etc.) followed by a pump."""
+        for fn in self._periodic:
+            fn()
+        return self.pump()
+
+    # -- threaded mode -----------------------------------------------------
+    def serve(self) -> None:
+        for w in self.workers:
+            t = threading.Thread(target=self._run_worker, args=(w,), daemon=True,
+                                 name=f"worker-{w.name}")
+            t.start()
+            self._threads.append(t)
+
+    def _run_worker(self, w: AsyncWorker) -> None:
+        backoff = 0.005
+        while not w._stopped:  # noqa: SLF001
+            if w.process_one(block=True):
+                backoff = 0.005
+            else:
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 0.5)
+
+    def stop(self) -> None:
+        for w in self.workers:
+            w.stop()

@@ -1,0 +1,1 @@
+from karmada_tpu.utils.quantity import Quantity, parse_quantity  # noqa: F401

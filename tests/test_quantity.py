@@ -1,0 +1,52 @@
+from karmada_tpu.utils.quantity import Quantity, parse_quantity, resource_request_value
+
+
+def test_parse_plain():
+    assert parse_quantity("2").milli == 2000
+    assert parse_quantity(3).milli == 3000
+    assert parse_quantity("0").milli == 0
+
+
+def test_parse_milli():
+    assert parse_quantity("100m").milli == 100
+    assert parse_quantity("1500m").value() == 2  # Value() rounds up
+    assert parse_quantity("1500m").milli_value() == 1500
+
+
+def test_parse_binary_suffixes():
+    assert parse_quantity("1Ki").value() == 1024
+    assert parse_quantity("2Gi").value() == 2 * 2**30
+    assert parse_quantity("1Mi").milli == 1000 * 2**20
+
+
+def test_parse_decimal_suffixes():
+    assert parse_quantity("1k").value() == 1000
+    assert parse_quantity("2M").value() == 2_000_000
+    assert parse_quantity("1.5G").value() == 1_500_000_000
+
+
+def test_parse_fraction():
+    assert parse_quantity("0.5").milli == 500
+    assert parse_quantity("1.5Gi").value() == 3 * 2**29
+
+
+def test_parse_exponent():
+    assert parse_quantity("1e3").value() == 1000
+    assert parse_quantity("1.2e2").milli == 120_000
+
+
+def test_arithmetic():
+    a, b = parse_quantity("2"), parse_quantity("500m")
+    assert (a - b).milli == 1500
+    assert (a + b).value() == 3  # 2.5 rounds up
+
+
+def test_resource_request_value_cpu_vs_other():
+    q = parse_quantity("1500m")
+    assert resource_request_value("cpu", q) == 1500
+    assert resource_request_value("memory", q) == 2
+
+
+def test_quantity_order():
+    assert parse_quantity("100m") < parse_quantity("1")
+    assert Quantity(0).is_zero()

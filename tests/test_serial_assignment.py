@@ -1,0 +1,212 @@
+"""Golden tests transcribed from reference pkg/scheduler/core/assignment_test.go.
+
+These pin the serial control path to the reference's exact semantics; the TPU
+solver is then property-tested against the serial path.
+"""
+
+import pytest
+
+from karmada_tpu.models.cluster import Cluster
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    ClusterAffinity,
+    ClusterPreferences,
+    Placement,
+    ReplicaSchedulingStrategy,
+    StaticClusterWeight,
+)
+from karmada_tpu.models.work import (
+    ReplicaRequirements,
+    ResourceBindingSpec,
+    ResourceBindingStatus,
+    TargetCluster,
+)
+from karmada_tpu.ops import serial
+from karmada_tpu.ops.serial import ClusterDetailInfo, UnschedulableError, assign_replicas
+
+
+def detail(name: str, allocatable: int = 0) -> ClusterDetailInfo:
+    return ClusterDetailInfo(
+        name=name,
+        score=0,
+        available_replicas=allocatable,
+        allocatable_replicas=allocatable,
+        cluster=Cluster(metadata=ObjectMeta(name=name)),
+    )
+
+
+def static_strategy(weights=None):
+    wp = None
+    if weights is not None:
+        wp = ClusterPreferences(
+            static_weight_list=[
+                StaticClusterWeight(
+                    target_cluster=ClusterAffinity(cluster_names=[n]), weight=w
+                )
+                for n, w in weights
+            ]
+        )
+    return ReplicaSchedulingStrategy(
+        replica_scheduling_type="Divided",
+        replica_division_preference="Weighted",
+        weight_preference=wp,
+    )
+
+
+DYNAMIC = ReplicaSchedulingStrategy(
+    replica_scheduling_type="Divided",
+    replica_division_preference="Weighted",
+    weight_preference=ClusterPreferences(dynamic_weight="AvailableReplicas"),
+)
+AGGREGATED = ReplicaSchedulingStrategy(
+    replica_scheduling_type="Divided",
+    replica_division_preference="Aggregated",
+)
+
+
+def spec_for(strategy, replicas, clusters=(), requirements=True):
+    return ResourceBindingSpec(
+        replicas=replicas,
+        replica_requirements=ReplicaRequirements() if requirements else None,
+        clusters=[TargetCluster(name=n, replicas=r) for n, r in clusters],
+        placement=Placement(replica_scheduling=strategy),
+    )
+
+
+def as_map(result):
+    return {tc.name: tc.replicas for tc in result}
+
+
+# --- Test_assignByStaticWeightStrategy --------------------------------------
+
+
+@pytest.mark.parametrize(
+    "replicas,weights,want",
+    [
+        (12, [("m1", 3), ("m2", 2), ("m3", 1)], {"m1": 6, "m2": 4, "m3": 2}),
+        (12, None, {"m1": 4, "m2": 4, "m3": 4}),
+        (13, [("m1", 3), ("m2", 2), ("m3", 1)], {"m1": 7, "m2": 4, "m3": 2}),
+        (14, [("m1", 3), ("m2", 2), ("m3", 1)], {"m1": 7, "m2": 5, "m3": 2}),
+    ],
+)
+def test_static_weight(replicas, weights, want):
+    candidates = [detail("m1"), detail("m2"), detail("m3")]
+    spec = spec_for(static_strategy(weights), replicas)
+    got = assign_replicas(candidates, spec, ResourceBindingStatus())
+    assert as_map(got) == want
+
+
+def test_static_weight_cluster_without_weight_ignored():
+    candidates = [detail("m1"), detail("m2")]
+    spec = spec_for(static_strategy([("m1", 1)]), 2)
+    got = assign_replicas(candidates, spec, ResourceBindingStatus())
+    assert as_map(got) == {"m1": 2}
+
+
+def test_static_weight_multiple_weights_takes_max():
+    candidates = [detail("m1"), detail("m2")]
+    spec = spec_for(static_strategy([("m1", 1), ("m2", 1), ("m1", 2)]), 3)
+    got = assign_replicas(candidates, spec, ResourceBindingStatus())
+    assert as_map(got) == {"m1": 2, "m2": 1}
+
+
+def test_static_weight_zero_replicas():
+    candidates = [detail("m1"), detail("m2")]
+    spec = spec_for(static_strategy([("m1", 1), ("m2", 1)]), 0)
+    got = assign_replicas(candidates, spec, ResourceBindingStatus())
+    assert as_map(got) == {}  # zero-replica clusters stripped
+
+
+# --- Test_dynamicScale ------------------------------------------------------
+
+
+def test_dynamic_weighted_scale_down_12_to_6():
+    candidates = [detail("m1", 1), detail("m2", 1), detail("m3", 1)]
+    spec = spec_for(DYNAMIC, 6, [("m1", 2), ("m2", 4), ("m3", 6)])
+    got = assign_replicas(candidates, spec, ResourceBindingStatus())
+    assert as_map(got) == {"m1": 1, "m2": 2, "m3": 3}
+
+
+def test_dynamic_weighted_scale_up_12_to_24():
+    candidates = [detail("m1", 10), detail("m2", 10), detail("m3", 10)]
+    spec = spec_for(DYNAMIC, 24, [("m1", 2), ("m2", 4), ("m3", 6)])
+    got = assign_replicas(candidates, spec, ResourceBindingStatus())
+    assert as_map(got) == {"m1": 6, "m2": 8, "m3": 10}
+
+
+def test_dynamic_weighted_scale_up_insufficient():
+    candidates = [detail("m1", 1), detail("m2", 1), detail("m3", 1)]
+    spec = spec_for(DYNAMIC, 24, [("m1", 2), ("m2", 4), ("m3", 6)])
+    with pytest.raises(UnschedulableError):
+        assign_replicas(candidates, spec, ResourceBindingStatus())
+
+
+def test_aggregated_scale_down_12_to_6():
+    candidates = [detail("m1", 1), detail("m2", 1), detail("m3", 1)]
+    spec = spec_for(AGGREGATED, 6, [("m1", 4), ("m2", 8)])
+    got = assign_replicas(candidates, spec, ResourceBindingStatus())
+    assert as_map(got) == {"m2": 6}
+
+
+def test_aggregated_scale_down_12_to_8():
+    candidates = [detail("m1", 100), detail("m2", 100)]
+    spec = spec_for(AGGREGATED, 8, [("m1", 4), ("m2", 8)])
+    got = assign_replicas(candidates, spec, ResourceBindingStatus())
+    assert as_map(got) == {"m2": 8}
+
+
+def test_aggregated_scale_up_4_6_8():
+    candidates = [detail("m1", 4), detail("m2", 6), detail("m3", 8)]
+    spec = spec_for(AGGREGATED, 24, [("m1", 4), ("m2", 8)])
+    got = assign_replicas(candidates, spec, ResourceBindingStatus())
+    assert as_map(got) == {"m1": 7, "m2": 12, "m3": 5}
+
+
+def test_aggregated_scale_up_6_6_20():
+    candidates = [detail("m1", 6), detail("m2", 6), detail("m3", 20)]
+    spec = spec_for(AGGREGATED, 24, [("m1", 4), ("m2", 8)])
+    got = assign_replicas(candidates, spec, ResourceBindingStatus())
+    assert as_map(got) == {"m1": 10, "m2": 14}
+
+
+def test_aggregated_scale_up_insufficient():
+    candidates = [detail("m1", 1), detail("m2", 1), detail("m3", 1)]
+    spec = spec_for(AGGREGATED, 24, [("m1", 4), ("m2", 8)])
+    with pytest.raises(UnschedulableError):
+        assign_replicas(candidates, spec, ResourceBindingStatus())
+
+
+def test_aggregated_cluster_disappeared_and_appeared():
+    candidates = [detail("m1", 4), detail("m3", 8), detail("m4", 12)]
+    spec = spec_for(AGGREGATED, 24, [("m1", 4), ("m2", 8)])
+    got = assign_replicas(candidates, spec, ResourceBindingStatus())
+    assert as_map(got) == {"m1": 7, "m3": 7, "m4": 10}
+
+
+def test_duplicated_strategy():
+    candidates = [detail("m1"), detail("m2")]
+    spec = ResourceBindingSpec(
+        replicas=5,
+        replica_requirements=ReplicaRequirements(),
+        placement=Placement(
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type="Duplicated"
+            )
+        ),
+    )
+    got = assign_replicas(candidates, spec, ResourceBindingStatus())
+    assert as_map(got) == {"m1": 5, "m2": 5}
+
+
+def test_non_workload_propagates_to_all():
+    candidates = [detail("m1"), detail("m2")]
+    spec = ResourceBindingSpec(replicas=0, replica_requirements=None,
+                               placement=Placement())
+    got = assign_replicas(candidates, spec, ResourceBindingStatus())
+    assert as_map(got) == {"m1": 0, "m2": 0}
+
+
+def test_no_candidates_raises():
+    spec = spec_for(DYNAMIC, 3)
+    with pytest.raises(serial.NoClusterAvailableError):
+        assign_replicas([], spec, ResourceBindingStatus())

@@ -1,0 +1,238 @@
+"""End-to-end tests of the serial schedule() pipeline: filters + scores +
+general-estimator capacity + selection + assignment (reference call stack 3.2)."""
+
+import pytest
+
+from karmada_tpu.estimator import GeneralEstimator
+from karmada_tpu.models.cluster import (
+    APIEnablement,
+    Cluster,
+    ClusterSpec,
+    ClusterStatus,
+    ResourceSummary,
+    Taint,
+)
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    ClusterAffinity,
+    ClusterPreferences,
+    Placement,
+    ReplicaSchedulingStrategy,
+    SpreadConstraint,
+    Toleration,
+)
+from karmada_tpu.models.work import (
+    GracefulEvictionTask,
+    ObjectReference,
+    ReplicaRequirements,
+    ResourceBindingSpec,
+    ResourceBindingStatus,
+)
+from karmada_tpu.ops import serial
+from karmada_tpu.utils.quantity import parse_quantity
+
+
+def make_cluster(
+    name,
+    cpu="100",
+    memory="1000Gi",
+    pods="1000",
+    region="",
+    zone="",
+    provider="",
+    taints=(),
+    labels=None,
+    allocated_cpu="0",
+):
+    summary = ResourceSummary(
+        allocatable={
+            "cpu": parse_quantity(cpu),
+            "memory": parse_quantity(memory),
+            "pods": parse_quantity(pods),
+        },
+        allocated={"cpu": parse_quantity(allocated_cpu)},
+    )
+    return Cluster(
+        metadata=ObjectMeta(name=name, labels=dict(labels or {})),
+        spec=ClusterSpec(
+            region=region,
+            zone=zone,
+            zones=[zone] if zone else [],
+            provider=provider,
+            taints=list(taints),
+        ),
+        status=ClusterStatus(
+            api_enablements=[
+                APIEnablement(group_version="apps/v1", resources=["Deployment"])
+            ],
+            resource_summary=summary,
+        ),
+    )
+
+
+def deployment_spec(replicas, cpu="1", placement=None):
+    return ResourceBindingSpec(
+        resource=ObjectReference(
+            api_version="apps/v1", kind="Deployment", namespace="default",
+            name="web", uid="uid-1",
+        ),
+        replicas=replicas,
+        replica_requirements=ReplicaRequirements(
+            resource_request={"cpu": parse_quantity(cpu)}
+        ),
+        placement=placement or Placement(),
+    )
+
+
+def schedule(spec, clusters, status=None):
+    cal = serial.make_cal_available([GeneralEstimator()])
+    return serial.schedule(spec, status or ResourceBindingStatus(), clusters, cal)
+
+
+def as_map(result):
+    return {tc.name: tc.replicas for tc in result}
+
+
+def test_duplicated_all_clusters():
+    clusters = [make_cluster("m1"), make_cluster("m2"), make_cluster("m3")]
+    spec = deployment_spec(3)
+    got = schedule(spec, clusters)
+    assert as_map(got) == {"m1": 3, "m2": 3, "m3": 3}
+
+
+def test_api_enablement_filters():
+    c_bad = make_cluster("m2")
+    c_bad.status.api_enablements = []
+    clusters = [make_cluster("m1"), c_bad]
+    got = schedule(deployment_spec(2), clusters)
+    assert as_map(got) == {"m1": 2}
+
+
+def test_taints_filter_and_toleration():
+    tainted = make_cluster("m2", taints=[Taint(key="k", value="v", effect="NoSchedule")])
+    clusters = [make_cluster("m1"), tainted]
+    got = schedule(deployment_spec(1), clusters)
+    assert as_map(got) == {"m1": 1}
+
+    placement = Placement(
+        cluster_tolerations=[Toleration(key="k", operator="Equal", value="v")]
+    )
+    got = schedule(deployment_spec(1, placement=placement), clusters)
+    assert as_map(got) == {"m1": 1, "m2": 1}
+
+
+def test_cluster_affinity_label_selector():
+    from karmada_tpu.models.meta import LabelSelector
+
+    clusters = [
+        make_cluster("m1", labels={"tier": "prod"}),
+        make_cluster("m2", labels={"tier": "dev"}),
+    ]
+    placement = Placement(
+        cluster_affinity=ClusterAffinity(
+            label_selector=LabelSelector(match_labels={"tier": "prod"})
+        )
+    )
+    got = schedule(deployment_spec(2, placement=placement), clusters)
+    assert as_map(got) == {"m1": 2}
+
+
+def test_cluster_affinity_exclude():
+    clusters = [make_cluster("m1"), make_cluster("m2")]
+    placement = Placement(cluster_affinity=ClusterAffinity(exclude_clusters=["m1"]))
+    got = schedule(deployment_spec(2, placement=placement), clusters)
+    assert as_map(got) == {"m2": 2}
+
+
+def test_eviction_filter():
+    clusters = [make_cluster("m1"), make_cluster("m2")]
+    spec = deployment_spec(2)
+    spec.graceful_eviction_tasks = [GracefulEvictionTask(from_cluster="m1")]
+    got = schedule(spec, clusters)
+    assert as_map(got) == {"m2": 2}
+
+
+def test_no_feasible_cluster_raises_fit_error():
+    clusters = [make_cluster("m1", taints=[Taint(key="k", effect="NoSchedule")])]
+    with pytest.raises(serial.FitError):
+        schedule(deployment_spec(1), clusters)
+
+
+def test_dynamic_weight_capacity_division():
+    # capacity cpu: m1=30, m2=60 -> dynamic weights 30:60 for 9 replicas
+    clusters = [make_cluster("m1", cpu="30"), make_cluster("m2", cpu="60")]
+    placement = Placement(
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type="Divided",
+            replica_division_preference="Weighted",
+            weight_preference=ClusterPreferences(dynamic_weight="AvailableReplicas"),
+        )
+    )
+    got = schedule(deployment_spec(9, cpu="1", placement=placement), clusters)
+    assert as_map(got) == {"m1": 3, "m2": 6}
+
+
+def test_aggregated_prefers_fewest_clusters():
+    clusters = [make_cluster("m1", cpu="100"), make_cluster("m2", cpu="10")]
+    placement = Placement(
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type="Divided",
+            replica_division_preference="Aggregated",
+        )
+    )
+    got = schedule(deployment_spec(50, cpu="1", placement=placement), clusters)
+    assert as_map(got) == {"m1": 50}
+
+
+def test_allocated_reduces_capacity():
+    clusters = [make_cluster("m1", cpu="10", allocated_cpu="8")]
+    placement = Placement(
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type="Divided",
+            replica_division_preference="Aggregated",
+        )
+    )
+    got = schedule(deployment_spec(2, cpu="1", placement=placement), clusters)
+    assert as_map(got) == {"m1": 2}
+    with pytest.raises(serial.UnschedulableError):
+        schedule(deployment_spec(3, cpu="1", placement=placement), clusters)
+
+
+def test_spread_by_region_ha():
+    clusters = [
+        make_cluster("a1", region="r1"),
+        make_cluster("a2", region="r1"),
+        make_cluster("b1", region="r2"),
+        make_cluster("c1", region=""),  # filtered: no region property
+    ]
+    placement = Placement(
+        spread_constraints=[
+            SpreadConstraint(spread_by_field="region", min_groups=2, max_groups=2),
+            SpreadConstraint(spread_by_field="cluster", min_groups=2, max_groups=2),
+        ]
+    )
+    got = schedule(deployment_spec(1, placement=placement), clusters)
+    assert len(got) == 2
+    names = set(as_map(got))
+    assert "b1" in names  # one cluster from each region
+    assert names & {"a1", "a2"}
+
+
+def test_scale_up_prefers_scheduled_clusters():
+    # steady scale-up: previously scheduled clusters keep their replicas
+    clusters = [make_cluster("m1", cpu="100"), make_cluster("m2", cpu="100")]
+    placement = Placement(
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type="Divided",
+            replica_division_preference="Aggregated",
+        )
+    )
+    spec = deployment_spec(10, cpu="1", placement=placement)
+    first = schedule(spec, clusters)
+    spec.clusters = first
+    spec.replicas = 20
+    second = schedule(spec, clusters)
+    m = as_map(second)
+    assert sum(m.values()) == 20
+    for name, r in as_map(first).items():
+        assert m.get(name, 0) >= r  # no disruption on scale-up

@@ -1,0 +1,207 @@
+"""Golden tests for spread-constraint group selection, transcribed from
+reference pkg/scheduler/core/spreadconstraint/select_groups_test.go and
+select_clusters_by_cluster/region semantics."""
+
+import pytest
+
+from karmada_tpu.models.cluster import Cluster, ClusterSpec
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    Placement,
+    ReplicaSchedulingStrategy,
+    SpreadConstraint,
+)
+from karmada_tpu.models.work import ResourceBindingSpec, TargetCluster
+from karmada_tpu.ops.serial import (
+    ClusterDetailInfo,
+    GroupClustersInfo,
+    GroupInfo,
+    UnschedulableError,
+    _DfsGroup,
+    select_best_clusters,
+    select_groups,
+)
+
+
+def g(name, value, weight):
+    return _DfsGroup(name=name, value=value, weight=weight)
+
+
+@pytest.mark.parametrize(
+    "groups,min_c,max_c,target,expected",
+    [
+        ([], 2, 3, 1, []),
+        ([g("R1", 1, 80)], 2, 3, 1, []),
+        ([g("R1", 1, 80), g("R2", 2, 30)], 2, 3, 4, []),
+        ([g("R1", 1, 80)], 1, 3, 1, ["R1"]),
+        (
+            [g("R1", 1, 80), g("R3", 1, 80), g("R2", 1, 60), g("R5", 2, 60),
+             g("R4", 5, 50), g("R6", 3, 50)],
+            1, 3, 10, ["R5", "R4", "R6"],
+        ),
+        (
+            [g("R1", 1, 80), g("R2", 4, 40), g("R3", 1, 30), g("R4", 3, 30),
+             g("R5", 3, 20), g("R6", 5, 10)],
+            2, 6, 5, ["R1", "R2"],
+        ),
+        (
+            [g("R1", 1, 60), g("R2", 1, 50), g("R3", 1, 40), g("R4", 3, 30),
+             g("R5", 3, 20), g("R6", 3, 10)],
+            1, 3, 6, ["R1", "R4", "R5"],
+        ),
+        (
+            [g("R1", 1, 60), g("R2", 2, 50), g("R3", 3, 40), g("R4", 4, 30)],
+            1, 2, 5, ["R1", "R4"],
+        ),
+        (
+            [g("R4", 1, 60), g("R3", 3, 50), g("R1", 3, 40), g("R2", 4, 30)],
+            1, 2, 5, ["R3", "R1"],
+        ),
+    ],
+)
+def test_select_groups_golden(groups, min_c, max_c, target, expected):
+    got = [grp.name for grp in select_groups(groups, min_c, max_c, target)]
+    assert got == expected
+
+
+# --- selectBestClustersByCluster -------------------------------------------
+
+
+def detail(name, score, available):
+    return ClusterDetailInfo(
+        name=name,
+        score=score,
+        available_replicas=available,
+        allocatable_replicas=available,
+        cluster=Cluster(metadata=ObjectMeta(name=name)),
+    )
+
+
+def duplicated_placement(min_groups, max_groups):
+    return Placement(
+        spread_constraints=[
+            SpreadConstraint(
+                spread_by_field="cluster", min_groups=min_groups, max_groups=max_groups
+            )
+        ],
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type="Duplicated"
+        ),
+    )
+
+
+def divided_placement(min_groups, max_groups):
+    return Placement(
+        spread_constraints=[
+            SpreadConstraint(
+                spread_by_field="cluster", min_groups=min_groups, max_groups=max_groups
+            )
+        ],
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type="Divided",
+            replica_division_preference="Aggregated",
+        ),
+    )
+
+
+def test_select_by_cluster_duplicated_takes_top_scored():
+    # Duplicated ignores available resource: top MaxGroups by sort order
+    info = GroupClustersInfo(
+        clusters=[detail("m1", 60, 40), detail("m2", 50, 30), detail("m3", 40, 60)]
+    )
+    got = select_best_clusters(duplicated_placement(1, 2), info, 80)
+    assert [c.name for c in got] == ["m1", "m2"]
+
+
+def test_select_by_cluster_capacity_repair():
+    # select_clusters_by_cluster.go:49-57 example: member1+member3 win because
+    # member1+member2 lack capacity for needReplicas=80.
+    info = GroupClustersInfo(
+        clusters=[detail("m1", 60, 40), detail("m2", 50, 30), detail("m3", 40, 60)]
+    )
+    got = select_best_clusters(divided_placement(1, 2), info, 80)
+    assert {c.name for c in got} == {"m1", "m3"}
+
+
+def test_select_by_cluster_min_groups_unsatisfied():
+    info = GroupClustersInfo(clusters=[detail("m1", 60, 40)])
+    with pytest.raises(UnschedulableError):
+        select_best_clusters(duplicated_placement(2, 3), info, 10)
+
+
+def test_select_by_cluster_insufficient_capacity():
+    info = GroupClustersInfo(
+        clusters=[detail("m1", 60, 10), detail("m2", 50, 10), detail("m3", 40, 10)]
+    )
+    with pytest.raises(UnschedulableError):
+        select_best_clusters(divided_placement(1, 2), info, 80)
+
+
+def test_no_spread_constraints_returns_all():
+    info = GroupClustersInfo(
+        clusters=[detail("m1", 60, 40), detail("m2", 50, 30)]
+    )
+    got = select_best_clusters(Placement(), info, 10)
+    assert [c.name for c in got] == ["m1", "m2"]
+
+
+# --- selectBestClustersByRegion ---------------------------------------------
+
+
+def region_info(regions):
+    """regions: {name: (score, [ClusterDetailInfo])}"""
+    info = GroupClustersInfo()
+    for name, (score, clusters) in regions.items():
+        info.regions[name] = GroupInfo(
+            name=name,
+            score=score,
+            available_replicas=sum(c.available_replicas for c in clusters),
+            clusters=clusters,
+        )
+        info.clusters.extend(clusters)
+    return info
+
+
+def region_placement(r_min, r_max, c_min=0, c_max=0):
+    scs = [
+        SpreadConstraint(spread_by_field="region", min_groups=r_min, max_groups=r_max)
+    ]
+    if c_min or c_max:
+        scs.append(
+            SpreadConstraint(spread_by_field="cluster", min_groups=c_min, max_groups=c_max)
+        )
+    return Placement(
+        spread_constraints=scs,
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type="Duplicated"
+        ),
+    )
+
+
+def test_select_by_region_picks_best_cluster_per_region():
+    info = region_info(
+        {
+            "r1": (80, [detail("a1", 60, 10), detail("a2", 50, 10)]),
+            "r2": (60, [detail("b1", 40, 10), detail("b2", 30, 10)]),
+        }
+    )
+    got = select_best_clusters(region_placement(2, 2, 1, 2), info, 5)
+    assert {c.name for c in got} == {"a1", "b1"}
+
+
+def test_select_by_region_fills_extra_clusters():
+    info = region_info(
+        {
+            "r1": (80, [detail("a1", 60, 10), detail("a2", 50, 99)]),
+            "r2": (60, [detail("b1", 40, 10)]),
+        }
+    )
+    got = select_best_clusters(region_placement(2, 2, 1, 3), info, 5)
+    assert [c.name for c in got][:2] == ["a1", "b1"]
+    assert {c.name for c in got} == {"a1", "b1", "a2"}
+
+
+def test_select_by_region_min_groups_unsatisfied():
+    info = region_info({"r1": (80, [detail("a1", 60, 10)])})
+    with pytest.raises(UnschedulableError):
+        select_best_clusters(region_placement(2, 3, 1, 2), info, 5)

@@ -1,0 +1,114 @@
+import pytest
+
+from karmada_tpu.models import Cluster, ResourceBinding
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.store import Event, ObjectStore
+from karmada_tpu.store.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
+from karmada_tpu.store.worker import AsyncWorker, Runtime
+
+
+def _cluster(name: str) -> Cluster:
+    return Cluster(metadata=ObjectMeta(name=name))
+
+
+def test_create_get_list():
+    s = ObjectStore()
+    s.create(_cluster("m1"))
+    s.create(_cluster("m2"))
+    assert s.get("Cluster", "", "m1").name == "m1"
+    assert [c.name for c in s.list("Cluster")] == ["m1", "m2"]
+    with pytest.raises(AlreadyExistsError):
+        s.create(_cluster("m1"))
+    with pytest.raises(NotFoundError):
+        s.get("Cluster", "", "nope")
+
+
+def test_resource_version_and_generation():
+    s = ObjectStore()
+    c = s.create(_cluster("m1"))
+    rv0, gen0 = c.metadata.resource_version, c.metadata.generation
+    assert gen0 == 1
+    c.spec.region = "us-east"
+    c2 = s.update(c)
+    assert c2.metadata.resource_version > rv0
+    assert c2.metadata.generation == gen0 + 1
+    # status-only change does not bump generation
+    c2.status.kubernetes_version = "1.30"
+    c3 = s.update(c2)
+    assert c3.metadata.generation == c2.metadata.generation
+
+
+def test_conflict_on_stale_update():
+    s = ObjectStore()
+    c = s.create(_cluster("m1"))
+    stale = s.get("Cluster", "", "m1")
+    c.spec.region = "a"
+    s.update(c)
+    stale.spec.region = "b"
+    with pytest.raises(ConflictError):
+        s.update(stale)
+
+
+def test_mutate_retries():
+    s = ObjectStore()
+    s.create(_cluster("m1"))
+    s.mutate("Cluster", "", "m1", lambda c: setattr(c.spec, "region", "r1"))
+    assert s.get("Cluster", "", "m1").spec.region == "r1"
+
+
+def test_watch_events():
+    s = ObjectStore()
+    events: list[Event] = []
+    s.bus.subscribe(events.append, kind="Cluster")
+    c = s.create(_cluster("m1"))
+    c.spec.region = "r"
+    c = s.update(c)
+    s.delete("Cluster", "", "m1")
+    assert [e.type for e in events] == [ADDED, MODIFIED, DELETED]
+
+
+def test_finalizer_gated_delete():
+    s = ObjectStore()
+    c = _cluster("m1")
+    c.metadata.finalizers = ["karmada.io/cluster-controller"]
+    c = s.create(c)
+    s.delete("Cluster", "", "m1")
+    obj = s.get("Cluster", "", "m1")  # still present
+    assert obj.metadata.deleting
+    obj.metadata.finalizers = []
+    s.update(obj)
+    assert s.try_get("Cluster", "", "m1") is None
+
+
+def test_worker_dedup_and_retry():
+    seen = []
+
+    def reconcile(key):
+        seen.append(key)
+        if len(seen) == 1:
+            raise RuntimeError("transient")
+        return None
+
+    w = AsyncWorker("t", reconcile, max_retries=3)
+    rt = Runtime()
+    rt.register(w)
+    w.enqueue("a")
+    w.enqueue("a")  # dedup
+    rt.pump()
+    assert seen == ["a", "a"]  # failed once, retried once
+
+
+def test_binding_store_roundtrip():
+    s = ObjectStore()
+    rb = ResourceBinding(metadata=ObjectMeta(name="web-abc", namespace="default"))
+    rb.spec.replicas = 3
+    s.create(rb)
+    got = s.get("ResourceBinding", "default", "web-abc")
+    assert got.spec.replicas == 3
