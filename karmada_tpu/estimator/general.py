@@ -16,7 +16,12 @@ from typing import Dict, List, Optional
 
 from karmada_tpu.models.cluster import Cluster, ResourceSummary
 from karmada_tpu.models.work import ReplicaRequirements, TargetCluster
-from karmada_tpu.utils.quantity import RESOURCE_CPU, RESOURCE_PODS, Quantity
+from karmada_tpu.utils.quantity import (
+    RESOURCE_CPU,
+    RESOURCE_PODS,
+    Quantity,
+    resource_request_value,
+)
 
 # Sentinel meaning "this estimator cannot authenticate a value for the
 # cluster" (client/interface.go:30); consumers skip it when min-merging.
@@ -58,7 +63,7 @@ def max_replicas_from_summary(
     if requirements is None:
         return maximum
     for name, qty in requirements.resource_request.items():
-        requested = qty.milli_value() if name == RESOURCE_CPU else qty.value()
+        requested = resource_request_value(name, qty)
         if requested <= 0:
             continue
         avail_milli = _available(summary, name)
@@ -101,14 +106,13 @@ def _node_available_replicas(
     grade's minimum boundary."""
     maximum_one_node = MAX_INT64
     for name, qty in requirements.resource_request.items():
-        requested = qty.milli_value() if name == RESOURCE_CPU else qty.value()
+        requested = resource_request_value(name, qty)
         if requested <= 0:
             continue
         grades = min_map.get(name)
         if grades is None or grade_index >= len(grades):
             continue
-        avail_q = grades[grade_index]
-        available = avail_q.milli_value() if name == RESOURCE_CPU else avail_q.value()
+        available = resource_request_value(name, grades[grade_index])
         maximum_one_node = min(maximum_one_node, available // requested)
     # first suitable model counts as able to host at least one pod
     return 1 if maximum_one_node == 0 else maximum_one_node
@@ -125,7 +129,7 @@ def max_replicas_from_models(
     min_map = _models_min_map(cluster)
     min_index = 0
     for name, qty in requirements.resource_request.items():
-        if (qty.milli_value() if name == RESOURCE_CPU else qty.value()) <= 0:
+        if resource_request_value(name, qty) <= 0:
             continue
         grades = min_map.get(name)
         if grades is None:

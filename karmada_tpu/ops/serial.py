@@ -770,6 +770,11 @@ def make_cal_available(estimators) -> Callable:
                     continue
                 if out[i].name == tc.name and out[i].replicas > tc.replicas:
                     out[i].replicas = tc.replicas
+        # leftover MaxInt32 (no estimator authenticated a value) clamps to
+        # spec.replicas to avoid overflow (core/util.go:104-109)
+        for tc in out:
+            if tc.replicas == MAX_INT32:
+                tc.replicas = spec.replicas
         return out
 
     return cal

@@ -77,6 +77,32 @@ class ObjectStore:
         self._rv = 0
         self._lock = threading.RLock()
         self.bus = bus or WatchBus()
+        # Events are enqueued under self._lock (in resourceVersion order) and
+        # drained under _pub_lock, so concurrent writers can never deliver a
+        # newer rv to subscribers before an older one.  _drain is re-entrancy
+        # safe: a subscriber callback that writes to the store enqueues and
+        # returns; the outer drain delivers its event.
+        self._pending_events: List[Event] = []
+        self._pub_lock = threading.Lock()
+        self._draining: Optional[int] = None  # thread id of active drainer
+
+    def _drain(self) -> None:
+        me = threading.get_ident()
+        if self._draining == me:
+            return  # re-entrant write from a subscriber callback
+        with self._pub_lock:
+            self._draining = me
+            try:
+                while True:
+                    # pop one at a time: if a subscriber raises, events not
+                    # yet popped stay queued for the next writer's drain
+                    with self._lock:
+                        if not self._pending_events:
+                            break
+                        ev = self._pending_events.pop(0)
+                    self.bus.publish(ev)
+            finally:
+                self._draining = None
 
     # -- internal ----------------------------------------------------------
     def _key(self, obj: TypedObject) -> Tuple[str, str, str]:
@@ -100,7 +126,8 @@ class ObjectStore:
             obj.metadata.resource_version = self._next_rv()
             self._objects[key] = obj
             stored = copy.deepcopy(obj)
-        self.bus.publish(Event(ADDED, stored))
+            self._pending_events.append(Event(ADDED, stored))
+        self._drain()
         return stored
 
     def get(self, kind: str, namespace: str, name: str) -> TypedObject:
@@ -158,7 +185,8 @@ class ObjectStore:
                 stored = copy.deepcopy(obj)
                 old_copy = copy.deepcopy(old)
                 event = Event(MODIFIED, stored, old_copy)
-        self.bus.publish(event)
+            self._pending_events.append(event)
+        self._drain()
         return stored
 
     def mutate(self, kind: str, namespace: str, name: str, fn: Callable[[TypedObject], None],
@@ -194,7 +222,8 @@ class ObjectStore:
                 obj.metadata.deletion_timestamp = obj.metadata.deletion_timestamp or now()
                 stored = copy.deepcopy(obj)
                 event = Event(DELETED, stored)
-        self.bus.publish(event)
+            self._pending_events.append(event)
+        self._drain()
 
     def items(self) -> Iterator[TypedObject]:
         with self._lock:

@@ -236,3 +236,28 @@ def test_scale_up_prefers_scheduled_clusters():
     assert sum(m.values()) == 20
     for name, r in as_map(first).items():
         assert m.get(name, 0) >= r  # no disruption on scale-up
+
+
+def test_cal_available_clamps_unauthentic_to_spec_replicas():
+    """core/util.go:104-109: clusters no estimator authenticated keep
+    spec.replicas, not MaxInt32, so Aggregated ordering matches the
+    reference."""
+    from karmada_tpu.models.work import TargetCluster
+
+    class HalfBlind:
+        def max_available_replicas(self, clusters, requirements):
+            # authenticates only the first cluster
+            out = [TargetCluster(name=c.name, replicas=-1) for c in clusters]
+            out[0].replicas = 7
+            return out
+
+    from karmada_tpu.models.cluster import Cluster
+    from karmada_tpu.models.meta import ObjectMeta
+    from karmada_tpu.models.work import ObjectReference, ResourceBindingSpec
+    from karmada_tpu.ops.serial import make_cal_available
+
+    clusters = [Cluster(metadata=ObjectMeta(name=n)) for n in ("a", "b")]
+    spec = ResourceBindingSpec(resource=ObjectReference(uid="u"), replicas=12)
+    cal = make_cal_available([HalfBlind()])
+    got = {tc.name: tc.replicas for tc in cal(clusters, spec)}
+    assert got == {"a": 7, "b": 12}
