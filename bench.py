@@ -1,0 +1,233 @@
+"""Benchmark: batched TPU scheduling vs the serial control path.
+
+Reproduces the BASELINE.md synthetic stress config: a mixed fleet of
+PropagationPolicy styles (Duplicated / StaticWeight / DynamicWeight /
+Aggregated, with and without cluster spread constraints) over a large member
+fleet, scheduled end to end (encode -> jitted solve -> decode), chunked so
+device memory stays bounded.  The serial baseline runs the identical
+scenario through ops/serial.schedule on a subsample and is extrapolated.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": bindings/s (batched, end-to-end),
+   "unit": "bindings/s", "vs_baseline": speedup vs serial path,
+   ...detail fields...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import time
+
+import numpy as np
+
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.models.cluster import (
+    APIEnablement,
+    Cluster,
+    ClusterSpec,
+    ClusterStatus,
+    ResourceSummary,
+)
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_DIVISION_AGGREGATED,
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+    REPLICA_SCHEDULING_DUPLICATED,
+    SPREAD_BY_FIELD_CLUSTER,
+    ClusterAffinity,
+    ClusterPreferences,
+    Placement,
+    ReplicaSchedulingStrategy,
+    SpreadConstraint,
+)
+from karmada_tpu.models.work import (
+    ObjectReference,
+    ReplicaRequirements,
+    ResourceBindingSpec,
+    ResourceBindingStatus,
+)
+from karmada_tpu.ops import serial, tensors
+from karmada_tpu.ops.solver import solve
+from karmada_tpu.utils.quantity import Quantity
+
+GVK = ("apps/v1", "Deployment")
+
+
+def build_fleet(rng: random.Random, n_clusters: int):
+    clusters = []
+    for i in range(n_clusters):
+        clusters.append(
+            Cluster(
+                metadata=ObjectMeta(name=f"member-{i:05d}"),
+                spec=ClusterSpec(region=f"r{i % 8}", provider=f"p{i % 3}"),
+                status=ClusterStatus(
+                    api_enablements=[APIEnablement(GVK[0], [GVK[1]])],
+                    resource_summary=ResourceSummary(
+                        allocatable={
+                            "cpu": Quantity.from_milli(rng.randint(16000, 128000)),
+                            "memory": Quantity.from_units(rng.randint(64, 512)),
+                            "pods": Quantity.from_units(rng.randint(110, 256)),
+                        },
+                        allocated={
+                            "cpu": Quantity.from_milli(rng.randint(0, 8000)),
+                            "memory": Quantity.from_units(rng.randint(0, 32)),
+                            "pods": Quantity.from_units(rng.randint(0, 40)),
+                        },
+                    ),
+                ),
+            )
+        )
+    return clusters
+
+
+def build_placements(rng: random.Random, names):
+    """The BASELINE.md config mix; affinity subsets keep fan-out realistic."""
+    placements = []
+
+    def subset_affinity():
+        k = rng.randint(3, min(24, len(names)))
+        start = rng.randrange(len(names))
+        picked = [names[(start + j) % len(names)] for j in range(k)]
+        return ClusterAffinity(cluster_names=picked)
+
+    for _ in range(8):  # Duplicated across an affinity subset
+        placements.append(Placement(
+            cluster_affinity=subset_affinity(),
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED),
+        ))
+    for _ in range(8):  # StaticWeight split
+        placements.append(Placement(
+            cluster_affinity=subset_affinity(),
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+            ),
+        ))
+    for _ in range(8):  # DynamicWeight over the whole fleet
+        placements.append(Placement(
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+            ),
+        ))
+    for _ in range(8):  # Aggregated with a cluster spread constraint
+        placements.append(Placement(
+            spread_constraints=[SpreadConstraint(
+                spread_by_field=SPREAD_BY_FIELD_CLUSTER, min_groups=2, max_groups=6)],
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_AGGREGATED,
+            ),
+        ))
+    return placements
+
+
+def build_bindings(rng: random.Random, n_bindings: int, placements):
+    items = []
+    for b in range(n_bindings):
+        spec = ResourceBindingSpec(
+            resource=ObjectReference(
+                api_version=GVK[0], kind=GVK[1], namespace=f"ns-{b % 64}",
+                name=f"app-{b}", uid=f"uid-{b}",
+            ),
+            replicas=rng.choice([1, 2, 3, 5, 10, 20, 50]),
+            replica_requirements=ReplicaRequirements(resource_request={
+                "cpu": Quantity.from_milli(rng.choice([100, 250, 500])),
+                "memory": Quantity.from_units(rng.choice([1, 2, 4])),
+            }),
+            placement=placements[b % len(placements)],
+        )
+        items.append((spec, ResourceBindingStatus()))
+    return items
+
+
+def run_batched(items, cindex, estimator, chunk: int):
+    """Returns (elapsed_s, solve_s, scheduled_count)."""
+    n = len(items)
+    scheduled = 0
+    t0 = time.perf_counter()
+    solve_s = 0.0
+    for lo in range(0, n, chunk):
+        part = items[lo : lo + chunk]
+        batch = tensors.encode_batch(part, cindex, estimator)
+        t1 = time.perf_counter()
+        rep, sel, status = solve(batch)
+        solve_s += time.perf_counter() - t1
+        ok = status[: batch.n_bindings] == tensors.STATUS_OK
+        scheduled += int(ok.sum())
+        # vectorized decode cost (targets per binding) is part of the loop
+        rows, cols = np.nonzero(rep[: batch.n_bindings, : batch.n_clusters] > 0)
+        _ = rows.shape[0] + cols.shape[0]
+    return time.perf_counter() - t0, solve_s, scheduled
+
+
+def run_serial(items, clusters, estimator):
+    cal = serial.make_cal_available([estimator])
+    t0 = time.perf_counter()
+    n_ok = 0
+    for spec, status in items:
+        try:
+            serial.schedule(spec, status, clusters, cal)
+            n_ok += 1
+        except Exception:  # noqa: BLE001
+            pass
+    return time.perf_counter() - t0, n_ok
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bindings", type=int, default=100_000)
+    ap.add_argument("--clusters", type=int, default=5_000)
+    ap.add_argument("--chunk", type=int, default=4096)
+    ap.add_argument("--serial-sample", type=int, default=64)
+    ap.add_argument("--quick", action="store_true", help="small smoke config")
+    args = ap.parse_args()
+    if args.quick:
+        args.bindings, args.clusters, args.chunk = 2048, 256, 1024
+        args.serial_sample = 32
+
+    rng = random.Random(0)
+    clusters = build_fleet(rng, args.clusters)
+    placements = build_placements(rng, [c.name for c in clusters])
+    items = build_bindings(rng, args.bindings, placements)
+    estimator = GeneralEstimator()
+    cindex = tensors.ClusterIndex.build(clusters)
+
+    # warmup: compile the chunk shape once (cached afterwards)
+    warm = items[: min(args.chunk, len(items))]
+    run_batched(warm, cindex, estimator, args.chunk)
+
+    elapsed, solve_s, scheduled = run_batched(items, cindex, estimator, args.chunk)
+    throughput = args.bindings / elapsed
+
+    sample = items[:: max(1, len(items) // args.serial_sample)][: args.serial_sample]
+    serial_elapsed, _ = run_serial(sample, clusters, estimator)
+    serial_throughput = len(sample) / serial_elapsed if serial_elapsed > 0 else 0.0
+    speedup = throughput / serial_throughput if serial_throughput > 0 else 0.0
+
+    print(json.dumps({
+        "metric": f"scheduled bindings/sec, {args.bindings} bindings x "
+                  f"{args.clusters} clusters (end-to-end batched)",
+        "value": round(throughput, 1),
+        "unit": "bindings/s",
+        "vs_baseline": round(speedup, 2),
+        "detail": {
+            "batched_elapsed_s": round(elapsed, 3),
+            "batched_solve_s": round(solve_s, 3),
+            "scheduled_ok": scheduled,
+            "serial_bindings_per_s": round(serial_throughput, 2),
+            "serial_sample": len(sample),
+            "chunk": args.chunk,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -95,8 +95,13 @@ def webster_divide(
     # -- 1. divisor bisection: T s.t. #[candidates with priority > T] <= n --
     def count(T: jnp.ndarray) -> jnp.ndarray:
         x = w.astype(jnp.float64) / T
-        cnt0 = jnp.minimum(jnp.maximum(jnp.ceil((x - 1.0) * 0.5), 0.0), nf)
-        c = jnp.maximum(cnt0.astype(jnp.int64) - s0, 0)
+        # clamp AFTER subtracting s0 (to n new seats); the pre-cast clamp at
+        # nf + s0 only guards the float->int64 cast against overflow
+        cnt0 = jnp.minimum(
+            jnp.maximum(jnp.ceil((x - 1.0) * 0.5), 0.0),
+            nf + s0.astype(jnp.float64),
+        )
+        c = jnp.minimum(jnp.maximum(cnt0.astype(jnp.int64) - s0, 0), n_eff)
         return jnp.where(active & (w > 0), c, 0)
 
     def bis(state, _):
@@ -201,3 +206,311 @@ def webster_divide(
 
 # vmapped over a batch of problems: n[B], w[B,C], s0[B,C], active[B,C], rank[B,C]
 webster_divide_batch = jax.vmap(webster_divide, in_axes=(0, 0, 0, 0, 0, None))
+
+
+# ---------------------------------------------------------------------------
+# Batched scheduling pipeline
+# ---------------------------------------------------------------------------
+#
+# One jitted program per scheduling cycle over the dense SolverBatch encoding
+# (ops/tensors.py): filter masks -> locality scores -> GeneralEstimator
+# capacity math (pkg/estimator/client/general.go:294) -> cluster-field spread
+# selection (select_clusters_by_cluster.go:25) -> replica division strategies
+# (assignment.go / division_algorithm.go) via the Webster kernel above.
+
+# strategy / status ids mirrored from ops/tensors.py (kept in sync by tests)
+STRAT_DUPLICATED = 0
+STRAT_STATIC = 1
+STRAT_DYNAMIC = 2
+STRAT_AGGREGATED = 3
+
+STATUS_OK = 0
+STATUS_FIT_ERROR = 1
+STATUS_UNSCHEDULABLE = 2
+STATUS_NO_CLUSTER = 3
+
+_AVAIL_BITS = 34  # avail values clamped below 2^34 for key packing
+_AVAIL_CAP = (1 << _AVAIL_BITS) - 1
+
+
+def _capacity_estimates(
+    req_milli, req_is_cpu, avail_milli, has_alloc, pods_allowed, has_summary
+):
+    """est[Q+1, C]: GeneralEstimator summary math (general.go:56-94,294-334).
+
+    Row Q is the requirements==None row: min(allowed pods, MaxInt32).
+    """
+    Q, R = req_milli.shape
+    C = avail_milli.shape[0]
+    # per-resource available in request units: cpu keeps milli, others ceil
+    unit_avail = jnp.where(
+        req_is_cpu[None, :], avail_milli, -((-avail_milli) // 1000)
+    )  # [C, R]
+    req = req_milli[:, None, :]  # [Q, 1, R]
+    avail = unit_avail[None, :, :]  # [1, C, R]
+    ok = has_alloc[None, :, :] & (avail > 0)
+    cnt = jnp.where(ok, avail // jnp.maximum(req, 1), 0)  # [Q, C, R]
+    cnt = jnp.where(req > 0, cnt, MAX_INT64)  # unrequested resources inert
+    est = jnp.min(cnt, axis=2)  # [Q, C]
+    est = jnp.minimum(est, pods_allowed[None, :])
+    est = jnp.where(has_summary[None, :] & (pods_allowed[None, :] > 0), est, 0)
+    est = jnp.minimum(jnp.maximum(est, 0), MAX_INT32)
+    none_row = jnp.where(
+        has_summary & (pods_allowed > 0), jnp.minimum(pods_allowed, MAX_INT32), 0
+    )
+    return jnp.concatenate([est, none_row[None, :]], axis=0)  # [Q+1, C]
+
+
+def _positions(key: jnp.ndarray) -> jnp.ndarray:
+    C = key.shape[0]
+    order = jnp.argsort(key)
+    return jnp.zeros((C,), jnp.int64).at[order].set(jnp.arange(C, dtype=jnp.int64))
+
+
+def _select_by_cluster(
+    feasible, score, avail, name_rank, n_need, sc_min, sc_max, ignore_avail
+):
+    """Port of select_clusters_by_cluster.go:25-105 as masked tensor ops.
+
+    Returns (selected mask, unschedulable flag).  Selection is by the packed
+    key (score desc, available desc, name asc); when capacity matters, the
+    swap loop replaces low-ranked picks with higher-capacity leftovers
+    exactly like _select_by_available_resource in ops/serial.py.
+    """
+    C = feasible.shape[0]
+    BIG = jnp.int64(1) << 62
+    fcount = jnp.sum(feasible)
+    avail_c = jnp.clip(avail, 0, _AVAIL_CAP)
+    key = (
+        ((200 - score).astype(jnp.int64) << 47)
+        | ((_AVAIL_CAP - avail_c) << 13)
+        | name_rank
+    )
+    key = jnp.where(feasible, key, BIG)
+    pos = _positions(key)
+    order = jnp.argsort(key)
+    need_cnt = jnp.minimum(jnp.asarray(sc_max, jnp.int64), fcount)
+    sel0 = feasible & (pos < need_cnt)
+
+    def swap_loop(args):
+        in_sel, rest_pos, update_id = args
+
+        def cond(st):
+            in_sel, _, update_id = st
+            total = jnp.sum(jnp.where(in_sel, avail, 0))
+            return (total < n_need) & (update_id >= 0)
+
+        def body(st):
+            in_sel, rest_pos, update_id = st
+            cur = order[update_id]
+            rest = feasible & ~in_sel
+            # max avail, ties to smallest rest position (serial list order)
+            cand = jnp.where(
+                rest, (avail_c << 13) | (8191 - jnp.clip(rest_pos, 0, 8191)), -1
+            )
+            best = jnp.argmax(cand)
+            found = (cand[best] >= 0) & (avail[best] > avail[cur])
+            in_sel = jnp.where(
+                found,
+                in_sel.at[best].set(True).at[cur].set(False),
+                in_sel,
+            )
+            rest_pos = jnp.where(
+                found, rest_pos.at[cur].set(rest_pos[best]), rest_pos
+            )
+            return in_sel, rest_pos, update_id - 1
+
+        return lax.while_loop(cond, body, (in_sel, rest_pos, update_id))
+
+    in_sel, _, _ = lax.cond(
+        ignore_avail,
+        lambda a: a,
+        swap_loop,
+        (sel0, pos, need_cnt.astype(jnp.int64) - 1),
+    )
+    total = jnp.sum(jnp.where(in_sel, avail, 0))
+    unsched = (fcount < sc_min) | (~ignore_avail & (total < n_need))
+    return in_sel, unsched
+
+
+def _schedule_one(
+    feasible, avail_cal, prev_present, prev_rep, name_rank,
+    n, strategy, has_sc, sc_min, sc_max, ignore_avail,
+    static_w, uid_desc, fresh, non_workload, valid,
+):
+    """One binding against [C] cluster lanes; vmapped over the batch."""
+    C = feasible.shape[0]
+    i64 = lambda x: jnp.asarray(x, jnp.int64)
+    n = i64(n)
+
+    fcount = jnp.sum(feasible)
+    has_prev = jnp.any(prev_present)
+    score = jnp.where(has_prev & prev_present, 100, 0).astype(jnp.int64)
+
+    # ---- selection -------------------------------------------------------
+    sel_sc, unsched_sel = _select_by_cluster(
+        feasible, score, avail_cal + prev_rep * prev_present, name_rank,
+        n, i64(sc_min), i64(sc_max), ignore_avail,
+    )
+    sel = jnp.where(has_sc, sel_sc, feasible)
+    unsched_sel = has_sc & unsched_sel
+    sel_count = jnp.sum(sel)
+
+    # ---- assignment ------------------------------------------------------
+    rank_eff = jnp.where(uid_desc, C - 1 - name_rank, name_rank)
+    scheduled_rep = jnp.where(sel & prev_present, prev_rep, 0)
+    assigned = jnp.sum(scheduled_rep)
+
+    is_dynamic = (strategy == STRAT_DYNAMIC) | (strategy == STRAT_AGGREGATED)
+    scale_down = is_dynamic & ~fresh & (assigned > n)
+    scale_up = is_dynamic & ~fresh & (assigned < n)
+    steady_eq = is_dynamic & ~fresh & (assigned == n)
+    is_fresh = is_dynamic & fresh
+
+    # webster problem per strategy (selected branchlessly)
+    static_eff = static_w * sel
+    static_eff = jnp.where(jnp.sum(static_eff) > 0, static_eff, sel.astype(jnp.int64))
+
+    w = jnp.zeros((C,), jnp.int64)
+    w = jnp.where(strategy == STRAT_STATIC, static_eff, w)
+    w = jnp.where(is_fresh, avail_cal * sel + scheduled_rep, w)
+    w = jnp.where(scale_up, avail_cal * sel, w)
+    w = jnp.where(scale_down, jnp.where(prev_present, prev_rep, 0), w)
+
+    active = sel
+    active = jnp.where(scale_down, prev_present, active)
+
+    target = jnp.where(strategy == STRAT_STATIC, n, 0)
+    target = jnp.where(is_fresh | scale_down, n, target)
+    target = jnp.where(scale_up, n - assigned, target)
+
+    base = jnp.where(scale_up | steady_eq, scheduled_rep, 0)
+
+    avail_sum = jnp.sum(w)
+    unsched_div = is_dynamic & (avail_sum < target)
+
+    # Aggregated: trim to the capacity-descending prefix reaching target
+    # (division_algorithm.go:80-90 + resortAvailableClusters assignment.go:145)
+    prior = scale_up & (scheduled_rep > 0)
+    wc = jnp.clip(w, 0, _AVAIL_CAP)
+    agg_key = (
+        (jnp.where(prior, 0, 1).astype(jnp.int64) << 48)
+        | ((_AVAIL_CAP - wc) << 13)
+        | name_rank
+    )
+    agg_key = jnp.where(active, agg_key, (jnp.int64(1) << 62))
+    agg_pos = _positions(agg_key)
+    w_sorted = jnp.zeros((C,), jnp.int64).at[agg_pos].set(jnp.where(active, w, 0))
+    cum_excl = jnp.cumsum(w_sorted) - w_sorted
+    include_sorted = cum_excl < target
+    inc = include_sorted[agg_pos]
+    use_prefix = (strategy == STRAT_AGGREGATED) & (is_fresh | scale_up | scale_down)
+    w = jnp.where(use_prefix, jnp.where(inc, w, 0), w)
+    active = jnp.where(use_prefix, active & inc, active)
+
+    run_webster = (
+        valid
+        & ~non_workload
+        & (
+            (strategy == STRAT_STATIC)
+            | ((is_fresh | scale_up | scale_down) & ~unsched_div)
+        )
+    )
+    seats = webster_divide(
+        jnp.where(run_webster, target, 0), w, jnp.zeros((C,), jnp.int64),
+        active & run_webster, rank_eff,
+    )
+
+    rep = base + seats
+    rep = jnp.where(strategy == STRAT_DUPLICATED, n * sel, rep)
+    rep = jnp.where(non_workload, 0, rep)
+
+    status = jnp.where(
+        fcount == 0,
+        STATUS_FIT_ERROR,
+        jnp.where(
+            unsched_sel | unsched_div,
+            STATUS_UNSCHEDULABLE,
+            jnp.where(sel_count == 0, STATUS_NO_CLUSTER, STATUS_OK),
+        ),
+    )
+    status = jnp.where(valid, status, STATUS_OK).astype(jnp.int32)
+    rep = jnp.where((status == STATUS_OK) & valid, rep, 0)
+    sel = sel & (status == STATUS_OK) & valid
+    return rep, sel, status
+
+
+_schedule_vmap = jax.vmap(
+    _schedule_one,
+    in_axes=(0, 0, 0, 0, None, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0),
+)
+
+
+@jax.jit
+def schedule_batch(
+    # cluster axis
+    cluster_valid, deleting, name_rank, pods_allowed, has_summary,
+    avail_milli, has_alloc, api_ok,
+    # request classes
+    req_milli, req_is_cpu, est_override,
+    # placements
+    pl_mask, pl_tol_bypass, pl_strategy, pl_static_w,
+    pl_has_cluster_sc, pl_sc_min, pl_sc_max, pl_ignore_avail,
+    # bindings
+    b_valid, placement_id, gvk_id, class_id, replicas, uid_desc, fresh,
+    non_workload, nw_shortcut, prev_rep, prev_present, evict,
+):
+    """The full cycle: returns (rep[B,C] int64, selected[B,C] bool, status[B])."""
+    est_q = _capacity_estimates(
+        req_milli, req_is_cpu, avail_milli, has_alloc, pods_allowed, has_summary
+    )
+    Q = req_milli.shape[0]
+    est_q = est_q.at[:Q].set(jnp.where(est_override >= 0, est_override, est_q[:Q]))
+
+    # per-binding gathers
+    cid = jnp.where(class_id >= 0, class_id, Q)
+    est_b = est_q[cid]  # [B, C]
+    # calAvailableReplicas (util.go:104): clamp leftover MaxInt32 to replicas,
+    # EXCEPT the non-workload shortcut, which early-returns unclamped
+    avail_cal = jnp.where(est_b == MAX_INT32, replicas[:, None], est_b)
+    avail_cal = jnp.where(nw_shortcut[:, None], MAX_INT32, avail_cal)
+
+    lanes_ok = cluster_valid[None, :] & ~deleting[None, :]
+    feasible = (
+        lanes_ok
+        & pl_mask[placement_id]
+        & (pl_tol_bypass[placement_id] | prev_present)
+        & (api_ok[gvk_id] | prev_present)
+        & ~evict
+    )
+
+    rep, sel, status = _schedule_vmap(
+        feasible, avail_cal, prev_present, prev_rep, name_rank,
+        replicas, pl_strategy[placement_id], pl_has_cluster_sc[placement_id],
+        pl_sc_min[placement_id], pl_sc_max[placement_id],
+        pl_ignore_avail[placement_id], pl_static_w[placement_id],
+        uid_desc, fresh, non_workload, b_valid,
+    )
+    return rep, sel, status
+
+
+def solve(batch):
+    """Run schedule_batch over an ops/tensors.SolverBatch; numpy results."""
+    import numpy as np
+
+    # packed sort keys reserve 13 bits for the cluster lane
+    assert batch.C <= 8192, "cluster axis must be <= 8192 per solve call"
+
+    rep, sel, status = schedule_batch(
+        batch.cluster_valid, batch.deleting, batch.name_rank,
+        batch.pods_allowed, batch.has_summary, batch.avail_milli,
+        batch.has_alloc, batch.api_ok,
+        batch.req_milli, batch.req_is_cpu, batch.est_override,
+        batch.pl_mask, batch.pl_tol_bypass, batch.pl_strategy,
+        batch.pl_static_w, batch.pl_has_cluster_sc, batch.pl_sc_min,
+        batch.pl_sc_max, batch.pl_ignore_avail,
+        batch.b_valid, batch.placement_id, batch.gvk_id, batch.class_id,
+        batch.replicas, batch.uid_desc, batch.fresh, batch.non_workload,
+        batch.nw_shortcut, batch.prev_rep, batch.prev_present, batch.evict,
+    )
+    return np.asarray(rep), np.asarray(sel), np.asarray(status)

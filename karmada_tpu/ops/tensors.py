@@ -1,0 +1,493 @@
+"""Snapshot encoder: clusters + pending bindings -> dense solver tensors.
+
+The reference scheduler evaluates (binding, cluster) pairs one binding at a
+time (pkg/scheduler/core/generic_scheduler.go:71).  The TPU path instead
+encodes one scheduling cycle as dense arrays and solves every binding in one
+jitted program (ops/solver.schedule_batch).  Encoding exploits the natural
+dedup axes of the domain:
+
+  * placements dedupe to P rows (bindings created by the same policy share
+    affinity / toleration / spread / strategy configuration) -- all
+    cluster-level predicates are evaluated host-side once per placement,
+    O(P x C), not per binding;
+  * replica requirements dedupe to Q request classes -- the capacity
+    estimate est[Q, C] is computed once on device and gathered per binding;
+  * clusters encode to capacity rows avail[C, R] (milli-units, int64) plus
+    a host-computed override for clusters using resource-model histograms
+    (pkg/estimator/client/general.go:336 math stays bit-equal via
+    estimator/general.py).
+
+Bindings the kernel cannot represent (region/provider/zone spread
+constraints requiring the group-selection DFS, multi-component workloads)
+are routed back to the serial host path; `route` marks them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.models.cluster import Cluster
+from karmada_tpu.models.policy import (
+    REPLICA_SCHEDULING_DUPLICATED,
+    SPREAD_BY_FIELD_CLUSTER,
+    SPREAD_BY_FIELD_PROVIDER,
+    SPREAD_BY_FIELD_REGION,
+    SPREAD_BY_FIELD_ZONE,
+    Placement,
+)
+from karmada_tpu.models.work import (
+    ResourceBindingSpec,
+    ResourceBindingStatus,
+    TargetCluster,
+)
+from karmada_tpu.ops import serial
+from karmada_tpu.ops.webster import tiebreak_descending_by_uid
+from karmada_tpu.utils.quantity import RESOURCE_CPU, RESOURCE_PODS
+
+MAX_INT32 = (1 << 31) - 1
+
+# strategy ids (solver-side dispatch)
+STRAT_DUPLICATED = 0
+STRAT_STATIC = 1
+STRAT_DYNAMIC = 2
+STRAT_AGGREGATED = 3
+STRAT_NON_WORKLOAD = 4
+
+# route reasons
+ROUTE_DEVICE = 0
+ROUTE_TOPOLOGY_SPREAD = 1  # region/provider/zone spread -> serial DFS
+ROUTE_MULTI_COMPONENT = 2
+ROUTE_UNSUPPORTED = 3
+
+# result status codes (must match ops/solver.py)
+STATUS_OK = 0
+STATUS_FIT_ERROR = 1
+STATUS_UNSCHEDULABLE = 2
+STATUS_NO_CLUSTER = 3
+
+
+def _next_pow2(n: int, lo: int = 1) -> int:
+    v = lo
+    while v < n:
+        v *= 2
+    return v
+
+
+@dataclass
+class ClusterIndex:
+    """Host-side cluster catalogue for one scheduling cycle."""
+
+    clusters: List[Cluster]
+    names: List[str]
+    index: Dict[str, int]
+    name_rank: np.ndarray  # int64[C]: position in ascending name sort
+
+    @staticmethod
+    def build(clusters: Sequence[Cluster]) -> "ClusterIndex":
+        clusters = list(clusters)
+        names = [c.name for c in clusters]
+        order = sorted(range(len(names)), key=lambda i: names[i])
+        rank = np.zeros(len(names), np.int64)
+        for pos, i in enumerate(order):
+            rank[i] = pos
+        return ClusterIndex(clusters, names, {n: i for i, n in enumerate(names)}, rank)
+
+
+@dataclass
+class SolverBatch:
+    """Dense pytree for ops/solver.schedule_batch (numpy; moved by jit)."""
+
+    # shapes
+    B: int  # padded bindings
+    C: int  # padded clusters
+    n_bindings: int
+    n_clusters: int
+
+    # cluster axis
+    cluster_valid: np.ndarray  # bool[C]
+    deleting: np.ndarray  # bool[C]
+    name_rank: np.ndarray  # int64[C]
+    pods_allowed: np.ndarray  # int64[C] (0 when no summary)
+    has_summary: np.ndarray  # bool[C]
+    avail_milli: np.ndarray  # int64[C, R] available milli per resource
+    has_alloc: np.ndarray  # bool[C, R] allocatable present
+    api_ok: np.ndarray  # bool[G, C]
+
+    # request classes
+    req_milli: np.ndarray  # int64[Q, R] requested (cpu: milli, other: units)
+    req_is_cpu: np.ndarray  # bool[R]
+    est_override: np.ndarray  # int64[Q, C]; >=0 overrides device estimate
+
+    # placements
+    pl_mask: np.ndarray  # bool[P, C] affinity & toleration & spread-prop
+    pl_tol_bypass: np.ndarray  # bool[P, C] passes api/taint WITHOUT prev bypass
+    pl_strategy: np.ndarray  # int32[P]
+    pl_static_w: np.ndarray  # int64[P, C]
+    pl_has_cluster_sc: np.ndarray  # bool[P]
+    pl_sc_min: np.ndarray  # int32[P]
+    pl_sc_max: np.ndarray  # int32[P]
+    pl_ignore_avail: np.ndarray  # bool[P] (duplicated: capacity ignored)
+
+    # binding axis
+    b_valid: np.ndarray  # bool[B]
+    placement_id: np.ndarray  # int32[B]
+    gvk_id: np.ndarray  # int32[B]
+    class_id: np.ndarray  # int32[B] (-1: no requirements)
+    replicas: np.ndarray  # int64[B]
+    uid_desc: np.ndarray  # bool[B]
+    fresh: np.ndarray  # bool[B]
+    non_workload: np.ndarray  # bool[B]
+    nw_shortcut: np.ndarray  # bool[B] replicas==0 and no components (cal fast path)
+    prev_rep: np.ndarray  # int64[B, C] previous assignment (dense)
+    prev_present: np.ndarray  # bool[B, C] name listed in spec.clusters
+    evict: np.ndarray  # bool[B, C]
+
+    # host-side routing / metadata
+    route: np.ndarray = field(default=None)  # int32[n_bindings] ROUTE_*
+    cluster_index: ClusterIndex = field(default=None)
+
+
+def _effective_placement(
+    spec: ResourceBindingSpec, status: ResourceBindingStatus
+) -> Placement:
+    """Resolve ClusterAffinities terms to the observed one (the scheduler
+    service drives the failover loop; the kernel sees one affinity)."""
+    placement = spec.placement or Placement()
+    if placement.cluster_affinity is not None or not placement.cluster_affinities:
+        return placement
+    affinity = None
+    for term in placement.cluster_affinities:
+        if term.affinity_name == status.scheduler_observed_affinity_name:
+            affinity = term.affinity
+            break
+    out = Placement(
+        cluster_affinity=affinity,
+        cluster_tolerations=placement.cluster_tolerations,
+        spread_constraints=placement.spread_constraints,
+        replica_scheduling=placement.replica_scheduling,
+    )
+    return out
+
+
+def _placement_key(p: Placement) -> str:
+    return repr(p)
+
+
+def _route_for(spec: ResourceBindingSpec, placement: Placement) -> int:
+    if len(spec.components) > 1:
+        return ROUTE_MULTI_COMPONENT
+    scs = placement.spread_constraints
+    if scs and not serial.should_ignore_spread_constraint(placement):
+        for sc in scs:
+            if sc.spread_by_field in (
+                SPREAD_BY_FIELD_REGION,
+                SPREAD_BY_FIELD_PROVIDER,
+                SPREAD_BY_FIELD_ZONE,
+            ):
+                return ROUTE_TOPOLOGY_SPREAD
+            if sc.spread_by_label:
+                return ROUTE_UNSUPPORTED
+    return ROUTE_DEVICE
+
+
+def encode_batch(
+    items: Sequence[Tuple[ResourceBindingSpec, ResourceBindingStatus]],
+    cindex: ClusterIndex,
+    estimator: Optional[GeneralEstimator] = None,
+    pad_bindings: bool = True,
+) -> SolverBatch:
+    """Encode one scheduling cycle.  `items` are (spec, status) pairs."""
+    estimator = estimator or GeneralEstimator()
+    clusters = cindex.clusters
+    nC = len(clusters)
+    C = _next_pow2(max(nC, 1), 8)
+    nB = len(items)
+    B = _next_pow2(max(nB, 1), 8) if pad_bindings else max(nB, 1)
+
+    # ---- cluster axis -----------------------------------------------------
+    cluster_valid = np.zeros(C, bool)
+    cluster_valid[:nC] = True
+    deleting = np.zeros(C, bool)
+    pods_allowed = np.zeros(C, np.int64)
+    has_summary = np.zeros(C, bool)
+    name_rank = np.full(C, 0, np.int64)
+    name_rank[:nC] = cindex.name_rank
+    # padding lanes need distinct ranks above real ones
+    name_rank[nC:] = np.arange(nC, C)
+    for i, c in enumerate(clusters):
+        deleting[i] = c.metadata.deleting
+        s = c.status.resource_summary
+        if s is not None:
+            has_summary[i] = True
+            pods_allowed[i] = _allowed_pods(s)
+
+    # resource vocabulary: everything any request mentions
+    placements: List[Placement] = []
+    pkeys: Dict[str, int] = {}
+    gvks: Dict[Tuple[str, str], int] = {}
+    classes: Dict[Tuple, int] = {}
+    class_reqs: List = []
+    res_names: Dict[str, int] = {}
+
+    route = np.zeros(nB, np.int32)
+    placement_id = np.zeros(B, np.int32)
+    gvk_id = np.zeros(B, np.int32)
+    class_id = np.full(B, -1, np.int32)
+    replicas = np.zeros(B, np.int64)
+    uid_desc = np.zeros(B, bool)
+    fresh = np.zeros(B, bool)
+    non_workload = np.zeros(B, bool)
+    nw_shortcut = np.zeros(B, bool)
+    b_valid = np.zeros(B, bool)
+    b_valid[:nB] = True
+    prev_rep = np.zeros((B, C), np.int64)
+    prev_present = np.zeros((B, C), bool)
+    evict = np.zeros((B, C), bool)
+
+    eff_placements: List[Placement] = []
+    for b, (spec, status) in enumerate(items):
+        placement = _effective_placement(spec, status)
+        eff_placements.append(placement)
+        route[b] = _route_for(spec, placement)
+        key = _placement_key(placement)
+        if key not in pkeys:
+            pkeys[key] = len(placements)
+            placements.append(placement)
+        placement_id[b] = pkeys[key]
+
+        g = (spec.resource.api_version, spec.resource.kind)
+        if g not in gvks:
+            gvks[g] = len(gvks)
+        gvk_id[b] = gvks[g]
+
+        rr = spec.replica_requirements
+        if rr is not None and rr.resource_request:
+            ck = tuple(sorted((n, q.milli) for n, q in rr.resource_request.items()))
+            if ck not in classes:
+                classes[ck] = len(classes)
+                class_reqs.append(rr)
+                for n in rr.resource_request:
+                    if n not in res_names:
+                        res_names[n] = len(res_names)
+            class_id[b] = classes[ck]
+
+        replicas[b] = spec.replicas
+        uid_desc[b] = tiebreak_descending_by_uid(spec.resource.uid)
+        fresh[b] = serial.reschedule_required(spec, status)
+        is_workload = (spec.replicas > 0 or rr is not None) and len(spec.components) <= 1
+        non_workload[b] = not is_workload
+        nw_shortcut[b] = spec.replicas == 0 and not spec.components
+        # NOTE: prev entries naming clusters absent from the current snapshot
+        # are dropped (the dense encoding cannot address them); the reference
+        # can in principle re-assign to a vanished cluster during scale-down.
+        for tc in spec.clusters:
+            ci = cindex.index.get(tc.name)
+            if ci is not None:
+                prev_rep[b, ci] = tc.replicas
+                prev_present[b, ci] = True
+        for task in spec.graceful_eviction_tasks:
+            ci = cindex.index.get(task.from_cluster)
+            if ci is not None:
+                evict[b, ci] = True
+
+    # ---- capacity tensors -------------------------------------------------
+    R = max(len(res_names), 1)
+    Q = max(len(class_reqs), 1)
+    avail_milli = np.zeros((C, R), np.int64)
+    has_alloc = np.zeros((C, R), bool)
+    req_is_cpu = np.zeros(R, bool)
+    for n, r in res_names.items():
+        req_is_cpu[r] = n == RESOURCE_CPU
+    for i, c in enumerate(clusters):
+        s = c.status.resource_summary
+        if s is None:
+            continue
+        for n, r in res_names.items():
+            alloc = s.allocatable.get(n)
+            if alloc is None:
+                continue
+            has_alloc[i, r] = True
+            m = alloc.milli
+            used = s.allocated.get(n)
+            if used is not None:
+                m -= used.milli
+            ing = s.allocating.get(n)
+            if ing is not None:
+                m -= ing.milli
+            avail_milli[i, r] = m
+
+    req_milli = np.zeros((Q, R), np.int64)
+    for q, rr in enumerate(class_reqs):
+        for n, qty in rr.resource_request.items():
+            r = res_names[n]
+            req_milli[q, r] = qty.milli_value() if n == RESOURCE_CPU else qty.value()
+
+    # histogram-modeled clusters: host-side exact override (general.go:336)
+    est_override = np.full((Q, C), -1, np.int64)
+    for i, c in enumerate(clusters):
+        if (
+            estimator.enable_resource_modeling
+            and c.status.resource_summary is not None
+            and c.status.resource_summary.allocatable_modelings
+        ):
+            for q, rr in enumerate(class_reqs):
+                est_override[q, i] = estimator._max_for_cluster(c, rr)
+
+    # ---- placement axis ---------------------------------------------------
+    P = max(len(placements), 1)
+    pl_mask = np.zeros((P, C), bool)
+    pl_tol_bypass = np.zeros((P, C), bool)
+    pl_strategy = np.zeros(P, np.int32)
+    pl_static_w = np.zeros((P, C), np.int64)
+    pl_has_cluster_sc = np.zeros(P, bool)
+    pl_sc_min = np.zeros(P, np.int32)
+    pl_sc_max = np.zeros(P, np.int32)
+    pl_ignore_avail = np.zeros(P, bool)
+
+    dummy_status = ResourceBindingStatus()
+    for p, placement in enumerate(placements):
+        strategy = serial.strategy_type(_spec_with(placement))
+        pl_strategy[p] = {
+            serial.DUPLICATED: STRAT_DUPLICATED,
+            serial.STATIC_WEIGHT: STRAT_STATIC,
+            serial.DYNAMIC_WEIGHT: STRAT_DYNAMIC,
+            serial.AGGREGATED: STRAT_AGGREGATED,
+        }.get(strategy, STRAT_DUPLICATED)
+        pl_ignore_avail[p] = serial.should_ignore_available_resource(placement)
+        if not serial.should_ignore_spread_constraint(placement):
+            for sc in placement.spread_constraints:
+                if sc.spread_by_field == SPREAD_BY_FIELD_CLUSTER:
+                    pl_has_cluster_sc[p] = True
+                    pl_sc_min[p] = sc.min_groups
+                    pl_sc_max[p] = sc.max_groups
+
+        probe = _spec_with(placement)
+        for i, c in enumerate(clusters):
+            # affinity + spread-property predicates (no prev bypass exists)
+            ok = (
+                serial.filter_cluster_affinity(probe, dummy_status, c) is None
+                and serial.filter_spread_constraint(probe, dummy_status, c) is None
+            )
+            pl_mask[p, i] = ok
+            # taint toleration WITHOUT the target_contains bypass
+            pl_tol_bypass[p, i] = _tolerated(placement, c)
+
+        # static weights (division_algorithm.go:38-72), rule match per cluster
+        s = placement.replica_scheduling
+        wl = (
+            s.weight_preference.static_weight_list
+            if s is not None and s.weight_preference is not None
+            else []
+        )
+        if pl_strategy[p] == STRAT_STATIC:
+            if not wl:
+                pl_static_w[p, :nC] = 1
+            else:
+                for i, c in enumerate(clusters):
+                    weight = 0
+                    for rule in wl:
+                        if rule.target_cluster.matches(c):
+                            weight = max(weight, rule.weight)
+                    pl_static_w[p, i] = weight
+
+    # ---- api enablement ---------------------------------------------------
+    G = max(len(gvks), 1)
+    api_ok = np.zeros((G, C), bool)
+    for (api_version, kind), g in gvks.items():
+        for i, c in enumerate(clusters):
+            api_ok[g, i] = c.api_enablement(api_version, kind) == serial.API_ENABLED
+
+    return SolverBatch(
+        B=B, C=C, n_bindings=nB, n_clusters=nC,
+        cluster_valid=cluster_valid, deleting=deleting, name_rank=name_rank,
+        pods_allowed=pods_allowed, has_summary=has_summary,
+        avail_milli=avail_milli, has_alloc=has_alloc, api_ok=api_ok,
+        req_milli=req_milli, req_is_cpu=req_is_cpu, est_override=est_override,
+        pl_mask=pl_mask, pl_tol_bypass=pl_tol_bypass, pl_strategy=pl_strategy,
+        pl_static_w=pl_static_w, pl_has_cluster_sc=pl_has_cluster_sc,
+        pl_sc_min=pl_sc_min, pl_sc_max=pl_sc_max, pl_ignore_avail=pl_ignore_avail,
+        b_valid=b_valid, placement_id=placement_id, gvk_id=gvk_id,
+        class_id=class_id, replicas=replicas, uid_desc=uid_desc, fresh=fresh,
+        non_workload=non_workload, nw_shortcut=nw_shortcut,
+        prev_rep=prev_rep, prev_present=prev_present, evict=evict,
+        route=route, cluster_index=cindex,
+    )
+
+
+def _spec_with(placement: Placement) -> ResourceBindingSpec:
+    return ResourceBindingSpec(placement=placement)
+
+
+def _allowed_pods(summary) -> int:
+    from karmada_tpu.estimator.general import allowed_pod_number
+
+    return allowed_pod_number(summary)
+
+
+def _tolerated(placement: Placement, cluster: Cluster) -> bool:
+    """TaintToleration predicate (without the per-binding prev bypass)."""
+    from karmada_tpu.models.cluster import EFFECT_NO_EXECUTE, EFFECT_NO_SCHEDULE
+
+    tolerations = placement.cluster_tolerations
+    for taint in cluster.spec.taints:
+        if taint.effect not in (EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE):
+            continue
+        if not any(t.tolerates(taint) for t in tolerations):
+            return False
+    return True
+
+
+def decode_result(
+    batch: SolverBatch,
+    rep: np.ndarray,
+    selected: np.ndarray,
+    status: np.ndarray,
+    *,
+    enable_empty_workload_propagation: bool = False,
+) -> List:
+    """Dense solver output -> per-binding List[TargetCluster] or an error.
+
+    Returns a list of length n_bindings whose entries are either
+    List[TargetCluster] (name-ascending) or an Exception mirroring the
+    serial path (FitError / UnschedulableError).
+    """
+    names = batch.cluster_index.names
+    out: List = []
+    rep = np.asarray(rep)
+    selected = np.asarray(selected)
+    status = np.asarray(status)
+    for b in range(batch.n_bindings):
+        st = int(status[b])
+        if st == STATUS_FIT_ERROR:
+            out.append(serial.FitError({}))
+            continue
+        if st == STATUS_UNSCHEDULABLE:
+            out.append(serial.UnschedulableError("insufficient capacity (batched)"))
+            continue
+        if st == STATUS_NO_CLUSTER:
+            out.append(serial.NoClusterAvailableError("no clusters available to schedule"))
+            continue
+        row = rep[b]
+        targets = [
+            TargetCluster(name=names[i], replicas=int(row[i]))
+            for i in np.nonzero(row[: batch.n_clusters] > 0)[0]
+        ]
+        if batch.non_workload[b]:
+            targets = [
+                TargetCluster(name=names[i], replicas=0)
+                for i in np.nonzero(selected[b, : batch.n_clusters])[0]
+            ]
+        elif enable_empty_workload_propagation:
+            have = {t.name for t in targets}
+            targets += [
+                TargetCluster(name=names[i], replicas=0)
+                for i in np.nonzero(selected[b, : batch.n_clusters])[0]
+                if names[i] not in have
+            ]
+        targets.sort(key=lambda t: t.name)
+        out.append(targets)
+    return out

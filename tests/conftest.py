@@ -7,7 +7,24 @@ path via __graft_entry__.dryrun_multichip).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Drop any tunnel-backed accelerator plugin (e.g. the axon TPU proxy) so the
+# suite never blocks on remote tunnel health: backends() would otherwise
+# initialise every registered factory even under JAX_PLATFORMS=cpu.
+try:
+    from jax._src import xla_bridge as _xb
+
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name not in ("cpu", "interpreter"):
+            _xb._backend_factories.pop(_name, None)
+    # a tunnel sitecustomize may have imported jax before this file ran,
+    # freezing jax_platforms from the outer environment
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+except Exception:  # pragma: no cover - best effort
+    pass

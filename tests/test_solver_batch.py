@@ -1,0 +1,273 @@
+"""Golden parity: batched TPU pipeline vs the serial control path.
+
+Randomized scenarios within the device-supported class (no topology-spread
+DFS, single component) must produce identical schedule results -- same
+target clusters, same replica counts, same error classes -- as
+ops/serial.schedule, binding by binding.
+"""
+
+import random
+
+import pytest
+
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.models.cluster import (
+    AllocatableModeling,
+    APIEnablement,
+    Cluster,
+    ClusterSpec,
+    ClusterStatus,
+    ResourceModel,
+    ResourceModelRange,
+    ResourceSummary,
+    Taint,
+)
+from karmada_tpu.models.meta import LabelSelector, ObjectMeta
+from karmada_tpu.models.policy import (
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_DIVISION_AGGREGATED,
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+    REPLICA_SCHEDULING_DUPLICATED,
+    SPREAD_BY_FIELD_CLUSTER,
+    ClusterAffinity,
+    ClusterPreferences,
+    Placement,
+    ReplicaSchedulingStrategy,
+    SpreadConstraint,
+    StaticClusterWeight,
+    Toleration,
+)
+from karmada_tpu.models.work import (
+    GracefulEvictionTask,
+    ObjectReference,
+    ReplicaRequirements,
+    ResourceBindingSpec,
+    ResourceBindingStatus,
+    TargetCluster,
+)
+from karmada_tpu.ops import serial
+from karmada_tpu.ops import tensors
+from karmada_tpu.ops.solver import solve
+from karmada_tpu.utils.quantity import Quantity
+
+GVK = ("apps/v1", "Deployment")
+
+
+def mk_cluster(rng, name):
+    labels = {}
+    if rng.random() < 0.5:
+        labels["tier"] = rng.choice(["gold", "silver"])
+    taints = []
+    if rng.random() < 0.3:
+        taints.append(Taint(key="dedicated", value="infra", effect="NoSchedule"))
+    summary = None
+    models = []
+    if rng.random() < 0.9:
+        summary = ResourceSummary(
+            allocatable={
+                "cpu": Quantity.from_milli(rng.randint(0, 64000)),
+                "memory": Quantity.from_units(rng.randint(0, 256)),
+                "pods": Quantity.from_units(rng.randint(0, 200)),
+            },
+            allocated={
+                "cpu": Quantity.from_milli(rng.randint(0, 16000)),
+                "memory": Quantity.from_units(rng.randint(0, 64)),
+                "pods": Quantity.from_units(rng.randint(0, 50)),
+            },
+        )
+        if rng.random() < 0.2:
+            # histogram-modeled cluster: exercises the host override path
+            models = [
+                ResourceModel(grade=0, ranges=[
+                    ResourceModelRange("cpu", Quantity.from_milli(0), Quantity.from_milli(2000)),
+                    ResourceModelRange("memory", Quantity.from_units(0), Quantity.from_units(8)),
+                ]),
+                ResourceModel(grade=1, ranges=[
+                    ResourceModelRange("cpu", Quantity.from_milli(2000), Quantity.from_milli(64000)),
+                    ResourceModelRange("memory", Quantity.from_units(8), Quantity.from_units(256)),
+                ]),
+            ]
+            summary.allocatable_modelings = [
+                AllocatableModeling(grade=0, count=rng.randint(0, 5)),
+                AllocatableModeling(grade=1, count=rng.randint(0, 5)),
+            ]
+    enablements = [APIEnablement(GVK[0], [GVK[1]])] if rng.random() < 0.9 else []
+    meta = ObjectMeta(name=name, labels=labels)
+    if rng.random() < 0.05:
+        meta.deletion_timestamp = 1.0
+    return Cluster(
+        metadata=meta,
+        spec=ClusterSpec(
+            region=rng.choice(["us", "eu"]),
+            provider=rng.choice(["aws", ""]),
+            taints=taints,
+            resource_models=models,
+        ),
+        status=ClusterStatus(api_enablements=enablements, resource_summary=summary),
+    )
+
+
+def mk_placement(rng, names):
+    affinity = None
+    r = rng.random()
+    if r < 0.3:
+        affinity = ClusterAffinity(cluster_names=rng.sample(names, rng.randint(1, len(names))))
+    elif r < 0.5:
+        affinity = ClusterAffinity(label_selector=LabelSelector(match_labels={"tier": "gold"}))
+    tolerations = []
+    if rng.random() < 0.5:
+        tolerations.append(Toleration(key="dedicated", operator="Exists"))
+    spread = []
+    if rng.random() < 0.4:
+        mn = rng.randint(1, 3)
+        spread.append(SpreadConstraint(
+            spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+            min_groups=mn, max_groups=rng.randint(mn, 5),
+        ))
+    strat = rng.choice(["dup", "static", "dynamic", "agg"])
+    if strat == "dup":
+        rs = ReplicaSchedulingStrategy(replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED)
+    elif strat == "static":
+        wl = []
+        if rng.random() < 0.7:
+            for nm in rng.sample(names, rng.randint(1, len(names))):
+                wl.append(StaticClusterWeight(
+                    target_cluster=ClusterAffinity(cluster_names=[nm]),
+                    weight=rng.randint(0, 3),
+                ))
+        rs = ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+            weight_preference=ClusterPreferences(static_weight_list=wl) if wl else None,
+        )
+    elif strat == "dynamic":
+        rs = ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+            weight_preference=ClusterPreferences(dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+        )
+    else:
+        rs = ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_AGGREGATED,
+        )
+    return Placement(
+        cluster_affinity=affinity,
+        cluster_tolerations=tolerations,
+        spread_constraints=spread,
+        replica_scheduling=rs,
+    )
+
+
+def mk_binding(rng, b, names, placements):
+    reqs = None
+    if rng.random() < 0.7:
+        reqs = ReplicaRequirements(resource_request={
+            "cpu": Quantity.from_milli(rng.choice([100, 250, 500, 1000])),
+            "memory": Quantity.from_units(rng.choice([1, 2, 4])),
+        })
+    spec = ResourceBindingSpec(
+        resource=ObjectReference(
+            api_version=GVK[0], kind=GVK[1], namespace="default",
+            name=f"app-{b}", uid=f"uid-{rng.randint(0, 10**9)}",
+        ),
+        replicas=rng.choice([0, 1, 3, 10, 40]),
+        replica_requirements=reqs,
+        placement=rng.choice(placements),
+    )
+    status = ResourceBindingStatus()
+    if rng.random() < 0.4:  # previous assignment (steady-mode paths)
+        prev = rng.sample(names, rng.randint(1, min(3, len(names))))
+        spec.clusters = [TargetCluster(name=n, replicas=rng.randint(0, 20)) for n in prev]
+        status.last_scheduled_time = 100.0
+        if rng.random() < 0.3:  # reschedule trigger -> Fresh mode
+            spec.reschedule_triggered_at = 200.0
+    if rng.random() < 0.15:
+        spec.graceful_eviction_tasks = [
+            GracefulEvictionTask(from_cluster=rng.choice(names))
+        ]
+    return spec, status
+
+
+def run_parity(seed, n_clusters=8, n_bindings=24):
+    rng = random.Random(seed)
+    names = [f"member-{i:02d}" for i in range(n_clusters)]
+    clusters = [mk_cluster(rng, nm) for nm in names]
+    placements = [mk_placement(rng, names) for _ in range(5)]
+    items = [mk_binding(rng, b, names, placements) for b in range(n_bindings)]
+
+    estimator = GeneralEstimator()
+    cal = serial.make_cal_available([estimator])
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch(items, cindex, estimator)
+    assert (batch.route == tensors.ROUTE_DEVICE).all(), "scenario must stay on-device"
+    rep, sel, status = solve(batch)
+    got = tensors.decode_result(batch, rep, sel, status)
+
+    for b, (spec, st) in enumerate(items):
+        try:
+            want = serial.schedule(spec, st, clusters, cal)
+        except Exception as e:  # noqa: BLE001
+            assert isinstance(got[b], type(e)), (
+                f"seed={seed} b={b}: serial raised {type(e).__name__}, "
+                f"device gave {got[b]!r}"
+            )
+            continue
+        assert not isinstance(got[b], Exception), (
+            f"seed={seed} b={b}: serial={want}, device error {got[b]!r}"
+        )
+        want_map = {tc.name: tc.replicas for tc in want}
+        got_map = {tc.name: tc.replicas for tc in got[b]}
+        assert got_map == want_map, (
+            f"seed={seed} b={b} strat={serial.strategy_type(spec)}: "
+            f"serial={want_map} device={got_map}"
+        )
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_batch_parity_random(seed):
+    run_parity(seed)
+
+
+def test_capacity_matches_general_estimator():
+    rng = random.Random(7)
+    names = [f"m{i}" for i in range(12)]
+    clusters = [mk_cluster(rng, nm) for nm in names]
+    reqs = ReplicaRequirements(resource_request={
+        "cpu": Quantity.from_milli(300), "memory": Quantity.from_units(2),
+    })
+    spec = ResourceBindingSpec(
+        resource=ObjectReference(api_version=GVK[0], kind=GVK[1], name="x", uid="u"),
+        replicas=5, replica_requirements=reqs,
+        placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+            weight_preference=ClusterPreferences(dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+        )),
+    )
+    est = GeneralEstimator()
+    cal = serial.make_cal_available([est])
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch([(spec, ResourceBindingStatus())], cindex, est)
+    rep, sel, status = solve(batch)
+    got = tensors.decode_result(batch, rep, sel, status)[0]
+    want = serial.schedule(spec, ResourceBindingStatus(), clusters, cal)
+    assert {t.name: t.replicas for t in got} == {t.name: t.replicas for t in want}
+
+
+def test_topology_spread_routes_to_host():
+    rng = random.Random(3)
+    names = ["a", "b"]
+    clusters = [mk_cluster(rng, nm) for nm in names]
+    spec = ResourceBindingSpec(
+        resource=ObjectReference(api_version=GVK[0], kind=GVK[1], name="x", uid="u"),
+        replicas=4,
+        placement=Placement(spread_constraints=[
+            SpreadConstraint(spread_by_field="region", min_groups=1, max_groups=2),
+            SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_CLUSTER, min_groups=1, max_groups=2),
+        ]),
+    )
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch([(spec, ResourceBindingStatus())], cindex)
+    assert batch.route[0] == tensors.ROUTE_TOPOLOGY_SPREAD

@@ -140,3 +140,27 @@ def test_batch_vmap():
         for i, nm in enumerate(names):
             expect = want.get(nm, init.get(nm, 0)) if want else init.get(nm, 0)
             assert int(seats[b, i]) == expect, (b, votes, init, int(n[b]))
+
+
+def test_large_initial_seats_regression():
+    """Bisection count() must clamp to n AFTER subtracting initial seats;
+    a large s0 once made the kernel award seats the serial dispenser never
+    gives (kernel {a:266,b:34} vs serial {a:300,b:0})."""
+    votes = {"a": 1000, "b": 1}
+    init = {"a": 100}
+    got = run_kernel(200, votes, init)
+    assert got == serial(200, votes, init)
+    assert got == {"a": 300, "b": 0}
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_property_large_init(seed):
+    rng = random.Random(1000 + seed)
+    names = [f"c{i}" for i in range(rng.randint(1, 6))]
+    votes = {nm: rng.randint(0, 5000) for nm in names}
+    init = {nm: rng.randint(0, 500) for nm in rng.sample(names, rng.randint(1, len(names)))}
+    n = rng.randint(0, 800)
+    got = run_kernel(n, votes, init, pad_to=8)
+    want = serial(n, votes, init)
+    for nm in names:
+        assert got[nm] == want.get(nm, 0), (seed, n, votes, init, got, want)
