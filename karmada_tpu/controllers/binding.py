@@ -1,0 +1,174 @@
+"""ResourceBinding controller: render per-cluster Work objects.
+
+Mirrors reference pkg/controllers/binding/binding_controller.go:71-198 +
+common.go:51-151 ensureWork: merge RequiredBy snapshots into the target
+list, revise replicas via the interpreter for Divided scheduling
+(common.go:81-89), divide Job completions (:95-108), apply override
+policies (:112), and write one Work per target cluster into the cluster's
+execution namespace (karmada-es-<cluster>); stale Works for dropped
+clusters are removed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from karmada_tpu.controllers.override import OverrideManager
+from karmada_tpu.interpreter import ResourceInterpreter
+from karmada_tpu.models.policy import (
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+)
+from karmada_tpu.models.unstructured import Unstructured
+from karmada_tpu.models.work import (
+    ResourceBinding,
+    TargetCluster,
+    Work,
+    WorkSpec,
+    merge_target_clusters,
+)
+from karmada_tpu.ops.webster import dispense_by_weight
+from karmada_tpu.store.store import Event, NotFoundError, ObjectStore
+from karmada_tpu.store.worker import AsyncWorker, Runtime
+
+EXECUTION_NS_PREFIX = "karmada-es-"
+WORK_BINDING_LABEL = "resourcebinding.karmada.io/key"
+
+
+def execution_namespace(cluster: str) -> str:
+    return EXECUTION_NS_PREFIX + cluster
+
+
+def work_name(binding: ResourceBinding) -> str:
+    ns = binding.spec.resource.namespace
+    return f"{ns}-{binding.spec.resource.name}-{binding.spec.resource.kind.lower()}"
+
+
+class BindingController:
+    def __init__(
+        self,
+        store: ObjectStore,
+        runtime: Runtime,
+        interpreter: Optional[ResourceInterpreter] = None,
+    ) -> None:
+        self.store = store
+        self.interpreter = interpreter or ResourceInterpreter()
+        self.overrides = OverrideManager(store)
+        self.worker = runtime.register(AsyncWorker("binding", self._reconcile))
+        store.bus.subscribe(self._on_event)
+
+    def _on_event(self, event: Event) -> None:
+        if event.kind == ResourceBinding.KIND:
+            self.worker.enqueue((event.obj.namespace, event.obj.name))
+        elif event.kind in ("OverridePolicy", "ClusterOverridePolicy"):
+            for rb in self.store.list(ResourceBinding.KIND):
+                self.worker.enqueue((rb.namespace, rb.name))
+
+    # -- helpers ------------------------------------------------------------
+    def _divided(self, rb: ResourceBinding) -> bool:
+        placement = rb.spec.placement
+        return (
+            placement is not None
+            and placement.replica_scheduling is not None
+            and placement.replica_scheduling.replica_scheduling_type
+            == REPLICA_SCHEDULING_DIVIDED
+        )
+
+    def _target_clusters(self, rb: ResourceBinding) -> List[TargetCluster]:
+        """mergeTargetClusters (common.go:56-66): RequiredBy joins targets."""
+        targets = list(rb.spec.clusters)
+        for snapshot in rb.spec.required_by:
+            targets = merge_target_clusters(targets, snapshot.clusters)
+        return targets
+
+    def _job_completions(
+        self, rb: ResourceBinding, manifest: Dict, targets: List[TargetCluster]
+    ) -> Dict[str, int]:
+        """divideReplicasByJobCompletions (common.go:95-108): completions
+        split by the same Webster weights as the replica division."""
+        from karmada_tpu.models.meta import deep_get
+
+        completions = deep_get(manifest, "spec.completions")
+        if manifest.get("kind") != "Job" or completions is None or not self._divided(rb):
+            return {}
+        weights = {t.name: t.replicas for t in targets}
+        return dispense_by_weight(int(completions), weights, None, rb.spec.resource.uid)
+
+    # -- reconcile ----------------------------------------------------------
+    def _reconcile(self, key) -> None:
+        ns, name = key
+        rb = self.store.try_get(ResourceBinding.KIND, ns, name)
+        wname = None
+        if rb is not None:
+            wname = work_name(rb)
+        if rb is None or rb.metadata.deleting:
+            self._remove_works(ns, name, keep=set())
+            return
+        resource = rb.spec.resource
+        template = self.store.try_get(resource.kind, resource.namespace, resource.name)
+        if template is None or not isinstance(template, Unstructured):
+            return
+        from karmada_tpu.interpreter.interpreter import prune_for_propagation
+
+        manifest = prune_for_propagation(template.to_manifest())
+        targets = self._target_clusters(rb)
+        completions = self._job_completions(rb, manifest, targets)
+
+        eviction = {t.from_cluster for t in rb.spec.graceful_eviction_tasks}
+        keep = set()
+        for target in targets:
+            m = dict(manifest)
+            if self._divided(rb) and rb.spec.replicas > 0:
+                m = self.interpreter.revise_replica(m, target.replicas)
+            if target.name in completions:
+                m = self.interpreter.revise_job_completions(m, completions[target.name])
+            m = self.overrides.apply(m, self._cluster(target.name))
+            suspend = self._suspended(rb, target.name)
+            self._ensure_work(rb, target.name, m, suspend)
+            keep.add(target.name)
+        # graceful eviction: keep the old Work until the task drains
+        keep |= eviction
+        self._remove_works(ns, name, keep, wname)
+
+    def _suspended(self, rb: ResourceBinding, cluster: str) -> bool:
+        s = rb.spec.suspension
+        if s is None:
+            return False
+        if s.dispatching:
+            return True
+        return cluster in (s.dispatching_on_clusters or [])
+
+    def _cluster(self, name: str):
+        return self.store.try_get("Cluster", "", name)
+
+    def _ensure_work(self, rb: ResourceBinding, cluster: str, manifest, suspend: bool) -> None:
+        ns = execution_namespace(cluster)
+        name = work_name(rb)
+        label_val = f"{rb.namespace}.{rb.name}"
+        existing = self.store.try_get(Work.KIND, ns, name)
+        if existing is None:
+            w = Work()
+            w.metadata.namespace = ns
+            w.metadata.name = name
+            w.metadata.labels[WORK_BINDING_LABEL] = label_val
+            w.spec = WorkSpec(workload=[manifest], suspend_dispatching=suspend)
+            self.store.create(w)
+        else:
+            def update(w):
+                w.metadata.labels[WORK_BINDING_LABEL] = label_val
+                w.spec.workload = [manifest]
+                w.spec.suspend_dispatching = suspend
+            self.store.mutate(Work.KIND, ns, name, update)
+
+    def _remove_works(self, rb_ns: str, rb_name: str, keep, wname=None) -> None:
+        label_val = f"{rb_ns}.{rb_name}"
+        for w in self.store.list(Work.KIND):
+            if w.metadata.labels.get(WORK_BINDING_LABEL) != label_val:
+                continue
+            cluster = w.metadata.namespace[len(EXECUTION_NS_PREFIX):]
+            if cluster in keep:
+                continue
+            try:
+                self.store.delete(Work.KIND, w.metadata.namespace, w.name)
+            except NotFoundError:
+                pass

@@ -1,0 +1,216 @@
+"""ResourceDetector: match templates to policies, emit ResourceBindings.
+
+Mirrors reference pkg/detector/detector.go: watches every template kind
+(dynamic informers, :183), matches template<->policy (LookForMatchedPolicy
+:382 -- namespaced PropagationPolicy beats ClusterPropagationPolicy;
+explicit priority, then name-selector specificity, then alphabetical),
+claims the object, and builds the ResourceBinding (BuildResourceBinding
+:793) with replicas/requirements from the resource interpreter
+(applyReplicaInterpretation :1455).  Policy create/update fans out to all
+matching templates (:991); policy delete releases claims and GCs bindings.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from karmada_tpu.controllers.override import selector_matches
+from karmada_tpu.interpreter import ResourceInterpreter
+from karmada_tpu.models.meta import OwnerReference
+from karmada_tpu.models.policy import (
+    ClusterPropagationPolicy,
+    PropagationPolicy,
+    ResourceSelector,
+)
+from karmada_tpu.models.unstructured import Unstructured
+from karmada_tpu.models.work import (
+    BindingSuspension,
+    ObjectReference,
+    ResourceBinding,
+    ResourceBindingSpec,
+)
+from karmada_tpu.store.store import DELETED, Event, NotFoundError, ObjectStore
+from karmada_tpu.store.worker import AsyncWorker, Runtime
+
+# claim labels (reference pkg/util/constants: PropagationPolicy labels)
+POLICY_LABEL = "propagationpolicy.karmada.io/permanent-id"
+CLUSTER_POLICY_LABEL = "clusterpropagationpolicy.karmada.io/permanent-id"
+BINDING_POLICY_LABEL = POLICY_LABEL
+
+# kinds owned by the framework itself -- never treated as templates
+FRAMEWORK_KINDS = {
+    "Cluster", "PropagationPolicy", "ClusterPropagationPolicy",
+    "OverridePolicy", "ClusterOverridePolicy", "ResourceBinding",
+    "ClusterResourceBinding", "Work", "FederatedResourceQuota",
+    "WorkloadRebalancer", "FederatedHPA", "CronFederatedHPA", "Remedy",
+    "ClusterTaintPolicy", "MultiClusterService", "ResourceRegistry",
+}
+
+
+def binding_name(kind: str, name: str) -> str:
+    """names.GenerateBindingName: lowercase kind suffix."""
+    return f"{name}-{kind.lower()}"
+
+
+def _selector_specificity(sel: ResourceSelector) -> int:
+    """name match > label-selector match > kind-wide (detector/policy.go)."""
+    if sel.name:
+        return 2
+    if sel.label_selector is not None:
+        return 1
+    return 0
+
+
+class ResourceDetector:
+    def __init__(
+        self,
+        store: ObjectStore,
+        runtime: Runtime,
+        interpreter: Optional[ResourceInterpreter] = None,
+    ) -> None:
+        self.store = store
+        self.interpreter = interpreter or ResourceInterpreter()
+        self.worker = runtime.register(AsyncWorker("detector", self._reconcile))
+        self.policy_worker = runtime.register(
+            AsyncWorker("detector-policy", self._reconcile_policy)
+        )
+        store.bus.subscribe(self._on_event)
+
+    # -- event wiring -------------------------------------------------------
+    def _on_event(self, event: Event) -> None:
+        kind = event.kind
+        if kind in (PropagationPolicy.KIND, ClusterPropagationPolicy.KIND):
+            self.policy_worker.enqueue((kind, event.obj.namespace, event.obj.name,
+                                        event.type == DELETED))
+            return
+        if kind in FRAMEWORK_KINDS or not isinstance(event.obj, Unstructured):
+            return
+        self.worker.enqueue((kind, event.obj.namespace, event.obj.name))
+
+    # -- policy fan-out -----------------------------------------------------
+    def _reconcile_policy(self, key) -> None:
+        kind, namespace, name, deleted = key
+        if deleted:
+            label = POLICY_LABEL if kind == PropagationPolicy.KIND else CLUSTER_POLICY_LABEL
+            uid = f"{namespace}/{name}" if namespace else name
+            for rb in self.store.list(ResourceBinding.KIND):
+                if rb.metadata.labels.get(label) == uid:
+                    try:
+                        self.store.delete(ResourceBinding.KIND, rb.namespace, rb.name)
+                    except NotFoundError:
+                        pass
+        # re-evaluate every template (policy preemption/claim updates)
+        for obj in self.store.items():
+            if isinstance(obj, Unstructured) and obj.KIND not in FRAMEWORK_KINDS:
+                self.worker.enqueue((obj.KIND, obj.namespace, obj.name))
+
+    # -- template reconcile -------------------------------------------------
+    def _matched_policies(
+        self, obj: Unstructured
+    ) -> Tuple[Optional[PropagationPolicy], Optional[ClusterPropagationPolicy]]:
+        manifest = obj.to_manifest()
+
+        def best(policies):
+            matched = []
+            for p in policies:
+                for sel in p.spec.resource_selectors:
+                    if selector_matches(sel, manifest):
+                        matched.append((p.spec.priority, _selector_specificity(sel), p))
+                        break
+            if not matched:
+                return None
+            # highest priority, then most specific selector, then name asc
+            matched.sort(key=lambda t: (-t[0], -t[1], t[2].name))
+            return matched[0][2]
+
+        pps = [
+            p for p in self.store.list(PropagationPolicy.KIND)
+            if p.metadata.namespace == obj.namespace
+        ]
+        cpps = self.store.list(ClusterPropagationPolicy.KIND)
+        return best(pps), best(cpps)
+
+    def _reconcile(self, key) -> None:
+        kind, namespace, name = key
+        obj = self.store.try_get(kind, namespace, name)
+        rb_name = binding_name(kind, name)
+        if obj is None or obj.metadata.deleting:
+            try:
+                self.store.delete(ResourceBinding.KIND, namespace, rb_name)
+            except NotFoundError:
+                pass
+            return
+        assert isinstance(obj, Unstructured)
+        pp, cpp = self._matched_policies(obj)
+        policy = pp if pp is not None else cpp
+        if policy is None:
+            # no policy claims it; drop a stale binding if we created one
+            try:
+                self.store.delete(ResourceBinding.KIND, namespace, rb_name)
+            except NotFoundError:
+                pass
+            return
+        label = POLICY_LABEL if isinstance(policy, PropagationPolicy) and not isinstance(
+            policy, ClusterPropagationPolicy) else CLUSTER_POLICY_LABEL
+        policy_id = (
+            f"{policy.metadata.namespace}/{policy.name}"
+            if policy.metadata.namespace
+            else policy.name
+        )
+
+        # claim the template (ClaimPolicyForObject, detector/claim.go)
+        if obj.metadata.labels.get(label) != policy_id:
+            def claim(o):
+                o.metadata.labels[label] = policy_id
+            self.store.mutate(kind, namespace, name, claim)
+
+        replicas, requirements = self.interpreter.get_replicas(obj.to_manifest())
+        spec = policy.spec
+        suspension = None
+        if spec.suspension is not None:
+            suspension = BindingSuspension(
+                scheduling=spec.suspension.scheduling,
+                dispatching=spec.suspension.dispatching,
+            )
+
+        existing = self.store.try_get(ResourceBinding.KIND, namespace, rb_name)
+        if existing is None:
+            rb = ResourceBinding()
+            rb.metadata.name = rb_name
+            rb.metadata.namespace = namespace
+            rb.metadata.labels[label] = policy_id
+            rb.metadata.owner_references = [OwnerReference(
+                api_version=obj.API_VERSION, kind=kind, name=name,
+                uid=obj.metadata.uid,
+            )]
+            rb.spec = ResourceBindingSpec(
+                resource=ObjectReference(
+                    api_version=obj.API_VERSION, kind=kind, namespace=namespace,
+                    name=name, uid=obj.metadata.uid,
+                    resource_version=obj.metadata.resource_version,
+                ),
+                replicas=replicas,
+                replica_requirements=requirements,
+                placement=spec.placement,
+                propagate_deps=spec.propagate_deps,
+                conflict_resolution=spec.conflict_resolution,
+                schedule_priority=spec.schedule_priority,
+                suspension=suspension,
+                failover=spec.failover,
+            )
+            self.store.create(rb)
+        else:
+            def update(rb):
+                rb.metadata.labels[label] = policy_id
+                # preserve the schedule result + eviction state; refresh the rest
+                rb.spec.resource.resource_version = obj.metadata.resource_version
+                rb.spec.resource.uid = obj.metadata.uid
+                rb.spec.replicas = replicas
+                rb.spec.replica_requirements = requirements
+                rb.spec.placement = spec.placement
+                rb.spec.propagate_deps = spec.propagate_deps
+                rb.spec.conflict_resolution = spec.conflict_resolution
+                rb.spec.schedule_priority = spec.schedule_priority
+                rb.spec.suspension = suspension
+                rb.spec.failover = spec.failover
+            self.store.mutate(ResourceBinding.KIND, namespace, rb_name, update)

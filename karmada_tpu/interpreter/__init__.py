@@ -1,0 +1,4 @@
+from karmada_tpu.interpreter.interpreter import (  # noqa: F401
+    Customization,
+    ResourceInterpreter,
+)

@@ -1,0 +1,298 @@
+"""Resource interpreter (L2): how the framework understands workload kinds.
+
+Mirrors the reference ResourceInterpreter facade
+(pkg/resourceinterpreter/interpreter.go:43-150) and its priority chain:
+customized hooks (the reference's webhook / declarative-Lua tiers; here
+registered Python callables) take precedence over the built-in native
+defaults (pkg/resourceinterpreter/default/native/*.go).
+
+Operations (interpreter.go:43-81): GetReplicas, ReviseReplica, Retain,
+AggregateStatus, GetDependencies, ReflectStatus, InterpretHealth.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from karmada_tpu.models.meta import deep_get
+from karmada_tpu.models.work import (
+    AggregatedStatusItem,
+    ReplicaRequirements,
+)
+from karmada_tpu.utils.quantity import Quantity
+
+# operation names (config/v1alpha1 InterpreterOperation)
+OP_INTERPRET_REPLICA = "InterpretReplica"
+OP_REVISE_REPLICA = "ReviseReplica"
+OP_RETAIN = "Retain"
+OP_AGGREGATE_STATUS = "AggregateStatus"
+OP_INTERPRET_DEPENDENCY = "InterpretDependency"
+OP_INTERPRET_STATUS = "InterpretStatus"
+OP_INTERPRET_HEALTH = "InterpretHealth"
+
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+UNKNOWN = "Unknown"
+
+
+@dataclass
+class DependentObjectReference:
+    """A dependency the workload needs propagated alongside it
+    (pkg/apis/config/v1alpha1 DependentObjectReference)."""
+
+    api_version: str = ""
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    label_selector: Optional[Dict[str, Any]] = None
+
+
+@dataclass
+class Customization:
+    """Per-(apiVersion, kind) hook table -- the framework's counterpart of a
+    ResourceInterpreterCustomization Lua script or interpreter webhook."""
+
+    api_version: str = ""
+    kind: str = ""
+    hooks: Dict[str, Callable] = field(default_factory=dict)
+
+
+def _pod_template_requirements(pod_spec: Dict[str, Any], namespace: str) -> Optional[ReplicaRequirements]:
+    """Aggregate container resource requests into ReplicaRequirements
+    (mirrors helper GetReplicaRequirements semantics: sum container requests)."""
+    if not pod_spec:
+        return None
+    totals: Dict[str, int] = {}
+    for container in pod_spec.get("containers", []) or []:
+        requests = deep_get(container, "resources.requests", {}) or {}
+        for name, raw in requests.items():
+            totals[name] = totals.get(name, 0) + Quantity.parse(raw).milli
+    node_selector = pod_spec.get("nodeSelector") or {}
+    priority_class = pod_spec.get("priorityClassName", "")
+    if not totals and not node_selector and not priority_class:
+        return None
+    return ReplicaRequirements(
+        resource_request={k: Quantity.from_milli(v) for k, v in totals.items()},
+        namespace=namespace,
+        priority_class_name=priority_class,
+    )
+
+
+_PRUNED_METADATA = (
+    "resourceVersion", "uid", "generation", "creationTimestamp",
+    "deletionTimestamp", "selfLink", "managedFields", "ownerReferences",
+)
+
+
+def prune_for_propagation(manifest: Dict[str, Any]) -> Dict[str, Any]:
+    """Strip server-populated fields before packing into a Work
+    (pkg/resourceinterpreter/default/native/prune): status and system
+    metadata never propagate to member clusters."""
+    out = copy.deepcopy(manifest)
+    out.pop("status", None)
+    md = out.get("metadata")
+    if isinstance(md, dict):
+        for f in _PRUNED_METADATA:
+            md.pop(f, None)
+    return out
+
+
+class ResourceInterpreter:
+    """Facade dispatching per-kind; customizations beat native defaults."""
+
+    def __init__(self) -> None:
+        self._customizations: Dict[Tuple[str, str], Customization] = {}
+
+    # -- customization registry (reference: declarative/webhook tiers) -----
+    def register(self, customization: Customization) -> None:
+        key = (customization.api_version, customization.kind)
+        self._customizations[key] = customization
+
+    def unregister(self, api_version: str, kind: str) -> None:
+        self._customizations.pop((api_version, kind), None)
+
+    def _hook(self, manifest: Dict[str, Any], op: str) -> Optional[Callable]:
+        key = (manifest.get("apiVersion", ""), manifest.get("kind", ""))
+        c = self._customizations.get(key)
+        if c is not None and op in c.hooks:
+            return c.hooks[op]
+        return None
+
+    # -- operations ---------------------------------------------------------
+    def get_replicas(self, manifest: Dict[str, Any]) -> Tuple[int, Optional[ReplicaRequirements]]:
+        """(replica count, per-replica requirements) for a workload
+        (native/replica.go)."""
+        hook = self._hook(manifest, OP_INTERPRET_REPLICA)
+        if hook is not None:
+            return hook(manifest)
+        kind = manifest.get("kind", "")
+        ns = deep_get(manifest, "metadata.namespace", "")
+        if kind in ("Deployment", "StatefulSet", "ReplicaSet"):
+            replicas = int(deep_get(manifest, "spec.replicas", 1) or 0)
+            pod_spec = deep_get(manifest, "spec.template.spec", {})
+            return replicas, _pod_template_requirements(pod_spec, ns)
+        if kind == "Job":
+            parallelism = int(deep_get(manifest, "spec.parallelism", 1) or 1)
+            pod_spec = deep_get(manifest, "spec.template.spec", {})
+            return parallelism, _pod_template_requirements(pod_spec, ns)
+        if kind == "Pod":
+            return 1, _pod_template_requirements(deep_get(manifest, "spec", {}), ns)
+        return 0, None
+
+    def revise_replica(self, manifest: Dict[str, Any], replicas: int) -> Dict[str, Any]:
+        """Set the per-cluster replica count (native/revisereplica.go)."""
+        hook = self._hook(manifest, OP_REVISE_REPLICA)
+        if hook is not None:
+            return hook(manifest, replicas)
+        out = copy.deepcopy(manifest)
+        kind = out.get("kind", "")
+        if kind in ("Deployment", "StatefulSet", "ReplicaSet"):
+            out.setdefault("spec", {})["replicas"] = int(replicas)
+        elif kind == "Job":
+            out.setdefault("spec", {})["parallelism"] = int(replicas)
+        return out
+
+    def revise_job_completions(self, manifest: Dict[str, Any], completions: int) -> Dict[str, Any]:
+        """Jobs also divide .spec.completions (binding/common.go:95-108)."""
+        out = copy.deepcopy(manifest)
+        if out.get("kind") == "Job" and deep_get(out, "spec.completions") is not None:
+            out["spec"]["completions"] = int(completions)
+        return out
+
+    def retain(self, desired: Dict[str, Any], observed: Dict[str, Any]) -> Dict[str, Any]:
+        """Keep member-cluster-owned fields on update
+        (native/retain.go; objectwatcher.go:127 retainClusterFields)."""
+        hook = self._hook(desired, OP_RETAIN)
+        if hook is not None:
+            return hook(desired, observed)
+        out = copy.deepcopy(desired)
+        kind = out.get("kind", "")
+        if kind == "Service":
+            ip = deep_get(observed, "spec.clusterIP")
+            if ip is not None:
+                out.setdefault("spec", {})["clusterIP"] = ip
+        if kind == "ServiceAccount":
+            secrets = observed.get("secrets")
+            if secrets is not None:
+                out["secrets"] = secrets
+        if kind == "PersistentVolumeClaim":
+            vn = deep_get(observed, "spec.volumeName")
+            if vn is not None:
+                out.setdefault("spec", {})["volumeName"] = vn
+        # always retain member-side resourceVersion bookkeeping fields
+        return out
+
+    def aggregate_status(
+        self, manifest: Dict[str, Any], items: List[AggregatedStatusItem]
+    ) -> Dict[str, Any]:
+        """Merge per-cluster statuses back onto the template
+        (native/aggregatestatus.go)."""
+        hook = self._hook(manifest, OP_AGGREGATE_STATUS)
+        if hook is not None:
+            return hook(manifest, items)
+        out = copy.deepcopy(manifest)
+        kind = out.get("kind", "")
+        if kind == "Deployment":
+            agg = {"replicas": 0, "readyReplicas": 0, "updatedReplicas": 0,
+                   "availableReplicas": 0, "unavailableReplicas": 0}
+            for item in items:
+                st = item.status or {}
+                for k in agg:
+                    agg[k] += int(st.get(k, 0) or 0)
+            out["status"] = agg
+        elif kind == "Job":
+            agg = {"active": 0, "succeeded": 0, "failed": 0}
+            for item in items:
+                st = item.status or {}
+                for k in agg:
+                    agg[k] += int(st.get(k, 0) or 0)
+            out["status"] = agg
+        else:
+            out["status"] = {
+                "clusters": {i.cluster_name: (i.status or {}) for i in items}
+            }
+        return out
+
+    def get_dependencies(self, manifest: Dict[str, Any]) -> List[DependentObjectReference]:
+        """ConfigMaps/Secrets/PVCs/ServiceAccounts the pod template references
+        (native/dependencies.go)."""
+        hook = self._hook(manifest, OP_INTERPRET_DEPENDENCY)
+        if hook is not None:
+            return hook(manifest)
+        kind = manifest.get("kind", "")
+        ns = deep_get(manifest, "metadata.namespace", "")
+        pod_spec: Dict[str, Any] = {}
+        if kind in ("Deployment", "StatefulSet", "ReplicaSet", "Job", "DaemonSet"):
+            pod_spec = deep_get(manifest, "spec.template.spec", {}) or {}
+        elif kind == "Pod":
+            pod_spec = manifest.get("spec", {}) or {}
+        if not pod_spec:
+            return []
+        deps: List[DependentObjectReference] = []
+
+        def add(kind_: str, name: str) -> None:
+            if name and not any(d.kind == kind_ and d.name == name for d in deps):
+                api = "v1"
+                deps.append(DependentObjectReference(
+                    api_version=api, kind=kind_, namespace=ns, name=name))
+
+        for vol in pod_spec.get("volumes", []) or []:
+            cm = deep_get(vol, "configMap.name")
+            if cm:
+                add("ConfigMap", cm)
+            sec = deep_get(vol, "secret.secretName")
+            if sec:
+                add("Secret", sec)
+            pvc = deep_get(vol, "persistentVolumeClaim.claimName")
+            if pvc:
+                add("PersistentVolumeClaim", pvc)
+        for container in pod_spec.get("containers", []) or []:
+            for envfrom in container.get("envFrom", []) or []:
+                add("ConfigMap", deep_get(envfrom, "configMapRef.name", ""))
+                add("Secret", deep_get(envfrom, "secretRef.name", ""))
+            for env in container.get("env", []) or []:
+                add("ConfigMap", deep_get(env, "valueFrom.configMapKeyRef.name", ""))
+                add("Secret", deep_get(env, "valueFrom.secretKeyRef.name", ""))
+        sa = pod_spec.get("serviceAccountName")
+        if sa and sa != "default":
+            add("ServiceAccount", sa)
+        return deps
+
+    def reflect_status(self, observed: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Pick the status to reflect into work.status.manifestStatuses
+        (native/reflectstatus.go: whole .status by default)."""
+        hook = self._hook(observed, OP_INTERPRET_STATUS)
+        if hook is not None:
+            return hook(observed)
+        status = observed.get("status")
+        return copy.deepcopy(status) if status is not None else None
+
+    def interpret_health(self, observed: Dict[str, Any]) -> str:
+        """Healthy / Unhealthy / Unknown (native/healthy.go)."""
+        hook = self._hook(observed, OP_INTERPRET_HEALTH)
+        if hook is not None:
+            return hook(observed)
+        kind = observed.get("kind", "")
+        st = observed.get("status") or {}
+        if kind == "Deployment":
+            gen = deep_get(observed, "metadata.generation", 0)
+            ogen = st.get("observedGeneration", 0)
+            want = int(deep_get(observed, "spec.replicas", 1) or 0)
+            if ogen >= gen and int(st.get("availableReplicas", 0) or 0) >= want:
+                return HEALTHY
+            return UNHEALTHY
+        if kind == "Job":
+            for cond in st.get("conditions", []) or []:
+                if cond.get("type") == "Failed" and cond.get("status") == "True":
+                    return UNHEALTHY
+            return HEALTHY
+        if kind in ("Pod",):
+            phase = st.get("phase")
+            if phase in ("Running", "Succeeded"):
+                return HEALTHY
+            if phase in ("Failed",):
+                return UNHEALTHY
+            return UNKNOWN
+        return UNKNOWN

@@ -1,0 +1,1 @@
+from karmada_tpu.members.member import FakeMemberCluster  # noqa: F401

@@ -1,0 +1,139 @@
+"""Fake member clusters: in-process capacity simulators.
+
+The reference's E2E environment spins up kind clusters
+(hack/local-up-karmada.sh); unit tests use fake clientsets.  This module is
+the framework's member-cluster substitute for the end-to-end slice
+(SURVEY.md section 7 step 4): each member owns an ObjectStore of applied
+manifests, reports a ResourceSummary/ APIEnablements like the reference's
+cluster-status controller collects (cluster_status_controller.go:278-282),
+and "runs" workloads by moving their status toward ready on each tick.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from karmada_tpu.models.cluster import APIEnablement, ResourceSummary
+from karmada_tpu.models.meta import deep_get
+from karmada_tpu.models.unstructured import Unstructured
+from karmada_tpu.store.store import AlreadyExistsError, NotFoundError, ObjectStore
+from karmada_tpu.utils.quantity import Quantity
+
+
+@dataclass
+class FakeMemberCluster:
+    name: str
+    cpu_allocatable_milli: int = 64_000
+    memory_allocatable_gi: int = 256  # GiB (memory quantities are bytes)
+    pods_allocatable: int = 110
+    api_enablements: List[APIEnablement] = field(default_factory=lambda: [
+        APIEnablement("apps/v1", ["Deployment", "StatefulSet", "ReplicaSet"]),
+        APIEnablement("batch/v1", ["Job"]),
+        APIEnablement("v1", ["Pod", "ConfigMap", "Secret", "Service",
+                             "ServiceAccount", "Namespace"]),
+    ])
+    healthy: bool = True
+    store: ObjectStore = field(default_factory=ObjectStore)
+
+    # -- the member "API server" -------------------------------------------
+    def apply(self, manifest: Dict[str, Any]) -> Unstructured:
+        """Server-side-apply-ish create-or-update keyed by (kind, ns, name)."""
+        obj = Unstructured.from_manifest(manifest)
+        existing = self.store.try_get(obj.KIND, obj.namespace, obj.name)
+        if existing is None:
+            return self.store.create(obj)
+        assert isinstance(existing, Unstructured)
+        merged = copy.deepcopy(manifest)
+        if existing.manifest.get("status") is not None and "status" not in merged:
+            merged["status"] = existing.manifest["status"]
+        existing.manifest = merged
+        existing.metadata.labels = dict(
+            deep_get(merged, "metadata.labels", {}) or {})
+        existing.metadata.annotations = dict(
+            deep_get(merged, "metadata.annotations", {}) or {})
+        return self.store.update(existing)
+
+    def get(self, kind: str, namespace: str, name: str) -> Optional[Unstructured]:
+        obj = self.store.try_get(kind, namespace, name)
+        return obj  # type: ignore[return-value]
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        try:
+            self.store.delete(kind, namespace, name)
+        except NotFoundError:
+            pass
+
+    # -- capacity telemetry (what cluster-status collects) ------------------
+    def used_milli(self) -> Dict[str, int]:
+        cpu = mem = pods = 0
+        for obj in self.store.items():
+            if not isinstance(obj, Unstructured):
+                continue
+            kind = obj.KIND
+            if kind not in ("Deployment", "StatefulSet", "ReplicaSet", "Job", "Pod"):
+                continue
+            m = obj.manifest
+            replicas = int(deep_get(m, "spec.replicas", 1) or 0)
+            if kind == "Job":
+                replicas = int(deep_get(m, "spec.parallelism", 1) or 1)
+            if kind == "Pod":
+                replicas = 1
+            pod_spec = deep_get(m, "spec.template.spec", {}) or m.get("spec", {})
+            c_cpu = c_mem = 0
+            for container in pod_spec.get("containers", []) or []:
+                reqs = deep_get(container, "resources.requests", {}) or {}
+                c_cpu += Quantity.parse(reqs.get("cpu", 0)).milli
+                c_mem += Quantity.parse(reqs.get("memory", 0)).milli
+            cpu += replicas * c_cpu
+            mem += replicas * c_mem
+            pods += replicas
+        return {"cpu": cpu, "memory": mem, "pods": pods * 1000}
+
+    def resource_summary(self) -> ResourceSummary:
+        used = self.used_milli()
+        return ResourceSummary(
+            allocatable={
+                "cpu": Quantity.from_milli(self.cpu_allocatable_milli),
+                "memory": Quantity.parse(f"{self.memory_allocatable_gi}Gi"),
+                "pods": Quantity.from_units(self.pods_allocatable),
+            },
+            allocated={
+                "cpu": Quantity.from_milli(used["cpu"]),
+                "memory": Quantity.from_milli(used["memory"]),
+                "pods": Quantity.from_milli(used["pods"]),
+            },
+        )
+
+    # -- workload simulation ------------------------------------------------
+    def tick(self) -> None:
+        """Advance every applied workload's status toward ready."""
+        if not self.healthy:
+            return
+        for obj in list(self.store.items()):
+            if not isinstance(obj, Unstructured):
+                continue
+            m = obj.manifest
+            kind = obj.KIND
+            if kind in ("Deployment", "StatefulSet", "ReplicaSet"):
+                want = int(deep_get(m, "spec.replicas", 1) or 0)
+                status = {
+                    "observedGeneration": deep_get(m, "metadata.generation",
+                                                   obj.metadata.generation),
+                    "replicas": want,
+                    "readyReplicas": want,
+                    "updatedReplicas": want,
+                    "availableReplicas": want,
+                }
+                if m.get("status") != status:
+                    def setst(o, status=status):
+                        o.manifest["status"] = status
+                    self.store.mutate(kind, obj.namespace, obj.name, setst)
+            elif kind == "Job":
+                par = int(deep_get(m, "spec.parallelism", 1) or 1)
+                status = {"active": par, "succeeded": 0, "failed": 0}
+                if m.get("status") != status:
+                    def setst(o, status=status):
+                        o.manifest["status"] = status
+                    self.store.mutate(kind, obj.namespace, obj.name, setst)

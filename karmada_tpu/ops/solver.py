@@ -218,16 +218,17 @@ webster_divide_batch = jax.vmap(webster_divide, in_axes=(0, 0, 0, 0, 0, None))
 # selection (select_clusters_by_cluster.go:25) -> replica division strategies
 # (assignment.go / division_algorithm.go) via the Webster kernel above.
 
-# strategy / status ids mirrored from ops/tensors.py (kept in sync by tests)
-STRAT_DUPLICATED = 0
-STRAT_STATIC = 1
-STRAT_DYNAMIC = 2
-STRAT_AGGREGATED = 3
-
-STATUS_OK = 0
-STATUS_FIT_ERROR = 1
-STATUS_UNSCHEDULABLE = 2
-STATUS_NO_CLUSTER = 3
+# strategy / status ids shared with the encoder/decoder
+from karmada_tpu.ops.tensors import (  # noqa: E402
+    STATUS_FIT_ERROR,
+    STATUS_NO_CLUSTER,
+    STATUS_OK,
+    STATUS_UNSCHEDULABLE,
+    STRAT_AGGREGATED,
+    STRAT_DUPLICATED,
+    STRAT_DYNAMIC,
+    STRAT_STATIC,
+)
 
 _AVAIL_BITS = 34  # avail values clamped below 2^34 for key packing
 _AVAIL_CAP = (1 << _AVAIL_BITS) - 1

@@ -194,13 +194,35 @@ def _route_for(spec: ResourceBindingSpec, placement: Placement) -> int:
     return ROUTE_DEVICE
 
 
+class EncoderCache:
+    """Memoizes the cluster-and-placement side of the encoding across chunks.
+
+    One scheduling cycle encodes many binding chunks against the SAME
+    cluster snapshot; placement predicate rows (O(C) Python each) and the
+    per-class estimator overrides are computed once per distinct
+    placement/class, not once per chunk.
+    """
+
+    def __init__(self) -> None:
+        self.placement_rows: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+        self.gvk_rows: Dict[Tuple[str, str], np.ndarray] = {}
+        self.override_rows: Dict[Tuple, np.ndarray] = {}
+        self.static_rows: Dict[str, np.ndarray] = {}
+
+
 def encode_batch(
     items: Sequence[Tuple[ResourceBindingSpec, ResourceBindingStatus]],
     cindex: ClusterIndex,
     estimator: Optional[GeneralEstimator] = None,
     pad_bindings: bool = True,
+    cache: Optional[EncoderCache] = None,
 ) -> SolverBatch:
-    """Encode one scheduling cycle.  `items` are (spec, status) pairs."""
+    """Encode one scheduling cycle.  `items` are (spec, status) pairs.
+
+    Pass the same `cache` across chunks of one cycle to amortize the
+    placement/cluster/override host work (cluster snapshot must not change
+    between cached calls).
+    """
     estimator = estimator or GeneralEstimator()
     clusters = cindex.clusters
     nC = len(clusters)
@@ -328,14 +350,24 @@ def encode_batch(
 
     # histogram-modeled clusters: host-side exact override (general.go:336)
     est_override = np.full((Q, C), -1, np.int64)
-    for i, c in enumerate(clusters):
+    modeled = [
+        i for i, c in enumerate(clusters)
         if (
             estimator.enable_resource_modeling
             and c.status.resource_summary is not None
             and c.status.resource_summary.allocatable_modelings
-        ):
-            for q, rr in enumerate(class_reqs):
-                est_override[q, i] = estimator._max_for_cluster(c, rr)
+        )
+    ]
+    if modeled:
+        for q, (ck, rr) in enumerate(zip(classes, class_reqs)):
+            row = None if cache is None else cache.override_rows.get(ck)
+            if row is None:
+                row = np.full(C, -1, np.int64)
+                for i in modeled:
+                    row[i] = estimator._max_for_cluster(clusters[i], rr)
+                if cache is not None:
+                    cache.override_rows[ck] = row
+            est_override[q] = row
 
     # ---- placement axis ---------------------------------------------------
     P = max(len(placements), 1)
@@ -365,41 +397,59 @@ def encode_batch(
                     pl_sc_min[p] = sc.min_groups
                     pl_sc_max[p] = sc.max_groups
 
-        probe = _spec_with(placement)
-        for i, c in enumerate(clusters):
-            # affinity + spread-property predicates (no prev bypass exists)
-            ok = (
-                serial.filter_cluster_affinity(probe, dummy_status, c) is None
-                and serial.filter_spread_constraint(probe, dummy_status, c) is None
+        pkey = _placement_key(placement)
+        rows = None if cache is None else cache.placement_rows.get(pkey)
+        if rows is None:
+            mask_row = np.zeros(C, bool)
+            tol_row = np.zeros(C, bool)
+            probe = _spec_with(placement)
+            for i, c in enumerate(clusters):
+                # affinity + spread-property predicates (no prev bypass)
+                mask_row[i] = (
+                    serial.filter_cluster_affinity(probe, dummy_status, c) is None
+                    and serial.filter_spread_constraint(probe, dummy_status, c) is None
+                )
+                # taint toleration WITHOUT the target_contains bypass
+                tol_row[i] = _tolerated(placement, c)
+            # static weights (division_algorithm.go:38-72) per cluster
+            static_row = np.zeros(C, np.int64)
+            s = placement.replica_scheduling
+            wl = (
+                s.weight_preference.static_weight_list
+                if s is not None and s.weight_preference is not None
+                else []
             )
-            pl_mask[p, i] = ok
-            # taint toleration WITHOUT the target_contains bypass
-            pl_tol_bypass[p, i] = _tolerated(placement, c)
-
-        # static weights (division_algorithm.go:38-72), rule match per cluster
-        s = placement.replica_scheduling
-        wl = (
-            s.weight_preference.static_weight_list
-            if s is not None and s.weight_preference is not None
-            else []
-        )
-        if pl_strategy[p] == STRAT_STATIC:
-            if not wl:
-                pl_static_w[p, :nC] = 1
-            else:
-                for i, c in enumerate(clusters):
-                    weight = 0
-                    for rule in wl:
-                        if rule.target_cluster.matches(c):
-                            weight = max(weight, rule.weight)
-                    pl_static_w[p, i] = weight
+            if pl_strategy[p] == STRAT_STATIC:
+                if not wl:
+                    static_row[:nC] = 1
+                else:
+                    for i, c in enumerate(clusters):
+                        weight = 0
+                        for rule in wl:
+                            if rule.target_cluster.matches(c):
+                                weight = max(weight, rule.weight)
+                        static_row[i] = weight
+            rows = (mask_row, tol_row, static_row)
+            if cache is not None:
+                cache.placement_rows[pkey] = rows
+        pl_mask[p], pl_tol_bypass[p], pl_static_w[p] = rows
 
     # ---- api enablement ---------------------------------------------------
     G = max(len(gvks), 1)
     api_ok = np.zeros((G, C), bool)
-    for (api_version, kind), g in gvks.items():
-        for i, c in enumerate(clusters):
-            api_ok[g, i] = c.api_enablement(api_version, kind) == serial.API_ENABLED
+    for gk, g in gvks.items():
+        row = None if cache is None else cache.gvk_rows.get(gk)
+        if row is None:
+            api_version, kind = gk
+            row = np.array(
+                [c.api_enablement(api_version, kind) == serial.API_ENABLED
+                 for c in clusters]
+                + [False] * (C - nC),
+                dtype=bool,
+            )
+            if cache is not None:
+                cache.gvk_rows[gk] = row
+        api_ok[g] = row
 
     return SolverBatch(
         B=B, C=C, n_bindings=nB, n_clusters=nC,

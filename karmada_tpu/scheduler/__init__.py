@@ -1,0 +1,1 @@
+from karmada_tpu.scheduler.service import Scheduler  # noqa: F401

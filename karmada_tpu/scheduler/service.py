@@ -1,0 +1,252 @@
+"""Scheduler service: batching window over the TPU solver.
+
+The reference scheduler pops ONE binding at a time (pkg/scheduler/
+scheduler.go:335-340 worker/scheduleNext) and runs the generic pipeline per
+binding.  This service keeps the same *decision* semantics
+(doScheduleBinding :376 -- schedule when the spec generation moved, a
+reschedule was triggered, or the binding is unscheduled; honor scheduling
+suspension) but drains every pending binding per cycle into ONE batched
+solver call (ops/solver.schedule_batch), falling back to the serial pipeline
+for bindings the dense encoding routes to host (ops/tensors.route).
+
+The ClusterAffinities failover loop (scheduleResourceBinding :599-662)
+iterates ordered affinity terms; each round re-batches the still-failing
+bindings under their next term, and the observed term is recorded in
+status.schedulerObservedAffinityName exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.models.cluster import Cluster
+from karmada_tpu.models.meta import Condition, set_condition
+from karmada_tpu.models.work import (
+    COND_SCHEDULED,
+    ResourceBinding,
+    ResourceBindingSpec,
+    ResourceBindingStatus,
+    TargetCluster,
+)
+from karmada_tpu.ops import serial, tensors
+from karmada_tpu.ops.solver import solve
+from karmada_tpu.store.store import Event, ObjectStore
+from karmada_tpu.store.worker import AsyncWorker, Runtime
+
+REASON_SUCCESS = "BindingScheduled"
+REASON_NO_FIT = "NoClusterFit"
+REASON_UNSCHEDULABLE = "Unschedulable"
+
+_CYCLE = "__cycle__"
+
+
+class Scheduler:
+    """Watches bindings + clusters; schedules in batched cycles."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        runtime: Runtime,
+        estimators: Optional[Sequence] = None,
+        backend: str = "device",  # device | serial
+        enable_empty_workload_propagation: bool = False,
+    ) -> None:
+        self.store = store
+        self.backend = backend
+        self.estimators = list(estimators) if estimators else [GeneralEstimator()]
+        self._general = next(
+            (e for e in self.estimators if isinstance(e, GeneralEstimator)),
+            GeneralEstimator(),
+        )
+        self.enable_empty_workload_propagation = enable_empty_workload_propagation
+        self._pending: Dict[Tuple[str, str], None] = {}
+        self.worker = runtime.register(AsyncWorker("scheduler", self._cycle))
+        store.bus.subscribe(self._on_event)
+
+    # -- event wiring -------------------------------------------------------
+    def _on_event(self, event: Event) -> None:
+        kind = event.kind
+        if kind == ResourceBinding.KIND:
+            self._pending[(event.obj.namespace, event.obj.name)] = None
+            self.worker.enqueue(_CYCLE)
+        elif kind == Cluster.KIND:
+            # capacity/feasibility changed: revisit everything unscheduled
+            for rb in self.store.list(ResourceBinding.KIND):
+                if not rb.spec.clusters or self._needs_schedule(rb):
+                    self._pending[(rb.namespace, rb.name)] = None
+            if self._pending:
+                self.worker.enqueue(_CYCLE)
+
+    # -- scheduling decision (doScheduleBinding scheduler.go:376) -----------
+    def _needs_schedule(self, rb: ResourceBinding) -> bool:
+        if rb.metadata.deleting:
+            return False
+        if rb.spec.suspension is not None and rb.spec.suspension.scheduling:
+            return False
+        if rb.metadata.generation != rb.status.scheduler_observed_generation:
+            return True
+        if serial.reschedule_required(rb.spec, rb.status):
+            return True
+        return not rb.spec.clusters and not _is_scheduled_empty(rb)
+
+    # -- the batched cycle --------------------------------------------------
+    def _cycle(self, _key) -> None:
+        keys = list(self._pending.keys())
+        self._pending.clear()
+        todo: List[ResourceBinding] = []
+        for ns, name in keys:
+            rb = self.store.try_get(ResourceBinding.KIND, ns, name)
+            if rb is None or not self._needs_schedule(rb):
+                continue
+            todo.append(rb)
+        if not todo:
+            return
+        clusters = [
+            c for c in self.store.list(Cluster.KIND)
+        ]
+        self.schedule_batch(todo, clusters)
+
+    # -- core: schedule a list of bindings against a cluster snapshot ------
+    def schedule_batch(
+        self, bindings: List[ResourceBinding], clusters: List[Cluster]
+    ) -> None:
+        # affinity failover loop: term index per binding
+        term_idx: Dict[int, int] = {}
+        active: List[Tuple[int, ResourceBinding]] = list(enumerate(bindings))
+        results: Dict[int, object] = {}
+        affinity_name: Dict[int, str] = {}
+
+        while active:
+            items: List[Tuple[ResourceBindingSpec, ResourceBindingStatus]] = []
+            for i, rb in active:
+                spec, status = rb.spec, rb.status
+                terms = spec.placement.cluster_affinities if spec.placement else []
+                if terms:
+                    idx = term_idx.setdefault(i, self._initial_term(rb))
+                    status = _status_with_affinity(status, terms[idx].affinity_name)
+                    affinity_name[i] = terms[idx].affinity_name
+                items.append((spec, status))
+
+            outcome = self._solve(items, clusters)
+
+            next_active: List[Tuple[int, ResourceBinding]] = []
+            for (i, rb), res in zip(active, outcome):
+                if isinstance(res, Exception):
+                    terms = rb.spec.placement.cluster_affinities if rb.spec.placement else []
+                    if terms and term_idx.get(i, 0) + 1 < len(terms):
+                        term_idx[i] = term_idx[i] + 1
+                        next_active.append((i, rb))
+                        continue
+                results[i] = res
+            active = next_active
+
+        for i, rb in enumerate(bindings):
+            self._apply_result(rb, results.get(i), affinity_name.get(i, ""))
+
+    def _initial_term(self, rb: ResourceBinding) -> int:
+        """Resume from the observed affinity term (scheduler.go:599-616)."""
+        terms = rb.spec.placement.cluster_affinities if rb.spec.placement else []
+        observed = rb.status.scheduler_observed_affinity_name
+        for idx, t in enumerate(terms):
+            if t.affinity_name == observed:
+                return idx
+        return 0
+
+    # -- backend dispatch ---------------------------------------------------
+    def _solve(
+        self,
+        items: List[Tuple[ResourceBindingSpec, ResourceBindingStatus]],
+        clusters: List[Cluster],
+    ) -> List[object]:
+        """Returns per item either List[TargetCluster] or an Exception."""
+        cal = serial.make_cal_available(self.estimators)
+        out: List[object] = [None] * len(items)
+        device_idx: List[int] = []
+        if self.backend == "device" and items:
+            cindex = tensors.ClusterIndex.build(clusters)
+            batch = tensors.encode_batch(items, cindex, self._general)
+            device_idx = [
+                i for i in range(len(items))
+                if batch.route[i] == tensors.ROUTE_DEVICE
+            ]
+            if device_idx:
+                rep, sel, status = solve(batch)
+                decoded = tensors.decode_result(
+                    batch, rep, sel, status,
+                    enable_empty_workload_propagation=self.enable_empty_workload_propagation,
+                )
+                for i in device_idx:
+                    out[i] = decoded[i]
+        host_idx = [i for i in range(len(items)) if i not in set(device_idx)]
+        for i in host_idx:
+            spec, status = items[i]
+            try:
+                out[i] = serial.schedule(
+                    spec, status, clusters, cal,
+                    enable_empty_workload_propagation=self.enable_empty_workload_propagation,
+                )
+            except Exception as e:  # noqa: BLE001 — per-binding failure object
+                out[i] = e
+        return out
+
+    # -- result patch-back (patchScheduleResultForResourceBinding :664) -----
+    def _apply_result(self, rb: ResourceBinding, res, affinity_name: str) -> None:
+        if res is None:
+            return
+
+        if isinstance(res, Exception):
+            reason = (
+                REASON_NO_FIT if isinstance(res, serial.FitError) else REASON_UNSCHEDULABLE
+            )
+
+            def mark_failed(obj: ResourceBinding) -> None:
+                set_condition(obj.status.conditions, Condition(
+                    type=COND_SCHEDULED, status="False", reason=reason,
+                    message=str(res),
+                ))
+                if affinity_name:
+                    obj.status.scheduler_observed_affinity_name = affinity_name
+
+            self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, mark_failed)
+            return
+
+    # success: write spec.clusters + observed generation + condition
+        targets: List[TargetCluster] = res
+
+        def patch(obj: ResourceBinding) -> None:
+            changed = [
+                (t.name, t.replicas) for t in obj.spec.clusters
+            ] != [(t.name, t.replicas) for t in targets]
+            obj.spec.clusters = list(targets)
+            # the store bumps generation iff the spec changed; observe it
+            obj.status.scheduler_observed_generation = obj.metadata.generation + (
+                1 if changed else 0
+            )
+            if affinity_name:
+                obj.status.scheduler_observed_affinity_name = affinity_name
+            obj.status.last_scheduled_time = __import__("time").time()
+            set_condition(obj.status.conditions, Condition(
+                type=COND_SCHEDULED, status="True", reason=REASON_SUCCESS,
+            ))
+
+        self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, patch)
+
+
+def _is_scheduled_empty(rb: ResourceBinding) -> bool:
+    """A successfully scheduled binding may legitimately have no targets
+    (e.g. replicas=0 workload); the Scheduled condition disambiguates."""
+    for c in rb.status.conditions:
+        if c.type == COND_SCHEDULED and c.status == "True":
+            return True
+    return False
+
+
+def _status_with_affinity(
+    status: ResourceBindingStatus, name: str
+) -> ResourceBindingStatus:
+    import copy
+
+    out = copy.deepcopy(status)
+    out.scheduler_observed_affinity_name = name
+    return out

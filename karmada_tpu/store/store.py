@@ -36,6 +36,14 @@ class Event:
         return self.obj.KIND
 
 
+def _spec_view(obj: TypedObject):
+    """Generation-relevant content; objects may provide spec_view()."""
+    fn = getattr(obj, "spec_view", None)
+    if callable(fn):
+        return fn()
+    return getattr(obj, "spec", None)
+
+
 class ConflictError(Exception):
     """resourceVersion mismatch on update (optimistic concurrency)."""
 
@@ -170,8 +178,15 @@ class ObjectStore:
             obj = copy.deepcopy(obj)
             obj.metadata.uid = old.metadata.uid
             obj.metadata.creation_timestamp = old.metadata.creation_timestamp
+            # semantic no-op: identical content gets no new resourceVersion
+            # and no event -- the loop-breaker that lets controller chains
+            # converge (controllers may mutate unconditionally)
+            obj.metadata.resource_version = old.metadata.resource_version
+            obj.metadata.generation = old.metadata.generation
+            if obj == old:
+                return copy.deepcopy(old)
             if spec_changed is None:
-                spec_changed = getattr(obj, "spec", None) != getattr(old, "spec", None)
+                spec_changed = _spec_view(obj) != _spec_view(old)
             obj.metadata.generation = old.metadata.generation + (1 if spec_changed else 0)
             obj.metadata.resource_version = self._next_rv()
             # deletion in progress + finalizers drained -> actually delete

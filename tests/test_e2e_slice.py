@@ -1,0 +1,212 @@
+"""End-to-end slice: template+policy -> schedule -> Work -> member -> status.
+
+Exercises the reference call stacks 3.1-3.4 (SURVEY.md section 3) entirely
+in-process: detector matching, batched/serial scheduling, Work rendering
+with overrides, member apply, and status reflection back to the template.
+"""
+
+import pytest
+
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.meta import deep_get
+from karmada_tpu.models.policy import (
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+    REPLICA_SCHEDULING_DUPLICATED,
+    ClusterAffinity,
+    ClusterPreferences,
+    ImageOverrider,
+    ObjectMeta,
+    OverridePolicy,
+    Overriders,
+    OverrideSpec,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ReplicaSchedulingStrategy,
+    ResourceSelector,
+    RuleWithCluster,
+)
+from karmada_tpu.models.work import COND_SCHEDULED, ResourceBinding, Work
+
+
+def nginx(replicas=6, cpu="500m"):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "nginx", "namespace": "default"},
+        "spec": {
+            "replicas": replicas,
+            "template": {"spec": {"containers": [
+                {"name": "nginx", "image": "nginx:1.19",
+                 "resources": {"requests": {"cpu": cpu, "memory": "1Gi"}}},
+            ]}},
+        },
+    }
+
+
+def policy(name="nginx-pp", divided=True, clusters=None):
+    if divided:
+        rs = ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+            weight_preference=ClusterPreferences(
+                dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+        )
+    else:
+        rs = ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED)
+    placement = Placement(replica_scheduling=rs)
+    if clusters:
+        placement.cluster_affinity = ClusterAffinity(cluster_names=clusters)
+    return PropagationPolicy(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(api_version="apps/v1",
+                                                 kind="Deployment")],
+            placement=placement,
+        ),
+    )
+
+
+@pytest.fixture
+def cp():
+    plane = ControlPlane(backend="serial")
+    plane.add_member("m1", cpu_milli=64_000)
+    plane.add_member("m2", cpu_milli=32_000)
+    plane.add_member("m3", cpu_milli=16_000)
+    plane.tick()
+    return plane
+
+
+def test_full_propagation_loop(cp):
+    cp.apply_policy(policy())
+    cp.apply(nginx(replicas=6))
+    cp.tick()
+
+    # 3.1 detector: binding exists with interpreted replicas/requirements
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    assert rb.spec.replicas == 6
+    assert rb.spec.replica_requirements.resource_request["cpu"].milli == 500
+
+    # 3.2 scheduler: all replicas divided across the fleet
+    assert sum(tc.replicas for tc in rb.spec.clusters) == 6
+    assert any(c.type == COND_SCHEDULED and c.status == "True"
+               for c in rb.status.conditions)
+
+    # 3.3 works rendered + applied to members with revised replicas
+    total_member_replicas = 0
+    for tc in rb.spec.clusters:
+        w = cp.store.get(Work.KIND, f"karmada-es-{tc.name}",
+                         "default-nginx-deployment")
+        manifest = w.spec.workload[0]
+        assert manifest["spec"]["replicas"] == tc.replicas
+        applied = cp.member(tc.name).get("Deployment", "default", "nginx")
+        assert applied is not None
+        total_member_replicas += applied.manifest["spec"]["replicas"]
+    assert total_member_replicas == 6
+
+    # 3.4 status reflected: template aggregates member statuses
+    cp.tick()
+    template = cp.store.get("Deployment", "default", "nginx")
+    assert template.manifest["status"]["readyReplicas"] == 6
+
+
+def test_scale_up_keeps_existing_assignment(cp):
+    cp.apply_policy(policy())
+    cp.apply(nginx(replicas=6))
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    first = {tc.name: tc.replicas for tc in rb.spec.clusters}
+
+    cp.apply(nginx(replicas=12))
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    second = {tc.name: tc.replicas for tc in rb.spec.clusters}
+    assert sum(second.values()) == 12
+    for name, n in first.items():  # steady mode: no disruption
+        assert second.get(name, 0) >= n
+
+
+def test_duplicated_propagates_full_replicas(cp):
+    cp.apply_policy(policy(divided=False, clusters=["m1", "m2"]))
+    cp.apply(nginx(replicas=4))
+    cp.tick()
+    for m in ("m1", "m2"):
+        applied = cp.member(m).get("Deployment", "default", "nginx")
+        assert applied.manifest["spec"]["replicas"] == 4
+    assert cp.member("m3").get("Deployment", "default", "nginx") is None
+
+
+def test_override_policy_rewrites_image(cp):
+    cp.apply_policy(policy(divided=False, clusters=["m1"]))
+    op = OverridePolicy(
+        metadata=ObjectMeta(name="img", namespace="default"),
+        spec=OverrideSpec(
+            resource_selectors=[ResourceSelector(kind="Deployment")],
+            override_rules=[RuleWithCluster(
+                target_cluster=ClusterAffinity(cluster_names=["m1"]),
+                overriders=Overriders(image_overrider=[
+                    ImageOverrider(component="Registry", operator="replace",
+                                   value="registry.local")]),
+            )],
+        ),
+    )
+    cp.apply_policy(op)
+    cp.apply(nginx())
+    cp.tick()
+    applied = cp.member("m1").get("Deployment", "default", "nginx")
+    image = deep_get(applied.manifest, "spec.template.spec")["containers"][0]["image"]
+    assert image == "registry.local/nginx:1.19"
+
+
+def test_template_delete_cleans_up(cp):
+    cp.apply_policy(policy())
+    cp.apply(nginx())
+    cp.tick()
+    assert cp.member("m1").get("Deployment", "default", "nginx") is not None \
+        or cp.member("m2").get("Deployment", "default", "nginx") is not None
+
+    cp.delete("Deployment", "default", "nginx")
+    cp.tick()
+    assert cp.store.try_get(ResourceBinding.KIND, "default", "nginx-deployment") is None
+    assert len(cp.store.list(Work.KIND)) == 0
+    for m in ("m1", "m2", "m3"):
+        assert cp.member(m).get("Deployment", "default", "nginx") is None
+
+
+def test_policy_delete_cleans_bindings(cp):
+    cp.apply_policy(policy())
+    cp.apply(nginx())
+    cp.tick()
+    assert cp.store.try_get(ResourceBinding.KIND, "default", "nginx-deployment") is not None
+    cp.delete(PropagationPolicy.KIND, "default", "nginx-pp")
+    cp.tick()
+    assert cp.store.try_get(ResourceBinding.KIND, "default", "nginx-deployment") is None
+
+
+def test_member_object_recreated_when_deleted(cp):
+    cp.apply_policy(policy(divided=False, clusters=["m1"]))
+    cp.apply(nginx(replicas=2))
+    cp.tick()
+    assert cp.member("m1").get("Deployment", "default", "nginx") is not None
+    # someone deletes the workload inside the member cluster
+    cp.member("m1").delete("Deployment", "default", "nginx")
+    cp.tick()
+    assert cp.member("m1").get("Deployment", "default", "nginx") is not None
+
+
+def test_device_backend_end_to_end():
+    plane = ControlPlane(backend="device")
+    plane.add_member("m1", cpu_milli=64_000)
+    plane.add_member("m2", cpu_milli=32_000)
+    plane.tick()
+    plane.apply_policy(policy())
+    plane.apply(nginx(replicas=8))
+    plane.tick()
+    rb = plane.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    assert sum(tc.replicas for tc in rb.spec.clusters) == 8
+    for tc in rb.spec.clusters:
+        applied = plane.member(tc.name).get("Deployment", "default", "nginx")
+        assert applied.manifest["spec"]["replicas"] == tc.replicas

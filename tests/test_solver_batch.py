@@ -190,7 +190,8 @@ def mk_binding(rng, b, names, placements):
     return spec, status
 
 
-def run_parity(seed, n_clusters=8, n_bindings=24):
+def run_parity(seed, n_clusters=11, n_bindings=24):
+    # 11 clusters pad to C=16: padded lanes flow through selection/division
     rng = random.Random(seed)
     names = [f"member-{i:02d}" for i in range(n_clusters)]
     clusters = [mk_cluster(rng, nm) for nm in names]
