@@ -98,9 +98,6 @@ class BindingController:
     def _reconcile(self, key) -> None:
         ns, name = key
         rb = self.store.try_get(ResourceBinding.KIND, ns, name)
-        wname = None
-        if rb is not None:
-            wname = work_name(rb)
         if rb is None or rb.metadata.deleting:
             self._remove_works(ns, name, keep=set())
             return
@@ -128,7 +125,7 @@ class BindingController:
             keep.add(target.name)
         # graceful eviction: keep the old Work until the task drains
         keep |= eviction
-        self._remove_works(ns, name, keep, wname)
+        self._remove_works(ns, name, keep)
 
     def _suspended(self, rb: ResourceBinding, cluster: str) -> bool:
         s = rb.spec.suspension
@@ -160,7 +157,7 @@ class BindingController:
                 w.spec.suspend_dispatching = suspend
             self.store.mutate(Work.KIND, ns, name, update)
 
-    def _remove_works(self, rb_ns: str, rb_name: str, keep, wname=None) -> None:
+    def _remove_works(self, rb_ns: str, rb_name: str, keep) -> None:
         label_val = f"{rb_ns}.{rb_name}"
         for w in self.store.list(Work.KIND):
             if w.metadata.labels.get(WORK_BINDING_LABEL) != label_val:

@@ -1,0 +1,119 @@
+"""Dependencies distributor: propagate what a workload needs alongside it.
+
+Mirrors reference pkg/dependenciesdistributor/dependencies_distributor.go:
+117-489: when a binding has propagateDeps=true, the interpreter's
+GetDependencies lists the ConfigMaps/Secrets/PVCs/ServiceAccounts its pod
+template references; each existing dependency gets an *attached*
+ResourceBinding whose RequiredBy snapshot mirrors the independent binding's
+schedule result (syncScheduleResultToAttachedBindings :381), so the binding
+controller propagates it to the same clusters.  Attached bindings are never
+scheduled themselves.
+"""
+
+from __future__ import annotations
+
+from karmada_tpu.controllers.detector import binding_name
+from karmada_tpu.interpreter import ResourceInterpreter
+from karmada_tpu.models.unstructured import Unstructured
+from karmada_tpu.models.work import (
+    BindingSnapshot,
+    ObjectReference,
+    ResourceBinding,
+    ResourceBindingSpec,
+)
+from karmada_tpu.store.store import Event, NotFoundError, ObjectStore
+from karmada_tpu.store.worker import AsyncWorker, Runtime
+
+ATTACHED_LABEL = "resourcebinding.karmada.io/depended-by"
+
+
+class DependenciesDistributor:
+    def __init__(
+        self,
+        store: ObjectStore,
+        runtime: Runtime,
+        interpreter: ResourceInterpreter | None = None,
+    ) -> None:
+        self.store = store
+        self.interpreter = interpreter or ResourceInterpreter()
+        self.worker = runtime.register(AsyncWorker("deps-distributor", self._reconcile))
+        store.bus.subscribe(self._on_event, kind=ResourceBinding.KIND)
+
+    def _on_event(self, event: Event) -> None:
+        rb = event.obj
+        # enqueue regardless of propagate_deps: a flip to False must GC the
+        # attached bindings (the reconcile handles both directions)
+        if ATTACHED_LABEL not in rb.metadata.labels:
+            self.worker.enqueue((rb.namespace, rb.name))
+
+    def _reconcile(self, key) -> None:
+        ns, name = key
+        rb = self.store.try_get(ResourceBinding.KIND, ns, name)
+        parent_id = f"{ns}.{name}"
+        if rb is None or rb.metadata.deleting or not rb.spec.propagate_deps:
+            self._gc(parent_id, keep=set())
+            return
+        resource = rb.spec.resource
+        template = self.store.try_get(resource.kind, resource.namespace, resource.name)
+        if template is None or not isinstance(template, Unstructured):
+            return
+        deps = self.interpreter.get_dependencies(template.to_manifest())
+        snapshot = BindingSnapshot(
+            namespace=ns, name=name, clusters=list(rb.spec.clusters)
+        )
+        keep = set()
+        for dep in deps:
+            dep_obj = self.store.try_get(dep.kind, dep.namespace, dep.name)
+            if dep_obj is None:
+                continue  # dependency not present in the control plane yet
+            attached_name = binding_name(dep.kind, dep.name)
+            keep.add(attached_name)
+            existing = self.store.try_get(ResourceBinding.KIND, dep.namespace,
+                                          attached_name)
+            if existing is None:
+                arb = ResourceBinding()
+                arb.metadata.namespace = dep.namespace
+                arb.metadata.name = attached_name
+                arb.metadata.labels[ATTACHED_LABEL] = parent_id
+                arb.spec = ResourceBindingSpec(
+                    resource=ObjectReference(
+                        api_version=dep.api_version, kind=dep.kind,
+                        namespace=dep.namespace, name=dep.name,
+                        uid=dep_obj.metadata.uid,
+                    ),
+                    required_by=[snapshot],
+                )
+                self.store.create(arb)
+            else:
+                def update(obj: ResourceBinding) -> None:
+                    obj.metadata.labels[ATTACHED_LABEL] = parent_id
+                    rest = [s for s in obj.spec.required_by
+                            if (s.namespace, s.name) != (ns, name)]
+                    obj.spec.required_by = rest + [snapshot]
+                self.store.mutate(ResourceBinding.KIND, dep.namespace,
+                                  attached_name, update)
+        self._gc(parent_id, keep)
+
+    def _gc(self, parent_id: str, keep) -> None:
+        for rb in self.store.list(ResourceBinding.KIND):
+            if rb.metadata.labels.get(ATTACHED_LABEL) != parent_id:
+                continue
+            if rb.name in keep:
+                continue
+            ns, name = parent_id.split(".", 1)
+
+            def update(obj: ResourceBinding, ns=ns, name=name) -> None:
+                obj.spec.required_by = [
+                    s for s in obj.spec.required_by
+                    if (s.namespace, s.name) != (ns, name)
+                ]
+                if not obj.spec.required_by:
+                    obj.metadata.labels.pop(ATTACHED_LABEL, None)
+
+            try:
+                self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, update)
+                cur = self.store.get(ResourceBinding.KIND, rb.namespace, rb.name)
+                if not cur.spec.required_by and not cur.spec.placement:
+                    self.store.delete(ResourceBinding.KIND, rb.namespace, rb.name)
+            except NotFoundError:
+                pass

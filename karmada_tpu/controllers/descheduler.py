@@ -1,0 +1,78 @@
+"""Descheduler: move replicas stuck unschedulable in their member cluster.
+
+Mirrors reference pkg/descheduler/descheduler.go:80-330: every descheduling
+interval, for Divided+Dynamic bindings, query per-cluster unschedulable
+replicas (the estimator's GetUnschedulableReplicas; here the member
+simulator's admission plan), subtract them from the binding's target
+(core/helper.go SchedulingResultHelper.TargetToUnschedulableReplicas), and
+let the scheduler top the lost replicas back up elsewhere (steady mode).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from karmada_tpu.members.member import FakeMemberCluster
+from karmada_tpu.models.policy import (
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_DIVISION_AGGREGATED,
+    REPLICA_SCHEDULING_DIVIDED,
+)
+from karmada_tpu.models.work import ResourceBinding, TargetCluster
+from karmada_tpu.store.store import ObjectStore
+from karmada_tpu.store.worker import Runtime
+
+
+class Descheduler:
+    def __init__(
+        self,
+        store: ObjectStore,
+        runtime: Runtime,
+        members: Dict[str, FakeMemberCluster],
+    ) -> None:
+        self.store = store
+        self.members = members
+        runtime.register_periodic(self.run_once)
+
+    def _eligible(self, rb: ResourceBinding) -> bool:
+        """descheduler.go:197-214: Divided + dynamic-weight or aggregated."""
+        placement = rb.spec.placement
+        if placement is None or placement.replica_scheduling is None:
+            return False
+        s = placement.replica_scheduling
+        if s.replica_scheduling_type != REPLICA_SCHEDULING_DIVIDED:
+            return False
+        if s.replica_division_preference == REPLICA_DIVISION_AGGREGATED:
+            return True
+        return (
+            s.weight_preference is not None
+            and s.weight_preference.dynamic_weight == DYNAMIC_WEIGHT_AVAILABLE_REPLICAS
+        )
+
+    def run_once(self) -> None:
+        for rb in self.store.list(ResourceBinding.KIND):
+            if not self._eligible(rb) or not rb.spec.clusters:
+                continue
+            resource = rb.spec.resource
+            shrink: Dict[str, int] = {}
+            for target in rb.spec.clusters:
+                member = self.members.get(target.name)
+                if member is None or not member.healthy:
+                    continue
+                stuck = member.unschedulable_replicas(
+                    resource.kind, resource.namespace, resource.name
+                )
+                if stuck > 0:
+                    shrink[target.name] = min(stuck, target.replicas)
+            if not shrink:
+                continue
+
+            def update(obj: ResourceBinding) -> None:
+                new = []
+                for t in obj.spec.clusters:
+                    n = t.replicas - shrink.get(t.name, 0)
+                    if n > 0:
+                        new.append(TargetCluster(name=t.name, replicas=n))
+                obj.spec.clusters = new
+
+            self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, update)

@@ -158,10 +158,19 @@ class ResourceDetector:
             else policy.name
         )
 
-        # claim the template (ClaimPolicyForObject, detector/claim.go)
-        if obj.metadata.labels.get(label) != policy_id:
+        other_label = (
+            CLUSTER_POLICY_LABEL if label == POLICY_LABEL else POLICY_LABEL
+        )
+        # claim the template (ClaimPolicyForObject, detector/claim.go);
+        # preemption drops the losing policy's claim so its deletion can no
+        # longer GC this object's binding
+        if (
+            obj.metadata.labels.get(label) != policy_id
+            or other_label in obj.metadata.labels
+        ):
             def claim(o):
                 o.metadata.labels[label] = policy_id
+                o.metadata.labels.pop(other_label, None)
             self.store.mutate(kind, namespace, name, claim)
 
         replicas, requirements = self.interpreter.get_replicas(obj.to_manifest())
@@ -179,6 +188,7 @@ class ResourceDetector:
             rb.metadata.name = rb_name
             rb.metadata.namespace = namespace
             rb.metadata.labels[label] = policy_id
+            rb.metadata.labels.pop(other_label, None)
             rb.metadata.owner_references = [OwnerReference(
                 api_version=obj.API_VERSION, kind=kind, name=name,
                 uid=obj.metadata.uid,
@@ -202,6 +212,7 @@ class ResourceDetector:
         else:
             def update(rb):
                 rb.metadata.labels[label] = policy_id
+                rb.metadata.labels.pop(other_label, None)
                 # preserve the schedule result + eviction state; refresh the rest
                 rb.spec.resource.resource_version = obj.metadata.resource_version
                 rb.spec.resource.uid = obj.metadata.uid

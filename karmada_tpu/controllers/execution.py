@@ -12,7 +12,11 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-from karmada_tpu.controllers.binding import EXECUTION_NS_PREFIX
+from karmada_tpu.controllers.binding import (
+    EXECUTION_NS_PREFIX,
+    WORK_BINDING_LABEL,
+    execution_namespace,
+)
 from karmada_tpu.interpreter import ResourceInterpreter
 from karmada_tpu.members.member import FakeMemberCluster
 from karmada_tpu.models.cluster import Cluster
@@ -92,6 +96,15 @@ class ExecutionController:
         self._deleted: Dict[tuple, list] = {}
         self.worker = runtime.register(AsyncWorker("execution", self._reconcile))
         store.bus.subscribe(self._on_event, kind=Work.KIND)
+        store.bus.subscribe(self._on_cluster_event, kind=Cluster.KIND)
+
+    def _on_cluster_event(self, event: Event) -> None:
+        # a cluster turning Ready must replay its pending Works (the retry
+        # budget may have been exhausted while it was down)
+        if event.obj.ready:  # type: ignore[union-attr]
+            ns = execution_namespace(event.obj.name)
+            for w in self.store.list(Work.KIND, ns):
+                self.worker.enqueue((ns, w.name, False))
 
     def _on_event(self, event: Event) -> None:
         if event.type == DELETED:
@@ -131,7 +144,7 @@ class ExecutionController:
         from karmada_tpu.models.work import ResourceBinding  # local import cycle guard
 
         conflict = "Abort"
-        label = work.metadata.labels.get("resourcebinding.karmada.io/key", "")
+        label = work.metadata.labels.get(WORK_BINDING_LABEL, "")
         if label and "." in label:
             rb_ns, rb_name = label.split(".", 1)
             rb = self.store.try_get(ResourceBinding.KIND, rb_ns, rb_name)

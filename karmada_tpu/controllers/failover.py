@@ -1,0 +1,297 @@
+"""Failure detection and elastic recovery: the reference's failover loop.
+
+Mirrors SURVEY.md section 3.5:
+* ClusterTaintController -- pkg/controllers/cluster/cluster_controller.go:156
+  taintClusterByCondition: Ready=False adds the not-ready NoExecute taint;
+  recovery removes it (grace periods collapsed to immediate for the
+  deterministic runtime; the serve-mode wrapper can delay enqueues).
+* NoExecuteTaintManager -- pkg/controllers/cluster/taint_manager.go:101:
+  bindings targeting a NoExecute-tainted cluster are evicted unless their
+  placement tolerates the taint (tolerationSeconds honored as
+  immediate-vs-never in pump mode).
+* GracefulEvictionController -- pkg/controllers/gracefuleviction/
+  evictiontask.go:38-116: an eviction task drains only once the binding's
+  *other* clusters report healthy replacement (or the grace period lapses);
+  SuppressDeletion pins the task for manual intervention.
+* ApplicationFailoverController -- pkg/controllers/applicationfailover/
+  rb_application_failover_controller.go:61: workloads unhealthy past
+  spec.failover.tolerationSeconds are evicted and rescheduled.
+
+Eviction itself mirrors binding_types.go GracefulEvict: the cluster leaves
+.spec.clusters and a GracefulEvictionTask is appended, so the scheduler
+re-places the lost replicas while the stale Work survives until the task
+drains (the binding controller keeps evicting clusters' Works alive).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from karmada_tpu.models.cluster import (
+    COND_CLUSTER_READY,
+    Cluster,
+    EFFECT_NO_EXECUTE,
+    Taint,
+)
+from karmada_tpu.models.meta import is_condition_true
+from karmada_tpu.models.work import (
+    GracefulEvictionTask,
+    ResourceBinding,
+    TargetCluster,
+)
+from karmada_tpu.store.store import Event, NotFoundError, ObjectStore
+from karmada_tpu.store.worker import AsyncWorker, Runtime
+
+TAINT_NOT_READY = "cluster.karmada.io/not-ready"
+DEFAULT_GRACE_PERIOD_S = 600
+DEFAULT_TOLERATION_S = 300
+
+PURGE_IMMEDIATELY = "Immediately"
+PURGE_GRACIOUSLY = "Graciously"
+PURGE_NEVER = "Never"
+
+
+def evict_cluster(
+    rb: ResourceBinding,
+    cluster: str,
+    reason: str,
+    producer: str,
+    grace_period_seconds: Optional[int] = None,
+    suppress_deletion: Optional[bool] = None,
+) -> bool:
+    """binding_types.go GracefulEvict semantics; returns True if changed."""
+    target = next((t for t in rb.spec.clusters if t.name == cluster), None)
+    if target is None:
+        return False
+    rb.spec.clusters = [t for t in rb.spec.clusters if t.name != cluster]
+    if any(t.from_cluster == cluster for t in rb.spec.graceful_eviction_tasks):
+        return True
+    rb.spec.graceful_eviction_tasks.append(GracefulEvictionTask(
+        from_cluster=cluster,
+        replicas=target.replicas,
+        reason=reason,
+        producer=producer,
+        grace_period_seconds=grace_period_seconds,
+        suppress_deletion=suppress_deletion,
+        creation_timestamp=time.time(),
+    ))
+    return True
+
+
+class ClusterTaintController:
+    """Ready=False <-> not-ready NoExecute taint."""
+
+    def __init__(self, store: ObjectStore, runtime: Runtime) -> None:
+        self.store = store
+        self.worker = runtime.register(AsyncWorker("cluster-taint", self._reconcile))
+        store.bus.subscribe(self._on_event, kind=Cluster.KIND)
+
+    def _on_event(self, event: Event) -> None:
+        self.worker.enqueue(event.obj.name)
+
+    def _reconcile(self, name) -> None:
+        cluster = self.store.try_get(Cluster.KIND, "", name)
+        if cluster is None:
+            return
+        ready = is_condition_true(cluster.status.conditions, COND_CLUSTER_READY)
+        has = any(t.key == TAINT_NOT_READY for t in cluster.spec.taints)
+        if ready and has:
+            def rm(c: Cluster) -> None:
+                c.spec.taints = [t for t in c.spec.taints if t.key != TAINT_NOT_READY]
+            self.store.mutate(Cluster.KIND, "", name, rm)
+        elif not ready and not has:
+            def add(c: Cluster) -> None:
+                c.spec.taints.append(Taint(
+                    key=TAINT_NOT_READY, effect=EFFECT_NO_EXECUTE,
+                    time_added=time.time(),
+                ))
+            self.store.mutate(Cluster.KIND, "", name, add)
+
+
+class NoExecuteTaintManager:
+    """Evict bindings from NoExecute-tainted clusters (taint_manager.go:101)."""
+
+    def __init__(self, store: ObjectStore, runtime: Runtime) -> None:
+        self.store = store
+        self.worker = runtime.register(AsyncWorker("taint-manager", self._reconcile))
+        store.bus.subscribe(self._on_event, kind=Cluster.KIND)
+
+    def _on_event(self, event: Event) -> None:
+        taints = [t for t in event.obj.spec.taints if t.effect == EFFECT_NO_EXECUTE]
+        if taints:
+            self.worker.enqueue(event.obj.name)
+
+    def _tolerated(self, rb: ResourceBinding, taint: Taint) -> bool:
+        placement = rb.spec.placement
+        tolerations = placement.cluster_tolerations if placement else []
+        return any(t.tolerates(taint) for t in tolerations)
+
+    def _reconcile(self, cluster_name) -> None:
+        cluster = self.store.try_get(Cluster.KIND, "", cluster_name)
+        if cluster is None:
+            return
+        taints = [t for t in cluster.spec.taints if t.effect == EFFECT_NO_EXECUTE]
+        if not taints:
+            return
+        for rb in self.store.list(ResourceBinding.KIND):
+            if not any(t.name == cluster_name for t in rb.spec.clusters):
+                continue
+            if all(self._tolerated(rb, taint) for taint in taints):
+                continue
+
+            def do_evict(obj: ResourceBinding) -> None:
+                evict_cluster(
+                    obj, cluster_name,
+                    reason="TaintUntolerated", producer="taint-manager",
+                )
+
+            try:
+                self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, do_evict)
+            except NotFoundError:
+                pass
+
+
+class GracefulEvictionController:
+    """Drain eviction tasks once replacement is healthy or grace expires."""
+
+    def __init__(self, store: ObjectStore, runtime: Runtime,
+                 grace_period_s: float = DEFAULT_GRACE_PERIOD_S) -> None:
+        self.store = store
+        self.grace_period_s = grace_period_s
+        self.worker = runtime.register(AsyncWorker("graceful-eviction", self._reconcile))
+        store.bus.subscribe(self._on_event, kind=ResourceBinding.KIND)
+        runtime.register_periodic(self.resync)
+
+    def resync(self) -> None:
+        for rb in self.store.list(ResourceBinding.KIND):
+            if rb.spec.graceful_eviction_tasks:
+                self.worker.enqueue((rb.namespace, rb.name))
+
+    def _on_event(self, event: Event) -> None:
+        if event.obj.spec.graceful_eviction_tasks:
+            self.worker.enqueue((event.obj.namespace, event.obj.name))
+
+    def _replacement_ready(self, rb: ResourceBinding) -> bool:
+        """assessEvictionTasks health gate: every scheduled cluster applied
+        and healthy (evictiontask.go:70-96)."""
+        if not rb.spec.clusters:
+            return False
+        by_cluster = {i.cluster_name: i for i in rb.status.aggregated_status}
+        for target in rb.spec.clusters:
+            item = by_cluster.get(target.name)
+            if item is None or not item.applied or item.health != "Healthy":
+                return False
+        return True
+
+    def _reconcile(self, key) -> None:
+        ns, name = key
+        rb = self.store.try_get(ResourceBinding.KIND, ns, name)
+        if rb is None or not rb.spec.graceful_eviction_tasks:
+            return
+        now = time.time()
+        ready = self._replacement_ready(rb)
+        keep = []
+        for task in rb.spec.graceful_eviction_tasks:
+            if task.suppress_deletion:
+                keep.append(task)
+                continue
+            grace = (
+                task.grace_period_seconds
+                if task.grace_period_seconds is not None
+                else self.grace_period_s
+            )
+            expired = now - task.creation_timestamp >= grace
+            if ready or expired:
+                continue  # drop the task; binding controller prunes the Work
+            keep.append(task)
+        if len(keep) != len(rb.spec.graceful_eviction_tasks):
+            def update(obj: ResourceBinding) -> None:
+                drained = {t.from_cluster for t in rb.spec.graceful_eviction_tasks} - {
+                    t.from_cluster for t in keep
+                }
+                obj.spec.graceful_eviction_tasks = [
+                    t for t in obj.spec.graceful_eviction_tasks
+                    if t.from_cluster not in drained
+                ]
+            self.store.mutate(ResourceBinding.KIND, ns, name, update)
+
+
+class ApplicationFailoverController:
+    """Unhealthy-too-long workloads get evicted and rescheduled.
+
+    Periodic-only (the reference drives this with time-based requeues,
+    rb_application_failover_controller.go:89-160); eviction additionally
+    requires the cluster to have been seen unhealthy in a PREVIOUS periodic
+    round, so a workload that is merely still starting up (applied but not
+    yet ready) never flaps even with tolerationSeconds=0.
+    """
+
+    def __init__(self, store: ObjectStore, runtime: Runtime) -> None:
+        self.store = store
+        self._unhealthy_since: Dict[tuple, float] = {}
+        self._round = 0
+        self._seen_round: Dict[tuple, int] = {}
+        runtime.register_periodic(self.run_once)
+
+    def run_once(self) -> None:
+        self._round += 1
+        for rb in self.store.list(ResourceBinding.KIND):
+            if rb.spec.failover is not None:
+                self._reconcile(rb)
+
+    def _reconcile(self, rb: ResourceBinding) -> None:
+        ns, name = rb.namespace, rb.name
+        toleration = getattr(rb.spec.failover, "toleration_seconds",
+                             DEFAULT_TOLERATION_S)
+        purge = getattr(rb.spec.failover, "purge_mode", PURGE_GRACIOUSLY)
+        now = time.time()
+        to_evict = []
+        unhealthy_now = set()
+        for item in rb.status.aggregated_status:
+            k = (ns, name, item.cluster_name)
+            if item.health == "Unhealthy":
+                unhealthy_now.add(item.cluster_name)
+                since = self._unhealthy_since.setdefault(k, now)
+                first_round = self._seen_round.setdefault(k, self._round)
+                if now - since >= toleration and first_round < self._round:
+                    to_evict.append(item.cluster_name)
+            else:
+                self._unhealthy_since.pop(k, None)
+                self._seen_round.pop(k, None)
+        # forget stale entries for clusters no longer targeted
+        for k in list(self._unhealthy_since):
+            if k[:2] == (ns, name) and k[2] not in unhealthy_now:
+                self._unhealthy_since.pop(k, None)
+                self._seen_round.pop(k, None)
+        if not to_evict:
+            return
+
+        def update(obj: ResourceBinding) -> None:
+            changed = False
+            for cluster in to_evict:
+                if purge == PURGE_IMMEDIATELY:
+                    before = len(obj.spec.clusters)
+                    obj.spec.clusters = [
+                        t for t in obj.spec.clusters if t.name != cluster
+                    ]
+                    changed = changed or len(obj.spec.clusters) != before
+                elif purge == PURGE_NEVER:
+                    changed = evict_cluster(
+                        obj, cluster, reason="ApplicationUnhealthy",
+                        producer="app-failover", suppress_deletion=True,
+                    ) or changed
+                else:
+                    changed = evict_cluster(
+                        obj, cluster, reason="ApplicationUnhealthy",
+                        producer="app-failover",
+                        grace_period_seconds=getattr(
+                            rb.spec.failover, "grace_period_seconds", None),
+                    ) or changed
+            # the spec change alone re-triggers scheduling; steady mode then
+            # tops the lost replicas back up without disrupting survivors
+
+        self.store.mutate(ResourceBinding.KIND, ns, name, update)
+        for cluster in to_evict:
+            self._unhealthy_since.pop((ns, name, cluster), None)
+            self._seen_round.pop((ns, name, cluster), None)

@@ -79,14 +79,17 @@ class WorkStatusController:
         if work is None:
             return
         if deleted or member.get(kind, ns, name) is None:
-            # desired object vanished from the member: recreate (:310)
+            # desired object vanished from the member: recreate (:310) --
+            # through the same managed-marking the execution path uses
+            from karmada_tpu.controllers.execution import _mark_managed
+
             if not work.metadata.deleting and not work.spec.suspend_dispatching:
                 for manifest in work.spec.workload:
                     if (
                         manifest.get("kind") == kind
                         and deep_get(manifest, "metadata.name") == name
                     ):
-                        member.apply(manifest)
+                        member.apply(_mark_managed(manifest))
             return
         observed = member.get(kind, ns, name)
         status = self.interpreter.reflect_status(observed.manifest)

@@ -19,8 +19,17 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from karmada_tpu.controllers.binding import BindingController
+from karmada_tpu.controllers.dependencies import DependenciesDistributor
+from karmada_tpu.controllers.descheduler import Descheduler
 from karmada_tpu.controllers.detector import ResourceDetector
 from karmada_tpu.controllers.execution import ExecutionController
+from karmada_tpu.controllers.failover import (
+    ApplicationFailoverController,
+    ClusterTaintController,
+    GracefulEvictionController,
+    NoExecuteTaintManager,
+)
+from karmada_tpu.controllers.namespace import NamespaceSyncController
 from karmada_tpu.controllers.status import (
     BindingStatusController,
     ClusterStatusController,
@@ -37,7 +46,12 @@ from karmada_tpu.store.worker import Runtime
 
 
 class ControlPlane:
-    def __init__(self, backend: str = "serial") -> None:
+    def __init__(
+        self,
+        backend: str = "serial",
+        enable_descheduler: bool = False,
+        eviction_grace_period_s: float = 600,
+    ) -> None:
         self.store = ObjectStore()
         self.runtime = Runtime()
         self.members: Dict[str, FakeMemberCluster] = {}
@@ -58,6 +72,21 @@ class ControlPlane:
         )
         self.cluster_status = ClusterStatusController(
             self.store, self.runtime, self.members
+        )
+        self.cluster_taints = ClusterTaintController(self.store, self.runtime)
+        self.taint_manager = NoExecuteTaintManager(self.store, self.runtime)
+        self.graceful_eviction = GracefulEvictionController(
+            self.store, self.runtime, grace_period_s=eviction_grace_period_s
+        )
+        self.app_failover = ApplicationFailoverController(self.store, self.runtime)
+        self.namespace_sync = NamespaceSyncController(self.store, self.runtime)
+        self.dependencies = DependenciesDistributor(
+            self.store, self.runtime, self.interpreter
+        )
+        self.descheduler = (
+            Descheduler(self.store, self.runtime, self.members)
+            if enable_descheduler
+            else None
         )
 
     # -- fleet management ---------------------------------------------------

@@ -107,10 +107,69 @@ class FakeMemberCluster:
         )
 
     # -- workload simulation ------------------------------------------------
+    def _workload_request(self, m: Dict[str, Any]) -> Dict[str, int]:
+        pod_spec = deep_get(m, "spec.template.spec", {}) or m.get("spec", {})
+        cpu = mem = 0
+        for container in pod_spec.get("containers", []) or []:
+            reqs = deep_get(container, "resources.requests", {}) or {}
+            cpu += Quantity.parse(reqs.get("cpu", 0)).milli
+            mem += Quantity.parse(reqs.get("memory", 0)).milli
+        return {"cpu": cpu, "memory": mem}
+
+    def admission_plan(self) -> Dict[tuple, int]:
+        """Deterministic capacity admission: workloads in (kind, ns, name)
+        order greedily admit replicas until cpu/memory/pods run out.  The
+        remainder stays pending -- what the reference's unschedulable-replica
+        estimator counts (pkg/estimator/server/replica/replica.go:43)."""
+        cpu_left = self.cpu_allocatable_milli
+        mem_left = Quantity.parse(f"{self.memory_allocatable_gi}Gi").milli
+        pods_left = self.pods_allocatable
+        plan: Dict[tuple, int] = {}
+        for obj in sorted(self.store.items(), key=lambda o: (o.KIND, o.namespace, o.name)):
+            if not isinstance(obj, Unstructured):
+                continue
+            kind = obj.KIND
+            if kind not in ("Deployment", "StatefulSet", "ReplicaSet", "Job", "Pod"):
+                continue
+            m = obj.manifest
+            want = int(deep_get(m, "spec.replicas", 1) or 0)
+            if kind == "Job":
+                want = int(deep_get(m, "spec.parallelism", 1) or 1)
+            if kind == "Pod":
+                want = 1
+            req = self._workload_request(m)
+            admitted = 0
+            for _ in range(want):
+                if pods_left <= 0:
+                    break
+                if req["cpu"] > cpu_left or req["memory"] > mem_left:
+                    break
+                cpu_left -= req["cpu"]
+                mem_left -= req["memory"]
+                pods_left -= 1
+                admitted += 1
+            plan[(kind, obj.namespace, obj.name)] = admitted
+        return plan
+
+    def unschedulable_replicas(self, kind: str, namespace: str, name: str) -> int:
+        """Desired-but-unadmitted replicas for one workload (the estimator's
+        GetUnschedulableReplicas answer)."""
+        obj = self.get(kind, namespace, name)
+        if obj is None:
+            return 0
+        m = obj.manifest
+        want = int(deep_get(m, "spec.replicas", 1) or 0)
+        if kind == "Job":
+            want = int(deep_get(m, "spec.parallelism", 1) or 1)
+        admitted = self.admission_plan().get((kind, namespace, name), 0)
+        return max(want - admitted, 0)
+
     def tick(self) -> None:
-        """Advance every applied workload's status toward ready."""
+        """Advance every applied workload's status toward ready, capped by
+        the capacity admission plan."""
         if not self.healthy:
             return
+        plan = self.admission_plan()
         for obj in list(self.store.items()):
             if not isinstance(obj, Unstructured):
                 continue
@@ -118,13 +177,14 @@ class FakeMemberCluster:
             kind = obj.KIND
             if kind in ("Deployment", "StatefulSet", "ReplicaSet"):
                 want = int(deep_get(m, "spec.replicas", 1) or 0)
+                ready = plan.get((kind, obj.namespace, obj.name), want)
                 status = {
                     "observedGeneration": deep_get(m, "metadata.generation",
                                                    obj.metadata.generation),
                     "replicas": want,
-                    "readyReplicas": want,
-                    "updatedReplicas": want,
-                    "availableReplicas": want,
+                    "readyReplicas": ready,
+                    "updatedReplicas": ready,
+                    "availableReplicas": ready,
                 }
                 if m.get("status") != status:
                     def setst(o, status=status):
@@ -132,7 +192,8 @@ class FakeMemberCluster:
                     self.store.mutate(kind, obj.namespace, obj.name, setst)
             elif kind == "Job":
                 par = int(deep_get(m, "spec.parallelism", 1) or 1)
-                status = {"active": par, "succeeded": 0, "failed": 0}
+                active = plan.get((kind, obj.namespace, obj.name), par)
+                status = {"active": active, "succeeded": 0, "failed": 0}
                 if m.get("status") != status:
                     def setst(o, status=status):
                         o.manifest["status"] = status

@@ -207,7 +207,6 @@ class EncoderCache:
         self.placement_rows: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
         self.gvk_rows: Dict[Tuple[str, str], np.ndarray] = {}
         self.override_rows: Dict[Tuple, np.ndarray] = {}
-        self.static_rows: Dict[str, np.ndarray] = {}
 
 
 def encode_batch(

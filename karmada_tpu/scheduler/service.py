@@ -82,6 +82,8 @@ class Scheduler:
     def _needs_schedule(self, rb: ResourceBinding) -> bool:
         if rb.metadata.deleting:
             return False
+        if rb.spec.placement is None and rb.spec.required_by:
+            return False  # attached binding: follows its parents' schedule
         if rb.spec.suspension is not None and rb.spec.suspension.scheduling:
             return False
         if rb.metadata.generation != rb.status.scheduler_observed_generation:
@@ -178,7 +180,8 @@ class Scheduler:
                 )
                 for i in device_idx:
                     out[i] = decoded[i]
-        host_idx = [i for i in range(len(items)) if i not in set(device_idx)]
+        device_set = set(device_idx)
+        host_idx = [i for i in range(len(items)) if i not in device_set]
         for i in host_idx:
             spec, status = items[i]
             try:

@@ -1,0 +1,196 @@
+"""Failure -> eviction -> reschedule loop (reference call stack 3.5), plus
+descheduler rebalancing, namespace sync, and dependency distribution."""
+
+import pytest
+
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+    ClusterPreferences,
+    FailoverBehavior,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ReplicaSchedulingStrategy,
+    ResourceSelector,
+)
+from karmada_tpu.models.work import ResourceBinding, Work
+
+
+def dynamic_policy(name="pp", propagate_deps=False, failover=None):
+    return PropagationPolicy(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(api_version="apps/v1",
+                                                 kind="Deployment")],
+            placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+            )),
+            propagate_deps=propagate_deps,
+            failover=failover,
+        ),
+    )
+
+
+def deployment(replicas=6, volumes=None):
+    spec = {"containers": [{"name": "app", "image": "app:1",
+                            "resources": {"requests": {"cpu": "500m",
+                                                       "memory": "1Gi"}}}]}
+    if volumes:
+        spec["volumes"] = volumes
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "app", "namespace": "default"},
+        "spec": {"replicas": replicas, "template": {"spec": spec}},
+    }
+
+
+def test_cluster_failure_evicts_and_reschedules():
+    cp = ControlPlane(eviction_grace_period_s=0)
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.add_member("m2", cpu_milli=64_000)
+    cp.tick()
+    cp.apply_policy(dynamic_policy())
+    cp.apply(deployment(6))
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    before = {t.name: t.replicas for t in rb.spec.clusters}
+    assert sum(before.values()) == 6 and len(before) == 2
+
+    # m2 dies: status controller marks NotReady, taints, taint manager evicts
+    cp.member("m2").healthy = False
+    cp.tick()
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    after = {t.name: t.replicas for t in rb.spec.clusters}
+    assert "m2" not in after
+    assert sum(after.values()) == 6  # lost replicas re-placed on m1
+    # eviction task drained (grace period 0) -> stale Work removed
+    assert not rb.spec.graceful_eviction_tasks
+    assert cp.store.try_get(Work.KIND, "karmada-es-m2",
+                            "default-app-deployment") is None
+
+
+def test_eviction_task_keeps_stale_work_until_drained():
+    cp = ControlPlane(eviction_grace_period_s=3600)
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.add_member("m2", cpu_milli=64_000)
+    cp.tick()
+    cp.apply_policy(dynamic_policy())
+    cp.apply(deployment(6))
+    cp.tick()
+
+    cp.member("m2").healthy = False
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    if rb.spec.graceful_eviction_tasks:
+        # replacement not yet healthy: old Work must survive the transition
+        assert cp.store.try_get(Work.KIND, "karmada-es-m2",
+                                "default-app-deployment") is not None
+    # after replacement turns healthy the task drains
+    cp.tick()
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    assert not rb.spec.graceful_eviction_tasks
+
+
+def test_cluster_recovery_removes_taint():
+    cp = ControlPlane()
+    cp.add_member("m1")
+    cp.tick()
+    cp.member("m1").healthy = False
+    cp.tick()
+    cluster = cp.store.get("Cluster", "", "m1")
+    assert any(t.key == "cluster.karmada.io/not-ready" for t in cluster.spec.taints)
+    cp.member("m1").healthy = True
+    cp.tick()
+    cluster = cp.store.get("Cluster", "", "m1")
+    assert not cluster.spec.taints
+
+
+def test_application_failover_moves_unhealthy_workload():
+    cp = ControlPlane(eviction_grace_period_s=0)
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.add_member("m2", cpu_milli=64_000)
+    cp.tick()
+    cp.apply_policy(dynamic_policy(
+        failover=FailoverBehavior(toleration_seconds=0)))
+    cp.apply(deployment(4))
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    targets = {t.name for t in rb.spec.clusters}
+    assert len(targets) == 2
+
+    # squeeze m1 so its replicas cannot be admitted -> Unhealthy there
+    victim = sorted(targets)[0]
+    cp.member(victim).cpu_allocatable_milli = 100
+    cp.tick()
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    after = {t.name: t.replicas for t in rb.spec.clusters}
+    assert victim not in after
+    assert sum(after.values()) == 4
+
+
+def test_descheduler_moves_stuck_replicas():
+    cp = ControlPlane(enable_descheduler=True)
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.add_member("m2", cpu_milli=64_000)
+    cp.tick()
+    cp.apply_policy(dynamic_policy())
+    cp.apply(deployment(8))
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    split = {t.name: t.replicas for t in rb.spec.clusters}
+    assert sum(split.values()) == 8
+
+    # m2 loses capacity after placement: its replicas get stuck
+    victim = sorted(split)[1]
+    other = sorted(split)[0]
+    cp.member(victim).cpu_allocatable_milli = 1000  # fits only 2 of 500m
+    cp.tick()
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    after = {t.name: t.replicas for t in rb.spec.clusters}
+    assert sum(after.values()) == 8
+    assert after.get(victim, 0) <= 2
+    assert after[other] >= 6
+
+
+def test_namespace_sync_to_all_members():
+    cp = ControlPlane()
+    cp.add_member("m1")
+    cp.tick()
+    cp.apply({"apiVersion": "v1", "kind": "Namespace",
+              "metadata": {"name": "team-a"}})
+    cp.tick()
+    assert cp.member("m1").get("Namespace", "", "team-a") is not None
+    # late-joining member receives existing namespaces
+    cp.add_member("m2")
+    cp.tick()
+    assert cp.member("m2").get("Namespace", "", "team-a") is not None
+
+
+def test_dependencies_follow_parent_schedule():
+    cp = ControlPlane()
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.add_member("m2", cpu_milli=64_000)
+    cp.tick()
+    cp.apply({"apiVersion": "v1", "kind": "ConfigMap",
+              "metadata": {"name": "app-config", "namespace": "default"},
+              "data": {"k": "v"}})
+    cp.apply_policy(dynamic_policy(propagate_deps=True))
+    cp.apply(deployment(4, volumes=[
+        {"name": "cfg", "configMap": {"name": "app-config"}}]))
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    attached = cp.store.get(ResourceBinding.KIND, "default", "app-config-configmap")
+    assert attached.spec.required_by[0].clusters == rb.spec.clusters
+    for t in rb.spec.clusters:
+        assert cp.member(t.name).get("ConfigMap", "default", "app-config") is not None
