@@ -29,6 +29,12 @@ from karmada_tpu.controllers.failover import (
     GracefulEvictionController,
     NoExecuteTaintManager,
 )
+from karmada_tpu.controllers.extras import (
+    ClusterTaintPolicyController,
+    FederatedResourceQuotaController,
+    RemedyController,
+    WorkloadRebalancerController,
+)
 from karmada_tpu.controllers.namespace import NamespaceSyncController
 from karmada_tpu.controllers.status import (
     BindingStatusController,
@@ -88,6 +94,10 @@ class ControlPlane:
             if enable_descheduler
             else None
         )
+        self.rebalancer = WorkloadRebalancerController(self.store, self.runtime)
+        self.taint_policies = ClusterTaintPolicyController(self.store, self.runtime)
+        self.remedies = RemedyController(self.store, self.runtime)
+        self.quotas = FederatedResourceQuotaController(self.store, self.runtime)
 
     # -- fleet management ---------------------------------------------------
     def add_member(

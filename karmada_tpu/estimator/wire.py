@@ -1,0 +1,326 @@
+"""Estimator wire protocol + transports (the gRPC tier of the reference).
+
+The reference scheduler/descheduler talk proto2 gRPC with mTLS to one
+karmada-scheduler-estimator per member cluster
+(pkg/estimator/service/service.proto, pkg/estimator/pb/generated.proto:
+MaxAvailableReplicasRequest/Response, UnschedulableReplicasRequest/
+Response; pkg/util/grpcconnection/{client,server}.go).  grpcio is not in
+this image, so the same contract runs over two transports with identical
+message schemas:
+
+  * LocalTransport -- in-process dispatch (the fake-member E2E path and the
+    default for the batching scheduler);
+  * TcpTransport / serve_tcp -- stdlib socket server with length-prefixed
+    JSON frames and optional TLS via ssl.SSLContext (the mTLS analogue),
+    for running estimators as real sidecar processes.
+
+Messages are dataclasses with explicit to/from_json so the wire format is
+stable and transport-independent.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from karmada_tpu.models.work import ReplicaRequirements
+from karmada_tpu.utils.quantity import Quantity
+
+UNAUTHENTIC_REPLICA = -1
+
+
+# -- messages (pb/generated.proto equivalents) ------------------------------
+
+
+@dataclass
+class MaxAvailableReplicasRequest:
+    cluster: str = ""
+    resource_request: Dict[str, str] = field(default_factory=dict)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {"cluster": self.cluster, "resourceRequest": self.resource_request,
+                "nodeSelector": self.node_selector}
+
+    @staticmethod
+    def from_json(d: dict) -> "MaxAvailableReplicasRequest":
+        return MaxAvailableReplicasRequest(
+            cluster=d.get("cluster", ""),
+            resource_request=dict(d.get("resourceRequest", {})),
+            node_selector=dict(d.get("nodeSelector", {})),
+        )
+
+    @staticmethod
+    def from_requirements(
+        cluster: str, requirements: Optional[ReplicaRequirements]
+    ) -> "MaxAvailableReplicasRequest":
+        req: Dict[str, str] = {}
+        selector: Dict[str, str] = {}
+        if requirements is not None:
+            req = {k: str(v) for k, v in requirements.resource_request.items()}
+            if requirements.node_claim is not None:
+                selector = dict(requirements.node_claim.node_selector)
+        return MaxAvailableReplicasRequest(
+            cluster=cluster, resource_request=req, node_selector=selector
+        )
+
+    def requirements(self) -> Optional[ReplicaRequirements]:
+        if not self.resource_request and not self.node_selector:
+            return None
+        from karmada_tpu.models.work import NodeClaim
+
+        return ReplicaRequirements(
+            resource_request={k: Quantity.parse(v)
+                              for k, v in self.resource_request.items()},
+            node_claim=NodeClaim(node_selector=dict(self.node_selector))
+            if self.node_selector else None,
+        )
+
+
+@dataclass
+class MaxAvailableReplicasResponse:
+    max_replicas: int = 0
+
+    def to_json(self) -> dict:
+        return {"maxReplicas": self.max_replicas}
+
+    @staticmethod
+    def from_json(d: dict) -> "MaxAvailableReplicasResponse":
+        return MaxAvailableReplicasResponse(max_replicas=int(d.get("maxReplicas", 0)))
+
+
+@dataclass
+class UnschedulableReplicasRequest:
+    cluster: str = ""
+    resource_kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    unschedulable_threshold_seconds: int = 60
+
+    def to_json(self) -> dict:
+        return {"cluster": self.cluster, "kind": self.resource_kind,
+                "namespace": self.namespace, "name": self.name,
+                "thresholdSeconds": self.unschedulable_threshold_seconds}
+
+    @staticmethod
+    def from_json(d: dict) -> "UnschedulableReplicasRequest":
+        return UnschedulableReplicasRequest(
+            cluster=d.get("cluster", ""), resource_kind=d.get("kind", ""),
+            namespace=d.get("namespace", ""), name=d.get("name", ""),
+            unschedulable_threshold_seconds=int(d.get("thresholdSeconds", 60)),
+        )
+
+
+@dataclass
+class UnschedulableReplicasResponse:
+    unschedulable_replicas: int = 0
+
+    def to_json(self) -> dict:
+        return {"unschedulableReplicas": self.unschedulable_replicas}
+
+    @staticmethod
+    def from_json(d: dict) -> "UnschedulableReplicasResponse":
+        return UnschedulableReplicasResponse(
+            unschedulable_replicas=int(d.get("unschedulableReplicas", 0)))
+
+
+@dataclass
+class CapacitySnapshotResponse:
+    """Capacity-tensor shipping (the BASELINE.json pkg/estimator change):
+    instead of one RPC per (binding, cluster), an estimator ships its whole
+    per-node capacity table once per refresh; the scheduler's batched
+    solver evaluates any request class against it locally."""
+
+    cluster: str = ""
+    # per node: {"cpu": milli, "memory": milli, "pods": n} free capacity
+    node_free: List[Dict[str, int]] = field(default_factory=list)
+    # per node: labels, aligned with node_free (node-selector evaluation)
+    node_labels: List[Dict[str, str]] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {"cluster": self.cluster, "nodeFree": self.node_free,
+                "nodeLabels": self.node_labels}
+
+    @staticmethod
+    def from_json(d: dict) -> "CapacitySnapshotResponse":
+        return CapacitySnapshotResponse(
+            cluster=d.get("cluster", ""), node_free=list(d.get("nodeFree", [])),
+            node_labels=list(d.get("nodeLabels", [])))
+
+
+def replicas_on_node(
+    free: Dict[str, int],
+    labels: Dict[str, str],
+    requirements: Optional[ReplicaRequirements],
+) -> int:
+    """How many replicas of `requirements` fit on one node's free capacity.
+
+    The single shared implementation of the per-node min-divide
+    (pkg/estimator/server estimate.go:31-93 semantics): cpu in milli,
+    memory Value() (ceil to units), pods; node-selector mismatch -> 0.
+    """
+    per_node = int(free.get("pods", 0))
+    if requirements is None:
+        return max(per_node, 0)
+    if requirements.node_claim is not None:
+        for k, v in requirements.node_claim.node_selector.items():
+            if labels.get(k) != v:
+                return 0
+    from karmada_tpu.utils.quantity import RESOURCE_CPU, resource_request_value
+
+    for rname, qty in requirements.resource_request.items():
+        requested = resource_request_value(rname, qty)
+        if requested <= 0:
+            continue
+        if rname == RESOURCE_CPU:
+            avail = int(free.get("cpu", 0))
+        elif rname == "memory":
+            avail = -((-int(free.get("memory", 0))) // 1000)
+        elif rname == "pods":
+            avail = int(free.get("pods", 0))
+        else:
+            avail = 0
+        per_node = min(per_node, avail // requested)
+    return max(per_node, 0)
+
+
+_METHODS = {
+    "MaxAvailableReplicas": MaxAvailableReplicasRequest,
+    "GetUnschedulableReplicas": UnschedulableReplicasRequest,
+    "CapacitySnapshot": None,  # empty request body
+}
+
+
+# -- transports --------------------------------------------------------------
+
+
+class Transport:
+    """One estimator endpoint: call(method, request_json) -> response_json."""
+
+    def call(self, method: str, request: dict) -> dict:  # pragma: no cover
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LocalTransport(Transport):
+    def __init__(self, handler: Callable[[str, dict], dict]) -> None:
+        self.handler = handler
+
+    def call(self, method: str, request: dict) -> dict:
+        return self.handler(method, request)
+
+
+def _send_frame(sock: socket.socket, payload: dict) -> None:
+    raw = json.dumps(payload).encode("utf-8")
+    sock.sendall(struct.pack(">I", len(raw)) + raw)
+
+
+def _recv_frame(sock: socket.socket) -> dict:
+    header = _recv_exact(sock, 4)
+    (length,) = struct.unpack(">I", header)
+    if length > 64 * 1024 * 1024:
+        raise ConnectionError("frame too large")
+    return json.loads(_recv_exact(sock, length).decode("utf-8"))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+class TcpTransport(Transport):
+    """Length-prefixed JSON frames over TCP, optionally TLS-wrapped."""
+
+    def __init__(self, host: str, port: int, ssl_context=None,
+                 timeout: float = 5.0) -> None:
+        self.addr = (host, port)
+        self.ssl_context = ssl_context
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self.addr, timeout=self.timeout)
+        if self.ssl_context is not None:
+            sock = self.ssl_context.wrap_socket(sock, server_hostname=self.addr[0])
+        return sock
+
+    def call(self, method: str, request: dict) -> dict:
+        with self._lock:
+            if self._sock is None:
+                self._sock = self._connect()
+            try:
+                _send_frame(self._sock, {"method": method, "body": request})
+                resp = _recv_frame(self._sock)
+            except (ConnectionError, OSError):
+                # one reconnect attempt (sidecar restarts are routine)
+                self._sock.close()
+                self._sock = self._connect()
+                _send_frame(self._sock, {"method": method, "body": request})
+                resp = _recv_frame(self._sock)
+        if "error" in resp:
+            raise RuntimeError(f"estimator error: {resp['error']}")
+        return resp.get("body", {})
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        while True:
+            try:
+                frame = _recv_frame(self.request)
+            except (ConnectionError, OSError):
+                return
+            try:
+                body = self.server.dispatch(  # type: ignore[attr-defined]
+                    frame.get("method", ""), frame.get("body", {}))
+                _send_frame(self.request, {"body": body})
+            except Exception as e:  # noqa: BLE001 -- serialize server errors
+                _send_frame(self.request, {"error": str(e)})
+
+
+class EstimatorTcpServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr, handler: Callable[[str, dict], dict],
+                 ssl_context=None) -> None:
+        super().__init__(addr, _Handler)
+        self._dispatch = handler
+        self._ssl_context = ssl_context
+
+    def get_request(self):
+        sock, addr = super().get_request()
+        if self._ssl_context is not None:
+            sock = self._ssl_context.wrap_socket(sock, server_side=True)
+        return sock, addr
+
+    def dispatch(self, method: str, body: dict) -> dict:
+        return self._dispatch(method, body)
+
+
+def serve_tcp(handler: Callable[[str, dict], dict], host: str = "127.0.0.1",
+              port: int = 0, ssl_context=None) -> EstimatorTcpServer:
+    """Start a daemon estimator server; returns it (server_address has the
+    bound port)."""
+    server = EstimatorTcpServer((host, port), handler, ssl_context)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server

@@ -23,11 +23,23 @@ from karmada_tpu.utils.quantity import Quantity
 
 
 @dataclass
+class FakeNode:
+    """One node's allocatable capacity (estimator-server granularity)."""
+
+    name: str = ""
+    cpu_milli: int = 0
+    memory_milli: int = 0
+    pods: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
 class FakeMemberCluster:
     name: str
     cpu_allocatable_milli: int = 64_000
     memory_allocatable_gi: int = 256  # GiB (memory quantities are bytes)
     pods_allocatable: int = 110
+    nodes: List[FakeNode] = field(default_factory=list)
     api_enablements: List[APIEnablement] = field(default_factory=lambda: [
         APIEnablement("apps/v1", ["Deployment", "StatefulSet", "ReplicaSet"]),
         APIEnablement("batch/v1", ["Job"]),
@@ -36,6 +48,17 @@ class FakeMemberCluster:
     ])
     healthy: bool = True
     store: ObjectStore = field(default_factory=ObjectStore)
+
+    def effective_nodes(self) -> List[FakeNode]:
+        """Explicit node list, or one synthetic node holding all capacity."""
+        if self.nodes:
+            return self.nodes
+        return [FakeNode(
+            name=f"{self.name}-node-0",
+            cpu_milli=self.cpu_allocatable_milli,
+            memory_milli=Quantity.parse(f"{self.memory_allocatable_gi}Gi").milli,
+            pods=self.pods_allocatable,
+        )]
 
     # -- the member "API server" -------------------------------------------
     def apply(self, manifest: Dict[str, Any]) -> Unstructured:
@@ -93,11 +116,12 @@ class FakeMemberCluster:
 
     def resource_summary(self) -> ResourceSummary:
         used = self.used_milli()
+        nodes = self.effective_nodes()
         return ResourceSummary(
             allocatable={
-                "cpu": Quantity.from_milli(self.cpu_allocatable_milli),
-                "memory": Quantity.parse(f"{self.memory_allocatable_gi}Gi"),
-                "pods": Quantity.from_units(self.pods_allocatable),
+                "cpu": Quantity.from_milli(sum(n.cpu_milli for n in nodes)),
+                "memory": Quantity.from_milli(sum(n.memory_milli for n in nodes)),
+                "pods": Quantity.from_units(sum(n.pods for n in nodes)),
             },
             allocated={
                 "cpu": Quantity.from_milli(used["cpu"]),
@@ -121,9 +145,10 @@ class FakeMemberCluster:
         order greedily admit replicas until cpu/memory/pods run out.  The
         remainder stays pending -- what the reference's unschedulable-replica
         estimator counts (pkg/estimator/server/replica/replica.go:43)."""
-        cpu_left = self.cpu_allocatable_milli
-        mem_left = Quantity.parse(f"{self.memory_allocatable_gi}Gi").milli
-        pods_left = self.pods_allocatable
+        nodes = self.effective_nodes()
+        cpu_left = sum(n.cpu_milli for n in nodes)
+        mem_left = sum(n.memory_milli for n in nodes)
+        pods_left = sum(n.pods for n in nodes)
         plan: Dict[tuple, int] = {}
         for obj in sorted(self.store.items(), key=lambda o: (o.KIND, o.namespace, o.name)):
             if not isinstance(obj, Unstructured):

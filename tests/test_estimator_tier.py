@@ -1,0 +1,138 @@
+"""Accurate estimator tier: server math, wire transports, clients.
+
+Covers the reference pkg/estimator contract (SURVEY.md section 2.5): the
+node-level server, per-cluster RPC fan-out with the UnauthenticReplica
+sentinel, the unschedulable-replica path, and the capacity-snapshot
+shipping that replaces per-call RPCs for the batched scheduler.
+"""
+
+import pytest
+
+from karmada_tpu.estimator.client import AccurateEstimatorClient, SnapshotEstimator
+from karmada_tpu.estimator.server import AccurateEstimatorServer
+from karmada_tpu.estimator.wire import LocalTransport, serve_tcp, TcpTransport
+from karmada_tpu.members.member import FakeMemberCluster, FakeNode
+from karmada_tpu.models.cluster import Cluster
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.work import NodeClaim, ReplicaRequirements
+from karmada_tpu.utils.quantity import Quantity
+
+
+def member_with_nodes():
+    return FakeMemberCluster(name="m1", nodes=[
+        FakeNode(name="n1", cpu_milli=4000, memory_milli=Quantity.parse("8Gi").milli,
+                 pods=10, labels={"tier": "fast"}),
+        FakeNode(name="n2", cpu_milli=2000, memory_milli=Quantity.parse("4Gi").milli,
+                 pods=10),
+    ])
+
+
+def req(cpu="1", memory="1Gi", selector=None):
+    return ReplicaRequirements(
+        resource_request={"cpu": Quantity.parse(cpu),
+                          "memory": Quantity.parse(memory)},
+        node_claim=NodeClaim(node_selector=selector) if selector else None,
+    )
+
+
+def test_node_level_estimate():
+    server = AccurateEstimatorServer(member_with_nodes())
+    # n1 fits min(4, 8) = 4; n2 fits min(2, 4) = 2
+    assert server.max_available_replicas(req()) == 6
+
+
+def test_node_selector_filters_nodes():
+    server = AccurateEstimatorServer(member_with_nodes())
+    assert server.max_available_replicas(req(selector={"tier": "fast"})) == 4
+
+
+def test_applied_workloads_consume_capacity():
+    member = member_with_nodes()
+    member.apply({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "eater", "namespace": "default"},
+        "spec": {"replicas": 3, "template": {"spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "1",
+                                                     "memory": "1Gi"}}}]}}},
+    })
+    server = AccurateEstimatorServer(member)
+    assert server.max_available_replicas(req()) == 3
+
+
+def test_unschedulable_replicas_counted():
+    member = FakeMemberCluster(name="m1", cpu_allocatable_milli=2000)
+    member.apply({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "big", "namespace": "default"},
+        "spec": {"replicas": 5, "template": {"spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "1"}}}]}}},
+    })
+    server = AccurateEstimatorServer(member)
+    assert server.unschedulable_replicas("Deployment", "default", "big") == 3
+
+
+def test_accurate_client_min_merge_and_sentinel():
+    m1 = member_with_nodes()
+    client = AccurateEstimatorClient()
+    client.register("m1", LocalTransport(AccurateEstimatorServer(m1).handle))
+    clusters = [Cluster(metadata=ObjectMeta(name="m1")),
+                Cluster(metadata=ObjectMeta(name="m2"))]  # m2 has no estimator
+    out = client.max_available_replicas(clusters, req())
+    got = {t.name: t.replicas for t in out}
+    assert got == {"m1": 6, "m2": -1}
+
+
+def test_tcp_transport_roundtrip():
+    server_impl = AccurateEstimatorServer(member_with_nodes())
+    srv = serve_tcp(server_impl.handle)
+    host, port = srv.server_address
+    try:
+        client = AccurateEstimatorClient()
+        client.register("m1", TcpTransport(host, port))
+        clusters = [Cluster(metadata=ObjectMeta(name="m1"))]
+        out = client.max_available_replicas(clusters, req())
+        assert out[0].replicas == 6
+        assert client.unschedulable_replicas("m1", "Deployment", "default", "x") == 0
+    finally:
+        srv.shutdown()
+
+
+def test_snapshot_estimator_matches_accurate():
+    member = member_with_nodes()
+    client = AccurateEstimatorClient()
+    client.register("m1", LocalTransport(AccurateEstimatorServer(member).handle))
+    snap = SnapshotEstimator(client)
+    clusters = [Cluster(metadata=ObjectMeta(name="m1"))]
+    for r in (req(), req(cpu="500m", memory="512Mi"), None):
+        accurate = client.max_available_replicas(clusters, r)[0].replicas
+        local = snap.max_available_replicas(clusters, r)[0].replicas
+        assert local == accurate, r
+
+
+def test_scheduler_uses_accurate_estimator():
+    """The estimator plugs into the serial cal_available min-merge."""
+    from karmada_tpu.ops import serial
+    from karmada_tpu.estimator.general import GeneralEstimator
+    from karmada_tpu.models.cluster import APIEnablement, ClusterStatus
+    from karmada_tpu.models.work import ObjectReference, ResourceBindingSpec
+
+    member = member_with_nodes()
+    client = AccurateEstimatorClient()
+    client.register("m1", LocalTransport(AccurateEstimatorServer(member).handle))
+
+    cluster = Cluster(
+        metadata=ObjectMeta(name="m1"),
+        status=ClusterStatus(
+            api_enablements=[APIEnablement("apps/v1", ["Deployment"])],
+            resource_summary=member.resource_summary(),
+        ),
+    )
+    spec = ResourceBindingSpec(
+        resource=ObjectReference(api_version="apps/v1", kind="Deployment",
+                                 name="x", uid="u"),
+        replicas=3, replica_requirements=req(),
+    )
+    cal = serial.make_cal_available([GeneralEstimator(), client])
+    out = cal([cluster], spec)
+    # general says min(cpu 6, mem 12, pods 20)=6; accurate node-level says 6
+    assert out[0].replicas == 6

@@ -1,0 +1,161 @@
+"""WorkloadRebalancer, ClusterTaintPolicy, Remedy, FederatedResourceQuota."""
+
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.extras import (
+    ClusterTaintPolicy,
+    ClusterTaintPolicySpec,
+    DecisionMatch,
+    FederatedResourceQuota,
+    FederatedResourceQuotaSpec,
+    MatchCondition,
+    ObjectReferenceSpec,
+    Remedy,
+    RemedySpec,
+    StaticClusterAssignment,
+    TaintSpec,
+    WorkloadRebalancer,
+    WorkloadRebalancerSpec,
+)
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+    ClusterPreferences,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ReplicaSchedulingStrategy,
+    ResourceSelector,
+)
+from karmada_tpu.models.work import ResourceBinding
+from karmada_tpu.utils.quantity import Quantity
+
+
+def _policy():
+    return PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(api_version="apps/v1",
+                                                 kind="Deployment")],
+            placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+            )),
+        ),
+    )
+
+
+def _deployment(replicas=4):
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "app", "namespace": "default"},
+        "spec": {"replicas": replicas, "template": {"spec": {"containers": [
+            {"name": "c", "image": "i",
+             "resources": {"requests": {"cpu": "100m", "memory": "128Mi"}}}]}}},
+    }
+
+
+def test_workload_rebalancer_triggers_fresh_reschedule():
+    cp = ControlPlane()
+    cp.add_member("m1")
+    cp.add_member("m2")
+    cp.tick()
+    cp.apply_policy(_policy())
+    cp.apply(_deployment())
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    assert rb.spec.reschedule_triggered_at is None
+
+    wr = WorkloadRebalancer(
+        metadata=ObjectMeta(name="rebalance-now"),
+        spec=WorkloadRebalancerSpec(workloads=[ObjectReferenceSpec(
+            api_version="apps/v1", kind="Deployment",
+            namespace="default", name="app")]),
+    )
+    cp.store.create(wr)
+    cp.tick()
+    wr = cp.store.get(WorkloadRebalancer.KIND, "", "rebalance-now")
+    assert wr.status.finish_time is not None
+    assert wr.status.observed_workloads[0].result == "Successful"
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    assert rb.spec.reschedule_triggered_at is not None
+    # still fully scheduled after the fresh pass
+    assert sum(t.replicas for t in rb.spec.clusters) == 4
+
+
+def test_cluster_taint_policy_adds_and_removes():
+    cp = ControlPlane()
+    cp.add_member("m1")
+    cp.tick()
+    cp.store.create(ClusterTaintPolicy(
+        metadata=ObjectMeta(name="notready-taint"),
+        spec=ClusterTaintPolicySpec(
+            add_on_conditions=[MatchCondition(
+                condition_type="Ready", operator="In", status_values=["False"])],
+            remove_on_conditions=[MatchCondition(
+                condition_type="Ready", operator="In", status_values=["True"])],
+            taints=[TaintSpec(key="example.io/unhealthy", effect="NoSchedule")],
+        ),
+    ))
+    cp.tick()
+    cluster = cp.store.get("Cluster", "", "m1")
+    assert not any(t.key == "example.io/unhealthy" for t in cluster.spec.taints)
+
+    cp.member("m1").healthy = False
+    cp.tick()
+    cluster = cp.store.get("Cluster", "", "m1")
+    assert any(t.key == "example.io/unhealthy" for t in cluster.spec.taints)
+
+    cp.member("m1").healthy = True
+    cp.tick()
+    cluster = cp.store.get("Cluster", "", "m1")
+    assert not any(t.key == "example.io/unhealthy" for t in cluster.spec.taints)
+
+
+def test_remedy_sets_cluster_actions():
+    cp = ControlPlane()
+    cp.add_member("m1")
+    cp.tick()
+    cp.store.create(Remedy(
+        metadata=ObjectMeta(name="traffic-off"),
+        spec=RemedySpec(
+            decision_matches=[DecisionMatch(
+                cluster_condition_type="Ready", cluster_condition_status="False")],
+            actions=["TrafficControl"],
+        ),
+    ))
+    cp.tick()
+    assert cp.store.get("Cluster", "", "m1").status.remedy_actions == []
+    cp.member("m1").healthy = False
+    cp.tick()
+    assert cp.store.get("Cluster", "", "m1").status.remedy_actions == ["TrafficControl"]
+    cp.member("m1").healthy = True
+    cp.tick()
+    assert cp.store.get("Cluster", "", "m1").status.remedy_actions == []
+
+
+def test_federated_resource_quota_renders_per_cluster():
+    cp = ControlPlane()
+    cp.add_member("m1")
+    cp.add_member("m2")
+    cp.tick()
+    cp.store.create(FederatedResourceQuota(
+        metadata=ObjectMeta(name="team-quota", namespace="default"),
+        spec=FederatedResourceQuotaSpec(
+            overall={"cpu": Quantity.parse("20")},
+            static_assignments=[
+                StaticClusterAssignment("m1", {"cpu": Quantity.parse("12")}),
+                StaticClusterAssignment("m2", {"cpu": Quantity.parse("8")}),
+            ],
+        ),
+    ))
+    cp.tick()
+    for m, want in (("m1", "12"), ("m2", "8")):
+        rq = cp.member(m).get("ResourceQuota", "default", "team-quota")
+        assert rq is not None
+        assert rq.manifest["spec"]["hard"]["cpu"] == want
+    frq = cp.store.get(FederatedResourceQuota.KIND, "default", "team-quota")
+    assert {c.cluster_name for c in frq.status.aggregated_status} == {"m1", "m2"}
