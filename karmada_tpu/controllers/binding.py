@@ -27,7 +27,7 @@ from karmada_tpu.models.work import (
     WorkSpec,
     merge_target_clusters,
 )
-from karmada_tpu.ops.webster import dispense_by_weight
+from karmada_tpu.ops.webster import dispense_by_weight, fnv32a
 from karmada_tpu.store.store import Event, NotFoundError, ObjectStore
 from karmada_tpu.store.worker import AsyncWorker, Runtime
 
@@ -40,8 +40,13 @@ def execution_namespace(cluster: str) -> str:
 
 
 def work_name(binding: ResourceBinding) -> str:
-    ns = binding.spec.resource.namespace
-    return f"{ns}-{binding.spec.resource.name}-{binding.spec.resource.kind.lower()}"
+    """Collision-free Work name (names.GenerateWorkName in the reference):
+    the '-'-joined readable prefix is ambiguous (ns='a-b',name='c' vs
+    ns='a',name='b-c'), so a hash of the full (kind, ns, name) tuple is
+    appended to disambiguate."""
+    ref = binding.spec.resource
+    h = fnv32a(f"{ref.kind}\x00{ref.namespace}\x00{ref.name}")
+    return f"{ref.name.lower()}-{ref.kind.lower()}-{h:08x}"
 
 
 class BindingController:

@@ -13,6 +13,7 @@ scheduled themselves.
 from __future__ import annotations
 
 from karmada_tpu.controllers.detector import binding_name
+from karmada_tpu.ops.webster import fnv32a
 from karmada_tpu.interpreter import ResourceInterpreter
 from karmada_tpu.models.unstructured import Unstructured
 from karmada_tpu.models.work import (
@@ -25,6 +26,17 @@ from karmada_tpu.store.store import Event, NotFoundError, ObjectStore
 from karmada_tpu.store.worker import AsyncWorker, Runtime
 
 ATTACHED_LABEL = "resourcebinding.karmada.io/depended-by"
+
+
+def attached_label_key(parent_id: str) -> str:
+    """Per-parent label key, so two independent bindings sharing a dependency
+    each own their marker (reference dependencies_distributor.go keys labels
+    by a hash of the independent binding's id for the same reason)."""
+    return f"{ATTACHED_LABEL}-{fnv32a(parent_id):08x}"
+
+
+def _is_attached(rb: ResourceBinding) -> bool:
+    return any(k.startswith(ATTACHED_LABEL) for k in rb.metadata.labels)
 
 
 class DependenciesDistributor:
@@ -43,7 +55,7 @@ class DependenciesDistributor:
         rb = event.obj
         # enqueue regardless of propagate_deps: a flip to False must GC the
         # attached bindings (the reconcile handles both directions)
-        if ATTACHED_LABEL not in rb.metadata.labels:
+        if not _is_attached(rb):
             self.worker.enqueue((rb.namespace, rb.name))
 
     def _reconcile(self, key) -> None:
@@ -74,7 +86,7 @@ class DependenciesDistributor:
                 arb = ResourceBinding()
                 arb.metadata.namespace = dep.namespace
                 arb.metadata.name = attached_name
-                arb.metadata.labels[ATTACHED_LABEL] = parent_id
+                arb.metadata.labels[attached_label_key(parent_id)] = parent_id
                 arb.spec = ResourceBindingSpec(
                     resource=ObjectReference(
                         api_version=dep.api_version, kind=dep.kind,
@@ -86,7 +98,7 @@ class DependenciesDistributor:
                 self.store.create(arb)
             else:
                 def update(obj: ResourceBinding) -> None:
-                    obj.metadata.labels[ATTACHED_LABEL] = parent_id
+                    obj.metadata.labels[attached_label_key(parent_id)] = parent_id
                     rest = [s for s in obj.spec.required_by
                             if (s.namespace, s.name) != (ns, name)]
                     obj.spec.required_by = rest + [snapshot]
@@ -95,20 +107,20 @@ class DependenciesDistributor:
         self._gc(parent_id, keep)
 
     def _gc(self, parent_id: str, keep) -> None:
+        key = attached_label_key(parent_id)
         for rb in self.store.list(ResourceBinding.KIND):
-            if rb.metadata.labels.get(ATTACHED_LABEL) != parent_id:
+            if rb.metadata.labels.get(key) != parent_id:
                 continue
             if rb.name in keep:
                 continue
             ns, name = parent_id.split(".", 1)
 
-            def update(obj: ResourceBinding, ns=ns, name=name) -> None:
+            def update(obj: ResourceBinding, ns=ns, name=name, key=key) -> None:
                 obj.spec.required_by = [
                     s for s in obj.spec.required_by
                     if (s.namespace, s.name) != (ns, name)
                 ]
-                if not obj.spec.required_by:
-                    obj.metadata.labels.pop(ATTACHED_LABEL, None)
+                obj.metadata.labels.pop(key, None)
 
             try:
                 self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, update)

@@ -165,11 +165,12 @@ class OverrideManager:
             if not any(selector_matches(s, out) for s in policy.spec.resource_selectors):
                 continue
             for rule in policy.spec.override_rules:
-                if (
-                    rule.target_cluster is not None
-                    and cluster is not None
-                    and not rule.target_cluster.matches(cluster)
+                if rule.target_cluster is not None and (
+                    cluster is None or not rule.target_cluster.matches(cluster)
                 ):
+                    # no Cluster object (deleted / not yet registered) means
+                    # the affinity cannot match -- reference only applies a
+                    # rule when the target affinity affirmatively matches
                     continue
                 apply_overriders(rule.overriders, out)
         return out

@@ -39,7 +39,8 @@ def _node_free(member: FakeMemberCluster) -> List[Dict[str, int]]:
     """
     nodes = member.effective_nodes()
     free = [
-        {"cpu": n.cpu_milli, "memory": n.memory_milli, "pods": n.pods}
+        {"cpu": n.cpu_milli, "memory": n.memory_milli, "pods": n.pods,
+         **n.extra_milli}
         for n in nodes
     ]
     # charge admitted workloads against nodes first-fit, like the plan
@@ -51,9 +52,12 @@ def _node_free(member: FakeMemberCluster) -> List[Dict[str, int]]:
         req = member._workload_request(obj.manifest)  # noqa: SLF001
         for _ in range(admitted):
             for f in free:
-                if f["pods"] > 0 and f["cpu"] >= req["cpu"] and f["memory"] >= req["memory"]:
-                    f["cpu"] -= req["cpu"]
-                    f["memory"] -= req["memory"]
+                if f["pods"] > 0 and all(
+                    f.get(r, 0) >= v for r, v in req.items()
+                ):
+                    for r, v in req.items():
+                        if r in f:
+                            f[r] -= v
                     f["pods"] -= 1
                     break
     return free

@@ -137,7 +137,10 @@ class CapacitySnapshotResponse:
     solver evaluates any request class against it locally."""
 
     cluster: str = ""
-    # per node: {"cpu": milli, "memory": milli, "pods": n} free capacity
+    # per node: free capacity, milli units for EVERY resource the node
+    # exposes — {"cpu": milli, "memory": milli, "pods": n, <extended
+    # resource e.g. "nvidia.com/gpu">: milli, ...}.  Estimator sidecars must
+    # ship extended resources here or replicas_on_node reports 0 for them.
     node_free: List[Dict[str, int]] = field(default_factory=list)
     # per node: labels, aligned with node_free (node-selector evaluation)
     node_labels: List[Dict[str, str]] = field(default_factory=list)
@@ -179,12 +182,15 @@ def replicas_on_node(
             continue
         if rname == RESOURCE_CPU:
             avail = int(free.get("cpu", 0))
-        elif rname == "memory":
-            avail = -((-int(free.get("memory", 0))) // 1000)
         elif rname == "pods":
             avail = int(free.get("pods", 0))
         else:
-            avail = 0
+            # generic path (memory, ephemeral-storage, extended resources
+            # such as GPUs): the free table carries milli units for every
+            # resource the node exposes; request values use Value(), so
+            # convert milli -> value with k8s away-from-zero rounding.  A
+            # resource the node does not expose is genuinely 0 here.
+            avail = -((-int(free.get(rname, 0))) // 1000)
         per_node = min(per_node, avail // requested)
     return max(per_node, 0)
 

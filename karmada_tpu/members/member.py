@@ -31,6 +31,8 @@ class FakeNode:
     memory_milli: int = 0
     pods: int = 0
     labels: Dict[str, str] = field(default_factory=dict)
+    # extended resources (GPUs, ephemeral-storage, ...) in milli units
+    extra_milli: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -133,12 +135,12 @@ class FakeMemberCluster:
     # -- workload simulation ------------------------------------------------
     def _workload_request(self, m: Dict[str, Any]) -> Dict[str, int]:
         pod_spec = deep_get(m, "spec.template.spec", {}) or m.get("spec", {})
-        cpu = mem = 0
+        req: Dict[str, int] = {"cpu": 0, "memory": 0}
         for container in pod_spec.get("containers", []) or []:
             reqs = deep_get(container, "resources.requests", {}) or {}
-            cpu += Quantity.parse(reqs.get("cpu", 0)).milli
-            mem += Quantity.parse(reqs.get("memory", 0)).milli
-        return {"cpu": cpu, "memory": mem}
+            for rname, qty in reqs.items():
+                req[rname] = req.get(rname, 0) + Quantity.parse(qty).milli
+        return req
 
     def admission_plan(self) -> Dict[tuple, int]:
         """Deterministic capacity admission: workloads in (kind, ns, name)

@@ -17,6 +17,7 @@ status.schedulerObservedAffinityName exactly like the reference.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from karmada_tpu.estimator.general import GeneralEstimator
@@ -60,6 +61,10 @@ class Scheduler:
             GeneralEstimator(),
         )
         self.enable_empty_workload_propagation = enable_empty_workload_propagation
+        # _pending is written from publisher threads (_on_event) and drained
+        # by the worker (_cycle); the lock makes the drain an atomic swap so
+        # keys enqueued mid-cycle survive into the next cycle.
+        self._pending_lock = threading.Lock()
         self._pending: Dict[Tuple[str, str], None] = {}
         self.worker = runtime.register(AsyncWorker("scheduler", self._cycle))
         store.bus.subscribe(self._on_event)
@@ -68,14 +73,18 @@ class Scheduler:
     def _on_event(self, event: Event) -> None:
         kind = event.kind
         if kind == ResourceBinding.KIND:
-            self._pending[(event.obj.namespace, event.obj.name)] = None
+            with self._pending_lock:
+                self._pending[(event.obj.namespace, event.obj.name)] = None
             self.worker.enqueue(_CYCLE)
         elif kind == Cluster.KIND:
             # capacity/feasibility changed: revisit everything unscheduled
-            for rb in self.store.list(ResourceBinding.KIND):
-                if not rb.spec.clusters or self._needs_schedule(rb):
-                    self._pending[(rb.namespace, rb.name)] = None
-            if self._pending:
+            enqueued = False
+            with self._pending_lock:
+                for rb in self.store.list(ResourceBinding.KIND):
+                    if not rb.spec.clusters or self._needs_schedule(rb):
+                        self._pending[(rb.namespace, rb.name)] = None
+                enqueued = bool(self._pending)
+            if enqueued:
                 self.worker.enqueue(_CYCLE)
 
     # -- scheduling decision (doScheduleBinding scheduler.go:376) -----------
@@ -94,8 +103,9 @@ class Scheduler:
 
     # -- the batched cycle --------------------------------------------------
     def _cycle(self, _key) -> None:
-        keys = list(self._pending.keys())
-        self._pending.clear()
+        with self._pending_lock:
+            keys = list(self._pending.keys())
+            self._pending = {}
         todo: List[ResourceBinding] = []
         for ns, name in keys:
             rb = self.store.try_get(ResourceBinding.KIND, ns, name)

@@ -98,8 +98,9 @@ def test_full_propagation_loop(cp):
     # 3.3 works rendered + applied to members with revised replicas
     total_member_replicas = 0
     for tc in rb.spec.clusters:
-        w = cp.store.get(Work.KIND, f"karmada-es-{tc.name}",
-                         "default-nginx-deployment")
+        from karmada_tpu.controllers.binding import work_name
+
+        w = cp.store.get(Work.KIND, f"karmada-es-{tc.name}", work_name(rb))
         manifest = w.spec.workload[0]
         assert manifest["spec"]["replicas"] == tc.replicas
         applied = cp.member(tc.name).get("Deployment", "default", "nginx")

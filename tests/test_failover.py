@@ -73,8 +73,9 @@ def test_cluster_failure_evicts_and_reschedules():
     assert sum(after.values()) == 6  # lost replicas re-placed on m1
     # eviction task drained (grace period 0) -> stale Work removed
     assert not rb.spec.graceful_eviction_tasks
-    assert cp.store.try_get(Work.KIND, "karmada-es-m2",
-                            "default-app-deployment") is None
+    from karmada_tpu.controllers.binding import work_name
+
+    assert cp.store.try_get(Work.KIND, "karmada-es-m2", work_name(rb)) is None
 
 
 def test_eviction_task_keeps_stale_work_until_drained():
@@ -90,9 +91,11 @@ def test_eviction_task_keeps_stale_work_until_drained():
     cp.tick()
     rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
     if rb.spec.graceful_eviction_tasks:
+        from karmada_tpu.controllers.binding import work_name
+
         # replacement not yet healthy: old Work must survive the transition
         assert cp.store.try_get(Work.KIND, "karmada-es-m2",
-                                "default-app-deployment") is not None
+                                work_name(rb)) is not None
     # after replacement turns healthy the task drains
     cp.tick()
     cp.tick()
