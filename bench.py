@@ -17,10 +17,64 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+# -- defensive backend bring-up ----------------------------------------------
+# The TPU tunnel in this environment is flaky: round 1 saw both a fast
+# UNAVAILABLE crash at backend init and a jax.devices() hang of minutes.
+# Importing jax is always fast; only backend *init* misbehaves.  So: probe
+# the backend in a SUBPROCESS with a hard timeout (a hang cannot be
+# interrupted in-process), retry once, and on failure fall back to the CPU
+# platform with a diagnostic trail in the output JSON.
+
+_PROBE_SNIPPET = (
+    "import jax, jax.numpy as jnp;"
+    "d = jax.devices();"
+    "jax.jit(lambda a: a @ a)(jnp.ones((128, 128), jnp.bfloat16))"
+    ".block_until_ready();"
+    "print('PLATFORM=' + d[0].platform)"
+)
+
+
+def probe_backend(timeout_s: float = 150.0, retries: int = 1) -> dict:
+    """Probe default-backend health out-of-process. Returns a diagnostic dict."""
+    diag = {"ok": False, "platform": None, "attempts": []}
+    for attempt in range(1 + retries):
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SNIPPET],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            elapsed = round(time.perf_counter() - t0, 1)
+            for line in r.stdout.splitlines():
+                if line.startswith("PLATFORM="):
+                    diag.update(ok=True, platform=line.split("=", 1)[1])
+                    diag["attempts"].append({"ok": True, "s": elapsed})
+                    return diag
+            diag["attempts"].append({
+                "ok": False, "s": elapsed, "rc": r.returncode,
+                "err": (r.stderr or r.stdout)[-400:],
+            })
+        except subprocess.TimeoutExpired:
+            diag["attempts"].append({
+                "ok": False, "s": round(time.perf_counter() - t0, 1),
+                "err": f"probe timed out after {timeout_s}s (backend init hang)",
+            })
+    return diag
+
+
+def force_cpu_fallback() -> None:
+    """Pin jax to the host CPU platform (jax may already be imported)."""
+    from karmada_tpu.utils.jaxenv import force_cpu
+
+    force_cpu()
 
 from karmada_tpu.estimator.general import GeneralEstimator
 from karmada_tpu.models.cluster import (
@@ -51,7 +105,6 @@ from karmada_tpu.models.work import (
     ResourceBindingStatus,
 )
 from karmada_tpu.ops import serial, tensors
-from karmada_tpu.ops.solver import solve
 from karmada_tpu.utils.quantity import Quantity
 
 GVK = ("apps/v1", "Deployment")
@@ -149,17 +202,21 @@ def build_bindings(rng: random.Random, n_bindings: int, placements):
 
 
 def run_batched(items, cindex, estimator, chunk: int, cache=None):
-    """Returns (elapsed_s, solve_s, scheduled_count).
+    """Returns (elapsed_s, solve_s, scheduled_count, chunk_latencies).
 
     Uses the production path end to end: shared EncoderCache across chunks,
     jitted solve, and the real decode_result (same as scheduler/service.py).
     """
+    from karmada_tpu.ops.solver import solve
+
     n = len(items)
     scheduled = 0
     cache = cache if cache is not None else tensors.EncoderCache()
     t0 = time.perf_counter()
     solve_s = 0.0
+    chunk_lat = []
     for lo in range(0, n, chunk):
+        tc = time.perf_counter()
         part = items[lo : lo + chunk]
         batch = tensors.encode_batch(part, cindex, estimator, cache=cache)
         t1 = time.perf_counter()
@@ -167,7 +224,8 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None):
         solve_s += time.perf_counter() - t1
         decoded = tensors.decode_result(batch, rep, sel, status)
         scheduled += sum(1 for d in decoded if not isinstance(d, Exception))
-    return time.perf_counter() - t0, solve_s, scheduled
+        chunk_lat.append(time.perf_counter() - tc)
+    return time.perf_counter() - t0, solve_s, scheduled, chunk_lat
 
 
 def run_serial(items, clusters, estimator):
@@ -190,10 +248,27 @@ def main() -> None:
     ap.add_argument("--chunk", type=int, default=4096)
     ap.add_argument("--serial-sample", type=int, default=64)
     ap.add_argument("--quick", action="store_true", help="small smoke config")
+    ap.add_argument("--force-cpu", action="store_true",
+                    help="skip the device probe and run on host CPU")
+    ap.add_argument("--probe-timeout", type=float, default=150.0)
     args = ap.parse_args()
     if args.quick:
         args.bindings, args.clusters, args.chunk = 2048, 256, 1024
         args.serial_sample = 32
+
+    # backend bring-up (before any backend init in this process)
+    if args.force_cpu:
+        probe = {"ok": False, "platform": None,
+                 "attempts": [{"ok": False, "err": "--force-cpu"}]}
+        force_cpu_fallback()
+        platform = "cpu (forced)"
+    else:
+        probe = probe_backend(timeout_s=args.probe_timeout)
+        if probe["ok"]:
+            platform = probe["platform"]
+        else:
+            force_cpu_fallback()
+            platform = "cpu (fallback: device probe failed)"
 
     rng = random.Random(0)
     clusters = build_fleet(rng, args.clusters)
@@ -202,22 +277,41 @@ def main() -> None:
     estimator = GeneralEstimator()
     cindex = tensors.ClusterIndex.build(clusters)
 
-    # warmup: compile every chunk shape once (full chunk + any tail shape)
-    cache = tensors.EncoderCache()
-    run_batched(items[: min(args.chunk, len(items))], cindex, estimator,
-                args.chunk, cache)
-    tail = len(items) % args.chunk
-    if tail:
-        run_batched(items[:tail], cindex, estimator, args.chunk, cache)
+    try:
+        # warmup: compile every chunk shape once (full chunk + any tail shape)
+        t_compile = time.perf_counter()
+        cache = tensors.EncoderCache()
+        run_batched(items[: min(args.chunk, len(items))], cindex, estimator,
+                    args.chunk, cache)
+        tail = len(items) % args.chunk
+        if tail:
+            run_batched(items[:tail], cindex, estimator, args.chunk, cache)
+        compile_s = time.perf_counter() - t_compile
 
-    elapsed, solve_s, scheduled = run_batched(
-        items, cindex, estimator, args.chunk, cache)
-    throughput = args.bindings / elapsed
+        elapsed, solve_s, scheduled, chunk_lat = run_batched(
+            items, cindex, estimator, args.chunk, cache)
+        throughput = args.bindings / elapsed
 
-    sample = items[:: max(1, len(items) // args.serial_sample)][: args.serial_sample]
-    serial_elapsed, _ = run_serial(sample, clusters, estimator)
-    serial_throughput = len(sample) / serial_elapsed if serial_elapsed > 0 else 0.0
-    speedup = throughput / serial_throughput if serial_throughput > 0 else 0.0
+        sample = items[:: max(1, len(items) // args.serial_sample)][: args.serial_sample]
+        serial_elapsed, _ = run_serial(sample, clusters, estimator)
+        serial_throughput = len(sample) / serial_elapsed if serial_elapsed > 0 else 0.0
+        speedup = throughput / serial_throughput if serial_throughput > 0 else 0.0
+    except Exception as e:  # noqa: BLE001 — leave a diagnostic trail, not a traceback
+        import traceback
+
+        print(json.dumps({
+            "metric": "bench failed",
+            "value": 0,
+            "unit": "bindings/s",
+            "vs_baseline": 0,
+            "detail": {
+                "platform": platform,
+                "backend_probe": probe,
+                "error": repr(e),
+                "trace_tail": traceback.format_exc()[-800:],
+            },
+        }))
+        raise SystemExit(1)
 
     print(json.dumps({
         "metric": f"scheduled bindings/sec, {args.bindings} bindings x "
@@ -226,12 +320,23 @@ def main() -> None:
         "unit": "bindings/s",
         "vs_baseline": round(speedup, 2),
         "detail": {
+            "platform": platform,
+            "backend_probe": probe,
             "batched_elapsed_s": round(elapsed, 3),
             "batched_solve_s": round(solve_s, 3),
+            "compile_warmup_s": round(compile_s, 3),
+            "p99_chunk_latency_s": round(
+                float(np.percentile(chunk_lat, 99)), 4) if chunk_lat else None,
             "scheduled_ok": scheduled,
             "serial_bindings_per_s": round(serial_throughput, 2),
             "serial_sample": len(sample),
             "chunk": args.chunk,
+            # honesty note (BASELINE.md): the >=50x north star is against the
+            # serial *Go-equivalent* path; this serial control is the Python
+            # port of those algorithms, which is itself substantially slower
+            # than Go (estimate 10-100x).  vs_baseline therefore overstates
+            # the speedup vs a Go implementation by that factor.
+            "serial_lang": "python (Go-port control; Go itself would be ~10-100x faster)",
         },
     }))
 

@@ -1,0 +1,60 @@
+"""JAX backend bring-up helpers.
+
+The TPU tunnel in this environment can hang or fail at backend *init*
+(importing jax is always fast).  Two traps, both observed in round 1:
+
+- ``xla_bridge.backends()`` initialises EVERY registered PJRT factory even
+  under ``JAX_PLATFORMS=cpu``, so a tunnel-backed accelerator plugin can
+  hang ``jax.devices()`` indefinitely -> drop non-CPU factories.
+- a sitecustomize may import jax before callers run, freezing
+  ``jax_platforms`` from the outer environment -> ``config.update`` after
+  import.
+
+This is the single shared implementation used by tests/conftest.py,
+__graft_entry__.dryrun_multichip, and bench.force_cpu_fallback — private
+jax API manipulation lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+
+def force_cpu(n_devices: int | None = None) -> bool:
+    """Pin jax to the host CPU platform, optionally with ``n_devices``
+    virtual devices.  Must run before first backend init.
+
+    Returns True if the pin was applied before any backend initialised;
+    False (with a warning) if a backend already exists, in which case the
+    pin may not take effect.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        kept = [
+            f for f in flags.split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        kept.append(f"--xla_force_host_platform_device_count={n_devices}")
+        os.environ["XLA_FLAGS"] = " ".join(kept)
+    try:
+        from jax._src import xla_bridge as _xb
+
+        initialized = bool(getattr(_xb, "_backends", None))
+        for _name in list(getattr(_xb, "_backend_factories", {})):
+            if _name not in ("cpu", "interpreter"):
+                _xb._backend_factories.pop(_name, None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        if initialized:
+            warnings.warn(
+                "jax backend already initialized before force_cpu(); the CPU "
+                "pin (and any virtual device count) may not take effect",
+                stacklevel=2,
+            )
+            return False
+        return True
+    except Exception:  # pragma: no cover - best effort against jax internals
+        return False

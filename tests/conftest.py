@@ -1,30 +1,17 @@
-"""Test harness: force an 8-virtual-device CPU platform before jax imports.
+"""Test harness: force an 8-virtual-device CPU platform before jax inits.
 
 Multi-chip TPU hardware is unavailable in CI; all sharding tests run against
 a virtual 8-device CPU mesh (the driver separately dry-runs the multi-chip
-path via __graft_entry__.dryrun_multichip).
+path via __graft_entry__.dryrun_multichip).  The actual pinning logic —
+including dropping tunnel-backed accelerator backend factories that would
+otherwise hang jax.devices() — lives in karmada_tpu/utils/jaxenv.py.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Drop any tunnel-backed accelerator plugin (e.g. the axon TPU proxy) so the
-# suite never blocks on remote tunnel health: backends() would otherwise
-# initialise every registered factory even under JAX_PLATFORMS=cpu.
-try:
-    from jax._src import xla_bridge as _xb
+from karmada_tpu.utils.jaxenv import force_cpu  # noqa: E402
 
-    for _name in list(getattr(_xb, "_backend_factories", {})):
-        if _name not in ("cpu", "interpreter"):
-            _xb._backend_factories.pop(_name, None)
-    # a tunnel sitecustomize may have imported jax before this file ran,
-    # freezing jax_platforms from the outer environment
-    import jax as _jax
-
-    _jax.config.update("jax_platforms", "cpu")
-except Exception:  # pragma: no cover - best effort
-    pass
+force_cpu(8)
