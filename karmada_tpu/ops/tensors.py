@@ -316,8 +316,15 @@ def encode_batch(
                 evict[b, ci] = True
 
     # ---- capacity tensors -------------------------------------------------
-    R = max(len(res_names), 1)
-    Q = max(len(class_reqs), 1)
+    # Every axis the jit signature depends on is pow2-bucketed: B, C, and
+    # the four vocabulary axes Q/P/G/R below.  Unbucketed vocabulary sizes
+    # recompile schedule_batch whenever a cycle sees a new number of
+    # distinct placements/request classes/GVKs/resources — a real control
+    # plane would thrash the compile cache.  Padding lanes are inert: zero
+    # requests never constrain (req>0 guard), -1 overrides are ignored,
+    # and padded placement/GVK rows are never indexed by a real binding.
+    R = _next_pow2(max(len(res_names), 1), 4)
+    Q = _next_pow2(max(len(class_reqs), 1), 4)
     avail_milli = np.zeros((C, R), np.int64)
     has_alloc = np.zeros((C, R), bool)
     req_is_cpu = np.zeros(R, bool)
@@ -369,7 +376,7 @@ def encode_batch(
             est_override[q] = row
 
     # ---- placement axis ---------------------------------------------------
-    P = max(len(placements), 1)
+    P = _next_pow2(max(len(placements), 1), 8)
     pl_mask = np.zeros((P, C), bool)
     pl_tol_bypass = np.zeros((P, C), bool)
     pl_strategy = np.zeros(P, np.int32)
@@ -434,7 +441,7 @@ def encode_batch(
         pl_mask[p], pl_tol_bypass[p], pl_static_w[p] = rows
 
     # ---- api enablement ---------------------------------------------------
-    G = max(len(gvks), 1)
+    G = _next_pow2(max(len(gvks), 1), 4)
     api_ok = np.zeros((G, C), bool)
     for gk, g in gvks.items():
         row = None if cache is None else cache.gvk_rows.get(gk)
@@ -497,12 +504,20 @@ def decode_result(
     status: np.ndarray,
     *,
     enable_empty_workload_propagation: bool = False,
+    items: Optional[Sequence[Tuple[ResourceBindingSpec, ResourceBindingStatus]]] = None,
 ) -> List:
     """Dense solver output -> per-binding List[TargetCluster] or an error.
 
     Returns a list of length n_bindings whose entries are either
     List[TargetCluster] (name-ascending) or an Exception mirroring the
     serial path (FitError / UnschedulableError).
+
+    Pass the original `items` to get full per-cluster FitError diagnosis
+    ("0/5 clusters are available: {m1: untolerated taint...}") — the
+    operator's main debugging signal (generic_scheduler.go:119 semantics).
+    Diagnosis is rebuilt host-side by re-running the serial filters, but
+    only for the (rare) bindings the kernel marked FIT_ERROR, so the device
+    path keeps its throughput.
     """
     names = batch.cluster_index.names
     out: List = []
@@ -512,7 +527,16 @@ def decode_result(
     for b in range(batch.n_bindings):
         st = int(status[b])
         if st == STATUS_FIT_ERROR:
-            out.append(serial.FitError({}))
+            # host-routed rows are re-scheduled serially anyway; don't pay
+            # the O(C) filter pass for a result the caller discards
+            if items is not None and batch.route[b] == ROUTE_DEVICE:
+                spec_b, status_b = items[b]
+                _, diagnosis = serial.find_clusters_that_fit(
+                    spec_b, status_b, batch.cluster_index.clusters
+                )
+                out.append(serial.FitError(diagnosis))
+            else:
+                out.append(serial.FitError({}))
             continue
         if st == STATUS_UNSCHEDULABLE:
             out.append(serial.UnschedulableError("insufficient capacity (batched)"))

@@ -187,6 +187,7 @@ class Scheduler:
                 decoded = tensors.decode_result(
                     batch, rep, sel, status,
                     enable_empty_workload_propagation=self.enable_empty_workload_propagation,
+                    items=items,
                 )
                 for i in device_idx:
                     out[i] = decoded[i]
@@ -224,18 +225,23 @@ class Scheduler:
             self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, mark_failed)
             return
 
-    # success: write spec.clusters + observed generation + condition
+        # success: patch spec.clusters, then record the *stored* generation in
+        # status — two steps exactly like the reference (scheduler.go:664
+        # patches spec, then patchBindingStatus reads the patched object's
+        # Generation into SchedulerObservedGeneration).  Predicting the bump
+        # inside one mutation would silently break idempotence if the store's
+        # no-op/equality semantics ever changed.
         targets: List[TargetCluster] = res
 
-        def patch(obj: ResourceBinding) -> None:
-            changed = [
-                (t.name, t.replicas) for t in obj.spec.clusters
-            ] != [(t.name, t.replicas) for t in targets]
+        def patch_spec(obj: ResourceBinding) -> None:
             obj.spec.clusters = list(targets)
-            # the store bumps generation iff the spec changed; observe it
-            obj.status.scheduler_observed_generation = obj.metadata.generation + (
-                1 if changed else 0
-            )
+
+        stored = self.store.mutate(
+            ResourceBinding.KIND, rb.namespace, rb.name, patch_spec
+        )
+
+        def patch_status(obj: ResourceBinding) -> None:
+            obj.status.scheduler_observed_generation = stored.metadata.generation
             if affinity_name:
                 obj.status.scheduler_observed_affinity_name = affinity_name
             obj.status.last_scheduled_time = __import__("time").time()
@@ -243,7 +249,7 @@ class Scheduler:
                 type=COND_SCHEDULED, status="True", reason=REASON_SUCCESS,
             ))
 
-        self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, patch)
+        self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, patch_status)
 
 
 def _is_scheduled_empty(rb: ResourceBinding) -> bool:
