@@ -1,0 +1,213 @@
+"""Three-queue scheduling queue: active / backoff / unschedulable.
+
+Mirrors the reference's priority scheduling queue
+(pkg/scheduler/internal/queue/scheduling_queue.go:127-372, active_queue.go:40,
+types.go Less):
+
+  * activeQ       — priority heap (priority desc, enqueue timestamp asc) of
+                    bindings ready to schedule now;
+  * backoffQ      — heap ordered by backoff expiry; failed attempts wait out
+                    an exponential backoff (initial 1s doubling to max 10s,
+                    calculateBackoffDuration :225) before re-entering activeQ;
+  * unschedulable — map of bindings whose last attempt said "no capacity /
+                    nothing will change until the cluster state does"; they
+                    re-enter activeQ on a cluster event
+                    (move_all_to_active_or_backoff) or after the leftover
+                    flush interval (flushUnschedulableBindingsLeftover :252,
+                    default 5min).
+
+Failure routing matches scheduler.go:829-841 handleErr: UnschedulableError
+-> unschedulable map; any other scheduling error (including FitError) ->
+backoffQ.  Success -> forget.
+
+Differences from the reference, by design:
+  * pop_ready drains a *batch* (the whole point of the TPU path is to
+    schedule many bindings per cycle); order within the drain is still
+    (priority desc, timestamp asc).
+  * no blocking Pop — the service runs tick-driven (store/worker.Runtime);
+    flush_backoff()/flush_unschedulable() are called per tick instead of by
+    1s/30s goroutines.  Wall-clock is injectable for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time as _time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Hashable, List, Optional, Tuple
+
+DEFAULT_INITIAL_BACKOFF_S = 1.0
+DEFAULT_MAX_BACKOFF_S = 10.0
+DEFAULT_MAX_IN_UNSCHEDULABLE_S = 300.0
+
+
+@dataclass
+class QueuedBindingInfo:
+    """types.go QueuedBindingInfo: key + priority + queue bookkeeping."""
+
+    key: Hashable
+    priority: int = 0
+    timestamp: float = 0.0  # last time added to a queue
+    attempts: int = 0
+    initial_attempt_timestamp: Optional[float] = None
+
+    def _active_sort_key(self, seq: int) -> Tuple:
+        # Less (types.go:182): priority desc, then timestamp asc
+        return (-self.priority, self.timestamp, seq)
+
+
+class SchedulingQueue:
+    def __init__(
+        self,
+        initial_backoff_s: float = DEFAULT_INITIAL_BACKOFF_S,
+        max_backoff_s: float = DEFAULT_MAX_BACKOFF_S,
+        max_in_unschedulable_s: float = DEFAULT_MAX_IN_UNSCHEDULABLE_S,
+        now: Callable[[], float] = _time.time,
+    ) -> None:
+        self.initial_backoff_s = initial_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.max_in_unschedulable_s = max_in_unschedulable_s
+        self.now = now
+        self._seq = itertools.count()
+        # heaps hold (sort_key..., key); staleness is resolved against the
+        # authoritative _where map (lazy deletion)
+        self._active_heap: List[Tuple] = []
+        self._backoff_heap: List[Tuple] = []
+        self._info: Dict[Hashable, QueuedBindingInfo] = {}
+        self._where: Dict[Hashable, str] = {}  # key -> active|backoff|unschedulable
+
+    # -- internals -----------------------------------------------------------
+    def _move_to_active(self, info: QueuedBindingInfo) -> None:
+        """moveToActiveQ (scheduling_queue.go:330): also removes the key from
+        backoff/unschedulable (lazily, via _where)."""
+        self._info[info.key] = info
+        self._where[info.key] = "active"
+        heapq.heappush(
+            self._active_heap, info._active_sort_key(next(self._seq)) + (info.key,)
+        )
+
+    def _backoff_duration(self, info: QueuedBindingInfo) -> float:
+        """calculateBackoffDuration (:225): 0 for first attempt, then initial
+        doubling per prior attempt, saturating at max."""
+        if info.attempts == 0:
+            return 0.0
+        d = self.initial_backoff_s
+        for _ in range(1, info.attempts):
+            if d > self.max_backoff_s - d:
+                return self.max_backoff_s
+            d += d
+        return d
+
+    # -- producer side -------------------------------------------------------
+    def push(self, key: Hashable, priority: int = 0) -> None:
+        """Push (:276): external event -> activeQ, superseding any backoff /
+        unschedulable residence."""
+        prev = self._info.get(key)
+        info = QueuedBindingInfo(
+            key=key, priority=priority, timestamp=self.now(),
+            attempts=prev.attempts if prev else 0,
+            initial_attempt_timestamp=(
+                prev.initial_attempt_timestamp if prev else None
+            ),
+        )
+        self._move_to_active(info)
+
+    def push_unschedulable_if_not_present(self, info: QueuedBindingInfo) -> None:
+        """:288 — no-op when the key already waits in active/backoff."""
+        if self._where.get(info.key) in ("active", "backoff"):
+            return
+        info.timestamp = self.now()
+        self._info[info.key] = info
+        self._where[info.key] = "unschedulable"
+
+    def push_backoff_if_not_present(self, info: QueuedBindingInfo) -> None:
+        """:301 — no-op when the key already waits in active/unschedulable."""
+        if self._where.get(info.key) in ("active", "unschedulable"):
+            return
+        info.timestamp = self.now()
+        self._info[info.key] = info
+        self._where[info.key] = "backoff"
+        expiry = info.timestamp + self._backoff_duration(info)
+        heapq.heappush(self._backoff_heap, (expiry, next(self._seq), info.key))
+
+    def forget(self, key: Hashable) -> None:
+        """:322 — scheduling finished (success or permanent); drop tracking."""
+        self._info.pop(key, None)
+        self._where.pop(key, None)
+
+    # -- consumer side -------------------------------------------------------
+    def pop_ready(self, max_n: Optional[int] = None) -> List[QueuedBindingInfo]:
+        """Drain up to max_n activeQ entries in (priority desc, ts asc) order.
+
+        The batched analogue of ActiveQueue.Pop; popped entries leave the
+        queue entirely (the cycle calls forget / push_* per result, which is
+        the Done() of this tick-driven design).
+        """
+        out: List[QueuedBindingInfo] = []
+        while self._active_heap and (max_n is None or len(out) < max_n):
+            entry = heapq.heappop(self._active_heap)
+            key = entry[-1]
+            if self._where.get(key) != "active":
+                continue  # stale heap entry
+            info = self._info.pop(key)
+            self._where.pop(key, None)
+            if info.initial_attempt_timestamp is None:
+                info.initial_attempt_timestamp = self.now()
+            out.append(info)
+        return out
+
+    # -- periodic flushes ----------------------------------------------------
+    def flush_backoff(self) -> int:
+        """flushBackoffQCompleted (:195): expired backoff -> activeQ."""
+        moved = 0
+        now = self.now()
+        while self._backoff_heap and self._backoff_heap[0][0] <= now:
+            _, _, key = heapq.heappop(self._backoff_heap)
+            if self._where.get(key) != "backoff":
+                continue
+            self._move_to_active(self._info[key])
+            moved += 1
+        return moved
+
+    def flush_unschedulable_leftover(self) -> int:
+        """flushUnschedulableBindingsLeftover (:252): entries older than
+        max_in_unschedulable_s -> activeQ."""
+        now = self.now()
+        stale = [
+            k for k, w in self._where.items()
+            if w == "unschedulable"
+            and now - self._info[k].timestamp > self.max_in_unschedulable_s
+        ]
+        for k in stale:
+            self._move_to_active(self._info[k])
+        return len(stale)
+
+    def move_all_to_active_or_backoff(self) -> int:
+        """MoveAllToActiveOrBackoffQueue semantics: a cluster event may make
+        unschedulable bindings schedulable; still-backing-off entries wait
+        out their timer, others go active."""
+        moved = 0
+        for k in [k for k, w in self._where.items() if w == "unschedulable"]:
+            info = self._info[k]
+            if self.now() < info.timestamp + self._backoff_duration(info):
+                self._where[k] = "backoff"
+                heapq.heappush(
+                    self._backoff_heap,
+                    (info.timestamp + self._backoff_duration(info),
+                     next(self._seq), k),
+                )
+            else:
+                self._move_to_active(info)
+            moved += 1
+        return moved
+
+    # -- introspection -------------------------------------------------------
+    def depths(self) -> Dict[str, int]:
+        counts = {"active": 0, "backoff": 0, "unschedulable": 0}
+        for w in self._where.values():
+            counts[w] += 1
+        return counts
+
+    def has(self, key: Hashable) -> bool:
+        return key in self._where

@@ -32,6 +32,7 @@ from karmada_tpu.models.work import (
 )
 from karmada_tpu.ops import serial, tensors
 from karmada_tpu.ops.solver import solve
+from karmada_tpu.scheduler.queue import QueuedBindingInfo, SchedulingQueue
 from karmada_tpu.store.store import Event, ObjectStore
 from karmada_tpu.store.worker import AsyncWorker, Runtime
 
@@ -43,7 +44,12 @@ _CYCLE = "__cycle__"
 
 
 class Scheduler:
-    """Watches bindings + clusters; schedules in batched cycles."""
+    """Watches bindings + clusters; schedules in batched cycles.
+
+    Pending bindings wait in a three-queue SchedulingQueue (active/backoff/
+    unschedulable, scheduler/queue.py); each cycle drains a priority-ordered
+    batch from the active queue into one solver call, then routes failures
+    back per scheduler.go:829-841 handleErr semantics."""
 
     def __init__(
         self,
@@ -52,6 +58,8 @@ class Scheduler:
         estimators: Optional[Sequence] = None,
         backend: str = "device",  # device | serial
         enable_empty_workload_propagation: bool = False,
+        batch_window: int = 4096,
+        queue: Optional[SchedulingQueue] = None,
     ) -> None:
         self.store = store
         self.backend = backend
@@ -61,31 +69,56 @@ class Scheduler:
             GeneralEstimator(),
         )
         self.enable_empty_workload_propagation = enable_empty_workload_propagation
-        # _pending is written from publisher threads (_on_event) and drained
-        # by the worker (_cycle); the lock makes the drain an atomic swap so
-        # keys enqueued mid-cycle survive into the next cycle.
-        self._pending_lock = threading.Lock()
-        self._pending: Dict[Tuple[str, str], None] = {}
+        self.batch_window = batch_window
+        # the queue is touched from publisher threads (_on_event) and the
+        # worker (_cycle); one lock guards every queue operation
+        self._queue_lock = threading.Lock()
+        self.queue = queue if queue is not None else SchedulingQueue()
         self.worker = runtime.register(AsyncWorker("scheduler", self._cycle))
+        runtime.register_periodic(self._periodic_flush)
         store.bus.subscribe(self._on_event)
 
     # -- event wiring -------------------------------------------------------
     def _on_event(self, event: Event) -> None:
         kind = event.kind
         if kind == ResourceBinding.KIND:
-            with self._pending_lock:
-                self._pending[(event.obj.namespace, event.obj.name)] = None
+            rb = event.obj
+            # only spec changes (generation moved) or creations enqueue; the
+            # scheduler's own status writes must not supersede the failure
+            # queues or reset the attempt counter (a status-only event would
+            # otherwise hot-loop a failing binding with no backoff)
+            if event.old is not None and (
+                rb.metadata.generation == event.old.metadata.generation
+            ):
+                return
+            with self._queue_lock:
+                self.queue.push((rb.namespace, rb.name), _priority_of(rb))
             self.worker.enqueue(_CYCLE)
         elif kind == Cluster.KIND:
-            # capacity/feasibility changed: revisit everything unscheduled
+            # capacity/feasibility changed: unschedulable entries become
+            # schedulable again (still-backing-off ones keep their timer);
+            # bindings not resident in any queue get another look
             enqueued = False
-            with self._pending_lock:
+            with self._queue_lock:
+                self.queue.move_all_to_active_or_backoff()
                 for rb in self.store.list(ResourceBinding.KIND):
+                    key = (rb.namespace, rb.name)
+                    if self.queue.has(key):
+                        continue  # resident: respect its queue/backoff state
                     if not rb.spec.clusters or self._needs_schedule(rb):
-                        self._pending[(rb.namespace, rb.name)] = None
-                enqueued = bool(self._pending)
+                        self.queue.push(key, _priority_of(rb))
+                enqueued = self.queue.depths()["active"] > 0
             if enqueued:
                 self.worker.enqueue(_CYCLE)
+
+    def _periodic_flush(self) -> None:
+        """Per-tick stand-in for the reference's 1s/30s flush goroutines."""
+        with self._queue_lock:
+            moved = self.queue.flush_backoff()
+            moved += self.queue.flush_unschedulable_leftover()
+            ready = self.queue.depths()["active"]
+        if moved or ready:
+            self.worker.enqueue(_CYCLE)
 
     # -- scheduling decision (doScheduleBinding scheduler.go:376) -----------
     def _needs_schedule(self, rb: ResourceBinding) -> bool:
@@ -103,26 +136,41 @@ class Scheduler:
 
     # -- the batched cycle --------------------------------------------------
     def _cycle(self, _key) -> None:
-        with self._pending_lock:
-            keys = list(self._pending.keys())
-            self._pending = {}
-        todo: List[ResourceBinding] = []
-        for ns, name in keys:
+        with self._queue_lock:
+            self.queue.flush_backoff()
+            infos = self.queue.pop_ready(self.batch_window)
+        todo: List[Tuple[QueuedBindingInfo, ResourceBinding]] = []
+        for info in infos:
+            ns, name = info.key
             rb = self.store.try_get(ResourceBinding.KIND, ns, name)
             if rb is None or not self._needs_schedule(rb):
+                # pop already removed the entry; an entry concurrently pushed
+                # for the same key is a REAL new event and must survive
                 continue
-            todo.append(rb)
-        if not todo:
-            return
-        clusters = [
-            c for c in self.store.list(Cluster.KIND)
-        ]
-        self.schedule_batch(todo, clusters)
+            info.attempts += 1
+            todo.append((info, rb))
+        if todo:
+            clusters = list(self.store.list(Cluster.KIND))
+            outcomes = self.schedule_batch([rb for _, rb in todo], clusters)
+            # handleErr routing (scheduler.go:829-841): UnschedulableError
+            # waits for a cluster event; other failures back off and retry.
+            # Success needs no forget: pop_ready removed the entry, and any
+            # concurrent re-push is a fresh event for the next cycle.
+            with self._queue_lock:
+                for (info, _), res in zip(todo, outcomes):
+                    if isinstance(res, serial.UnschedulableError):
+                        self.queue.push_unschedulable_if_not_present(info)
+                    elif isinstance(res, Exception):
+                        self.queue.push_backoff_if_not_present(info)
+        with self._queue_lock:
+            more = self.queue.depths()["active"] > 0
+        if more:
+            self.worker.enqueue(_CYCLE)
 
     # -- core: schedule a list of bindings against a cluster snapshot ------
     def schedule_batch(
         self, bindings: List[ResourceBinding], clusters: List[Cluster]
-    ) -> None:
+    ) -> List[object]:
         # affinity failover loop: term index per binding
         term_idx: Dict[int, int] = {}
         active: List[Tuple[int, ResourceBinding]] = list(enumerate(bindings))
@@ -153,8 +201,12 @@ class Scheduler:
                 results[i] = res
             active = next_active
 
+        outcomes: List[object] = []
         for i, rb in enumerate(bindings):
-            self._apply_result(rb, results.get(i), affinity_name.get(i, ""))
+            res = results.get(i)
+            self._apply_result(rb, res, affinity_name.get(i, ""))
+            outcomes.append(res)
+        return outcomes
 
     def _initial_term(self, rb: ResourceBinding) -> int:
         """Resume from the observed affinity term (scheduler.go:599-616)."""
@@ -250,6 +302,10 @@ class Scheduler:
             ))
 
         self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, patch_status)
+
+
+def _priority_of(rb: ResourceBinding) -> int:
+    return rb.spec.schedule_priority or 0
 
 
 def _is_scheduled_empty(rb: ResourceBinding) -> bool:

@@ -108,10 +108,12 @@ class Runtime:
     """Holds every controller's worker; runs them deterministically (pump)
     or in background threads (serve)."""
 
-    def __init__(self) -> None:
+    def __init__(self, periodic_interval_s: float = 0.5) -> None:
         self.workers: List[AsyncWorker] = []
         self._threads: List[threading.Thread] = []
         self._periodic: List[Callable[[], None]] = []
+        self._periodic_interval_s = periodic_interval_s
+        self._stop_event = threading.Event()
 
     def register(self, worker: AsyncWorker) -> AsyncWorker:
         self.workers.append(worker)
@@ -148,6 +150,22 @@ class Runtime:
                                  name=f"worker-{w.name}")
             t.start()
             self._threads.append(t)
+        if self._periodic:
+            # resync/flush hooks tick on a timer in serve mode (the
+            # reference's wait.Until goroutines; e.g. scheduling-queue
+            # backoff expiry must fire without any triggering event)
+            t = threading.Thread(target=self._run_periodic, daemon=True,
+                                 name="periodic")
+            t.start()
+            self._threads.append(t)
+
+    def _run_periodic(self) -> None:
+        while not self._stop_event.wait(self._periodic_interval_s):
+            for fn in self._periodic:
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 — periodic hooks never die
+                    traceback.print_exc()
 
     def _run_worker(self, w: AsyncWorker) -> None:
         backoff = 0.005
@@ -159,5 +177,6 @@ class Runtime:
                 backoff = min(backoff * 2, 0.5)
 
     def stop(self) -> None:
+        self._stop_event.set()
         for w in self.workers:
             w.stop()
