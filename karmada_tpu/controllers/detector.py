@@ -173,7 +173,15 @@ class ResourceDetector:
                 o.metadata.labels.pop(other_label, None)
             self.store.mutate(kind, namespace, name, claim)
 
-        replicas, requirements = self.interpreter.get_replicas(obj.to_manifest())
+        # applyReplicaInterpretation (detector.go:1454-1482): components win
+        # over plain replicas when an InterpretComponent customization exists
+        manifest = obj.to_manifest()
+        components = self.interpreter.get_components(manifest)
+        if components is not None:
+            replicas, requirements = 0, None
+        else:
+            components = []
+            replicas, requirements = self.interpreter.get_replicas(manifest)
         spec = policy.spec
         suspension = None
         if spec.suspension is not None:
@@ -201,6 +209,7 @@ class ResourceDetector:
                 ),
                 replicas=replicas,
                 replica_requirements=requirements,
+                components=list(components),
                 placement=spec.placement,
                 propagate_deps=spec.propagate_deps,
                 conflict_resolution=spec.conflict_resolution,
@@ -218,6 +227,7 @@ class ResourceDetector:
                 rb.spec.resource.uid = obj.metadata.uid
                 rb.spec.replicas = replicas
                 rb.spec.replica_requirements = requirements
+                rb.spec.components = list(components)
                 rb.spec.placement = spec.placement
                 rb.spec.propagate_deps = spec.propagate_deps
                 rb.spec.conflict_resolution = spec.conflict_resolution

@@ -74,6 +74,33 @@ class AccurateEstimatorClient:
 
         return list(self._pool.map(one, clusters))
 
+    def max_available_component_sets(
+        self, clusters: List[Cluster], components
+    ) -> List[TargetCluster]:
+        """MaxAvailableComponentSets fan-out (estimation.go:66-103 client
+        side): unreachable/unregistered estimators answer UNAUTHENTIC."""
+        from karmada_tpu.estimator.wire import (
+            MaxAvailableComponentSetsRequest,
+            MaxAvailableComponentSetsResponse,
+        )
+
+        def one(cluster: Cluster) -> TargetCluster:
+            transport = self.transports.get(cluster.name)
+            if transport is None:
+                return TargetCluster(cluster.name, UNAUTHENTIC_REPLICA)
+            req = MaxAvailableComponentSetsRequest.from_components(
+                cluster.name, components
+            )
+            try:
+                resp = MaxAvailableComponentSetsResponse.from_json(
+                    transport.call("MaxAvailableComponentSets", req.to_json())
+                )
+                return TargetCluster(cluster.name, resp.max_sets)
+            except Exception:  # noqa: BLE001 -- unreachable estimator
+                return TargetCluster(cluster.name, self._timeout_replicas)
+
+        return list(self._pool.map(one, clusters))
+
     # -- UnschedulableReplicaEstimator --------------------------------------
     def unschedulable_replicas(
         self, cluster: str, kind: str, namespace: str, name: str
@@ -148,4 +175,28 @@ class SnapshotEstimator:
                 labels = snap.node_labels[i] if i < len(snap.node_labels) else {}
                 total += replicas_on_node(f, labels, requirements)
             out.append(TargetCluster(cluster.name, total))
+        return out
+
+    def max_available_component_sets(
+        self, clusters: List[Cluster], components
+    ) -> List[TargetCluster]:
+        """Component-set capacity from the shipped free table (pool-level,
+        same bound as AccurateEstimatorServer, via the shared
+        wire.max_sets_from_free_table)."""
+        from karmada_tpu.estimator.wire import max_sets_from_free_table
+
+        out: List[TargetCluster] = []
+        now = time.time()
+        for cluster in clusters:
+            self.refresh(cluster.name)
+            with self._lock:
+                snap = self._snapshots.get(cluster.name)
+                age = now - self._fetched_at.get(cluster.name, 0.0)
+            no_transport = cluster.name not in self.client.transports
+            if snap is None or (no_transport or age > self.max_age_s):
+                out.append(TargetCluster(cluster.name, UNAUTHENTIC_REPLICA))
+                continue
+            out.append(TargetCluster(
+                cluster.name, max_sets_from_free_table(snap.node_free, components)
+            ))
         return out

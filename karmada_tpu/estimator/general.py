@@ -150,6 +150,30 @@ def max_replicas_from_models(
     return total
 
 
+def per_set_requirement(components) -> Dict[str, int]:
+    """perSetRequirement (general.go:181-195): aggregate demand of ONE set of
+    components, in request units (cpu milli, others Value)."""
+    out: Dict[str, int] = {}
+    for c in components:
+        rr = c.replica_requirements
+        if rr is None or not rr.resource_request:
+            continue
+        for name, qty in rr.resource_request.items():
+            out[name] = out.get(name, 0) + resource_request_value(name, qty) * c.replicas
+    return out
+
+
+def pods_in_set(components) -> int:
+    """podsInSet (general.go:172-179)."""
+    return sum(c.replicas for c in components)
+
+
+def max_sets_from_models(cluster: Cluster, components) -> int:
+    """getMaximumSetsBasedOnResourceModels (general.go:163-170): the
+    reference leaves this as a placeholder that never reduces the bound."""
+    return MAX_INT64
+
+
 class GeneralEstimator:
     """Reference GeneralEstimator: pure math on cluster.status.resourceSummary."""
 
@@ -165,6 +189,45 @@ class GeneralEstimator:
             TargetCluster(name=c.name, replicas=self._max_for_cluster(c, requirements))
             for c in clusters
         ]
+
+    def max_available_component_sets(
+        self, clusters: List[Cluster], components
+    ) -> List[TargetCluster]:
+        """MaxAvailableComponentSets (general.go:96-104): how many full SETS
+        of a multi-template workload's components fit per cluster."""
+        return [
+            TargetCluster(name=c.name, replicas=self._max_sets_for_cluster(c, components))
+            for c in clusters
+        ]
+
+    def _max_sets_for_cluster(self, cluster: Cluster, components) -> int:
+        """maxAvailableComponentSets (general.go:106-160)."""
+        summary = cluster.status.resource_summary
+        if summary is None:
+            return 0
+        allowed = allowed_pod_number(summary)
+        if allowed <= 0:
+            return 0
+        pods_per_set = pods_in_set(components)
+        if pods_per_set <= 0:
+            return min(allowed, MAX_INT32)
+        max_sets = allowed // pods_per_set
+        per_set = per_set_requirement(components)
+        if per_set and any(v > 0 for v in per_set.values()):
+            for name, req in per_set.items():
+                if req <= 0:
+                    continue
+                avail_milli = _available(summary, name)
+                if name == RESOURCE_CPU:
+                    available = avail_milli
+                else:
+                    available = -((-avail_milli) // 1000)
+                if available <= 0:
+                    return 0
+                max_sets = min(max_sets, available // req)
+        if self.enable_resource_modeling and summary.allocatable_modelings:
+            max_sets = min(max_sets, max_sets_from_models(cluster, components))
+        return min(max_sets, MAX_INT32)
 
     def _max_for_cluster(
         self, cluster: Cluster, requirements: Optional[ReplicaRequirements]

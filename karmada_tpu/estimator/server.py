@@ -85,6 +85,17 @@ class AccurateEstimatorServer:
             total = min(total, plugin(requirements, total))
         return total
 
+    def max_available_component_sets(self, components) -> int:
+        """Whole component SETS that fit this member's free capacity
+        (wire.max_sets_from_free_table), capped by the quota-style plugins
+        the reference runs (estimate.go:70-90)."""
+        from karmada_tpu.estimator.wire import max_sets_from_free_table
+
+        total = max_sets_from_free_table(_node_free(self.member), components)
+        for plugin in self.plugins:
+            total = min(total, plugin(None, total))
+        return min(total, MAX_INT32)
+
     def unschedulable_replicas(self, kind: str, namespace: str, name: str) -> int:
         return self.member.unschedulable_replicas(kind, namespace, name)
 
@@ -101,6 +112,15 @@ class AccurateEstimatorServer:
             req = MaxAvailableReplicasRequest.from_json(body)
             n = self.max_available_replicas(req.requirements())
             return MaxAvailableReplicasResponse(max_replicas=n).to_json()
+        if method == "MaxAvailableComponentSets":
+            from karmada_tpu.estimator.wire import (
+                MaxAvailableComponentSetsRequest,
+                MaxAvailableComponentSetsResponse,
+            )
+
+            req = MaxAvailableComponentSetsRequest.from_json(body)
+            n = self.max_available_component_sets(req.typed_components())
+            return MaxAvailableComponentSetsResponse(max_sets=n).to_json()
         if method == "GetUnschedulableReplicas":
             req = UnschedulableReplicasRequest.from_json(body)
             n = self.unschedulable_replicas(req.resource_kind, req.namespace, req.name)

@@ -95,6 +95,64 @@ class MaxAvailableReplicasResponse:
 
 
 @dataclass
+class MaxAvailableComponentSetsRequest:
+    """pb.MaxAvailableComponentSetsRequest (generated.proto Component):
+    how many whole SETS of a multi-template workload's components fit."""
+
+    cluster: str = ""
+    # [{"name": ..., "replicas": n, "resourceRequest": {res: quantity-str}}]
+    components: List[Dict] = field(default_factory=list)
+
+    @staticmethod
+    def from_components(cluster: str, components) -> "MaxAvailableComponentSetsRequest":
+        rows = []
+        for c in components:
+            req = {}
+            if c.replica_requirements is not None:
+                req = {k: str(v)
+                       for k, v in c.replica_requirements.resource_request.items()}
+            rows.append({"name": c.name, "replicas": c.replicas,
+                         "resourceRequest": req})
+        return MaxAvailableComponentSetsRequest(cluster=cluster, components=rows)
+
+    def to_json(self) -> dict:
+        return {"cluster": self.cluster, "components": self.components}
+
+    @staticmethod
+    def from_json(d: dict) -> "MaxAvailableComponentSetsRequest":
+        return MaxAvailableComponentSetsRequest(
+            cluster=d.get("cluster", ""),
+            components=list(d.get("components", [])),
+        )
+
+    def typed_components(self):
+        from karmada_tpu.models.work import Component
+
+        out = []
+        for row in self.components:
+            req = {k: Quantity.parse(v)
+                   for k, v in (row.get("resourceRequest") or {}).items()}
+            out.append(Component(
+                name=row.get("name", ""), replicas=int(row.get("replicas", 0)),
+                replica_requirements=ReplicaRequirements(resource_request=req)
+                if req else None,
+            ))
+        return out
+
+
+@dataclass
+class MaxAvailableComponentSetsResponse:
+    max_sets: int = 0
+
+    def to_json(self) -> dict:
+        return {"maxSets": self.max_sets}
+
+    @staticmethod
+    def from_json(d: dict) -> "MaxAvailableComponentSetsResponse":
+        return MaxAvailableComponentSetsResponse(max_sets=int(d.get("maxSets", 0)))
+
+
+@dataclass
 class UnschedulableReplicasRequest:
     cluster: str = ""
     resource_kind: str = ""
@@ -195,8 +253,44 @@ def replicas_on_node(
     return max(per_node, 0)
 
 
+def max_sets_from_free_table(free: List[Dict[str, int]], components) -> int:
+    """Whole component SETS that fit a free-capacity table (pool level).
+
+    The single implementation behind AccurateEstimatorServer and
+    SnapshotEstimator component-set answers.  The reference estimator server
+    leaves node-level set packing as a TODO (estimate.go:70-90 runs only
+    quota-style plugins); this pool-level bound is at least as tight.
+    Units follow the table convention: 'pods' is a raw count, cpu is milli,
+    everything else milli -> Value.
+    """
+    from karmada_tpu.estimator.general import per_set_requirement, pods_in_set
+    from karmada_tpu.utils.quantity import RESOURCE_CPU, RESOURCE_PODS
+
+    MAX_INT32 = (1 << 31) - 1
+    pods_free = sum(int(f.get("pods", 0)) for f in free)
+    if pods_free <= 0:
+        return 0
+    pods_per_set = pods_in_set(components)
+    if pods_per_set <= 0:
+        return min(pods_free, MAX_INT32)
+    total = pods_free // pods_per_set
+    for rname, req in per_set_requirement(components).items():
+        if req <= 0:
+            continue
+        pool = sum(int(f.get(rname, 0)) for f in free)
+        if rname in (RESOURCE_CPU, RESOURCE_PODS):
+            avail = pool
+        else:
+            avail = -((-pool) // 1000)
+        if avail <= 0:
+            return 0
+        total = min(total, avail // req)
+    return min(total, MAX_INT32)
+
+
 _METHODS = {
     "MaxAvailableReplicas": MaxAvailableReplicasRequest,
+    "MaxAvailableComponentSets": MaxAvailableComponentSetsRequest,
     "GetUnschedulableReplicas": UnschedulableReplicasRequest,
     "CapacitySnapshot": None,  # empty request body
 }

@@ -25,6 +25,7 @@ from karmada_tpu.utils.quantity import Quantity
 
 # operation names (config/v1alpha1 InterpreterOperation)
 OP_INTERPRET_REPLICA = "InterpretReplica"
+OP_INTERPRET_COMPONENT = "InterpretComponent"
 OP_REVISE_REPLICA = "ReviseReplica"
 OP_RETAIN = "Retain"
 OP_AGGREGATE_STATUS = "AggregateStatus"
@@ -140,6 +141,17 @@ class ResourceInterpreter:
         if kind == "Pod":
             return 1, _pod_template_requirements(deep_get(manifest, "spec", {}), ns)
         return 0, None
+
+    def get_components(self, manifest: Dict[str, Any]):
+        """Components of a multi-template workload (binding_types.go:98), or
+        None when no customization implements InterpretComponent — the
+        native default declines, exactly like the reference
+        (native/default.go:115 'no plan to implement this method yet');
+        callers then fall back to get_replicas (detector.go:1454-1482)."""
+        hook = self._hook(manifest, OP_INTERPRET_COMPONENT)
+        if hook is None:
+            return None
+        return hook(manifest)
 
     def revise_replica(self, manifest: Dict[str, Any], replicas: int) -> Dict[str, Any]:
         """Set the per-cluster replica count (native/revisereplica.go)."""

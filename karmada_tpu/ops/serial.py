@@ -755,16 +755,43 @@ def _dynamic_fresh_scale(state: _AssignState) -> List[TargetCluster]:
 # ---------------------------------------------------------------------------
 
 
+def is_multi_template_applicable(spec: ResourceBindingSpec) -> bool:
+    """isMultiTemplateSchedulingApplicable (core/estimation.go:42-64): two or
+    more components AND a Cluster spread constraint targeting exactly one
+    cluster (MinGroups == MaxGroups == 1)."""
+    if len(spec.components) < 2 or spec.placement is None:
+        return False
+    from karmada_tpu.models.policy import SPREAD_BY_FIELD_CLUSTER
+
+    for sc in spec.placement.spread_constraints:
+        if (
+            sc.spread_by_field == SPREAD_BY_FIELD_CLUSTER
+            and sc.min_groups == 1
+            and sc.max_groups == 1
+        ):
+            return True
+    return False
+
+
 def make_cal_available(estimators) -> Callable:
-    """calAvailableReplicas (core/util.go:56-101): min across estimators,
-    skipping UnauthenticReplica; non-workloads shortcut to MaxInt32."""
+    """calAvailableReplicas (core/util.go:56-110): min across estimators,
+    skipping UnauthenticReplica; non-workloads shortcut to MaxInt32.  Multi-
+    template workloads (feature MultiplePodTemplatesScheduling) estimate
+    whole component SETS instead (calculateMultiTemplateAvailableSets,
+    estimation.go:66-103)."""
 
     def cal(clusters: List[Cluster], spec: ResourceBindingSpec) -> List[TargetCluster]:
         out = [TargetCluster(name=c.name, replicas=MAX_INT32) for c in clusters]
         if spec.replicas == 0 and not spec.components:
             return out
+        multi_template = is_multi_template_applicable(spec)
         for est in estimators:
-            res = est.max_available_replicas(clusters, spec.replica_requirements)
+            if multi_template:
+                if not hasattr(est, "max_available_component_sets"):
+                    continue
+                res = est.max_available_component_sets(clusters, spec.components)
+            else:
+                res = est.max_available_replicas(clusters, spec.replica_requirements)
             for i, tc in enumerate(res):
                 if tc.replicas == -1:
                     continue

@@ -235,9 +235,12 @@ _AVAIL_CAP = (1 << _AVAIL_BITS) - 1
 
 
 def _capacity_estimates(
-    req_milli, req_is_cpu, avail_milli, has_alloc, pods_allowed, has_summary
+    req_milli, req_is_cpu, req_pods, avail_milli, has_alloc, pods_allowed,
+    has_summary
 ):
-    """est[Q+1, C]: GeneralEstimator summary math (general.go:56-94,294-334).
+    """est[Q+1, C]: GeneralEstimator summary math (general.go:56-94,294-334),
+    including component-SET classes (maxAvailableComponentSets general.go:
+    106-160) whose pod bound divides by pods-per-set.
 
     Row Q is the requirements==None row: min(allowed pods, MaxInt32).
     """
@@ -253,7 +256,8 @@ def _capacity_estimates(
     cnt = jnp.where(ok, avail // jnp.maximum(req, 1), 0)  # [Q, C, R]
     cnt = jnp.where(req > 0, cnt, MAX_INT64)  # unrequested resources inert
     est = jnp.min(cnt, axis=2)  # [Q, C]
-    est = jnp.minimum(est, pods_allowed[None, :])
+    pods_bound = pods_allowed[None, :] // jnp.maximum(req_pods[:, None], 1)
+    est = jnp.minimum(est, pods_bound)
     est = jnp.where(has_summary[None, :] & (pods_allowed[None, :] > 0), est, 0)
     est = jnp.minimum(jnp.maximum(est, 0), MAX_INT32)
     none_row = jnp.where(
@@ -453,7 +457,7 @@ def schedule_batch(
     cluster_valid, deleting, name_rank, pods_allowed, has_summary,
     avail_milli, has_alloc, api_ok,
     # request classes
-    req_milli, req_is_cpu, est_override,
+    req_milli, req_is_cpu, req_pods, est_override,
     # placements
     pl_mask, pl_tol_bypass, pl_strategy, pl_static_w,
     pl_has_cluster_sc, pl_sc_min, pl_sc_max, pl_ignore_avail,
@@ -463,7 +467,8 @@ def schedule_batch(
 ):
     """The full cycle: returns (rep[B,C] int64, selected[B,C] bool, status[B])."""
     est_q = _capacity_estimates(
-        req_milli, req_is_cpu, avail_milli, has_alloc, pods_allowed, has_summary
+        req_milli, req_is_cpu, req_pods, avail_milli, has_alloc, pods_allowed,
+        has_summary
     )
     Q = req_milli.shape[0]
     est_q = est_q.at[:Q].set(jnp.where(est_override >= 0, est_override, est_q[:Q]))
@@ -506,7 +511,7 @@ def solve(batch):
         batch.cluster_valid, batch.deleting, batch.name_rank,
         batch.pods_allowed, batch.has_summary, batch.avail_milli,
         batch.has_alloc, batch.api_ok,
-        batch.req_milli, batch.req_is_cpu, batch.est_override,
+        batch.req_milli, batch.req_is_cpu, batch.req_pods, batch.est_override,
         batch.pl_mask, batch.pl_tol_bypass, batch.pl_strategy,
         batch.pl_static_w, batch.pl_has_cluster_sc, batch.pl_sc_min,
         batch.pl_sc_max, batch.pl_ignore_avail,

@@ -120,6 +120,7 @@ class SolverBatch:
     # request classes
     req_milli: np.ndarray  # int64[Q, R] requested (cpu: milli, other: units)
     req_is_cpu: np.ndarray  # bool[R]
+    req_pods: np.ndarray  # int64[Q] pods per unit (1; pods-per-set for sets)
     est_override: np.ndarray  # int64[Q, C]; >=0 overrides device estimate
 
     # placements
@@ -178,8 +179,6 @@ def _placement_key(p: Placement) -> str:
 
 
 def _route_for(spec: ResourceBindingSpec, placement: Placement) -> int:
-    if len(spec.components) > 1:
-        return ROUTE_MULTI_COMPONENT
     scs = placement.spread_constraints
     if scs and not serial.should_ignore_spread_constraint(placement):
         for sc in scs:
@@ -191,7 +190,24 @@ def _route_for(spec: ResourceBindingSpec, placement: Placement) -> int:
                 return ROUTE_TOPOLOGY_SPREAD
             if sc.spread_by_label:
                 return ROUTE_UNSUPPORTED
+    if len(spec.components) > 1:
+        # multi-template scheduling (estimation.go:42-64) encodes the
+        # component-set capacity as a request class (per-set aggregate +
+        # pods-per-set divisor) and stays on device; other multi-component
+        # shapes take the serial replicas-0 propagation path
+        if serial.is_multi_template_applicable(spec):
+            return ROUTE_DEVICE
+        return ROUTE_MULTI_COMPONENT
     return ROUTE_DEVICE
+
+
+@dataclass
+class _SetClass:
+    """Request class for a multi-template workload: capacity is counted in
+    whole component SETS (per-set aggregate requirement + pods-per-set)."""
+
+    per_set: Dict[str, int]  # request units (cpu milli, others Value)
+    pods_per_set: int
 
 
 class EncoderCache:
@@ -286,7 +302,24 @@ def encode_batch(
         gvk_id[b] = gvks[g]
 
         rr = spec.replica_requirements
-        if rr is not None and rr.resource_request:
+        if len(spec.components) > 1 and route[b] == ROUTE_DEVICE:
+            # multi-template: the request class is the per-set aggregate
+            from karmada_tpu.estimator.general import (
+                per_set_requirement,
+                pods_in_set,
+            )
+
+            per_set = per_set_requirement(spec.components)
+            pods_per_set = pods_in_set(spec.components)
+            ck = ("__sets__", pods_per_set, tuple(sorted(per_set.items())))
+            if ck not in classes:
+                classes[ck] = len(classes)
+                class_reqs.append(_SetClass(per_set, pods_per_set))
+                for n in per_set:
+                    if n not in res_names:
+                        res_names[n] = len(res_names)
+            class_id[b] = classes[ck]
+        elif rr is not None and rr.resource_request:
             ck = tuple(sorted((n, q.milli) for n, q in rr.resource_request.items()))
             if ck not in classes:
                 classes[ck] = len(classes)
@@ -349,10 +382,16 @@ def encode_batch(
             avail_milli[i, r] = m
 
     req_milli = np.zeros((Q, R), np.int64)
-    for q, rr in enumerate(class_reqs):
-        for n, qty in rr.resource_request.items():
-            r = res_names[n]
-            req_milli[q, r] = qty.milli_value() if n == RESOURCE_CPU else qty.value()
+    req_pods = np.ones(Q, np.int64)
+    for q, cr in enumerate(class_reqs):
+        if isinstance(cr, _SetClass):
+            for n, v in cr.per_set.items():
+                req_milli[q, res_names[n]] = v
+            req_pods[q] = max(cr.pods_per_set, 1)
+        else:
+            for n, qty in cr.resource_request.items():
+                r = res_names[n]
+                req_milli[q, r] = qty.milli_value() if n == RESOURCE_CPU else qty.value()
 
     # histogram-modeled clusters: host-side exact override (general.go:336)
     est_override = np.full((Q, C), -1, np.int64)
@@ -366,6 +405,10 @@ def encode_batch(
     ]
     if modeled:
         for q, (ck, rr) in enumerate(zip(classes, class_reqs)):
+            if isinstance(rr, _SetClass):
+                # sets math has no model-histogram refinement (the reference
+                # getMaximumSetsBasedOnResourceModels is a no-op placeholder)
+                continue
             row = None if cache is None else cache.override_rows.get(ck)
             if row is None:
                 row = np.full(C, -1, np.int64)
@@ -462,7 +505,8 @@ def encode_batch(
         cluster_valid=cluster_valid, deleting=deleting, name_rank=name_rank,
         pods_allowed=pods_allowed, has_summary=has_summary,
         avail_milli=avail_milli, has_alloc=has_alloc, api_ok=api_ok,
-        req_milli=req_milli, req_is_cpu=req_is_cpu, est_override=est_override,
+        req_milli=req_milli, req_is_cpu=req_is_cpu, req_pods=req_pods,
+        est_override=est_override,
         pl_mask=pl_mask, pl_tol_bypass=pl_tol_bypass, pl_strategy=pl_strategy,
         pl_static_w=pl_static_w, pl_has_cluster_sc=pl_has_cluster_sc,
         pl_sc_min=pl_sc_min, pl_sc_max=pl_sc_max, pl_ignore_avail=pl_ignore_avail,

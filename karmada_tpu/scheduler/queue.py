@@ -76,6 +76,9 @@ class SchedulingQueue:
         self._backoff_heap: List[Tuple] = []
         self._info: Dict[Hashable, QueuedBindingInfo] = {}
         self._where: Dict[Hashable, str] = {}  # key -> active|backoff|unschedulable
+        # the expiry of the CURRENT backoff residence; a heap entry whose
+        # expiry differs is stale (the key left and re-entered backoff)
+        self._backoff_expiry: Dict[Hashable, float] = {}
 
     # -- internals -----------------------------------------------------------
     def _move_to_active(self, info: QueuedBindingInfo) -> None:
@@ -83,6 +86,7 @@ class SchedulingQueue:
         backoff/unschedulable (lazily, via _where)."""
         self._info[info.key] = info
         self._where[info.key] = "active"
+        self._backoff_expiry.pop(info.key, None)
         heapq.heappush(
             self._active_heap, info._active_sort_key(next(self._seq)) + (info.key,)
         )
@@ -129,12 +133,14 @@ class SchedulingQueue:
         self._info[info.key] = info
         self._where[info.key] = "backoff"
         expiry = info.timestamp + self._backoff_duration(info)
+        self._backoff_expiry[info.key] = expiry
         heapq.heappush(self._backoff_heap, (expiry, next(self._seq), info.key))
 
     def forget(self, key: Hashable) -> None:
         """:322 — scheduling finished (success or permanent); drop tracking."""
         self._info.pop(key, None)
         self._where.pop(key, None)
+        self._backoff_expiry.pop(key, None)
 
     # -- consumer side -------------------------------------------------------
     def pop_ready(self, max_n: Optional[int] = None) -> List[QueuedBindingInfo]:
@@ -163,9 +169,11 @@ class SchedulingQueue:
         moved = 0
         now = self.now()
         while self._backoff_heap and self._backoff_heap[0][0] <= now:
-            _, _, key = heapq.heappop(self._backoff_heap)
+            expiry, _, key = heapq.heappop(self._backoff_heap)
             if self._where.get(key) != "backoff":
                 continue
+            if expiry != self._backoff_expiry.get(key):
+                continue  # stale entry from an earlier backoff residence
             self._move_to_active(self._info[key])
             moved += 1
         return moved
@@ -190,13 +198,11 @@ class SchedulingQueue:
         moved = 0
         for k in [k for k, w in self._where.items() if w == "unschedulable"]:
             info = self._info[k]
-            if self.now() < info.timestamp + self._backoff_duration(info):
+            expiry = info.timestamp + self._backoff_duration(info)
+            if self.now() < expiry:
                 self._where[k] = "backoff"
-                heapq.heappush(
-                    self._backoff_heap,
-                    (info.timestamp + self._backoff_duration(info),
-                     next(self._seq), k),
-                )
+                self._backoff_expiry[k] = expiry
+                heapq.heappush(self._backoff_heap, (expiry, next(self._seq), k))
             else:
                 self._move_to_active(info)
             moved += 1

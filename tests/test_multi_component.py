@@ -1,0 +1,209 @@
+"""Multi-template (multi-component) scheduling: MaxAvailableComponentSets
+estimation (general.go:96-160, estimation.go:42-103), device/serial parity,
+and the end-to-end FlinkDeployment-style flow through the hook tier."""
+
+import random
+
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.estimator.server import AccurateEstimatorServer
+from karmada_tpu.interpreter.interpreter import (
+    Customization,
+    OP_INTERPRET_COMPONENT,
+)
+from karmada_tpu.members.member import FakeMemberCluster
+from karmada_tpu.models.cluster import (
+    APIEnablement,
+    Cluster,
+    ClusterSpec,
+    ClusterStatus,
+    ResourceSummary,
+)
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ResourceSelector,
+    SPREAD_BY_FIELD_CLUSTER,
+    SpreadConstraint,
+)
+from karmada_tpu.models.work import (
+    Component,
+    ObjectReference,
+    ReplicaRequirements,
+    ResourceBinding,
+    ResourceBindingSpec,
+    ResourceBindingStatus,
+)
+from karmada_tpu.ops import serial, tensors
+from karmada_tpu.ops.solver import solve
+from karmada_tpu.utils.quantity import Quantity
+
+
+def flink_components(jm_cpu="1", tm_cpu="2", jm_n=1, tm_n=3):
+    return [
+        Component(name="jobmanager", replicas=jm_n,
+                  replica_requirements=ReplicaRequirements(resource_request={
+                      "cpu": Quantity.parse(jm_cpu),
+                      "memory": Quantity.parse("2Gi")})),
+        Component(name="taskmanager", replicas=tm_n,
+                  replica_requirements=ReplicaRequirements(resource_request={
+                      "cpu": Quantity.parse(tm_cpu),
+                      "memory": Quantity.parse("4Gi")})),
+    ]
+
+
+def mk_cluster(name, cpu="64", mem="256Gi", pods=110):
+    return Cluster(
+        metadata=ObjectMeta(name=name),
+        spec=ClusterSpec(),
+        status=ClusterStatus(
+            api_enablements=[APIEnablement("flink.apache.org/v1beta1",
+                                           ["FlinkDeployment"])],
+            resource_summary=ResourceSummary(allocatable={
+                "cpu": Quantity.parse(cpu), "memory": Quantity.parse(mem),
+                "pods": Quantity.parse(str(pods))}),
+        ),
+    )
+
+
+def test_general_estimator_component_sets_math():
+    est = GeneralEstimator()
+    c = mk_cluster("m", cpu="64", mem="256Gi", pods=110)
+    comps = flink_components()  # per set: 1x(1cpu,2Gi) + 3x(2cpu,4Gi) = 7cpu, 14Gi, 4 pods
+    sets = est._max_sets_for_cluster(c, comps)
+    # cpu bound: 64000m // 7000m = 9; mem bound: 256Gi//14Gi = 18; pods: 110//4 = 27
+    assert sets == 9
+
+    # pods bound wins when pods are scarce
+    c2 = mk_cluster("m2", cpu="64", mem="256Gi", pods=7)
+    assert est._max_sets_for_cluster(c2, comps) == 1  # 7 // 4
+
+    # missing allocatable for a requested resource -> 0
+    c3 = mk_cluster("m3")
+    del c3.status.resource_summary.allocatable["memory"]
+    assert est._max_sets_for_cluster(c3, comps) == 0
+
+    # componentless replicas=0 set: allowed pods bound
+    assert est._max_sets_for_cluster(c, [Component(name="x", replicas=0)]) == 110
+
+
+def test_is_multi_template_applicable():
+    spec = ResourceBindingSpec(components=flink_components())
+    assert not serial.is_multi_template_applicable(spec)  # no placement
+    spec.placement = Placement(spread_constraints=[SpreadConstraint(
+        spread_by_field=SPREAD_BY_FIELD_CLUSTER, min_groups=1, max_groups=2)])
+    assert not serial.is_multi_template_applicable(spec)  # max_groups != 1
+    spec.placement.spread_constraints[0].max_groups = 1
+    assert serial.is_multi_template_applicable(spec)
+    spec.components = spec.components[:1]
+    assert not serial.is_multi_template_applicable(spec)  # < 2 components
+
+
+def _mt_spec(b, uid="u"):
+    return ResourceBindingSpec(
+        resource=ObjectReference(api_version="flink.apache.org/v1beta1",
+                                 kind="FlinkDeployment", namespace="default",
+                                 name=f"job-{b}", uid=uid),
+        replicas=0,
+        components=flink_components(tm_cpu=str(1 + b % 3)),
+        placement=Placement(spread_constraints=[SpreadConstraint(
+            spread_by_field=SPREAD_BY_FIELD_CLUSTER, min_groups=1, max_groups=1)]),
+    )
+
+
+def test_multi_template_routes_to_device_and_matches_serial():
+    rng = random.Random(3)
+    clusters = [
+        mk_cluster(f"m{i}", cpu=str(rng.choice([8, 16, 64])),
+                   mem=rng.choice(["32Gi", "64Gi", "256Gi"]),
+                   pods=rng.choice([10, 110]))
+        for i in range(9)
+    ]
+    items = [(_mt_spec(b, uid=f"uid-{b}"), ResourceBindingStatus())
+             for b in range(12)]
+    est = GeneralEstimator()
+    cal = serial.make_cal_available([est])
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch(items, cindex, est)
+    assert (batch.route == tensors.ROUTE_DEVICE).all()
+    rep, sel, status = solve(batch)
+    got = tensors.decode_result(batch, rep, sel, status, items=items)
+    for b, (spec, st) in enumerate(items):
+        want = serial.schedule(spec, st, clusters, cal)
+        want_map = {tc.name: tc.replicas for tc in want}
+        got_map = {tc.name: tc.replicas for tc in got[b]}
+        assert got_map == want_map, f"b={b}: serial={want_map} device={got_map}"
+        assert len(got_map) == 1  # spread 1..1: exactly one cluster
+        assert set(got_map.values()) == {0}  # propagated whole, no division
+
+
+def test_multi_component_without_single_cluster_constraint_routes_serial():
+    spec = _mt_spec(0)
+    spec.placement = Placement()
+    cindex = tensors.ClusterIndex.build([mk_cluster("m0")])
+    batch = tensors.encode_batch([(spec, ResourceBindingStatus())], cindex)
+    assert batch.route[0] == tensors.ROUTE_MULTI_COMPONENT
+
+
+def test_estimator_server_component_sets():
+    m = FakeMemberCluster("m", cpu_allocatable_milli=64_000,
+                          memory_allocatable_gi=256, pods_allocatable=110)
+    srv = AccurateEstimatorServer(m)
+    assert srv.max_available_component_sets(flink_components()) == 9
+
+
+def test_flink_style_e2e_via_component_hook():
+    cp = ControlPlane()
+    cp.add_member("small", cpu_milli=8_000)
+    cp.add_member("big", cpu_milli=64_000)
+    for member in cp.members.values():
+        member.api_enablements.append(
+            APIEnablement("flink.apache.org/v1beta1", ["FlinkDeployment"]))
+    cp.tick()
+
+    def get_components(manifest):
+        spec = manifest.get("spec", {})
+        return [
+            Component(name=n, replicas=int(c.get("replicas", 1)),
+                      replica_requirements=ReplicaRequirements(resource_request={
+                          "cpu": Quantity.parse(str(c.get("cpu", "1")))}))
+            for n, c in spec.get("components", {}).items()
+        ]
+
+    cp.interpreter.register(Customization(
+        api_version="flink.apache.org/v1beta1", kind="FlinkDeployment",
+        hooks={OP_INTERPRET_COMPONENT: get_components},
+    ))
+    cp.apply_policy(PropagationPolicy(
+        metadata=ObjectMeta(name="flink-pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(
+                api_version="flink.apache.org/v1beta1", kind="FlinkDeployment")],
+            placement=Placement(spread_constraints=[SpreadConstraint(
+                spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+                min_groups=1, max_groups=1)]),
+        ),
+    ))
+    cp.apply({
+        "apiVersion": "flink.apache.org/v1beta1", "kind": "FlinkDeployment",
+        "metadata": {"name": "wordcount", "namespace": "default"},
+        "spec": {"components": {
+            "jobmanager": {"replicas": 1, "cpu": "1"},
+            "taskmanager": {"replicas": 3, "cpu": "2"},
+        }},
+    })
+    cp.tick()
+
+    rb = cp.store.get(ResourceBinding.KIND, "default", "wordcount-flinkdeployment")
+    assert len(rb.spec.components) == 2
+    assert rb.spec.replicas == 0
+    # single target, and it must be the big cluster (most component sets fit)
+    assert [t.name for t in rb.spec.clusters] == ["big"]
+    assert rb.spec.clusters[0].replicas == 0
+    # whole manifest applied, unrevised
+    applied = cp.member("big").get("FlinkDeployment", "default", "wordcount")
+    assert applied is not None
+    assert applied.manifest["spec"]["components"]["taskmanager"]["replicas"] == 3
+    assert cp.member("small").get("FlinkDeployment", "default", "wordcount") is None
