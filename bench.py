@@ -208,6 +208,7 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None):
     jitted solve, and the real decode_result (same as scheduler/service.py).
     """
     from karmada_tpu.ops.solver import solve
+    from karmada_tpu.scheduler import metrics as sm
 
     n = len(items)
     scheduled = 0
@@ -220,9 +221,14 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None):
         part = items[lo : lo + chunk]
         batch = tensors.encode_batch(part, cindex, estimator, cache=cache)
         t1 = time.perf_counter()
+        sm.STEP_LATENCY.observe(t1 - tc, schedule_step=sm.STEP_ENCODE)
         rep, sel, status = solve(batch)
-        solve_s += time.perf_counter() - t1
+        t2 = time.perf_counter()
+        solve_s += t2 - t1
+        sm.STEP_LATENCY.observe(t2 - t1, schedule_step=sm.STEP_SOLVE)
         decoded = tensors.decode_result(batch, rep, sel, status)
+        sm.STEP_LATENCY.observe(time.perf_counter() - t2,
+                                schedule_step=sm.STEP_DECODE)
         scheduled += sum(1 for d in decoded if not isinstance(d, Exception))
         chunk_lat.append(time.perf_counter() - tc)
     return time.perf_counter() - t0, solve_s, scheduled, chunk_lat
@@ -250,6 +256,8 @@ def main() -> None:
     ap.add_argument("--quick", action="store_true", help="small smoke config")
     ap.add_argument("--force-cpu", action="store_true",
                     help="skip the device probe and run on host CPU")
+    ap.add_argument("--metrics", action="store_true",
+                    help="dump the metrics registry to stderr after the run")
     ap.add_argument("--probe-timeout", type=float, default=150.0)
     args = ap.parse_args()
     if args.quick:
@@ -339,6 +347,10 @@ def main() -> None:
             "serial_lang": "python (Go-port control; Go itself would be ~10-100x faster)",
         },
     }))
+    if args.metrics:
+        from karmada_tpu.utils.metrics import REGISTRY
+
+        print(REGISTRY.dump(), file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -10,6 +10,8 @@ same apply interface.
 
 from __future__ import annotations
 
+import time
+
 from typing import Dict, Optional
 
 from karmada_tpu.controllers.binding import (
@@ -24,6 +26,16 @@ from karmada_tpu.models.meta import Condition, deep_get, set_condition
 from karmada_tpu.models.work import COND_WORK_APPLIED, Work
 from karmada_tpu.store.store import DELETED, Event, ObjectStore
 from karmada_tpu.store.worker import AsyncWorker, Runtime
+from karmada_tpu.utils import events as ev
+from karmada_tpu.utils.metrics import REGISTRY, exponential_buckets
+
+# execution_controller.go:154 metrics.ObserveSyncWorkloadLatency
+SYNC_WORKLOAD_LATENCY = REGISTRY.histogram(
+    "karmada_work_sync_workload_duration_seconds",
+    "Duration in seconds to sync a Work's manifests to its member cluster",
+    ("result",),
+    buckets=exponential_buckets(0.001, 2, 12),
+)
 
 # annotation carrying the conflict policy down to the apply engine
 CONFLICT_ANNOTATION = "work.karmada.io/conflict-resolution"
@@ -89,9 +101,11 @@ class ExecutionController:
         runtime: Runtime,
         members: Dict[str, FakeMemberCluster],
         interpreter: Optional[ResourceInterpreter] = None,
+        recorder: Optional[ev.EventRecorder] = None,
     ) -> None:
         self.store = store
         self.members = members
+        self.recorder = recorder if recorder is not None else ev.EventRecorder()
         self.watcher = ObjectWatcher(interpreter or ResourceInterpreter())
         self._deleted: Dict[tuple, list] = {}
         self.worker = runtime.register(AsyncWorker("execution", self._reconcile))
@@ -140,6 +154,7 @@ class ExecutionController:
             return None
         if not self._cluster_ready(cluster_name):
             return False  # requeue until the cluster turns Ready
+        sync_start = time.perf_counter()
         errors = []
         from karmada_tpu.models.work import ResourceBinding  # local import cycle guard
 
@@ -166,4 +181,16 @@ class ExecutionController:
             ))
 
         self.store.mutate(Work.KIND, ns, name, set_applied)
+        SYNC_WORKLOAD_LATENCY.observe(
+            time.perf_counter() - sync_start,
+            result="error" if errors else "success",
+        )
+        if errors:
+            self.recorder.event(work, ev.TYPE_WARNING,
+                                ev.REASON_SYNC_WORKLOAD_FAILED, "; ".join(errors))
+        else:
+            self.recorder.event(
+                work, ev.TYPE_NORMAL, ev.REASON_SYNC_WORKLOAD_SUCCEED,
+                f"Successfully applied manifests to cluster {cluster_name}.",
+            )
         return None if not errors else False

@@ -196,20 +196,56 @@ class BindingStatusController:
                     pass
 
 
+from karmada_tpu.utils.metrics import REGISTRY as _REGISTRY
+
+CLUSTER_READY_STATE = _REGISTRY.gauge(
+    "karmada_cluster_ready_state", "State of the cluster (1 ready, 0 not)",
+    ("cluster_name",))
+CLUSTER_CPU_ALLOCATABLE = _REGISTRY.gauge(
+    "karmada_cluster_cpu_allocatable_number", "Allocatable cluster CPU cores",
+    ("cluster_name",))
+CLUSTER_CPU_ALLOCATED = _REGISTRY.gauge(
+    "karmada_cluster_cpu_allocated_number", "Allocated cluster CPU cores",
+    ("cluster_name",))
+CLUSTER_MEMORY_ALLOCATABLE = _REGISTRY.gauge(
+    "karmada_cluster_memory_allocatable_bytes", "Allocatable cluster memory",
+    ("cluster_name",))
+CLUSTER_MEMORY_ALLOCATED = _REGISTRY.gauge(
+    "karmada_cluster_memory_allocated_bytes", "Allocated cluster memory",
+    ("cluster_name",))
+CLUSTER_POD_ALLOCATABLE = _REGISTRY.gauge(
+    "karmada_cluster_pod_allocatable_number", "Allocatable cluster pod slots",
+    ("cluster_name",))
+CLUSTER_POD_ALLOCATED = _REGISTRY.gauge(
+    "karmada_cluster_pod_allocated_number", "Allocated cluster pod slots",
+    ("cluster_name",))
+
+
 class ClusterStatusController:
-    """Periodic heartbeat: member telemetry -> Cluster.status."""
+    """Periodic heartbeat: member telemetry -> Cluster.status.
+
+    Also maintains the karmada_cluster_* capacity gauges
+    (pkg/metrics/cluster.go:57-132) and emits ClusterReady /
+    ClusterNotReady events on transitions."""
 
     def __init__(
         self,
         store: ObjectStore,
         runtime: Runtime,
         members: Dict[str, FakeMemberCluster],
+        recorder=None,
     ) -> None:
+        from karmada_tpu.utils.events import EventRecorder
+
         self.store = store
         self.members = members
+        self.recorder = recorder if recorder is not None else EventRecorder()
+        self._last_ready: Dict[str, bool] = {}
         runtime.register_periodic(self.collect_all)
 
     def collect_all(self) -> None:
+        from karmada_tpu.utils import events as ev
+
         for name, member in self.members.items():
             cluster = self.store.try_get(Cluster.KIND, "", name)
             if cluster is None:
@@ -230,4 +266,34 @@ class ClusterStatusController:
                     ))
                     c.status.resource_summary = member.resource_summary()
 
-            self.store.mutate(Cluster.KIND, "", name, update)
+            stored = self.store.mutate(Cluster.KIND, "", name, update)
+            self._export_gauges(stored)
+            ready = member.healthy
+            if self._last_ready.get(name) != ready:
+                self._last_ready[name] = ready
+                self.recorder.event(
+                    stored,
+                    ev.TYPE_NORMAL if ready else ev.TYPE_WARNING,
+                    ev.REASON_CLUSTER_READY if ready else ev.REASON_CLUSTER_NOT_READY,
+                    f"cluster {name} readiness is now {ready}",
+                )
+
+    @staticmethod
+    def _export_gauges(cluster: Cluster) -> None:
+        """karmada_cluster_* gauges (pkg/metrics/cluster.go:57-132)."""
+        CLUSTER_READY_STATE.set(1.0 if cluster.ready else 0.0,
+                                cluster_name=cluster.name)
+        summary = cluster.status.resource_summary
+        if summary is None:
+            return
+        for res, gauge_alloc, gauge_used in (
+            ("cpu", CLUSTER_CPU_ALLOCATABLE, CLUSTER_CPU_ALLOCATED),
+            ("memory", CLUSTER_MEMORY_ALLOCATABLE, CLUSTER_MEMORY_ALLOCATED),
+            ("pods", CLUSTER_POD_ALLOCATABLE, CLUSTER_POD_ALLOCATED),
+        ):
+            alloc = summary.allocatable.get(res)
+            used = summary.allocated.get(res)
+            if alloc is not None:
+                gauge_alloc.set(alloc.milli / 1000.0, cluster_name=cluster.name)
+            if used is not None:
+                gauge_used.set(used.milli / 1000.0, cluster_name=cluster.name)

@@ -58,17 +58,22 @@ class ControlPlane:
         enable_descheduler: bool = False,
         eviction_grace_period_s: float = 600,
     ) -> None:
+        from karmada_tpu.utils.events import EventRecorder
+
         self.store = ObjectStore()
         self.runtime = Runtime()
         self.members: Dict[str, FakeMemberCluster] = {}
         self.interpreter = ResourceInterpreter()
+        self.recorder = EventRecorder()
         self.detector = ResourceDetector(self.store, self.runtime, self.interpreter)
-        self.scheduler = Scheduler(self.store, self.runtime, backend=backend)
+        self.scheduler = Scheduler(self.store, self.runtime, backend=backend,
+                                   recorder=self.recorder)
         self.binding_controller = BindingController(
             self.store, self.runtime, self.interpreter
         )
         self.execution = ExecutionController(
-            self.store, self.runtime, self.members, self.interpreter
+            self.store, self.runtime, self.members, self.interpreter,
+            recorder=self.recorder,
         )
         self.work_status = WorkStatusController(
             self.store, self.runtime, self.members, self.interpreter
@@ -77,7 +82,7 @@ class ControlPlane:
             self.store, self.runtime, self.interpreter
         )
         self.cluster_status = ClusterStatusController(
-            self.store, self.runtime, self.members
+            self.store, self.runtime, self.members, recorder=self.recorder
         )
         self.cluster_taints = ClusterTaintController(self.store, self.runtime)
         self.taint_manager = NoExecuteTaintManager(self.store, self.runtime)
@@ -155,6 +160,16 @@ class ControlPlane:
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         self.store.delete(kind, namespace, name)
+
+    # -- observability ------------------------------------------------------
+    def metrics_dump(self) -> str:
+        """Prometheus text exposition of every registered metric."""
+        from karmada_tpu.utils.metrics import REGISTRY
+
+        return REGISTRY.dump()
+
+    def events(self, kind=None, namespace=None, name=None):
+        return self.recorder.list(kind=kind, namespace=namespace, name=name)
 
     # -- clock --------------------------------------------------------------
     def tick(self, rounds: int = 3) -> int:

@@ -154,20 +154,28 @@ class SnapshotEstimator:
             self._snapshots[cluster] = snap
             self._fetched_at[cluster] = time.time()
 
+    def _fresh_snapshot(self, cluster_name: str) -> Optional[CapacitySnapshotResponse]:
+        """The current snapshot, or None when it is absent/stale or the
+        estimator endpoint is gone (callers answer UNAUTHENTIC)."""
+        self.refresh(cluster_name)
+        with self._lock:
+            snap = self._snapshots.get(cluster_name)
+            age = time.time() - self._fetched_at.get(cluster_name, 0.0)
+        if cluster_name not in self.client.transports:
+            return None
+        if snap is None or age > self.max_age_s:
+            return None
+        return snap
+
     def max_available_replicas(
         self,
         clusters: List[Cluster],
         requirements: Optional[ReplicaRequirements],
     ) -> List[TargetCluster]:
         out: List[TargetCluster] = []
-        now = time.time()
         for cluster in clusters:
-            self.refresh(cluster.name)
-            with self._lock:
-                snap = self._snapshots.get(cluster.name)
-                age = now - self._fetched_at.get(cluster.name, 0.0)
-            no_transport = cluster.name not in self.client.transports
-            if snap is None or (no_transport or age > self.max_age_s):
+            snap = self._fresh_snapshot(cluster.name)
+            if snap is None:
                 out.append(TargetCluster(cluster.name, UNAUTHENTIC_REPLICA))
                 continue
             total = 0
@@ -186,14 +194,9 @@ class SnapshotEstimator:
         from karmada_tpu.estimator.wire import max_sets_from_free_table
 
         out: List[TargetCluster] = []
-        now = time.time()
         for cluster in clusters:
-            self.refresh(cluster.name)
-            with self._lock:
-                snap = self._snapshots.get(cluster.name)
-                age = now - self._fetched_at.get(cluster.name, 0.0)
-            no_transport = cluster.name not in self.client.transports
-            if snap is None or (no_transport or age > self.max_age_s):
+            snap = self._fresh_snapshot(cluster.name)
+            if snap is None:
                 out.append(TargetCluster(cluster.name, UNAUTHENTIC_REPLICA))
                 continue
             out.append(TargetCluster(

@@ -785,7 +785,18 @@ def make_cal_available(estimators) -> Callable:
         if spec.replicas == 0 and not spec.components:
             return out
         multi_template = is_multi_template_applicable(spec)
-        for est in estimators:
+        ests = list(estimators)
+        if multi_template and not any(
+            hasattr(e, "max_available_component_sets") for e in ests
+        ):
+            # never silently skip capacity checking: the reference registry
+            # always contains the GeneralEstimator (which implements
+            # MaxAvailableComponentSets); mirror that as a fallback when the
+            # caller supplied only replica-style estimators
+            from karmada_tpu.estimator.general import GeneralEstimator
+
+            ests.append(GeneralEstimator())
+        for est in ests:
             if multi_template:
                 if not hasattr(est, "max_available_component_sets"):
                     continue

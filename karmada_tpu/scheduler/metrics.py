@@ -1,0 +1,59 @@
+"""Scheduler metrics (pkg/scheduler/metrics/metrics.go:60-142 equivalents).
+
+Same metric names and label shapes as the reference so dashboards/alerts
+port over; per-step latency covers the batched pipeline's real stages
+(Encode / Solve / Decode on the device path, Serial on the host path).
+"""
+
+from __future__ import annotations
+
+from karmada_tpu.utils.metrics import REGISTRY, exponential_buckets
+
+RESULT_SCHEDULED = "scheduled"
+RESULT_ERROR = "error"
+RESULT_UNSCHEDULABLE = "unschedulable"
+SCHEDULE_TYPE_RECONCILE = "reconcile"
+
+STEP_ENCODE = "Encode"
+STEP_SOLVE = "Solve"
+STEP_DECODE = "Decode"
+STEP_SERIAL = "Serial"
+
+SCHEDULE_ATTEMPTS = REGISTRY.counter(
+    "karmada_scheduler_schedule_attempts_total",
+    "Number of attempts to schedule a ResourceBinding",
+    ("result", "schedule_type"),
+)
+
+E2E_LATENCY = REGISTRY.histogram(
+    "karmada_scheduler_e2e_scheduling_duration_seconds",
+    "E2e scheduling latency in seconds",
+    ("result", "schedule_type"),
+    buckets=exponential_buckets(0.001, 2, 15),
+)
+
+STEP_LATENCY = REGISTRY.histogram(
+    "karmada_scheduler_scheduling_algorithm_duration_seconds",
+    "Scheduling algorithm latency in seconds by pipeline step",
+    ("schedule_step",),
+    buckets=exponential_buckets(0.001, 2, 15),
+)
+
+QUEUE_INCOMING = REGISTRY.counter(
+    "karmada_scheduler_queue_incoming_bindings_total",
+    "Bindings added to scheduling queues by event type",
+    ("event",),
+)
+
+QUEUE_DEPTH = REGISTRY.gauge(
+    "karmada_scheduler_queue_depth",
+    "Current scheduling queue depths",
+    ("queue",),
+)
+
+BATCH_SIZE = REGISTRY.histogram(
+    "karmada_scheduler_batch_size",
+    "Bindings drained into one batched solver cycle",
+    (),
+    buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192],
+)

@@ -18,6 +18,7 @@ status.schedulerObservedAffinityName exactly like the reference.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from karmada_tpu.estimator.general import GeneralEstimator
@@ -32,9 +33,11 @@ from karmada_tpu.models.work import (
 )
 from karmada_tpu.ops import serial, tensors
 from karmada_tpu.ops.solver import solve
+from karmada_tpu.scheduler import metrics as sched_metrics
 from karmada_tpu.scheduler.queue import QueuedBindingInfo, SchedulingQueue
 from karmada_tpu.store.store import Event, ObjectStore
 from karmada_tpu.store.worker import AsyncWorker, Runtime
+from karmada_tpu.utils import events as ev
 
 REASON_SUCCESS = "BindingScheduled"
 REASON_NO_FIT = "NoClusterFit"
@@ -60,7 +63,9 @@ class Scheduler:
         enable_empty_workload_propagation: bool = False,
         batch_window: int = 4096,
         queue: Optional[SchedulingQueue] = None,
+        recorder: Optional[ev.EventRecorder] = None,
     ) -> None:
+        self.recorder = recorder if recorder is not None else ev.EventRecorder()
         self.store = store
         self.backend = backend
         self.estimators = list(estimators) if estimators else [GeneralEstimator()]
@@ -93,6 +98,7 @@ class Scheduler:
                 return
             with self._queue_lock:
                 self.queue.push((rb.namespace, rb.name), _priority_of(rb))
+            sched_metrics.QUEUE_INCOMING.inc(event="BindingUpdate")
             self.worker.enqueue(_CYCLE)
         elif kind == Cluster.KIND:
             # capacity/feasibility changed: unschedulable entries become
@@ -107,6 +113,7 @@ class Scheduler:
                         continue  # resident: respect its queue/backoff state
                     if not rb.spec.clusters or self._needs_schedule(rb):
                         self.queue.push(key, _priority_of(rb))
+                        sched_metrics.QUEUE_INCOMING.inc(event="ClusterEvent")
                 enqueued = self.queue.depths()["active"] > 0
             if enqueued:
                 self.worker.enqueue(_CYCLE)
@@ -136,6 +143,7 @@ class Scheduler:
 
     # -- the batched cycle --------------------------------------------------
     def _cycle(self, _key) -> None:
+        cycle_start = time.perf_counter()
         with self._queue_lock:
             self.queue.flush_backoff()
             infos = self.queue.pop_ready(self.batch_window)
@@ -150,6 +158,7 @@ class Scheduler:
             info.attempts += 1
             todo.append((info, rb))
         if todo:
+            sched_metrics.BATCH_SIZE.observe(len(todo))
             clusters = list(self.store.list(Cluster.KIND))
             outcomes = self.schedule_batch([rb for _, rb in todo], clusters)
             # handleErr routing (scheduler.go:829-841): UnschedulableError
@@ -162,8 +171,34 @@ class Scheduler:
                         self.queue.push_unschedulable_if_not_present(info)
                     elif isinstance(res, Exception):
                         self.queue.push_backoff_if_not_present(info)
+            cycle_elapsed = time.perf_counter() - cycle_start
+            now = self.queue.now()
+            for (info, _), res in zip(todo, outcomes):
+                if isinstance(res, serial.UnschedulableError):
+                    result = sched_metrics.RESULT_UNSCHEDULABLE
+                elif isinstance(res, Exception):
+                    result = sched_metrics.RESULT_ERROR
+                else:
+                    result = sched_metrics.RESULT_SCHEDULED
+                sched_metrics.SCHEDULE_ATTEMPTS.inc(
+                    result=result,
+                    schedule_type=sched_metrics.SCHEDULE_TYPE_RECONCILE,
+                )
+                # per-binding e2e: from its first scheduling attempt (queue
+                # clock) to this outcome; floor at the cycle cost so a
+                # single-attempt binding isn't recorded as ~0
+                e2e = max(now - (info.initial_attempt_timestamp or now),
+                          cycle_elapsed)
+                sched_metrics.E2E_LATENCY.observe(
+                    e2e,
+                    result=result,
+                    schedule_type=sched_metrics.SCHEDULE_TYPE_RECONCILE,
+                )
         with self._queue_lock:
-            more = self.queue.depths()["active"] > 0
+            depths = self.queue.depths()
+            more = depths["active"] > 0
+        for qname, depth in depths.items():
+            sched_metrics.QUEUE_DEPTH.set(depth, queue=qname)
         if more:
             self.worker.enqueue(_CYCLE)
 
@@ -228,32 +263,49 @@ class Scheduler:
         out: List[object] = [None] * len(items)
         device_idx: List[int] = []
         if self.backend == "device" and items:
+            t0 = time.perf_counter()
             cindex = tensors.ClusterIndex.build(clusters)
             batch = tensors.encode_batch(items, cindex, self._general)
+            sched_metrics.STEP_LATENCY.observe(
+                time.perf_counter() - t0, schedule_step=sched_metrics.STEP_ENCODE
+            )
             device_idx = [
                 i for i in range(len(items))
                 if batch.route[i] == tensors.ROUTE_DEVICE
             ]
             if device_idx:
+                t1 = time.perf_counter()
                 rep, sel, status = solve(batch)
+                sched_metrics.STEP_LATENCY.observe(
+                    time.perf_counter() - t1, schedule_step=sched_metrics.STEP_SOLVE
+                )
+                t2 = time.perf_counter()
                 decoded = tensors.decode_result(
                     batch, rep, sel, status,
                     enable_empty_workload_propagation=self.enable_empty_workload_propagation,
                     items=items,
                 )
+                sched_metrics.STEP_LATENCY.observe(
+                    time.perf_counter() - t2, schedule_step=sched_metrics.STEP_DECODE
+                )
                 for i in device_idx:
                     out[i] = decoded[i]
         device_set = set(device_idx)
         host_idx = [i for i in range(len(items)) if i not in device_set]
-        for i in host_idx:
-            spec, status = items[i]
-            try:
-                out[i] = serial.schedule(
-                    spec, status, clusters, cal,
-                    enable_empty_workload_propagation=self.enable_empty_workload_propagation,
-                )
-            except Exception as e:  # noqa: BLE001 — per-binding failure object
-                out[i] = e
+        if host_idx:
+            t3 = time.perf_counter()
+            for i in host_idx:
+                spec, status = items[i]
+                try:
+                    out[i] = serial.schedule(
+                        spec, status, clusters, cal,
+                        enable_empty_workload_propagation=self.enable_empty_workload_propagation,
+                    )
+                except Exception as e:  # noqa: BLE001 — per-binding failure object
+                    out[i] = e
+            sched_metrics.STEP_LATENCY.observe(
+                time.perf_counter() - t3, schedule_step=sched_metrics.STEP_SERIAL
+            )
         return out
 
     # -- result patch-back (patchScheduleResultForResourceBinding :664) -----
@@ -275,6 +327,8 @@ class Scheduler:
                     obj.status.scheduler_observed_affinity_name = affinity_name
 
             self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, mark_failed)
+            self.recorder.event(rb, ev.TYPE_WARNING,
+                                ev.REASON_SCHEDULE_BINDING_FAILED, str(res))
             return
 
         # success: patch spec.clusters, then record the *stored* generation in
@@ -302,6 +356,10 @@ class Scheduler:
             ))
 
         self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, patch_status)
+        self.recorder.event(
+            rb, ev.TYPE_NORMAL, ev.REASON_SCHEDULE_BINDING_SUCCEED,
+            "Binding has been scheduled successfully.",
+        )
 
 
 def _priority_of(rb: ResourceBinding) -> int:

@@ -1,0 +1,203 @@
+"""Prometheus-style metrics primitives (counter / gauge / histogram).
+
+The reference instruments every component with prometheus client_golang
+(pkg/scheduler/metrics/metrics.go:60-142, pkg/metrics/cluster.go:57-132,
+pkg/util/metrics/); this module is the framework's equivalent: a small
+threadsafe registry with the same metric shapes (labeled counters,
+gauges, exponential-bucket histograms) and a text exposition dump.
+
+No external dependency: the scrape surface is `Registry.dump()` (the
+Prometheus text format) so an HTTP handler or the bench can expose it.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def exponential_buckets(start: float, factor: float, count: int) -> List[float]:
+    return [start * (factor ** i) for i in range(count)]
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str]) -> None:
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != {sorted(self.label_names)}"
+            )
+        return tuple(labels[n] for n in self.label_names)
+
+    @staticmethod
+    def _fmt_labels(names: Sequence[str], values: Sequence[str],
+                    extra: Optional[Tuple[str, str]] = None) -> str:
+        pairs = [f'{n}="{v}"' for n, v in zip(names, values)]
+        if extra is not None:
+            pairs.append(f'{extra[0]}="{extra[1]}"')
+        return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+class Counter(_Metric):
+    TYPE = "counter"
+
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def _render(self) -> List[str]:
+        with self._lock:
+            return [
+                f"{self.name}{self._fmt_labels(self.label_names, k)} {v}"
+                for k, v in sorted(self._values.items())
+            ]
+
+
+class Gauge(_Metric):
+    TYPE = "gauge"
+
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def add(self, amount: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def _render(self) -> List[str]:
+        with self._lock:
+            return [
+                f"{self.name}{self._fmt_labels(self.label_names, k)} {v}"
+                for k, v in sorted(self._values.items())
+            ]
+
+
+class Histogram(_Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name, help_, label_names=(), buckets: Optional[List[float]] = None):
+        super().__init__(name, help_, label_names)
+        self.buckets = sorted(buckets or exponential_buckets(0.001, 2, 15))
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._totals: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, **labels: str) -> int:
+        with self._lock:
+            return self._totals.get(self._key(labels), 0)
+
+    def sum(self, **labels: str) -> float:
+        with self._lock:
+            return self._sums.get(self._key(labels), 0.0)
+
+    def quantile(self, q: float, **labels: str) -> float:
+        """Bucket-resolution quantile estimate (for dumps/tests)."""
+        key = self._key(labels)
+        with self._lock:
+            total = self._totals.get(key, 0)
+            counts = self._counts.get(key, [])
+        if total == 0:
+            return math.nan
+        rank = q * total
+        for i, c in enumerate(counts):
+            if c >= rank:
+                return self.buckets[i]
+        return math.inf
+
+    def _render(self) -> List[str]:
+        out: List[str] = []
+        with self._lock:
+            for key in sorted(self._totals):
+                for i, ub in enumerate(self.buckets):
+                    out.append(
+                        f"{self.name}_bucket"
+                        f"{self._fmt_labels(self.label_names, key, ('le', repr(ub)))}"
+                        f" {self._counts[key][i]}"
+                    )
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{self._fmt_labels(self.label_names, key, ('le', '+Inf'))}"
+                    f" {self._totals[key]}"
+                )
+                out.append(
+                    f"{self.name}_sum{self._fmt_labels(self.label_names, key)}"
+                    f" {self._sums[key]}"
+                )
+                out.append(
+                    f"{self.name}_count{self._fmt_labels(self.label_names, key)}"
+                    f" {self._totals[key]}"
+                )
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name, help_="", label_names=()) -> Counter:
+        return self.register(Counter(name, help_, label_names))  # type: ignore[return-value]
+
+    def gauge(self, name, help_="", label_names=()) -> Gauge:
+        return self.register(Gauge(name, help_, label_names))  # type: ignore[return-value]
+
+    def histogram(self, name, help_="", label_names=(), buckets=None) -> Histogram:
+        return self.register(Histogram(name, help_, label_names, buckets))  # type: ignore[return-value]
+
+    def dump(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.TYPE}")
+            lines.extend(m._render())  # noqa: SLF001
+        return "\n".join(lines) + "\n"
+
+
+# the default registry every component instruments into (the reference's
+# controller-runtime metrics.Registry equivalent)
+REGISTRY = Registry()

@@ -1,0 +1,144 @@
+"""Metrics registry + event recorder, and their wiring through the control
+plane (reference pkg/scheduler/metrics/metrics.go, pkg/metrics/cluster.go,
+pkg/events/events.go)."""
+
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    ClusterPreferences,
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+    ReplicaSchedulingStrategy,
+    ResourceSelector,
+)
+from karmada_tpu.models.work import ResourceBinding
+from karmada_tpu.utils import events as ev
+from karmada_tpu.utils.metrics import Counter, Gauge, Histogram, Registry
+
+
+def test_counter_gauge_histogram_basics():
+    r = Registry()
+    c = r.counter("c_total", "a counter", ("k",))
+    c.inc(k="x")
+    c.inc(2, k="x")
+    c.inc(k="y")
+    assert c.value(k="x") == 3 and c.value(k="y") == 1
+
+    g = r.gauge("g", "a gauge", ("k",))
+    g.set(5, k="x")
+    g.add(-2, k="x")
+    assert g.value(k="x") == 3
+
+    h = r.histogram("h_seconds", "a histogram", ("k",), buckets=[0.1, 1, 10])
+    for v in (0.05, 0.5, 5, 50):
+        h.observe(v, k="x")
+    assert h.count(k="x") == 4
+    assert h.sum(k="x") == 55.55
+
+    dump = r.dump()
+    assert '# TYPE c_total counter' in dump
+    assert 'c_total{k="x"} 3.0' in dump
+    assert 'h_seconds_bucket{k="x",le="+Inf"} 4' in dump
+    assert 'h_seconds_count{k="x"} 4' in dump
+
+
+def test_registry_register_is_idempotent():
+    r = Registry()
+    a = r.counter("same", "one")
+    b = r.counter("same", "two")
+    assert a is b
+
+
+def test_event_recorder_coalesces_and_bounds():
+    clock = {"t": 0.0}
+    rec = ev.EventRecorder(capacity=3, now=lambda: clock["t"])
+    ref = ev.ObjectRef(kind="ResourceBinding", namespace="ns", name="a")
+    rec.event(ref, ev.TYPE_WARNING, "R", "same message")
+    clock["t"] = 5.0
+    rec.event(ref, ev.TYPE_WARNING, "R", "same message")
+    got = rec.list(kind="ResourceBinding")
+    assert len(got) == 1 and got[0].count == 2
+    assert got[0].first_timestamp == 0.0 and got[0].last_timestamp == 5.0
+    # capacity bound evicts oldest
+    for i in range(4):
+        rec.event(ev.ObjectRef(kind="K", name=f"n{i}"), ev.TYPE_NORMAL, "R", "m")
+    assert len(rec.list()) == 3
+
+
+def test_control_plane_emits_metrics_and_events():
+    cp = ControlPlane()
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.tick()
+    cp.apply_policy(PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(api_version="apps/v1",
+                                                 kind="Deployment")],
+            placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS))),
+        ),
+    ))
+    cp.apply({"apiVersion": "apps/v1", "kind": "Deployment",
+              "metadata": {"name": "app", "namespace": "default"},
+              "spec": {"replicas": 2, "template": {"spec": {"containers": [
+                  {"name": "a", "resources": {"requests": {"cpu": "100m"}}}]}}}})
+    cp.tick()
+
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    assert rb.spec.clusters
+
+    # events: schedule success + work sync success + cluster ready
+    reasons = {e.reason for e in cp.events()}
+    assert ev.REASON_SCHEDULE_BINDING_SUCCEED in reasons
+    assert ev.REASON_SYNC_WORKLOAD_SUCCEED in reasons
+    assert ev.REASON_CLUSTER_READY in reasons
+    per_binding = cp.events(kind="ResourceBinding", name="app-deployment")
+    assert any(e.reason == ev.REASON_SCHEDULE_BINDING_SUCCEED for e in per_binding)
+
+    # metrics: attempts counted, per-step latency observed, gauges exported
+    dump = cp.metrics_dump()
+    assert 'karmada_scheduler_schedule_attempts_total{result="scheduled"' in dump
+    assert "karmada_scheduler_scheduling_algorithm_duration_seconds" in dump
+    assert 'karmada_cluster_ready_state{cluster_name="m1"} 1.0' in dump
+    assert "karmada_work_sync_workload_duration_seconds" in dump
+    assert 'karmada_scheduler_queue_depth{queue="active"} 0' in dump
+
+
+def test_failure_schedules_record_error_metrics_and_events():
+    from karmada_tpu.scheduler.metrics import SCHEDULE_ATTEMPTS
+
+    before = SCHEDULE_ATTEMPTS.value(result="error", schedule_type="reconcile")
+    cp = ControlPlane()
+    cp.add_member("m1")
+    cp.tick()
+    cp.apply_policy(PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(api_version="apps/v1",
+                                                 kind="Deployment")],
+            placement=Placement(),
+        ),
+    ))
+    # no member enables batch/v1 CronJob-like kind: force FitError via affinity
+    from karmada_tpu.models.policy import ClusterAffinity
+
+    cp.store.mutate("PropagationPolicy", "default", "pp", lambda p: setattr(
+        p.spec.placement, "cluster_affinity",
+        ClusterAffinity(cluster_names=["absent"])))
+    cp.apply({"apiVersion": "apps/v1", "kind": "Deployment",
+              "metadata": {"name": "app", "namespace": "default"},
+              "spec": {"replicas": 1, "template": {"spec": {"containers": [
+                  {"name": "a"}]}}}})
+    cp.tick()
+    after = SCHEDULE_ATTEMPTS.value(result="error", schedule_type="reconcile")
+    assert after > before
+    warn = [e for e in cp.events(kind="ResourceBinding")
+            if e.reason == ev.REASON_SCHEDULE_BINDING_FAILED]
+    assert warn and warn[0].type == ev.TYPE_WARNING
