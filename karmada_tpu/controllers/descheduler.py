@@ -24,15 +24,36 @@ from karmada_tpu.store.worker import Runtime
 
 
 class Descheduler:
+    """Shares the scheduler's estimator tier: unschedulable counts come from
+    the per-member estimator servers over the wire protocol
+    (descheduler.go:141 -> GetUnschedulableReplicas gRPC), exactly the path
+    the reference runs.  `members` remains only as a health gate and as a
+    fallback when no estimator client is wired (unit-test harnesses)."""
+
     def __init__(
         self,
         store: ObjectStore,
         runtime: Runtime,
         members: Dict[str, FakeMemberCluster],
+        estimator=None,  # AccurateEstimatorClient (wire path) or None
     ) -> None:
         self.store = store
         self.members = members
+        self.estimator = estimator
         runtime.register_periodic(self.run_once)
+
+    def _stuck_replicas(self, cluster: str, resource) -> int:
+        if self.estimator is not None:
+            n = self.estimator.unschedulable_replicas(
+                cluster, resource.kind, resource.namespace, resource.name
+            )
+            return max(n, 0)  # UNAUTHENTIC_REPLICA (-1) == unknown: skip
+        member = self.members.get(cluster)
+        if member is None:
+            return 0
+        return member.unschedulable_replicas(
+            resource.kind, resource.namespace, resource.name
+        )
 
     def _eligible(self, rb: ResourceBinding) -> bool:
         """descheduler.go:197-214: Divided + dynamic-weight or aggregated."""
@@ -59,9 +80,7 @@ class Descheduler:
                 member = self.members.get(target.name)
                 if member is None or not member.healthy:
                     continue
-                stuck = member.unschedulable_replicas(
-                    resource.kind, resource.namespace, resource.name
-                )
+                stuck = self._stuck_replicas(target.name, resource)
                 if stuck > 0:
                     shrink[target.name] = min(stuck, target.replicas)
             if not shrink:

@@ -18,6 +18,7 @@ from karmada_tpu.controllers.override import selector_matches
 from karmada_tpu.interpreter import ResourceInterpreter
 from karmada_tpu.models.meta import OwnerReference
 from karmada_tpu.models.policy import (
+    LAZY_ACTIVATION,
     ClusterPropagationPolicy,
     PropagationPolicy,
     ResourceSelector,
@@ -85,7 +86,7 @@ class ResourceDetector:
             return
         if kind in FRAMEWORK_KINDS or not isinstance(event.obj, Unstructured):
             return
-        self.worker.enqueue((kind, event.obj.namespace, event.obj.name))
+        self.worker.enqueue((kind, event.obj.namespace, event.obj.name, False))
 
     # -- policy fan-out -----------------------------------------------------
     def _reconcile_policy(self, key) -> None:
@@ -99,17 +100,16 @@ class ResourceDetector:
                         self.store.delete(ResourceBinding.KIND, rb.namespace, rb.name)
                     except NotFoundError:
                         pass
-        # re-evaluate every template (policy preemption/claim updates)
+        # re-evaluate every template (policy preemption/claim updates);
+        # from_policy=True so Lazy activation can defer (detector.go:1485)
         for obj in self.store.items():
             if isinstance(obj, Unstructured) and obj.KIND not in FRAMEWORK_KINDS:
-                self.worker.enqueue((obj.KIND, obj.namespace, obj.name))
+                self.worker.enqueue((obj.KIND, obj.namespace, obj.name, True))
 
     # -- template reconcile -------------------------------------------------
     def _matched_policies(
-        self, obj: Unstructured
+        self, obj: Unstructured, manifest: dict
     ) -> Tuple[Optional[PropagationPolicy], Optional[ClusterPropagationPolicy]]:
-        manifest = obj.to_manifest()
-
         def best(policies):
             matched = []
             for p in policies:
@@ -130,8 +130,50 @@ class ResourceDetector:
         cpps = self.store.list(ClusterPropagationPolicy.KIND)
         return best(pps), best(cpps)
 
+    def _current_claim(self, obj: Unstructured):
+        """The policy currently claiming `obj` via claim labels (or None)."""
+        pid = obj.metadata.labels.get(POLICY_LABEL)
+        if pid is not None:
+            ns, _, nm = pid.partition("/")
+            return self.store.try_get(PropagationPolicy.KIND, ns, nm)
+        pid = obj.metadata.labels.get(CLUSTER_POLICY_LABEL)
+        if pid is not None:
+            return self.store.try_get(ClusterPropagationPolicy.KIND, "", pid)
+        return None
+
+    @staticmethod
+    def _still_matches(policy, manifest) -> bool:
+        return any(
+            selector_matches(sel, manifest) for sel in policy.spec.resource_selectors
+        )
+
+    def _effective_policy(self, obj: Unstructured, manifest: dict, pp, cpp):
+        """Claim stickiness + preemption (preemption.go:50-107).
+
+        An object claimed by a still-matching policy STAYS claimed; a
+        different policy takes over only with `preemption: Always` and the
+        reference's priority rule (high-priority PP > low-priority PP >
+        CPP; CPP preempts CPP by priority only).
+        """
+        challenger = pp if pp is not None else cpp
+        cur = self._current_claim(obj)
+        if cur is None or not self._still_matches(cur, manifest):
+            return challenger
+        if challenger is None or challenger is cur:
+            return cur
+        cur_is_cpp = isinstance(cur, ClusterPropagationPolicy)
+        ch_is_cpp = isinstance(challenger, ClusterPropagationPolicy)
+        always = challenger.spec.preemption == "Always"
+        if not always:
+            return cur
+        if cur_is_cpp and not ch_is_cpp:
+            return challenger  # PP > CPP (preemptClusterPropagationPolicyDirectly)
+        if cur_is_cpp == ch_is_cpp and challenger.spec.priority > cur.spec.priority:
+            return challenger
+        return cur
+
     def _reconcile(self, key) -> None:
-        kind, namespace, name = key
+        kind, namespace, name, from_policy = key
         obj = self.store.try_get(kind, namespace, name)
         rb_name = binding_name(kind, name)
         if obj is None or obj.metadata.deleting:
@@ -141,8 +183,18 @@ class ResourceDetector:
                 pass
             return
         assert isinstance(obj, Unstructured)
-        pp, cpp = self._matched_policies(obj)
-        policy = pp if pp is not None else cpp
+        manifest = obj.to_manifest()
+        pp, cpp = self._matched_policies(obj, manifest)
+        policy = self._effective_policy(obj, manifest, pp, cpp)
+        # Lazy activation (detector.go:1485-1497): a policy-driven change
+        # does not touch templates whose effective policy is Lazy -- the new
+        # policy content applies only when the resource itself next changes
+        if (
+            from_policy
+            and policy is not None
+            and policy.spec.activation_preference == LAZY_ACTIVATION
+        ):
+            return
         if policy is None:
             # no policy claims it; drop a stale binding if we created one
             try:
@@ -175,7 +227,6 @@ class ResourceDetector:
 
         # applyReplicaInterpretation (detector.go:1454-1482): components win
         # over plain replicas when an InterpretComponent customization exists
-        manifest = obj.to_manifest()
         components = self.interpreter.get_components(manifest)
         if components is not None:
             replicas, requirements = 0, None

@@ -181,15 +181,27 @@ class RemedyController:
 
 
 class FederatedResourceQuotaController:
-    """Static split -> per-cluster ResourceQuota Works + usage aggregation."""
+    """Static split -> per-cluster ResourceQuota Works + usage aggregation.
+
+    Overall-only quotas (no static assignments) follow the reference's
+    enforcement controller instead (federated_resource_quota_enforcement_
+    controller.go:239 collectQuotaStatus): status.overallUsed is
+    recalculated from the namespace's ResourceBindings, reconciling on FRQ
+    changes and on every binding change in the namespace."""
 
     def __init__(self, store: ObjectStore, runtime: Runtime) -> None:
         self.store = store
         self.worker = runtime.register(AsyncWorker("frq", self._reconcile))
         store.bus.subscribe(self._on_event, kind=FederatedResourceQuota.KIND)
+        store.bus.subscribe(self._on_binding_event, kind="ResourceBinding")
 
     def _on_event(self, event: Event) -> None:
         self.worker.enqueue((event.obj.namespace, event.obj.name))
+
+    def _on_binding_event(self, event: Event) -> None:
+        ns = event.obj.namespace
+        for frq in self.store.list(FederatedResourceQuota.KIND, ns):
+            self.worker.enqueue((ns, frq.metadata.name))
 
     def _work_id(self, ns: str, name: str) -> str:
         return f"resourcequota-{ns}-{name}"
@@ -237,6 +249,27 @@ class FederatedResourceQuotaController:
                 def update(w: Work) -> None:
                     w.spec.workload = [manifest]
                 self.store.mutate(Work.KIND, wns, wid, update)
+
+        # overall-only quota: recalculate overallUsed from the namespace's
+        # ResourceBindings (collectQuotaStatus), the same usage math the
+        # admission gate applies — the two converge on the same number
+        if not frq.spec.static_assignments:
+            from karmada_tpu.webhook.builtin import calculate_rb_usage
+
+            overall_used = {}
+            for rb in self.store.list("ResourceBinding", ns):
+                for k, milli in calculate_rb_usage(rb).items():
+                    overall_used[k] = Quantity(
+                        overall_used.get(k, Quantity(0)).milli + milli
+                    )
+
+            def set_overall(obj: FederatedResourceQuota) -> None:
+                obj.status.overall = dict(obj.spec.overall)
+                obj.status.overall_used = overall_used
+                obj.status.aggregated_status = []
+
+            self.store.mutate(FederatedResourceQuota.KIND, ns, name, set_overall)
+            return
 
         # aggregate usage from the member-side ResourceQuota statuses
         agg: List = []

@@ -57,10 +57,16 @@ class ControlPlane:
         backend: str = "serial",
         enable_descheduler: bool = False,
         eviction_grace_period_s: float = 600,
+        feature_gates: Optional[Dict[str, bool]] = None,
     ) -> None:
         from karmada_tpu.utils.events import EventRecorder
+        from karmada_tpu.utils.features import FeatureGates
+        from karmada_tpu.webhook import AdmissionRegistry, install_default_webhooks
 
-        self.store = ObjectStore()
+        self.gates = FeatureGates(feature_gates)
+        self.admission = AdmissionRegistry()
+        self.store = ObjectStore(admission=self.admission)
+        install_default_webhooks(self.admission, self.store, self.gates)
         self.runtime = Runtime()
         self.members: Dict[str, FakeMemberCluster] = {}
         self.interpreter = ResourceInterpreter()
@@ -94,8 +100,14 @@ class ControlPlane:
         self.dependencies = DependenciesDistributor(
             self.store, self.runtime, self.interpreter
         )
+        # the descheduler consumes unschedulable counts over the estimator
+        # wire protocol (descheduler.go:141), one in-proc server per member
+        from karmada_tpu.estimator.client import AccurateEstimatorClient
+
+        self.descheduler_estimator = AccurateEstimatorClient()
         self.descheduler = (
-            Descheduler(self.store, self.runtime, self.members)
+            Descheduler(self.store, self.runtime, self.members,
+                        estimator=self.descheduler_estimator)
             if enable_descheduler
             else None
         )
@@ -130,6 +142,13 @@ class ControlPlane:
         # member informers are registered at construction; wire the new one
         self.work_status.members[name] = member
         member.store.bus.subscribe(self.work_status._member_event(name))  # noqa: SLF001
+        # per-member estimator server behind the wire transport (the
+        # descheduler's unschedulable counts ride this, never the simulator)
+        from karmada_tpu.estimator.server import AccurateEstimatorServer
+        from karmada_tpu.estimator.wire import LocalTransport
+
+        server = AccurateEstimatorServer(member)
+        self.descheduler_estimator.register(name, LocalTransport(server.handle))
         self.cluster_status.collect_all()
         return member
 

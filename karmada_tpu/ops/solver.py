@@ -8,17 +8,33 @@ sharded over a TPU mesh on the cluster/binding axes.
 
 Golden contract: for every supported input class, kernels here produce
 bit-identical results to the serial control path (ops/serial.py /
-ops/webster.py), which is itself a faithful port of the reference Go
-algorithms.  Priorities are computed in IEEE float64 in both paths, so
-equality is exact, not approximate.
+ops/webster.py).  The Webster priority is the quantized integer
+(votes << 28) // (2*seats+1) in BOTH paths (see ops/webster.py docstring),
+so equality is exact with zero floating point in either path.
 
-Requires jax x64 (int64 weights/cross-products, float64 priorities); enabled
-at import.  On TPU, f64/s64 are emulated -- acceptable because the solver is
-elementwise/sort-bound, not matmul-bound, and the batch axis provides the
-parallelism.
+TPU shape: the hot path is pure int32/int64 elementwise + reductions — no
+float64 anywhere (f64 is software-emulated on TPU), no sort inside any loop
+(the only argsorts left run once per binding: selection setup + Aggregated
+prefix), and the Webster allocation is CLOSED FORM: a logarithmic integer
+threshold bisection plus a one-shot tie-block award, both fixed-depth
+lax.while_loops of cheap elementwise ops.  jax x64 stays enabled for int64
+arrays (int64 lowers to int32 pairs on TPU, ~2-4x int32 cost — measured
+acceptable; f64 emulation, the real cliff, is gone).
+
+Within-batch capacity contention: schedule_batch runs the chunk as `waves`
+sequential waves (lax.scan) carrying a consumed-capacity accumulator;
+bindings in wave k see the snapshot minus everything waves <k consumed
+(milli resources, pods, and same-class accurate-estimator counts).
+waves=B reproduces the reference's one-binding-at-a-time semantics exactly
+(SURVEY §7 "Hard parts": sequential-equivalent ordering); the production
+default trades that for throughput and documents the divergence: bindings
+WITHIN one wave price against the same snapshot (the reference has the same
+race across its status-update interval).
 """
 
 from __future__ import annotations
+
+from functools import partial
 
 import jax
 
@@ -27,34 +43,50 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
 
+from karmada_tpu.ops.webster import PRIORITY_QBITS  # noqa: E402
+
 MAX_INT32 = (1 << 31) - 1
 MAX_INT64 = (1 << 63) - 1
 
+_W_CAP = (1 << 34) - 1  # weights clamped so (w << QBITS) fits int64
+_N_CAP = (1 << 25) - 1  # seat targets clamped (2^25 replicas per binding)
+
 
 # ---------------------------------------------------------------------------
-# Webster (Sainte-Lague) divisor allocation
+# Webster (Sainte-Lague) divisor allocation — closed form
 # ---------------------------------------------------------------------------
 #
 # Reference semantics (pkg/util/helper/webstermethod.go:112 AllocateWebsterSeats
 # + binding.go:70-144 Dispenser/UID tiebreak), as ported in ops/webster.py:
-# award `n` seats one at a time to the party maximising float64 priority
-# w/(2s+1); ties by fewer current seats, then name order (ascending, or
-# descending when fnv32a(uid) is odd).
+# award `n` seats one at a time to the party maximising the quantized priority
+# q(w, s) = (w << QBITS) // (2s+1); ties by fewer current seats, then name
+# order (ascending, or descending when fnv32a(uid) is odd).
 #
-# Kernel insight: the candidate "s-th seat of party i" is awarded when party i
-# holds exactly s seats, so each candidate has a STATIC key
-# (priority(w_i, s) desc, s asc, rank_i asc) and the serial result is exactly
-# the top-n candidates under that order.  We fast-forward with a divisor
-# bisection (float64 threshold T; seats awarded ~= candidates with priority
-# above T) and then run a small correction loop that awards / removes / swaps
-# whole tie-blocks until the awarded set is the true top-n.  The correction
-# uses the same float64 priorities and integer tiebreaks as the serial heap,
-# so the final seat vector is bit-identical.
+# Kernel insight: the candidate "s-th seat of party i" has the STATIC key
+# (q(w_i, s) desc, s asc, rank_i asc) and the serial result is exactly the
+# top-n candidates under that order (the standard divisor-method argument:
+# within a party candidates are awarded in seat order, and across parties
+# the heap always pops the globally best remaining candidate).  So:
+#
+#   1. bisect the integer threshold t* = smallest t with
+#      #[candidates q > t] <= n          (while_loop, ~log2(max w<<28) steps,
+#                                         one int64 divide per lane per step)
+#   2. fully award every candidate with q > t*;
+#   3. award the remaining r seats among the q == t* tie block, ordered by
+#      (seat, rank): candidate keys are seat*C + rank with distinct values,
+#      so a second bisection on the key value yields the exact r smallest
+#      (one-shot block award — no correction loop, no sorts).
 
 
-def _priority(w: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
-    """float64 Webster priority w/(2s+1), matching the serial/Go float math."""
-    return w.astype(jnp.float64) / (2.0 * s.astype(jnp.float64) + 1.0)
+def _count_above(wq, s0, pos_mask, n_eff, t):
+    """Per-party count of candidates (seat index >= s0) with priority > t.
+
+    q(w, s) > t  <=>  wq // (2s+1) >= t+1  <=>  2s+1 <= wq // (t+1),
+    so #{s >= 0} = (wq // (t+1) + 1) >> 1, clamped per party to n_eff
+    (a single party can absorb at most the whole target).
+    """
+    m = ((wq // (t + 1)) + 1) >> 1
+    return jnp.where(pos_mask, jnp.clip(m - s0, 0, n_eff), 0)
 
 
 def webster_divide(
@@ -63,149 +95,91 @@ def webster_divide(
     s0: jnp.ndarray,
     active: jnp.ndarray,
     rank: jnp.ndarray,
-    max_iters: int = 0,
 ) -> jnp.ndarray:
     """Allocate `n` new seats among parties; returns total seats per party.
 
     Args:
       n: int scalar -- number of new seats to award (<=0 awards none).
-      w: int64[C] votes (weights); negative treated as 0.
+      w: int64[C] votes (weights); negative treated as 0, clamped to 2^34.
       s0: int64[C] initial seats (kept; never removed).
       active: bool[C] party-exists mask (inactive lanes are padding).
-      rank: int32[C] tiebreak order; MUST be a permutation-like strict order
-        (distinct values) among active lanes, pre-flipped for descending UID
-        tiebreak by the caller.
-      max_iters: correction-loop bound; 0 means C + 64.
+      rank: int[C] tiebreak order; MUST hold distinct values among active
+        lanes, pre-flipped for descending UID tiebreak by the caller.
 
     Matches ops/webster.py allocate_webster_seats / dispense_by_weight:
     a zero total weight awards nothing (seats stay s0).
     """
     C = w.shape[0]
-    if max_iters <= 0:
-        max_iters = C + 64
-
     n = jnp.asarray(n, jnp.int64)
-    w = jnp.where(active, jnp.maximum(jnp.asarray(w, jnp.int64), 0), 0)
-    s0 = jnp.where(active, jnp.asarray(s0, jnp.int64), 0)
+    w = jnp.where(active, jnp.clip(jnp.asarray(w, jnp.int64), 0, _W_CAP), 0)
+    s0 = jnp.where(active, jnp.clip(jnp.asarray(s0, jnp.int64), 0, _N_CAP), 0)
     rank = jnp.asarray(rank, jnp.int64)
     totw = jnp.sum(w)
-    n_eff = jnp.where(totw > 0, jnp.maximum(n, 0), 0)
-    nf = n_eff.astype(jnp.float64)
+    n_eff = jnp.where(totw > 0, jnp.clip(n, 0, _N_CAP), 0)
 
-    # -- 1. divisor bisection: T s.t. #[candidates with priority > T] <= n --
-    def count(T: jnp.ndarray) -> jnp.ndarray:
-        x = w.astype(jnp.float64) / T
-        # clamp AFTER subtracting s0 (to n new seats); the pre-cast clamp at
-        # nf + s0 only guards the float->int64 cast against overflow
-        cnt0 = jnp.minimum(
-            jnp.maximum(jnp.ceil((x - 1.0) * 0.5), 0.0),
-            nf + s0.astype(jnp.float64),
-        )
-        c = jnp.minimum(jnp.maximum(cnt0.astype(jnp.int64) - s0, 0), n_eff)
-        return jnp.where(active & (w > 0), c, 0)
+    wq = w << PRIORITY_QBITS
+    pos_mask = active & (w > 0)
 
-    def bis(state, _):
-        lo, hi = state
-        mid = 0.5 * (lo + hi)
-        over = jnp.sum(count(mid)) > n_eff
-        return (jnp.where(over, mid, lo), jnp.where(over, hi, mid)), None
+    # -- 1. threshold bisection: smallest t >= 0 with cnt(t) <= n_eff -------
+    # Invariant maintained: cnt(hi) <= n_eff < cnt(lo) (when cnt(0) > n_eff;
+    # otherwise the result is overridden to t* = 0 below, where the award is
+    # exact because every positive-weight party already absorbs its clamp).
+    def cnt(t):
+        return jnp.sum(_count_above(wq, s0, pos_mask, n_eff, t))
 
-    lo0 = jnp.float64(1e-30)
-    hi0 = jnp.max(w).astype(jnp.float64) + 1.0
-    (_, hi), _ = lax.scan(bis, (lo0, hi0), None, length=80)
-    s = s0 + count(hi)  # total <= n_eff awarded; correction loop finishes
+    hi0 = jnp.maximum(jnp.max(wq), jnp.int64(1))
 
-    # -- 2. correction loop: block award / remove / swap to the exact top-n --
-    NEG_INF = jnp.float64(-jnp.inf)
-    POS_INF = jnp.float64(jnp.inf)
-    BIG = jnp.int64(1) << 62
+    def bis_cond(st):
+        lo, hi = st
+        return hi - lo > 1
 
-    def positions(packed: jnp.ndarray) -> jnp.ndarray:
-        """pos[i] = rank of lane i when sorting `packed` ascending."""
-        order = jnp.argsort(packed)
-        return jnp.zeros((C,), jnp.int64).at[order].set(jnp.arange(C, dtype=jnp.int64))
+    def bis_body(st):
+        lo, hi = st
+        mid = (lo + hi) >> 1
+        over = cnt(mid) > n_eff
+        return (jnp.where(over, mid, lo), jnp.where(over, hi, mid))
 
-    def body(state):
-        s, it = state
-        awarded = jnp.sum(s - s0)
-        deficit = n_eff - awarded
+    _, hi = lax.while_loop(bis_cond, bis_body, (jnp.int64(0), hi0))
+    t_star = jnp.where(cnt(jnp.int64(0)) <= n_eff, jnp.int64(0), hi)
 
-        # candidate keys
-        p_next = jnp.where(active, _priority(w, s), NEG_INF)
-        removable = active & (s > s0)
-        p_last = jnp.where(removable, _priority(w, s - 1), POS_INF)
+    # -- 2. full award above the threshold ----------------------------------
+    full = _count_above(wq, s0, pos_mask, n_eff, t_star)
+    r = n_eff - jnp.sum(full)
 
-        # best next candidate (award order: p desc, seats asc, rank asc)
-        m1 = jnp.max(p_next)
-        tie_a = active & (p_next == m1)
-        pk_a = jnp.where(tie_a, s * C + rank, BIG)  # (seats, rank) packed
-        pos_a = positions(pk_a)
+    # -- 3. one-shot tie-block award at q == t* -----------------------------
+    # Tie candidates of party i occupy seat indices base_i .. base_i+k_i-1
+    # with static keys seat*C + rank_i (all distinct).  The r serial awards
+    # are exactly the r smallest keys (merge argument over per-party
+    # ascending key streams), found by bisecting the key value.
+    tm1 = jnp.maximum(t_star - 1, jnp.int64(0))
+    k = jnp.where(t_star > 0, _count_above(wq, s0, pos_mask, n_eff, tm1) - full, 0)
+    base = s0 + full
 
-        # worst awarded candidate (removal: p asc, then seats desc, rank desc)
-        m2 = jnp.min(p_last)
-        tie_r = removable & (p_last == m2)
-        pk_r = jnp.where(tie_r, -((s - 1) * C + rank), BIG)
-        pos_r = positions(pk_r)
+    def cnt_key(K):
+        c = ((K - 1 - rank) // C) - base + 1
+        return jnp.clip(c, 0, k)
 
-        def do_award(s):
-            r = jnp.minimum(deficit, jnp.sum(tie_a))
-            return s + jnp.where(tie_a & (pos_a < r), 1, 0)
+    KHI = jnp.int64((1 << 27) * C)  # keys < (s0_cap + n_cap + 1) * C
 
-        def do_remove(s):
-            r = jnp.minimum(-deficit, jnp.sum(tie_r))
-            return s - jnp.where(tie_r & (pos_r < r), 1, 0)
+    def kb_cond(st):
+        lo, hi = st
+        return hi - lo > 1
 
-        def do_swap(s):
-            # profitable iff best-next key < worst-last key (strict):
-            #   (-m1, s_a, rank_a) < (-m2, s_r - 1, rank_r) lexicographic
-            a_i = jnp.argmin(pk_a)
-            r_i = jnp.argmin(pk_r)
-            ka = s[a_i] * C + rank[a_i]
-            kr = (s[r_i] - 1) * C + rank[r_i]
-            better = (m1 > m2) | ((m1 == m2) & (ka < kr))
-            swap = jnp.where(better & (jnp.sum(tie_a) > 0) & (jnp.sum(tie_r) > 0), 1, 0)
-            return (
-                s
-                + jnp.zeros((C,), jnp.int64).at[a_i].add(swap)
-                - jnp.zeros((C,), jnp.int64).at[r_i].add(swap)
-            )
+    def kb_body(st):
+        lo, hi = st
+        mid = (lo + hi) >> 1
+        ge = jnp.sum(cnt_key(mid)) >= r
+        return (jnp.where(ge, lo, mid), jnp.where(ge, mid, hi))
 
-        s = lax.cond(
-            deficit > 0,
-            do_award,
-            lambda s: lax.cond(deficit < 0, do_remove, do_swap, s),
-            s,
-        )
-        return s, it + 1
+    _, k_star = lax.while_loop(kb_cond, kb_body, (jnp.int64(0), KHI))
+    award = jnp.where(r > 0, cnt_key(k_star), 0)
 
-    def cond(state):
-        s, it = state
-        awarded = jnp.sum(s - s0)
-        deficit = n_eff - awarded
-        p_next = jnp.where(active, _priority(w, s), NEG_INF)
-        removable = active & (s > s0)
-        p_last = jnp.where(removable, _priority(w, s - 1), POS_INF)
-        m1 = jnp.max(p_next)
-        m2 = jnp.min(p_last)
-        tie_a = active & (p_next == m1)
-        tie_r = removable & (p_last == m2)
-        pk_a = jnp.where(tie_a, s * C + rank, BIG)
-        pk_r = jnp.where(tie_r, -((s - 1) * C + rank), BIG)
-        a_i = jnp.argmin(pk_a)
-        r_i = jnp.argmin(pk_r)
-        ka = s[a_i] * C + rank[a_i]
-        kr = (s[r_i] - 1) * C + rank[r_i]
-        has_a = jnp.sum(tie_a) > 0
-        has_r = jnp.sum(tie_r) > 0
-        profitable = has_a & has_r & ((m1 > m2) | ((m1 == m2) & (ka < kr)))
-        return ((deficit != 0) | profitable) & (it < max_iters)
-
-    s, _ = lax.while_loop(cond, body, (s, jnp.int64(0)))
+    s = s0 + full + award
     return jnp.where(active, s, 0)
 
 
 # vmapped over a batch of problems: n[B], w[B,C], s0[B,C], active[B,C], rank[B,C]
-webster_divide_batch = jax.vmap(webster_divide, in_axes=(0, 0, 0, 0, 0, None))
+webster_divide_batch = jax.vmap(webster_divide, in_axes=(0, 0, 0, 0, 0))
 
 
 # ---------------------------------------------------------------------------
@@ -451,7 +425,7 @@ _schedule_vmap = jax.vmap(
 )
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("waves",))
 def schedule_batch(
     # cluster axis
     cluster_valid, deleting, name_rank, pods_allowed, has_summary,
@@ -463,51 +437,148 @@ def schedule_batch(
     pl_has_cluster_sc, pl_sc_min, pl_sc_max, pl_ignore_avail,
     # bindings
     b_valid, placement_id, gvk_id, class_id, replicas, uid_desc, fresh,
-    non_workload, nw_shortcut, prev_rep, prev_present, evict,
+    non_workload, nw_shortcut, prev_idx, prev_val, evict_idx,
+    *, waves: int = 1,
 ):
-    """The full cycle: returns (rep[B,C] int64, selected[B,C] bool, status[B])."""
-    est_q = _capacity_estimates(
-        req_milli, req_is_cpu, req_pods, avail_milli, has_alloc, pods_allowed,
-        has_summary
-    )
-    Q = req_milli.shape[0]
-    est_q = est_q.at[:Q].set(jnp.where(est_override >= 0, est_override, est_q[:Q]))
+    """The full cycle: returns (rep[B,C] int64, selected[B,C] bool, status[B]).
 
-    # per-binding gathers
-    cid = jnp.where(class_id >= 0, class_id, Q)
-    est_b = est_q[cid]  # [B, C]
-    # calAvailableReplicas (util.go:104): clamp leftover MaxInt32 to replicas,
-    # EXCEPT the non-workload shortcut, which early-returns unclamped
-    avail_cal = jnp.where(est_b == MAX_INT32, replicas[:, None], est_b)
-    avail_cal = jnp.where(nw_shortcut[:, None], MAX_INT32, avail_cal)
+    `waves` splits the chunk (in its queue-priority order) into sequential
+    capacity-contention waves: wave k prices against the snapshot minus what
+    waves <k consumed.  waves == B is exactly the reference's serial
+    one-at-a-time semantics; waves == 1 prices the whole chunk against the
+    unmodified snapshot.
+
+    Previous assignments / eviction tasks arrive SPARSE (prev_idx/prev_val
+    [B, Kp], evict_idx [B, Ke], -1 padded) and are scattered to dense [B, C]
+    lanes here: the dense forms are ~hundreds of MB per chunk and would be
+    transfer-bound over the host<->TPU link.
+    """
+    B = b_valid.shape[0]
+    C = cluster_valid.shape[0]
+    Q = req_milli.shape[0]
+    # clamp to the nearest divisor of B at or below the requested count
+    # (B is pow2 when padded, arbitrary otherwise) — a configured waves=8
+    # on a tiny 4-binding cycle must degrade, not crash
+    waves = max(1, min(waves, B))
+    while B % waves:
+        waves -= 1
+    Bw = B // waves
+
+    # scatter sparse prev/evict to dense device lanes (additive: -1 padding
+    # rows collapse onto lane 0 contributing zero, so duplicates are safe)
+    bidx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    pmask = prev_idx >= 0
+    pic = jnp.where(pmask, prev_idx, 0)
+    prev_rep = (
+        jnp.zeros((B, C), jnp.int64)
+        .at[bidx, pic]
+        .add(jnp.where(pmask, prev_val, 0).astype(jnp.int64))
+    )
+    prev_present = (
+        jnp.zeros((B, C), jnp.int32).at[bidx, pic].add(pmask.astype(jnp.int32)) > 0
+    )
+    emask = evict_idx >= 0
+    eic = jnp.where(emask, evict_idx, 0)
+    evict = (
+        jnp.zeros((B, C), jnp.int32).at[bidx, eic].add(emask.astype(jnp.int32)) > 0
+    )
 
     lanes_ok = cluster_valid[None, :] & ~deleting[None, :]
-    feasible = (
-        lanes_ok
-        & pl_mask[placement_id]
-        & (pl_tol_bypass[placement_id] | prev_present)
-        & (api_ok[gvk_id] | prev_present)
-        & ~evict
+    # consumption per replica, in avail_milli units (cpu rows are stored in
+    # milli; every other resource row is stored in whole units -> x1000)
+    req_consume = req_milli * jnp.where(req_is_cpu[None, :], 1, 1000)  # [Q, R]
+    # class gather rows padded with a "no requirements" row Q: zero resource
+    # consumption, one pod per replica
+    req_consume_ext = jnp.concatenate(
+        [req_consume, jnp.zeros((1,) + req_consume.shape[1:], req_consume.dtype)]
+    )
+    req_pods_ext = jnp.concatenate([req_pods, jnp.ones((1,), req_pods.dtype)])
+
+    def wave_step(carry, xs):
+        used_milli, used_pods, used_sets = carry
+        (b_valid_w, placement_id_w, gvk_id_w, class_id_w, replicas_w,
+         uid_desc_w, fresh_w, non_workload_w, nw_shortcut_w, prev_rep_w,
+         prev_present_w, evict_w) = xs
+
+        avail_eff = avail_milli - used_milli
+        pods_eff = jnp.maximum(pods_allowed - used_pods, 0)
+        est_q = _capacity_estimates(
+            req_milli, req_is_cpu, req_pods, avail_eff, has_alloc, pods_eff,
+            has_summary,
+        )
+        # accurate-estimator overrides decrement by same-class consumption
+        # (cross-class coupling rides the general milli math above)
+        ovr = jnp.maximum(est_override - used_sets, 0)
+        est_q = est_q.at[:Q].set(jnp.where(est_override >= 0, ovr, est_q[:Q]))
+
+        cid = jnp.where(class_id_w >= 0, class_id_w, Q)
+        est_b = est_q[cid]  # [Bw, C]
+        # calAvailableReplicas (util.go:104): clamp leftover MaxInt32 to
+        # replicas, EXCEPT the non-workload shortcut (early-return unclamped)
+        avail_cal = jnp.where(est_b == MAX_INT32, replicas_w[:, None], est_b)
+        avail_cal = jnp.where(nw_shortcut_w[:, None], MAX_INT32, avail_cal)
+
+        feasible = (
+            lanes_ok
+            & pl_mask[placement_id_w]
+            & (pl_tol_bypass[placement_id_w] | prev_present_w)
+            & (api_ok[gvk_id_w] | prev_present_w)
+            & ~evict_w
+        )
+
+        rep, sel, status = _schedule_vmap(
+            feasible, avail_cal, prev_present_w, prev_rep_w, name_rank,
+            replicas_w, pl_strategy[placement_id_w],
+            pl_has_cluster_sc[placement_id_w], pl_sc_min[placement_id_w],
+            pl_sc_max[placement_id_w], pl_ignore_avail[placement_id_w],
+            pl_static_w[placement_id_w],
+            uid_desc_w, fresh_w, non_workload_w, b_valid_w,
+        )
+
+        if waves > 1:
+            # New consumption only: replicas KEPT from the previous
+            # assignment are already reflected in the snapshot's
+            # allocated/allocating totals (cluster_status controller), so
+            # charging full rep would double-count steady-state bindings.
+            # Shrinks are not credited back either — pods terminate
+            # asynchronously, so freed capacity is not instantly available.
+            delta = jnp.maximum(rep - prev_rep_w, 0)
+            # s64 dot_general is unsupported on TPU; these contractions are
+            # tiny (R, Q axes), so broadcast-multiply-reduce / segment_sum
+            req_b = req_consume_ext[cid]  # [Bw, R]
+            used_milli = used_milli + jnp.sum(
+                delta[:, :, None] * req_b[:, None, :], axis=0
+            )
+            used_pods = used_pods + jnp.sum(delta * req_pods_ext[cid][:, None], axis=0)
+            used_sets = used_sets + jax.ops.segment_sum(
+                delta, cid, num_segments=Q + 1
+            )[:Q]
+        return (used_milli, used_pods, used_sets), (rep, sel, status)
+
+    xs = jax.tree.map(
+        lambda a: a.reshape((waves, Bw) + a.shape[1:]),
+        (b_valid, placement_id, gvk_id, class_id, replicas, uid_desc, fresh,
+         non_workload, nw_shortcut, prev_rep, prev_present, evict),
+    )
+    carry0 = (
+        jnp.zeros_like(avail_milli),
+        jnp.zeros_like(pods_allowed),
+        jnp.zeros_like(est_override),
+    )
+    if waves == 1:
+        _, (rep, sel, status) = wave_step(carry0, jax.tree.map(lambda a: a[0], xs))
+        return rep, sel, status
+    _, (rep, sel, status) = lax.scan(wave_step, carry0, xs)
+    C = rep.shape[-1]
+    return (
+        rep.reshape(B, C),
+        sel.reshape(B, C),
+        status.reshape(B),
     )
 
-    rep, sel, status = _schedule_vmap(
-        feasible, avail_cal, prev_present, prev_rep, name_rank,
-        replicas, pl_strategy[placement_id], pl_has_cluster_sc[placement_id],
-        pl_sc_min[placement_id], pl_sc_max[placement_id],
-        pl_ignore_avail[placement_id], pl_static_w[placement_id],
-        uid_desc, fresh, non_workload, b_valid,
-    )
-    return rep, sel, status
 
-
-def solve(batch):
-    """Run schedule_batch over an ops/tensors.SolverBatch; numpy results."""
-    import numpy as np
-
-    # packed sort keys reserve 13 bits for the cluster lane
-    assert batch.C <= 8192, "cluster axis must be <= 8192 per solve call"
-
-    rep, sel, status = schedule_batch(
+def _batch_args(batch):
+    return (
         batch.cluster_valid, batch.deleting, batch.name_rank,
         batch.pods_allowed, batch.has_summary, batch.avail_milli,
         batch.has_alloc, batch.api_ok,
@@ -517,6 +588,52 @@ def solve(batch):
         batch.pl_sc_max, batch.pl_ignore_avail,
         batch.b_valid, batch.placement_id, batch.gvk_id, batch.class_id,
         batch.replicas, batch.uid_desc, batch.fresh, batch.non_workload,
-        batch.nw_shortcut, batch.prev_rep, batch.prev_present, batch.evict,
+        batch.nw_shortcut, batch.prev_idx, batch.prev_val, batch.evict_idx,
     )
+
+
+def solve(batch, waves: int = 1):
+    """Run schedule_batch over an ops/tensors.SolverBatch; dense numpy
+    results (rep[B,C], sel[B,C], status[B]).  Tests and small callers; the
+    hot path uses solve_compact to avoid the dense D2H transfer."""
+    import numpy as np
+
+    # packed sort keys reserve 13 bits for the cluster lane
+    assert batch.C <= 8192, "cluster axis must be <= 8192 per solve call"
+    rep, sel, status = schedule_batch(*_batch_args(batch), waves=waves)
     return np.asarray(rep), np.asarray(sel), np.asarray(status)
+
+
+@partial(jax.jit, static_argnames=("max_nnz",))
+def _compact_extract(rep, sel, status, *, max_nnz: int):
+    """Sparse COO extraction of the schedule result on device.
+
+    Returns (idx[max_nnz] int32 flat b*C+c, val[max_nnz] int32, status[B]
+    int32, nnz int32).  idx == -1 marks padding; nnz > max_nnz means the
+    caller must escalate max_nnz (only this tiny kernel recompiles).
+    """
+    mask = (sel | (rep > 0)).ravel()
+    nnz = jnp.sum(mask.astype(jnp.int32))
+    (idx,) = jnp.nonzero(mask, size=max_nnz, fill_value=-1)
+    val = jnp.where(idx >= 0, rep.ravel()[jnp.maximum(idx, 0)], 0)
+    return idx.astype(jnp.int32), val.astype(jnp.int32), status.astype(jnp.int32), nnz
+
+
+def solve_compact(batch, waves: int = 1, max_nnz: int = 0):
+    """Device-side solve + sparse result extraction: D2H ships only the
+    (binding, cluster, replicas) nonzeros instead of the dense [B, C] int64
+    plane (x100+ less traffic on realistic mixes).  Escalates max_nnz x4 on
+    overflow, capped at B*C (== dense)."""
+    import numpy as np
+
+    assert batch.C <= 8192, "cluster axis must be <= 8192 per solve call"
+    dense_nnz = batch.B * batch.C
+    if max_nnz <= 0:
+        max_nnz = min(max(batch.B * 16, 1 << 14), dense_nnz)
+    rep, sel, status = schedule_batch(*_batch_args(batch), waves=waves)
+    while True:
+        idx, val, st, nnz = _compact_extract(rep, sel, status, max_nnz=max_nnz)
+        if int(nnz) <= max_nnz or max_nnz >= dense_nnz:
+            break
+        max_nnz = min(max_nnz * 4, dense_nnz)
+    return np.asarray(idx), np.asarray(val), np.asarray(st), int(nnz)

@@ -62,6 +62,14 @@ ROUTE_DEVICE = 0
 ROUTE_TOPOLOGY_SPREAD = 1  # region/provider/zone spread -> serial DFS
 ROUTE_MULTI_COMPONENT = 2
 ROUTE_UNSUPPORTED = 3
+ROUTE_VANISHED_PREV = 4  # prev assignment names a cluster outside the snapshot
+ROUTE_HUGE_REPLICAS = 5  # replica count beyond the kernel's 2^25 cap
+
+# the device kernel clamps seat targets at 2^25-1 (ops/solver._N_CAP) and
+# Webster weights at 2^34-1 (ops/solver._W_CAP); bindings above either cap
+# must take the arbitrary-precision host path
+KERNEL_REPLICA_CAP = (1 << 25) - 1
+KERNEL_WEIGHT_CAP = (1 << 34) - 1
 
 # result status codes (must match ops/solver.py)
 STATUS_OK = 0
@@ -143,9 +151,13 @@ class SolverBatch:
     fresh: np.ndarray  # bool[B]
     non_workload: np.ndarray  # bool[B]
     nw_shortcut: np.ndarray  # bool[B] replicas==0 and no components (cal fast path)
-    prev_rep: np.ndarray  # int64[B, C] previous assignment (dense)
-    prev_present: np.ndarray  # bool[B, C] name listed in spec.clusters
-    evict: np.ndarray  # bool[B, C]
+    # previous assignment / eviction, SPARSE: the dense [B, C] forms would
+    # dominate host<->device transfer (hundreds of MB per chunk over a
+    # skinny PCIe/tunnel link) for data that is ~8 entries per binding;
+    # the kernel scatters them back to dense lanes on device.
+    prev_idx: np.ndarray  # int32[B, Kp] cluster lane, -1 padding
+    prev_val: np.ndarray  # int32[B, Kp] previous replicas
+    evict_idx: np.ndarray  # int32[B, Ke] cluster lane, -1 padding
 
     # host-side routing / metadata
     route: np.ndarray = field(default=None)  # int32[n_bindings] ROUTE_*
@@ -190,6 +202,12 @@ def _route_for(spec: ResourceBindingSpec, placement: Placement) -> int:
                 return ROUTE_TOPOLOGY_SPREAD
             if sc.spread_by_label:
                 return ROUTE_UNSUPPORTED
+    rs = placement.replica_scheduling
+    if rs is not None and rs.weight_preference is not None and any(
+        w.weight > KERNEL_WEIGHT_CAP
+        for w in rs.weight_preference.static_weight_list
+    ):
+        return ROUTE_HUGE_REPLICAS
     if len(spec.components) > 1:
         # multi-template scheduling (estimation.go:42-64) encodes the
         # component-set capacity as a request class (per-set aggregate +
@@ -281,9 +299,8 @@ def encode_batch(
     nw_shortcut = np.zeros(B, bool)
     b_valid = np.zeros(B, bool)
     b_valid[:nB] = True
-    prev_rep = np.zeros((B, C), np.int64)
-    prev_present = np.zeros((B, C), bool)
-    evict = np.zeros((B, C), bool)
+    prev_entries: List[List[Tuple[int, int]]] = [[] for _ in range(B)]
+    evict_entries: List[List[int]] = [[] for _ in range(B)]
 
     eff_placements: List[Placement] = []
     for b, (spec, status) in enumerate(items):
@@ -335,18 +352,48 @@ def encode_batch(
         is_workload = (spec.replicas > 0 or rr is not None) and len(spec.components) <= 1
         non_workload[b] = not is_workload
         nw_shortcut[b] = spec.replicas == 0 and not spec.components
-        # NOTE: prev entries naming clusters absent from the current snapshot
-        # are dropped (the dense encoding cannot address them); the reference
-        # can in principle re-assign to a vanished cluster during scale-down.
+        # prev entries naming clusters absent from the current snapshot
+        # cannot be addressed by the dense encoding, and the reference CAN
+        # re-assign to a vanished cluster during scale-down
+        # (division_algorithm.go:103-119 weights by spec.clusters regardless
+        # of snapshot membership) -- route those bindings to the serial host.
+        # Duplicate names keep the LAST entry (serial paths build
+        # {name: replicas} dicts, serial.py:658 -- last wins).
+        prev_by_lane: Dict[int, int] = {}
         for tc in spec.clusters:
             ci = cindex.index.get(tc.name)
             if ci is not None:
-                prev_rep[b, ci] = tc.replicas
-                prev_present[b, ci] = True
+                prev_by_lane[ci] = tc.replicas
+            elif route[b] == ROUTE_DEVICE:
+                route[b] = ROUTE_VANISHED_PREV
+        prev_entries[b] = list(prev_by_lane.items())
+        if route[b] == ROUTE_DEVICE and (
+            spec.replicas > KERNEL_REPLICA_CAP
+            or any(v > KERNEL_REPLICA_CAP for v in prev_by_lane.values())
+        ):
+            route[b] = ROUTE_HUGE_REPLICAS
         for task in spec.graceful_eviction_tasks:
             ci = cindex.index.get(task.from_cluster)
             if ci is not None:
-                evict[b, ci] = True
+                evict_entries[b].append(ci)
+
+    # rows the host path owns must not schedule NOR consume wave capacity on
+    # device (their device results are discarded; charging them would price
+    # later waves against phantom usage)
+    b_valid[:nB] = route == ROUTE_DEVICE
+
+    Kp = _next_pow2(max((len(e) for e in prev_entries), default=0) or 1, 4)
+    Ke = _next_pow2(max((len(e) for e in evict_entries), default=0) or 1, 4)
+    prev_idx = np.full((B, Kp), -1, np.int32)
+    prev_val = np.zeros((B, Kp), np.int32)
+    evict_idx = np.full((B, Ke), -1, np.int32)
+    for b, entries in enumerate(prev_entries):
+        for j, (ci, r) in enumerate(entries):
+            prev_idx[b, j] = ci
+            prev_val[b, j] = min(r, MAX_INT32)
+    for b, entries in enumerate(evict_entries):
+        for j, ci in enumerate(entries):
+            evict_idx[b, j] = ci
 
     # ---- capacity tensors -------------------------------------------------
     # Every axis the jit signature depends on is pow2-bucketed: B, C, and
@@ -513,7 +560,7 @@ def encode_batch(
         b_valid=b_valid, placement_id=placement_id, gvk_id=gvk_id,
         class_id=class_id, replicas=replicas, uid_desc=uid_desc, fresh=fresh,
         non_workload=non_workload, nw_shortcut=nw_shortcut,
-        prev_rep=prev_rep, prev_present=prev_present, evict=evict,
+        prev_idx=prev_idx, prev_val=prev_val, evict_idx=evict_idx,
         route=route, cluster_index=cindex,
     )
 
@@ -569,24 +616,9 @@ def decode_result(
     selected = np.asarray(selected)
     status = np.asarray(status)
     for b in range(batch.n_bindings):
-        st = int(status[b])
-        if st == STATUS_FIT_ERROR:
-            # host-routed rows are re-scheduled serially anyway; don't pay
-            # the O(C) filter pass for a result the caller discards
-            if items is not None and batch.route[b] == ROUTE_DEVICE:
-                spec_b, status_b = items[b]
-                _, diagnosis = serial.find_clusters_that_fit(
-                    spec_b, status_b, batch.cluster_index.clusters
-                )
-                out.append(serial.FitError(diagnosis))
-            else:
-                out.append(serial.FitError({}))
-            continue
-        if st == STATUS_UNSCHEDULABLE:
-            out.append(serial.UnschedulableError("insufficient capacity (batched)"))
-            continue
-        if st == STATUS_NO_CLUSTER:
-            out.append(serial.NoClusterAvailableError("no clusters available to schedule"))
+        err = _status_error(batch, b, int(status[b]), items)
+        if err is not None:
+            out.append(err)
             continue
         row = rep[b]
         targets = [
@@ -605,6 +637,73 @@ def decode_result(
                 for i in np.nonzero(selected[b, : batch.n_clusters])[0]
                 if names[i] not in have
             ]
+        targets.sort(key=lambda t: t.name)
+        out.append(targets)
+    return out
+
+
+def _status_error(batch, b: int, st: int, items) -> Optional[Exception]:
+    """Map a solver status code to the serial path's exception (or None)."""
+    if st == STATUS_FIT_ERROR:
+        # host-routed rows are re-scheduled serially anyway; don't pay
+        # the O(C) filter pass for a result the caller discards
+        if items is not None and batch.route[b] == ROUTE_DEVICE:
+            spec_b, status_b = items[b]
+            _, diagnosis = serial.find_clusters_that_fit(
+                spec_b, status_b, batch.cluster_index.clusters
+            )
+            return serial.FitError(diagnosis)
+        return serial.FitError({})
+    if st == STATUS_UNSCHEDULABLE:
+        return serial.UnschedulableError("insufficient capacity (batched)")
+    if st == STATUS_NO_CLUSTER:
+        return serial.NoClusterAvailableError("no clusters available to schedule")
+    return None
+
+
+def decode_compact(
+    batch: SolverBatch,
+    idx: np.ndarray,
+    val: np.ndarray,
+    status: np.ndarray,
+    *,
+    enable_empty_workload_propagation: bool = False,
+    items: Optional[Sequence[Tuple[ResourceBindingSpec, ResourceBindingStatus]]] = None,
+) -> List:
+    """decode_result over the sparse COO form from solver.solve_compact.
+
+    idx/val carry every (selected OR replicas>0) lane: replicas>0 entries
+    are assignments; val==0 entries are selected-only lanes, meaningful for
+    non-workload propagation and empty-workload propagation.
+    """
+    names = batch.cluster_index.names
+    C = batch.C
+    per_b: List[List[Tuple[int, int]]] = [[] for _ in range(batch.n_bindings)]
+    for i, v in zip(np.asarray(idx).tolist(), np.asarray(val).tolist()):
+        if i < 0:
+            continue
+        b, c = divmod(i, C)
+        if b < batch.n_bindings and c < batch.n_clusters:
+            per_b[b].append((c, v))
+    status = np.asarray(status)
+    out: List = []
+    for b in range(batch.n_bindings):
+        err = _status_error(batch, b, int(status[b]), items)
+        if err is not None:
+            out.append(err)
+            continue
+        if batch.non_workload[b]:
+            targets = [TargetCluster(name=names[c], replicas=0) for c, _ in per_b[b]]
+        else:
+            targets = [
+                TargetCluster(name=names[c], replicas=v) for c, v in per_b[b] if v > 0
+            ]
+            if enable_empty_workload_propagation:
+                targets += [
+                    TargetCluster(name=names[c], replicas=0)
+                    for c, v in per_b[b]
+                    if v == 0
+                ]
         targets.sort(key=lambda t: t.name)
         out.append(targets)
     return out

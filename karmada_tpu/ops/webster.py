@@ -4,14 +4,21 @@ Port of reference pkg/util/helper/webstermethod.go:112 (AllocateWebsterSeats)
 and pkg/util/helper/binding.go:70-183 (Dispenser + UID tiebreaker):
 
   * one seat at a time to the party with the highest priority
-    votes/(2*seats+1), computed in float64 exactly like the Go code;
+    votes/(2*seats+1);
   * ties: fewer seats wins, then lexicographically smaller (or larger, when
     fnv32a(uid) is odd) name wins;
   * parties only present in the initial assignment keep their seats with
     zero votes.
 
-The TPU kernel (ops/solver.py) reproduces this allocation via a threshold
-search; tests assert bit-equality against this implementation.
+Priority arithmetic: the Go reference compares float64 quotients
+(webstermethod.go:131).  This framework instead defines the priority as the
+QUANTIZED INTEGER  (votes << PRIORITY_QBITS) // (2*seats + 1)  — exact,
+platform-independent integer math with 2^-28 relative resolution.  The TPU
+kernel (ops/solver.py) computes the identical quantity in int64, so serial
+and device paths agree bit-for-bit with no float in either.  Behavior
+diverges from the Go float64 path only when two priorities collide within
+one quantum (then the seats/name tiebreak decides instead of the 53-bit
+mantissa) — strictly tighter determinism than the reference's.
 """
 
 from __future__ import annotations
@@ -19,6 +26,18 @@ from __future__ import annotations
 import heapq
 from dataclasses import dataclass
 from typing import Dict, List, Optional
+
+# Quantization of the Webster priority votes/(2*seats+1): both the serial
+# heap below and the TPU kernel (ops/solver.webster_divide) compare
+# (votes << PRIORITY_QBITS) // (2*seats + 1) as integers.  28 bits keeps
+# votes << 28 within int64 for votes < 2^34 (capacity values are clamped to
+# MaxInt32 upstream).
+PRIORITY_QBITS = 28
+
+
+def priority_quantized(votes: int, seats: int) -> int:
+    """The framework's Webster priority: integer-quantized votes/(2s+1)."""
+    return (max(int(votes), 0) << PRIORITY_QBITS) // (2 * int(seats) + 1)
 
 
 def fnv32a(data: str) -> int:
@@ -84,9 +103,9 @@ def allocate_webster_seats(
     if not parties:
         return []
 
-    # heap entries: (-priority_float64, seats, name_key, name)
+    # heap entries: (-quantized_priority, seats, name_key, name)
     def entry(p: Party):
-        prio = float(p.votes) / float(2 * p.seats + 1)
+        prio = priority_quantized(p.votes, p.seats)
         return (-prio, p.seats, _NameKey(p.name, name_descending), p.name)
 
     heap = [entry(p) for p in parties.values()]

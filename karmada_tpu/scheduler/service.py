@@ -32,7 +32,8 @@ from karmada_tpu.models.work import (
     TargetCluster,
 )
 from karmada_tpu.ops import serial, tensors
-from karmada_tpu.ops.solver import solve
+from karmada_tpu.ops.solver import solve_compact
+from karmada_tpu.webhook.admission import AdmissionDenied
 from karmada_tpu.scheduler import metrics as sched_metrics
 from karmada_tpu.scheduler.queue import QueuedBindingInfo, SchedulingQueue
 from karmada_tpu.store.store import Event, ObjectStore
@@ -64,10 +65,16 @@ class Scheduler:
         batch_window: int = 4096,
         queue: Optional[SchedulingQueue] = None,
         recorder: Optional[ev.EventRecorder] = None,
+        waves: int = 8,
     ) -> None:
         self.recorder = recorder if recorder is not None else ev.EventRecorder()
         self.store = store
         self.backend = backend
+        # capacity-contention waves per solver chunk (ops/solver.py): the
+        # chunk is priced in `waves` sequential waves, each seeing the
+        # snapshot minus what earlier waves consumed; waves == batch size
+        # is exactly the reference's one-binding-at-a-time semantics
+        self.waves = max(1, waves)
         self.estimators = list(estimators) if estimators else [GeneralEstimator()]
         self._general = next(
             (e for e in self.estimators if isinstance(e, GeneralEstimator)),
@@ -239,8 +246,10 @@ class Scheduler:
         outcomes: List[object] = []
         for i, rb in enumerate(bindings):
             res = results.get(i)
-            self._apply_result(rb, res, affinity_name.get(i, ""))
-            outcomes.append(res)
+            # _apply_result may downgrade a success to unschedulable (e.g.
+            # the quota-enforcement admission denies the patch) — the queue
+            # must route on the EFFECTIVE outcome
+            outcomes.append(self._apply_result(rb, res, affinity_name.get(i, "")))
         return outcomes
 
     def _initial_term(self, rb: ResourceBinding) -> int:
@@ -275,13 +284,13 @@ class Scheduler:
             ]
             if device_idx:
                 t1 = time.perf_counter()
-                rep, sel, status = solve(batch)
+                idx, val, status, _nnz = solve_compact(batch, waves=self.waves)
                 sched_metrics.STEP_LATENCY.observe(
                     time.perf_counter() - t1, schedule_step=sched_metrics.STEP_SOLVE
                 )
                 t2 = time.perf_counter()
-                decoded = tensors.decode_result(
-                    batch, rep, sel, status,
+                decoded = tensors.decode_compact(
+                    batch, idx, val, status,
                     enable_empty_workload_propagation=self.enable_empty_workload_propagation,
                     items=items,
                 )
@@ -309,9 +318,11 @@ class Scheduler:
         return out
 
     # -- result patch-back (patchScheduleResultForResourceBinding :664) -----
-    def _apply_result(self, rb: ResourceBinding, res, affinity_name: str) -> None:
+    def _apply_result(self, rb: ResourceBinding, res, affinity_name: str):
+        """Patch the schedule outcome back; returns the EFFECTIVE outcome
+        (admission may downgrade a success to UnschedulableError)."""
         if res is None:
-            return
+            return None
 
         if isinstance(res, Exception):
             reason = (
@@ -329,7 +340,7 @@ class Scheduler:
             self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, mark_failed)
             self.recorder.event(rb, ev.TYPE_WARNING,
                                 ev.REASON_SCHEDULE_BINDING_FAILED, str(res))
-            return
+            return res
 
         # success: patch spec.clusters, then record the *stored* generation in
         # status — two steps exactly like the reference (scheduler.go:664
@@ -342,9 +353,18 @@ class Scheduler:
         def patch_spec(obj: ResourceBinding) -> None:
             obj.spec.clusters = list(targets)
 
-        stored = self.store.mutate(
-            ResourceBinding.KIND, rb.namespace, rb.name, patch_spec
-        )
+        try:
+            stored = self.store.mutate(
+                ResourceBinding.KIND, rb.namespace, rb.name, patch_spec
+            )
+        except AdmissionDenied as denial:
+            # the FederatedQuotaEnforcement webhook (or any admission gate)
+            # rejected the schedule-result patch: treat exactly like an
+            # unschedulable outcome so the binding lands in the backoff/
+            # unschedulable queue instead of crash-looping the cycle
+            return self._apply_result(
+                rb, serial.UnschedulableError(str(denial)), affinity_name
+            )
 
         def patch_status(obj: ResourceBinding) -> None:
             obj.status.scheduler_observed_generation = stored.metadata.generation
@@ -360,6 +380,7 @@ class Scheduler:
             rb, ev.TYPE_NORMAL, ev.REASON_SCHEDULE_BINDING_SUCCEED,
             "Binding has been scheduled successfully.",
         )
+        return res
 
 
 def _priority_of(rb: ResourceBinding) -> int:

@@ -80,11 +80,14 @@ class WatchBus:
 
 
 class ObjectStore:
-    def __init__(self, bus: Optional[WatchBus] = None) -> None:
+    def __init__(self, bus: Optional[WatchBus] = None, admission=None) -> None:
         self._objects: Dict[Tuple[str, str, str], TypedObject] = {}
         self._rv = 0
         self._lock = threading.RLock()
         self.bus = bus or WatchBus()
+        # optional webhook.AdmissionRegistry: mutate/validate inside the
+        # write path, before persist (reference karmada-webhook semantics)
+        self.admission = admission
         # Events are enqueued under self._lock (in resourceVersion order) and
         # drained under _pub_lock, so concurrent writers can never deliver a
         # newer rv to subscribers before an older one.  _drain is re-entrancy
@@ -93,8 +96,21 @@ class ObjectStore:
         self._pending_events: List[Event] = []
         self._pub_lock = threading.Lock()
         self._draining: Optional[int] = None  # thread id of active drainer
+        # nested-write depth per thread: an admission plugin writing to the
+        # store runs INSIDE the outer write's lock; its _drain must defer to
+        # the outermost write (blocking on _pub_lock there can deadlock
+        # against a drainer's subscriber taking _lock)
+        self._wd = threading.local()
+
+    def _begin_write(self) -> None:
+        self._wd.depth = getattr(self._wd, "depth", 0) + 1
+
+    def _end_write(self) -> None:
+        self._wd.depth -= 1
 
     def _drain(self) -> None:
+        if getattr(self._wd, "depth", 0) > 0:
+            return  # nested write: the outermost writer drains
         me = threading.get_ident()
         if self._draining == me:
             return  # re-entrant write from a subscriber callback
@@ -122,19 +138,25 @@ class ObjectStore:
 
     # -- API ---------------------------------------------------------------
     def create(self, obj: TypedObject) -> TypedObject:
-        with self._lock:
-            key = self._key(obj)
-            if key in self._objects:
-                raise AlreadyExistsError(f"{key} already exists")
-            obj = copy.deepcopy(obj)
-            if not obj.metadata.uid:
-                obj.metadata.uid = new_uid()
-            obj.metadata.creation_timestamp = now()
-            obj.metadata.generation = 1
-            obj.metadata.resource_version = self._next_rv()
-            self._objects[key] = obj
-            stored = copy.deepcopy(obj)
-            self._pending_events.append(Event(ADDED, stored))
+        self._begin_write()
+        try:
+            with self._lock:
+                key = self._key(obj)
+                if key in self._objects:
+                    raise AlreadyExistsError(f"{key} already exists")
+                obj = copy.deepcopy(obj)
+                if self.admission is not None:
+                    self.admission.admit("CREATE", obj, None)
+                if not obj.metadata.uid:
+                    obj.metadata.uid = new_uid()
+                obj.metadata.creation_timestamp = now()
+                obj.metadata.generation = 1
+                obj.metadata.resource_version = self._next_rv()
+                self._objects[key] = obj
+                stored = copy.deepcopy(obj)
+                self._pending_events.append(Event(ADDED, stored))
+        finally:
+            self._end_write()
         self._drain()
         return stored
 
@@ -163,6 +185,15 @@ class ObjectStore:
     def update(self, obj: TypedObject, *, spec_changed: Optional[bool] = None) -> TypedObject:
         """Optimistic-concurrency update. Bumps generation when the spec
         changed (caller may force via spec_changed)."""
+        self._begin_write()
+        try:
+            stored = self._update_inner(obj, spec_changed)
+        finally:
+            self._end_write()
+        self._drain()
+        return stored
+
+    def _update_inner(self, obj: TypedObject, spec_changed: Optional[bool]) -> TypedObject:
         with self._lock:
             key = self._key(obj)
             if key not in self._objects:
@@ -176,6 +207,8 @@ class ObjectStore:
                     f"{key}: rv {obj.metadata.resource_version} != {old.metadata.resource_version}"
                 )
             obj = copy.deepcopy(obj)
+            if self.admission is not None:
+                self.admission.admit("UPDATE", obj, copy.deepcopy(old))
             obj.metadata.uid = old.metadata.uid
             obj.metadata.creation_timestamp = old.metadata.creation_timestamp
             # semantic no-op: identical content gets no new resourceVersion
@@ -201,7 +234,6 @@ class ObjectStore:
                 old_copy = copy.deepcopy(old)
                 event = Event(MODIFIED, stored, old_copy)
             self._pending_events.append(event)
-        self._drain()
         return stored
 
     def mutate(self, kind: str, namespace: str, name: str, fn: Callable[[TypedObject], None],
@@ -219,25 +251,29 @@ class ObjectStore:
     def delete(self, kind: str, namespace: str, name: str) -> None:
         """Finalizer-aware delete: marks deletionTimestamp; removal happens
         once finalizers drain (or immediately when none)."""
-        with self._lock:
-            key = (kind, namespace, name)
-            if key not in self._objects:
-                raise NotFoundError(f"{key} not found")
-            obj = self._objects[key]
-            if obj.metadata.finalizers:
-                if obj.metadata.deletion_timestamp is None:
-                    obj.metadata.deletion_timestamp = now()
-                    obj.metadata.resource_version = self._next_rv()
-                    stored = copy.deepcopy(obj)
-                    event = Event(MODIFIED, stored)
+        self._begin_write()
+        try:
+            with self._lock:
+                key = (kind, namespace, name)
+                if key not in self._objects:
+                    raise NotFoundError(f"{key} not found")
+                obj = self._objects[key]
+                if obj.metadata.finalizers:
+                    if obj.metadata.deletion_timestamp is None:
+                        obj.metadata.deletion_timestamp = now()
+                        obj.metadata.resource_version = self._next_rv()
+                        stored = copy.deepcopy(obj)
+                        event = Event(MODIFIED, stored)
+                    else:
+                        return
                 else:
-                    return
-            else:
-                del self._objects[key]
-                obj.metadata.deletion_timestamp = obj.metadata.deletion_timestamp or now()
-                stored = copy.deepcopy(obj)
-                event = Event(DELETED, stored)
-            self._pending_events.append(event)
+                    del self._objects[key]
+                    obj.metadata.deletion_timestamp = obj.metadata.deletion_timestamp or now()
+                    stored = copy.deepcopy(obj)
+                    event = Event(DELETED, stored)
+                self._pending_events.append(event)
+        finally:
+            self._end_write()
         self._drain()
 
     def items(self) -> Iterator[TypedObject]:

@@ -1,0 +1,17 @@
+from karmada_tpu.webhook.admission import (
+    OP_CREATE,
+    OP_DELETE,
+    OP_UPDATE,
+    AdmissionDenied,
+    AdmissionRegistry,
+)
+from karmada_tpu.webhook.builtin import install_default_webhooks
+
+__all__ = [
+    "OP_CREATE",
+    "OP_DELETE",
+    "OP_UPDATE",
+    "AdmissionDenied",
+    "AdmissionRegistry",
+    "install_default_webhooks",
+]

@@ -1,0 +1,158 @@
+"""Admission webhooks + FederatedResourceQuota enforcement gate.
+
+Reference: pkg/webhook/ (karmada-webhook admission for policy CRDs) and
+pkg/webhook/resourcebinding/validating.go (FederatedQuotaEnforcement:
+deny a schedule-result patch that would exceed the namespace quota, bump
+status.overallUsed on success).
+"""
+
+import pytest
+
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.extras import (
+    FederatedResourceQuota,
+    FederatedResourceQuotaSpec,
+)
+from karmada_tpu.models.policy import (
+    REPLICA_SCHEDULING_DIVIDED,
+    REPLICA_DIVISION_WEIGHTED,
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    ClusterPreferences,
+    ObjectMeta,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ReplicaSchedulingStrategy,
+    ResourceSelector,
+    SpreadConstraint,
+)
+from karmada_tpu.models.work import ResourceBinding
+from karmada_tpu.utils.quantity import Quantity
+from karmada_tpu.webhook.admission import AdmissionDenied
+
+
+def nginx(replicas=6, cpu="500m"):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "nginx", "namespace": "default"},
+        "spec": {
+            "replicas": replicas,
+            "template": {"spec": {"containers": [
+                {"name": "nginx", "image": "nginx:1.19",
+                 "resources": {"requests": {"cpu": cpu, "memory": "1Gi"}}},
+            ]}},
+        },
+    }
+
+
+def policy():
+    return PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="apps/v1", kind="Deployment")
+            ],
+            placement=Placement(
+                replica_scheduling=ReplicaSchedulingStrategy(
+                    replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                    replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                    weight_preference=ClusterPreferences(
+                        dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS
+                    ),
+                )
+            ),
+        ),
+    )
+
+
+def frq(cpu_milli):
+    return FederatedResourceQuota(
+        metadata=ObjectMeta(name="quota", namespace="default"),
+        spec=FederatedResourceQuotaSpec(
+            overall={"cpu": Quantity.from_milli(cpu_milli)}
+        ),
+    )
+
+
+def plane(**gates):
+    cp = ControlPlane(backend="serial", feature_gates=gates or None)
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.add_member("m2", cpu_milli=64_000)
+    cp.tick()
+    return cp
+
+
+def test_policy_validation_rejects_bad_spread():
+    cp = plane()
+    bad = policy()
+    bad.spec.placement.spread_constraints = [
+        SpreadConstraint(spread_by_field="cluster", min_groups=3, max_groups=1)
+    ]
+    with pytest.raises(AdmissionDenied, match="maxGroups lower than minGroups"):
+        cp.store.create(bad)
+
+
+def test_policy_defaulting_fills_preemption():
+    cp = plane()
+    p = policy()
+    p.spec.preemption = ""
+    cp.store.create(p)
+    assert cp.store.get(PropagationPolicy.KIND, "default", "pp").spec.preemption == "Never"
+
+
+def test_frq_validation_rejects_negative():
+    cp = plane()
+    bad = frq(-100)
+    with pytest.raises(AdmissionDenied, match="non-negative"):
+        cp.store.create(bad)
+
+
+def test_quota_gate_disabled_by_default():
+    cp = plane()
+    cp.store.create(frq(1000))  # 1 cpu total; 6 replicas x 500m = 3000m
+    cp.store.create(policy())
+    cp.apply(nginx())
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    # gate off: scheduling proceeds past the quota
+    assert sum(tc.replicas for tc in rb.spec.clusters) == 6
+
+
+def test_quota_gate_blocks_scheduling():
+    cp = plane(FederatedQuotaEnforcement=True)
+    cp.store.create(frq(1000))
+    cp.store.create(policy())
+    cp.apply(nginx())  # needs 3000m > 1000m quota
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    assert rb.spec.clusters == []
+    conds = {c.type: (c.status, c.message) for c in rb.status.conditions}
+    assert conds["Scheduled"][0] == "False"
+    assert "FederatedResourceQuota" in conds["Scheduled"][1]
+
+
+def test_quota_gate_allows_within_budget_and_bumps_used():
+    cp = plane(FederatedQuotaEnforcement=True)
+    cp.store.create(frq(5000))
+    cp.store.create(policy())
+    cp.apply(nginx())  # 3000m <= 5000m
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    assert sum(tc.replicas for tc in rb.spec.clusters) == 6
+    q = cp.store.get(FederatedResourceQuota.KIND, "default", "quota")
+    assert q.status.overall_used["cpu"].milli == 3000
+
+
+def test_quota_gate_scale_down_releases_budget():
+    cp = plane(FederatedQuotaEnforcement=True)
+    cp.store.create(frq(3000))
+    cp.store.create(policy())
+    cp.apply(nginx(replicas=6))  # exactly 3000m
+    cp.tick()
+    q = cp.store.get(FederatedResourceQuota.KIND, "default", "quota")
+    assert q.status.overall_used["cpu"].milli == 3000
+    cp.apply(nginx(replicas=2))  # scale down to 1000m
+    cp.tick()
+    q = cp.store.get(FederatedResourceQuota.KIND, "default", "quota")
+    assert q.status.overall_used["cpu"].milli == 1000

@@ -1,0 +1,290 @@
+"""Within-batch capacity contention: waves=B must equal serial one-at-a-time.
+
+SURVEY §7 "Hard parts" requires a defined capacity-contention policy for the
+batched solver.  The policy: schedule_batch(waves=G) splits the chunk into G
+sequential waves; wave k prices against the snapshot minus everything waves
+<k consumed.  waves == B is bit-equal to the reference's serial semantics
+(one binding at a time against a decremented snapshot,
+pkg/scheduler/core/generic_scheduler.go:71); production uses a small G and
+documents that bindings WITHIN a wave share a snapshot.
+"""
+
+import copy
+import random
+
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.models.cluster import (
+    APIEnablement,
+    Cluster,
+    ClusterSpec,
+    ClusterStatus,
+    ResourceSummary,
+)
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_DIVISION_AGGREGATED,
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+    ClusterPreferences,
+    Placement,
+    ReplicaSchedulingStrategy,
+)
+from karmada_tpu.models.work import (
+    ObjectReference,
+    ReplicaRequirements,
+    ResourceBindingSpec,
+    ResourceBindingStatus,
+)
+from karmada_tpu.ops import serial, tensors
+from karmada_tpu.ops.solver import solve
+from karmada_tpu.utils.quantity import Quantity
+
+GVK = ("apps/v1", "Deployment")
+
+
+def mk_cluster(name, cpu_milli, mem_units, pods):
+    return Cluster(
+        metadata=ObjectMeta(name=name),
+        spec=ClusterSpec(),
+        status=ClusterStatus(
+            api_enablements=[APIEnablement(GVK[0], [GVK[1]])],
+            resource_summary=ResourceSummary(
+                allocatable={
+                    "cpu": Quantity.from_milli(cpu_milli),
+                    "memory": Quantity.from_units(mem_units),
+                    "pods": Quantity.from_units(pods),
+                },
+            ),
+        ),
+    )
+
+
+def mk_binding(b, replicas, cpu_milli, mem_units, dynamic=True):
+    pref = (
+        ClusterPreferences(dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS)
+        if dynamic
+        else None
+    )
+    rs = ReplicaSchedulingStrategy(
+        replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+        replica_division_preference=(
+            REPLICA_DIVISION_WEIGHTED if dynamic else REPLICA_DIVISION_AGGREGATED
+        ),
+        weight_preference=pref,
+    )
+    spec = ResourceBindingSpec(
+        resource=ObjectReference(
+            api_version=GVK[0], kind=GVK[1], namespace="default",
+            name=f"app-{b}", uid=f"uid-{b}",
+        ),
+        replicas=replicas,
+        replica_requirements=ReplicaRequirements(resource_request={
+            "cpu": Quantity.from_milli(cpu_milli),
+            "memory": Quantity.from_units(mem_units),
+        }),
+        placement=Placement(replica_scheduling=rs),
+    )
+    return spec, ResourceBindingStatus()
+
+
+def consume(cluster: Cluster, replicas: int, cpu_milli: int, mem_units: int):
+    """Decrement the snapshot the way the wave accumulator does: replicas x
+    request added to `allocated` (cpu milli, memory units, 1 pod/replica)."""
+    s = cluster.status.resource_summary
+    alloc = s.allocated
+    alloc["cpu"] = Quantity.from_milli(
+        alloc.get("cpu", Quantity(0)).milli + replicas * cpu_milli
+    )
+    alloc["memory"] = Quantity.from_units(
+        alloc.get("memory", Quantity(0)).value() + replicas * mem_units
+    )
+    alloc["pods"] = Quantity.from_units(
+        alloc.get("pods", Quantity(0)).value() + replicas
+    )
+
+
+def serial_one_at_a_time(items, clusters):
+    """The reference semantics: each binding sees the previous ones' usage.
+
+    Consumption is the positive DELTA over the binding's previous
+    assignment — kept replicas are already in the snapshot's allocated
+    totals (same rule as the wave accumulator in ops/solver.py).
+    """
+    clusters = copy.deepcopy(clusters)
+    estimator = GeneralEstimator()
+    cal = serial.make_cal_available([estimator])
+    results = []
+    for spec, st in items:
+        try:
+            want = serial.schedule(spec, st, clusters, cal)
+        except Exception as e:  # noqa: BLE001
+            results.append(e)
+            continue
+        results.append(want)
+        by_name = {c.name: c for c in clusters}
+        prev = {tc.name: tc.replicas for tc in spec.clusters}
+        req = spec.replica_requirements.resource_request
+        for tc in want:
+            delta = max(tc.replicas - prev.get(tc.name, 0), 0)
+            consume(
+                by_name[tc.name], delta,
+                req["cpu"].milli, req["memory"].value(),
+            )
+    return results
+
+
+def run_case(items, clusters):
+    estimator = GeneralEstimator()
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch(items, cindex, estimator, pad_bindings=False)
+    rep, sel, status = solve(batch, waves=batch.B)
+    got = tensors.decode_result(batch, rep, sel, status, items=items)
+    want = serial_one_at_a_time(items, clusters)
+    for b, (w, g) in enumerate(zip(want, got)):
+        if isinstance(w, Exception):
+            assert isinstance(g, type(w)), (b, w, g)
+            continue
+        wm = {tc.name: tc.replicas for tc in w}
+        gm = {tc.name: tc.replicas for tc in g}
+        assert gm == wm, (b, wm, gm)
+
+
+def test_contention_single_small_cluster():
+    """N dynamic bindings fighting over one small cluster: later bindings
+    must see the decremented capacity and go unschedulable when it runs out,
+    exactly as the serial one-at-a-time path does."""
+    clusters = [mk_cluster("m1", cpu_milli=10_000, mem_units=100, pods=100)]
+    # each replica: 1000m cpu -> cluster fits 10 replicas total
+    items = [mk_binding(b, replicas=4, cpu_milli=1000, mem_units=1) for b in range(4)]
+    run_case(items, clusters)
+    # sanity on the serial meaning itself: 4+4 fit, the rest don't
+    want = serial_one_at_a_time(items, copy.deepcopy(clusters))
+    fits = [w for w in want if not isinstance(w, Exception)]
+    fails = [w for w in want if isinstance(w, Exception)]
+    assert len(fits) == 2 and len(fails) == 2
+    assert all(isinstance(w, serial.UnschedulableError) for w in fails)
+
+
+def test_contention_two_clusters_spillover():
+    """When the preferred cluster drains, later bindings spill to the other."""
+    clusters = [
+        mk_cluster("big", cpu_milli=8000, mem_units=64, pods=50),
+        mk_cluster("small", cpu_milli=4000, mem_units=64, pods=50),
+    ]
+    items = [mk_binding(b, replicas=3, cpu_milli=1000, mem_units=1) for b in range(4)]
+    run_case(items, clusters)
+
+
+def test_contention_aggregated_strategy():
+    clusters = [
+        mk_cluster("a", cpu_milli=6000, mem_units=64, pods=50),
+        mk_cluster("b", cpu_milli=6000, mem_units=64, pods=50),
+        mk_cluster("c", cpu_milli=3000, mem_units=64, pods=50),
+    ]
+    items = [
+        mk_binding(b, replicas=3, cpu_milli=1000, mem_units=1, dynamic=False)
+        for b in range(5)
+    ]
+    run_case(items, clusters)
+
+
+def test_contention_pods_axis():
+    """Pod-count capacity (no resource shortage) must decrement too."""
+    clusters = [mk_cluster("m1", cpu_milli=10**9, mem_units=10**6, pods=10)]
+    items = [mk_binding(b, replicas=3, cpu_milli=10, mem_units=0) for b in range(5)]
+    run_case(items, clusters)
+
+
+def test_contention_random_fuzz():
+    rng = random.Random(42)
+    for _ in range(6):
+        clusters = [
+            mk_cluster(
+                f"m{i}",
+                cpu_milli=rng.randint(2000, 20000),
+                mem_units=rng.randint(8, 128),
+                pods=rng.randint(5, 60),
+            )
+            for i in range(rng.randint(2, 6))
+        ]
+        items = [
+            mk_binding(
+                b,
+                replicas=rng.randint(1, 8),
+                cpu_milli=rng.choice([100, 250, 500, 1000]),
+                mem_units=rng.choice([1, 2]),
+                dynamic=rng.random() < 0.7,
+            )
+            for b in range(rng.randint(3, 10))
+        ]
+        run_case(items, clusters)
+
+
+def test_contention_steady_state_no_double_count():
+    """Bindings that KEEP their previous assignment consume nothing new:
+    a chunk of unchanged steady-state bindings must not drain the snapshot
+    (regression: wave accounting once charged full rep, so re-scheduling
+    unchanged bindings went spuriously unschedulable)."""
+    from karmada_tpu.models.work import TargetCluster
+
+    clusters = [mk_cluster("m1", cpu_milli=10_000, mem_units=100, pods=100)]
+    # snapshot already accounts the running replicas
+    consume(clusters[0], 8, 1000, 1)
+    items = []
+    for b in range(2):
+        spec, st = mk_binding(b, replicas=4, cpu_milli=1000, mem_units=1)
+        spec.clusters = [TargetCluster(name="m1", replicas=4)]
+        st.last_scheduled_time = 100.0
+        items.append((spec, st))
+    run_case(items, clusters)
+    want = serial_one_at_a_time(items, clusters)
+    # both keep their 4 replicas; nothing is newly consumed, nothing fails
+    assert all(not isinstance(w, Exception) for w in want)
+    assert [{t.name: t.replicas for t in w} for w in want] == [{"m1": 4}] * 2
+
+
+def test_contention_scale_up_delta_only():
+    """A scale-up charges only the delta; the kept part is free."""
+    from karmada_tpu.models.work import TargetCluster
+
+    clusters = [mk_cluster("m1", cpu_milli=10_000, mem_units=100, pods=100)]
+    consume(clusters[0], 4, 1000, 1)  # 4 running -> 6 cpu-slots left
+    items = []
+    for b in range(3):
+        spec, st = mk_binding(b, replicas=4, cpu_milli=1000, mem_units=1)
+        if b == 0:
+            spec.clusters = [TargetCluster(name="m1", replicas=2)]  # +2 delta
+            st.last_scheduled_time = 100.0
+        items.append((spec, st))
+    run_case(items, clusters)
+
+
+def test_waves_one_reproduces_shared_snapshot():
+    """waves=1 is the documented shared-snapshot mode: every binding sees
+    full capacity (the round-2 behavior), so all four fit 'on paper'."""
+    clusters = [mk_cluster("m1", cpu_milli=10_000, mem_units=100, pods=100)]
+    items = [mk_binding(b, replicas=4, cpu_milli=1000, mem_units=1) for b in range(4)]
+    estimator = GeneralEstimator()
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch(items, cindex, estimator, pad_bindings=False)
+    rep, sel, status = solve(batch, waves=1)
+    got = tensors.decode_result(batch, rep, sel, status, items=items)
+    assert all(not isinstance(g, Exception) for g in got)
+
+
+def test_intermediate_wave_counts_monotone():
+    """waves=2 on 4 bindings: pairs share a snapshot; second pair sees the
+    first pair's combined usage."""
+    clusters = [mk_cluster("m1", cpu_milli=10_000, mem_units=100, pods=100)]
+    items = [mk_binding(b, replicas=4, cpu_milli=1000, mem_units=1) for b in range(4)]
+    estimator = GeneralEstimator()
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch(items, cindex, estimator, pad_bindings=False)
+    rep, sel, status = solve(batch, waves=2)
+    got = tensors.decode_result(batch, rep, sel, status, items=items)
+    # wave 1 (b0, b1) both fit vs fresh snapshot; wave 2 sees 8 replicas
+    # consumed -> only 2 cpu-capacity left -> 4-replica asks are unschedulable
+    assert not isinstance(got[0], Exception) and not isinstance(got[1], Exception)
+    assert isinstance(got[2], serial.UnschedulableError)
+    assert isinstance(got[3], serial.UnschedulableError)

@@ -1,0 +1,172 @@
+"""Detector claim semantics: preemption Always/Never + Lazy activation.
+
+Reference: pkg/detector/preemption.go:50-107 (preemptionEnabled + the
+high-priority-PP > low-priority-PP > CPP rule) and detector.go:1485-1497
+(Lazy ActivationPreference defers policy-driven changes until the resource
+itself changes).
+"""
+
+from karmada_tpu.controllers.detector import (
+    CLUSTER_POLICY_LABEL,
+    POLICY_LABEL,
+)
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.policy import (
+    LAZY_ACTIVATION,
+    ClusterPropagationPolicy,
+    ObjectMeta,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ReplicaSchedulingStrategy,
+    ResourceSelector,
+)
+from karmada_tpu.models.policy import REPLICA_SCHEDULING_DUPLICATED
+from karmada_tpu.models.work import ResourceBinding
+
+
+def nginx():
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "nginx", "namespace": "default"},
+        "spec": {"replicas": 3},
+    }
+
+
+def pp(name, priority=0, preemption="Never", lazy=False, ns="default"):
+    return PropagationPolicy(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="apps/v1", kind="Deployment")
+            ],
+            placement=Placement(
+                replica_scheduling=ReplicaSchedulingStrategy(
+                    replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED
+                )
+            ),
+            priority=priority,
+            preemption=preemption,
+            activation_preference=LAZY_ACTIVATION if lazy else "",
+        ),
+    )
+
+
+def cpp(name, priority=0, preemption="Never"):
+    p = pp(name, priority, preemption, ns="")
+    return ClusterPropagationPolicy(metadata=p.metadata, spec=p.spec)
+
+
+def plane():
+    cp = ControlPlane(backend="serial")
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.tick()
+    return cp
+
+
+def claimed_by(cp):
+    obj = cp.store.get("Deployment", "default", "nginx")
+    return (
+        obj.metadata.labels.get(POLICY_LABEL),
+        obj.metadata.labels.get(CLUSTER_POLICY_LABEL),
+    )
+
+
+def test_preemption_never_keeps_claim():
+    cp = plane()
+    cp.store.create(pp("low", priority=1))
+    cp.apply(nginx())
+    cp.tick()
+    assert claimed_by(cp) == ("default/low", None)
+    # higher priority but preemption Never: claim must NOT move
+    cp.store.create(pp("high", priority=10, preemption="Never"))
+    cp.tick()
+    assert claimed_by(cp) == ("default/low", None)
+
+
+def test_preemption_always_takes_claim():
+    cp = plane()
+    cp.store.create(pp("low", priority=1))
+    cp.apply(nginx())
+    cp.tick()
+    cp.store.create(pp("high", priority=10, preemption="Always"))
+    cp.tick()
+    assert claimed_by(cp) == ("default/high", None)
+
+
+def test_preemption_always_requires_higher_priority():
+    cp = plane()
+    cp.store.create(pp("first", priority=5))
+    cp.apply(nginx())
+    cp.tick()
+    # same priority + Always: no preemption (strictly-higher rule)
+    cp.store.create(pp("equal", priority=5, preemption="Always"))
+    cp.tick()
+    assert claimed_by(cp) == ("default/first", None)
+
+
+def test_pp_preempts_cpp_with_always():
+    cp = plane()
+    cp.store.create(cpp("cluster-wide", priority=100))
+    cp.apply(nginx())
+    cp.tick()
+    assert claimed_by(cp) == (None, "cluster-wide")
+    # a PP with Always takes over regardless of priority (PP > CPP)
+    cp.store.create(pp("local", priority=0, preemption="Always"))
+    cp.tick()
+    assert claimed_by(cp) == ("default/local", None)
+
+
+def test_pp_does_not_preempt_cpp_with_never():
+    cp = plane()
+    cp.store.create(cpp("cluster-wide"))
+    cp.apply(nginx())
+    cp.tick()
+    cp.store.create(pp("local", priority=50, preemption="Never"))
+    cp.tick()
+    assert claimed_by(cp) == (None, "cluster-wide")
+
+
+def test_lazy_policy_update_deferred_until_resource_change():
+    cp = plane()
+    cp.store.create(pp("lazy", lazy=True))
+    cp.apply(nginx())
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    assert rb.spec.conflict_resolution == "Abort"
+
+    # change the policy: Lazy means existing claimed templates keep the OLD
+    # binding content on a policy-driven reconcile
+    def bump(p):
+        p.spec.conflict_resolution = "Overwrite"
+
+    cp.store.mutate(PropagationPolicy.KIND, "default", "lazy", bump)
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    assert rb.spec.conflict_resolution == "Abort", "lazy update applied too early"
+
+    # the resource itself changing activates the new policy content
+    manifest = nginx()
+    manifest["spec"]["replicas"] = 4
+    cp.apply(manifest)
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    assert rb.spec.conflict_resolution == "Overwrite"
+
+
+def test_lazy_policy_does_not_claim_existing_until_resource_change():
+    cp = plane()
+    cp.apply(nginx())
+    cp.tick()
+    assert cp.store.try_get(ResourceBinding.KIND, "default", "nginx-deployment") is None
+    cp.store.create(pp("late-lazy", lazy=True))
+    cp.tick()
+    # policy-driven pass skips the lazy claim entirely
+    assert cp.store.try_get(ResourceBinding.KIND, "default", "nginx-deployment") is None
+    # a template change picks it up
+    manifest = nginx()
+    manifest["spec"]["replicas"] = 5
+    cp.apply(manifest)
+    cp.tick()
+    assert cp.store.try_get(ResourceBinding.KIND, "default", "nginx-deployment") is not None

@@ -129,7 +129,7 @@ def test_batch_vmap():
     rank = np.tile(np.arange(C, dtype=np.int64), (B, 1))
     seats = np.asarray(
         webster_divide_batch(jnp.asarray(n), jnp.asarray(w), jnp.asarray(s0),
-                             jnp.asarray(active), jnp.asarray(rank), 0)
+                             jnp.asarray(active), jnp.asarray(rank))
     )
     names = [f"c{i}" for i in range(C)]
     for b in range(B):
