@@ -1,0 +1,469 @@
+"""FederatedHPA + CronFederatedHPA controllers.
+
+Reference: pkg/controllers/federatedhpa/federatedhpa_controller.go:141-995
+(the k8s autoscaling/v2 HPA algorithm lifted and evaluated against pods
+gathered from ALL the workload's target clusters via the metrics adapter),
+replica_calculator.go (utilization / average-value math, 10% tolerance),
+cronfederatedhpa/cronfederatedhpa_controller.go:58 (cron rules scaling
+workloads or the FHPA's min/max), hpascaletargetmarker (labels HPA targets
+so replica sync is skipped) and deploymentreplicassyncer (aggregated member
+replicas synced back to the template when HPA-controlled).
+
+Scaling acts on the TEMPLATE's spec.replicas: the detector refreshes the
+binding, the scheduler redistributes — the same closed loop as the
+reference (scale target -> karmada-apiserver -> detector -> scheduler).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from karmada_tpu.controllers.detector import binding_name
+from karmada_tpu.models.autoscaling import (
+    POLICY_PERCENT,
+    POLICY_PODS,
+    SELECT_DISABLED,
+    SELECT_MAX,
+    SELECT_MIN,
+    TARGET_AVERAGE_VALUE,
+    TARGET_UTILIZATION,
+    CronFederatedHPA,
+    ExecutionHistory,
+    FederatedHPA,
+    HPAScalingPolicy,
+    HPAScalingRules,
+    MetricStatusValue,
+)
+from karmada_tpu.models.meta import deep_get
+from karmada_tpu.models.work import ResourceBinding
+from karmada_tpu.store.store import Event, NotFoundError, ObjectStore
+from karmada_tpu.store.worker import AsyncWorker, Runtime
+
+# labels (reference pkg/util/constants)
+RETAIN_REPLICAS_LABEL = "resourcetemplate.karmada.io/retain-replicas"
+
+TOLERANCE = 0.1  # replica_calculator.go tolerance
+
+# k8s default behavior (autoscaling/v2 defaults the reference inherits)
+DEFAULT_SCALE_UP = HPAScalingRules(
+    stabilization_window_seconds=0,
+    select_policy=SELECT_MAX,
+    policies=[
+        HPAScalingPolicy(type=POLICY_PERCENT, value=100, period_seconds=15),
+        HPAScalingPolicy(type=POLICY_PODS, value=4, period_seconds=15),
+    ],
+)
+DEFAULT_SCALE_DOWN = HPAScalingRules(
+    stabilization_window_seconds=300,
+    select_policy=SELECT_MAX,
+    policies=[HPAScalingPolicy(type=POLICY_PERCENT, value=100, period_seconds=15)],
+)
+
+
+class ReplicaCalculator:
+    """replica_calculator.go — per-metric desired replicas over the merged
+    multi-cluster pod samples."""
+
+    def desired_for_metric(self, metric, samples: List[dict],
+                           current_replicas: int) -> Tuple[int, MetricStatusValue]:
+        res = metric.resource
+        name = res.name
+        ready = len(samples)
+        if ready == 0:
+            # no pods yet: keep current (the reference errors and retries)
+            return current_replicas, MetricStatusValue(name=name)
+        usage = sum(s["usage"].get(name, 0) for s in samples)
+        if res.target.type == TARGET_UTILIZATION:
+            requests = sum(s["request"].get(name, 0) for s in samples)
+            if requests <= 0:
+                return current_replicas, MetricStatusValue(name=name)
+            current_util = int(round(100.0 * usage / requests))
+            target = max(res.target.average_utilization or 0, 1)
+            ratio = (usage / requests) / (target / 100.0)
+            status = MetricStatusValue(name=name, current_utilization=current_util)
+        else:  # AverageValue
+            target = max(res.target.average_value or 0, 1)
+            avg = usage / ready
+            ratio = avg / target
+            status = MetricStatusValue(name=name, current_average_value=int(avg))
+        if abs(ratio - 1.0) <= TOLERANCE:
+            return current_replicas, status
+        return int(math.ceil(ratio * ready)), status
+
+
+def _replicas_change_in_period(events: List[Tuple[float, int, int]],
+                               now: float, period: int, up: bool) -> int:
+    """Sum of replica increases (or decreases) within the trailing period
+    (the k8s getReplicasChangePerPeriod over scaleEvents)."""
+    total = 0
+    for (t, old, new) in events:
+        if now - t > period:
+            continue
+        d = new - old
+        total += max(d, 0) if up else max(-d, 0)
+    return total
+
+
+def _rule_limit(rules: HPAScalingRules, current: int, up: bool,
+                events: List[Tuple[float, int, int]], now: float) -> Optional[int]:
+    """Max replicas reachable under the scaling policies, accounting for
+    changes already made inside each policy's period
+    (k8s calculateScaleUpLimitWithScalingRules)."""
+    if rules.select_policy == SELECT_DISABLED:
+        return current
+    limits = []
+    for p in rules.policies:
+        changed = _replicas_change_in_period(events, now, p.period_seconds, up)
+        base = current - changed if up else current + changed
+        if p.type == POLICY_PODS:
+            limits.append(base + p.value if up else base - p.value)
+        else:  # Percent
+            if up:
+                limits.append(int(math.ceil(base * (1.0 + p.value / 100.0))))
+            else:
+                limits.append(int(math.floor(base * (1.0 - p.value / 100.0))))
+    if not limits:
+        return None
+    if rules.select_policy == SELECT_MIN:
+        return min(limits) if up else max(limits)
+    return max(limits) if up else min(limits)
+
+
+class FederatedHPAController:
+    def __init__(
+        self,
+        store: ObjectStore,
+        runtime: Runtime,
+        metrics,  # search.MultiClusterMetricsProvider
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        self.store = store
+        self.metrics = metrics
+        self.clock = clock
+        self.calc = ReplicaCalculator()
+        # per-HPA recommendation history for stabilization windows:
+        # (ns, name) -> [(timestamp, recommendation)]
+        self._recommendations: Dict[Tuple[str, str], List[Tuple[float, int]]] = {}
+        # per-HPA scale events for behavior rate limits:
+        # (ns, name) -> [(timestamp, old_replicas, new_replicas)]
+        self._scale_events: Dict[Tuple[str, str], List[Tuple[float, int, int]]] = {}
+        self.worker = runtime.register(AsyncWorker("federatedhpa", self._reconcile))
+        runtime.register_periodic(self.run_once)
+        store.bus.subscribe(self._on_event, kind=FederatedHPA.KIND)
+
+    def _on_event(self, event: Event) -> None:
+        self.worker.enqueue((event.obj.namespace, event.obj.name))
+
+    def run_once(self) -> None:
+        for hpa in self.store.list(FederatedHPA.KIND):
+            self.worker.enqueue((hpa.namespace, hpa.name))
+
+    # -- target plumbing ----------------------------------------------------
+    def _target_clusters(self, ns: str, ref) -> List[str]:
+        rb = self.store.try_get(
+            ResourceBinding.KIND, ns, binding_name(ref.kind, ref.name)
+        )
+        if rb is None:
+            return []
+        return [tc.name for tc in rb.spec.clusters]
+
+    def _reconcile(self, key) -> None:
+        ns, name = key
+        hpa = self.store.try_get(FederatedHPA.KIND, ns, name)
+        if hpa is None or hpa.metadata.deleting:
+            self._recommendations.pop((ns, name), None)
+            self._scale_events.pop((ns, name), None)
+            return
+        ref = hpa.spec.scale_target_ref
+        target = self.store.try_get(ref.kind, ns, ref.name)
+        if target is None:
+            return
+        current = int(deep_get(target.manifest, "spec.replicas", 0) or 0)
+        if current == 0:
+            return  # scaled to zero: HPA disabled (k8s semantics)
+
+        clusters = self._target_clusters(ns, ref)
+        samples = self.metrics.pod_metrics(ref.kind, ns, ref.name, clusters or None)
+
+        # k8s: every metric proposes a replica count; the max wins
+        statuses: List[MetricStatusValue] = []
+        proposals: List[int] = []
+        for metric in hpa.spec.metrics:
+            if metric.resource is None:
+                continue
+            d, st = self.calc.desired_for_metric(metric, samples, current)
+            statuses.append(st)
+            proposals.append(d)
+        desired = max(proposals) if proposals else current
+
+        desired = self._stabilize(ns, name, hpa, current, desired)
+        desired = self._apply_behavior(ns, name, hpa, current, desired)
+        desired = max(hpa.spec.min_replicas, min(desired, hpa.spec.max_replicas))
+
+        if desired != current:
+            def scale(obj) -> None:
+                obj.manifest.setdefault("spec", {})["replicas"] = desired
+            self.store.mutate(ref.kind, ns, ref.name, scale)
+            events = self._scale_events.setdefault((ns, name), [])
+            events.append((self.clock(), current, desired))
+            horizon = 3600.0
+            events[:] = [e for e in events if self.clock() - e[0] <= horizon]
+
+        def set_status(obj: FederatedHPA) -> None:
+            obj.status.current_replicas = current
+            obj.status.desired_replicas = desired
+            obj.status.current_metrics = statuses
+            if desired != current:
+                obj.status.last_scale_time = self.clock()
+        self.store.mutate(FederatedHPA.KIND, ns, name, set_status)
+
+    # -- stabilization + behavior ------------------------------------------
+    def _stabilize(self, ns: str, name: str, hpa: FederatedHPA,
+                   current: int, desired: int) -> int:
+        """Record the recommendation; within the stabilization window the
+        scale-down floor is the MAX recent recommendation and the scale-up
+        ceiling the MIN (the k8s stabilizeRecommendationWithBehaviors)."""
+        now = self.clock()
+        behavior = hpa.spec.behavior
+        up = (behavior.scale_up if behavior else None) or DEFAULT_SCALE_UP
+        down = (behavior.scale_down if behavior else None) or DEFAULT_SCALE_DOWN
+        up_w = up.stabilization_window_seconds or 0
+        down_w = (
+            down.stabilization_window_seconds
+            if down.stabilization_window_seconds is not None else 300
+        )
+        hist = self._recommendations.setdefault((ns, name), [])
+        hist.append((now, desired))
+        horizon = max(up_w, down_w)
+        hist[:] = [(t, r) for (t, r) in hist if now - t <= horizon]
+        out = desired
+        if desired < current and down_w > 0:
+            out = max(r for (t, r) in hist if now - t <= down_w)
+            out = min(out, current)
+        elif desired > current and up_w > 0:
+            out = min(r for (t, r) in hist if now - t <= up_w)
+            out = max(out, current)
+        return out
+
+    def _apply_behavior(self, ns: str, name: str, hpa: FederatedHPA,
+                        current: int, desired: int) -> int:
+        behavior = hpa.spec.behavior
+        events = self._scale_events.get((ns, name), [])
+        now = self.clock()
+        if desired > current:
+            rules = (behavior.scale_up if behavior else None) or DEFAULT_SCALE_UP
+            limit = _rule_limit(rules, current, True, events, now)
+            if limit is not None:
+                desired = min(desired, max(limit, current))
+        elif desired < current:
+            rules = (behavior.scale_down if behavior else None) or DEFAULT_SCALE_DOWN
+            limit = _rule_limit(rules, current, False, events, now)
+            if limit is not None:
+                desired = max(desired, min(limit, current))
+        return desired
+
+
+# -- CronFederatedHPA --------------------------------------------------------
+
+
+def _cron_field_matches(field_spec: str, value: int, lo: int, hi: int) -> bool:
+    for part in field_spec.split(","):
+        part = part.strip()
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            step = int(step_s)
+        if part in ("*", ""):
+            rng = range(lo, hi + 1)
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            rng = range(int(a), int(b) + 1)
+        else:
+            rng = range(int(part), int(part) + 1)
+        if value in rng and (value - rng.start) % step == 0:
+            return True
+    return False
+
+
+def cron_matches(expr: str, ts: float) -> bool:
+    """Standard 5-field cron match for the minute containing `ts`."""
+    parts = expr.split()
+    if len(parts) != 5:
+        return False
+    tm = time.localtime(ts)
+    cron_dow = (tm.tm_wday + 1) % 7  # python Mon=0..Sun=6 -> cron Sun=0..Sat=6
+    if not (
+        _cron_field_matches(parts[0], tm.tm_min, 0, 59)
+        and _cron_field_matches(parts[1], tm.tm_hour, 0, 23)
+        and _cron_field_matches(parts[3], tm.tm_mon, 1, 12)
+    ):
+        return False
+    dom_ok = _cron_field_matches(parts[2], tm.tm_mday, 1, 31)
+    dow_ok = _cron_field_matches(parts[4], cron_dow, 0, 6)
+    # vixie/robfig cron (the reference's parser): when BOTH day fields are
+    # restricted, a time matches if EITHER does; otherwise both must match
+    # (the unrestricted one is always true)
+    if parts[2] != "*" and parts[4] != "*":
+        return dom_ok or dow_ok
+    return dom_ok and dow_ok
+
+
+class CronFederatedHPAController:
+    """cronfederatedhpa_controller.go:58 — each sync, fire any rule whose
+    schedule matches a minute since the last check; targets either a
+    workload's spec.replicas or a FederatedHPA's min/max."""
+
+    def __init__(self, store: ObjectStore, runtime: Runtime,
+                 clock: Callable[[], float] = time.time) -> None:
+        self.store = store
+        self.clock = clock
+        self._last_check: Dict[Tuple[str, str], float] = {}
+        runtime.register_periodic(self.run_once)
+
+    def run_once(self) -> None:
+        now = self.clock()
+        for cron in self.store.list(CronFederatedHPA.KIND):
+            self._sync(cron, now)
+
+    def _sync(self, cron: CronFederatedHPA, now: float) -> None:
+        key = (cron.namespace, cron.name)
+        last = self._last_check.get(key, now - 60)
+        self._last_check[key] = now
+        fired: Dict[str, Tuple[float, str, str]] = {}
+        for rule in cron.spec.rules:
+            if rule.suspend:
+                continue
+            # check each whole minute in (last, now]
+            t = (int(last) // 60 + 1) * 60
+            while t <= now:
+                if cron_matches(rule.schedule, t):
+                    result, msg = self._fire(cron, rule)
+                    fired[rule.name] = (float(t), result, msg)
+                t += 60
+        if not fired:
+            return
+
+        def set_status(obj: CronFederatedHPA) -> None:
+            hist = {h.rule_name: h for h in obj.status.execution_histories}
+            for rname, (t, result, msg) in fired.items():
+                h = hist.get(rname)
+                if h is None:
+                    h = ExecutionHistory(rule_name=rname)
+                    obj.status.execution_histories.append(h)
+                    hist[rname] = h
+                h.last_execution_time = t
+                h.last_result = result
+                h.message = msg
+        self.store.mutate(CronFederatedHPA.KIND, cron.namespace, cron.name, set_status)
+
+    def _fire(self, cron: CronFederatedHPA, rule) -> Tuple[str, str]:
+        ref = cron.spec.scale_target_ref
+        ns = cron.namespace
+        try:
+            if ref.kind == FederatedHPA.KIND:
+                def upd(hpa: FederatedHPA) -> None:
+                    if rule.target_min_replicas is not None:
+                        hpa.spec.min_replicas = rule.target_min_replicas
+                    if rule.target_max_replicas is not None:
+                        hpa.spec.max_replicas = rule.target_max_replicas
+                self.store.mutate(FederatedHPA.KIND, ns, ref.name, upd)
+            else:
+                if rule.target_replicas is None:
+                    return "Failed", "rule has no targetReplicas"
+
+                def scale(obj) -> None:
+                    obj.manifest.setdefault("spec", {})["replicas"] = (
+                        rule.target_replicas
+                    )
+                self.store.mutate(ref.kind, ns, ref.name, scale)
+            return "Succeed", ""
+        except NotFoundError:
+            return "Failed", f"target {ref.kind}/{ref.name} not found"
+
+
+# -- HpaScaleTargetMarker + DeploymentReplicasSyncer -------------------------
+
+
+class HpaScaleTargetMarker:
+    """hpascaletargetmarker: watches NATIVE HorizontalPodAutoscaler
+    templates (the propagate-an-HPA-to-members flow, hpa_scale_target_
+    marker_controller.go:60 — NOT FederatedHPA) and labels their scale
+    target with retain-replicas, so the apply engine keeps each member's
+    own replica count (retain.go:145 retainWorkloadReplicas) and the
+    member-side HPAs stay in control."""
+
+    def __init__(self, store: ObjectStore, runtime: Runtime) -> None:
+        self.store = store
+        self.worker = runtime.register(AsyncWorker("hpa-marker", self._reconcile))
+        store.bus.subscribe(self._on_event, kind="HorizontalPodAutoscaler")
+
+    def _on_event(self, event: Event) -> None:
+        hpa = event.obj
+        ref = deep_get(hpa.manifest, "spec.scaleTargetRef", {}) or {}
+        if not ref.get("kind") or not ref.get("name"):
+            return
+        self.worker.enqueue(
+            (hpa.namespace, ref["kind"], ref["name"], event.type == "DELETED")
+        )
+
+    def _reconcile(self, key) -> None:
+        ns, kind, name, removed = key
+        obj = self.store.try_get(kind, ns, name)
+        if obj is None:
+            return
+
+        def mark(o) -> None:
+            labels = o.manifest.setdefault("metadata", {}).setdefault("labels", {})
+            if removed:
+                labels.pop(RETAIN_REPLICAS_LABEL, None)
+                o.metadata.labels.pop(RETAIN_REPLICAS_LABEL, None)
+            else:
+                labels[RETAIN_REPLICAS_LABEL] = "true"
+                o.metadata.labels[RETAIN_REPLICAS_LABEL] = "true"
+        self.store.mutate(kind, ns, name, mark)
+
+
+class DeploymentReplicasSyncer:
+    """deploymentreplicassyncer: for HPA-controlled targets, sync the sum of
+    member-reported replicas back into the template's spec.replicas so the
+    control plane view follows what HPA actually achieved."""
+
+    def __init__(self, store: ObjectStore, runtime: Runtime) -> None:
+        self.store = store
+        runtime.register_periodic(self.run_once)
+
+    def run_once(self) -> None:
+        for rb in self.store.list(ResourceBinding.KIND):
+            ref = rb.spec.resource
+            tmpl = self.store.try_get(ref.kind, ref.namespace, ref.name)
+            if tmpl is None:
+                continue
+            if tmpl.metadata.labels.get(RETAIN_REPLICAS_LABEL) != "true":
+                continue
+            cur = int(deep_get(tmpl.manifest, "spec.replicas", 0) or 0)
+            # guards (deployment_replicas_syncer_controller.go:146-190): the
+            # spec change must have fully propagated — binding caught up,
+            # scheduler observed the latest generation, every target
+            # cluster's status collected — before status drives spec, or
+            # this controller would fight an in-flight HPA scale
+            if rb.spec.replicas != cur:
+                continue
+            if rb.metadata.generation != rb.status.scheduler_observed_generation:
+                continue
+            if len(rb.status.aggregated_status) != len(rb.spec.clusters):
+                continue
+            total = 0
+            seen = False
+            for agg in rb.status.aggregated_status:
+                st = agg.status or {}
+                if "replicas" in st:
+                    total += int(st.get("replicas") or 0)
+                    seen = True
+            if not seen:
+                continue
+            if total > 0 and total != cur:
+                def sync(o) -> None:
+                    o.manifest.setdefault("spec", {})["replicas"] = total
+                self.store.mutate(ref.kind, ref.namespace, ref.name, sync)

@@ -58,6 +58,7 @@ class ControlPlane:
         enable_descheduler: bool = False,
         eviction_grace_period_s: float = 600,
         feature_gates: Optional[Dict[str, bool]] = None,
+        clock=None,
     ) -> None:
         from karmada_tpu.utils.events import EventRecorder
         from karmada_tpu.utils.features import FeatureGates
@@ -111,6 +112,37 @@ class ControlPlane:
             if enable_descheduler
             else None
         )
+        # L5 query plane: registry-driven fan-in cache, cluster proxy behind
+        # unified auth, and the metrics provider the HPA family consumes
+        from karmada_tpu.search import (
+            ClusterProxy,
+            MultiClusterCache,
+            MultiClusterMetricsProvider,
+            UnifiedAuthController,
+        )
+
+        self.search_cache = MultiClusterCache(self.store, self.runtime, self.members)
+        self.unified_auth = UnifiedAuthController(self.store, self.runtime, self.members)
+        self.cluster_proxy = ClusterProxy(self.store, self.members, self.unified_auth)
+        self.metrics_provider = MultiClusterMetricsProvider(self.members)
+        # autoscaling family (FederatedHPA / CronFederatedHPA / marker /
+        # replicas syncer), fed by the metrics provider above
+        from karmada_tpu.controllers.federatedhpa import (
+            CronFederatedHPAController,
+            DeploymentReplicasSyncer,
+            FederatedHPAController,
+            HpaScaleTargetMarker,
+        )
+
+        self.clock = clock if clock is not None else __import__("time").time
+        self.federated_hpa = FederatedHPAController(
+            self.store, self.runtime, self.metrics_provider, clock=self.clock
+        )
+        self.cron_hpa = CronFederatedHPAController(
+            self.store, self.runtime, clock=self.clock
+        )
+        self.hpa_marker = HpaScaleTargetMarker(self.store, self.runtime)
+        self.replicas_syncer = DeploymentReplicasSyncer(self.store, self.runtime)
         self.rebalancer = WorkloadRebalancerController(self.store, self.runtime)
         self.taint_policies = ClusterTaintPolicyController(self.store, self.runtime)
         self.remedies = RemedyController(self.store, self.runtime)
@@ -156,6 +188,11 @@ class ControlPlane:
         return self.members[name]
 
     # -- user-facing API ----------------------------------------------------
+    def proxy(self, cluster: str, subject: str = "system:admin"):
+        """`karmadactl get --cluster=...`-style passthrough to one member
+        (aggregated apiserver cluster proxy, proxy.go:73)."""
+        return self.cluster_proxy.connect(cluster, subject)
+
     def apply(self, manifest: dict) -> Unstructured:
         obj = Unstructured.from_manifest(manifest)
         existing = self.store.try_get(obj.KIND, obj.namespace, obj.name)

@@ -181,6 +181,13 @@ class ResourceInterpreter:
             return hook(desired, observed)
         out = copy.deepcopy(desired)
         kind = out.get("kind", "")
+        # retain-replicas label: member-side HPAs own the replica count
+        # (native/retain.go:145 retainWorkloadReplicas)
+        labels = deep_get(out, "metadata.labels", {}) or {}
+        if labels.get("resourcetemplate.karmada.io/retain-replicas") == "true":
+            observed_replicas = deep_get(observed, "spec.replicas")
+            if observed_replicas is not None:
+                out.setdefault("spec", {})["replicas"] = observed_replicas
         if kind == "Service":
             ip = deep_get(observed, "spec.clusterIP")
             if ip is not None:

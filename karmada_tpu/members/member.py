@@ -50,6 +50,11 @@ class FakeMemberCluster:
     ])
     healthy: bool = True
     store: ObjectStore = field(default_factory=ObjectStore)
+    # per-workload live load for the metrics plane: (kind, ns, name) ->
+    # per-replica usage in milli-units, e.g. {"cpu": 250, "memory": ...}.
+    # Unset workloads idle at 10% of their request (something nonzero for
+    # utilization math without claiming precision the simulator lacks).
+    load: Dict[tuple, Dict[str, int]] = field(default_factory=dict)
 
     def effective_nodes(self) -> List[FakeNode]:
         """Explicit node list, or one synthetic node holding all capacity."""
@@ -190,6 +195,29 @@ class FakeMemberCluster:
             want = int(deep_get(m, "spec.parallelism", 1) or 1)
         admitted = self.admission_plan().get((kind, namespace, name), 0)
         return max(want - admitted, 0)
+
+    # -- metrics plane (what the metrics adapter scrapes) -------------------
+    def set_load(self, kind: str, namespace: str, name: str,
+                 per_replica: Dict[str, int]) -> None:
+        """Drive per-replica usage (milli-units) for one workload."""
+        self.load[(kind, namespace, name)] = dict(per_replica)
+
+    def pod_metrics(self, kind: str, namespace: str, name: str) -> List[Dict[str, Any]]:
+        """metrics.k8s.io-style PodMetrics for one workload's READY replicas:
+        [{"name": pod, "usage": {"cpu": milli, "memory": milli}}].  Usage is
+        the driven load (set_load) or 10% of request when idle."""
+        obj = self.get(kind, namespace, name)
+        if obj is None or not self.healthy:
+            return []
+        ready = self.admission_plan().get((kind, namespace, name), 0)
+        req = self._workload_request(obj.manifest)
+        load = self.load.get((kind, namespace, name))
+        if load is None:
+            load = {k: v // 10 for k, v in req.items()}
+        return [
+            {"name": f"{name}-{i}", "usage": dict(load), "request": dict(req)}
+            for i in range(ready)
+        ]
 
     def tick(self) -> None:
         """Advance every applied workload's status toward ready, capped by

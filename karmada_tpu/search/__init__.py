@@ -1,0 +1,12 @@
+from karmada_tpu.search.cache import CACHED_FROM_ANNOTATION, MultiClusterCache
+from karmada_tpu.search.proxy import ClusterProxy, ProxyDenied, UnifiedAuthController
+from karmada_tpu.search.metrics_adapter import MultiClusterMetricsProvider
+
+__all__ = [
+    "CACHED_FROM_ANNOTATION",
+    "MultiClusterCache",
+    "ClusterProxy",
+    "ProxyDenied",
+    "UnifiedAuthController",
+    "MultiClusterMetricsProvider",
+]

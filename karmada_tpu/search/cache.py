@@ -1,0 +1,158 @@
+"""karmada-search: ResourceRegistry-driven multi-cluster resource cache.
+
+Reference: pkg/search/proxy/store/multi_cluster_cache.go (fan-in cache) +
+pkg/search/controller.go:79-248 (registry controller building per-cluster
+informers for the selected GVKs).
+
+Design: each ResourceRegistry selects (clusters x kinds); the cache
+subscribes to every selected member store's watch bus (the framework's
+informer equivalent) and maintains a fan-in index keyed by
+(kind, cluster, namespace, name).  get/list/watch answer from the index
+without touching members; entries carry the origin cluster in the
+`resource.karmada.io/cached-from-cluster` annotation exactly like the
+reference proxy does.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from karmada_tpu.models.cluster import Cluster
+from karmada_tpu.models.search import ResourceRegistry
+from karmada_tpu.models.unstructured import Unstructured
+from karmada_tpu.store.store import DELETED, Event, ObjectStore
+from karmada_tpu.store.worker import AsyncWorker, Runtime
+
+CACHED_FROM_ANNOTATION = "resource.karmada.io/cached-from-cluster"
+
+
+class MultiClusterCache:
+    """Fan-in cache + the registry controller driving it."""
+
+    def __init__(self, store: ObjectStore, runtime: Runtime, members) -> None:
+        self.store = store
+        self.members = members  # name -> FakeMemberCluster
+        # (kind, cluster, namespace, name) -> Unstructured (deep copies)
+        self._index: Dict[Tuple[str, str, str, str], Unstructured] = {}
+        self._lock = threading.Lock()
+        # (cluster, kind) -> refcount of registries selecting it
+        self._selected: Dict[Tuple[str, str], int] = {}
+        self._synced: set = set()  # pairs whose initial list completed
+        self._subscribed: set = set()  # clusters whose bus we watch
+        self._watchers: List[Callable[[str, Unstructured, str], None]] = []
+        self.worker = runtime.register(AsyncWorker("search-cache", self._reconcile))
+        store.bus.subscribe(self._on_event, kind=ResourceRegistry.KIND)
+        store.bus.subscribe(self._on_cluster_event, kind=Cluster.KIND)
+
+    # -- registry reconciliation -------------------------------------------
+    def _on_event(self, event: Event) -> None:
+        self.worker.enqueue(("sync",))
+
+    def _on_cluster_event(self, event: Event) -> None:
+        self.worker.enqueue(("sync",))
+
+    def _reconcile(self, key) -> None:
+        """Recompute the (cluster, kind) selection set from all registries
+        and (re)build the index for newly selected pairs."""
+        clusters = self.store.list(Cluster.KIND)
+        selected: Dict[Tuple[str, str], int] = {}
+        for reg in self.store.list(ResourceRegistry.KIND):
+            if reg.metadata.deleting:
+                continue
+            targets = [
+                c.name for c in clusters
+                if reg.spec.target_cluster.matches(c)
+            ]
+            for sel in reg.spec.resource_selectors:
+                for cname in targets:
+                    k = (cname, sel.kind)
+                    selected[k] = selected.get(k, 0) + 1
+        with self._lock:
+            dropped = set(self._selected) - set(selected)
+            self._selected = selected
+            self._synced -= dropped
+            # purge entries for no-longer-selected pairs
+            for (cname, kind) in dropped:
+                for ikey in [k for k in self._index
+                             if k[0] == kind and k[1] == cname]:
+                    del self._index[ikey]
+            pending = set(selected) - self._synced
+        # subscribe to member buses (once per cluster) + resync only pairs
+        # not yet synced — already-watched pairs stay current through the
+        # bus, and re-upserting them would fire phantom watch events.
+        # (pairs whose member is unreachable stay pending and retry on the
+        # next cluster event)
+        for (cname, kind) in pending:
+            member = self.members.get(cname)
+            if member is None:
+                continue
+            if cname not in self._subscribed:
+                self._subscribed.add(cname)
+                member.store.bus.subscribe(self._member_event(cname))
+            for obj in member.store.list(kind):
+                self._upsert(cname, obj)
+            with self._lock:
+                self._synced.add((cname, kind))
+
+    # -- member informers ---------------------------------------------------
+    def _member_event(self, cname: str):
+        def handler(event: Event) -> None:
+            obj = event.obj
+            if not isinstance(obj, Unstructured):
+                return
+            with self._lock:
+                if (cname, obj.KIND) not in self._selected:
+                    return
+            if event.type == DELETED:
+                self._remove(cname, obj)
+            else:
+                self._upsert(cname, obj)
+        return handler
+
+    def _upsert(self, cname: str, obj) -> None:
+        if not isinstance(obj, Unstructured):
+            return
+        cached = copy.deepcopy(obj)
+        cached.metadata.annotations[CACHED_FROM_ANNOTATION] = cname
+        cached.manifest.setdefault("metadata", {}).setdefault(
+            "annotations", {}
+        )[CACHED_FROM_ANNOTATION] = cname
+        with self._lock:
+            self._index[(obj.KIND, cname, obj.namespace, obj.name)] = cached
+        for w in list(self._watchers):
+            w("UPSERT", cached, cname)
+
+    def _remove(self, cname: str, obj) -> None:
+        with self._lock:
+            self._index.pop((obj.KIND, cname, obj.namespace, obj.name), None)
+        for w in list(self._watchers):
+            w("DELETE", obj, cname)
+
+    # -- query surface (get/list/watch fan-in) ------------------------------
+    def get(self, kind: str, namespace: str, name: str,
+            cluster: Optional[str] = None) -> Optional[Unstructured]:
+        """First match across clusters (or the named cluster's entry)."""
+        with self._lock:
+            if cluster is not None:
+                return copy.deepcopy(self._index.get((kind, cluster, namespace, name)))
+            for (k, c, ns, n), obj in sorted(self._index.items()):
+                if k == kind and ns == namespace and n == name:
+                    return copy.deepcopy(obj)
+        return None
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             cluster: Optional[str] = None) -> List[Unstructured]:
+        with self._lock:
+            return [
+                copy.deepcopy(o)
+                for (k, c, ns, _), o in sorted(self._index.items())
+                if k == kind
+                and (namespace is None or ns == namespace)
+                and (cluster is None or c == cluster)
+            ]
+
+    def watch(self, handler: Callable[[str, Unstructured, str], None]) -> None:
+        """handler(event_type, obj, cluster) on every cached change."""
+        self._watchers.append(handler)

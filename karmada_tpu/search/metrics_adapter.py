@@ -1,0 +1,48 @@
+"""Multi-cluster metrics provider (karmada-metrics-adapter).
+
+Reference: pkg/metricsadapter/provider/{resourcemetrics,custommetrics,
+externalmetrics}.go — implements metrics.k8s.io / custom.metrics.k8s.io /
+external.metrics.k8s.io by querying every relevant member cluster and
+merging.  The FederatedHPA controller consumes this exact surface.
+
+Here the provider fans out to the member simulators' pod-metrics endpoints
+and merges, keeping the reference's shape: a list of per-pod samples with
+usage + request, tagged with the origin cluster.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class MultiClusterMetricsProvider:
+    def __init__(self, members) -> None:
+        self.members = members  # name -> FakeMemberCluster
+        # external metric series: name -> value (pluggable for tests)
+        self.external: Dict[str, float] = {}
+
+    def pod_metrics(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        clusters: Optional[List[str]] = None,
+    ) -> List[dict]:
+        """Merged PodMetrics for a workload across `clusters` (default all):
+        [{"name", "cluster", "usage": {res: milli}, "request": {res: milli}}]
+        (resourcemetrics.go GetPodMetrics fan-out + merge)."""
+        out: List[dict] = []
+        targets = clusters if clusters is not None else list(self.members)
+        for cname in targets:
+            member = self.members.get(cname)
+            if member is None or not member.healthy:
+                continue
+            for pm in member.pod_metrics(kind, namespace, name):
+                sample = dict(pm)
+                sample["cluster"] = cname
+                out.append(sample)
+        return out
+
+    def external_metric(self, name: str) -> Optional[float]:
+        """externalmetrics.go GetExternalMetric (test-pluggable series)."""
+        return self.external.get(name)

@@ -1,0 +1,113 @@
+"""Cluster proxy + unified auth.
+
+Reference: the aggregated apiserver's `clusters/{name}/proxy` passthrough
+(pkg/registry/cluster/storage/proxy.go:73 Connect) forwards requests to the
+member API server, and the unified-auth controller
+(pkg/controllers/unifiedauth/unified_auth_controller.go:69) syncs RBAC into
+every member so control-plane subjects are authorized there.
+
+Here the proxy hands out a per-cluster handle over the member's store with
+the same verbs (get/list/apply/delete), gated by the subjects unified-auth
+has synced into that member.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from karmada_tpu.models.cluster import Cluster
+from karmada_tpu.models.unstructured import Unstructured
+from karmada_tpu.store.store import Event, ObjectStore
+from karmada_tpu.store.worker import AsyncWorker, Runtime
+
+# the RBAC object unified-auth maintains inside each member cluster
+IMPERSONATION_RBAC_NAME = "karmada-impersonator"
+
+
+class ProxyDenied(Exception):
+    """Subject not authorized on the target cluster (no synced RBAC)."""
+
+
+class UnifiedAuthController:
+    """Syncs the impersonation ClusterRole/Binding into every member
+    (unified_auth_controller.go:69): subjects granted cluster-proxy access
+    on the control plane become usable through the proxy on every cluster."""
+
+    def __init__(self, store: ObjectStore, runtime: Runtime, members) -> None:
+        self.store = store
+        self.members = members
+        self.subjects: List[str] = ["system:admin"]
+        self.worker = runtime.register(AsyncWorker("unified-auth", self._reconcile))
+        store.bus.subscribe(self._on_cluster, kind=Cluster.KIND)
+
+    def grant(self, subject: str) -> None:
+        if subject not in self.subjects:
+            self.subjects.append(subject)
+        for c in self.store.list(Cluster.KIND):
+            self.worker.enqueue(c.name)
+
+    def _on_cluster(self, event: Event) -> None:
+        self.worker.enqueue(event.obj.name)
+
+    def _reconcile(self, cluster_name: str) -> None:
+        member = self.members.get(cluster_name)
+        if member is None:
+            return
+        member.apply({
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": IMPERSONATION_RBAC_NAME, "namespace": ""},
+            "subjects": [{"kind": "User", "name": s} for s in self.subjects],
+            "roleRef": {"kind": "ClusterRole", "name": IMPERSONATION_RBAC_NAME},
+        })
+
+
+class ClusterProxy:
+    """`ControlPlane.proxy(cluster)`-style handle (proxy.go:73 Connect)."""
+
+    def __init__(self, store: ObjectStore, members, auth: Optional[UnifiedAuthController] = None) -> None:
+        self.store = store
+        self.members = members
+        self.auth = auth
+
+    def connect(self, cluster: str, subject: str = "system:admin") -> "ProxyHandle":
+        if self.store.try_get(Cluster.KIND, "", cluster) is None:
+            raise ProxyDenied(f"unknown cluster {cluster!r}")
+        member = self.members.get(cluster)
+        if member is None:
+            raise ProxyDenied(f"cluster {cluster!r} has no reachable endpoint")
+        if self.auth is not None:
+            rbac = member.get("ClusterRoleBinding", "", IMPERSONATION_RBAC_NAME)
+            allowed = [
+                s.get("name")
+                for s in (rbac.manifest.get("subjects") or [])
+            ] if rbac is not None else []
+            if subject not in allowed:
+                raise ProxyDenied(
+                    f"subject {subject!r} not authorized on {cluster!r} "
+                    "(unified auth not synced)"
+                )
+        return ProxyHandle(cluster, member)
+
+
+class ProxyHandle:
+    """The member's API surface, reached through the control plane."""
+
+    def __init__(self, cluster: str, member) -> None:
+        self.cluster = cluster
+        self._member = member
+
+    def get(self, kind: str, namespace: str, name: str) -> Optional[Unstructured]:
+        return self._member.get(kind, namespace, name)
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[Unstructured]:
+        return [
+            o for o in self._member.store.list(kind, namespace)
+            if isinstance(o, Unstructured)
+        ]
+
+    def apply(self, manifest: Dict[str, Any]) -> Unstructured:
+        return self._member.apply(manifest)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._member.delete(kind, namespace, name)

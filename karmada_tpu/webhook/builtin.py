@@ -97,14 +97,17 @@ def validate_frq(op, q, old) -> Optional[str]:
     for name, qty in q.spec.overall.items():
         if qty.milli < 0:
             return f"overall[{name}] must be non-negative"
+    summed: Dict[str, int] = {}
     for sa in q.spec.static_assignments:
         for name, qty in sa.hard.items():
             if qty.milli < 0:
                 return f"staticAssignments[{sa.cluster_name}][{name}] must be non-negative"
-            if name in q.spec.overall and qty.milli > q.spec.overall[name].milli:
-                return (
-                    f"staticAssignments[{sa.cluster_name}][{name}] exceeds overall"
-                )
+            summed[name] = summed.get(name, 0) + qty.milli
+    # the SUM of the static split must stay within overall, or the object
+    # distributes more hard quota than it guarantees
+    for name, total in summed.items():
+        if name in q.spec.overall and total > q.spec.overall[name].milli:
+            return f"staticAssignments sum for {name} exceeds overall"
     return None
 
 

@@ -1,0 +1,274 @@
+"""FederatedHPA + CronFederatedHPA end to end.
+
+Reference: pkg/controllers/federatedhpa/federatedhpa_controller.go:141-995,
+replica_calculator.go, cronfederatedhpa/cronfederatedhpa_controller.go:58.
+
+The closed loop under test: member load changes -> metrics provider merges
+pod samples across the workload's target clusters -> replica calculator ->
+template spec.replicas -> detector refreshes the binding -> scheduler
+redistributes.
+"""
+
+import pytest
+
+from karmada_tpu.controllers.federatedhpa import (
+    RETAIN_REPLICAS_LABEL,
+    cron_matches,
+)
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.autoscaling import (
+    CronFederatedHPA,
+    CronFederatedHPARule,
+    CronFederatedHPASpec,
+    CrossVersionObjectReference,
+    FederatedHPA,
+    FederatedHPASpec,
+    HPABehavior,
+    HPAScalingPolicy,
+    HPAScalingRules,
+    MetricSpec,
+    MetricTarget,
+    ResourceMetricSource,
+)
+from karmada_tpu.models.meta import ObjectMeta, deep_get
+from karmada_tpu.models.policy import (
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+    ClusterPreferences,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ReplicaSchedulingStrategy,
+    ResourceSelector,
+)
+from karmada_tpu.models.work import ResourceBinding
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def deployment(replicas=4):
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"replicas": replicas, "template": {"spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "100m",
+                                                     "memory": "1Gi"}}}]}}},
+    }
+
+
+def policy():
+    return PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="apps/v1", kind="Deployment")
+            ],
+            placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+            )),
+        ),
+    )
+
+
+def hpa(min_r=2, max_r=10, target_util=50, behavior=None):
+    return FederatedHPA(
+        metadata=ObjectMeta(name="web-hpa", namespace="default"),
+        spec=FederatedHPASpec(
+            scale_target_ref=CrossVersionObjectReference(
+                api_version="apps/v1", kind="Deployment", name="web"),
+            min_replicas=min_r,
+            max_replicas=max_r,
+            metrics=[MetricSpec(resource=ResourceMetricSource(
+                name="cpu",
+                target=MetricTarget(type="Utilization", average_utilization=target_util),
+            ))],
+            behavior=behavior,
+        ),
+    )
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    cp = ControlPlane(backend="serial", clock=clock)
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.add_member("m2", cpu_milli=64_000)
+    cp.tick()
+    cp.store.create(policy())
+    cp.apply(deployment())
+    # steady state: 50m usage on a 100m request == exactly the 50% target,
+    # so the HPA holds the initial 4 replicas until a test drives the load
+    for m in cp.members.values():
+        m.set_load("Deployment", "default", "web", {"cpu": 50})
+    cp.store.create(hpa())
+    cp.tick()
+    assert template_replicas(cp) == 4
+    return cp, clock
+
+
+def set_load_everywhere(cp, cpu):
+    for m in cp.members.values():
+        m.set_load("Deployment", "default", "web", {"cpu": cpu})
+
+
+def template_replicas(cp):
+    obj = cp.store.get("Deployment", "default", "web")
+    return int(deep_get(obj.manifest, "spec.replicas", 0))
+
+
+def test_scale_up_on_load_then_down_when_idle(env):
+    cp, clock = env
+    # 90m usage on a 100m request vs 50% target -> ratio 1.8 -> scale up
+    set_load_everywhere(cp, 90)
+    cp.tick()
+    up = template_replicas(cp)
+    assert up > 4, f"expected scale-up, got {up}"
+    # binding follows (detector + scheduler closed the loop)
+    rb = cp.store.get(ResourceBinding.KIND, "default", "web-deployment")
+    assert sum(tc.replicas for tc in rb.spec.clusters) == up
+
+    # drop to idle; the 300s down-stabilization window must hold first
+    set_load_everywhere(cp, 10)
+    cp.tick()
+    assert template_replicas(cp) == up, "scaled down inside stabilization window"
+    clock.advance(400)
+    cp.tick()
+    cp.tick()
+    down = template_replicas(cp)
+    assert down < up, f"expected scale-down after window, got {down}"
+    assert down >= 2
+
+
+def test_scale_respects_max_replicas(env):
+    cp, clock = env
+    set_load_everywhere(cp, 10_000)
+    cp.tick()
+    clock.advance(60)
+    cp.tick()
+    clock.advance(60)
+    cp.tick()
+    assert template_replicas(cp) <= 10
+    h = cp.store.get(FederatedHPA.KIND, "default", "web-hpa")
+    assert h.status.desired_replicas <= 10
+
+
+def test_tolerance_holds_steady(env):
+    cp, clock = env
+    # 52m vs 50% of 100m target: ratio 1.04, inside the 10% tolerance
+    set_load_everywhere(cp, 52)
+    cp.tick()
+    assert template_replicas(cp) == 4
+
+
+def test_behavior_pods_policy_limits_step(env):
+    cp, clock = env
+    b = HPABehavior(scale_up=HPAScalingRules(
+        stabilization_window_seconds=0,
+        policies=[HPAScalingPolicy(type="Pods", value=1, period_seconds=60)],
+    ))
+    def set_behavior(h):
+        h.spec.behavior = b
+    cp.store.mutate(FederatedHPA.KIND, "default", "web-hpa", set_behavior)
+    set_load_everywhere(cp, 10_000)
+    cp.tick()
+    assert template_replicas(cp) == 5  # one pod per step
+
+
+def test_scale_target_marker_labels_template(env):
+    """Propagating a NATIVE HorizontalPodAutoscaler marks its scale target
+    with retain-replicas, so members keep their own replica counts."""
+    cp, _ = env
+    assert cp.store.get("Deployment", "default", "web").metadata.labels.get(
+        RETAIN_REPLICAS_LABEL) is None  # FederatedHPA path: unmarked
+    cp.apply({
+        "apiVersion": "autoscaling/v2", "kind": "HorizontalPodAutoscaler",
+        "metadata": {"name": "web-native-hpa", "namespace": "default"},
+        "spec": {"scaleTargetRef": {"apiVersion": "apps/v1",
+                                    "kind": "Deployment", "name": "web"},
+                 "minReplicas": 1, "maxReplicas": 10},
+    })
+    cp.tick()
+    obj = cp.store.get("Deployment", "default", "web")
+    assert obj.metadata.labels.get(RETAIN_REPLICAS_LABEL) == "true"
+
+
+def test_cron_matches_basics():
+    import time as _t
+    # 2026-01-05 is a Monday; 10:30 local
+    ts = _t.mktime((2026, 1, 5, 10, 30, 0, 0, 0, -1))
+    assert cron_matches("30 10 * * *", ts)
+    assert cron_matches("*/15 * * * *", ts)
+    assert cron_matches("30 10 5 1 1", ts)
+    assert not cron_matches("31 10 * * *", ts)
+    assert not cron_matches("30 10 * * 0", ts)
+
+
+def test_cron_scales_workload_on_schedule(env):
+    cp, clock = env
+    cp.store.create(CronFederatedHPA(
+        metadata=ObjectMeta(name="nightly", namespace="default"),
+        spec=CronFederatedHPASpec(
+            scale_target_ref=CrossVersionObjectReference(
+                api_version="apps/v1", kind="Deployment", name="web"),
+            rules=[CronFederatedHPARule(
+                name="every-minute", schedule="* * * * *", target_replicas=7)],
+        ),
+    ))
+    clock.advance(61)
+    cp.tick()
+    assert template_replicas(cp) == 7
+    cron = cp.store.get(CronFederatedHPA.KIND, "default", "nightly")
+    hist = {h.rule_name: h for h in cron.status.execution_histories}
+    assert hist["every-minute"].last_result == "Succeed"
+
+
+def test_cron_adjusts_fhpa_min_max(env):
+    cp, clock = env
+    cp.store.create(CronFederatedHPA(
+        metadata=ObjectMeta(name="window", namespace="default"),
+        spec=CronFederatedHPASpec(
+            scale_target_ref=CrossVersionObjectReference(
+                api_version="autoscaling.karmada.io/v1alpha1",
+                kind="FederatedHPA", name="web-hpa"),
+            rules=[CronFederatedHPARule(
+                name="biz-hours", schedule="* * * * *",
+                target_min_replicas=5, target_max_replicas=20)],
+        ),
+    ))
+    clock.advance(61)
+    cp.tick()
+    h = cp.store.get(FederatedHPA.KIND, "default", "web-hpa")
+    assert (h.spec.min_replicas, h.spec.max_replicas) == (5, 20)
+    # min is enforced on the next HPA pass even when idle
+    cp.tick()
+    assert template_replicas(cp) >= 5
+
+
+def test_suspended_rule_does_not_fire(env):
+    cp, clock = env
+    cp.store.create(CronFederatedHPA(
+        metadata=ObjectMeta(name="paused", namespace="default"),
+        spec=CronFederatedHPASpec(
+            scale_target_ref=CrossVersionObjectReference(
+                api_version="apps/v1", kind="Deployment", name="web"),
+            rules=[CronFederatedHPARule(
+                name="noop", schedule="* * * * *", target_replicas=9,
+                suspend=True)],
+        ),
+    ))
+    clock.advance(61)
+    cp.tick()
+    assert template_replicas(cp) == 4

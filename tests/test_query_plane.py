@@ -1,0 +1,171 @@
+"""L5 query plane: multi-cluster cache, cluster proxy + unified auth,
+metrics provider.
+
+Reference: pkg/search/proxy/store/multi_cluster_cache.go,
+pkg/registry/cluster/storage/proxy.go:73,
+pkg/controllers/unifiedauth/unified_auth_controller.go:69,
+pkg/metricsadapter/provider/.
+"""
+
+import pytest
+
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.policy import (
+    REPLICA_SCHEDULING_DUPLICATED,
+    ClusterAffinity,
+    ObjectMeta,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ReplicaSchedulingStrategy,
+    ResourceSelector,
+)
+from karmada_tpu.models.search import (
+    ResourceRegistry,
+    ResourceRegistrySelector,
+    ResourceRegistrySpec,
+)
+from karmada_tpu.search import CACHED_FROM_ANNOTATION, ProxyDenied
+
+
+def deployment(name, ns="default", replicas=2):
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"replicas": replicas, "template": {"spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "100m",
+                                                     "memory": "1Gi"}}}]}}},
+    }
+
+
+def registry(clusters=None):
+    return ResourceRegistry(
+        metadata=ObjectMeta(name="all-deployments"),
+        spec=ResourceRegistrySpec(
+            target_cluster=ClusterAffinity(cluster_names=clusters or []),
+            resource_selectors=[
+                ResourceRegistrySelector(api_version="apps/v1", kind="Deployment")
+            ],
+        ),
+    )
+
+
+def dup_policy():
+    return PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="apps/v1", kind="Deployment")
+            ],
+            placement=Placement(
+                replica_scheduling=ReplicaSchedulingStrategy(
+                    replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED
+                )
+            ),
+        ),
+    )
+
+
+@pytest.fixture
+def cp():
+    plane = ControlPlane(backend="serial")
+    for m in ("m1", "m2", "m3"):
+        plane.add_member(m, cpu_milli=64_000)
+    plane.tick()
+    return plane
+
+
+def test_cache_fans_in_from_selected_clusters(cp):
+    cp.store.create(registry())
+    # propagate a deployment to all three members through the real pipeline
+    cp.store.create(dup_policy())
+    cp.apply(deployment("web"))
+    cp.tick()
+    entries = cp.search_cache.list("Deployment", "default")
+    clusters = {e.metadata.annotations[CACHED_FROM_ANNOTATION] for e in entries}
+    assert clusters == {"m1", "m2", "m3"}
+    got = cp.search_cache.get("Deployment", "default", "web", cluster="m2")
+    assert got is not None and got.manifest["spec"]["replicas"] == 2
+
+
+def test_cache_respects_registry_target_clusters(cp):
+    cp.store.create(registry(clusters=["m1"]))
+    cp.store.create(dup_policy())
+    cp.apply(deployment("web"))
+    cp.tick()
+    clusters = {
+        e.metadata.annotations[CACHED_FROM_ANNOTATION]
+        for e in cp.search_cache.list("Deployment")
+    }
+    assert clusters == {"m1"}
+
+
+def test_cache_drops_on_member_delete(cp):
+    cp.store.create(registry())
+    cp.tick()
+    # applied directly on the member (not via a Work, which the work-status
+    # controller would heal by recreating)
+    cp.members["m1"].apply(deployment("local-only"))
+    assert len(cp.search_cache.list("Deployment")) == 1
+    cp.members["m1"].delete("Deployment", "default", "local-only")
+    assert cp.search_cache.list("Deployment") == []
+
+
+def test_cache_watch_streams_changes(cp):
+    cp.store.create(registry())
+    cp.tick()
+    seen = []
+    cp.search_cache.watch(lambda t, obj, c: seen.append((t, obj.name, c)))
+    cp.members["m2"].apply(deployment("direct"))
+    assert ("UPSERT", "direct", "m2") in seen
+
+
+def test_proxy_roundtrip_with_unified_auth(cp):
+    cp.tick()  # unified-auth sync
+    handle = cp.proxy("m1")
+    handle.apply(deployment("via-proxy"))
+    assert cp.members["m1"].get("Deployment", "default", "via-proxy") is not None
+    assert handle.get("Deployment", "default", "via-proxy") is not None
+
+
+def test_proxy_denies_unknown_subject(cp):
+    cp.tick()
+    with pytest.raises(ProxyDenied, match="not authorized"):
+        cp.proxy("m1", subject="mallory")
+
+
+def test_proxy_grant_then_allowed(cp):
+    cp.tick()
+    cp.unified_auth.grant("alice")
+    cp.tick()
+    assert cp.proxy("m1", subject="alice").list("Deployment") == []
+
+
+def test_proxy_unknown_cluster(cp):
+    with pytest.raises(ProxyDenied, match="unknown cluster"):
+        cp.proxy("nope")
+
+
+def test_metrics_provider_merges_pods_across_clusters(cp):
+    cp.store.create(dup_policy())
+    cp.apply(deployment("web", replicas=3))
+    cp.tick()
+    cp.members["m1"].set_load("Deployment", "default", "web", {"cpu": 80})
+    samples = cp.metrics_provider.pod_metrics("Deployment", "default", "web")
+    by_cluster = {}
+    for s in samples:
+        by_cluster.setdefault(s["cluster"], []).append(s)
+    assert set(by_cluster) == {"m1", "m2", "m3"}
+    assert len(by_cluster["m1"]) == 3
+    assert by_cluster["m1"][0]["usage"]["cpu"] == 80
+    # idle default: 10% of the 100m request
+    assert by_cluster["m2"][0]["usage"]["cpu"] == 10
+
+
+def test_metrics_provider_skips_unhealthy(cp):
+    cp.store.create(dup_policy())
+    cp.apply(deployment("web"))
+    cp.tick()
+    cp.members["m3"].healthy = False
+    samples = cp.metrics_provider.pod_metrics("Deployment", "default", "web")
+    assert {s["cluster"] for s in samples} == {"m1", "m2"}
