@@ -45,6 +45,7 @@ FRAMEWORK_KINDS = {
     "ClusterResourceBinding", "Work", "FederatedResourceQuota",
     "WorkloadRebalancer", "FederatedHPA", "CronFederatedHPA", "Remedy",
     "ClusterTaintPolicy", "MultiClusterService", "ResourceRegistry",
+    "ResourceInterpreterCustomization",
 }
 
 

@@ -41,8 +41,7 @@ from karmada_tpu.models.work import ResourceBinding
 from karmada_tpu.store.store import Event, NotFoundError, ObjectStore
 from karmada_tpu.store.worker import AsyncWorker, Runtime
 
-# labels (reference pkg/util/constants)
-RETAIN_REPLICAS_LABEL = "resourcetemplate.karmada.io/retain-replicas"
+from karmada_tpu.utils.constants import RETAIN_REPLICAS_LABEL
 
 TOLERANCE = 0.1  # replica_calculator.go tolerance
 
@@ -329,8 +328,13 @@ class CronFederatedHPAController:
 
     def _sync(self, cron: CronFederatedHPA, now: float) -> None:
         key = (cron.namespace, cron.name)
-        last = self._last_check.get(key, now - 60)
+        last = self._last_check.get(key)
         self._last_check[key] = now
+        if last is None:
+            # first observation: schedule only FUTURE fire times (the
+            # reference's cron library never fires slots that predate
+            # registration)
+            return
         fired: Dict[str, Tuple[float, str, str]] = {}
         for rule in cron.spec.rules:
             if rule.suspend:
@@ -399,29 +403,52 @@ class HpaScaleTargetMarker:
         self.worker = runtime.register(AsyncWorker("hpa-marker", self._reconcile))
         store.bus.subscribe(self._on_event, kind="HorizontalPodAutoscaler")
 
-    def _on_event(self, event: Event) -> None:
-        hpa = event.obj
+    @staticmethod
+    def _ref_of(hpa) -> Optional[Tuple[str, str]]:
         ref = deep_get(hpa.manifest, "spec.scaleTargetRef", {}) or {}
         if not ref.get("kind") or not ref.get("name"):
-            return
-        self.worker.enqueue(
-            (hpa.namespace, ref["kind"], ref["name"], event.type == "DELETED")
-        )
+            return None
+        return (ref["kind"], ref["name"])
+
+    def _on_event(self, event: Event) -> None:
+        hpa = event.obj
+        if event.type == "DELETED":
+            refs = {self._ref_of(hpa)}
+        else:
+            # retargeting an HPA must also UNMARK the previous target, or
+            # the stale label keeps member replicas authoritative with no
+            # HPA left in control
+            refs = {self._ref_of(hpa),
+                    self._ref_of(event.old) if event.old is not None else None}
+        for ref in refs:
+            if ref is not None:
+                self.worker.enqueue((hpa.namespace,) + ref)
+
+    def _still_targeted(self, ns: str, kind: str, name: str) -> bool:
+        for hpa in self.store.list("HorizontalPodAutoscaler", ns):
+            if hpa.metadata.deleting:
+                continue
+            if self._ref_of(hpa) == (kind, name):
+                return True
+        return False
 
     def _reconcile(self, key) -> None:
-        ns, kind, name, removed = key
+        ns, kind, name = key
         obj = self.store.try_get(kind, ns, name)
         if obj is None:
             return
+        # the label reflects whether ANY live HPA targets the object —
+        # deleting one of two HPAs sharing a target must not unmark it
+        want = self._still_targeted(ns, kind, name)
 
         def mark(o) -> None:
             labels = o.manifest.setdefault("metadata", {}).setdefault("labels", {})
-            if removed:
-                labels.pop(RETAIN_REPLICAS_LABEL, None)
-                o.metadata.labels.pop(RETAIN_REPLICAS_LABEL, None)
-            else:
+            if want:
                 labels[RETAIN_REPLICAS_LABEL] = "true"
                 o.metadata.labels[RETAIN_REPLICAS_LABEL] = "true"
+            else:
+                labels.pop(RETAIN_REPLICAS_LABEL, None)
+                o.metadata.labels.pop(RETAIN_REPLICAS_LABEL, None)
         self.store.mutate(kind, ns, name, mark)
 
 

@@ -1,0 +1,282 @@
+"""Multi-cluster service discovery (MCS).
+
+Reference controllers:
+  * MCSController (pkg/controllers/multiclusterservice/mcs_controller.go:71)
+    — propagates the referenced Service to provider + consumer clusters via
+    Works when a MultiClusterService exists.
+  * EndpointSliceCollectController (endpointslice_collect_controller.go:87)
+    — watches provider members' EndpointSlices for exported services and
+    reports them UP into the control plane (cluster-tagged).
+  * EndpointsliceDispatchController (endpointslice_dispatch_controller.go:68)
+    — dispatches the collected provider slices DOWN to consumer clusters
+    via Works, renamed per origin cluster so consumers resolve endpoints.
+  * ServiceExportController (pkg/controllers/mcs/service_export_controller.go:103)
+    — the mcs.k8s.io flavor: a propagated ServiceExport marks a service for
+    collection the same way.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+from karmada_tpu.controllers.binding import execution_namespace
+from karmada_tpu.models.meta import deep_get
+from karmada_tpu.models.networking import (
+    EXPOSURE_CROSS_CLUSTER,
+    MultiClusterService,
+    ServiceExport,
+)
+from karmada_tpu.models.unstructured import Unstructured
+from karmada_tpu.models.work import Work, WorkSpec
+from karmada_tpu.store.store import DELETED, Event, NotFoundError, ObjectStore
+from karmada_tpu.store.worker import AsyncWorker, Runtime
+
+# annotations/labels on collected + dispatched slices (reference constants)
+SERVICE_NAME_LABEL = "kubernetes.io/service-name"
+ORIGIN_CLUSTER_ANNOTATION = "endpointslice.karmada.io/origin-cluster"
+MANAGED_BY_ANNOTATION = "endpointslice.karmada.io/managed-by"
+WORK_PREFIX = "mcs"
+
+
+def _collected_name(cluster: str, ns: str, name: str) -> str:
+    """Cluster-qualified upward name.  A short hash disambiguates the
+    '-'-joined parts (cluster 'a' + slice 'b-c' vs cluster 'a-b' + slice
+    'c' would otherwise collide and silently drop one provider's
+    endpoints)."""
+    from karmada_tpu.ops.webster import fnv32a
+
+    h = fnv32a(f"{cluster}/{ns}/{name}") & 0xFFFF
+    return f"imported-{cluster}-{name}-{h:04x}"
+
+
+class MultiClusterServiceController:
+    """MCS object -> Service Works on provider + consumer clusters."""
+
+    def __init__(self, store: ObjectStore, runtime: Runtime) -> None:
+        self.store = store
+        self.worker = runtime.register(AsyncWorker("mcs", self._reconcile))
+        store.bus.subscribe(self._on_event, kind=MultiClusterService.KIND)
+        store.bus.subscribe(self._on_service_event, kind="Service")
+
+    def _on_event(self, event: Event) -> None:
+        self.worker.enqueue((event.obj.namespace, event.obj.name))
+
+    def _on_service_event(self, event: Event) -> None:
+        self.worker.enqueue((event.obj.namespace, event.obj.name))
+
+    def _work_name(self, ns: str, name: str) -> str:
+        return f"{WORK_PREFIX}-service-{ns}-{name}"
+
+    def _target_clusters(self, mcs: MultiClusterService) -> List[str]:
+        from karmada_tpu.models.cluster import Cluster
+
+        all_clusters = [c.name for c in self.store.list(Cluster.KIND)]
+        providers = mcs.provider_names() or all_clusters
+        consumers = mcs.consumer_names() or all_clusters
+        # preserve order, dedupe
+        out: List[str] = []
+        for n in providers + consumers:
+            if n in all_clusters and n not in out:
+                out.append(n)
+        return out
+
+    def _reconcile(self, key) -> None:
+        ns, name = key
+        mcs = self.store.try_get(MultiClusterService.KIND, ns, name)
+        service = self.store.try_get("Service", ns, name)
+        work_name = self._work_name(ns, name)
+        from karmada_tpu.models.cluster import Cluster
+
+        if (
+            mcs is None or mcs.metadata.deleting
+            or EXPOSURE_CROSS_CLUSTER not in mcs.spec.types
+            or service is None
+        ):
+            for c in self.store.list(Cluster.KIND):
+                try:
+                    self.store.delete(Work.KIND, execution_namespace(c.name), work_name)
+                except NotFoundError:
+                    pass
+            return
+        assert isinstance(service, Unstructured)
+        manifest = copy.deepcopy(service.to_manifest())
+        targets = set(self._target_clusters(mcs))
+        for c in self.store.list(Cluster.KIND):
+            wns = execution_namespace(c.name)
+            if c.name not in targets:
+                try:
+                    self.store.delete(Work.KIND, wns, work_name)
+                except NotFoundError:
+                    pass
+                continue
+            existing = self.store.try_get(Work.KIND, wns, work_name)
+            if existing is None:
+                w = Work()
+                w.metadata.namespace = wns
+                w.metadata.name = work_name
+                w.spec = WorkSpec(workload=[manifest])
+                self.store.create(w)
+            else:
+                def update(w: Work) -> None:
+                    w.spec.workload = [manifest]
+                self.store.mutate(Work.KIND, wns, work_name, update)
+
+
+class EndpointSliceCollectController:
+    """Provider members' EndpointSlices -> control-plane (cluster-tagged).
+
+    Subscribes to each member's store (the per-cluster informer); slices
+    labeled kubernetes.io/service-name for a service exported by an MCS
+    (with that member as provider) or by a ServiceExport are reported up.
+    """
+
+    def __init__(self, store: ObjectStore, runtime: Runtime, members: Dict) -> None:
+        self.store = store
+        self.members = members
+        self.worker = runtime.register(
+            AsyncWorker("endpointslice-collect", self._reconcile)
+        )
+        self._subscribed: set = set()
+        for name in list(members):
+            self.watch_member(name)
+        # resync when exports change
+        store.bus.subscribe(self._on_export_event, kind=MultiClusterService.KIND)
+        store.bus.subscribe(self._on_export_event, kind=ServiceExport.KIND)
+
+    def watch_member(self, cluster: str) -> None:
+        if cluster in self._subscribed:
+            return
+        self._subscribed.add(cluster)
+        member = self.members[cluster]
+        member.store.bus.subscribe(self._member_event(cluster))
+        for obj in member.store.list("EndpointSlice"):
+            self.worker.enqueue((cluster, obj.namespace, obj.name, False))
+
+    def _member_event(self, cluster: str):
+        def handler(event: Event) -> None:
+            if event.obj.KIND != "EndpointSlice":
+                return
+            self.worker.enqueue(
+                (cluster, event.obj.namespace, event.obj.name,
+                 event.type == DELETED)
+            )
+        return handler
+
+    def _on_export_event(self, event: Event) -> None:
+        for cluster, member in self.members.items():
+            for obj in member.store.list("EndpointSlice"):
+                self.worker.enqueue((cluster, obj.namespace, obj.name, False))
+
+    def _exported(self, cluster: str, ns: str, service: str) -> bool:
+        mcs = self.store.try_get(MultiClusterService.KIND, ns, service)
+        if mcs is not None and not mcs.metadata.deleting:
+            providers = mcs.provider_names()
+            if not providers or cluster in providers:
+                return True
+        exp = self.store.try_get(ServiceExport.KIND, ns, service)
+        return exp is not None and not exp.metadata.deleting
+
+    def _reconcile(self, key) -> None:
+        cluster, ns, name, deleted = key
+        collected = _collected_name(cluster, ns, name)
+        member = self.members.get(cluster)
+        obj = None if (deleted or member is None) else member.get("EndpointSlice", ns, name)
+        service = ""
+        if obj is not None:
+            # never re-collect a slice this framework dispatched INTO the
+            # member: that would bounce slices between collect and dispatch
+            # forever (each round minting a new imported-... name)
+            annotations = deep_get(obj.manifest, "metadata.annotations", {}) or {}
+            if MANAGED_BY_ANNOTATION in annotations:
+                return
+            service = deep_get(obj.manifest, "metadata.labels", {}).get(
+                SERVICE_NAME_LABEL, "")
+        if obj is None or not service or not self._exported(cluster, ns, service):
+            try:
+                self.store.delete("EndpointSlice", ns, collected)
+            except NotFoundError:
+                pass
+            return
+        manifest = copy.deepcopy(obj.to_manifest())
+        manifest.setdefault("metadata", {})["name"] = collected
+        md = manifest["metadata"]
+        md.setdefault("labels", {})[SERVICE_NAME_LABEL] = service
+        md.setdefault("annotations", {})[ORIGIN_CLUSTER_ANNOTATION] = cluster
+        md["annotations"][MANAGED_BY_ANNOTATION] = "karmada-tpu"
+        reported = Unstructured.from_manifest(manifest)
+        existing = self.store.try_get("EndpointSlice", ns, collected)
+        if existing is None:
+            self.store.create(reported)
+        else:
+            def update(o) -> None:
+                o.manifest = copy.deepcopy(manifest)
+                o.metadata.labels = dict(md.get("labels", {}))
+                o.metadata.annotations = dict(md.get("annotations", {}))
+            self.store.mutate("EndpointSlice", ns, collected, update)
+
+
+class EndpointSliceDispatchController:
+    """Collected provider slices -> Works on consumer clusters (excluding
+    the origin cluster), so a consumer's resolver sees remote endpoints."""
+
+    def __init__(self, store: ObjectStore, runtime: Runtime) -> None:
+        self.store = store
+        self.worker = runtime.register(
+            AsyncWorker("endpointslice-dispatch", self._reconcile)
+        )
+        store.bus.subscribe(self._on_slice_event, kind="EndpointSlice")
+        store.bus.subscribe(self._on_mcs_event, kind=MultiClusterService.KIND)
+
+    def _on_slice_event(self, event: Event) -> None:
+        self.worker.enqueue((event.obj.namespace, event.obj.name))
+
+    def _on_mcs_event(self, event: Event) -> None:
+        ns = event.obj.namespace
+        for obj in self.store.list("EndpointSlice", ns):
+            self.worker.enqueue((ns, obj.name))
+
+    def _work_name(self, ns: str, slice_name: str) -> str:
+        return f"{WORK_PREFIX}-eps-{ns}-{slice_name}"
+
+    def _reconcile(self, key) -> None:
+        ns, name = key
+        from karmada_tpu.models.cluster import Cluster
+
+        obj = self.store.try_get("EndpointSlice", ns, name)
+        work_name = self._work_name(ns, name)
+        origin = ""
+        service = ""
+        consumers: List[str] = []
+        if obj is not None and not obj.metadata.deleting:
+            origin = obj.metadata.annotations.get(ORIGIN_CLUSTER_ANNOTATION, "")
+            service = obj.metadata.labels.get(SERVICE_NAME_LABEL, "")
+        ok = bool(origin and service)
+        if ok:
+            mcs = self.store.try_get(MultiClusterService.KIND, ns, service)
+            if mcs is None or mcs.metadata.deleting:
+                ok = False
+            else:
+                all_clusters = [c.name for c in self.store.list(Cluster.KIND)]
+                consumers = mcs.consumer_names() or all_clusters
+        for c in self.store.list(Cluster.KIND):
+            wns = execution_namespace(c.name)
+            want = ok and c.name in consumers and c.name != origin
+            if not want:
+                try:
+                    self.store.delete(Work.KIND, wns, work_name)
+                except NotFoundError:
+                    pass
+                continue
+            manifest = copy.deepcopy(obj.to_manifest())
+            existing = self.store.try_get(Work.KIND, wns, work_name)
+            if existing is None:
+                w = Work()
+                w.metadata.namespace = wns
+                w.metadata.name = work_name
+                w.spec = WorkSpec(workload=[manifest])
+                self.store.create(w)
+            else:
+                def update(w: Work) -> None:
+                    w.spec.workload = [manifest]
+                self.store.mutate(Work.KIND, wns, work_name, update)

@@ -16,6 +16,7 @@ Usage:
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 from karmada_tpu.controllers.binding import BindingController
@@ -71,6 +72,7 @@ class ControlPlane:
         self.runtime = Runtime()
         self.members: Dict[str, FakeMemberCluster] = {}
         self.interpreter = ResourceInterpreter()
+        self.interpreter.attach_store(self.store)
         self.recorder = EventRecorder()
         self.detector = ResourceDetector(self.store, self.runtime, self.interpreter)
         self.scheduler = Scheduler(self.store, self.runtime, backend=backend,
@@ -134,7 +136,7 @@ class ControlPlane:
             HpaScaleTargetMarker,
         )
 
-        self.clock = clock if clock is not None else __import__("time").time
+        self.clock = clock if clock is not None else time.time
         self.federated_hpa = FederatedHPAController(
             self.store, self.runtime, self.metrics_provider, clock=self.clock
         )
@@ -143,6 +145,18 @@ class ControlPlane:
         )
         self.hpa_marker = HpaScaleTargetMarker(self.store, self.runtime)
         self.replicas_syncer = DeploymentReplicasSyncer(self.store, self.runtime)
+        # MCS slice: service propagation + endpoint-slice collect/dispatch
+        from karmada_tpu.controllers.mcs import (
+            EndpointSliceCollectController,
+            EndpointSliceDispatchController,
+            MultiClusterServiceController,
+        )
+
+        self.mcs = MultiClusterServiceController(self.store, self.runtime)
+        self.eps_collect = EndpointSliceCollectController(
+            self.store, self.runtime, self.members
+        )
+        self.eps_dispatch = EndpointSliceDispatchController(self.store, self.runtime)
         self.rebalancer = WorkloadRebalancerController(self.store, self.runtime)
         self.taint_policies = ClusterTaintPolicyController(self.store, self.runtime)
         self.remedies = RemedyController(self.store, self.runtime)
@@ -181,6 +195,7 @@ class ControlPlane:
 
         server = AccurateEstimatorServer(member)
         self.descheduler_estimator.register(name, LocalTransport(server.handle))
+        self.eps_collect.watch_member(name)
         self.cluster_status.collect_all()
         return member
 

@@ -101,12 +101,23 @@ def prune_for_propagation(manifest: Dict[str, Any]) -> Dict[str, Any]:
 
 
 class ResourceInterpreter:
-    """Facade dispatching per-kind; customizations beat native defaults."""
+    """Facade dispatching per-kind with the reference's four-tier priority
+    (interpreter.go:104-150): registered hooks (the webhook tier) >
+    declarative store customizations > third-party bundle > native
+    defaults."""
 
     def __init__(self) -> None:
-        self._customizations: Dict[Tuple[str, str], Customization] = {}
+        from karmada_tpu.interpreter.declarative import DeclarativeManager
 
-    # -- customization registry (reference: declarative/webhook tiers) -----
+        self._customizations: Dict[Tuple[str, str], Customization] = {}
+        self.declarative = DeclarativeManager()
+
+    def attach_store(self, store) -> None:
+        """Enable the declarative tier: ResourceInterpreterCustomization
+        objects in `store` become live customizations."""
+        self.declarative.attach_store(store)
+
+    # -- customization registry (reference: webhook tier) -------------------
     def register(self, customization: Customization) -> None:
         key = (customization.api_version, customization.kind)
         self._customizations[key] = customization
@@ -115,11 +126,17 @@ class ResourceInterpreter:
         self._customizations.pop((api_version, kind), None)
 
     def _hook(self, manifest: Dict[str, Any], op: str) -> Optional[Callable]:
-        key = (manifest.get("apiVersion", ""), manifest.get("kind", ""))
-        c = self._customizations.get(key)
+        from karmada_tpu.interpreter.thirdparty import thirdparty_hook
+
+        api_version = manifest.get("apiVersion", "")
+        kind = manifest.get("kind", "")
+        c = self._customizations.get((api_version, kind))
         if c is not None and op in c.hooks:
             return c.hooks[op]
-        return None
+        hook = self.declarative.hook(api_version, kind, op)
+        if hook is not None:
+            return hook
+        return thirdparty_hook(api_version, kind, op)
 
     # -- operations ---------------------------------------------------------
     def get_replicas(self, manifest: Dict[str, Any]) -> Tuple[int, Optional[ReplicaRequirements]]:
@@ -183,8 +200,13 @@ class ResourceInterpreter:
         kind = out.get("kind", "")
         # retain-replicas label: member-side HPAs own the replica count
         # (native/retain.go:145 retainWorkloadReplicas)
+        from karmada_tpu.utils.constants import (
+            RETAIN_REPLICAS_LABEL,
+            RETAIN_REPLICAS_VALUE,
+        )
+
         labels = deep_get(out, "metadata.labels", {}) or {}
-        if labels.get("resourcetemplate.karmada.io/retain-replicas") == "true":
+        if labels.get(RETAIN_REPLICAS_LABEL) == RETAIN_REPLICAS_VALUE:
             observed_replicas = deep_get(observed, "spec.replicas")
             if observed_replicas is not None:
                 out.setdefault("spec", {})["replicas"] = observed_replicas

@@ -1,0 +1,91 @@
+"""Third-party customization bundle — pure data, like the reference's
+embedded Lua tree (pkg/resourceinterpreter/default/thirdparty/
+resourcecustomizations/<group>/<Kind>/customizations.yaml: Kruise, Argo,
+Flink, ...).  Each entry is the same script dialect users write in
+ResourceInterpreterCustomization objects; the facade ranks this tier below
+user customizations and above the native defaults.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+from karmada_tpu.interpreter.declarative import make_hooks
+
+# (apiVersion, kind) -> op -> script
+THIRDPARTY_BUNDLE: Dict[Tuple[str, str], Dict[str, str]] = {
+    # Argo Rollouts (argoproj.io/v1alpha1 Rollout/customizations.yaml)
+    ("argoproj.io/v1alpha1", "Rollout"): {
+        "InterpretReplica": (
+            "{'replicas': get(obj, 'spec.replicas', 0) or 0,"
+            " 'requirements': {"
+            "   name: req for c in get(obj, 'spec.template.spec.containers', [])"
+            "   for name, req in items(get(c, 'resources.requests', {}))"
+            " }}"
+        ),
+        "ReviseReplica": "set(obj, 'spec.replicas', replicas)",
+        "InterpretHealth": (
+            "get(obj, 'status.observedGeneration', 0) =="
+            " get(obj, 'metadata.generation', 0)"
+            " and (get(obj, 'status.availableReplicas', 0) or 0) >="
+            " (get(obj, 'spec.replicas', 0) or 0)"
+            " and get(obj, 'status.phase', '') != 'Degraded'"
+        ),
+        "InterpretStatus": (
+            "{'replicas': get(obj, 'status.replicas', 0),"
+            " 'readyReplicas': get(obj, 'status.readyReplicas', 0),"
+            " 'availableReplicas': get(obj, 'status.availableReplicas', 0),"
+            " 'updatedReplicas': get(obj, 'status.updatedReplicas', 0),"
+            " 'phase': get(obj, 'status.phase', '')}"
+        ),
+        "AggregateStatus": (
+            "set(obj, 'status', {"
+            " 'replicas': sum([get(i, 'status.replicas', 0) or 0 for i in items]),"
+            " 'readyReplicas': sum([get(i, 'status.readyReplicas', 0) or 0 for i in items]),"
+            " 'availableReplicas': sum([get(i, 'status.availableReplicas', 0) or 0 for i in items]),"
+            " 'updatedReplicas': sum([get(i, 'status.updatedReplicas', 0) or 0 for i in items])})"
+        ),
+    },
+    # OpenKruise CloneSet (apps.kruise.io/v1alpha1 CloneSet/customizations.yaml)
+    ("apps.kruise.io/v1alpha1", "CloneSet"): {
+        "InterpretReplica": (
+            "{'replicas': get(obj, 'spec.replicas', 0) or 0,"
+            " 'requirements': {"
+            "   name: req for c in get(obj, 'spec.template.spec.containers', [])"
+            "   for name, req in items(get(c, 'resources.requests', {}))"
+            " }}"
+        ),
+        "ReviseReplica": "set(obj, 'spec.replicas', replicas)",
+        "InterpretHealth": (
+            "get(obj, 'status.observedGeneration', 0) =="
+            " get(obj, 'metadata.generation', 0)"
+            " and (get(obj, 'status.updatedReadyReplicas', 0) or 0) >="
+            " (get(obj, 'spec.replicas', 0) or 0)"
+        ),
+        "InterpretStatus": (
+            "{'replicas': get(obj, 'status.replicas', 0),"
+            " 'readyReplicas': get(obj, 'status.readyReplicas', 0),"
+            " 'updatedReplicas': get(obj, 'status.updatedReplicas', 0),"
+            " 'updatedReadyReplicas': get(obj, 'status.updatedReadyReplicas', 0),"
+            " 'expectedUpdatedReplicas': get(obj, 'status.expectedUpdatedReplicas', 0)}"
+        ),
+        "AggregateStatus": (
+            "set(obj, 'status', {"
+            " 'replicas': sum([get(i, 'status.replicas', 0) or 0 for i in items]),"
+            " 'readyReplicas': sum([get(i, 'status.readyReplicas', 0) or 0 for i in items]),"
+            " 'updatedReplicas': sum([get(i, 'status.updatedReplicas', 0) or 0 for i in items]),"
+            " 'updatedReadyReplicas': sum([get(i, 'status.updatedReadyReplicas', 0) or 0 for i in items])})"
+        ),
+    },
+}
+
+_compiled: Dict[Tuple[str, str], Dict[str, Callable]] = {}
+
+
+def thirdparty_hook(api_version: str, kind: str, op: str) -> Optional[Callable]:
+    key = (api_version, kind)
+    if key not in THIRDPARTY_BUNDLE:
+        return None
+    if key not in _compiled:
+        _compiled[key] = make_hooks(THIRDPARTY_BUNDLE[key])
+    return _compiled[key].get(op)

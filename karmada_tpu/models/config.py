@@ -1,0 +1,40 @@
+"""config.karmada.io API types (reference pkg/apis/config/v1alpha1).
+
+ResourceInterpreterCustomization: DATA-DRIVEN per-kind interpreter scripts
+(the reference ships Lua executed by gopher-lua,
+resourceinterpretercustomization_types.go + customized/declarative/luavm/
+lua.go).  This framework's script language is a sandboxed expression
+dialect (interpreter/declarative.py); each operation carries one
+expression string evaluated against the operation's bound names.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+from karmada_tpu.models.meta import ObjectMeta, TypedObject
+
+
+@dataclass
+class CustomizationTarget:
+    api_version: str = ""
+    kind: str = ""
+
+
+@dataclass
+class ResourceInterpreterCustomizationSpec:
+    target: CustomizationTarget = field(default_factory=CustomizationTarget)
+    # operation name (interpreter.OP_*) -> sandboxed expression script
+    customizations: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceInterpreterCustomization(TypedObject):
+    KIND = "ResourceInterpreterCustomization"
+    API_VERSION = "config.karmada.io/v1alpha1"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceInterpreterCustomizationSpec = field(
+        default_factory=ResourceInterpreterCustomizationSpec
+    )

@@ -1,0 +1,203 @@
+"""Declarative interpreter tier: sandboxed data-driven customizations.
+
+Reference: pkg/resourceinterpreter/customized/declarative/luavm/lua.go
+(user scripts from ResourceInterpreterCustomization objects, sandboxed,
+ranked above the third-party bundle and native defaults) and
+default/thirdparty/resourcecustomizations/ (the data-only bundle).
+"""
+
+import pytest
+
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.interpreter.declarative import ScriptError, compile_script
+from karmada_tpu.interpreter.interpreter import ResourceInterpreter
+from karmada_tpu.models.config import (
+    CustomizationTarget,
+    ResourceInterpreterCustomization,
+    ResourceInterpreterCustomizationSpec,
+)
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    REPLICA_SCHEDULING_DUPLICATED,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ReplicaSchedulingStrategy,
+    ResourceSelector,
+)
+from karmada_tpu.models.work import ResourceBinding
+
+
+# -- sandbox ---------------------------------------------------------------
+
+
+def test_sandbox_rejects_imports_and_attributes():
+    with pytest.raises(ScriptError):
+        compile_script("__import__('os')")
+    with pytest.raises(ScriptError):
+        compile_script("obj.__class__")
+    with pytest.raises(ScriptError):
+        compile_script("(lambda: 1)()")
+    with pytest.raises(ScriptError):
+        compile_script("x := 5")
+
+
+def test_sandbox_evaluates_expressions():
+    fn = compile_script("get(obj, 'spec.replicas', 0) * 2")
+    assert fn({"obj": {"spec": {"replicas": 3}}}) == 6
+    fn = compile_script("{'n': max([i for i in [1, 5, 3]])}")
+    assert fn({}) == {"n": 5}
+    fn = compile_script("quantity('500m') + quantity('1')")
+    assert fn({}) == 1500
+
+
+def test_sandbox_set_is_copy_on_write():
+    fn = compile_script("set(obj, 'spec.replicas', replicas)")
+    src = {"spec": {"replicas": 1}}
+    out = fn({"obj": src, "replicas": 9})
+    assert out["spec"]["replicas"] == 9
+    assert src["spec"]["replicas"] == 1
+
+
+# -- third-party bundle ----------------------------------------------------
+
+
+def rollout(replicas=5):
+    return {
+        "apiVersion": "argoproj.io/v1alpha1", "kind": "Rollout",
+        "metadata": {"name": "r", "namespace": "default", "generation": 2},
+        "spec": {"replicas": replicas, "template": {"spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "250m"}}}]}}},
+        "status": {"observedGeneration": 2, "availableReplicas": replicas,
+                   "replicas": replicas, "readyReplicas": replicas,
+                   "updatedReplicas": replicas, "phase": "Healthy"},
+    }
+
+
+def test_thirdparty_rollout_replicas_and_health():
+    interp = ResourceInterpreter()
+    replicas, req = interp.get_replicas(rollout())
+    assert replicas == 5
+    assert req.resource_request["cpu"].milli == 250
+    assert interp.interpret_health(rollout()) == "Healthy"
+    revised = interp.revise_replica(rollout(), 2)
+    assert revised["spec"]["replicas"] == 2
+
+
+def test_thirdparty_cloneset_replicas():
+    interp = ResourceInterpreter()
+    manifest = {
+        "apiVersion": "apps.kruise.io/v1alpha1", "kind": "CloneSet",
+        "metadata": {"name": "cs", "namespace": "default"},
+        "spec": {"replicas": 7, "template": {"spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"memory": "2Gi"}}}]}}},
+    }
+    replicas, req = interp.get_replicas(manifest)
+    assert replicas == 7
+    assert req.resource_request["memory"].value() == 2 * 1024**3
+
+
+# -- declarative tier end to end -------------------------------------------
+
+
+def crd_workload(replicas=4):
+    return {
+        "apiVersion": "example.io/v1", "kind": "Widget",
+        "metadata": {"name": "w", "namespace": "default"},
+        "spec": {"size": replicas},  # replicas live in a custom field
+    }
+
+
+def customization(name="widget-cust"):
+    return ResourceInterpreterCustomization(
+        metadata=ObjectMeta(name=name),
+        spec=ResourceInterpreterCustomizationSpec(
+            target=CustomizationTarget(api_version="example.io/v1", kind="Widget"),
+            customizations={
+                "InterpretReplica": "get(obj, 'spec.size', 0)",
+                "ReviseReplica": "set(obj, 'spec.size', replicas)",
+                "InterpretStatus": "{'size': get(obj, 'status.size', 0)}",
+                "InterpretHealth": "get(obj, 'status.size', 0) >= get(obj, 'spec.size', 0)",
+            },
+        ),
+    )
+
+
+def test_customization_changes_get_replicas_without_framework_code():
+    cp = ControlPlane(backend="serial")
+    cp.add_member("m1")
+    cp.tick()
+    # before the customization: unknown kind -> 0 replicas
+    assert cp.interpreter.get_replicas(crd_workload())[0] == 0
+    cp.store.create(customization())
+    assert cp.interpreter.get_replicas(crd_workload())[0] == 4
+    # live update through the store changes behavior again
+    def double(c):
+        c.spec.customizations["InterpretReplica"] = "get(obj, 'spec.size', 0) * 2"
+    cp.store.mutate(
+        ResourceInterpreterCustomization.KIND, "", "widget-cust", double
+    )
+    assert cp.interpreter.get_replicas(crd_workload())[0] == 8
+    # delete: back to native (which declines the unknown kind)
+    cp.store.delete(ResourceInterpreterCustomization.KIND, "", "widget-cust")
+    assert cp.interpreter.get_replicas(crd_workload())[0] == 0
+
+
+def test_customization_drives_propagation_pipeline():
+    """A CRD the framework has never seen schedules via its customization:
+    detector reads replicas from spec.size, binding revises the same field."""
+    cp = ControlPlane(backend="serial")
+    m = cp.add_member("m1", cpu_milli=64_000)
+    from karmada_tpu.models.cluster import APIEnablement
+
+    m.api_enablements.append(APIEnablement("example.io/v1", ["Widget"]))
+    cp.tick()
+    cp.store.create(customization())
+    cp.store.create(PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="example.io/v1", kind="Widget")
+            ],
+            placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED)),
+        ),
+    ))
+    cp.apply(crd_workload(replicas=4))
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "w-widget")
+    assert rb.spec.replicas == 4
+    applied = cp.members["m1"].get("Widget", "default", "w")
+    assert applied is not None
+    assert applied.manifest["spec"]["size"] == 4
+
+
+def test_invalid_script_never_shadows_native():
+    cp = ControlPlane(backend="serial")
+    cp.add_member("m1")
+    bad = ResourceInterpreterCustomization(
+        metadata=ObjectMeta(name="bad"),
+        spec=ResourceInterpreterCustomizationSpec(
+            target=CustomizationTarget(api_version="apps/v1", kind="Deployment"),
+            customizations={"InterpretReplica": "import os"},
+        ),
+    )
+    cp.store.create(bad)
+    manifest = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "d", "namespace": "default"},
+        "spec": {"replicas": 3},
+    }
+    assert cp.interpreter.get_replicas(manifest)[0] == 3  # native default
+
+
+def test_alphabetical_priority_between_customizations():
+    cp = ControlPlane(backend="serial")
+    cp.add_member("m1")
+    a = customization("a-first")
+    a.spec.customizations = {"InterpretReplica": "111"}
+    z = customization("z-last")
+    z.spec.customizations = {"InterpretReplica": "999"}
+    cp.store.create(z)
+    cp.store.create(a)
+    assert cp.interpreter.get_replicas(crd_workload())[0] == 111

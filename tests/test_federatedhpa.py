@@ -227,6 +227,7 @@ def test_cron_scales_workload_on_schedule(env):
                 name="every-minute", schedule="* * * * *", target_replicas=7)],
         ),
     ))
+    cp.tick()  # first sync registers; rules fire only for FUTURE slots
     clock.advance(61)
     cp.tick()
     assert template_replicas(cp) == 7
@@ -248,6 +249,7 @@ def test_cron_adjusts_fhpa_min_max(env):
                 target_min_replicas=5, target_max_replicas=20)],
         ),
     ))
+    cp.tick()  # first sync registers; rules fire only for FUTURE slots
     clock.advance(61)
     cp.tick()
     h = cp.store.get(FederatedHPA.KIND, "default", "web-hpa")
@@ -269,6 +271,7 @@ def test_suspended_rule_does_not_fire(env):
                 suspend=True)],
         ),
     ))
+    cp.tick()  # first sync registers; rules fire only for FUTURE slots
     clock.advance(61)
     cp.tick()
     assert template_replicas(cp) == 4

@@ -1,0 +1,167 @@
+"""MCS end to end: a service exported from one member resolves in another.
+
+Reference: pkg/controllers/multiclusterservice/{mcs_controller.go:71,
+endpointslice_collect_controller.go:87, endpointslice_dispatch_controller.go:68}
+and pkg/controllers/mcs/service_export_controller.go:103.
+"""
+
+import pytest
+
+from karmada_tpu.controllers.mcs import (
+    ORIGIN_CLUSTER_ANNOTATION,
+    SERVICE_NAME_LABEL,
+    _collected_name,
+)
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.networking import (
+    ExposureRange,
+    MultiClusterService,
+    MultiClusterServiceSpec,
+    ServiceExport,
+)
+from karmada_tpu.models.meta import ObjectMeta
+
+
+def service(name="web", ns="default"):
+    return {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"ports": [{"port": 80}], "selector": {"app": name}},
+    }
+
+
+def endpoint_slice(name, service_name, ns="default", ips=("10.0.0.1",)):
+    return {
+        "apiVersion": "discovery.k8s.io/v1", "kind": "EndpointSlice",
+        "metadata": {"name": name, "namespace": ns,
+                     "labels": {SERVICE_NAME_LABEL: service_name}},
+        "addressType": "IPv4",
+        "endpoints": [{"addresses": list(ips)}],
+        "ports": [{"port": 80}],
+    }
+
+
+def mcs(name="web", ns="default", providers=None, consumers=None):
+    return MultiClusterService(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=MultiClusterServiceSpec(
+            provider_clusters=(
+                [ExposureRange(cluster_names=providers)] if providers else []
+            ),
+            consumer_clusters=(
+                [ExposureRange(cluster_names=consumers)] if consumers else []
+            ),
+        ),
+    )
+
+
+@pytest.fixture
+def cp():
+    plane = ControlPlane(backend="serial")
+    plane.add_member("m1")
+    plane.add_member("m2")
+    plane.add_member("m3")
+    plane.tick()
+    return plane
+
+
+def test_mcs_propagates_service_everywhere(cp):
+    cp.apply(service())
+    cp.store.create(mcs())
+    cp.tick()
+    for m in ("m1", "m2", "m3"):
+        assert cp.members[m].get("Service", "default", "web") is not None
+
+
+def test_service_exported_from_m1_resolvable_in_m2(cp):
+    """The headline flow: provider m1's endpoints appear in consumer m2."""
+    cp.apply(service())
+    cp.store.create(mcs(providers=["m1"], consumers=["m2"]))
+    cp.tick()
+    # m1's endpoint controller publishes a local slice for the service
+    cp.members["m1"].apply(endpoint_slice("web-abc", "web", ips=("10.1.1.5",)))
+    cp.tick()
+    # collected upward, tagged with origin
+    up = cp.store.try_get("EndpointSlice", "default", _collected_name("m1", "default", "web-abc"))
+    assert up is not None
+    assert up.metadata.annotations[ORIGIN_CLUSTER_ANNOTATION] == "m1"
+    # dispatched into the consumer
+    down = cp.members["m2"].get("EndpointSlice", "default", _collected_name("m1", "default", "web-abc"))
+    assert down is not None
+    assert down.manifest["endpoints"][0]["addresses"] == ["10.1.1.5"]
+    # never dispatched back to the origin or to non-consumers
+    assert cp.members["m1"].get("EndpointSlice", "default", _collected_name("m1", "default", "web-abc")) is None
+    assert cp.members["m3"].get("EndpointSlice", "default", _collected_name("m1", "default", "web-abc")) is None
+
+
+def test_slice_removal_propagates(cp):
+    cp.apply(service())
+    cp.store.create(mcs(providers=["m1"], consumers=["m2"]))
+    cp.tick()
+    cp.members["m1"].apply(endpoint_slice("web-abc", "web"))
+    cp.tick()
+    assert cp.members["m2"].get("EndpointSlice", "default", _collected_name("m1", "default", "web-abc")) is not None
+    cp.members["m1"].delete("EndpointSlice", "default", "web-abc")
+    cp.tick()
+    assert cp.store.try_get("EndpointSlice", "default", _collected_name("m1", "default", "web-abc")) is None
+    assert cp.members["m2"].get("EndpointSlice", "default", _collected_name("m1", "default", "web-abc")) is None
+
+
+def test_mcs_delete_cleans_up(cp):
+    cp.apply(service())
+    cp.store.create(mcs(providers=["m1"], consumers=["m2"]))
+    cp.tick()
+    cp.members["m1"].apply(endpoint_slice("web-abc", "web"))
+    cp.tick()
+    cp.store.delete(MultiClusterService.KIND, "default", "web")
+    cp.tick()
+    assert cp.store.try_get("EndpointSlice", "default", _collected_name("m1", "default", "web-abc")) is None
+    assert cp.members["m2"].get("Service", "default", "web") is None
+
+
+def test_unexported_service_not_collected(cp):
+    cp.apply(service())
+    cp.tick()
+    cp.members["m1"].apply(endpoint_slice("web-abc", "web"))
+    cp.tick()
+    assert cp.store.try_get("EndpointSlice", "default", _collected_name("m1", "default", "web-abc")) is None
+
+
+def test_service_export_marks_for_collection(cp):
+    """The mcs.k8s.io flavor: a ServiceExport alone triggers collection."""
+    cp.apply(service())
+    cp.store.create(ServiceExport(metadata=ObjectMeta(name="web", namespace="default")))
+    cp.tick()
+    cp.members["m1"].apply(endpoint_slice("web-abc", "web"))
+    cp.tick()
+    assert cp.store.try_get("EndpointSlice", "default", _collected_name("m1", "default", "web-abc")) is not None
+
+
+def test_default_mcs_no_collect_dispatch_livelock(cp):
+    """Default MCS (every cluster is provider AND consumer): dispatched
+    slices carry the managed-by annotation and must never be re-collected
+    (regression: collect<->dispatch bounced new imported-... names forever
+    and the runtime failed to quiesce)."""
+    cp.apply(service())
+    cp.store.create(mcs())  # no explicit providers/consumers
+    cp.tick()
+    cp.members["m1"].apply(endpoint_slice("web-abc", "web", ips=("10.9.9.9",)))
+    cp.tick()
+    collected = [
+        o for o in cp.store.list("EndpointSlice", "default")
+        if o.name.startswith("imported-")
+    ]
+    assert len(collected) == 1  # exactly one upward copy, no cascade
+    name = _collected_name("m1", "default", "web-abc")
+    assert cp.members["m2"].get("EndpointSlice", "default", name) is not None
+    assert cp.members["m3"].get("EndpointSlice", "default", name) is not None
+
+
+def test_provider_scoping(cp):
+    """Slices from a non-provider cluster are not collected."""
+    cp.apply(service())
+    cp.store.create(mcs(providers=["m1"], consumers=["m2"]))
+    cp.tick()
+    cp.members["m3"].apply(endpoint_slice("web-xyz", "web"))
+    cp.tick()
+    assert cp.store.try_get("EndpointSlice", "default", _collected_name("m3", "default", "web-xyz")) is None
