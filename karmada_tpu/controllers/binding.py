@@ -119,6 +119,11 @@ class BindingController:
         eviction = {t.from_cluster for t in rb.spec.graceful_eviction_tasks}
         keep = set()
         for target in targets:
+            # never materialize a Work for a cluster that no longer exists:
+            # an unjoined cluster's execution space has been drained and
+            # nothing would ever clean an orphan up
+            if self._cluster(target.name) is None:
+                continue
             m = dict(manifest)
             if self._divided(rb) and rb.spec.replicas > 0:
                 m = self.interpreter.revise_replica(m, target.replicas)

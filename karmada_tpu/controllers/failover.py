@@ -110,10 +110,16 @@ class ClusterTaintController:
 
 
 class NoExecuteTaintManager:
-    """Evict bindings from NoExecute-tainted clusters (taint_manager.go:101)."""
+    """Evict bindings from NoExecute-tainted clusters (taint_manager.go:101).
 
-    def __init__(self, store: ObjectStore, runtime: Runtime) -> None:
+    With an eviction_queue attached, evictions flow through the
+    rate-limited queue (cluster/eviction_worker.go) instead of executing
+    inline — a mass cluster failure then drains gradually."""
+
+    def __init__(self, store: ObjectStore, runtime: Runtime,
+                 eviction_queue=None) -> None:
         self.store = store
+        self.eviction_queue = eviction_queue
         self.worker = runtime.register(AsyncWorker("taint-manager", self._reconcile))
         store.bus.subscribe(self._on_event, kind=Cluster.KIND)
 
@@ -139,17 +145,37 @@ class NoExecuteTaintManager:
                 continue
             if all(self._tolerated(rb, taint) for taint in taints):
                 continue
+            if self.eviction_queue is not None:
+                self.eviction_queue.add((rb.namespace, rb.name, cluster_name))
+            else:
+                self.evict_one((rb.namespace, rb.name, cluster_name))
 
-            def do_evict(obj: ResourceBinding) -> None:
-                evict_cluster(
-                    obj, cluster_name,
-                    reason="TaintUntolerated", producer="taint-manager",
-                )
+    def evict_one(self, key) -> None:
+        """One paced eviction; re-verifies the decision at processing time
+        (the binding or the taints may have changed while queued)."""
+        ns, name, cluster_name = key
+        cluster = self.store.try_get(Cluster.KIND, "", cluster_name)
+        if cluster is None:
+            return
+        taints = [t for t in cluster.spec.taints if t.effect == EFFECT_NO_EXECUTE]
+        if not taints:
+            return
+        rb = self.store.try_get(ResourceBinding.KIND, ns, name)
+        if rb is None or not any(t.name == cluster_name for t in rb.spec.clusters):
+            return
+        if all(self._tolerated(rb, taint) for taint in taints):
+            return
 
-            try:
-                self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, do_evict)
-            except NotFoundError:
-                pass
+        def do_evict(obj: ResourceBinding) -> None:
+            evict_cluster(
+                obj, cluster_name,
+                reason="TaintUntolerated", producer="taint-manager",
+            )
+
+        try:
+            self.store.mutate(ResourceBinding.KIND, ns, name, do_evict)
+        except NotFoundError:
+            pass
 
 
 class GracefulEvictionController:

@@ -60,14 +60,22 @@ class ControlPlane:
         eviction_grace_period_s: float = 600,
         feature_gates: Optional[Dict[str, bool]] = None,
         clock=None,
+        persist_dir: Optional[str] = None,
+        eviction_rate: float = 100.0,
     ) -> None:
+        self.clock = clock if clock is not None else time.time
         from karmada_tpu.utils.events import EventRecorder
         from karmada_tpu.utils.features import FeatureGates
         from karmada_tpu.webhook import AdmissionRegistry, install_default_webhooks
 
         self.gates = FeatureGates(feature_gates)
         self.admission = AdmissionRegistry()
-        self.store = ObjectStore(admission=self.admission)
+        if persist_dir is not None:
+            from karmada_tpu.store.persistence import load_store
+
+            self.store = load_store(persist_dir, admission=self.admission)
+        else:
+            self.store = ObjectStore(admission=self.admission)
         install_default_webhooks(self.admission, self.store, self.gates)
         self.runtime = Runtime()
         self.members: Dict[str, FakeMemberCluster] = {}
@@ -94,7 +102,20 @@ class ControlPlane:
             self.store, self.runtime, self.members, recorder=self.recorder
         )
         self.cluster_taints = ClusterTaintController(self.store, self.runtime)
+        # taint-driven evictions pace through the rate-limited queue
+        # (cluster/eviction_worker.go); lifecycle handles join/unjoin
+        from karmada_tpu.controllers.cluster import (
+            ClusterLifecycleController,
+            RateLimitedEvictionQueue,
+        )
+
+        self.cluster_lifecycle = ClusterLifecycleController(self.store, self.runtime)
         self.taint_manager = NoExecuteTaintManager(self.store, self.runtime)
+        self.eviction_queue = RateLimitedEvictionQueue(
+            self.runtime, self.taint_manager.evict_one,
+            rate_per_s=eviction_rate, clock=self.clock,
+        )
+        self.taint_manager.eviction_queue = self.eviction_queue
         self.graceful_eviction = GracefulEvictionController(
             self.store, self.runtime, grace_period_s=eviction_grace_period_s
         )
@@ -136,7 +157,6 @@ class ControlPlane:
             HpaScaleTargetMarker,
         )
 
-        self.clock = clock if clock is not None else time.time
         self.federated_hpa = FederatedHPAController(
             self.store, self.runtime, self.metrics_provider, clock=self.clock
         )
@@ -161,6 +181,22 @@ class ControlPlane:
         self.taint_policies = ClusterTaintPolicyController(self.store, self.runtime)
         self.remedies = RemedyController(self.store, self.runtime)
         self.quotas = FederatedResourceQuotaController(self.store, self.runtime)
+        # restart story (SURVEY §5 checkpoint/resume): a restored store
+        # resyncs every object through freshly wired controllers, exactly
+        # like the reference's informer resync after a component restart
+        if persist_dir is not None and len(self.store):
+            self.resync()
+
+    def resync(self) -> None:
+        from karmada_tpu.store.persistence import resync
+
+        resync(self.store)
+
+    def checkpoint(self) -> None:
+        """Compact the WAL into a fresh snapshot (periodic maintenance)."""
+        persistence = getattr(self.store, "persistence", None)
+        if persistence is not None:
+            persistence.snapshot()
 
     # -- fleet management ---------------------------------------------------
     def add_member(
@@ -180,11 +216,12 @@ class ControlPlane:
             pods_allocatable=pods,
         )
         self.members[name] = member
-        cluster = Cluster(
-            metadata=ObjectMeta(name=name),
-            spec=ClusterSpec(region=region, zone=zone, provider=provider),
-        )
-        self.store.create(cluster)
+        if self.store.try_get(Cluster.KIND, "", name) is None:
+            cluster = Cluster(
+                metadata=ObjectMeta(name=name),
+                spec=ClusterSpec(region=region, zone=zone, provider=provider),
+            )
+            self.store.create(cluster)
         # member informers are registered at construction; wire the new one
         self.work_status.members[name] = member
         member.store.bus.subscribe(self.work_status._member_event(name))  # noqa: SLF001
@@ -203,6 +240,22 @@ class ControlPlane:
         return self.members[name]
 
     # -- user-facing API ----------------------------------------------------
+    def unjoin(self, name: str) -> None:
+        """Unregister a member: the lifecycle controller drains its
+        execution space, then the finalizer releases the Cluster object.
+        Per-member wiring from add_member unwinds here too (estimator
+        transport, status informer, slice collection)."""
+        from karmada_tpu.store.store import NotFoundError
+
+        try:
+            self.store.delete(Cluster.KIND, "", name)
+        except NotFoundError:
+            pass
+        self.descheduler_estimator.deregister(name)
+        self.work_status.members.pop(name, None)
+        self.eps_collect._subscribed.discard(name)  # noqa: SLF001
+        self.members.pop(name, None)
+
     def proxy(self, cluster: str, subject: str = "system:admin"):
         """`karmadactl get --cluster=...`-style passthrough to one member
         (aggregated apiserver cluster proxy, proxy.go:73)."""

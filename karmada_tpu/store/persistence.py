@@ -1,0 +1,161 @@
+"""Store persistence: snapshot + write-ahead log, restart via resync.
+
+The reference keeps all control-plane state in etcd behind the
+karmada-apiserver; components are stateless and resume via informer resync
++ leader election (SURVEY §5 checkpoint/resume).  Here the ObjectStore is
+the apiserver-equivalent, so durability lives at the same layer:
+
+  * every committed write (the exact deep-copied object the watch bus
+    delivers) appends to a length-prefixed WAL;
+  * `snapshot()` writes the full object set and truncates the WAL;
+  * `load()` rebuilds a store from snapshot + WAL replay, then rotates
+    (fresh snapshot, empty WAL) so logs never grow unbounded across
+    restarts.
+
+Controllers resync the same way the reference's informers do: the restored
+ControlPlane re-publishes one synthetic ADDED event per object
+(ControlPlane.resync), and every reconcile is idempotent by design.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+from typing import Optional
+
+from karmada_tpu.store.store import ADDED, DELETED, Event, ObjectStore
+
+_LEN = struct.Struct("<I")
+
+SNAPSHOT_FILE = "store.snapshot"
+WAL_FILE = "store.wal"
+
+
+class FilePersistence:
+    """Attach to an ObjectStore; every bus event lands in the WAL."""
+
+    def __init__(self, directory: str) -> None:
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._wal = open(os.path.join(directory, WAL_FILE), "ab")
+        self._store: Optional[ObjectStore] = None
+        self._paused = False
+
+    # -- wiring -------------------------------------------------------------
+    def attach(self, store: ObjectStore) -> None:
+        self._store = store
+        store.bus.subscribe(self._on_event)
+
+    def pause(self) -> None:
+        """Skip WAL appends (resync republication of already-durable state;
+        must only bracket single-threaded startup, or real writes drop)."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+
+    def _on_event(self, event: Event) -> None:
+        if self._paused:
+            return
+        record = (event.type, pickle.dumps(event.obj, pickle.HIGHEST_PROTOCOL))
+        payload = pickle.dumps(record, pickle.HIGHEST_PROTOCOL)
+        with self._lock:
+            self._wal.write(_LEN.pack(len(payload)))
+            self._wal.write(payload)
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+
+    # -- snapshot / rotate ---------------------------------------------------
+    def snapshot(self) -> None:
+        """Write the full object set and truncate the WAL (atomic rename).
+
+        self._lock is held across the store cut AND the rotation: a write
+        committed after the cut must land in the NEW wal, never be
+        truncated out of the old one (it would survive in neither file).
+        Lock order is always persistence._lock -> store._lock; appenders
+        take persistence._lock alone, store writers never hold store._lock
+        while appending (events publish after the write lock is released).
+        """
+        assert self._store is not None
+        with self._lock:
+            with self._store._lock:  # noqa: SLF001 — consistent cut
+                objects = list(self._store._objects.values())  # noqa: SLF001
+                rv = self._store._rv  # noqa: SLF001
+            tmp = os.path.join(self.directory, SNAPSHOT_FILE + ".tmp")
+            with open(tmp, "wb") as f:
+                pickle.dump({"rv": rv, "objects": objects}, f,
+                            pickle.HIGHEST_PROTOCOL)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self.directory, SNAPSHOT_FILE))
+            self._wal.close()
+            self._wal = open(os.path.join(self.directory, WAL_FILE), "wb")
+
+    def close(self) -> None:
+        with self._lock:
+            self._wal.close()
+
+
+def load_store(directory: str, admission=None) -> ObjectStore:
+    """Rebuild an ObjectStore from snapshot + WAL, attach fresh persistence
+    (rotating the log), and return it.  Missing files -> empty store."""
+    store = ObjectStore(admission=admission)
+    snap_path = os.path.join(directory, SNAPSHOT_FILE)
+    rv = 0
+    if os.path.exists(snap_path):
+        with open(snap_path, "rb") as f:
+            snap = pickle.load(f)
+        rv = snap["rv"]
+        for obj in snap["objects"]:
+            store._objects[store._key(obj)] = obj  # noqa: SLF001 — rebuild, no events
+    wal_path = os.path.join(directory, WAL_FILE)
+    if os.path.exists(wal_path):
+        with open(wal_path, "rb") as f:
+            data = f.read()
+        off = 0
+        while off + _LEN.size <= len(data):
+            (n,) = _LEN.unpack_from(data, off)
+            off += _LEN.size
+            if off + n > len(data):
+                break  # torn tail write: discard (standard WAL recovery)
+            etype, blob = pickle.loads(data[off : off + n])
+            off += n
+            obj = pickle.loads(blob)
+            key = store._key(obj)  # noqa: SLF001
+            if etype == DELETED:
+                store._objects.pop(key, None)  # noqa: SLF001
+            else:
+                store._objects[key] = obj  # noqa: SLF001
+            rv = max(rv, obj.metadata.resource_version or 0)
+    store._rv = rv  # noqa: SLF001
+    persistence = FilePersistence(directory)
+    persistence.attach(store)
+    persistence.snapshot()
+    store.persistence = persistence
+    return store
+
+
+def new_persistent_store(directory: str, admission=None) -> ObjectStore:
+    """Create-or-load, for callers that don't care which happened."""
+    return load_store(directory, admission=admission)
+
+
+def resync(store: ObjectStore) -> None:
+    """Informer-style resync: re-publish every object as a synthetic ADDED
+    event so freshly wired controllers reconcile the restored state.
+
+    Runs during single-threaded startup; persistence appends pause for the
+    duration (the republished objects are already durable — re-logging
+    them would refill the WAL that load_store just compacted)."""
+    persistence = getattr(store, "persistence", None)
+    if persistence is not None:
+        persistence.pause()
+    try:
+        for obj in store.items():
+            store.bus.publish(Event(ADDED, obj))
+    finally:
+        if persistence is not None:
+            persistence.resume()

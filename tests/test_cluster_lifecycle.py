@@ -1,0 +1,167 @@
+"""Cluster lifecycle (join/unjoin) + rate-limited eviction.
+
+Reference: pkg/controllers/cluster/cluster_controller.go:156-381 (finalizer
++ execution-space lifecycle), eviction_worker.go + dynamic_rate_limiter.go
+(taint-driven evictions paced at ResourceEvictionRate/second; rate 0 halts).
+"""
+
+from karmada_tpu.controllers.binding import execution_namespace
+from karmada_tpu.controllers.cluster import CLUSTER_FINALIZER
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.cluster import Cluster
+from karmada_tpu.models.policy import (
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+    ClusterPreferences,
+    ObjectMeta,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ReplicaSchedulingStrategy,
+    ResourceSelector,
+)
+from karmada_tpu.models.work import ResourceBinding, Work
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def nginx(name="nginx", replicas=4):
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"replicas": replicas, "template": {"spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "100m",
+                                                     "memory": "1Gi"}}}]}}},
+    }
+
+
+def policy():
+    return PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="apps/v1", kind="Deployment")
+            ],
+            placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+            )),
+        ),
+    )
+
+
+def test_join_adds_finalizer_and_execution_space():
+    cp = ControlPlane(backend="serial")
+    cp.add_member("m1")
+    cp.tick()
+    cluster = cp.store.get(Cluster.KIND, "", "m1")
+    assert CLUSTER_FINALIZER in cluster.metadata.finalizers
+    ns = cp.store.try_get("Namespace", "", execution_namespace("m1"))
+    assert ns is not None
+    assert ns.metadata.labels["karmada.io/execution-space-for"] == "m1"
+
+
+def test_unjoin_drains_works_then_releases_cluster():
+    cp = ControlPlane(backend="serial")
+    cp.add_member("m1")
+    cp.add_member("m2")
+    cp.tick()
+    cp.store.create(policy())
+    cp.apply(nginx())
+    cp.tick()
+    assert len(cp.store.list(Work.KIND, execution_namespace("m1"))) >= 1
+    cp.unjoin("m1")
+    cp.tick()
+    # execution space drained + removed; Cluster object fully gone
+    assert cp.store.list(Work.KIND, execution_namespace("m1")) == []
+    assert cp.store.try_get("Namespace", "", execution_namespace("m1")) is None
+    assert cp.store.try_get(Cluster.KIND, "", "m1") is None
+    # survivors untouched
+    assert len(cp.store.list(Work.KIND, execution_namespace("m2"))) >= 1
+
+
+def test_unjoin_reschedules_bindings_off_the_removed_cluster():
+    """Bindings targeting the unjoined cluster lose it and the scheduler
+    tops the replicas back up on survivors; no orphan Work reappears
+    (regression: binding controller recreated Works in the drained space)."""
+    cp = ControlPlane(backend="serial")
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.add_member("m2", cpu_milli=64_000)
+    cp.tick()
+    cp.store.create(policy())
+    cp.apply(nginx(replicas=4))
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    assert {tc.name for tc in rb.spec.clusters} == {"m1", "m2"}
+    cp.unjoin("m1")
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    assert {tc.name for tc in rb.spec.clusters} == {"m2"}
+    assert sum(tc.replicas for tc in rb.spec.clusters) == 4
+    # template update must not resurrect a Work for the gone cluster
+    cp.apply(nginx(replicas=5))
+    cp.tick()
+    assert cp.store.list(Work.KIND, execution_namespace("m1")) == []
+
+
+def test_eviction_rate_limits_mass_failure():
+    """A zone outage with 6 affected bindings drains at the configured
+    2/second instead of stampeding all six through rescheduling at once."""
+    clock = FakeClock()
+    cp = ControlPlane(backend="serial", clock=clock, eviction_rate=2.0)
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.add_member("m2", cpu_milli=64_000)
+    cp.tick()
+    cp.store.create(policy())
+    for i in range(6):
+        cp.apply(nginx(name=f"app-{i}", replicas=2))
+    cp.tick()
+
+    def evicted_count() -> int:
+        n = 0
+        for rb in cp.store.list(ResourceBinding.KIND):
+            if any(t.from_cluster == "m1" for t in rb.spec.graceful_eviction_tasks):
+                n += 1
+            elif not any(tc.name == "m1" for tc in rb.spec.clusters):
+                n += 1
+        return n
+
+    cp.member("m1").healthy = False
+    cp.tick()  # taints land; initial burst (max(rate,1)=2) evicts 2
+    assert evicted_count() == 2, evicted_count()
+    clock.advance(1.0)
+    cp.tick()  # +2 tokens
+    assert evicted_count() == 4
+    clock.advance(1.0)
+    cp.tick()
+    assert evicted_count() == 6
+
+
+def test_eviction_rate_zero_halts():
+    clock = FakeClock()
+    cp = ControlPlane(backend="serial", clock=clock, eviction_rate=0.0)
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.add_member("m2", cpu_milli=64_000)
+    cp.tick()
+    cp.store.create(policy())
+    cp.apply(nginx())
+    cp.tick()
+    cp.member("m1").healthy = False
+    cp.tick()
+    clock.advance(3600)
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    assert not rb.spec.graceful_eviction_tasks  # nothing evicted: halted
+    assert cp.eviction_queue.pending() >= 1

@@ -1,0 +1,149 @@
+"""Persistence + restart: the control plane resumes from snapshot + WAL.
+
+Reference analog (SURVEY §5 checkpoint/resume): state in etcd, stateless
+components resuming via informer resync.  Here: ObjectStore WAL/snapshot
+(store/persistence.py), ControlPlane(persist_dir=...) reload + resync.
+"""
+
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.policy import (
+    REPLICA_SCHEDULING_DUPLICATED,
+    ObjectMeta,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ReplicaSchedulingStrategy,
+    ResourceSelector,
+)
+from karmada_tpu.models.work import ResourceBinding, Work
+from karmada_tpu.store.persistence import load_store
+from karmada_tpu.store.store import ObjectStore
+
+
+def nginx(replicas=3):
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "nginx", "namespace": "default"},
+        "spec": {"replicas": replicas, "template": {"spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "100m",
+                                                     "memory": "1Gi"}}}]}}},
+    }
+
+
+def dup_policy():
+    return PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="apps/v1", kind="Deployment")
+            ],
+            placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED)),
+        ),
+    )
+
+
+def test_wal_roundtrip(tmp_path):
+    d = str(tmp_path / "store")
+    store = load_store(d)
+    store.create(dup_policy())
+    # reload from WAL alone (no explicit snapshot call needed)
+    again = load_store(d)
+    assert again.get(PropagationPolicy.KIND, "default", "pp").spec.priority == 0
+    # delete persists too
+    again.delete(PropagationPolicy.KIND, "default", "pp")
+    third = load_store(d)
+    assert third.try_get(PropagationPolicy.KIND, "default", "pp") is None
+
+
+def test_snapshot_truncates_wal(tmp_path):
+    import os
+
+    d = str(tmp_path / "store")
+    store = load_store(d)
+    for i in range(20):
+        p = dup_policy()
+        p.metadata.name = f"pp-{i}"
+        store.create(p)
+    store.persistence.snapshot()
+    assert os.path.getsize(os.path.join(d, "store.wal")) == 0
+    again = load_store(d)
+    assert len(again.list(PropagationPolicy.KIND)) == 20
+
+
+def test_resource_version_monotonic_across_restart(tmp_path):
+    d = str(tmp_path / "store")
+    store = load_store(d)
+    obj = store.create(dup_policy())
+    rv1 = obj.metadata.resource_version
+    again = load_store(d)
+    p2 = dup_policy()
+    p2.metadata.name = "pp2"
+    rv2 = again.create(p2).metadata.resource_version
+    assert rv2 > rv1
+
+
+def test_torn_tail_write_discarded(tmp_path):
+    import os
+
+    d = str(tmp_path / "store")
+    store = load_store(d)
+    store.create(dup_policy())
+    wal = os.path.join(d, "store.wal")
+    with open(wal, "ab") as f:
+        f.write(b"\xff\xff\xff\x7f partial garbage")
+    again = load_store(d)  # must not crash; keeps the committed prefix
+    assert again.try_get(PropagationPolicy.KIND, "default", "pp") is not None
+
+
+def test_control_plane_restart_mid_propagation_converges(tmp_path):
+    """Kill the plane after scheduling but before the members applied
+    anything; a new plane over the same files must converge."""
+    d = str(tmp_path / "cp")
+    cp = ControlPlane(backend="serial", persist_dir=d)
+    cp.add_member("m1")
+    cp.add_member("m2")
+    cp.tick()
+    cp.store.create(dup_policy())
+    cp.apply(nginx())
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    assert len(rb.spec.clusters) == 2
+    assert cp.members["m1"].get("Deployment", "default", "nginx") is not None
+    # "kill" the plane: drop it entirely; members are fresh (external
+    # clusters would have kept state, but convergence must not DEPEND on it)
+    del cp
+
+    cp2 = ControlPlane(backend="serial", persist_dir=d)
+    cp2.add_member("m1")
+    cp2.add_member("m2")
+    cp2.tick()
+    # restored state is present pre-tick: policy, template, binding, works
+    assert cp2.store.try_get(PropagationPolicy.KIND, "default", "pp") is not None
+    assert cp2.store.try_get("Deployment", "default", "nginx") is not None
+    assert cp2.store.try_get(ResourceBinding.KIND, "default", "nginx-deployment") is not None
+    assert len(cp2.store.list(Work.KIND)) >= 2
+    # and the propagation pipeline converges onto the new members
+    assert cp2.members["m1"].get("Deployment", "default", "nginx") is not None
+    assert cp2.members["m2"].get("Deployment", "default", "nginx") is not None
+
+
+def test_restart_preserves_schedule_result(tmp_path):
+    """The scheduler does not churn restored bindings: observed generation
+    survives the restart, so an unchanged binding is not rescheduled."""
+    d = str(tmp_path / "cp")
+    cp = ControlPlane(backend="serial", persist_dir=d)
+    cp.add_member("m1")
+    cp.tick()
+    cp.store.create(dup_policy())
+    cp.apply(nginx())
+    cp.tick()
+    rb1 = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    del cp
+
+    cp2 = ControlPlane(backend="serial", persist_dir=d)
+    cp2.add_member("m1")
+    cp2.tick()
+    rb2 = cp2.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    assert {tc.name for tc in rb2.spec.clusters} == {tc.name for tc in rb1.spec.clusters}
+    assert rb2.status.scheduler_observed_generation == rb2.metadata.generation
