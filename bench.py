@@ -108,6 +108,7 @@ from karmada_tpu.models.policy import (
     REPLICA_SCHEDULING_DIVIDED,
     REPLICA_SCHEDULING_DUPLICATED,
     SPREAD_BY_FIELD_CLUSTER,
+    SPREAD_BY_FIELD_REGION,
     ClusterAffinity,
     ClusterPreferences,
     Placement,
@@ -195,6 +196,26 @@ def build_placements(rng: random.Random, names):
                 replica_division_preference=REPLICA_DIVISION_AGGREGATED,
             ),
         ))
+    for _ in range(8):  # region topology spread (device group math + host DFS)
+        rmin = rng.randint(1, 2)
+        placements.append(Placement(
+            spread_constraints=[
+                SpreadConstraint(
+                    spread_by_field=SPREAD_BY_FIELD_REGION,
+                    min_groups=rmin, max_groups=rng.randint(rmin, 3),
+                ),
+                SpreadConstraint(
+                    spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+                    min_groups=2, max_groups=6,
+                ),
+            ],
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+            ),
+        ))
     return placements
 
 
@@ -226,6 +247,7 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
     `waves`-deep capacity contention exactly like scheduler/service.py.
     """
     from karmada_tpu.ops.solver import solve_compact
+    from karmada_tpu.ops.spread import solve_spread
     from karmada_tpu.scheduler import metrics as sm
 
     n = len(items)
@@ -241,13 +263,22 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
         t1 = time.perf_counter()
         sm.STEP_LATENCY.observe(t1 - tc, schedule_step=sm.STEP_ENCODE)
         idx, val, status, _nnz = solve_compact(batch, waves=waves)
+        spread_idx = [
+            i for i in range(len(part))
+            if batch.route[i] == tensors.ROUTE_DEVICE_SPREAD
+        ]
+        spread_res = solve_spread(batch, part, spread_idx, waves=waves)
         t2 = time.perf_counter()
         solve_s += t2 - t1
         sm.STEP_LATENCY.observe(t2 - t1, schedule_step=sm.STEP_SOLVE)
         decoded = tensors.decode_compact(batch, idx, val, status)
+        for i in range(len(part)):
+            d = spread_res[i] if i in spread_res else decoded[i]
+            if batch.route[i] in (tensors.ROUTE_DEVICE,
+                                  tensors.ROUTE_DEVICE_SPREAD):
+                scheduled += 0 if isinstance(d, Exception) else 1
         sm.STEP_LATENCY.observe(time.perf_counter() - t2,
                                 schedule_step=sm.STEP_DECODE)
-        scheduled += sum(1 for d in decoded if not isinstance(d, Exception))
         chunk_lat.append(time.perf_counter() - tc)
     return time.perf_counter() - t0, solve_s, scheduled, chunk_lat
 

@@ -1,0 +1,405 @@
+"""Region topology spread on device (SURVEY §2.9 masked tensor search).
+
+Reference: pkg/scheduler/core/spreadconstraint/ — group clusters by region
+with scores + available replicas (group_clusters.go:220-333), pick the
+best region combination by DFS (select_groups.go:102-230), then pick
+clusters within the chosen regions (select_clusters_by_region.go:27-118).
+
+Device split: the O(C) per-cluster work — grouping, the sorted-prefix
+group-score walk, and the final cluster pick — runs as one vmapped jitted
+program over the dense batch; ONLY the DFS over at most MAX_DEVICE_REGIONS
+group-level scalars runs on host, and it IS serial.select_groups itself,
+so path prioritization and the sub-path rule match the golden path by
+construction.  Placements with provider/zone spread, spread-by-label, or
+more than MAX_DEVICE_REGIONS regions route to the full serial host path.
+
+Flow (ops.spread.solve_spread):
+  phase A (device)  group tensors per binding: score/avail/value [B_s, G]
+  host              serial.select_groups over G scalars -> chosen regions
+  phase B (device)  cluster pick inside chosen regions -> feasible mask
+  main kernel       solver.schedule_batch with that mask as the placement
+                    row (spread disabled) -> replica assignment
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from karmada_tpu.ops import serial
+from karmada_tpu.ops.solver import (
+    MAX_INT32,
+    _AVAIL_CAP,
+    _capacity_estimates,
+)
+
+WEIGHT_UNIT = serial.WEIGHT_UNIT  # 1000 (group_clusters.go:139)
+_BIG = jnp.int64(1) << 62
+
+
+def _sort_key(score, avail, name_rank, feasible):
+    """The spreadconstraint sortClusters order: score desc, avail desc,
+    name asc (util.go) — same packing as the solver's selection key."""
+    avail_c = jnp.clip(avail, 0, _AVAIL_CAP)
+    key = (
+        ((200 - score).astype(jnp.int64) << 47)
+        | ((_AVAIL_CAP - avail_c) << 13)
+        | name_rank
+    )
+    return jnp.where(feasible, key, _BIG)
+
+
+def _group_info_one(
+    feasible, avail_sel, score, name_rank, region_id,
+    replicas, region_min, cluster_min, duplicated, G: int,
+):
+    """Group tensors for ONE binding: (score_g, avail_g, value_g, order).
+
+    Ports _calc_group_score / _calc_group_score_duplicate
+    (group_clusters.go:141-333) as a sorted-prefix scan per region lane.
+    """
+    C = feasible.shape[0]
+    key = _sort_key(score, avail_sel, name_rank, feasible)
+    order = jnp.argsort(key)  # one sort per binding, setup only
+    sorted_feasible = feasible[order]
+    sorted_avail = jnp.where(sorted_feasible, avail_sel[order], 0)
+    sorted_score = jnp.where(sorted_feasible, score[order], 0)
+    sorted_region = jnp.where(sorted_feasible, region_id[order], -1)
+
+    member = sorted_region[None, :] == jnp.arange(G, dtype=jnp.int32)[:, None]
+    cum_avail = jnp.cumsum(jnp.where(member, sorted_avail[None, :], 0), axis=1)
+    cum_cnt = jnp.cumsum(member.astype(jnp.int64), axis=1)
+    cum_score = jnp.cumsum(jnp.where(member, sorted_score[None, :], 0), axis=1)
+
+    value_g = cum_cnt[:, -1]
+    avail_g = cum_avail[:, -1]
+    score_sum_g = cum_score[:, -1]
+
+    # Divided score (group_clusters.go:220-333): walk the group's clusters
+    # in sorted order until >= cluster_min members AND >= target available
+    mg = jnp.maximum(region_min, 1)
+    target_d = -(-replicas // mg)  # ceil, matches math.ceil(replicas/min)
+    target_d = jnp.where(region_min > 0, target_d, replicas)
+    cmin = jnp.maximum(cluster_min, region_min)
+    ok = member & (cum_cnt >= cmin) & (cum_avail >= target_d)
+    has = jnp.any(ok, axis=1)
+    first = jnp.argmax(ok, axis=1)  # first True along the sorted axis
+    gi = jnp.arange(G)
+    valid = cum_cnt[gi, first]
+    # exhausted-walk semantics (group_clusters.go:300-308): only
+    # INSUFFICIENT AVAILABLE demotes the score; a group that merely has
+    # fewer than cluster_min members still scores target*UNIT with the
+    # whole group as `valid`
+    div_score = jnp.where(
+        has,
+        target_d * WEIGHT_UNIT + cum_score[gi, first] // jnp.maximum(valid, 1),
+        jnp.where(
+            avail_g >= target_d,
+            target_d * WEIGHT_UNIT + score_sum_g // jnp.maximum(value_g, 1),
+            avail_g * WEIGHT_UNIT + score_sum_g // jnp.maximum(value_g, 1),
+        ),
+    )
+
+    # Duplicated score (group_clusters.go:141-218)
+    fits = member & (jnp.where(member, sorted_avail[None, :], 0) >= replicas)
+    n_fit = jnp.sum(fits, axis=1)
+    fit_score = jnp.sum(jnp.where(fits, sorted_score[None, :], 0), axis=1)
+    dup_score = jnp.where(
+        n_fit > 0, n_fit * WEIGHT_UNIT + fit_score // jnp.maximum(n_fit, 1), 0
+    )
+
+    score_g = jnp.where(duplicated, dup_score, div_score)
+    score_g = jnp.where(value_g > 0, score_g, 0)
+    return score_g, avail_g, value_g, order
+
+
+_group_info_vmap = jax.vmap(
+    _group_info_one, in_axes=(0, 0, 0, None, None, 0, 0, 0, 0, None)
+)
+
+
+@partial(jax.jit, static_argnames=("G",))
+def spread_group_info(
+    # cluster axis
+    cluster_valid, deleting, name_rank, pods_allowed, has_summary,
+    avail_milli, has_alloc, api_ok, region_id,
+    # request classes
+    req_milli, req_is_cpu, req_pods, est_override,
+    # placement rows
+    pl_mask, pl_tol_bypass,
+    # per spread-binding rows
+    placement_id, gvk_id, class_id, replicas, region_min, cluster_min,
+    duplicated, nw_shortcut, prev_idx, prev_val, evict_idx,
+    *, G: int,
+):
+    """Phase A: per-binding region-group tensors + the per-binding cluster
+    sort order and feasible/availability planes phase B reuses."""
+    B = placement_id.shape[0]
+    C = cluster_valid.shape[0]
+    Q = req_milli.shape[0]
+
+    est_q = _capacity_estimates(
+        req_milli, req_is_cpu, req_pods, avail_milli, has_alloc,
+        pods_allowed, has_summary,
+    )
+    est_q = est_q.at[:Q].set(jnp.where(est_override >= 0, est_override, est_q[:Q]))
+    cid = jnp.where(class_id >= 0, class_id, Q)
+    est_b = est_q[cid]
+    avail_cal = jnp.where(est_b == MAX_INT32, replicas[:, None], est_b)
+    avail_cal = jnp.where(nw_shortcut[:, None], MAX_INT32, avail_cal)
+
+    bidx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    pmask = prev_idx >= 0
+    pic = jnp.where(pmask, prev_idx, 0)
+    prev_rep = (
+        jnp.zeros((B, C), jnp.int64)
+        .at[bidx, pic]
+        .add(jnp.where(pmask, prev_val, 0).astype(jnp.int64))
+    )
+    prev_present = (
+        jnp.zeros((B, C), jnp.int32).at[bidx, pic].add(pmask.astype(jnp.int32)) > 0
+    )
+    emask = evict_idx >= 0
+    eic = jnp.where(emask, evict_idx, 0)
+    evict = (
+        jnp.zeros((B, C), jnp.int32).at[bidx, eic].add(emask.astype(jnp.int32)) > 0
+    )
+
+    lanes_ok = cluster_valid[None, :] & ~deleting[None, :]
+    feasible = (
+        lanes_ok
+        & pl_mask[placement_id]
+        & (pl_tol_bypass[placement_id] | prev_present)
+        & (api_ok[gvk_id] | prev_present)
+        & ~evict
+    )
+    has_prev = jnp.any(prev_present, axis=1)
+    score = jnp.where(
+        has_prev[:, None] & prev_present, 100, 0
+    ).astype(jnp.int64)
+    # group availability includes already-assigned replicas
+    # (group_clusters_with_score: tc.replicas + assigned)
+    avail_sel = avail_cal + prev_rep * prev_present
+
+    score_g, avail_g, value_g, order = _group_info_vmap(
+        feasible, avail_sel, score, name_rank, region_id,
+        replicas, region_min, cluster_min, duplicated, G,
+    )
+    return score_g, avail_g, value_g, order, feasible, avail_sel, score
+
+
+def _pick_one(order, feasible, avail_sel, score, name_rank, region_id,
+              chosen, cluster_max, G: int):
+    """Phase B for ONE binding (select_clusters_by_region.go:27-118):
+    the FIRST cluster of each chosen region is selected; remaining chosen-
+    region clusters are candidates taken in sorted order up to
+    cluster_max total (0 when the cluster constraint is absent)."""
+    C = order.shape[0]
+    sorted_feasible = feasible[order]
+    sorted_region = jnp.where(sorted_feasible, region_id[order], -1)
+    member = sorted_region[None, :] == jnp.arange(G, dtype=jnp.int32)[:, None]
+    member = member & chosen[:, None]
+    any_member = jnp.any(member, axis=1)
+    first = jnp.argmax(member, axis=1)  # first sorted position per group
+    # .max: memberless groups contribute False without clobbering a True
+    # another group scattered to the same (fallback) position
+    is_first = jnp.zeros((C,), bool).at[first].max(any_member)
+    in_chosen = jnp.any(member, axis=0)
+    n_selected = jnp.sum(any_member)
+    total = jnp.sum(in_chosen)
+    need_cnt = jnp.minimum(total, cluster_max)
+    rest_cnt = jnp.maximum(need_cnt - n_selected, 0)
+    cand = in_chosen & ~is_first
+    cand_rank = jnp.cumsum(cand.astype(jnp.int64)) - 1
+    take = cand & (cand_rank < rest_cnt)
+    sel_sorted = is_first | take
+    # back to cluster-lane order
+    sel = jnp.zeros((C,), bool).at[order].set(sel_sorted)
+    return sel
+
+
+_pick_vmap = jax.vmap(_pick_one, in_axes=(0, 0, 0, 0, None, None, 0, 0, None))
+
+
+@partial(jax.jit, static_argnames=("G",))
+def spread_pick_clusters(order, feasible, avail_sel, score, name_rank,
+                         region_id, chosen, cluster_max, *, G: int):
+    return _pick_vmap(order, feasible, avail_sel, score, name_rank,
+                      region_id, chosen, cluster_max, G)
+
+
+def solve_spread(
+    batch,
+    items: Sequence,
+    spread_idx: Sequence[int],
+    waves: int = 1,
+    enable_empty_workload_propagation: bool = False,
+):
+    """Schedule the ROUTE_DEVICE_SPREAD bindings of one chunk.
+
+    Returns {binding_index: List[TargetCluster] | Exception} in the same
+    result vocabulary as tensors.decode_* (serial error classes).
+    """
+    from karmada_tpu.models.work import TargetCluster
+    from karmada_tpu.ops import tensors as T
+    from karmada_tpu.ops.solver import schedule_batch
+
+    if not len(spread_idx):
+        return {}
+    # pad the phase A batch axis so jit signatures stay stable as the
+    # spread-binding count varies chunk to chunk (row 0 repeats as inert
+    # padding: its results are simply never read back)
+    n_spread = len(spread_idx)
+    Bp = T._next_pow2(n_spread, 8)  # noqa: SLF001
+    idx = np.asarray(list(spread_idx) + [spread_idx[0]] * (Bp - n_spread),
+                     np.int64)
+    G = max(len(batch.region_names), 1)
+
+    pid = batch.placement_id[idx]
+    duplicated = batch.pl_strategy[pid] == T.STRAT_DUPLICATED
+    region_min = batch.pl_region_min[pid]
+    region_max = batch.pl_region_max[pid]
+    cluster_min = batch.pl_sc_min[pid]
+    cluster_max = np.where(batch.pl_has_cluster_sc[pid], batch.pl_sc_max[pid], 0)
+
+    score_g, avail_g, value_g, order, feasible, avail_sel, score = (
+        spread_group_info(
+            batch.cluster_valid, batch.deleting, batch.name_rank,
+            batch.pods_allowed, batch.has_summary, batch.avail_milli,
+            batch.has_alloc, batch.api_ok, batch.region_id,
+            batch.req_milli, batch.req_is_cpu, batch.req_pods,
+            batch.est_override,
+            batch.pl_mask, batch.pl_tol_bypass,
+            pid, batch.gvk_id[idx], batch.class_id[idx],
+            batch.replicas[idx], region_min, cluster_min, duplicated,
+            batch.nw_shortcut[idx],
+            batch.prev_idx[idx], batch.prev_val[idx], batch.evict_idx[idx],
+            G=G,
+        )
+    )
+    score_g = np.asarray(score_g)
+    avail_g = np.asarray(avail_g)
+    value_g = np.asarray(value_g)
+    feasible_np = np.asarray(feasible)
+
+    # -- host DFS over G-level scalars: serial.select_groups itself --------
+    out = {}
+    chosen = np.zeros((len(idx), G), bool)
+    for row in range(n_spread):
+        b = idx[row]
+        if not feasible_np[row].any():
+            _, diagnosis = serial.find_clusters_that_fit(
+                items[b][0], items[b][1], batch.cluster_index.clusters
+            )
+            out[int(b)] = serial.FitError(diagnosis)
+            continue
+        groups = [
+            serial._DfsGroup(  # noqa: SLF001 — deliberate reuse of the golden DFS
+                name=batch.region_names[g],
+                value=int(value_g[row, g]),
+                weight=int(score_g[row, g]),
+            )
+            for g in range(G)
+            if value_g[row, g] > 0
+        ]
+        if len(groups) < int(region_min[row]):
+            out[int(b)] = serial.UnschedulableError(
+                "the number of feasible region is less than spreadConstraint.MinGroups"
+            )
+            continue
+        picked = serial.select_groups(
+            groups, int(region_min[row]), int(region_max[row]),
+            int(cluster_min[row]),
+        )
+        if not picked:
+            out[int(b)] = serial.UnschedulableError(
+                "the number of clusters is less than the cluster spreadConstraint.MinGroups"
+            )
+            continue
+        names = {g.name for g in picked}
+        for g in range(G):
+            chosen[row, g] = batch.region_names[g] in names
+
+    live = [r for r in range(n_spread) if int(idx[r]) not in out]
+    if not live:
+        return out
+    # pad phase B's batch axis too (same jit-signature stability)
+    n_live = len(live)
+    live_np = np.asarray(live + [live[0]] * (T._next_pow2(n_live, 8) - n_live),  # noqa: SLF001
+                         np.int64)
+    sel = spread_pick_clusters(
+        np.asarray(order)[live_np], feasible_np[live_np],
+        np.asarray(avail_sel)[live_np], np.asarray(score)[live_np],
+        batch.name_rank, batch.region_id, chosen[live_np],
+        cluster_max[live_np].astype(np.int64), G=G,
+    )
+    sel = np.asarray(sel)[:n_live]
+    live_np = live_np[:n_live]
+
+    # -- assignment: the main kernel with the picked clusters as the mask --
+    Bs = T._next_pow2(len(live), 8)  # noqa: SLF001
+    C = batch.C
+    lidx = idx[live_np]
+    pl_mask = np.zeros((Bs, C), bool)
+    pl_mask[: len(live)] = sel
+    pad = lambda a, fill=0: np.concatenate(  # noqa: E731
+        [a, np.full((Bs - len(live),) + a.shape[1:], fill, a.dtype)]
+    )
+    b_valid = np.zeros(Bs, bool)
+    b_valid[: len(live)] = True
+    rep, selected, status = schedule_batch(
+        batch.cluster_valid, batch.deleting, batch.name_rank,
+        batch.pods_allowed, batch.has_summary, batch.avail_milli,
+        batch.has_alloc, batch.api_ok,
+        batch.req_milli, batch.req_is_cpu, batch.req_pods, batch.est_override,
+        pl_mask,
+        np.ones((Bs, C), bool),  # tolerations already folded into the pick
+        pad(batch.pl_strategy[pid][live_np]),
+        pad(batch.pl_static_w[pid][live_np]),
+        np.zeros(Bs, bool),  # cluster spread consumed by the pick
+        np.zeros(Bs, np.int32), np.zeros(Bs, np.int32),
+        pad(batch.pl_ignore_avail[pid][live_np]),
+        b_valid,
+        np.arange(Bs, dtype=np.int32),  # placement row i belongs to binding i
+        pad(batch.gvk_id[lidx]), pad(batch.class_id[lidx], -1),
+        pad(batch.replicas[lidx]), pad(batch.uid_desc[lidx]),
+        pad(batch.fresh[lidx]), pad(batch.non_workload[lidx]),
+        pad(batch.nw_shortcut[lidx]),
+        pad(batch.prev_idx[lidx], -1), pad(batch.prev_val[lidx]),
+        pad(batch.evict_idx[lidx], -1),
+        waves=waves,
+    )
+    rep = np.asarray(rep)
+    selected = np.asarray(selected)
+    status = np.asarray(status)
+    names = batch.cluster_index.names
+    for row, b in enumerate(lidx):
+        err = T._status_error(batch, int(b), int(status[row]), items)  # noqa: SLF001
+        if err is not None:
+            out[int(b)] = err
+            continue
+        row_rep = rep[row]
+        targets = [
+            TargetCluster(name=names[i], replicas=int(row_rep[i]))
+            for i in np.nonzero(row_rep[: batch.n_clusters] > 0)[0]
+        ]
+        if batch.non_workload[b]:
+            targets = [
+                TargetCluster(name=names[i], replicas=0)
+                for i in np.nonzero(selected[row, : batch.n_clusters])[0]
+            ]
+        elif enable_empty_workload_propagation:
+            have = {t.name for t in targets}
+            targets += [
+                TargetCluster(name=names[i], replicas=0)
+                for i in np.nonzero(selected[row, : batch.n_clusters])[0]
+                if names[i] not in have
+            ]
+        targets.sort(key=lambda t: t.name)
+        out[int(b)] = targets
+    return out

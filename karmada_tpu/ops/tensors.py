@@ -59,11 +59,15 @@ STRAT_NON_WORKLOAD = 4
 
 # route reasons
 ROUTE_DEVICE = 0
-ROUTE_TOPOLOGY_SPREAD = 1  # region/provider/zone spread -> serial DFS
+ROUTE_TOPOLOGY_SPREAD = 1  # provider/zone spread or >16 regions -> serial host
 ROUTE_MULTI_COMPONENT = 2
 ROUTE_UNSUPPORTED = 3
 ROUTE_VANISHED_PREV = 4  # prev assignment names a cluster outside the snapshot
 ROUTE_HUGE_REPLICAS = 5  # replica count beyond the kernel's 2^25 cap
+ROUTE_DEVICE_SPREAD = 6  # region spread: device group math + host DFS
+
+# the device spread path enumerates region groups as fixed lanes
+MAX_DEVICE_REGIONS = 16
 
 # the device kernel clamps seat targets at 2^25-1 (ops/solver._N_CAP) and
 # Webster weights at 2^34-1 (ops/solver._W_CAP); bindings above either cap
@@ -162,6 +166,12 @@ class SolverBatch:
     # host-side routing / metadata
     route: np.ndarray = field(default=None)  # int32[n_bindings] ROUTE_*
     cluster_index: ClusterIndex = field(default=None)
+    # region topology (device spread path, ops/spread.py)
+    region_id: np.ndarray = field(default=None)  # int32[C]; -1 = no region
+    region_names: List[str] = field(default=None)  # vocabulary
+    pl_has_region_sc: np.ndarray = field(default=None)  # bool[P]
+    pl_region_min: np.ndarray = field(default=None)  # int32[P]
+    pl_region_max: np.ndarray = field(default=None)  # int32[P]
 
 
 def _effective_placement(
@@ -190,18 +200,30 @@ def _placement_key(p: Placement) -> str:
     return repr(p)
 
 
-def _route_for(spec: ResourceBindingSpec, placement: Placement) -> int:
+def _route_for(
+    spec: ResourceBindingSpec, placement: Placement, n_regions: int = 0
+) -> int:
     scs = placement.spread_constraints
     if scs and not serial.should_ignore_spread_constraint(placement):
+        has_region = False
         for sc in scs:
             if sc.spread_by_field in (
-                SPREAD_BY_FIELD_REGION,
                 SPREAD_BY_FIELD_PROVIDER,
                 SPREAD_BY_FIELD_ZONE,
             ):
+                # the reference only supports cluster+region selection
+                # (select_clusters.go:55 'just support cluster and region');
+                # provider/zone-bearing placements go host for the identical
+                # UnschedulableError
                 return ROUTE_TOPOLOGY_SPREAD
+            if sc.spread_by_field == SPREAD_BY_FIELD_REGION:
+                has_region = True
             if sc.spread_by_label:
                 return ROUTE_UNSUPPORTED
+        if has_region:
+            if 0 < n_regions <= MAX_DEVICE_REGIONS and len(spec.components) <= 1:
+                return ROUTE_DEVICE_SPREAD
+            return ROUTE_TOPOLOGY_SPREAD
     rs = placement.replica_scheduling
     if rs is not None and rs.weight_preference is not None and any(
         w.weight > KERNEL_WEIGHT_CAP
@@ -266,6 +288,18 @@ def encode_batch(
     # ---- cluster axis -----------------------------------------------------
     cluster_valid = np.zeros(C, bool)
     cluster_valid[:nC] = True
+    # region vocabulary (device spread path routes on its size)
+    region_names: List[str] = []
+    region_ids: Dict[str, int] = {}
+    region_id = np.full(C, -1, np.int32)
+    for i, c in enumerate(clusters):
+        r = c.spec.region
+        if not r:
+            continue
+        if r not in region_ids:
+            region_ids[r] = len(region_names)
+            region_names.append(r)
+        region_id[i] = region_ids[r]
     deleting = np.zeros(C, bool)
     pods_allowed = np.zeros(C, np.int64)
     has_summary = np.zeros(C, bool)
@@ -306,7 +340,7 @@ def encode_batch(
     for b, (spec, status) in enumerate(items):
         placement = _effective_placement(spec, status)
         eff_placements.append(placement)
-        route[b] = _route_for(spec, placement)
+        route[b] = _route_for(spec, placement, len(region_names))
         key = _placement_key(placement)
         if key not in pkeys:
             pkeys[key] = len(placements)
@@ -359,15 +393,16 @@ def encode_batch(
         # of snapshot membership) -- route those bindings to the serial host.
         # Duplicate names keep the LAST entry (serial paths build
         # {name: replicas} dicts, serial.py:658 -- last wins).
+        on_device = (ROUTE_DEVICE, ROUTE_DEVICE_SPREAD)
         prev_by_lane: Dict[int, int] = {}
         for tc in spec.clusters:
             ci = cindex.index.get(tc.name)
             if ci is not None:
                 prev_by_lane[ci] = tc.replicas
-            elif route[b] == ROUTE_DEVICE:
+            elif route[b] in on_device:
                 route[b] = ROUTE_VANISHED_PREV
         prev_entries[b] = list(prev_by_lane.items())
-        if route[b] == ROUTE_DEVICE and (
+        if route[b] in on_device and (
             spec.replicas > KERNEL_REPLICA_CAP
             or any(v > KERNEL_REPLICA_CAP for v in prev_by_lane.values())
         ):
@@ -475,6 +510,9 @@ def encode_batch(
     pl_sc_min = np.zeros(P, np.int32)
     pl_sc_max = np.zeros(P, np.int32)
     pl_ignore_avail = np.zeros(P, bool)
+    pl_has_region_sc = np.zeros(P, bool)
+    pl_region_min = np.zeros(P, np.int32)
+    pl_region_max = np.zeros(P, np.int32)
 
     dummy_status = ResourceBindingStatus()
     for p, placement in enumerate(placements):
@@ -492,6 +530,10 @@ def encode_batch(
                     pl_has_cluster_sc[p] = True
                     pl_sc_min[p] = sc.min_groups
                     pl_sc_max[p] = sc.max_groups
+                elif sc.spread_by_field == SPREAD_BY_FIELD_REGION:
+                    pl_has_region_sc[p] = True
+                    pl_region_min[p] = sc.min_groups
+                    pl_region_max[p] = sc.max_groups
 
         pkey = _placement_key(placement)
         rows = None if cache is None else cache.placement_rows.get(pkey)
@@ -562,6 +604,9 @@ def encode_batch(
         non_workload=non_workload, nw_shortcut=nw_shortcut,
         prev_idx=prev_idx, prev_val=prev_val, evict_idx=evict_idx,
         route=route, cluster_index=cindex,
+        region_id=region_id, region_names=region_names,
+        pl_has_region_sc=pl_has_region_sc, pl_region_min=pl_region_min,
+        pl_region_max=pl_region_max,
     )
 
 

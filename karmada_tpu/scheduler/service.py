@@ -282,6 +282,25 @@ class Scheduler:
                 i for i in range(len(items))
                 if batch.route[i] == tensors.ROUTE_DEVICE
             ]
+            spread_idx = [
+                i for i in range(len(items))
+                if batch.route[i] == tensors.ROUTE_DEVICE_SPREAD
+            ]
+            if spread_idx:
+                from karmada_tpu.ops.spread import solve_spread
+
+                t_sp = time.perf_counter()
+                for i, res in solve_spread(
+                    batch, items, spread_idx, waves=self.waves,
+                    enable_empty_workload_propagation=(
+                        self.enable_empty_workload_propagation
+                    ),
+                ).items():
+                    out[i] = res
+                sched_metrics.STEP_LATENCY.observe(
+                    time.perf_counter() - t_sp,
+                    schedule_step=sched_metrics.STEP_SOLVE,
+                )
             if device_idx:
                 t1 = time.perf_counter()
                 idx, val, status, _nnz = solve_compact(batch, waves=self.waves)
@@ -299,6 +318,7 @@ class Scheduler:
                 )
                 for i in device_idx:
                     out[i] = decoded[i]
+            device_idx = device_idx + spread_idx
         device_set = set(device_idx)
         host_idx = [i for i in range(len(items)) if i not in device_set]
         if host_idx:

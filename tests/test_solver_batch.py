@@ -262,21 +262,30 @@ def test_capacity_matches_general_estimator():
     assert {t.name: t.replicas for t in got} == {t.name: t.replicas for t in want}
 
 
-def test_topology_spread_routes_to_host():
+def test_topology_spread_routing():
     rng = random.Random(3)
     names = ["a", "b"]
     clusters = [mk_cluster(rng, nm) for nm in names]
-    spec = ResourceBindingSpec(
-        resource=ObjectReference(api_version=GVK[0], kind=GVK[1], name="x", uid="u"),
-        replicas=4,
-        placement=Placement(spread_constraints=[
-            SpreadConstraint(spread_by_field="region", min_groups=1, max_groups=2),
-            SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_CLUSTER, min_groups=1, max_groups=2),
-        ]),
-    )
+
+    def spec_with(field):
+        return ResourceBindingSpec(
+            resource=ObjectReference(api_version=GVK[0], kind=GVK[1], name="x", uid="u"),
+            replicas=4,
+            placement=Placement(spread_constraints=[
+                SpreadConstraint(spread_by_field=field, min_groups=1, max_groups=2),
+                SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_CLUSTER, min_groups=1, max_groups=2),
+            ]),
+        )
+
     cindex = tensors.ClusterIndex.build(clusters)
-    batch = tensors.encode_batch([(spec, ResourceBindingStatus())], cindex)
-    assert batch.route[0] == tensors.ROUTE_TOPOLOGY_SPREAD
+    # region spread with few regions: the device spread path (ops/spread.py)
+    batch = tensors.encode_batch([(spec_with("region"), ResourceBindingStatus())], cindex)
+    assert batch.route[0] == tensors.ROUTE_DEVICE_SPREAD
+    # provider/zone spread: host (the reference only selects by
+    # cluster+region; these fail identically on the serial path)
+    for field in ("provider", "zone"):
+        batch = tensors.encode_batch([(spec_with(field), ResourceBindingStatus())], cindex)
+        assert batch.route[0] == tensors.ROUTE_TOPOLOGY_SPREAD
 
 
 def test_jit_signature_stable_across_vocab_churn():

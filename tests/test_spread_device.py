@@ -1,0 +1,133 @@
+"""Golden parity: device region-spread path vs the serial DFS pipeline.
+
+Reference: pkg/scheduler/core/spreadconstraint/{group_clusters.go:220-333,
+select_groups.go:102-230, select_clusters_by_region.go:27-118}.  The device
+path (ops/spread.py) computes grouping/scoring/selection on device and runs
+serial.select_groups over group-level scalars, so results must be
+bit-identical to ops/serial.schedule for every supported input.
+"""
+
+import random
+
+import pytest
+
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.models.policy import (
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_DIVISION_AGGREGATED,
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+    REPLICA_SCHEDULING_DUPLICATED,
+    SPREAD_BY_FIELD_CLUSTER,
+    SPREAD_BY_FIELD_REGION,
+    ClusterPreferences,
+    Placement,
+    ReplicaSchedulingStrategy,
+    SpreadConstraint,
+)
+from karmada_tpu.models.work import ResourceBindingStatus, TargetCluster
+from karmada_tpu.ops import serial, tensors
+from karmada_tpu.ops.spread import solve_spread
+from tests.test_solver_batch import GVK, mk_binding, mk_cluster
+
+
+def mk_region_cluster(rng, name, region):
+    c = mk_cluster(rng, name)
+    c.spec.region = region
+    # the harness randomizes taints/deleting; keep a usable fleet
+    return c
+
+
+def mk_spread_placement(rng, names):
+    region_min = rng.randint(1, 2)
+    scs = [SpreadConstraint(
+        spread_by_field=SPREAD_BY_FIELD_REGION,
+        min_groups=region_min,
+        max_groups=rng.randint(region_min, 3),
+    )]
+    if rng.random() < 0.7:
+        cmin = rng.randint(1, 3)
+        scs.append(SpreadConstraint(
+            spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+            min_groups=cmin, max_groups=rng.randint(cmin, 6),
+        ))
+    strat = rng.choice(["dup", "dynamic", "agg"])
+    if strat == "dup":
+        rs = ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED)
+    elif strat == "dynamic":
+        rs = ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+            weight_preference=ClusterPreferences(
+                dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+        )
+    else:
+        rs = ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_AGGREGATED,
+        )
+    return Placement(spread_constraints=scs, replica_scheduling=rs)
+
+
+def run_parity(seed, n_clusters=13, n_bindings=16, n_regions=4):
+    rng = random.Random(seed)
+    names = [f"member-{i:02d}" for i in range(n_clusters)]
+    regions = [f"region-{r}" for r in range(n_regions)]
+    clusters = [
+        mk_region_cluster(rng, nm, rng.choice(regions)) for nm in names
+    ]
+    placements = [mk_spread_placement(rng, names) for _ in range(4)]
+    items = [mk_binding(rng, b, names, placements) for b in range(n_bindings)]
+
+    estimator = GeneralEstimator()
+    cal = serial.make_cal_available([estimator])
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch(items, cindex, estimator)
+    spread_idx = [
+        i for i in range(len(items))
+        if batch.route[i] == tensors.ROUTE_DEVICE_SPREAD
+    ]
+    assert spread_idx, "scenario must exercise the device spread path"
+    got = solve_spread(batch, items, spread_idx)
+
+    for b in spread_idx:
+        spec, st = items[b]
+        try:
+            want = serial.schedule(spec, st, clusters, cal)
+        except Exception as e:  # noqa: BLE001
+            assert isinstance(got[b], type(e)), (
+                f"seed={seed} b={b}: serial raised {type(e).__name__}, "
+                f"device gave {got[b]!r}"
+            )
+            continue
+        assert not isinstance(got[b], Exception), (
+            f"seed={seed} b={b}: serial={want}, device error {got[b]!r}"
+        )
+        want_map = {tc.name: tc.replicas for tc in want}
+        got_map = {tc.name: tc.replicas for tc in got[b]}
+        assert got_map == want_map, (
+            f"seed={seed} b={b} strat={serial.strategy_type(spec)}: "
+            f"serial={want_map} device={got_map}"
+        )
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_spread_parity_random(seed):
+    run_parity(seed)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_spread_parity_many_regions(seed):
+    run_parity(100 + seed, n_clusters=24, n_bindings=12, n_regions=8)
+
+
+def test_spread_routes_to_host_above_region_cap():
+    rng = random.Random(0)
+    names = [f"m-{i:02d}" for i in range(40)]
+    clusters = [mk_region_cluster(rng, nm, f"r{i}") for i, nm in enumerate(names)]
+    placements = [mk_spread_placement(rng, names)]
+    items = [mk_binding(rng, 0, names, placements)]
+    batch = tensors.encode_batch(items, tensors.ClusterIndex.build(clusters),
+                                 GeneralEstimator())
+    assert batch.route[0] == tensors.ROUTE_TOPOLOGY_SPREAD  # 40 regions > 16
