@@ -1,0 +1,336 @@
+"""karmadactl — the framework's CLI (reference pkg/karmadactl/, 30
+subcommands over the control plane).
+
+Operates on a PERSISTED control plane directory (store/persistence.py):
+every invocation loads the plane, applies the command, pumps the
+controllers to quiescence, and exits — state carries across invocations
+through the snapshot+WAL, the same way karmadactl talks to a long-lived
+apiserver.
+
+Member clusters are capacity simulators; `join` records the simulated
+capacity on the Cluster object so later invocations rehydrate the same
+fleet (the kind-cluster analog of hack/local-up-karmada.sh).
+
+    python -m karmada_tpu.cli --dir ./plane init
+    python -m karmada_tpu.cli --dir ./plane join m1 --cpu 64 --memory-gi 256
+    python -m karmada_tpu.cli --dir ./plane apply -f deployment.yaml
+    python -m karmada_tpu.cli --dir ./plane get ResourceBinding -n default
+    python -m karmada_tpu.cli --dir ./plane get Deployment --cluster m1
+    python -m karmada_tpu.cli --dir ./plane top clusters
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+SIM_CAPACITY_ANNOTATION = "karmada.io/simulated-capacity"
+
+VERSION = "karmada-tpu v0.3"
+
+
+def _load_plane(directory: str, backend: str = "serial"):
+    from karmada_tpu.e2e import ControlPlane
+    from karmada_tpu.models.cluster import Cluster
+
+    cp = ControlPlane(backend=backend, persist_dir=directory)
+    # rehydrate simulated members from their recorded capacity
+    for cluster in cp.store.list(Cluster.KIND):
+        raw = cluster.metadata.annotations.get(SIM_CAPACITY_ANNOTATION)
+        if not raw or cluster.metadata.name in cp.members:
+            continue
+        cap = json.loads(raw)
+        cp.add_member(
+            cluster.metadata.name,
+            cpu_milli=cap.get("cpu_milli", 64_000),
+            memory_gi=cap.get("memory_gi", 256),
+            pods=cap.get("pods", 110),
+            sync_mode=cluster.spec.sync_mode,
+        )
+    if cp.members:
+        cp.tick()  # re-sync member-facing state (RBAC, works) post-rehydrate
+    return cp
+
+
+def _finish(cp) -> None:
+    cp.tick()
+    cp.checkpoint()
+
+
+def cmd_init(args) -> int:
+    cp = _load_plane(args.dir)
+    _finish(cp)
+    print(f"control plane initialized at {args.dir}")
+    return 0
+
+
+def cmd_join(args) -> int:
+    from karmada_tpu.models.cluster import Cluster
+
+    cp = _load_plane(args.dir)
+    if args.name in cp.members:
+        print(f"cluster {args.name} already joined", file=sys.stderr)
+        return 1
+    cp.add_member(
+        args.name, cpu_milli=args.cpu * 1000, memory_gi=args.memory_gi,
+        pods=args.pods, region=args.region, zone=args.zone,
+        provider=args.provider, sync_mode=args.sync_mode,
+    )
+
+    def record(c: Cluster) -> None:
+        c.metadata.annotations[SIM_CAPACITY_ANNOTATION] = json.dumps({
+            "cpu_milli": args.cpu * 1000, "memory_gi": args.memory_gi,
+            "pods": args.pods,
+        })
+    cp.store.mutate(Cluster.KIND, "", args.name, record)
+    _finish(cp)
+    print(f"cluster {args.name} joined ({args.sync_mode} mode)")
+    return 0
+
+
+def cmd_unjoin(args) -> int:
+    cp = _load_plane(args.dir)
+    if args.name not in cp.members:
+        print(f"unknown cluster {args.name}", file=sys.stderr)
+        return 1
+    cp.unjoin(args.name)
+    _finish(cp)
+    print(f"cluster {args.name} unjoined")
+    return 0
+
+
+def _print_table(rows, headers) -> None:
+    widths = [max(len(str(r[i])) for r in rows + [headers]) for i in range(len(headers))]
+    for r in [headers] + rows:
+        print("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+
+
+def cmd_get(args) -> int:
+    cp = _load_plane(args.dir)
+    if args.cluster:
+        handle = cp.proxy(args.cluster)
+        objs = (
+            [handle.get(args.kind, args.namespace, args.name)]
+            if args.name else handle.list(args.kind, args.namespace or None)
+        )
+        objs = [o for o in objs if o is not None]
+    elif args.name:
+        o = cp.store.try_get(args.kind, args.namespace, args.name)
+        objs = [o] if o is not None else []
+    else:
+        objs = cp.store.list(args.kind, args.namespace or None)
+    if args.output == "json":
+        for o in objs:
+            manifest = o.to_manifest() if hasattr(o, "to_manifest") else o.__dict__
+            print(json.dumps(manifest, default=str))
+        return 0
+    rows = [[o.namespace or "-", o.name, type(o).__name__] for o in objs]
+    _print_table(rows, ["NAMESPACE", "NAME", "TYPE"])
+    return 0
+
+
+def cmd_apply(args) -> int:
+    import yaml
+
+    cp = _load_plane(args.dir)
+    with open(args.filename) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    for manifest in docs:
+        cp.apply(manifest)
+        print(f"{manifest.get('kind')}/{manifest['metadata']['name']} applied")
+    _finish(cp)
+    return 0
+
+
+def cmd_promote(args) -> int:
+    """Adopt a member-cluster resource into the federation
+    (pkg/karmadactl/promote)."""
+    from karmada_tpu.interpreter.interpreter import prune_for_propagation
+
+    cp = _load_plane(args.dir)
+    handle = cp.proxy(args.cluster)
+    obj = handle.get(args.kind, args.namespace, args.name)
+    if obj is None:
+        print(f"{args.kind}/{args.name} not found in {args.cluster}", file=sys.stderr)
+        return 1
+    cp.apply(prune_for_propagation(obj.to_manifest()))
+    _finish(cp)
+    print(f"{args.kind}/{args.name} promoted from {args.cluster}")
+    return 0
+
+
+def cmd_cordon(args, uncordon: bool = False) -> int:
+    """cordon/uncordon: the NoSchedule taint (pkg/karmadactl/cordon)."""
+    from karmada_tpu.models.cluster import Cluster, Taint
+
+    cp = _load_plane(args.dir)
+    key = "cluster.karmada.io/cordoned"
+
+    def update(c: Cluster) -> None:
+        c.spec.taints = [t for t in c.spec.taints if t.key != key]
+        if not uncordon:
+            c.spec.taints.append(Taint(key=key, effect="NoSchedule"))
+    try:
+        cp.store.mutate(Cluster.KIND, "", args.name, update)
+    except KeyError:
+        print(f"unknown cluster {args.name}", file=sys.stderr)
+        return 1
+    _finish(cp)
+    print(f"cluster {args.name} {'uncordoned' if uncordon else 'cordoned'}")
+    return 0
+
+
+def cmd_top(args) -> int:
+    from karmada_tpu.models.cluster import Cluster
+
+    cp = _load_plane(args.dir)
+    rows = []
+    for c in cp.store.list(Cluster.KIND):
+        s = c.status.resource_summary
+        if s is None:
+            rows.append([c.name, "-", "-", "-", c.ready])
+            continue
+        cpu_alloc = s.allocatable.get("cpu")
+        cpu_used = s.allocated.get("cpu")
+        pct = (
+            f"{100 * cpu_used.milli // max(cpu_alloc.milli, 1)}%"
+            if cpu_alloc and cpu_used else "-"
+        )
+        rows.append([
+            c.name,
+            f"{cpu_used.milli}m/{cpu_alloc.milli}m" if cpu_alloc else "-",
+            pct,
+            s.allocatable.get("pods", "-"),
+            c.ready,
+        ])
+    _print_table(rows, ["CLUSTER", "CPU(used/alloc)", "CPU%", "PODS", "READY"])
+    return 0
+
+
+def cmd_interpret(args) -> int:
+    """Dry-run interpreter customizations against a manifest
+    (pkg/karmadactl/interpret)."""
+    import yaml
+
+    from karmada_tpu.interpreter.interpreter import ResourceInterpreter
+
+    with open(args.filename) as f:
+        manifest = yaml.safe_load(f)
+    interp = ResourceInterpreter()
+    if args.customization:
+        from karmada_tpu.interpreter.declarative import make_hooks
+        from karmada_tpu.interpreter.interpreter import Customization
+
+        with open(args.customization) as f:
+            cust = yaml.safe_load(f)
+        hooks = make_hooks(cust.get("customizations", {}))
+        interp.register(Customization(
+            api_version=manifest.get("apiVersion", ""),
+            kind=manifest.get("kind", ""),
+            hooks=hooks,
+        ))
+    op = args.operation
+    if op == "InterpretReplica":
+        replicas, req = interp.get_replicas(manifest)
+        print(json.dumps({"replicas": replicas, "requirements": (
+            {k: str(v) for k, v in req.resource_request.items()} if req else None
+        )}))
+    elif op == "InterpretHealth":
+        print(json.dumps({"health": interp.interpret_health(manifest)}))
+    elif op == "ReviseReplica":
+        print(json.dumps(interp.revise_replica(manifest, args.replicas)))
+    elif op == "InterpretStatus":
+        print(json.dumps(interp.reflect_status(manifest)))
+    else:
+        print(f"unsupported operation {op}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_tick(args) -> int:
+    cp = _load_plane(args.dir, backend=args.backend)
+    n = cp.tick()
+    cp.checkpoint()
+    print(f"{n} reconciles")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="karmadactl", description=__doc__)
+    p.add_argument("--dir", required=True, help="control plane directory")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("init")
+    sub.add_parser("version")
+
+    j = sub.add_parser("join")
+    j.add_argument("name")
+    j.add_argument("--cpu", type=int, default=64, help="cores")
+    j.add_argument("--memory-gi", type=int, default=256)
+    j.add_argument("--pods", type=int, default=110)
+    j.add_argument("--region", default="")
+    j.add_argument("--zone", default="")
+    j.add_argument("--provider", default="")
+    j.add_argument("--sync-mode", choices=["Push", "Pull"], default="Push")
+
+    u = sub.add_parser("unjoin")
+    u.add_argument("name")
+
+    g = sub.add_parser("get")
+    g.add_argument("kind")
+    g.add_argument("name", nargs="?")
+    g.add_argument("-n", "--namespace", default="")
+    g.add_argument("--cluster", default="", help="read through the cluster proxy")
+    g.add_argument("-o", "--output", choices=["table", "json"], default="table")
+
+    a = sub.add_parser("apply")
+    a.add_argument("-f", "--filename", required=True)
+
+    pr = sub.add_parser("promote")
+    pr.add_argument("kind")
+    pr.add_argument("name")
+    pr.add_argument("-n", "--namespace", default="")
+    pr.add_argument("--cluster", required=True)
+
+    for cname in ("cordon", "uncordon"):
+        c = sub.add_parser(cname)
+        c.add_argument("name")
+
+    t = sub.add_parser("top")
+    t.add_argument("what", choices=["clusters"])
+
+    i = sub.add_parser("interpret")
+    i.add_argument("-f", "--filename", required=True)
+    i.add_argument("--operation", default="InterpretReplica")
+    i.add_argument("--customization", default="")
+    i.add_argument("--replicas", type=int, default=1)
+
+    tk = sub.add_parser("tick")
+    tk.add_argument("--backend", default="serial")
+    return p
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "version":
+        print(VERSION)
+        return 0
+    return {
+        "init": cmd_init,
+        "join": cmd_join,
+        "unjoin": cmd_unjoin,
+        "get": cmd_get,
+        "apply": cmd_apply,
+        "promote": cmd_promote,
+        "cordon": cmd_cordon,
+        "uncordon": lambda a: cmd_cordon(a, uncordon=True),
+        "top": cmd_top,
+        "interpret": cmd_interpret,
+        "tick": cmd_tick,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -265,6 +265,18 @@ class ClusterStatusController:
                         reason="CollectionSucceed",
                     ))
                     c.status.resource_summary = member.resource_summary()
+                    if c.spec.resource_models:
+                        # feature CustomizedClusterResourceModeling
+                        # (cluster_status_controller.go:282 -> modeling.go)
+                        from karmada_tpu.estimator.general import (
+                            produce_allocatable_modelings,
+                        )
+
+                        c.status.resource_summary.allocatable_modelings = (
+                            produce_allocatable_modelings(
+                                member, c.spec.resource_models
+                            )
+                        )
 
             stored = self.store.mutate(Cluster.KIND, "", name, update)
             self._export_gauges(stored)

@@ -79,6 +79,10 @@ class ControlPlane:
         install_default_webhooks(self.admission, self.store, self.gates)
         self.runtime = Runtime()
         self.members: Dict[str, FakeMemberCluster] = {}
+        # the push-side execution/status controllers only drive PUSH-mode
+        # members; pull members get a per-member KarmadaAgent instead
+        self.push_members: Dict[str, FakeMemberCluster] = {}
+        self.agents: Dict[str, object] = {}
         self.interpreter = ResourceInterpreter()
         self.interpreter.attach_store(self.store)
         self.recorder = EventRecorder()
@@ -89,17 +93,17 @@ class ControlPlane:
             self.store, self.runtime, self.interpreter
         )
         self.execution = ExecutionController(
-            self.store, self.runtime, self.members, self.interpreter,
+            self.store, self.runtime, self.push_members, self.interpreter,
             recorder=self.recorder,
         )
         self.work_status = WorkStatusController(
-            self.store, self.runtime, self.members, self.interpreter
+            self.store, self.runtime, self.push_members, self.interpreter
         )
         self.binding_status = BindingStatusController(
             self.store, self.runtime, self.interpreter
         )
         self.cluster_status = ClusterStatusController(
-            self.store, self.runtime, self.members, recorder=self.recorder
+            self.store, self.runtime, self.push_members, recorder=self.recorder
         )
         self.cluster_taints = ClusterTaintController(self.store, self.runtime)
         # taint-driven evictions pace through the rate-limited queue
@@ -180,6 +184,16 @@ class ControlPlane:
         self.rebalancer = WorkloadRebalancerController(self.store, self.runtime)
         self.taint_policies = ClusterTaintPolicyController(self.store, self.runtime)
         self.remedies = RemedyController(self.store, self.runtime)
+        # agent CSR approval + credential rotation
+        from karmada_tpu.controllers.certificates import (
+            AgentCsrApprover,
+            CertRotationController,
+        )
+
+        self.csr_approver = AgentCsrApprover(self.store, self.runtime,
+                                             clock=self.clock)
+        self.cert_rotation = CertRotationController(self.store, self.runtime,
+                                                    clock=self.clock)
         self.quotas = FederatedResourceQuotaController(self.store, self.runtime)
         # restart story (SURVEY §5 checkpoint/resume): a restored store
         # resyncs every object through freshly wired controllers, exactly
@@ -208,6 +222,7 @@ class ControlPlane:
         region: str = "",
         zone: str = "",
         provider: str = "",
+        sync_mode: str = "Push",
     ) -> FakeMemberCluster:
         member = FakeMemberCluster(
             name=name,
@@ -219,12 +234,28 @@ class ControlPlane:
         if self.store.try_get(Cluster.KIND, "", name) is None:
             cluster = Cluster(
                 metadata=ObjectMeta(name=name),
-                spec=ClusterSpec(region=region, zone=zone, provider=provider),
+                spec=ClusterSpec(region=region, zone=zone, provider=provider,
+                                 sync_mode=sync_mode),
             )
             self.store.create(cluster)
-        # member informers are registered at construction; wire the new one
-        self.work_status.members[name] = member
-        member.store.bus.subscribe(self.work_status._member_event(name))  # noqa: SLF001
+        if sync_mode == "Pull":
+            # pull mode: the control plane cannot reach the member; a
+            # KarmadaAgent inside it drives execution/status instead
+            # (cmd/agent/app/agent.go:140-145), bootstrapping its identity
+            # with a CSR the approver honors (karmadactl register flow)
+            from karmada_tpu.agent import KarmadaAgent
+            from karmada_tpu.controllers.certificates import bootstrap_agent_csr
+
+            bootstrap_agent_csr(self.store, name)
+            self.agents[name] = KarmadaAgent(
+                self.store, member, self.runtime, self.interpreter,
+                recorder=self.recorder,
+            )
+        else:
+            # work_status shares the push_members dict by reference; only
+            # the member-informer subscription needs per-member wiring
+            self.push_members[name] = member
+            member.store.bus.subscribe(self.work_status._member_event(name))  # noqa: SLF001
         # per-member estimator server behind the wire transport (the
         # descheduler's unschedulable counts ride this, never the simulator)
         from karmada_tpu.estimator.server import AccurateEstimatorServer
@@ -234,6 +265,8 @@ class ControlPlane:
         self.descheduler_estimator.register(name, LocalTransport(server.handle))
         self.eps_collect.watch_member(name)
         self.cluster_status.collect_all()
+        for agent in self.agents.values():
+            agent.cluster_status.collect_all()
         return member
 
     def member(self, name: str) -> FakeMemberCluster:
@@ -254,6 +287,10 @@ class ControlPlane:
         self.descheduler_estimator.deregister(name)
         self.work_status.members.pop(name, None)
         self.eps_collect._subscribed.discard(name)  # noqa: SLF001
+        self.push_members.pop(name, None)
+        agent = self.agents.pop(name, None)
+        if agent is not None:
+            agent.stop()
         self.members.pop(name, None)
 
     def proxy(self, cluster: str, subject: str = "system:admin"):

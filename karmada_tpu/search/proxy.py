@@ -39,6 +39,14 @@ class UnifiedAuthController:
         self.subjects: List[str] = ["system:admin"]
         self.worker = runtime.register(AsyncWorker("unified-auth", self._reconcile))
         store.bus.subscribe(self._on_cluster, kind=Cluster.KIND)
+        # resync every round: members rebuilt out-of-band (restart
+        # rehydration) must regain the impersonation RBAC without waiting
+        # for a Cluster event
+        runtime.register_periodic(self._resync)
+
+    def _resync(self) -> None:
+        for c in self.store.list(Cluster.KIND):
+            self.worker.enqueue(c.metadata.name)
 
     def grant(self, subject: str) -> None:
         if subject not in self.subjects:
@@ -53,6 +61,11 @@ class UnifiedAuthController:
         member = self.members.get(cluster_name)
         if member is None:
             return
+        existing = member.get("ClusterRoleBinding", "", IMPERSONATION_RBAC_NAME)
+        if existing is not None:
+            have = [s.get("name") for s in existing.manifest.get("subjects") or []]
+            if have == list(self.subjects):
+                return  # converged: the periodic resync must not churn writes
         member.apply({
             "apiVersion": "rbac.authorization.k8s.io/v1",
             "kind": "ClusterRoleBinding",

@@ -119,9 +119,24 @@ class Runtime:
         self.workers.append(worker)
         return worker
 
+    def unregister(self, worker: AsyncWorker) -> None:
+        """Tear a worker down (e.g. a pull agent leaving): stopped and
+        removed so long-lived planes don't accumulate dead queues."""
+        worker.stop()
+        try:
+            self.workers.remove(worker)
+        except ValueError:
+            pass
+
     def register_periodic(self, fn: Callable[[], None]) -> None:
         """A resync-style hook invoked once per pump round (or per serve tick)."""
         self._periodic.append(fn)
+
+    def unregister_periodic(self, fn: Callable[[], None]) -> None:
+        try:
+            self._periodic.remove(fn)
+        except ValueError:
+            pass
 
     # -- deterministic mode ------------------------------------------------
     def pump(self, max_rounds: int = 200) -> int:

@@ -1,0 +1,143 @@
+"""Pull-mode agent + certificate bootstrap/rotation.
+
+Reference: cmd/agent/app/agent.go:140-145 (the agent runs clusterStatus,
+execution, workStatus controllers inside the member cluster),
+pkg/controllers/certificate/agent_csr_approving.go:59 and
+cert_rotation_controller.go:89.
+"""
+
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.certs import (
+    AGENT_USER_PREFIX,
+    CertificateSigningRequest,
+    ClusterCredential,
+)
+from karmada_tpu.models.cluster import Cluster
+from karmada_tpu.models.policy import (
+    REPLICA_SCHEDULING_DIVIDED,
+    REPLICA_DIVISION_WEIGHTED,
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    ClusterPreferences,
+    ObjectMeta,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ReplicaSchedulingStrategy,
+    ResourceSelector,
+)
+from karmada_tpu.models.work import ResourceBinding
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def nginx(replicas=4):
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "nginx", "namespace": "default"},
+        "spec": {"replicas": replicas, "template": {"spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "100m",
+                                                     "memory": "1Gi"}}}]}}},
+    }
+
+
+def policy():
+    return PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="apps/v1", kind="Deployment")
+            ],
+            placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+            )),
+        ),
+    )
+
+
+def mixed_plane(clock=None):
+    cp = ControlPlane(backend="serial", clock=clock)
+    cp.add_member("push-1", cpu_milli=64_000)
+    cp.add_member("pull-1", cpu_milli=64_000, sync_mode="Pull")
+    cp.tick()
+    return cp
+
+
+def test_pull_member_gets_workload_via_agent():
+    cp = mixed_plane()
+    cp.store.create(policy())
+    cp.apply(nginx())
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    assert {tc.name for tc in rb.spec.clusters} == {"push-1", "pull-1"}
+    # the workload landed in BOTH members — pull via the agent, not the
+    # push execution controller (which does not know the pull member)
+    assert cp.members["pull-1"].get("Deployment", "default", "nginx") is not None
+    assert "pull-1" not in cp.execution.members
+    assert "pull-1" in cp.agents
+
+
+def test_pull_member_status_reflected_by_agent():
+    cp = mixed_plane()
+    cp.store.create(policy())
+    cp.apply(nginx())
+    cp.tick()
+    cp.members["pull-1"].tick()  # member workload turns ready
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    agg = {a.cluster_name: a.status for a in rb.status.aggregated_status}
+    assert "pull-1" in agg and agg["pull-1"].get("readyReplicas", 0) > 0
+    # cluster status heartbeat comes from the agent too
+    cluster = cp.store.get(Cluster.KIND, "", "pull-1")
+    assert cluster.status.resource_summary is not None
+    assert cluster.ready
+
+
+def test_agent_bootstrap_csr_approved_and_credential_issued():
+    cp = mixed_plane()
+    csr = cp.store.get(CertificateSigningRequest.KIND, "", "bootstrap-pull-1")
+    assert csr.status.approved
+    assert csr.status.expires_at is not None
+    cred = cp.store.get(ClusterCredential.KIND, "", "pull-1")
+    assert cred.status.expires_at == csr.status.expires_at
+
+
+def test_csr_with_wrong_identity_denied():
+    cp = mixed_plane()
+    bad = CertificateSigningRequest(metadata=ObjectMeta(name="evil"))
+    bad.spec.cluster = "pull-1"
+    bad.spec.username = "system:karmada:agent:other"
+    cp.store.create(bad)
+    cp.tick()
+    got = cp.store.get(CertificateSigningRequest.KIND, "", "evil")
+    assert not got.status.approved
+    assert got.status.denied_reason
+
+
+def test_certificate_rotation_renews_before_expiry():
+    clock = FakeClock()
+    cp = mixed_plane(clock=clock)
+    cred = cp.store.get(ClusterCredential.KIND, "", "pull-1")
+    ttl = cred.status.expires_at - cred.status.issued_at
+    assert cred.status.rotations == 0
+    # inside the threshold window: no rotation yet
+    clock.advance(ttl * 0.5)
+    cp.tick()
+    assert cp.store.get(ClusterCredential.KIND, "", "pull-1").status.rotations == 0
+    # past 80% of the lifetime: rotation fires, expiry extends
+    clock.advance(ttl * 0.35)
+    cp.tick()
+    rotated = cp.store.get(ClusterCredential.KIND, "", "pull-1")
+    assert rotated.status.rotations >= 1
+    assert rotated.status.expires_at > cred.status.expires_at

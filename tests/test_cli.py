@@ -1,0 +1,158 @@
+"""karmadactl CLI over a persisted control plane directory.
+
+Reference: pkg/karmadactl/ (init/join/unjoin/get/apply/promote/cordon/
+top/interpret subcommands).
+"""
+
+import json
+
+import pytest
+
+from karmada_tpu.cli import main
+
+CONFTEST_ENV_NOTE = "runs on the CPU platform via tests/conftest.py"
+
+
+def run(tmp_path, *argv, capsys=None):
+    rc = main(["--dir", str(tmp_path / "plane"), *argv])
+    return rc
+
+
+def deployment_yaml(tmp_path, replicas=4):
+    p = tmp_path / "deploy.yaml"
+    p.write_text(f"""
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: web
+  namespace: default
+spec:
+  replicas: {replicas}
+  template:
+    spec:
+      containers:
+        - name: c
+          resources:
+            requests:
+              cpu: 100m
+              memory: 1Gi
+""")
+    return str(p)
+
+
+def policy_yaml(tmp_path):
+    # policies are typed objects; drive through apply of the template plus a
+    # store-side policy via the python API is the normal path — the CLI
+    # covers templates, so tests create the policy directly
+    return None
+
+
+def test_init_join_get_roundtrip(tmp_path, capsys):
+    assert run(tmp_path, "init") == 0
+    assert run(tmp_path, "join", "m1", "--cpu", "64") == 0
+    assert run(tmp_path, "join", "m2", "--cpu", "32", "--region", "eu") == 0
+    capsys.readouterr()
+    assert run(tmp_path, "get", "Cluster") == 0
+    out = capsys.readouterr().out
+    assert "m1" in out and "m2" in out
+    # state survives across invocations (each call is a fresh process-load)
+    assert run(tmp_path, "unjoin", "m2") == 0
+    capsys.readouterr()
+    assert run(tmp_path, "get", "Cluster") == 0
+    out = capsys.readouterr().out
+    assert "m2" not in out
+
+
+def test_apply_schedules_through_real_pipeline(tmp_path, capsys):
+    run(tmp_path, "init")
+    run(tmp_path, "join", "m1")
+    run(tmp_path, "join", "m2")
+    # policy via the python API against the same persisted plane
+    from karmada_tpu.cli import _load_plane
+    from karmada_tpu.models.policy import (
+        REPLICA_SCHEDULING_DUPLICATED,
+        ObjectMeta,
+        Placement,
+        PropagationPolicy,
+        PropagationSpec,
+        ReplicaSchedulingStrategy,
+        ResourceSelector,
+    )
+
+    cp = _load_plane(str(tmp_path / "plane"))
+    cp.store.create(PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(api_version="apps/v1",
+                                                 kind="Deployment")],
+            placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED)),
+        ),
+    ))
+    cp.tick()
+    cp.checkpoint()
+
+    assert run(tmp_path, "apply", "-f", deployment_yaml(tmp_path)) == 0
+    capsys.readouterr()
+    assert run(tmp_path, "get", "ResourceBinding", "-n", "default") == 0
+    assert "web-deployment" in capsys.readouterr().out
+    # proxy read: the workload landed in the member
+    assert run(tmp_path, "get", "Deployment", "--cluster", "m1",
+               "-n", "default", "-o", "json") == 0
+    got = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert got["metadata"]["name"] == "web"
+
+
+def test_cordon_taints_cluster(tmp_path, capsys):
+    run(tmp_path, "init")
+    run(tmp_path, "join", "m1")
+    assert run(tmp_path, "cordon", "m1") == 0
+    from karmada_tpu.cli import _load_plane
+    from karmada_tpu.models.cluster import Cluster
+
+    cp = _load_plane(str(tmp_path / "plane"))
+    taints = cp.store.get(Cluster.KIND, "", "m1").spec.taints
+    assert any(t.key == "cluster.karmada.io/cordoned" for t in taints)
+    assert run(tmp_path, "uncordon", "m1") == 0
+    cp = _load_plane(str(tmp_path / "plane"))
+    assert not cp.store.get(Cluster.KIND, "", "m1").spec.taints
+
+
+def test_top_clusters(tmp_path, capsys):
+    run(tmp_path, "init")
+    run(tmp_path, "join", "m1", "--cpu", "8")
+    capsys.readouterr()
+    assert run(tmp_path, "top", "clusters") == 0
+    out = capsys.readouterr().out
+    assert "m1" in out and "8000m" in out
+
+
+def test_interpret_dry_run(tmp_path, capsys):
+    f = deployment_yaml(tmp_path, replicas=7)
+    assert main(["--dir", str(tmp_path / "plane"), "interpret", "-f", f]) == 0
+    got = json.loads(capsys.readouterr().out)
+    assert got["replicas"] == 7
+    assert got["requirements"]["cpu"] == "100m"
+
+
+def test_interpret_with_customization(tmp_path, capsys):
+    m = tmp_path / "widget.yaml"
+    m.write_text("""
+apiVersion: example.io/v1
+kind: Widget
+metadata: {name: w, namespace: default}
+spec: {size: 9}
+""")
+    c = tmp_path / "cust.yaml"
+    c.write_text("""
+customizations:
+  InterpretReplica: "get(obj, 'spec.size', 0)"
+""")
+    assert main(["--dir", str(tmp_path / "plane"), "interpret", "-f", str(m),
+                 "--customization", str(c)]) == 0
+    assert json.loads(capsys.readouterr().out)["replicas"] == 9
+
+
+def test_version(tmp_path, capsys):
+    assert run(tmp_path, "version") == 0
+    assert "karmada-tpu" in capsys.readouterr().out

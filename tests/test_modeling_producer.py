@@ -1,0 +1,89 @@
+"""Resource-modeling PRODUCER: grade histogram from node inventories.
+
+Reference: pkg/modeling/modeling.go:33-246 (AddToResourceSummary/getIndex)
+fed by the cluster-status controller (cluster_status_controller.go:282,
+feature CustomizedClusterResourceModeling).  Round 2 only had the consumer
+math (estimator/general.py); this covers the producing side.
+"""
+
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.estimator.general import produce_allocatable_modelings
+from karmada_tpu.members.member import FakeMemberCluster, FakeNode
+from karmada_tpu.models.cluster import (
+    Cluster,
+    ResourceModel,
+    ResourceModelRange,
+)
+from karmada_tpu.utils.quantity import Quantity
+
+
+def models():
+    gi = 1024 ** 3
+    return [
+        ResourceModel(grade=0, ranges=[
+            ResourceModelRange("cpu", Quantity.from_milli(0), Quantity.from_milli(2000)),
+            ResourceModelRange("memory", Quantity.from_units(0), Quantity.from_units(8 * gi)),
+        ]),
+        ResourceModel(grade=1, ranges=[
+            ResourceModelRange("cpu", Quantity.from_milli(2000), Quantity.from_milli(16000)),
+            ResourceModelRange("memory", Quantity.from_units(8 * gi), Quantity.from_units(64 * gi)),
+        ]),
+        ResourceModel(grade=2, ranges=[
+            ResourceModelRange("cpu", Quantity.from_milli(16000), Quantity.from_milli(1 << 40)),
+            ResourceModelRange("memory", Quantity.from_units(64 * gi), Quantity.from_units(1 << 60)),
+        ]),
+    ]
+
+
+def node(name, cpu_milli, mem_gi, pods=110):
+    return FakeNode(name=name, cpu_milli=cpu_milli,
+                    memory_milli=Quantity.parse(f"{mem_gi}Gi").milli, pods=pods)
+
+
+def test_histogram_counts_nodes_by_grade():
+    member = FakeMemberCluster(name="m1", nodes=[
+        node("small", 1000, 4),     # grade 0
+        node("medium", 8000, 32),   # grade 1
+        node("medium2", 4000, 16),  # grade 1
+        node("large", 32000, 128),  # grade 2
+    ])
+    got = {m.grade: m.count for m in produce_allocatable_modelings(member, models())}
+    assert got == {0: 1, 1: 2, 2: 1}
+
+
+def test_grade_is_minimum_across_axes():
+    """A node with grade-2 cpu but grade-0 memory lands in grade 0
+    (getIndex takes the min over the model's resource axes)."""
+    member = FakeMemberCluster(name="m1", nodes=[node("skewed", 32000, 4)])
+    got = {m.grade: m.count for m in produce_allocatable_modelings(member, models())}
+    assert got == {0: 1, 1: 0, 2: 0}
+
+
+def test_admitted_workloads_shrink_free_capacity():
+    """The histogram models FREE capacity: admitted pods push a node down
+    a grade, exactly what the estimator's consumer math then reads."""
+    member = FakeMemberCluster(name="m1", nodes=[node("medium", 8000, 32)])
+    assert {m.grade: m.count for m in produce_allocatable_modelings(member, models())} \
+        == {0: 0, 1: 1, 2: 0}
+    member.apply({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "hog", "namespace": "default"},
+        "spec": {"replicas": 1, "template": {"spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "7", "memory": "28Gi"}}}]}}},
+    })
+    got = {m.grade: m.count for m in produce_allocatable_modelings(member, models())}
+    assert got == {0: 1, 1: 0, 2: 0}
+
+
+def test_cluster_status_controller_produces_modelings():
+    cp = ControlPlane(backend="serial")
+    m = cp.add_member("m1", cpu_milli=8000, memory_gi=32)
+
+    def set_models(c: Cluster) -> None:
+        c.spec.resource_models = models()
+    cp.store.mutate(Cluster.KIND, "", "m1", set_models)
+    cp.tick()
+    cluster = cp.store.get(Cluster.KIND, "", "m1")
+    histogram = {m.grade: m.count for m in
+                 cluster.status.resource_summary.allocatable_modelings}
+    assert histogram == {0: 0, 1: 1, 2: 0}
