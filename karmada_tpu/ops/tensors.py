@@ -263,6 +263,13 @@ class EncoderCache:
         self.placement_rows: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
         self.gvk_rows: Dict[Tuple[str, str], np.ndarray] = {}
         self.override_rows: Dict[Tuple, np.ndarray] = {}
+        # id(placement) -> (placement, repr key): placements are shared
+        # objects across a cycle's bindings, and repr() of the dataclass
+        # tree dominates warm encode time without this.  The object itself
+        # is pinned in the entry so a GC'd id can never alias a stale key.
+        self.placement_keys: Dict[int, Tuple[object, str]] = {}
+        # cluster lane -> allowed pod count (snapshot-stable per cycle)
+        self.pods_allowed: Optional[np.ndarray] = None
 
 
 def encode_batch(
@@ -301,7 +308,6 @@ def encode_batch(
             region_names.append(r)
         region_id[i] = region_ids[r]
     deleting = np.zeros(C, bool)
-    pods_allowed = np.zeros(C, np.int64)
     has_summary = np.zeros(C, bool)
     name_rank = np.full(C, 0, np.int64)
     name_rank[:nC] = cindex.name_rank
@@ -309,10 +315,18 @@ def encode_batch(
     name_rank[nC:] = np.arange(nC, C)
     for i, c in enumerate(clusters):
         deleting[i] = c.metadata.deleting
-        s = c.status.resource_summary
-        if s is not None:
+        if c.status.resource_summary is not None:
             has_summary[i] = True
-            pods_allowed[i] = _allowed_pods(s)
+    if cache is not None and cache.pods_allowed is not None:
+        pods_allowed = cache.pods_allowed
+    else:
+        pods_allowed = np.zeros(C, np.int64)
+        for i, c in enumerate(clusters):
+            s = c.status.resource_summary
+            if s is not None:
+                pods_allowed[i] = _allowed_pods(s)
+        if cache is not None:
+            cache.pods_allowed = pods_allowed
 
     # resource vocabulary: everything any request mentions
     placements: List[Placement] = []
@@ -341,7 +355,19 @@ def encode_batch(
         placement = _effective_placement(spec, status)
         eff_placements.append(placement)
         route[b] = _route_for(spec, placement, len(region_names))
-        key = _placement_key(placement)
+        # only SHARED placement objects (placement is spec.placement) are
+        # worth memoizing — _effective_placement builds fresh objects for
+        # the affinity-resolution path, which would never hit and would pin
+        # one entry per binding
+        if cache is not None and placement is spec.placement:
+            entry = cache.placement_keys.get(id(placement))
+            if entry is not None and entry[0] is placement:
+                key = entry[1]
+            else:
+                key = _placement_key(placement)
+                cache.placement_keys[id(placement)] = (placement, key)
+        else:
+            key = _placement_key(placement)
         if key not in pkeys:
             pkeys[key] = len(placements)
             placements.append(placement)

@@ -274,7 +274,11 @@ class Scheduler:
         if self.backend == "device" and items:
             t0 = time.perf_counter()
             cindex = tensors.ClusterIndex.build(clusters)
-            batch = tensors.encode_batch(items, cindex, self._general)
+            # per-cycle encoder cache: placement keys dedupe across the
+            # cycle's bindings and the cluster-side rows compute once
+            batch = tensors.encode_batch(
+                items, cindex, self._general, cache=tensors.EncoderCache()
+            )
             sched_metrics.STEP_LATENCY.observe(
                 time.perf_counter() - t0, schedule_step=sched_metrics.STEP_ENCODE
             )
