@@ -123,6 +123,115 @@ class MultiClusterServiceController:
                 self.store.mutate(Work.KIND, wns, work_name, update)
 
 
+class MultiClusterIngressController:
+    """MultiClusterIngress -> per-cluster Ingress Works
+    (pkg/controllers/multiclusteringress): the derived Ingress lands on the
+    clusters serving its backend services — the consumer clusters of each
+    backend's MultiClusterService, or every cluster when no MCS scopes it."""
+
+    def __init__(self, store: ObjectStore, runtime: Runtime) -> None:
+        from karmada_tpu.models.networking import MultiClusterIngress
+
+        self.store = store
+        self.worker = runtime.register(AsyncWorker("mci", self._reconcile))
+        store.bus.subscribe(self._on_event, kind=MultiClusterIngress.KIND)
+        store.bus.subscribe(self._on_mcs, kind=MultiClusterService.KIND)
+        store.bus.subscribe(self._on_cluster, kind="Cluster")
+
+    def _on_event(self, event: Event) -> None:
+        self.worker.enqueue((event.obj.namespace, event.obj.name))
+
+    def _on_mcs(self, event: Event) -> None:
+        from karmada_tpu.models.networking import MultiClusterIngress
+
+        for mci in self.store.list(MultiClusterIngress.KIND, event.obj.namespace):
+            self.worker.enqueue((mci.namespace, mci.name))
+
+    def _on_cluster(self, event: Event) -> None:
+        # membership changes must refresh the "everywhere" fallback scope
+        from karmada_tpu.models.networking import MultiClusterIngress
+
+        for mci in self.store.list(MultiClusterIngress.KIND):
+            self.worker.enqueue((mci.namespace, mci.name))
+
+    def _work_name(self, ns: str, name: str) -> str:
+        from karmada_tpu.ops.webster import fnv32a
+
+        h = fnv32a(f"{ns}/{name}") & 0xFFFF
+        return f"{WORK_PREFIX}-ingress-{ns}-{name}-{h:04x}"
+
+    def _backend_services(self, mci) -> List[str]:
+        names: List[str] = []
+        svc = deep_get(mci.spec.default_backend, "service.name")
+        if svc:
+            names.append(svc)
+        for rule in mci.spec.rules:
+            for path in deep_get(rule, "http.paths", []) or []:
+                svc = deep_get(path, "backend.service.name")
+                if svc and svc not in names:
+                    names.append(svc)
+        return names
+
+    def _target_clusters(self, mci) -> List[str]:
+        from karmada_tpu.models.cluster import Cluster
+
+        all_clusters = [c.name for c in self.store.list(Cluster.KIND)]
+        scoped: List[str] = []
+        any_mcs = False
+        for svc in self._backend_services(mci):
+            mcs = self.store.try_get(MultiClusterService.KIND, mci.namespace, svc)
+            if mcs is None or mcs.metadata.deleting:
+                continue
+            any_mcs = True
+            for n in mcs.consumer_names() or all_clusters:
+                if n not in scoped:
+                    scoped.append(n)
+        return scoped if any_mcs else all_clusters
+
+    def _reconcile(self, key) -> None:
+        from karmada_tpu.models.cluster import Cluster
+        from karmada_tpu.models.networking import MultiClusterIngress
+
+        ns, name = key
+        mci = self.store.try_get(MultiClusterIngress.KIND, ns, name)
+        work_name = self._work_name(ns, name)
+        targets = set()
+        if mci is not None and not mci.metadata.deleting:
+            targets = set(self._target_clusters(mci))
+            manifest = {
+                "apiVersion": "networking.k8s.io/v1",
+                "kind": "Ingress",
+                "metadata": {"name": name, "namespace": ns,
+                             "labels": {"multiclusteringress.karmada.io/name": name}},
+                "spec": {
+                    "rules": copy.deepcopy(mci.spec.rules),
+                    **(
+                        {"defaultBackend": copy.deepcopy(mci.spec.default_backend)}
+                        if mci.spec.default_backend else {}
+                    ),
+                },
+            }
+        for c in self.store.list(Cluster.KIND):
+            wns = execution_namespace(c.name)
+            if c.name not in targets:
+                try:
+                    self.store.delete(Work.KIND, wns, work_name)
+                except NotFoundError:
+                    pass
+                continue
+            existing = self.store.try_get(Work.KIND, wns, work_name)
+            if existing is None:
+                w = Work()
+                w.metadata.namespace = wns
+                w.metadata.name = work_name
+                w.spec = WorkSpec(workload=[manifest])
+                self.store.create(w)
+            else:
+                def update(w: Work) -> None:
+                    w.spec.workload = [manifest]
+                self.store.mutate(Work.KIND, wns, work_name, update)
+
+
 class EndpointSliceCollectController:
     """Provider members' EndpointSlices -> control-plane (cluster-tagged).
 

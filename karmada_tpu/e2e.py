@@ -173,10 +173,12 @@ class ControlPlane:
         from karmada_tpu.controllers.mcs import (
             EndpointSliceCollectController,
             EndpointSliceDispatchController,
+            MultiClusterIngressController,
             MultiClusterServiceController,
         )
 
         self.mcs = MultiClusterServiceController(self.store, self.runtime)
+        self.mci = MultiClusterIngressController(self.store, self.runtime)
         self.eps_collect = EndpointSliceCollectController(
             self.store, self.runtime, self.members
         )

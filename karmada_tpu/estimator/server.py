@@ -63,13 +63,60 @@ def _node_free(member: FakeMemberCluster) -> List[Dict[str, int]]:
     return free
 
 
+def resource_quota_plugin(member: FakeMemberCluster, gates=None):
+    """The resourcequota estimator plugin
+    (server/framework/plugins/resourcequota/resourcequota.go:95-130, behind
+    the ResourceQuotaEstimate feature gate): replicas are additionally
+    capped by the member namespace's ResourceQuota headroom
+    floor((hard - used) / per-replica request), min over quotas."""
+    from karmada_tpu.utils.features import GATES
+    from karmada_tpu.models.meta import deep_get
+    from karmada_tpu.utils.quantity import Quantity
+
+    gates = gates or GATES
+
+    def _headroom(rq_manifest, requirements: ReplicaRequirements) -> int:
+        hard = deep_get(rq_manifest, "spec.hard", {}) or {}
+        used = deep_get(rq_manifest, "status.used", {}) or {}
+        allowed = MAX_INT32
+        for name, qty in requirements.resource_request.items():
+            req = qty.milli
+            if req <= 0:
+                continue
+            raw = hard.get(name, hard.get(f"requests.{name}"))
+            if raw is None:
+                continue
+            used_raw = used.get(name, used.get(f"requests.{name}", 0))
+            free = Quantity.parse(raw).milli - Quantity.parse(used_raw).milli
+            allowed = min(allowed, max(free, 0) // req)
+        return allowed
+
+    def plugin(requirements: Optional[ReplicaRequirements], estimate: int) -> int:
+        if not gates.enabled("ResourceQuotaEstimate"):
+            return estimate
+        if requirements is None or not requirements.namespace:
+            return estimate
+        for rq in member.store.list("ResourceQuota", requirements.namespace):
+            manifest = getattr(rq, "manifest", None)
+            if manifest is None:
+                continue
+            estimate = min(estimate, _headroom(manifest, requirements))
+        return estimate
+
+    return plugin
+
+
 class AccurateEstimatorServer:
     """One server per member cluster (cmd/scheduler-estimator)."""
 
-    def __init__(self, member: FakeMemberCluster) -> None:
+    def __init__(self, member: FakeMemberCluster, gates=None) -> None:
         self.member = member
-        # plugin hooks: each may cap the estimate (resourcequota plugin etc.)
-        self.plugins: List[Callable[[Optional[ReplicaRequirements], int], int]] = []
+        # plugin hooks: each may cap the estimate; the in-tree set mirrors
+        # server/framework/plugins/registry.go:26-30 (noderesource is the
+        # base estimate; resourcequota caps it behind its feature gate)
+        self.plugins: List[Callable[[Optional[ReplicaRequirements], int], int]] = [
+            resource_quota_plugin(member, gates)
+        ]
 
     # -- service methods ----------------------------------------------------
     def max_available_replicas(
@@ -88,12 +135,34 @@ class AccurateEstimatorServer:
     def max_available_component_sets(self, components) -> int:
         """Whole component SETS that fit this member's free capacity
         (wire.max_sets_from_free_table), capped by the quota-style plugins
-        the reference runs (estimate.go:70-90)."""
+        the reference runs (estimate.go:70-90).  Plugins see ONE SET's
+        aggregate demand as the per-"replica" requirement, so quota
+        headroom caps whole sets exactly like single-template replicas."""
         from karmada_tpu.estimator.wire import max_sets_from_free_table
+        from karmada_tpu.estimator.general import per_set_requirement
+        from karmada_tpu.utils.quantity import RESOURCE_CPU, Quantity
 
         total = max_sets_from_free_table(_node_free(self.member), components)
+        namespace = next(
+            (c.replica_requirements.namespace for c in components
+             if c.replica_requirements is not None
+             and c.replica_requirements.namespace),
+            "",
+        )
+        # per_set_requirement units: cpu in milli, everything else in Value
+        per_set = ReplicaRequirements(
+            resource_request={
+                name: (
+                    Quantity.from_milli(v)
+                    if name == RESOURCE_CPU
+                    else Quantity.from_units(v)
+                )
+                for name, v in per_set_requirement(components).items()
+            },
+            namespace=namespace,
+        )
         for plugin in self.plugins:
-            total = min(total, plugin(None, total))
+            total = min(total, plugin(per_set, total))
         return min(total, MAX_INT32)
 
     def unschedulable_replicas(self, kind: str, namespace: str, name: str) -> int:

@@ -271,6 +271,16 @@ class EncoderCache:
         # cluster lane -> allowed pod count (snapshot-stable per cycle)
         self.pods_allowed: Optional[np.ndarray] = None
 
+    def reset_for_cycle(self) -> None:
+        """Drop the STATUS-derived fields before a new cycle's snapshot:
+        pod allowances and modeled-capacity override rows track live usage,
+        and placement-key pins hold the previous cycle's objects.  The
+        spec-derived rows (placement masks) and api-enablement rows survive
+        — their owners invalidate them on their own signatures."""
+        self.pods_allowed = None
+        self.override_rows = {}
+        self.placement_keys = {}
+
 
 def encode_batch(
     items: Sequence[Tuple[ResourceBindingSpec, ResourceBindingStatus]],

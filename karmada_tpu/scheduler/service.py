@@ -261,6 +261,37 @@ class Scheduler:
                 return idx
         return 0
 
+    def _encoder_cache(self, clusters) -> "tensors.EncoderCache":
+        """Warm the encoder across cycles with precise invalidation: the
+        per-cycle status-derived rows always reset; the O(P x C) placement
+        masks survive while no cluster SPEC changed (generation signature);
+        the api-enablement rows survive while enablements are unchanged."""
+        cache = getattr(self, "_enc_cache", None)
+        # generation covers spec changes; labels live in metadata (no
+        # generation bump) yet drive placement label selectors, so they
+        # sign explicitly
+        spec_sig = tuple(
+            (c.name, c.metadata.generation, tuple(sorted(c.metadata.labels.items())))
+            for c in clusters
+        )
+        api_sig = tuple(
+            (c.name, tuple(
+                (e.group_version, tuple(e.resources))
+                for e in c.status.api_enablements
+            ))
+            for c in clusters
+        )
+        if cache is None or spec_sig != getattr(self, "_enc_spec_sig", None):
+            cache = tensors.EncoderCache()
+            self._enc_cache = cache
+            self._enc_spec_sig = spec_sig
+            self._enc_api_sig = api_sig
+        elif api_sig != getattr(self, "_enc_api_sig", None):
+            cache.gvk_rows = {}
+            self._enc_api_sig = api_sig
+        cache.reset_for_cycle()
+        return cache
+
     # -- backend dispatch ---------------------------------------------------
     def _solve(
         self,
@@ -274,10 +305,8 @@ class Scheduler:
         if self.backend == "device" and items:
             t0 = time.perf_counter()
             cindex = tensors.ClusterIndex.build(clusters)
-            # per-cycle encoder cache: placement keys dedupe across the
-            # cycle's bindings and the cluster-side rows compute once
             batch = tensors.encode_batch(
-                items, cindex, self._general, cache=tensors.EncoderCache()
+                items, cindex, self._general, cache=self._encoder_cache(clusters)
             )
             sched_metrics.STEP_LATENCY.observe(
                 time.perf_counter() - t0, schedule_step=sched_metrics.STEP_ENCODE

@@ -136,3 +136,38 @@ def test_scheduler_uses_accurate_estimator():
     out = cal([cluster], spec)
     # general says min(cpu 6, mem 12, pods 20)=6; accurate node-level says 6
     assert out[0].replicas == 6
+
+
+def test_resource_quota_plugin_caps_estimate():
+    """server/framework/plugins/resourcequota behind ResourceQuotaEstimate:
+    the member namespace's ResourceQuota headroom caps the estimate."""
+    from karmada_tpu.estimator.server import AccurateEstimatorServer
+    from karmada_tpu.members.member import FakeMemberCluster
+    from karmada_tpu.models.work import ReplicaRequirements
+    from karmada_tpu.utils.features import FeatureGates
+    from karmada_tpu.utils.quantity import Quantity
+
+    member = FakeMemberCluster(name="m1", cpu_allocatable_milli=64_000)
+    member.apply({
+        "apiVersion": "v1", "kind": "ResourceQuota",
+        "metadata": {"name": "team-a", "namespace": "default"},
+        "spec": {"hard": {"cpu": "2", "memory": "8Gi"}},
+        "status": {"used": {"cpu": "500m"}},
+    })
+    req = ReplicaRequirements(
+        resource_request={"cpu": Quantity.parse("500m"),
+                          "memory": Quantity.parse("1Gi")},
+        namespace="default",
+    )
+    gated_off = AccurateEstimatorServer(member, gates=FeatureGates())
+    assert gated_off.max_available_replicas(req) > 3  # node capacity only
+
+    gates = FeatureGates({"ResourceQuotaEstimate": True})
+    server = AccurateEstimatorServer(member, gates=gates)
+    # quota headroom: (2000m - 500m) / 500m = 3 replicas
+    assert server.max_available_replicas(req) == 3
+    # other namespaces are unaffected
+    req_other = ReplicaRequirements(
+        resource_request={"cpu": Quantity.parse("500m")}, namespace="prod"
+    )
+    assert server.max_available_replicas(req_other) > 3

@@ -165,3 +165,49 @@ def test_provider_scoping(cp):
     cp.members["m3"].apply(endpoint_slice("web-xyz", "web"))
     cp.tick()
     assert cp.store.try_get("EndpointSlice", "default", _collected_name("m3", "default", "web-xyz")) is None
+
+
+def test_mci_renders_ingress_to_consumer_clusters(cp):
+    from karmada_tpu.models.networking import (
+        MultiClusterIngress,
+        MultiClusterIngressSpec,
+    )
+
+    cp.apply(service())
+    cp.store.create(mcs(providers=["m1"], consumers=["m2"]))
+    cp.store.create(MultiClusterIngress(
+        metadata=ObjectMeta(name="web-ingress", namespace="default"),
+        spec=MultiClusterIngressSpec(rules=[{
+            "host": "web.example.com",
+            "http": {"paths": [{"path": "/", "backend": {
+                "service": {"name": "web", "port": {"number": 80}}}}]},
+        }]),
+    ))
+    cp.tick()
+    # the derived Ingress lands on the MCS consumer cluster only
+    ing = cp.members["m2"].get("Ingress", "default", "web-ingress")
+    assert ing is not None
+    assert ing.manifest["spec"]["rules"][0]["host"] == "web.example.com"
+    assert cp.members["m1"].get("Ingress", "default", "web-ingress") is None
+    assert cp.members["m3"].get("Ingress", "default", "web-ingress") is None
+    # deleting the MCI cleans the Works up
+    cp.store.delete(MultiClusterIngress.KIND, "default", "web-ingress")
+    cp.tick()
+    assert cp.members["m2"].get("Ingress", "default", "web-ingress") is None
+
+
+def test_mci_without_mcs_goes_everywhere(cp):
+    from karmada_tpu.models.networking import (
+        MultiClusterIngress,
+        MultiClusterIngressSpec,
+    )
+
+    cp.store.create(MultiClusterIngress(
+        metadata=ObjectMeta(name="wide", namespace="default"),
+        spec=MultiClusterIngressSpec(
+            default_backend={"service": {"name": "web", "port": {"number": 80}}}
+        ),
+    ))
+    cp.tick()
+    for m in ("m1", "m2", "m3"):
+        assert cp.members[m].get("Ingress", "default", "wide") is not None
