@@ -257,6 +257,34 @@ def cmd_tick(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Run the control plane long-lived: every controller on its own
+    thread, periodic hooks on a timer (the karmada-controller-manager /
+    scheduler / webhook processes rolled into one, Runtime.serve)."""
+    import time as _time
+
+    cp = _load_plane(args.dir, backend=args.backend)
+    if args.feature_gates:
+        cp.gates.set_from_string(args.feature_gates)
+    cp.runtime._periodic_interval_s = args.sync_period  # noqa: SLF001
+    cp.runtime.serve()
+    print(f"serving control plane from {args.dir} "
+          f"(backend={args.backend}, {len(cp.members)} members); ctrl-c to stop")
+    try:
+        next_checkpoint = _time.time() + args.checkpoint_period
+        while True:
+            _time.sleep(0.5)
+            if _time.time() >= next_checkpoint:
+                cp.checkpoint()
+                next_checkpoint = _time.time() + args.checkpoint_period
+    except KeyboardInterrupt:
+        pass
+    finally:
+        cp.runtime.stop()
+        cp.checkpoint()
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="karmadactl", description=__doc__)
     p.add_argument("--dir", required=True, help="control plane directory")
@@ -309,6 +337,15 @@ def build_parser() -> argparse.ArgumentParser:
 
     tk = sub.add_parser("tick")
     tk.add_argument("--backend", default="serial")
+
+    sv = sub.add_parser("serve")
+    sv.add_argument("--backend", choices=["serial", "device"], default="device")
+    sv.add_argument("--feature-gates", default="",
+                    help="A=true,B=false (pkg/features registry names)")
+    sv.add_argument("--sync-period", type=float, default=0.5,
+                    help="periodic resync interval seconds")
+    sv.add_argument("--checkpoint-period", type=float, default=30.0,
+                    help="WAL compaction interval seconds")
     return p
 
 
@@ -329,6 +366,7 @@ def main(argv: Optional[list] = None) -> int:
         "top": cmd_top,
         "interpret": cmd_interpret,
         "tick": cmd_tick,
+        "serve": cmd_serve,
     }[args.command](args)
 
 
