@@ -66,7 +66,22 @@ class Scheduler:
         queue: Optional[SchedulingQueue] = None,
         recorder: Optional[ev.EventRecorder] = None,
         waves: int = 8,
+        elector=None,  # utils.leaderelection.LeaderElector (None: always lead)
     ) -> None:
+        self.elector = elector
+        if elector is not None:
+            # takeover must rebuild the queue from the STORE: a standby that
+            # joined late never saw the backlog's events
+            prev_cb = elector.on_started_leading
+
+            def rebuild() -> None:
+                if prev_cb is not None:
+                    prev_cb()
+                for rb in self.store.list(ResourceBinding.KIND):
+                    with self._queue_lock:
+                        self.queue.push((rb.namespace, rb.name), _priority_of(rb))
+                self.worker.enqueue(_CYCLE)
+            elector.on_started_leading = rebuild
         self.recorder = recorder if recorder is not None else ev.EventRecorder()
         self.store = store
         self.backend = backend
@@ -126,7 +141,12 @@ class Scheduler:
                 self.worker.enqueue(_CYCLE)
 
     def _periodic_flush(self) -> None:
-        """Per-tick stand-in for the reference's 1s/30s flush goroutines."""
+        """Per-tick stand-in for the reference's 1s/30s flush goroutines.
+        Doubles as the leader-election heartbeat: a follower renews its
+        candidacy but never drains the queue (standby scheduler replicas,
+        SURVEY §5 leader election)."""
+        if self.elector is not None and not self.elector.tick():
+            return
         with self._queue_lock:
             moved = self.queue.flush_backoff()
             moved += self.queue.flush_unschedulable_leftover()
@@ -150,6 +170,8 @@ class Scheduler:
 
     # -- the batched cycle --------------------------------------------------
     def _cycle(self, _key) -> None:
+        if self.elector is not None and not self.elector.is_leader():
+            return  # standby: bindings stay queued; flush re-drives on takeover
         cycle_start = time.perf_counter()
         with self._queue_lock:
             self.queue.flush_backoff()

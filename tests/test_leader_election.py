@@ -1,0 +1,143 @@
+"""Leader election: HA scheduler replicas over one store.
+
+Reference: every karmada binary runs controller-runtime leader election on
+a coordination.k8s.io Lease so exactly one replica acts (SURVEY §5
+checkpoint/resume: stateless components + leader election).
+"""
+
+from karmada_tpu.store.store import ObjectStore
+from karmada_tpu.utils.leaderelection import LeaderElector, Lease
+
+
+class FakeClock:
+    def __init__(self, t=1_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def test_first_candidate_wins_and_renews():
+    store = ObjectStore()
+    clock = FakeClock()
+    a = LeaderElector(store, "scheduler", "a", lease_duration_s=10, clock=clock)
+    b = LeaderElector(store, "scheduler", "b", lease_duration_s=10, clock=clock)
+    assert a.tick() and not b.tick()
+    clock.advance(5)
+    assert a.tick()  # renewal extends the lease
+    clock.advance(8)
+    assert not b.tick()  # still within a's renewed duration
+    assert a.is_leader() and not b.is_leader()
+
+
+def test_takeover_after_expiry():
+    store = ObjectStore()
+    clock = FakeClock()
+    a = LeaderElector(store, "scheduler", "a", lease_duration_s=10, clock=clock)
+    b = LeaderElector(store, "scheduler", "b", lease_duration_s=10, clock=clock)
+    assert a.tick()
+    clock.advance(11)  # a stopped renewing
+    assert b.tick()
+    assert b.is_leader()
+    # a comes back: sees b's fresh lease, steps down
+    assert not a.tick()
+    assert not a.is_leader()
+
+
+def test_graceful_release_hands_over_immediately():
+    store = ObjectStore()
+    clock = FakeClock()
+    a = LeaderElector(store, "scheduler", "a", lease_duration_s=10, clock=clock)
+    b = LeaderElector(store, "scheduler", "b", lease_duration_s=10, clock=clock)
+    assert a.tick()
+    a.release()
+    assert b.tick()  # no expiry wait needed
+    assert b.is_leader()
+
+
+def test_callbacks_fire_on_transitions():
+    store = ObjectStore()
+    clock = FakeClock()
+    events = []
+    a = LeaderElector(store, "s", "a", lease_duration_s=10, clock=clock,
+                      on_started_leading=lambda: events.append("a-start"),
+                      on_stopped_leading=lambda: events.append("a-stop"))
+    b = LeaderElector(store, "s", "b", lease_duration_s=10, clock=clock,
+                      on_started_leading=lambda: events.append("b-start"))
+    a.tick()
+    clock.advance(11)
+    b.tick()
+    a.tick()
+    assert events == ["a-start", "b-start", "a-stop"]
+
+
+def test_standby_scheduler_takes_over_queued_work():
+    """Two schedulers over one store: only the leader drains; killing its
+    renewals hands the queue to the standby."""
+    from karmada_tpu.e2e import ControlPlane
+    from karmada_tpu.models.policy import (
+        REPLICA_SCHEDULING_DUPLICATED,
+        ObjectMeta,
+        Placement,
+        PropagationPolicy,
+        PropagationSpec,
+        ReplicaSchedulingStrategy,
+        ResourceSelector,
+    )
+    from karmada_tpu.models.work import ResourceBinding
+    from karmada_tpu.scheduler import Scheduler
+    from karmada_tpu.store.worker import Runtime
+
+    clock = FakeClock()
+    cp = ControlPlane(backend="serial", clock=clock)
+    # replace the built-in always-leader scheduler with two elected replicas
+    cp.scheduler.elector = LeaderElector(
+        cp.store, "scheduler", "replica-1", lease_duration_s=10, clock=clock
+    )
+    standby_runtime = Runtime()
+    standby = Scheduler(cp.store, standby_runtime, backend="serial",
+                        elector=LeaderElector(cp.store, "scheduler",
+                                              "replica-2", lease_duration_s=10,
+                                              clock=clock))
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.tick()
+    standby_runtime.tick()
+    cp.store.create(PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="apps/v1", kind="Deployment")
+            ],
+            placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED)),
+        ),
+    ))
+    cp.apply({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"replicas": 2},
+    })
+    cp.tick()
+    standby_runtime.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "web-deployment")
+    assert rb.spec.clusters, "leader replica must schedule"
+
+    # leader dies (stops renewing: only the standby runtime keeps ticking)
+    cp.apply({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "web2", "namespace": "default"},
+        "spec": {"replicas": 2},
+    })
+    # detector etc. still run (they are not elected here); the dead
+    # scheduler's queue entry exists but its cycles no longer fire
+    cp.scheduler.elector._leading = False  # noqa: SLF001 — simulate crash
+    cp.scheduler.elector.tick = lambda: False
+    clock.advance(11)
+    cp.tick()
+    standby_runtime.tick()
+    rb2 = cp.store.get(ResourceBinding.KIND, "default", "web2-deployment")
+    assert rb2.spec.clusters, "standby must take over after lease expiry"
+    assert standby.elector.is_leader()
