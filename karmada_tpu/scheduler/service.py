@@ -77,9 +77,17 @@ class Scheduler:
             def rebuild() -> None:
                 if prev_cb is not None:
                     prev_cb()
-                for rb in self.store.list(ResourceBinding.KIND):
-                    with self._queue_lock:
-                        self.queue.push((rb.namespace, rb.name), _priority_of(rb))
+                # same discipline as the Cluster-event resync: resident keys
+                # keep their queue/backoff state (a leadership flap must not
+                # grant failing bindings an extra immediate attempt), and
+                # already-converged bindings stay out
+                with self._queue_lock:
+                    for rb in self.store.list(ResourceBinding.KIND):
+                        key = (rb.namespace, rb.name)
+                        if self.queue.has(key):
+                            continue
+                        if not rb.spec.clusters or self._needs_schedule(rb):
+                            self.queue.push(key, _priority_of(rb))
                 self.worker.enqueue(_CYCLE)
             elector.on_started_leading = rebuild
         self.recorder = recorder if recorder is not None else ev.EventRecorder()

@@ -1,0 +1,60 @@
+"""Pluggable search backend stores.
+
+Reference: pkg/search/backendstore/{defaultstore,opensearch}.go:127-193 —
+each ResourceRegistry may name a backend sink; the default store is the
+in-memory cache itself, and external backends (the reference ships an
+OpenSearch client) receive every cached upsert/delete for offboard
+indexing.  External engines are not bundled here; the seam is the point:
+`register_backend_factory("OpenSearch", ...)` plugs one in without
+touching the cache.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from karmada_tpu.models.search import BackendStoreConfig
+from karmada_tpu.models.unstructured import Unstructured
+
+
+class BackendStore:
+    """One registry's sink (backendstore.BackendStore)."""
+
+    def upsert(self, cluster: str, obj: Unstructured) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def delete(self, cluster: str, obj: Unstructured) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class DefaultBackend(BackendStore):
+    """The in-memory default (defaultstore.go): the cache IS the store, so
+    the sink only needs to exist as a no-op landing point."""
+
+    def upsert(self, cluster: str, obj: Unstructured) -> None:
+        pass
+
+    def delete(self, cluster: str, obj: Unstructured) -> None:
+        pass
+
+
+_FACTORIES: Dict[str, Callable[[BackendStoreConfig], BackendStore]] = {
+    "Default": lambda cfg: DefaultBackend(),
+}
+
+
+def register_backend_factory(
+    kind: str, factory: Callable[[BackendStoreConfig], BackendStore]
+) -> None:
+    _FACTORIES[kind] = factory
+
+
+def make_backend(cfg: Optional[BackendStoreConfig]) -> BackendStore:
+    cfg = cfg or BackendStoreConfig()
+    factory = _FACTORIES.get(cfg.kind)
+    if factory is None:
+        raise ValueError(
+            f"unknown backend store kind {cfg.kind!r} "
+            f"(registered: {sorted(_FACTORIES)})"
+        )
+    return factory(cfg)

@@ -42,6 +42,11 @@ class MultiClusterCache:
         self._synced: set = set()  # pairs whose initial list completed
         self._subscribed: set = set()  # clusters whose bus we watch
         self._watchers: List[Callable[[str, Unstructured, str], None]] = []
+        # registry name -> (config signature, BackendStore, selected pairs).
+        # Instances persist across reconciles (an external client may hold
+        # connections/buffers) and each backend only sees ITS registry's
+        # (cluster, kind) selections.
+        self._backends: Dict[str, Tuple[str, object, set]] = {}
         self.worker = runtime.register(AsyncWorker("search-cache", self._reconcile))
         store.bus.subscribe(self._on_event, kind=ResourceRegistry.KIND)
         store.bus.subscribe(self._on_cluster_event, kind=Cluster.KIND)
@@ -56,8 +61,12 @@ class MultiClusterCache:
     def _reconcile(self, key) -> None:
         """Recompute the (cluster, kind) selection set from all registries
         and (re)build the index for newly selected pairs."""
+        from karmada_tpu.search.backend import make_backend
+
         clusters = self.store.list(Cluster.KIND)
         selected: Dict[Tuple[str, str], int] = {}
+        new_backends: Dict[str, Tuple[str, object, set]] = {}
+        replay: List[Tuple[object, set]] = []
         for reg in self.store.list(ResourceRegistry.KIND):
             if reg.metadata.deleting:
                 continue
@@ -65,10 +74,37 @@ class MultiClusterCache:
                 c.name for c in clusters
                 if reg.spec.target_cluster.matches(c)
             ]
+            pairs = set()
             for sel in reg.spec.resource_selectors:
                 for cname in targets:
                     k = (cname, sel.kind)
                     selected[k] = selected.get(k, 0) + 1
+                    pairs.add(k)
+            sig = repr(reg.spec.backend_store)
+            prev = self._backends.get(reg.metadata.name)
+            if prev is not None and prev[0] == sig:
+                backend = prev[1]
+                added_pairs = pairs - prev[2]
+            else:
+                try:
+                    backend = make_backend(reg.spec.backend_store)
+                except ValueError:
+                    continue  # unknown external backend: cache still serves
+                added_pairs = set(pairs)
+            new_backends[reg.metadata.name] = (sig, backend, pairs)
+            if added_pairs:
+                # a backend gaining pairs must receive the EXISTING cached
+                # objects for them, like the informer's initial list — not
+                # just future deltas
+                replay.append((backend, added_pairs))
+        self._backends = new_backends
+        if replay:
+            with self._lock:
+                entries = list(self._index.items())
+            for (backend, pairs) in replay:
+                for (kind, cname, _, _), obj in entries:
+                    if (cname, kind) in pairs:
+                        backend.upsert(cname, copy.deepcopy(obj))
         with self._lock:
             dropped = set(self._selected) - set(selected)
             self._selected = selected
@@ -121,12 +157,18 @@ class MultiClusterCache:
         )[CACHED_FROM_ANNOTATION] = cname
         with self._lock:
             self._index[(obj.KIND, cname, obj.namespace, obj.name)] = cached
+        for (_, backend, pairs) in list(self._backends.values()):
+            if (cname, obj.KIND) in pairs:
+                backend.upsert(cname, cached)
         for w in list(self._watchers):
             w("UPSERT", cached, cname)
 
     def _remove(self, cname: str, obj) -> None:
         with self._lock:
             self._index.pop((obj.KIND, cname, obj.namespace, obj.name), None)
+        for (_, backend, pairs) in list(self._backends.values()):
+            if (cname, obj.KIND) in pairs:
+                backend.delete(cname, obj)
         for w in list(self._watchers):
             w("DELETE", obj, cname)
 

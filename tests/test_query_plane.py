@@ -169,3 +169,133 @@ def test_metrics_provider_skips_unhealthy(cp):
     cp.members["m3"].healthy = False
     samples = cp.metrics_provider.pod_metrics("Deployment", "default", "web")
     assert {s["cluster"] for s in samples} == {"m1", "m2"}
+
+
+def test_backend_store_receives_cache_events(cp):
+    """backendstore seam (pkg/search/backendstore): a registry naming a
+    registered external backend kind streams every cached upsert/delete."""
+    from karmada_tpu.models.search import BackendStoreConfig
+    from karmada_tpu.search.backend import BackendStore, register_backend_factory
+
+    events = []
+
+    class Recording(BackendStore):
+        def upsert(self, cluster, obj):
+            events.append(("upsert", cluster, obj.name))
+
+        def delete(self, cluster, obj):
+            events.append(("delete", cluster, obj.name))
+
+    register_backend_factory("Recording", lambda cfg: Recording())
+    reg = registry(clusters=["m1"])
+    reg.spec.backend_store = BackendStoreConfig(kind="Recording")
+    cp.store.create(reg)
+    cp.tick()
+    cp.members["m1"].apply(deployment("indexed"))
+    assert ("upsert", "m1", "indexed") in events
+    cp.members["m1"].delete("Deployment", "default", "indexed")
+    assert ("delete", "m1", "indexed") in events
+
+
+def test_unknown_backend_kind_does_not_break_cache(cp):
+    from karmada_tpu.models.search import BackendStoreConfig
+
+    reg = registry(clusters=["m1"])
+    reg.spec.backend_store = BackendStoreConfig(kind="OpenSearch")  # not bundled
+    cp.store.create(reg)
+    cp.tick()
+    cp.members["m1"].apply(deployment("still-cached"))
+    assert cp.search_cache.get("Deployment", "default", "still-cached") is not None
+
+
+def test_backend_replays_existing_objects_on_late_registration(cp):
+    """A backend added for an already-synced pair receives the initial
+    list, not just future deltas (backendstore informer semantics)."""
+    from karmada_tpu.models.meta import ObjectMeta as OM
+    from karmada_tpu.models.search import (
+        BackendStoreConfig,
+        ResourceRegistry,
+        ResourceRegistrySelector,
+        ResourceRegistrySpec,
+    )
+    from karmada_tpu.search.backend import BackendStore, register_backend_factory
+
+    cp.store.create(registry(clusters=["m1"]))
+    cp.tick()
+    cp.members["m1"].apply(deployment("pre-existing"))
+
+    events = []
+
+    class Late(BackendStore):
+        def upsert(self, cluster, obj):
+            events.append((cluster, obj.name))
+
+        def delete(self, cluster, obj):
+            pass
+
+    register_backend_factory("Late", lambda cfg: Late())
+    cp.store.create(ResourceRegistry(
+        metadata=OM(name="late-reg"),
+        spec=ResourceRegistrySpec(
+            resource_selectors=[
+                ResourceRegistrySelector(api_version="apps/v1", kind="Deployment")
+            ],
+            backend_store=BackendStoreConfig(kind="Late"),
+        ),
+    ))
+    cp.tick()
+    assert ("m1", "pre-existing") in events
+
+
+def test_backend_scoped_to_its_registry_pairs(cp):
+    """Backends only see THEIR registry's (cluster, kind) selections —
+    another registry's resources never leak into an external sink."""
+    from karmada_tpu.models.meta import ObjectMeta as OM
+    from karmada_tpu.models.search import (
+        BackendStoreConfig,
+        ResourceRegistry,
+        ResourceRegistrySelector,
+        ResourceRegistrySpec,
+    )
+    from karmada_tpu.models.policy import ClusterAffinity
+    from karmada_tpu.search.backend import BackendStore, register_backend_factory
+
+    events = []
+
+    class Scoped(BackendStore):
+        def upsert(self, cluster, obj):
+            events.append((cluster, obj.KIND, obj.name))
+
+        def delete(self, cluster, obj):
+            pass
+
+    register_backend_factory("Scoped", lambda cfg: Scoped())
+    cp.store.create(ResourceRegistry(
+        metadata=OM(name="m1-deployments"),
+        spec=ResourceRegistrySpec(
+            target_cluster=ClusterAffinity(cluster_names=["m1"]),
+            resource_selectors=[
+                ResourceRegistrySelector(api_version="apps/v1", kind="Deployment")
+            ],
+            backend_store=BackendStoreConfig(kind="Scoped"),
+        ),
+    ))
+    cp.store.create(ResourceRegistry(
+        metadata=OM(name="m2-secrets"),
+        spec=ResourceRegistrySpec(
+            target_cluster=ClusterAffinity(cluster_names=["m2"]),
+            resource_selectors=[
+                ResourceRegistrySelector(api_version="v1", kind="Secret")
+            ],
+        ),
+    ))
+    cp.tick()
+    cp.members["m2"].apply({
+        "apiVersion": "v1", "kind": "Secret",
+        "metadata": {"name": "db-creds", "namespace": "default"},
+        "data": {"p": "x"},
+    })
+    cp.members["m1"].apply(deployment("mine"))
+    kinds = {(c, k) for (c, k, _) in events}
+    assert ("m1", "Deployment") in kinds
+    assert ("m2", "Secret") not in kinds, "other registry's resources leaked"
