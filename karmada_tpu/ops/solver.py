@@ -577,15 +577,37 @@ def schedule_batch(
     )
 
 
+# Single-generation device-transfer cache for the chunk-stable cluster-side
+# tensors: the encoder hands back the SAME (frozen) numpy objects across
+# chunks of a cycle (EncoderCache.assembled), so their device copies upload
+# once per cycle instead of once per chunk (~5MB/chunk over a 36MB/s link).
+# One slot only — keyed by the identity of the whole arg tuple's first
+# member and holding the numpy refs so a GC'd id can never alias — so a
+# long-running service retains exactly one stale-free generation.
+_DEVICE_SLOT: list = [None]  # (cluster_args_np_tuple, cluster_args_dev_tuple)
+
+_CLUSTER_FIELDS = (
+    "cluster_valid", "deleting", "name_rank", "pods_allowed", "has_summary",
+    "avail_milli", "has_alloc", "api_ok",
+    "req_milli", "req_is_cpu", "req_pods", "est_override",
+    "pl_mask", "pl_tol_bypass", "pl_strategy", "pl_static_w",
+    "pl_has_cluster_sc", "pl_sc_min", "pl_sc_max", "pl_ignore_avail",
+)
+
+
+def _cluster_args(batch):
+    np_args = tuple(getattr(batch, f) for f in _CLUSTER_FIELDS)
+    slot = _DEVICE_SLOT[0]
+    if slot is not None and all(a is b for a, b in zip(slot[0], np_args)):
+        return slot[1]
+    dev = tuple(jax.device_put(a) for a in np_args)
+    _DEVICE_SLOT[0] = (np_args, dev)
+    return dev
+
+
 def _batch_args(batch):
-    return (
-        batch.cluster_valid, batch.deleting, batch.name_rank,
-        batch.pods_allowed, batch.has_summary, batch.avail_milli,
-        batch.has_alloc, batch.api_ok,
-        batch.req_milli, batch.req_is_cpu, batch.req_pods, batch.est_override,
-        batch.pl_mask, batch.pl_tol_bypass, batch.pl_strategy,
-        batch.pl_static_w, batch.pl_has_cluster_sc, batch.pl_sc_min,
-        batch.pl_sc_max, batch.pl_ignore_avail,
+    return _cluster_args(batch) + (
+        # binding-axis tensors change every chunk: no caching value
         batch.b_valid, batch.placement_id, batch.gvk_id, batch.class_id,
         batch.replicas, batch.uid_desc, batch.fresh, batch.non_workload,
         batch.nw_shortcut, batch.prev_idx, batch.prev_val, batch.evict_idx,
