@@ -601,7 +601,15 @@ def _cluster_args(batch):
     if slot is not None and all(a is b for a, b in zip(slot[0], np_args)):
         return slot[1]
     dev = tuple(jax.device_put(a) for a in np_args)
-    _DEVICE_SLOT[0] = (np_args, dev)
+    # only cache FROZEN arrays (encode_batch(cache=...) sets writeable=False):
+    # a mutable array could be modified in place between solves and the
+    # identity check would then serve a stale device copy
+    import numpy as _np
+
+    if all(
+        not (isinstance(a, _np.ndarray) and a.flags.writeable) for a in np_args
+    ):
+        _DEVICE_SLOT[0] = (np_args, dev)
     return dev
 
 

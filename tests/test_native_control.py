@@ -1,0 +1,254 @@
+"""Golden parity: the C++ serial control vs the Python serial pipeline.
+
+The native control (karmada_tpu/native/serial_solver.cc) must agree with
+ops/serial.schedule binding-for-binding — same targets, same failure class —
+over the bench scenario mix and adversarial corners (taints, affinities,
+scale up/down, fresh reschedule, region spread DFS).  bench.py's
+``vs_baseline`` is only honest if this holds.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from karmada_tpu import native
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.models.cluster import (
+    EFFECT_NO_SCHEDULE,
+    APIEnablement,
+    Cluster,
+    ClusterSpec,
+    ClusterStatus,
+    ResourceSummary,
+    Taint,
+)
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_DIVISION_AGGREGATED,
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+    REPLICA_SCHEDULING_DUPLICATED,
+    SPREAD_BY_FIELD_CLUSTER,
+    SPREAD_BY_FIELD_REGION,
+    ClusterAffinity,
+    ClusterPreferences,
+    Placement,
+    ReplicaSchedulingStrategy,
+    StaticClusterWeight,
+    Toleration,
+)
+from karmada_tpu.models.work import (
+    ObjectReference,
+    ReplicaRequirements,
+    ResourceBindingSpec,
+    ResourceBindingStatus,
+    TargetCluster,
+)
+from karmada_tpu.ops import serial
+from karmada_tpu.utils.quantity import Quantity
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native solver unavailable: {native.build_error()}"
+)
+
+GVK = ("apps/v1", "Deployment")
+
+
+def mk_cluster(name, region="", provider="", cpu=32000, mem=128, pods=110,
+               taints=(), deleting=False, no_summary=False):
+    return Cluster(
+        metadata=ObjectMeta(name=name,
+                            deletion_timestamp=1.0 if deleting else None),
+        spec=ClusterSpec(region=region, provider=provider, taints=list(taints)),
+        status=ClusterStatus(
+            api_enablements=[APIEnablement(GVK[0], [GVK[1]])],
+            resource_summary=None if no_summary else ResourceSummary(
+                allocatable={
+                    "cpu": Quantity.from_milli(cpu),
+                    "memory": Quantity.from_units(mem),
+                    "pods": Quantity.from_units(pods),
+                },
+                allocated={},
+            ),
+        ),
+    )
+
+
+def mk_binding(name, placement, replicas=10, cpu_m=250, prev=(), uid=None,
+               fresh=False):
+    spec = ResourceBindingSpec(
+        resource=ObjectReference(
+            api_version=GVK[0], kind=GVK[1], namespace="default",
+            name=name, uid=uid or f"uid-{name}",
+        ),
+        replicas=replicas,
+        replica_requirements=ReplicaRequirements(
+            resource_request={"cpu": Quantity.from_milli(cpu_m)}
+        ),
+        placement=placement,
+        clusters=[TargetCluster(name=n, replicas=r) for n, r in prev],
+        reschedule_triggered_at=100.0 if fresh else None,
+    )
+    return spec, ResourceBindingStatus()
+
+
+def assert_parity(items, clusters):
+    est = GeneralEstimator()
+    cal = serial.make_cal_available([est])
+    snap = native.NativeSnapshot(clusters, native.collect_res_names(items))
+    got = native.schedule_batch_native(items, snap)
+    for (spec, status), (st, targets) in zip(items, got):
+        assert st != native.STATUS_UNSUPPORTED, (
+            f"{spec.resource.name}: unexpectedly unsupported"
+        )
+        try:
+            want = serial.schedule(spec, status, clusters, cal)
+            want_d = {tc.name: tc.replicas for tc in want}
+            want_st = native.STATUS_OK
+        except serial.FitError:
+            want_d, want_st = {}, native.STATUS_FIT_ERROR
+        except serial.UnschedulableError:
+            want_d, want_st = {}, native.STATUS_UNSCHEDULABLE
+        except serial.NoClusterAvailableError:
+            want_d, want_st = {}, native.STATUS_NO_CLUSTER
+        got_d = {tc.name: tc.replicas for tc in targets}
+        assert st == want_st, (spec.resource.name, st, want_st)
+        if st == native.STATUS_OK:
+            assert got_d == want_d, (spec.resource.name, got_d, want_d)
+
+
+def test_bench_mix_parity():
+    import bench
+
+    rng = random.Random(7)
+    clusters = bench.build_fleet(rng, 96)
+    placements = bench.build_placements(rng, [c.name for c in clusters])
+    items = bench.build_bindings(rng, 384, placements)
+    assert_parity(items, clusters)
+
+
+def test_taints_affinity_and_static_weights():
+    taint = Taint(key="maintenance", value="true", effect=EFFECT_NO_SCHEDULE)
+    clusters = [
+        mk_cluster("m-a", region="r1"),
+        mk_cluster("m-b", region="r1", taints=[taint]),
+        mk_cluster("m-c", region="r2"),
+        mk_cluster("m-d", region="r2", deleting=True),
+        mk_cluster("m-e", region="", no_summary=True),
+    ]
+    tolerate = Toleration(key="maintenance", operator="Exists")
+    items = [
+        mk_binding("tainted", Placement(
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+            ))),
+        mk_binding("tolerated", Placement(
+            cluster_tolerations=[tolerate],
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+            ))),
+        mk_binding("affinity", Placement(
+            cluster_affinity=ClusterAffinity(cluster_names=["m-a", "m-c"]),
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED),
+        ), replicas=3),
+        mk_binding("static-weighted", Placement(
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(static_weight_list=[
+                    StaticClusterWeight(
+                        target_cluster=ClusterAffinity(cluster_names=["m-a"]),
+                        weight=3),
+                    StaticClusterWeight(
+                        target_cluster=ClusterAffinity(cluster_names=["m-c"]),
+                        weight=1),
+                ]),
+            )), replicas=8),
+        mk_binding("no-fit", Placement(
+            cluster_affinity=ClusterAffinity(cluster_names=["absent"]),
+        ), replicas=2),
+    ]
+    assert_parity(items, clusters)
+
+
+def test_scale_paths_and_fresh():
+    clusters = [mk_cluster(f"m-{i}", region=f"r{i % 3}", cpu=64000, pods=200)
+                for i in range(12)]
+    dyn = Placement(
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+            weight_preference=ClusterPreferences(
+                dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+        ))
+    agg = Placement(
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_AGGREGATED,
+        ))
+    items = [
+        # steady scale-up: prev 6 -> want 20
+        mk_binding("up", dyn, replicas=20, prev=[("m-1", 3), ("m-2", 3)]),
+        # steady scale-down: prev 30 -> want 10
+        mk_binding("down", dyn, replicas=10,
+                   prev=[("m-0", 10), ("m-3", 12), ("m-5", 8)]),
+        # equality: no-op
+        mk_binding("same", dyn, replicas=6, prev=[("m-1", 2), ("m-2", 4)]),
+        # fresh reassignment ignores steady mode
+        mk_binding("fresh", dyn, replicas=9, prev=[("m-7", 9)], fresh=True),
+        # aggregated prefers prior clusters via resort
+        mk_binding("agg-up", agg, replicas=14, prev=[("m-4", 4)]),
+    ]
+    assert_parity(items, clusters)
+
+
+def test_region_spread_dfs_parity():
+    rng = random.Random(3)
+    clusters = [
+        mk_cluster(f"m-{i:02d}", region=f"r{i % 5}", cpu=rng.randint(8000, 64000),
+                   pods=rng.randint(30, 200))
+        for i in range(30)
+    ]
+    items = []
+    for i in range(24):
+        rmin = rng.randint(1, 2)
+        p = Placement(
+            spread_constraints=[
+                __import__("karmada_tpu.models.policy", fromlist=["SpreadConstraint"]).SpreadConstraint(
+                    spread_by_field=SPREAD_BY_FIELD_REGION,
+                    min_groups=rmin, max_groups=rng.randint(rmin, 4)),
+                __import__("karmada_tpu.models.policy", fromlist=["SpreadConstraint"]).SpreadConstraint(
+                    spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+                    min_groups=2, max_groups=rng.randint(2, 8)),
+            ],
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+            ),
+        )
+        items.append(mk_binding(f"spread-{i}", p,
+                                replicas=rng.choice([3, 10, 40])))
+    assert_parity(items, clusters)
+
+
+def test_unsupported_marked_not_wrong():
+    """Multi-component bindings and vanished prev clusters must surface as
+    STATUS_UNSUPPORTED (serial-only classes), never as a wrong answer."""
+    clusters = [mk_cluster("m-a"), mk_cluster("m-b")]
+    spec, status = mk_binding("vanished", Placement(), replicas=5,
+                              prev=[("gone", 5)])
+    snap = native.NativeSnapshot(clusters, ["cpu"])
+    got = native.schedule_batch_native([(spec, status)], snap)
+    assert got[0][0] == native.STATUS_UNSUPPORTED
