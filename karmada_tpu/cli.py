@@ -36,6 +36,14 @@ def _load_plane(directory: str, backend: str = "serial"):
     from karmada_tpu.models.cluster import Cluster
 
     cp = ControlPlane(backend=backend, persist_dir=directory)
+    # rehydrate feature gates persisted by `addons enable/disable`
+    gates_cm = cp.store.try_get("ConfigMap", "karmada-system", "feature-gates")
+    if gates_cm is not None:
+        for gate, value in gates_cm.manifest.get("data", {}).items():
+            try:
+                cp.gates.set(gate, bool(value) and value not in ("false", "False"))
+            except KeyError:
+                pass  # gate from a newer/older version: ignore
     # rehydrate simulated members from their recorded capacity
     for cluster in cp.store.list(Cluster.KIND):
         raw = cluster.metadata.annotations.get(SIM_CAPACITY_ANNOTATION)
@@ -110,11 +118,15 @@ def _print_table(rows, headers) -> None:
 def cmd_get(args) -> int:
     cp = _load_plane(args.dir)
     if args.cluster:
-        handle = cp.proxy(args.cluster)
-        objs = (
-            [handle.get(args.kind, args.namespace, args.name)]
-            if args.name else handle.list(args.kind, args.namespace or None)
-        )
+        try:
+            handle = cp.proxy(args.cluster)
+            objs = (
+                [handle.get(args.kind, args.namespace, args.name)]
+                if args.name else handle.list(args.kind, args.namespace or None)
+            )
+        except Exception as e:  # noqa: BLE001 — ProxyDenied / unknown cluster
+            print(f"cluster proxy error: {e}", file=sys.stderr)
+            return 1
         objs = [o for o in objs if o is not None]
     elif args.name:
         o = cp.store.try_get(args.kind, args.namespace, args.name)
@@ -249,6 +261,273 @@ def cmd_interpret(args) -> int:
     return 0
 
 
+def cmd_describe(args) -> int:
+    """Detailed single-object view incl. recorded events
+    (pkg/karmadactl/describe)."""
+    cp = _load_plane(args.dir)
+    if args.cluster:
+        try:
+            obj = cp.proxy(args.cluster).get(args.kind, args.namespace, args.name)
+        except Exception as e:  # noqa: BLE001 — ProxyDenied / unknown cluster
+            print(f"cluster proxy error: {e}", file=sys.stderr)
+            return 1
+    else:
+        obj = cp.store.try_get(args.kind, args.namespace, args.name)
+    if obj is None:
+        print(f"{args.kind}/{args.name} not found", file=sys.stderr)
+        return 1
+    manifest = obj.to_manifest() if hasattr(obj, "to_manifest") else obj.__dict__
+    print(json.dumps(manifest, default=lambda o: getattr(o, "__dict__", str(o)),
+                     indent=2))
+    events = cp.events(kind=args.kind, namespace=args.namespace or None,
+                       name=args.name)
+    if events:
+        print("\nEvents:")
+        for e in events[-12:]:
+            print(f"  {e.type}\t{e.reason}\t{e.message}")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    cp = _load_plane(args.dir)
+    try:
+        cp.delete(args.kind, args.namespace, args.name)
+    except KeyError:
+        print(f"{args.kind}/{args.name} not found", file=sys.stderr)
+        return 1
+    _finish(cp)
+    print(f"{args.kind}/{args.name} deleted")
+    return 0
+
+
+def _parse_kv_edits(pairs):
+    """kubectl-style edits: `k=v` sets, `k-` removes."""
+    sets, removes = {}, []
+    for p in pairs:
+        if p.endswith("-"):
+            removes.append(p[:-1])
+        elif "=" in p:
+            k, v = p.split("=", 1)
+            sets[k] = v
+        else:
+            raise ValueError(f"expected key=value or key-, got {p!r}")
+    return sets, removes
+
+
+def cmd_meta_edit(args, field: str) -> int:
+    """label / annotate (pkg/karmadactl/label, annotate)."""
+    cp = _load_plane(args.dir)
+    try:
+        sets, removes = _parse_kv_edits(args.pairs)
+    except ValueError as e:
+        print(e, file=sys.stderr)
+        return 1
+
+    def update(obj) -> None:
+        target = getattr(obj.metadata, field)
+        target.update(sets)
+        for k in removes:
+            target.pop(k, None)
+    try:
+        cp.store.mutate(args.kind, args.namespace, args.name, update)
+    except KeyError:
+        print(f"{args.kind}/{args.name} not found", file=sys.stderr)
+        return 1
+    _finish(cp)
+    print(f"{args.kind}/{args.name} {field} updated")
+    return 0
+
+
+def cmd_taint(args) -> int:
+    """Add/remove cluster taints: `key=value:Effect` adds, `key-` removes
+    (pkg/karmadactl/taint)."""
+    from karmada_tpu.models.cluster import Cluster, Taint
+
+    cp = _load_plane(args.dir)
+    adds, removes = [], []
+    for spec in args.taints:
+        if spec.endswith("-"):
+            removes.append(spec[:-1])
+            continue
+        if ":" not in spec:
+            print(f"expected key[=value]:Effect or key-, got {spec!r}",
+                  file=sys.stderr)
+            return 1
+        kv, effect = spec.rsplit(":", 1)
+        key, _, value = kv.partition("=")
+        adds.append(Taint(key=key, value=value, effect=effect))
+
+    def update(c: Cluster) -> None:
+        keep = [t for t in c.spec.taints
+                if t.key not in removes and t.key not in {a.key for a in adds}]
+        c.spec.taints = keep + adds
+    try:
+        cp.store.mutate(Cluster.KIND, "", args.name, update)
+    except KeyError:
+        print(f"unknown cluster {args.name}", file=sys.stderr)
+        return 1
+    _finish(cp)
+    print(f"cluster {args.name} tainted")
+    return 0
+
+
+def _model_registry():
+    """kind -> dataclass for every registered API type."""
+    import dataclasses
+
+    from karmada_tpu.models import (autoscaling, certs, cluster, config,
+                                    extras, networking, policy, search, work)
+
+    out = {}
+    for mod in (cluster, policy, work, config, extras,
+                autoscaling, networking, search, certs):
+        for obj in vars(mod).values():
+            kind = getattr(obj, "KIND", None)
+            if dataclasses.is_dataclass(obj) and isinstance(kind, str) and kind:
+                out[kind] = obj
+    return out
+
+
+def cmd_api_resources(args) -> int:
+    """List every registered API kind (pkg/karmadactl/apiresources)."""
+    rows = [[kind, cls.__module__.rsplit(".", 1)[-1], cls.__name__]
+            for kind, cls in sorted(_model_registry().items())]
+    _print_table(rows, ["KIND", "GROUP", "TYPE"])
+    return 0
+
+
+def cmd_explain(args) -> int:
+    """Field documentation from the dataclass tree
+    (pkg/karmadactl/explain)."""
+    import dataclasses
+    import typing
+
+    registry = _model_registry()
+    cls = registry.get(args.kind)
+    if cls is None:
+        print(f"unknown kind {args.kind}; see `karmadactl api-resources`",
+              file=sys.stderr)
+        return 1
+
+    def walk(c, indent: int, seen) -> None:
+        if c in seen or indent > 3 * 2:
+            return
+        seen = seen | {c}
+        try:
+            hints = typing.get_type_hints(c)
+        except Exception:  # noqa: BLE001 — unresolvable forward refs
+            hints = {}
+        for f in dataclasses.fields(c):
+            t = hints.get(f.name, f.type)
+            name = getattr(t, "__name__", None) or str(t)
+            print(" " * indent + f"{f.name}\t<{name}>")
+            origin = typing.get_origin(t)
+            sub = typing.get_args(t) if origin else (t,)
+            for s in sub:
+                if dataclasses.is_dataclass(s):
+                    walk(s, indent + 2, seen)
+    print(f"KIND: {args.kind}")
+    walk(cls, 0, frozenset())
+    return 0
+
+
+def cmd_token(args) -> int:
+    """Create/list bootstrap tokens for pull-mode registration
+    (pkg/karmadactl/token, kubeadm-style). Tokens live in the
+    karmada-system/bootstrap-tokens ConfigMap."""
+    import secrets
+
+    cp = _load_plane(args.dir)
+    ns, name = "karmada-system", "bootstrap-tokens"
+    holder = cp.store.try_get("ConfigMap", ns, name)
+    if args.action == "create":
+        token = secrets.token_hex(8)
+        if holder is None:
+            cp.apply({"apiVersion": "v1", "kind": "ConfigMap",
+                      "metadata": {"namespace": ns, "name": name},
+                      "data": {token: "valid"}})
+        else:
+            def add(obj) -> None:
+                obj.manifest.setdefault("data", {})[token] = "valid"
+            cp.store.mutate("ConfigMap", ns, name, add)
+        _finish(cp)
+        print(token)
+        return 0
+    tokens = (holder.manifest.get("data", {}) if holder is not None else {})
+    _print_table([[t, v] for t, v in tokens.items()] or [["-", "-"]],
+                 ["TOKEN", "STATUS"])
+    return 0
+
+
+def cmd_register(args) -> int:
+    """Pull-mode registration: token-gated agent bootstrap
+    (pkg/karmadactl/register — the kubeadm-join analog)."""
+    cp = _load_plane(args.dir)
+    holder = cp.store.try_get("ConfigMap", "karmada-system", "bootstrap-tokens")
+    tokens = holder.manifest.get("data", {}) if holder is not None else {}
+    if tokens.get(args.token) != "valid":
+        print("invalid or expired bootstrap token", file=sys.stderr)
+        return 1
+    if args.name in cp.members:
+        print(f"cluster {args.name} already registered", file=sys.stderr)
+        return 1
+    from karmada_tpu.models.cluster import Cluster
+
+    cp.add_member(args.name, cpu_milli=args.cpu * 1000,
+                  memory_gi=args.memory_gi, pods=args.pods,
+                  region=args.region, sync_mode="Pull")
+
+    def record(c: Cluster) -> None:
+        c.metadata.annotations[SIM_CAPACITY_ANNOTATION] = json.dumps({
+            "cpu_milli": args.cpu * 1000, "memory_gi": args.memory_gi,
+            "pods": args.pods,
+        })
+    cp.store.mutate(Cluster.KIND, "", args.name, record)
+    _finish(cp)
+    print(f"cluster {args.name} registered (Pull mode, CSR approved)")
+    return 0
+
+
+def cmd_unregister(args) -> int:
+    """Pull-mode teardown (pkg/karmadactl/unregister)."""
+    return cmd_unjoin(args)
+
+
+def cmd_addons(args) -> int:
+    """Enable/disable optional subsystems via their feature gates
+    (pkg/karmadactl/addons: estimator/descheduler/search/metrics-adapter).
+    Gate choices map onto the pkg/features registry names."""
+    gate_by_addon = {
+        "resource-quota-estimate": "ResourceQuotaEstimate",
+        "multicluster-service": "MultiClusterService",
+        "quota-enforcement": "FederatedQuotaEnforcement",
+        "stateful-failover": "StatefulFailoverInjection",
+        "priority-queue": "ControllerPriorityQueue",
+    }
+    cp = _load_plane(args.dir)
+    gate = gate_by_addon[args.addon]
+    cp.gates.set(gate, args.action == "enable")
+    # persist the choice; _load_plane rehydrates it on every later invocation
+    cp.apply({"apiVersion": "v1", "kind": "ConfigMap",
+              "metadata": {"namespace": "karmada-system", "name": "feature-gates"},
+              "data": dict(cp.gates.snapshot())})
+    _finish(cp)
+    print(f"addon {args.addon}: {gate}={args.action == 'enable'}")
+    return 0
+
+
+def cmd_deinit(args) -> int:
+    """Tear down the persisted control plane (pkg/karmadactl/deinit)."""
+    import shutil
+
+    if not args.force:
+        print("refusing to delete without --force", file=sys.stderr)
+        return 1
+    shutil.rmtree(args.dir, ignore_errors=True)
+    print(f"control plane at {args.dir} removed")
+    return 0
+
+
 def cmd_tick(args) -> int:
     cp = _load_plane(args.dir, backend=args.backend)
     n = cp.tick()
@@ -335,6 +614,57 @@ def build_parser() -> argparse.ArgumentParser:
     i.add_argument("--customization", default="")
     i.add_argument("--replicas", type=int, default=1)
 
+    d = sub.add_parser("describe")
+    d.add_argument("kind")
+    d.add_argument("name")
+    d.add_argument("-n", "--namespace", default="")
+    d.add_argument("--cluster", default="")
+
+    dl = sub.add_parser("delete")
+    dl.add_argument("kind")
+    dl.add_argument("name")
+    dl.add_argument("-n", "--namespace", default="")
+
+    for ename in ("label", "annotate"):
+        e = sub.add_parser(ename)
+        e.add_argument("kind")
+        e.add_argument("name")
+        e.add_argument("pairs", nargs="+", help="key=value to set, key- to remove")
+        e.add_argument("-n", "--namespace", default="")
+
+    tn = sub.add_parser("taint")
+    tn.add_argument("name", help="cluster name")
+    tn.add_argument("taints", nargs="+", help="key[=value]:Effect or key-")
+
+    sub.add_parser("api-resources")
+
+    ex = sub.add_parser("explain")
+    ex.add_argument("kind")
+
+    to = sub.add_parser("token")
+    to.add_argument("action", choices=["create", "list"])
+
+    rg = sub.add_parser("register")
+    rg.add_argument("name")
+    rg.add_argument("--token", required=True)
+    rg.add_argument("--cpu", type=int, default=64)
+    rg.add_argument("--memory-gi", type=int, default=256)
+    rg.add_argument("--pods", type=int, default=110)
+    rg.add_argument("--region", default="")
+
+    ur = sub.add_parser("unregister")
+    ur.add_argument("name")
+
+    ad = sub.add_parser("addons")
+    ad.add_argument("action", choices=["enable", "disable"])
+    ad.add_argument("addon", choices=[
+        "resource-quota-estimate", "multicluster-service",
+        "quota-enforcement", "stateful-failover", "priority-queue",
+    ])
+
+    di = sub.add_parser("deinit")
+    di.add_argument("--force", action="store_true")
+
     tk = sub.add_parser("tick")
     tk.add_argument("--backend", default="serial")
 
@@ -354,6 +684,18 @@ def main(argv: Optional[list] = None) -> int:
     if args.command == "version":
         print(VERSION)
         return 0
+    try:
+        return _dispatch(args)
+    except BrokenPipeError:
+        # piped into head/less that exited — the unix-polite outcome
+        try:
+            sys.stdout.close()
+        except Exception:  # noqa: BLE001
+            pass
+        return 0
+
+
+def _dispatch(args) -> int:
     return {
         "init": cmd_init,
         "join": cmd_join,
@@ -365,6 +707,18 @@ def main(argv: Optional[list] = None) -> int:
         "uncordon": lambda a: cmd_cordon(a, uncordon=True),
         "top": cmd_top,
         "interpret": cmd_interpret,
+        "describe": cmd_describe,
+        "delete": cmd_delete,
+        "label": lambda a: cmd_meta_edit(a, "labels"),
+        "annotate": lambda a: cmd_meta_edit(a, "annotations"),
+        "taint": cmd_taint,
+        "api-resources": cmd_api_resources,
+        "explain": cmd_explain,
+        "token": cmd_token,
+        "register": cmd_register,
+        "unregister": cmd_unregister,
+        "addons": cmd_addons,
+        "deinit": cmd_deinit,
         "tick": cmd_tick,
         "serve": cmd_serve,
     }[args.command](args)

@@ -422,7 +422,9 @@ def marshal_batch(
     ] or [nC]
     out_cap = 1
     for b in range(nB):
-        if snapshot.p_strategy[b_placement[b]] == 0:  # Duplicated
+        if not b_workload[b] or snapshot.p_strategy[b_placement[b]] == 0:
+            # non-workload zero-propagation and Duplicated both emit one
+            # entry per feasible candidate
             out_cap += pass_count[b_placement[b]]
         else:
             out_cap += int(
@@ -463,12 +465,15 @@ def run_marshaled(
 
     c = ctypes
     p = lambda arr: arr.ctypes.data_as(c.c_void_p)  # noqa: E731
+    # bind to a local so the pointer outlives the call even if a future
+    # change makes avail_milli a non-contiguous view
+    avail_milli = np.ascontiguousarray(snapshot.avail_milli)
     rc = lib.serial_schedule_batch(
         c.c_int32(a["nC"]), p(snapshot.name_rank), p(snapshot.deleting),
         p(snapshot.has_summary), p(snapshot.region_id), p(snapshot.region_rank),
         c.c_int32(snapshot.n_regions), p(snapshot.pods_allowed),
         c.c_int32(a["nR"]), p(snapshot.res_is_cpu),
-        p(np.ascontiguousarray(snapshot.avail_milli)),
+        p(avail_milli),
         c.c_int32(a["nG"]), p(a["gvk_enabled"]),
         c.c_int32(a["nP"]), p(a["p_taint"]), p(a["p_reason"]),
         p(a["p_strategy"]), p(a["p_ignore"]), p(a["p_has_w"]),

@@ -660,15 +660,18 @@ int serial_schedule_batch(
     }
 
     // ---- AssignReplicas (assignment.go / division_algorithm.go) ----------
-    if (!bd.workload) {
-      // non-workloads propagate with zero replicas; zeros are dropped below,
-      // matching serial.py with enable_empty_workload_propagation=False
-      out_off[b + 1] = cursor;
-      continue;
-    }
-
+    bool drop_zeros = true;
     bool fresh = bd.fresh;
     int32_t strat = pv.strategy;
+    if (!bd.workload) {
+      // non-workloads & multi-component: propagate to ALL candidates with
+      // zero replicas (assign_replicas early return — NOT subject to the
+      // strategy paths' replicas>0 drop)
+      for (const auto& c : candidates) result.push_back({c.idx, 0});
+      drop_zeros = false;
+      goto emit;
+    }
+
     if (strat == kDuplicated) {
       for (const auto& c : candidates) result.push_back({c.idx, bd.replicas});
     } else if (strat == kStaticWeight) {
@@ -816,7 +819,7 @@ int serial_schedule_batch(
 
   emit:
     for (const auto& t : result) {
-      if (t.replicas <= 0) continue;  // serial.py drops zeros
+      if (drop_zeros && t.replicas <= 0) continue;  // strategy paths drop zeros
       if (cursor >= out_cap) {
         out_status[b] = kOutputOverflow;
         return 1;

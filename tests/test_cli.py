@@ -156,3 +156,76 @@ customizations:
 def test_version(tmp_path, capsys):
     assert run(tmp_path, "version") == 0
     assert "karmada-tpu" in capsys.readouterr().out
+
+
+def test_label_annotate_taint_describe_delete(tmp_path, capsys):
+    assert run(tmp_path, "init") == 0
+    assert run(tmp_path, "join", "m1") == 0
+    assert run(tmp_path, "label", "Cluster", "m1", "tier=gold", "env=prod") == 0
+    assert run(tmp_path, "annotate", "Cluster", "m1", "note=hello") == 0
+    assert run(tmp_path, "label", "Cluster", "m1", "env-") == 0
+    capsys.readouterr()
+    assert run(tmp_path, "describe", "Cluster", "m1") == 0
+    desc = capsys.readouterr().out
+    assert "tier" in desc and "gold" in desc and "env" not in json.loads(
+        desc.split("\nEvents:")[0])["metadata"]["labels"]
+    assert run(tmp_path, "taint", "m1", "maint=true:NoSchedule") == 0
+    capsys.readouterr()
+    assert run(tmp_path, "describe", "Cluster", "m1") == 0
+    assert "maint" in capsys.readouterr().out
+    assert run(tmp_path, "taint", "m1", "maint-") == 0
+    # delete an applied template
+    assert run(tmp_path, "apply", "-f", deployment_yaml(tmp_path)) == 0
+    assert run(tmp_path, "delete", "Deployment", "web", "-n", "default") == 0
+    capsys.readouterr()
+    assert run(tmp_path, "get", "Deployment", "-n", "default") == 0
+    assert "web" not in capsys.readouterr().out
+
+
+def test_api_resources_and_explain(tmp_path, capsys):
+    assert run(tmp_path, "api-resources") == 0
+    out = capsys.readouterr().out
+    assert "PropagationPolicy" in out and "ResourceBinding" in out
+    assert run(tmp_path, "explain", "PropagationPolicy") == 0
+    out = capsys.readouterr().out
+    assert "resource_selectors" in out
+    assert run(tmp_path, "explain", "NoSuchKind") == 1
+
+
+def test_token_register_unregister_pull_mode(tmp_path, capsys):
+    assert run(tmp_path, "init") == 0
+    assert run(tmp_path, "token", "create") == 0
+    token = capsys.readouterr().out.strip().splitlines()[-1]
+    assert run(tmp_path, "register", "edge-1", "--token", "nope") == 1
+    capsys.readouterr()
+    assert run(tmp_path, "register", "edge-1", "--token", token) == 0
+    capsys.readouterr()
+    assert run(tmp_path, "get", "Cluster") == 0
+    assert "edge-1" in capsys.readouterr().out
+    assert run(tmp_path, "unregister", "edge-1") == 0
+
+
+def test_addons_and_deinit(tmp_path, capsys):
+    assert run(tmp_path, "init") == 0
+    assert run(tmp_path, "addons", "enable", "multicluster-service") == 0
+    capsys.readouterr()
+    assert run(tmp_path, "get", "ConfigMap", "-n", "karmada-system",
+               "-o", "json") == 0
+    data = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    gates = [d for d in data if d["metadata"]["name"] == "feature-gates"]
+    assert gates and gates[0]["data"]["MultiClusterService"] is True
+    assert run(tmp_path, "deinit") == 1  # refuses without --force
+    assert run(tmp_path, "deinit", "--force") == 0
+    assert not (tmp_path / "plane").exists()
+
+
+def test_addons_gate_rehydrates_across_invocations(tmp_path):
+    from karmada_tpu.cli import _load_plane
+
+    assert run(tmp_path, "init") == 0
+    assert run(tmp_path, "addons", "enable", "multicluster-service") == 0
+    cp = _load_plane(str(tmp_path / "plane"))
+    assert cp.gates.enabled("MultiClusterService") is True
+    assert run(tmp_path, "addons", "disable", "multicluster-service") == 0
+    cp = _load_plane(str(tmp_path / "plane"))
+    assert cp.gates.enabled("MultiClusterService") is False

@@ -252,3 +252,25 @@ def test_unsupported_marked_not_wrong():
     snap = native.NativeSnapshot(clusters, ["cpu"])
     got = native.schedule_batch_native([(spec, status)], snap)
     assert got[0][0] == native.STATUS_UNSUPPORTED
+
+
+def test_non_workload_zero_propagation_parity():
+    """ConfigMap-style bindings (replicas=0, no requirements) must propagate
+    to ALL candidates with zero replicas, exactly like assign_replicas'
+    early return (core/common.go:44-78)."""
+    clusters = [mk_cluster("m-a"), mk_cluster("m-b"), mk_cluster("m-c")]
+    spec = ResourceBindingSpec(
+        resource=ObjectReference(api_version=GVK[0], kind=GVK[1],
+                                 namespace="default", name="cm", uid="u-cm"),
+        replicas=0,
+        placement=Placement(),
+    )
+    items = [(spec, ResourceBindingStatus())]
+    est = GeneralEstimator()
+    cal = serial.make_cal_available([est])
+    want = serial.schedule(spec, items[0][1], clusters, cal)
+    snap = native.NativeSnapshot(clusters, [])
+    st, got = native.schedule_batch_native(items, snap)[0]
+    assert st == native.STATUS_OK
+    assert {t.name: t.replicas for t in got} == {t.name: t.replicas for t in want}
+    assert {t.replicas for t in got} == {0} and len(got) == 3
