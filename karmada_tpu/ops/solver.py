@@ -649,21 +649,36 @@ def _compact_extract(rep, sel, status, *, max_nnz: int):
     return idx.astype(jnp.int32), val.astype(jnp.int32), status.astype(jnp.int32), nnz
 
 
-def solve_compact(batch, waves: int = 1, max_nnz: int = 0):
-    """Device-side solve + sparse result extraction: D2H ships only the
-    (binding, cluster, replicas) nonzeros instead of the dense [B, C] int64
-    plane (x100+ less traffic on realistic mixes).  Escalates max_nnz x4 on
-    overflow, capped at B*C (== dense)."""
-    import numpy as np
-
+def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0):
+    """Enqueue the device solve WITHOUT forcing the result (jax dispatch is
+    async): returns an opaque handle for finalize_compact.  Lets a caller
+    overlap host work (encode of the next chunk, decode of the previous)
+    with the device execution of this one."""
     assert batch.C <= 8192, "cluster axis must be <= 8192 per solve call"
     dense_nnz = batch.B * batch.C
     if max_nnz <= 0:
         max_nnz = min(max(batch.B * 16, 1 << 14), dense_nnz)
     rep, sel, status = schedule_batch(*_batch_args(batch), waves=waves)
-    while True:
-        idx, val, st, nnz = _compact_extract(rep, sel, status, max_nnz=max_nnz)
-        if int(nnz) <= max_nnz or max_nnz >= dense_nnz:
-            break
+    # speculative first extraction rides the same async queue
+    first = _compact_extract(rep, sel, status, max_nnz=max_nnz)
+    return (rep, sel, status, first, max_nnz, dense_nnz)
+
+
+def finalize_compact(handle):
+    """Force a dispatch_compact handle: (idx, val, status, nnz) numpy."""
+    import numpy as np
+
+    rep, sel, status, first, max_nnz, dense_nnz = handle
+    idx, val, st, nnz = first
+    while int(nnz) > max_nnz and max_nnz < dense_nnz:
         max_nnz = min(max_nnz * 4, dense_nnz)
+        idx, val, st, nnz = _compact_extract(rep, sel, status, max_nnz=max_nnz)
     return np.asarray(idx), np.asarray(val), np.asarray(st), int(nnz)
+
+
+def solve_compact(batch, waves: int = 1, max_nnz: int = 0):
+    """Device-side solve + sparse result extraction: D2H ships only the
+    (binding, cluster, replicas) nonzeros instead of the dense [B, C] int64
+    plane (x100+ less traffic on realistic mixes).  Escalates max_nnz x4 on
+    overflow, capped at B*C (== dense)."""
+    return finalize_compact(dispatch_compact(batch, waves=waves, max_nnz=max_nnz))
