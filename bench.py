@@ -239,7 +239,10 @@ def build_bindings(rng: random.Random, n_bindings: int, placements):
 
 
 def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8):
-    """Returns (elapsed_s, solve_s, scheduled_count, chunk_latencies).
+    """Returns (elapsed_s, solve_s, scheduled_count, chunk_lat, chunk_wall):
+    chunk_lat is each chunk's OWN work (encode span + finalize span);
+    chunk_wall is its submit-to-results wall time, which under pipelining
+    also contains the interleaved work of neighboring chunks.
 
     Uses the production path end to end: shared EncoderCache across chunks,
     jitted compact solve (sparse COO results — the dense [B, C] plane is

@@ -108,14 +108,19 @@ class ResourceInterpreter:
 
     def __init__(self) -> None:
         from karmada_tpu.interpreter.declarative import DeclarativeManager
+        from karmada_tpu.interpreter.webhook import WebhookManager
 
         self._customizations: Dict[Tuple[str, str], Customization] = {}
         self.declarative = DeclarativeManager()
+        self.webhooks = WebhookManager()
 
     def attach_store(self, store) -> None:
-        """Enable the declarative tier: ResourceInterpreterCustomization
-        objects in `store` become live customizations."""
+        """Enable the store-fed customization tiers:
+        ResourceInterpreterCustomization objects become declarative
+        customizations, ResourceInterpreterWebhook objects become live
+        out-of-process interpreters."""
         self.declarative.attach_store(store)
+        self.webhooks.attach_store(store)
 
     # -- customization registry (reference: webhook tier) -------------------
     def register(self, customization: Customization) -> None:
@@ -126,10 +131,16 @@ class ResourceInterpreter:
         self._customizations.pop((api_version, kind), None)
 
     def _hook(self, manifest: Dict[str, Any], op: str) -> Optional[Callable]:
+        """Tier priority (interpreter.go:104-150): customized webhook >
+        in-process registered hooks > declarative store customizations >
+        third-party bundle; callers fall through to native defaults."""
         from karmada_tpu.interpreter.thirdparty import thirdparty_hook
 
         api_version = manifest.get("apiVersion", "")
         kind = manifest.get("kind", "")
+        hook = self.webhooks.hook(api_version, kind, op)
+        if hook is not None:
+            return hook
         c = self._customizations.get((api_version, kind))
         if c is not None and op in c.hooks:
             return c.hooks[op]

@@ -38,3 +38,37 @@ class ResourceInterpreterCustomization(TypedObject):
     spec: ResourceInterpreterCustomizationSpec = field(
         default_factory=ResourceInterpreterCustomizationSpec
     )
+
+
+@dataclass
+class InterpreterRule:
+    """Which (apiVersion, kind, operations) a webhook serves
+    (resourceinterpreterwebhook_types.go RuleWithOperations)."""
+
+    api_versions: list = field(default_factory=list)  # ["apps/v1"] or ["*"]
+    kinds: list = field(default_factory=list)         # ["Deployment"] or ["*"]
+    operations: list = field(default_factory=list)    # interpreter.OP_* or ["*"]
+
+
+@dataclass
+class ResourceInterpreterWebhookSpec:
+    """Endpoint + rules (resourceinterpreterwebhook_types.go:34-77).  The
+    reference dials HTTPS with CA bundles; this framework's transport is a
+    pluggable URL (http:// for loopback services, or the in-process
+    `local:` scheme used in tests) — the mTLS story lives one layer down
+    in estimator/wire.py's transport seam."""
+
+    endpoint: str = ""
+    rules: list = field(default_factory=list)  # List[InterpreterRule]
+    timeout_s: float = 5.0
+
+
+@dataclass
+class ResourceInterpreterWebhook(TypedObject):
+    KIND = "ResourceInterpreterWebhook"
+    API_VERSION = "config.karmada.io/v1alpha1"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceInterpreterWebhookSpec = field(
+        default_factory=ResourceInterpreterWebhookSpec
+    )

@@ -1,0 +1,198 @@
+"""Interpreter webhook tier: out-of-process customizations over HTTP.
+
+Reference: pkg/resourceinterpreter/customized/webhook/ (engine) +
+pkg/webhook/interpreter/ (host).  The webhook tier outranks every other
+customization tier and its failures surface as errors, never as silent
+fall-through.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karmada_tpu.interpreter.interpreter import (
+    OP_INTERPRET_HEALTH,
+    OP_INTERPRET_REPLICA,
+    OP_REVISE_REPLICA,
+    ResourceInterpreter,
+)
+from karmada_tpu.interpreter.webhook import (
+    InterpreterWebhookServer,
+    WebhookCallError,
+    unregister_local_endpoint,
+)
+from karmada_tpu.models.config import (
+    InterpreterRule,
+    ResourceInterpreterWebhook,
+    ResourceInterpreterWebhookSpec,
+)
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.store.store import ObjectStore
+
+GVK = {"apiVersion": "example.io/v1", "kind": "Widget"}
+
+
+def widget(replicas=7):
+    return {**GVK, "metadata": {"namespace": "default", "name": "w"},
+            "spec": {"size": replicas}}
+
+
+def make_server():
+    srv = InterpreterWebhookServer()
+    srv.handle("example.io/v1", "Widget", OP_INTERPRET_REPLICA,
+               lambda req: {"replicas": req["object"]["spec"]["size"],
+                            "requirements": {"cpu": "250m"}})
+    srv.handle("example.io/v1", "Widget", OP_REVISE_REPLICA,
+               lambda req: {"revised": {
+                   **req["object"],
+                   "spec": {**req["object"]["spec"],
+                            "size": req["desiredReplicas"]},
+               }})
+    srv.handle("example.io/v1", "Widget", OP_INTERPRET_HEALTH,
+               lambda req: {"healthy": req["object"]["spec"]["size"] < 100})
+    return srv
+
+
+def webhook_config(endpoint, name="widget-hook"):
+    return ResourceInterpreterWebhook(
+        metadata=ObjectMeta(name=name),
+        spec=ResourceInterpreterWebhookSpec(
+            endpoint=endpoint,
+            rules=[InterpreterRule(api_versions=["example.io/v1"],
+                                   kinds=["Widget"], operations=["*"])],
+        ),
+    )
+
+
+def attach(interp, store, endpoint):
+    store.create(webhook_config(endpoint))
+    interp.attach_store(store)
+
+
+def test_webhook_over_http_all_ops():
+    srv = make_server()
+    endpoint = srv.start()
+    try:
+        interp = ResourceInterpreter()
+        attach(interp, ObjectStore(), endpoint)
+        replicas, req = interp.get_replicas(widget(7))
+        assert replicas == 7
+        assert req is not None and req.resource_request["cpu"].milli == 250
+        revised = interp.revise_replica(widget(7), 3)
+        assert revised["spec"]["size"] == 3
+        assert interp.interpret_health(widget(7)) == "Healthy"
+        assert interp.interpret_health(widget(500)) == "Unhealthy"
+    finally:
+        srv.stop()
+
+
+def test_webhook_local_endpoint_and_store_watch():
+    srv = make_server()
+    endpoint = srv.as_local_endpoint("widget-test")
+    try:
+        store = ObjectStore()
+        interp = ResourceInterpreter()
+        interp.attach_store(store)
+        # config created AFTER attach: the watch subscription must pick it up
+        store.create(webhook_config(endpoint))
+        replicas, _ = interp.get_replicas(widget(11))
+        assert replicas == 11
+        # deleting the config removes the tier
+        store.delete(ResourceInterpreterWebhook.KIND, "", "widget-hook")
+        replicas, _ = interp.get_replicas(widget(11))
+        assert replicas == 0  # native defaults know no Widget
+    finally:
+        unregister_local_endpoint("widget-test")
+
+
+def test_webhook_failure_is_an_error_not_fallthrough():
+    store = ObjectStore()
+    interp = ResourceInterpreter()
+    interp.attach_store(store)
+    store.create(webhook_config("local:definitely-absent"))
+    with pytest.raises(WebhookCallError):
+        interp.get_replicas(widget(1))
+
+
+def test_webhook_outranks_declarative_tier():
+    from karmada_tpu.models.config import (
+        CustomizationTarget,
+        ResourceInterpreterCustomization,
+        ResourceInterpreterCustomizationSpec,
+    )
+
+    srv = make_server()
+    endpoint = srv.as_local_endpoint("widget-priority")
+    try:
+        store = ObjectStore()
+        store.create(ResourceInterpreterCustomization(
+            metadata=ObjectMeta(name="declarative-widget"),
+            spec=ResourceInterpreterCustomizationSpec(
+                target=CustomizationTarget(api_version="example.io/v1",
+                                           kind="Widget"),
+                customizations={OP_INTERPRET_REPLICA: "999"},
+            ),
+        ))
+        store.create(webhook_config(endpoint))
+        interp = ResourceInterpreter()
+        interp.attach_store(store)
+        replicas, _ = interp.get_replicas(widget(7))
+        assert replicas == 7  # webhook answer, not the declarative 999
+    finally:
+        unregister_local_endpoint("widget-priority")
+
+
+def test_empty_rule_matches_nothing():
+    store = ObjectStore()
+    interp = ResourceInterpreter()
+    interp.attach_store(store)
+    cfg = webhook_config("local:absent", name="empty-rule")
+    cfg.spec.rules = [InterpreterRule()]  # all pattern lists empty
+    store.create(cfg)
+    # native Deployment interpretation must be untouched
+    replicas, _ = interp.get_replicas({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"namespace": "d", "name": "x"},
+        "spec": {"replicas": 5, "template": {"spec": {"containers": []}}},
+    })
+    assert replicas == 5
+
+
+def test_local_handler_fault_is_webhook_call_error():
+    srv = InterpreterWebhookServer()
+    srv.handle("example.io/v1", "Widget", OP_INTERPRET_REPLICA,
+               lambda req: None)  # buggy handler: no response dict
+    endpoint = srv.as_local_endpoint("widget-buggy")
+    try:
+        store = ObjectStore()
+        interp = ResourceInterpreter()
+        interp.attach_store(store)
+        store.create(webhook_config(endpoint))
+        with pytest.raises(WebhookCallError):
+            interp.get_replicas(widget(1))
+    finally:
+        unregister_local_endpoint("widget-buggy")
+
+
+def test_aggregate_status_returns_full_manifest():
+    from karmada_tpu.interpreter.interpreter import OP_AGGREGATE_STATUS
+    from karmada_tpu.models.work import AggregatedStatusItem
+
+    srv = InterpreterWebhookServer()
+    srv.handle("example.io/v1", "Widget", OP_AGGREGATE_STATUS,
+               lambda req: {"status": {"readyTotal": sum(
+                   i["status"].get("ready", 0)
+                   for i in req["aggregatedStatusItems"])}})
+    endpoint = srv.as_local_endpoint("widget-agg")
+    try:
+        store = ObjectStore()
+        interp = ResourceInterpreter()
+        interp.attach_store(store)
+        store.create(webhook_config(endpoint))
+        items = [AggregatedStatusItem(cluster_name="m1", status={"ready": 2}),
+                 AggregatedStatusItem(cluster_name="m2", status={"ready": 3})]
+        merged = interp.aggregate_status(widget(7), items)
+        assert merged["kind"] == "Widget"  # full manifest, not a bare status
+        assert merged["status"] == {"readyTotal": 5}
+    finally:
+        unregister_local_endpoint("widget-agg")
