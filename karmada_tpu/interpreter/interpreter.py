@@ -101,8 +101,9 @@ def prune_for_propagation(manifest: Dict[str, Any]) -> Dict[str, Any]:
 
 
 class ResourceInterpreter:
-    """Facade dispatching per-kind with the reference's four-tier priority
-    (interpreter.go:104-150): registered hooks (the webhook tier) >
+    """Facade dispatching per-kind with the reference's tier priority
+    (interpreter.go:104-150): customized webhook (out-of-process, over
+    HTTP — interpreter/webhook.py) > in-process registered hooks >
     declarative store customizations > third-party bundle > native
     defaults."""
 
@@ -122,7 +123,7 @@ class ResourceInterpreter:
         self.declarative.attach_store(store)
         self.webhooks.attach_store(store)
 
-    # -- customization registry (reference: webhook tier) -------------------
+    # -- in-process customization registry (outranked by the webhook tier) --
     def register(self, customization: Customization) -> None:
         key = (customization.api_version, customization.kind)
         self._customizations[key] = customization

@@ -58,7 +58,14 @@ def _call_endpoint(endpoint: str, request: dict, timeout_s: float) -> dict:
             handler = _LOCAL_ENDPOINTS.get(endpoint)
         if handler is None:
             raise WebhookCallError(f"no local endpoint {endpoint!r}")
-        return handler(request)
+        # JSON round-trip for transport parity with http://: a handler must
+        # never receive references into live control-plane manifests
+        try:
+            return json.loads(json.dumps(handler(json.loads(json.dumps(request)))))
+        except WebhookCallError:
+            raise
+        except Exception as e:  # noqa: BLE001 — handler/serialization fault
+            raise WebhookCallError(f"{endpoint}: {e!r}") from e
     if endpoint.startswith("http://"):
         import http.client
         from urllib.parse import urlparse
@@ -85,15 +92,15 @@ def _call_endpoint(endpoint: str, request: dict, timeout_s: float) -> dict:
 
 
 def _rule_matches(rule, api_version: str, kind: str, op: str) -> bool:
-    """Wildcards must be EXPLICIT ("*"): an empty pattern list matches
-    nothing, so a default-constructed InterpreterRule can never hijack
-    every kind in the control plane."""
+    """Wildcards must be EXPLICIT ("*") on every axis: an empty pattern
+    list matches nothing, so a partially-filled InterpreterRule can never
+    hijack kinds or operations the user did not spell out."""
     def hit(patterns, value) -> bool:
         return any(p == "*" or p == value for p in patterns)
 
     return (hit(rule.api_versions, api_version)
             and hit(rule.kinds, kind)
-            and hit(rule.operations or ["*"], op))
+            and hit(rule.operations, op))
 
 
 class WebhookManager:
@@ -104,6 +111,11 @@ class WebhookManager:
     def __init__(self) -> None:
         self._configs: Dict[str, ResourceInterpreterWebhook] = {}
         self._lock = threading.Lock()
+        # resolved-hook cache, invalidated wholesale on any config change —
+        # hook() sits on every controller's interpretation hot path
+        self._gen = 0
+        self._hook_cache: Dict[Tuple[str, str, str],
+                               Tuple[int, Optional[Callable]]] = {}
 
     def attach_store(self, store) -> None:
         # subscribe FIRST, then rebuild: a config created in the gap is
@@ -120,6 +132,8 @@ class WebhookManager:
                 self._configs.pop(obj.metadata.name, None)
             else:
                 self._configs[obj.metadata.name] = obj
+            self._gen += 1
+            self._hook_cache.clear()
 
     def _find(self, api_version: str, kind: str, op: str):
         with self._lock:
@@ -131,6 +145,19 @@ class WebhookManager:
         return None
 
     def hook(self, api_version: str, kind: str, op: str) -> Optional[Callable]:
+        key = (api_version, kind, op)
+        with self._lock:
+            gen = self._gen
+            cached = self._hook_cache.get(key)
+            if cached is not None and cached[0] == gen:
+                return cached[1]
+        resolved = self._resolve(api_version, kind, op)
+        with self._lock:
+            if self._gen == gen:  # a config change mid-resolve invalidates
+                self._hook_cache[key] = (gen, resolved)
+        return resolved
+
+    def _resolve(self, api_version: str, kind: str, op: str) -> Optional[Callable]:
         cfg = self._find(api_version, kind, op)
         if cfg is None:
             return None
@@ -140,6 +167,10 @@ class WebhookManager:
         def call(request: dict) -> dict:
             request["operation"] = op
             resp = _call_endpoint(endpoint, request, timeout_s)
+            if not isinstance(resp, dict):
+                raise WebhookCallError(
+                    f"{endpoint}: response is {type(resp).__name__}, "
+                    "expected an object")
             if not resp.get("successful", False):
                 raise WebhookCallError(
                     f"{endpoint}: {resp.get('message', 'unsuccessful')}")

@@ -1,0 +1,82 @@
+"""Service-name-resolution detector — the example failure-detector sidecar.
+
+Reference: cmd/service-name-resolution-detector-example +
+pkg/servicenameresolutiondetector/coredns/detector.go:92 — a sidecar that
+periodically resolves a well-known in-cluster service name and feeds the
+result into a Cluster status condition, which ClusterTaintPolicy /
+Remedy rules then act on (condition -> taint -> eviction / TrafficControl).
+
+Here the probe targets the member simulator's DNS health flag; the
+aggregation mirrors the reference's windowed success/failure vote: the
+condition only transitions after `threshold` consecutive observations of
+the new state (detector.go's period/successThreshold/failureThreshold),
+so a single flaky probe cannot flap the condition.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque
+
+from karmada_tpu.models.cluster import Cluster
+from karmada_tpu.models.meta import Condition, set_condition
+
+COND_SERVICE_DNS_READY = "ServiceDomainNameResolutionReady"
+
+
+class ServiceNameResolutionDetector:
+    """Per-member sidecar: probe -> windowed vote -> cluster condition."""
+
+    def __init__(self, store, member, runtime, threshold: int = 3) -> None:
+        self.store = store
+        self.member = member
+        self.runtime = runtime
+        self.threshold = max(1, threshold)
+        self._window: Deque[bool] = deque(maxlen=self.threshold)
+        self._reported = None  # nothing reported yet: first vote writes
+        runtime.register_periodic(self.probe)
+        self.probe()
+
+    def stop(self) -> None:
+        """Detach from the runtime (call on member unjoin so long-lived
+        planes don't accumulate dead probes)."""
+        self.runtime.unregister_periodic(self.probe)
+
+    # -- the probe ----------------------------------------------------------
+    def _resolve(self) -> bool:
+        """One resolution attempt against the member's DNS plane (the
+        simulator's dns_healthy flag; a real deployment would dial CoreDNS
+        for a well-known name, detector.go:92)."""
+        return bool(getattr(self.member, "dns_healthy", True))
+
+    def probe(self) -> None:
+        self._window.append(self._resolve())
+        votes = list(self._window)
+        if len(votes) < self.threshold:
+            # bootstrap: report the very first observation immediately so
+            # the condition exists from the sidecar's first cycle
+            if self._reported is None:
+                self._set_condition(votes[-1])
+            return
+        if all(votes) and self._reported is not True:
+            self._set_condition(True)
+        elif not any(votes) and self._reported is not False:
+            self._set_condition(False)
+
+    def _set_condition(self, ready: bool) -> None:
+        name = self.member.name
+
+        def update(c: Cluster) -> None:
+            set_condition(c.status.conditions, Condition(
+                type=COND_SERVICE_DNS_READY,
+                status="True" if ready else "False",
+                reason="ServiceNameResolutionSucceed" if ready
+                else "ServiceNameResolutionFailed",
+                message="service name resolution is working" if ready
+                else "service name resolution keeps failing",
+            ))
+        try:
+            self.store.mutate(Cluster.KIND, "", name, update)
+            self._reported = ready
+        except KeyError:
+            pass  # cluster unjoined mid-probe: nothing to report against

@@ -49,6 +49,9 @@ class FakeMemberCluster:
                              "ServiceAccount", "Namespace"]),
     ])
     healthy: bool = True
+    # simulated in-cluster DNS plane (CoreDNS analog), probed by
+    # members/dns_detector.ServiceNameResolutionDetector
+    dns_healthy: bool = True
     store: ObjectStore = field(default_factory=ObjectStore)
     # per-workload live load for the metrics plane: (kind, ns, name) ->
     # per-replica usage in milli-units, e.g. {"cpu": 250, "memory": ...}.

@@ -45,6 +45,7 @@ class InterpreterRule:
     """Which (apiVersion, kind, operations) a webhook serves
     (resourceinterpreterwebhook_types.go RuleWithOperations)."""
 
+    # wildcards are EXPLICIT on every axis: an empty list matches nothing
     api_versions: list = field(default_factory=list)  # ["apps/v1"] or ["*"]
     kinds: list = field(default_factory=list)         # ["Deployment"] or ["*"]
     operations: list = field(default_factory=list)    # interpreter.OP_* or ["*"]

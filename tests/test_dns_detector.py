@@ -1,0 +1,87 @@
+"""Service-name-resolution detector sidecar: probe -> condition -> taint.
+
+Reference: cmd/service-name-resolution-detector-example +
+pkg/servicenameresolutiondetector/coredns/detector.go:92, composed with the
+ClusterTaintPolicy controller (condition-driven taints).
+"""
+
+from __future__ import annotations
+
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.members.dns_detector import (
+    COND_SERVICE_DNS_READY,
+    ServiceNameResolutionDetector,
+)
+from karmada_tpu.models.cluster import Cluster
+from karmada_tpu.models.extras import (
+    ClusterTaintPolicy,
+    ClusterTaintPolicySpec,
+    MatchCondition,
+    TaintSpec,
+)
+from karmada_tpu.models.meta import ObjectMeta, get_condition
+
+
+def _condition(cp, name):
+    c = cp.store.get(Cluster.KIND, "", name)
+    return get_condition(c.status.conditions, COND_SERVICE_DNS_READY)
+
+
+def test_dns_failure_sets_condition_and_policy_taints():
+    cp = ControlPlane()
+    m1 = cp.add_member("m1")
+    cp.add_member("m2")
+    det = ServiceNameResolutionDetector(cp.store, m1, cp.runtime, threshold=3)
+    cp.tick()
+    cond = _condition(cp, "m1")
+    assert cond is not None and cond.status == "True"
+
+    # taint policy: condition False -> NoSchedule taint; True -> remove
+    cp.store.create(ClusterTaintPolicy(
+        metadata=ObjectMeta(name="dns-taint"),
+        spec=ClusterTaintPolicySpec(
+            add_on_conditions=[MatchCondition(
+                condition_type=COND_SERVICE_DNS_READY, operator="In",
+                status_values=["False"])],
+            remove_on_conditions=[MatchCondition(
+                condition_type=COND_SERVICE_DNS_READY, operator="In",
+                status_values=["True"])],
+            taints=[TaintSpec(key="dns-unavailable", effect="NoSchedule")],
+        ),
+    ))
+
+    # one flaky probe must NOT flip the condition (windowed vote)
+    m1.dns_healthy = False
+    det.probe()
+    m1.dns_healthy = True
+    det.probe()
+    det.probe()
+    assert _condition(cp, "m1").status == "True"
+
+    # sustained failure flips it and the policy taints the cluster
+    m1.dns_healthy = False
+    for _ in range(3):
+        det.probe()
+    cp.tick()
+    assert _condition(cp, "m1").status == "False"
+    cluster = cp.store.get(Cluster.KIND, "", "m1")
+    assert any(t.key == "dns-unavailable" for t in cluster.spec.taints)
+
+    # recovery removes the taint again
+    m1.dns_healthy = True
+    for _ in range(3):
+        det.probe()
+    cp.tick()
+    assert _condition(cp, "m1").status == "True"
+    cluster = cp.store.get(Cluster.KIND, "", "m1")
+    assert not any(t.key == "dns-unavailable" for t in cluster.spec.taints)
+
+
+def test_detector_stop_detaches_from_runtime():
+    cp = ControlPlane()
+    m1 = cp.add_member("m1")
+    det = ServiceNameResolutionDetector(cp.store, m1, cp.runtime, threshold=2)
+    det.stop()
+    before = len(det._window)
+    cp.tick()  # periodics must no longer reach the detector
+    assert len(det._window) == before
