@@ -99,7 +99,8 @@ _SAFE_FUNCS: Dict[str, Callable] = {
     "values": lambda d: list((d or {}).values()),
     "len": len, "int": int, "float": float, "str": str, "bool": bool,
     "min": min, "max": max, "sum": sum, "round": round, "sorted": sorted,
-    "any": any, "all": all, "abs": abs,
+    "any": any, "all": all, "abs": abs, "enumerate": enumerate,
+    "range": range, "zip": zip, "list": list,
 }
 
 

@@ -77,6 +77,165 @@ THIRDPARTY_BUNDLE: Dict[Tuple[str, str], Dict[str, str]] = {
             " 'updatedReadyReplicas': sum([get(i, 'status.updatedReadyReplicas', 0) or 0 for i in items])})"
         ),
     },
+    # OpenKruise Advanced StatefulSet (apps.kruise.io/v1beta1
+    # StatefulSet/customizations.yaml)
+    ("apps.kruise.io/v1beta1", "StatefulSet"): {
+        "InterpretReplica": (
+            "{'replicas': get(obj, 'spec.replicas', 0) or 0,"
+            " 'requirements': {"
+            "   name: req for c in get(obj, 'spec.template.spec.containers', [])"
+            "   for name, req in items(get(c, 'resources.requests', {}))"
+            " }}"
+        ),
+        "ReviseReplica": "set(obj, 'spec.replicas', replicas)",
+        "InterpretHealth": (
+            "get(obj, 'status.observedGeneration', 0) =="
+            " get(obj, 'metadata.generation', 0)"
+            " and (get(obj, 'status.readyReplicas', 0) or 0) >="
+            " (get(obj, 'spec.replicas', 0) or 0)"
+        ),
+        "InterpretStatus": (
+            "{'replicas': get(obj, 'status.replicas', 0),"
+            " 'readyReplicas': get(obj, 'status.readyReplicas', 0),"
+            " 'updatedReplicas': get(obj, 'status.updatedReplicas', 0),"
+            " 'availableReplicas': get(obj, 'status.availableReplicas', 0)}"
+        ),
+        "AggregateStatus": (
+            "set(obj, 'status', {"
+            " 'replicas': sum([get(i, 'status.replicas', 0) or 0 for i in items]),"
+            " 'readyReplicas': sum([get(i, 'status.readyReplicas', 0) or 0 for i in items]),"
+            " 'updatedReplicas': sum([get(i, 'status.updatedReplicas', 0) or 0 for i in items]),"
+            " 'availableReplicas': sum([get(i, 'status.availableReplicas', 0) or 0 for i in items])})"
+        ),
+    },
+    # Flink operator (flink.apache.org/v1beta1
+    # FlinkDeployment/customizations.yaml): replica weight is the
+    # taskmanager count; health tracks the operator's lifecycle state
+    ("flink.apache.org/v1beta1", "FlinkDeployment"): {
+        "InterpretReplica": (
+            # `or 0` (not `or 1`): an EXPLICIT replicas: 0 (suspended
+            # deployment) must round-trip with ReviseReplica(0)
+            "{'replicas': int(get(obj, 'spec.taskManager.replicas', 1) or 0),"
+            " 'requirements': {"
+            "   'cpu': get(obj, 'spec.taskManager.resource.cpu', 1),"
+            "   'memory': get(obj, 'spec.taskManager.resource.memory', '1Gi')}}"
+        ),
+        "ReviseReplica": "set(obj, 'spec.taskManager.replicas', replicas)",
+        "InterpretHealth": (
+            "get(obj, 'status.lifecycleState', '') == 'STABLE'"
+        ),
+        "InterpretStatus": (
+            "{'lifecycleState': get(obj, 'status.lifecycleState', ''),"
+            " 'jobState': get(obj, 'status.jobStatus.state', '')}"
+        ),
+    },
+    # Volcano batch Job (batch.volcano.sh/v1alpha1 Job/customizations.yaml):
+    # replicas is the sum over task groups; health follows the job phase
+    ("batch.volcano.sh/v1alpha1", "Job"): {
+        "InterpretReplica": (
+            "{'replicas': sum([get(t, 'replicas', 1) or 1"
+            "                  for t in get(obj, 'spec.tasks', [])])}"
+        ),
+        # divide by sequential fill over the task list: task i keeps
+        # min(own, total - sum(earlier)); minAvailable clamps to the revised
+        # total so the gang-scheduling bar stays satisfiable
+        "ReviseReplica": (
+            "set(set(obj, 'spec.tasks', ["
+            "  set(t, 'replicas', max(0, min(get(t, 'replicas', 1) or 1,"
+            "    replicas - sum([get(u, 'replicas', 1) or 1"
+            "      for u in get(obj, 'spec.tasks', [])[:i]]))))"
+            "  for i, t in enumerate(get(obj, 'spec.tasks', []))"
+            "]), 'spec.minAvailable',"
+            " min(get(obj, 'spec.minAvailable', replicas) or replicas, replicas))"
+        ),
+        "InterpretHealth": (
+            "get(obj, 'status.state.phase', '') in"
+            " ('Running', 'Completed', 'Completing')"
+        ),
+        "InterpretStatus": (
+            "{'state': get(obj, 'status.state', {}),"
+            " 'succeeded': get(obj, 'status.succeeded', 0),"
+            " 'failed': get(obj, 'status.failed', 0),"
+            " 'running': get(obj, 'status.running', 0)}"
+        ),
+        "AggregateStatus": (
+            "set(obj, 'status', {"
+            " 'running': sum([get(i, 'status.running', 0) or 0 for i in items]),"
+            " 'succeeded': sum([get(i, 'status.succeeded', 0) or 0 for i in items]),"
+            " 'failed': sum([get(i, 'status.failed', 0) or 0 for i in items]),"
+            " 'state': {'phase':"
+            "   'Running' if sum([get(i, 'status.running', 0) or 0 for i in items]) > 0"
+            "   else ('Failed' if sum([get(i, 'status.failed', 0) or 0 for i in items]) > 0"
+            "   else ('Completed' if sum([get(i, 'status.succeeded', 0) or 0 for i in items]) > 0"
+            "   else ''))}})"
+        ),
+    },
+    # Kubeflow TFJob (kubeflow.org/v1 TFJob/customizations.yaml): replicas
+    # is the sum over the role replica specs; health from the Succeeded/
+    # Running conditions
+    ("kubeflow.org/v1", "TFJob"): {
+        "InterpretReplica": (
+            "{'replicas': sum(["
+            "   get(s, 'replicas', 1) or 1"
+            "   for role, s in items(get(obj, 'spec.tfReplicaSpecs', {}))])}"
+        ),
+        # division scales the Worker role; fixed roles (PS/Chief/...) keep
+        # their counts and the Worker absorbs the difference
+        "ReviseReplica": (
+            "set(obj, 'spec.tfReplicaSpecs.Worker.replicas',"
+            " max(0, replicas - sum(["
+            "   get(s, 'replicas', 1) or 1"
+            "   for role, s in items(get(obj, 'spec.tfReplicaSpecs', {}))"
+            "   if role != 'Worker'])))"
+        ),
+        "InterpretHealth": (
+            "any([get(c, 'type', '') in ('Running', 'Succeeded')"
+            "     and get(c, 'status', '') == 'True'"
+            "     for c in get(obj, 'status.conditions', [])])"
+        ),
+        "InterpretStatus": (
+            "{'conditions': get(obj, 'status.conditions', []),"
+            " 'replicaStatuses': get(obj, 'status.replicaStatuses', {})}"
+        ),
+    },
+    # Flux HelmRelease (helm.toolkit.fluxcd.io/v2beta1
+    # HelmRelease/customizations.yaml): non-workload; health is the Ready
+    # condition
+    ("helm.toolkit.fluxcd.io/v2beta1", "HelmRelease"): {
+        "InterpretReplica": "{'replicas': 0}",
+        "InterpretHealth": (
+            "any([get(c, 'type', '') == 'Ready'"
+            "     and get(c, 'status', '') == 'True'"
+            "     for c in get(obj, 'status.conditions', [])])"
+        ),
+        "InterpretStatus": (
+            "{'conditions': get(obj, 'status.conditions', []),"
+            " 'lastAppliedRevision': get(obj, 'status.lastAppliedRevision', '')}"
+        ),
+    },
+    # Spark operator (sparkoperator.k8s.io/v1beta2
+    # SparkApplication/customizations.yaml)
+    ("sparkoperator.k8s.io/v1beta2", "SparkApplication"): {
+        "InterpretReplica": (
+            # `or 0` keeps the driver+executors total invertible with
+            # ReviseReplica: an explicit instances: 0 reads back as 1 total
+            "{'replicas': 1 + int(get(obj, 'spec.executor.instances', 1) or 0)}"
+        ),
+        "ReviseReplica": (
+            "set(obj, 'spec.executor.instances',"
+            "    replicas - 1 if replicas > 0 else 0)"
+        ),
+        "InterpretHealth": (
+            "get(obj, 'status.applicationState.state', '') in"
+            " ('RUNNING', 'COMPLETED', 'SUBMITTED')"
+        ),
+        "InterpretStatus": (
+            "{'applicationState': get(obj, 'status.applicationState', {}),"
+            " 'executorState': get(obj, 'status.executorState', {}),"
+            " 'lastSubmissionAttemptTime':"
+            "   get(obj, 'status.lastSubmissionAttemptTime', '')}"
+        ),
+    },
 }
 
 _compiled: Dict[Tuple[str, str], Dict[str, Callable]] = {}

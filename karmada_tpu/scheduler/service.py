@@ -32,7 +32,7 @@ from karmada_tpu.models.work import (
     TargetCluster,
 )
 from karmada_tpu.ops import serial, tensors
-from karmada_tpu.ops.solver import dispatch_compact, finalize_compact  # noqa: F401
+from karmada_tpu.ops.solver import dispatch_compact, finalize_compact
 from karmada_tpu.webhook.admission import AdmissionDenied
 from karmada_tpu.scheduler import metrics as sched_metrics
 from karmada_tpu.scheduler.queue import QueuedBindingInfo, SchedulingQueue
@@ -353,8 +353,6 @@ class Scheduler:
             # it while the host walks the spread bindings' DFS ping-pong
             handle = None
             if device_idx:
-                from karmada_tpu.ops.solver import dispatch_compact
-
                 handle = dispatch_compact(batch, waves=self.waves)
             if spread_idx:
                 from karmada_tpu.ops.spread import solve_spread
@@ -372,8 +370,6 @@ class Scheduler:
                     schedule_step=sched_metrics.STEP_SOLVE,
                 )
             if device_idx:
-                from karmada_tpu.ops.solver import finalize_compact
-
                 t1 = time.perf_counter()
                 idx, val, status, _nnz = finalize_compact(handle)
                 sched_metrics.STEP_LATENCY.observe(

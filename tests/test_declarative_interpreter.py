@@ -201,3 +201,115 @@ def test_alphabetical_priority_between_customizations():
     cp.store.create(z)
     cp.store.create(a)
     assert cp.interpreter.get_replicas(crd_workload())[0] == 111
+
+
+def test_thirdparty_flink_volcano_kubeflow_flux_spark():
+    """Round-3 bundle additions (default/thirdparty/resourcecustomizations/
+    flink.apache.org, batch.volcano.sh, kubeflow.org, helm.toolkit.fluxcd.io,
+    sparkoperator.k8s.io)."""
+    interp = ResourceInterpreter()
+
+    flink = {"apiVersion": "flink.apache.org/v1beta1", "kind": "FlinkDeployment",
+             "metadata": {"namespace": "d", "name": "f"},
+             "spec": {"taskManager": {"replicas": 4,
+                                      "resource": {"cpu": 2, "memory": "2Gi"}}},
+             "status": {"lifecycleState": "STABLE",
+                        "jobStatus": {"state": "RUNNING"}}}
+    replicas, req = interp.get_replicas(flink)
+    assert replicas == 4 and req.resource_request["cpu"].milli == 2000
+    assert interp.interpret_health(flink) == "Healthy"
+    assert interp.revise_replica(flink, 6)["spec"]["taskManager"]["replicas"] == 6
+
+    volcano = {"apiVersion": "batch.volcano.sh/v1alpha1", "kind": "Job",
+               "metadata": {"namespace": "d", "name": "v"},
+               "spec": {"tasks": [{"replicas": 2}, {"replicas": 3}]},
+               "status": {"state": {"phase": "Running"}, "running": 5}}
+    assert interp.get_replicas(volcano)[0] == 5
+    assert interp.interpret_health(volcano) == "Healthy"
+    assert interp.reflect_status(volcano)["running"] == 5
+
+    tfjob = {"apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+             "metadata": {"namespace": "d", "name": "t"},
+             "spec": {"tfReplicaSpecs": {"PS": {"replicas": 1},
+                                         "Worker": {"replicas": 3}}},
+             "status": {"conditions": [
+                 {"type": "Running", "status": "True"}]}}
+    assert interp.get_replicas(tfjob)[0] == 4
+    assert interp.interpret_health(tfjob) == "Healthy"
+
+    helm = {"apiVersion": "helm.toolkit.fluxcd.io/v2beta1", "kind": "HelmRelease",
+            "metadata": {"namespace": "d", "name": "h"},
+            "status": {"conditions": [{"type": "Ready", "status": "False"}]}}
+    assert interp.get_replicas(helm)[0] == 0
+    assert interp.interpret_health(helm) == "Unhealthy"
+
+    spark = {"apiVersion": "sparkoperator.k8s.io/v1beta2",
+             "kind": "SparkApplication",
+             "metadata": {"namespace": "d", "name": "s"},
+             "spec": {"executor": {"instances": 3}},
+             "status": {"applicationState": {"state": "RUNNING"}}}
+    assert interp.get_replicas(spark)[0] == 4  # driver + executors
+    assert interp.interpret_health(spark) == "Healthy"
+    revised = interp.revise_replica(spark, 6)
+    assert revised["spec"]["executor"]["instances"] == 5
+
+
+def test_thirdparty_divisibility_roundtrips():
+    """ReviseReplica must round-trip with InterpretReplica for every
+    divisible bundle kind (review finding: otherwise a Divided placement
+    over-deploys on every member)."""
+    interp = ResourceInterpreter()
+
+    # Volcano: sequential fill across tasks + minAvailable clamp
+    volcano = {"apiVersion": "batch.volcano.sh/v1alpha1", "kind": "Job",
+               "metadata": {"namespace": "d", "name": "v"},
+               "spec": {"minAvailable": 5,
+                        "tasks": [{"name": "master", "replicas": 1},
+                                  {"name": "worker", "replicas": 4}]}}
+    revised = interp.revise_replica(volcano, 3)
+    assert [t["replicas"] for t in revised["spec"]["tasks"]] == [1, 2]
+    assert revised["spec"]["minAvailable"] == 3
+    assert interp.get_replicas(revised)[0] == 3
+    # original untouched (copy-on-write set())
+    assert [t["replicas"] for t in volcano["spec"]["tasks"]] == [1, 4]
+
+    # TFJob: Worker absorbs the division, fixed roles keep their counts
+    tfjob = {"apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+             "metadata": {"namespace": "d", "name": "t"},
+             "spec": {"tfReplicaSpecs": {"PS": {"replicas": 1},
+                                         "Worker": {"replicas": 3}}}}
+    revised = interp.revise_replica(tfjob, 2)
+    assert revised["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] == 1
+    assert interp.get_replicas(revised)[0] == 2
+
+    # Spark: explicit instances: 0 round-trips (driver-only == 1 replica)
+    spark = {"apiVersion": "sparkoperator.k8s.io/v1beta2",
+             "kind": "SparkApplication",
+             "metadata": {"namespace": "d", "name": "s"},
+             "spec": {"executor": {"instances": 3}}}
+    revised = interp.revise_replica(spark, 1)
+    assert revised["spec"]["executor"]["instances"] == 0
+    assert interp.get_replicas(revised)[0] == 1
+
+    # Flink: scale-to-zero round-trips
+    flink = {"apiVersion": "flink.apache.org/v1beta1", "kind": "FlinkDeployment",
+             "metadata": {"namespace": "d", "name": "f"},
+             "spec": {"taskManager": {"replicas": 4}}}
+    revised = interp.revise_replica(flink, 0)
+    assert interp.get_replicas(revised)[0] == 0
+
+
+def test_thirdparty_volcano_aggregate_status():
+    interp = ResourceInterpreter()
+    from karmada_tpu.models.work import AggregatedStatusItem
+
+    volcano = {"apiVersion": "batch.volcano.sh/v1alpha1", "kind": "Job",
+               "metadata": {"namespace": "d", "name": "v"},
+               "spec": {"tasks": [{"replicas": 4}]}}
+    items = [AggregatedStatusItem(cluster_name="m1",
+                                  status={"running": 2, "succeeded": 0, "failed": 0}),
+             AggregatedStatusItem(cluster_name="m2",
+                                  status={"running": 3, "succeeded": 1, "failed": 0})]
+    merged = interp.aggregate_status(volcano, items)
+    assert merged["status"]["running"] == 5
+    assert merged["status"]["state"]["phase"] == "Running"
