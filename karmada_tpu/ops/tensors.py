@@ -241,6 +241,35 @@ def _route_for(
     return ROUTE_DEVICE
 
 
+# spec-free probe for the placement-only route: _route_for reads only
+# spec.components (empty here), so one call per distinct placement suffices
+_ROUTE_PROBE_SPEC = ResourceBindingSpec()
+
+
+def fnv32a_batch_odd(uids: List[str]) -> np.ndarray:
+    """Vectorized tiebreak_descending_by_uid over a batch: bool[n] of
+    fnv32a(uid) & 1, with empty uids False (webster.py:52-57 semantics).
+    One numpy pass per character column instead of a Python loop per byte."""
+    n = len(uids)
+    bs = [u.encode("utf-8") for u in uids]
+    lens = np.fromiter((len(x) for x in bs), np.int64, n)
+    L = int(lens.max()) if n else 0
+    if L == 0:
+        return np.zeros(n, bool)
+    flat = np.frombuffer(b"".join(bs), np.uint8)
+    starts = np.zeros(n + 1, np.int64)
+    np.cumsum(lens, out=starts[1:])
+    h = np.full(n, 0x811C9DC5, np.uint64)
+    idx0 = starts[:-1]
+    for j in range(L):
+        valid = lens > j
+        c = np.zeros(n, np.uint64)
+        c[valid] = flat[idx0[valid] + j]
+        hv = (h ^ c) * np.uint64(0x01000193) & np.uint64(0xFFFFFFFF)
+        h = np.where(valid, hv, h)
+    return ((h & np.uint64(1)).astype(bool)) & (lens > 0)
+
+
 @dataclass
 class _SetClass:
     """Request class for a multi-template workload: capacity is counted in
@@ -370,10 +399,16 @@ def encode_batch(
     evict_entries: List[List[int]] = [[] for _ in range(B)]
 
     eff_placements: List[Placement] = []
+    n_regions = len(region_names)
+    # per-call pid -> placement-only route (spec-free: _route_for reads only
+    # spec.components, empty on the common path)
+    route_by_pid: Dict[int, int] = {}
+    uids: List[str] = []
+    on_device = (ROUTE_DEVICE, ROUTE_DEVICE_SPREAD)
+    cindex_get = cindex.index.get
     for b, (spec, status) in enumerate(items):
         placement = _effective_placement(spec, status)
         eff_placements.append(placement)
-        route[b] = _route_for(spec, placement, len(region_names))
         # only SHARED placement objects (placement is spec.placement) are
         # worth memoizing — _effective_placement builds fresh objects for
         # the affinity-resolution path, which would never hit and would pin
@@ -387,18 +422,24 @@ def encode_batch(
                 cache.placement_keys[id(placement)] = (placement, key)
         else:
             key = _placement_key(placement)
-        if key not in pkeys:
-            pkeys[key] = len(placements)
+        pid = pkeys.get(key)
+        if pid is None:
+            pid = pkeys[key] = len(placements)
             placements.append(placement)
-        placement_id[b] = pkeys[key]
+            route_by_pid[pid] = _route_for(_ROUTE_PROBE_SPEC, placement,
+                                           n_regions)
+        placement_id[b] = pid
+        r = (route_by_pid[pid] if not spec.components
+             else _route_for(spec, placement, n_regions))
 
         g = (spec.resource.api_version, spec.resource.kind)
-        if g not in gvks:
-            gvks[g] = len(gvks)
-        gvk_id[b] = gvks[g]
+        gid = gvks.get(g)
+        if gid is None:
+            gid = gvks[g] = len(gvks)
+        gvk_id[b] = gid
 
         rr = spec.replica_requirements
-        if len(spec.components) > 1 and route[b] == ROUTE_DEVICE:
+        if len(spec.components) > 1 and r == ROUTE_DEVICE:
             # multi-template: the request class is the per-set aggregate
             from karmada_tpu.estimator.general import (
                 per_set_requirement,
@@ -416,21 +457,26 @@ def encode_batch(
                         res_names[n] = len(res_names)
             class_id[b] = classes[ck]
         elif rr is not None and rr.resource_request:
+            # canonical (sorted) key: permutations of the same request must
+            # dedup into ONE class row, or the class axis inflates past pow2
+            # boundaries (recompiles) and assembled_sig misses its cache
             ck = tuple(sorted((n, q.milli) for n, q in rr.resource_request.items()))
-            if ck not in classes:
-                classes[ck] = len(classes)
+            cid = classes.get(ck)
+            if cid is None:
+                cid = classes[ck] = len(classes)
                 class_reqs.append(rr)
                 for n in rr.resource_request:
                     if n not in res_names:
                         res_names[n] = len(res_names)
-            class_id[b] = classes[ck]
+            class_id[b] = cid
 
-        replicas[b] = spec.replicas
-        uid_desc[b] = tiebreak_descending_by_uid(spec.resource.uid)
+        nrep = spec.replicas
+        replicas[b] = nrep
+        uids.append(spec.resource.uid)
         fresh[b] = serial.reschedule_required(spec, status)
-        is_workload = (spec.replicas > 0 or rr is not None) and len(spec.components) <= 1
+        is_workload = (nrep > 0 or rr is not None) and len(spec.components) <= 1
         non_workload[b] = not is_workload
-        nw_shortcut[b] = spec.replicas == 0 and not spec.components
+        nw_shortcut[b] = nrep == 0 and not spec.components
         # prev entries naming clusters absent from the current snapshot
         # cannot be addressed by the dense encoding, and the reference CAN
         # re-assign to a vanished cluster during scale-down
@@ -438,24 +484,30 @@ def encode_batch(
         # of snapshot membership) -- route those bindings to the serial host.
         # Duplicate names keep the LAST entry (serial paths build
         # {name: replicas} dicts, serial.py:658 -- last wins).
-        on_device = (ROUTE_DEVICE, ROUTE_DEVICE_SPREAD)
-        prev_by_lane: Dict[int, int] = {}
-        for tc in spec.clusters:
-            ci = cindex.index.get(tc.name)
-            if ci is not None:
-                prev_by_lane[ci] = tc.replicas
-            elif route[b] in on_device:
-                route[b] = ROUTE_VANISHED_PREV
-        prev_entries[b] = list(prev_by_lane.items())
-        if route[b] in on_device and (
-            spec.replicas > KERNEL_REPLICA_CAP
-            or any(v > KERNEL_REPLICA_CAP for v in prev_by_lane.values())
-        ):
-            route[b] = ROUTE_HUGE_REPLICAS
-        for task in spec.graceful_eviction_tasks:
-            ci = cindex.index.get(task.from_cluster)
-            if ci is not None:
-                evict_entries[b].append(ci)
+        if spec.clusters:
+            prev_by_lane: Dict[int, int] = {}
+            for tc in spec.clusters:
+                ci = cindex_get(tc.name)
+                if ci is not None:
+                    prev_by_lane[ci] = tc.replicas
+                elif r in on_device:
+                    r = ROUTE_VANISHED_PREV
+            prev_entries[b] = list(prev_by_lane.items())
+            if r in on_device and (
+                nrep > KERNEL_REPLICA_CAP
+                or any(v > KERNEL_REPLICA_CAP for v in prev_by_lane.values())
+            ):
+                r = ROUTE_HUGE_REPLICAS
+        elif nrep > KERNEL_REPLICA_CAP and r in on_device:
+            r = ROUTE_HUGE_REPLICAS
+        if spec.graceful_eviction_tasks:
+            for task in spec.graceful_eviction_tasks:
+                ci = cindex_get(task.from_cluster)
+                if ci is not None:
+                    evict_entries[b].append(ci)
+        route[b] = r
+    if nB:
+        uid_desc[:nB] = fnv32a_batch_odd(uids)
 
     # rows the host path owns must not schedule NOR consume wave capacity on
     # device (their device results are discarded; charging them would price
@@ -826,33 +878,55 @@ def decode_compact(
     idx/val carry every (selected OR replicas>0) lane: replicas>0 entries
     are assignments; val==0 entries are selected-only lanes, meaningful for
     non-workload propagation and empty-workload propagation.
+
+    CONTRACT: idx must be ascending among its >=0 entries (row-major
+    binding order) — _compact_extract's jnp.nonzero guarantees this; any
+    other producer must sort first (asserted below).
     """
     names = batch.cluster_index.names
     C = batch.C
-    per_b: List[List[Tuple[int, int]]] = [[] for _ in range(batch.n_bindings)]
-    for i, v in zip(np.asarray(idx).tolist(), np.asarray(val).tolist()):
-        if i < 0:
-            continue
-        b, c = divmod(i, C)
-        if b < batch.n_bindings and c < batch.n_clusters:
-            per_b[b].append((c, v))
-    status = np.asarray(status)
+    nb = batch.n_bindings
+    # vectorized COO split: _compact_extract emits row-major (b ascending)
+    # order, so per-binding runs are contiguous and searchsorted finds them
+    idx = np.asarray(idx)
+    val = np.asarray(val)
+    keep = idx >= 0
+    iv = idx[keep]
+    vv = val[keep]
+    b_arr = iv // C
+    c_arr = iv - b_arr * C
+    in_range = (b_arr < nb) & (c_arr < batch.n_clusters)
+    b_arr = b_arr[in_range]
+    c_arr = c_arr[in_range]
+    vv = vv[in_range]
+    assert b_arr.size == 0 or np.all(np.diff(b_arr) >= 0), (
+        "decode_compact requires row-major (ascending) COO input"
+    )
+    bounds = np.searchsorted(b_arr, np.arange(nb + 1))
+    status = np.asarray(status).tolist()
+    non_workload = batch.non_workload
     out: List = []
-    for b in range(batch.n_bindings):
-        err = _status_error(batch, b, int(status[b]), items)
-        if err is not None:
-            out.append(err)
-            continue
-        if batch.non_workload[b]:
-            targets = [TargetCluster(name=names[c], replicas=0) for c, _ in per_b[b]]
+    for b in range(nb):
+        st = status[b]
+        if st != 0:
+            err = _status_error(batch, b, int(st), items)
+            if err is not None:
+                out.append(err)
+                continue
+        lo, hi = bounds[b], bounds[b + 1]
+        cs = c_arr[lo:hi].tolist()
+        vs = vv[lo:hi].tolist()
+        if non_workload[b]:
+            targets = [TargetCluster(name=names[c], replicas=0) for c in cs]
         else:
             targets = [
-                TargetCluster(name=names[c], replicas=v) for c, v in per_b[b] if v > 0
+                TargetCluster(name=names[c], replicas=v)
+                for c, v in zip(cs, vs) if v > 0
             ]
             if enable_empty_workload_propagation:
                 targets += [
                     TargetCluster(name=names[c], replicas=0)
-                    for c, v in per_b[b]
+                    for c, v in zip(cs, vs)
                     if v == 0
                 ]
         targets.sort(key=lambda t: t.name)
