@@ -516,6 +516,23 @@ def cmd_addons(args) -> int:
     return 0
 
 
+def cmd_completion(args) -> int:
+    """Emit a bash completion function over the live subcommand set
+    (pkg/karmadactl/completion)."""
+    cmds = " ".join(sorted(COMMANDS))
+    print(f"""_karmadactl_completions() {{
+  COMPREPLY=($(compgen -W "{cmds}" -- "${{COMP_WORDS[COMP_CWORD]}}"))
+}}
+complete -F _karmadactl_completions karmadactl""")
+    return 0
+
+
+def cmd_options(args) -> int:
+    """List global flags (pkg/karmadactl/options)."""
+    print("--dir   control plane directory (required)")
+    return 0
+
+
 def cmd_deinit(args) -> int:
     """Tear down the persisted control plane (pkg/karmadactl/deinit)."""
     import shutil
@@ -662,6 +679,9 @@ def build_parser() -> argparse.ArgumentParser:
         "quota-enforcement", "stateful-failover", "priority-queue",
     ])
 
+    sub.add_parser("completion")
+    sub.add_parser("options")
+
     di = sub.add_parser("deinit")
     di.add_argument("--force", action="store_true")
 
@@ -695,8 +715,14 @@ def main(argv: Optional[list] = None) -> int:
         return 0
 
 
+COMMANDS = {}
+
+
 def _dispatch(args) -> int:
-    return {
+    return COMMANDS[args.command](args)
+
+
+COMMANDS.update({
         "init": cmd_init,
         "join": cmd_join,
         "unjoin": cmd_unjoin,
@@ -719,9 +745,11 @@ def _dispatch(args) -> int:
         "unregister": cmd_unregister,
         "addons": cmd_addons,
         "deinit": cmd_deinit,
+        "completion": cmd_completion,
+        "options": cmd_options,
         "tick": cmd_tick,
         "serve": cmd_serve,
-    }[args.command](args)
+})
 
 
 if __name__ == "__main__":

@@ -229,3 +229,11 @@ def test_addons_gate_rehydrates_across_invocations(tmp_path):
     assert run(tmp_path, "addons", "disable", "multicluster-service") == 0
     cp = _load_plane(str(tmp_path / "plane"))
     assert cp.gates.enabled("MultiClusterService") is False
+
+
+def test_completion_and_options(tmp_path, capsys):
+    assert run(tmp_path, "completion") == 0
+    out = capsys.readouterr().out
+    assert "complete -F" in out and "describe" in out and "serve" in out
+    assert run(tmp_path, "options") == 0
+    assert "--dir" in capsys.readouterr().out
