@@ -516,10 +516,73 @@ def cmd_addons(args) -> int:
     return 0
 
 
+def _deep_merge(dst: dict, src: dict) -> dict:
+    for k, v in src.items():
+        if v is None:
+            dst.pop(k, None)
+        elif isinstance(v, dict):
+            if isinstance(dst.get(k), dict):
+                _deep_merge(dst[k], v)
+            else:
+                # fresh subtree: recurse into an empty dict so nulls are
+                # stripped on create too (RFC 7386 semantics)
+                dst[k] = _deep_merge({}, v)
+        else:
+            dst[k] = v
+    return dst
+
+
+def cmd_patch(args) -> int:
+    """Strategic-merge-style patch of a template object
+    (pkg/karmadactl/patch): `-p '{"spec": {"replicas": 5}}'`; null deletes
+    a key."""
+    cp = _load_plane(args.dir)
+    try:
+        patch = json.loads(args.patch)
+    except json.JSONDecodeError as e:
+        print(f"invalid patch JSON: {e}", file=sys.stderr)
+        return 1
+    if not isinstance(patch, dict):
+        print("patch must be a JSON object", file=sys.stderr)
+        return 1
+
+    if any(k in patch for k in ("kind", "apiVersion")):
+        print("cannot patch kind/apiVersion", file=sys.stderr)
+        return 1
+    meta_patch = patch.get("metadata", {})
+    if any(k in meta_patch for k in ("name", "namespace", "uid")):
+        print("cannot patch metadata identity fields", file=sys.stderr)
+        return 1
+
+    def update(obj) -> None:
+        if not hasattr(obj, "manifest"):
+            raise TypeError(
+                f"{args.kind} is a typed API object; edit it with apply"
+            )
+        _deep_merge(obj.manifest, patch)
+        # to_manifest() re-syncs metadata from ObjectMeta, so label/
+        # annotation patches must land there too or they silently revert
+        for field, target in (("labels", obj.metadata.labels),
+                              ("annotations", obj.metadata.annotations)):
+            if field in meta_patch:
+                _deep_merge(target, meta_patch[field] or {})
+    try:
+        cp.store.mutate(args.kind, args.namespace, args.name, update)
+    except KeyError:
+        print(f"{args.kind}/{args.name} not found", file=sys.stderr)
+        return 1
+    except TypeError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    _finish(cp)
+    print(f"{args.kind}/{args.name} patched")
+    return 0
+
+
 def cmd_completion(args) -> int:
     """Emit a bash completion function over the live subcommand set
     (pkg/karmadactl/completion)."""
-    cmds = " ".join(sorted(COMMANDS))
+    cmds = " ".join(sorted([*COMMANDS, "version"]))
     print(f"""_karmadactl_completions() {{
   COMPREPLY=($(compgen -W "{cmds}" -- "${{COMP_WORDS[COMP_CWORD]}}"))
 }}
@@ -679,6 +742,12 @@ def build_parser() -> argparse.ArgumentParser:
         "quota-enforcement", "stateful-failover", "priority-queue",
     ])
 
+    pt = sub.add_parser("patch")
+    pt.add_argument("kind")
+    pt.add_argument("name")
+    pt.add_argument("-n", "--namespace", default="")
+    pt.add_argument("-p", "--patch", required=True, help="JSON merge patch")
+
     sub.add_parser("completion")
     sub.add_parser("options")
 
@@ -715,41 +784,39 @@ def main(argv: Optional[list] = None) -> int:
         return 0
 
 
-COMMANDS = {}
+COMMANDS = {
+    "init": cmd_init,
+    "join": cmd_join,
+    "unjoin": cmd_unjoin,
+    "get": cmd_get,
+    "apply": cmd_apply,
+    "promote": cmd_promote,
+    "cordon": cmd_cordon,
+    "uncordon": lambda a: cmd_cordon(a, uncordon=True),
+    "top": cmd_top,
+    "interpret": cmd_interpret,
+    "describe": cmd_describe,
+    "delete": cmd_delete,
+    "label": lambda a: cmd_meta_edit(a, "labels"),
+    "annotate": lambda a: cmd_meta_edit(a, "annotations"),
+    "taint": cmd_taint,
+    "api-resources": cmd_api_resources,
+    "explain": cmd_explain,
+    "token": cmd_token,
+    "register": cmd_register,
+    "unregister": cmd_unregister,
+    "addons": cmd_addons,
+    "deinit": cmd_deinit,
+    "patch": cmd_patch,
+    "completion": cmd_completion,
+    "options": cmd_options,
+    "tick": cmd_tick,
+    "serve": cmd_serve,
+}
 
 
 def _dispatch(args) -> int:
     return COMMANDS[args.command](args)
-
-
-COMMANDS.update({
-        "init": cmd_init,
-        "join": cmd_join,
-        "unjoin": cmd_unjoin,
-        "get": cmd_get,
-        "apply": cmd_apply,
-        "promote": cmd_promote,
-        "cordon": cmd_cordon,
-        "uncordon": lambda a: cmd_cordon(a, uncordon=True),
-        "top": cmd_top,
-        "interpret": cmd_interpret,
-        "describe": cmd_describe,
-        "delete": cmd_delete,
-        "label": lambda a: cmd_meta_edit(a, "labels"),
-        "annotate": lambda a: cmd_meta_edit(a, "annotations"),
-        "taint": cmd_taint,
-        "api-resources": cmd_api_resources,
-        "explain": cmd_explain,
-        "token": cmd_token,
-        "register": cmd_register,
-        "unregister": cmd_unregister,
-        "addons": cmd_addons,
-        "deinit": cmd_deinit,
-        "completion": cmd_completion,
-        "options": cmd_options,
-        "tick": cmd_tick,
-        "serve": cmd_serve,
-})
 
 
 if __name__ == "__main__":

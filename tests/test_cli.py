@@ -237,3 +237,46 @@ def test_completion_and_options(tmp_path, capsys):
     assert "complete -F" in out and "describe" in out and "serve" in out
     assert run(tmp_path, "options") == 0
     assert "--dir" in capsys.readouterr().out
+
+
+def test_patch_template_scales_replicas(tmp_path, capsys):
+    assert run(tmp_path, "init") == 0
+    assert run(tmp_path, "join", "m1") == 0
+    assert run(tmp_path, "apply", "-f", deployment_yaml(tmp_path, replicas=4)) == 0
+    assert run(tmp_path, "patch", "Deployment", "web", "-n", "default",
+               "-p", '{"spec": {"replicas": 7}}') == 0
+    capsys.readouterr()
+    assert run(tmp_path, "get", "Deployment", "web", "-n", "default",
+               "-o", "json") == 0
+    doc = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert doc["spec"]["replicas"] == 7
+    # bad patch and typed-object refusal
+    assert run(tmp_path, "patch", "Deployment", "web", "-n", "default",
+               "-p", "not-json") == 1
+    assert run(tmp_path, "patch", "Cluster", "m1", "-p", '{"spec": {}}') == 1
+
+
+def test_patch_metadata_labels_and_null_semantics(tmp_path, capsys):
+    assert run(tmp_path, "init") == 0
+    assert run(tmp_path, "apply", "-f", deployment_yaml(tmp_path)) == 0
+    # label patch must survive to_manifest's ObjectMeta re-sync
+    assert run(tmp_path, "patch", "Deployment", "web", "-n", "default",
+               "-p", '{"metadata": {"labels": {"app": "api"}}}') == 0
+    capsys.readouterr()
+    assert run(tmp_path, "get", "Deployment", "web", "-n", "default",
+               "-o", "json") == 0
+    doc = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert doc["metadata"]["labels"]["app"] == "api"
+    # nulls are stripped even inside a freshly-created subtree (RFC 7386)
+    assert run(tmp_path, "patch", "Deployment", "web", "-n", "default",
+               "-p", '{"spec": {"fresh": {"a": 1, "b": null}}}') == 0
+    capsys.readouterr()
+    assert run(tmp_path, "get", "Deployment", "web", "-n", "default",
+               "-o", "json") == 0
+    doc = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert doc["spec"]["fresh"] == {"a": 1}
+    # identity fields refuse
+    assert run(tmp_path, "patch", "Deployment", "web", "-n", "default",
+               "-p", '{"metadata": {"name": "x"}}') == 1
+    assert run(tmp_path, "patch", "Deployment", "web", "-n", "default",
+               "-p", '{"kind": "Job"}') == 1
