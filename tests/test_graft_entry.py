@@ -1,0 +1,26 @@
+"""The driver's hooks must keep working between rounds: entry() compiles
+and runs single-device; dryrun_multichip shards the full step over a
+(bindings, clusters) mesh (conftest already pins the 8-device virtual CPU
+platform, which force_cpu detects and reuses)."""
+
+from __future__ import annotations
+
+import jax
+
+import __graft_entry__ as graft
+
+
+def test_entry_runs():
+    fn, args = graft.entry()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    rep = out[0]
+    assert rep.ndim == 2
+
+
+def test_dryrun_multichip_two_devices():
+    graft.dryrun_multichip(2)
+
+
+def test_dryrun_multichip_eight_devices():
+    graft.dryrun_multichip(8)
