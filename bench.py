@@ -305,6 +305,35 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
     return time.perf_counter() - t0, solve_s, scheduled, chunk_lat, chunk_wall
 
 
+def build_rebalance_items(rng: random.Random, items, names):
+    """BASELINE config 5's second half: bindings that WERE scheduled now
+    need re-assignment (descheduler marks clusters lossy / triggers
+    reschedule). Prev assignments seed Steady scale-up/down and Fresh
+    paths — the exact solver modes the descheduler reuses."""
+    from karmada_tpu.models.work import TargetCluster
+
+    out = []
+    for k, (spec, status) in enumerate(items):
+        import dataclasses
+
+        prev_n = rng.randint(1, 4)
+        start = rng.randrange(len(names))
+        per = max(1, spec.replicas // prev_n)
+        prev = [
+            TargetCluster(name=names[(start + j) % len(names)], replicas=per)
+            for j in range(prev_n)
+        ]
+        new_spec = dataclasses.replace(
+            spec,
+            clusters=prev,
+            # a third of the fleet gets an explicit reschedule trigger
+            # (WorkloadRebalancer / failover path -> Fresh mode)
+            reschedule_triggered_at=(100.0 if k % 3 == 0 else None),
+        )
+        out.append((new_spec, ResourceBindingStatus()))
+    return out
+
+
 def run_serial(items, clusters, estimator):
     cal = serial.make_cal_available([estimator])
     t0 = time.perf_counter()
@@ -395,6 +424,16 @@ def main() -> None:
             items, cindex, estimator, args.chunk, cache, waves=args.waves)
         throughput = args.bindings / elapsed
 
+        # descheduler rebalance loop (BASELINE config 5, second half):
+        # one chunk of previously-scheduled bindings re-assigned with prev
+        # seats (Steady scale-up/down + Fresh reschedule triggers)
+        reb_items = build_rebalance_items(
+            rng, items[: args.chunk], [c.name for c in clusters])
+        cache.reset_for_cycle()
+        reb_elapsed, _, reb_ok, _, _ = run_batched(
+            reb_items, cindex, estimator, args.chunk, cache, waves=args.waves)
+        rebalance_bps = len(reb_items) / reb_elapsed if reb_elapsed > 0 else 0.0
+
         # serial control: prefer the C++ control (Go-equivalent); it is fast
         # enough to run a much larger sample than the Python port
         native_sample = items[:: max(1, len(items) // (args.serial_sample * 32))][
@@ -456,6 +495,8 @@ def main() -> None:
             "p99_chunk_wall_s": round(
                 float(np.percentile(chunk_wall, 99)), 4) if chunk_wall else None,
             "scheduled_ok": scheduled,
+            "rebalance_bindings_per_s": round(rebalance_bps, 1),
+            "rebalance_ok": reb_ok,
             "serial_bindings_per_s": round(serial_throughput, 2),
             "serial_python_bindings_per_s": round(py_serial_throughput, 2),
             "serial_sample": len(native_sample) if native_ok else len(sample),

@@ -93,6 +93,28 @@ def validate_override_policy(op, p, old) -> Optional[str]:
 # -- FederatedResourceQuota -------------------------------------------------
 
 
+def validate_interpreter_webhook(op, w, old) -> Optional[str]:
+    """ResourceInterpreterWebhook admission (the reference validates these
+    in cmd/webhook, webhook.go:186-232): endpoint scheme + non-empty rules
+    with explicit wildcards, so a half-built config can never silently
+    hijack interpretation (interpreter/webhook._rule_matches)."""
+    spec = w.spec
+    if not spec.endpoint:
+        return "endpoint must not be empty"
+    if not (spec.endpoint.startswith("http://")
+            or spec.endpoint.startswith("local:")):
+        return f"unsupported endpoint scheme {spec.endpoint!r}"
+    if not spec.rules:
+        return "rules must not be empty"
+    for rule in spec.rules:
+        if not rule.api_versions or not rule.kinds:
+            return ("every rule needs explicit apiVersions and kinds "
+                    "(use \"*\" for wildcard)")
+    if spec.timeout_s <= 0:
+        return "timeout_s must be positive"
+    return None
+
+
 def validate_frq(op, q, old) -> Optional[str]:
     for name, qty in q.spec.overall.items():
         if qty.milli < 0:
@@ -208,3 +230,7 @@ def install_default_webhooks(
         registry.register_validating(kind, validate_override_policy)
     registry.register_validating(FederatedResourceQuota.KIND, validate_frq)
     registry.register_validating(ResourceBinding.KIND, QuotaEnforcer(store, gates))
+    from karmada_tpu.models.config import ResourceInterpreterWebhook
+
+    registry.register_validating(ResourceInterpreterWebhook.KIND,
+                                 validate_interpreter_webhook)

@@ -156,3 +156,36 @@ def test_quota_gate_scale_down_releases_budget():
     cp.tick()
     q = cp.store.get(FederatedResourceQuota.KIND, "default", "quota")
     assert q.status.overall_used["cpu"].milli == 1000
+
+
+def test_interpreter_webhook_admission():
+    from karmada_tpu.e2e import ControlPlane
+    from karmada_tpu.models.config import (
+        InterpreterRule,
+        ResourceInterpreterWebhook,
+        ResourceInterpreterWebhookSpec,
+    )
+    from karmada_tpu.models.meta import ObjectMeta
+    from karmada_tpu.webhook.admission import AdmissionDenied
+
+    cp = ControlPlane()
+
+    def mk(endpoint, rules, timeout_s=5.0, name="w"):
+        return ResourceInterpreterWebhook(
+            metadata=ObjectMeta(name=name),
+            spec=ResourceInterpreterWebhookSpec(
+                endpoint=endpoint, rules=rules, timeout_s=timeout_s))
+
+    ok_rule = InterpreterRule(api_versions=["apps/v1"], kinds=["*"],
+                              operations=["*"])
+    cp.store.create(mk("http://127.0.0.1:9/x", [ok_rule]))
+
+    import pytest
+    with pytest.raises(AdmissionDenied):
+        cp.store.create(mk("ftp://nope", [ok_rule], name="bad-scheme"))
+    with pytest.raises(AdmissionDenied):
+        cp.store.create(mk("local:x", [], name="no-rules"))
+    with pytest.raises(AdmissionDenied):
+        cp.store.create(mk("local:x", [InterpreterRule()], name="empty-rule"))
+    with pytest.raises(AdmissionDenied):
+        cp.store.create(mk("local:x", [ok_rule], timeout_s=0, name="bad-timeout"))
