@@ -310,12 +310,12 @@ def build_rebalance_items(rng: random.Random, items, names):
     need re-assignment (descheduler marks clusters lossy / triggers
     reschedule). Prev assignments seed Steady scale-up/down and Fresh
     paths — the exact solver modes the descheduler reuses."""
+    import dataclasses
+
     from karmada_tpu.models.work import TargetCluster
 
     out = []
     for k, (spec, status) in enumerate(items):
-        import dataclasses
-
         prev_n = rng.randint(1, 4)
         start = rng.randrange(len(names))
         per = max(1, spec.replicas // prev_n)

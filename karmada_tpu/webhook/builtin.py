@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+from karmada_tpu.models.config import ResourceInterpreterWebhook
 from karmada_tpu.models.extras import FederatedResourceQuota
 from karmada_tpu.models.policy import (
     ClusterOverridePolicy,
@@ -107,9 +108,9 @@ def validate_interpreter_webhook(op, w, old) -> Optional[str]:
     if not spec.rules:
         return "rules must not be empty"
     for rule in spec.rules:
-        if not rule.api_versions or not rule.kinds:
-            return ("every rule needs explicit apiVersions and kinds "
-                    "(use \"*\" for wildcard)")
+        if not rule.api_versions or not rule.kinds or not rule.operations:
+            return ("every rule needs explicit apiVersions, kinds and "
+                    "operations (use \"*\" for wildcard)")
     if spec.timeout_s <= 0:
         return "timeout_s must be positive"
     return None
@@ -230,7 +231,5 @@ def install_default_webhooks(
         registry.register_validating(kind, validate_override_policy)
     registry.register_validating(FederatedResourceQuota.KIND, validate_frq)
     registry.register_validating(ResourceBinding.KIND, QuotaEnforcer(store, gates))
-    from karmada_tpu.models.config import ResourceInterpreterWebhook
-
     registry.register_validating(ResourceInterpreterWebhook.KIND,
                                  validate_interpreter_webhook)

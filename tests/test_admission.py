@@ -189,3 +189,24 @@ def test_interpreter_webhook_admission():
         cp.store.create(mk("local:x", [InterpreterRule()], name="empty-rule"))
     with pytest.raises(AdmissionDenied):
         cp.store.create(mk("local:x", [ok_rule], timeout_s=0, name="bad-timeout"))
+
+
+def test_interpreter_webhook_empty_operations_denied():
+    from karmada_tpu.e2e import ControlPlane
+    from karmada_tpu.models.config import (
+        InterpreterRule,
+        ResourceInterpreterWebhook,
+        ResourceInterpreterWebhookSpec,
+    )
+    from karmada_tpu.models.meta import ObjectMeta
+    from karmada_tpu.webhook.admission import AdmissionDenied
+
+    import pytest
+    cp = ControlPlane()
+    with pytest.raises(AdmissionDenied):
+        cp.store.create(ResourceInterpreterWebhook(
+            metadata=ObjectMeta(name="no-ops"),
+            spec=ResourceInterpreterWebhookSpec(
+                endpoint="local:x",
+                rules=[InterpreterRule(api_versions=["apps/v1"],
+                                       kinds=["*"], operations=[])])))
