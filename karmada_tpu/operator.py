@@ -52,7 +52,7 @@ class KarmadaSpec:
 
 @dataclass
 class KarmadaStatus:
-    phase: str = ""  # Installing | Running | Failed | Deinstalling
+    phase: str = ""  # Installing | Upgrading | Running | Failed | Deinstalling
     conditions: List[Condition] = field(default_factory=list)
     api_ready: bool = False
 
@@ -96,6 +96,7 @@ class KarmadaOperator:
         self.store = mgmt_store
         self.base_dir = base_dir
         self.planes: Dict[str, object] = {}  # name -> ControlPlane
+        self.observed: Dict[str, int] = {}   # name -> reconciled generation
         self.worker = runtime.register(AsyncWorker("karmada-operator", self._reconcile))
         mgmt_store.bus.subscribe(self._on_event, kind=Karmada.KIND)
 
@@ -111,8 +112,10 @@ class KarmadaOperator:
             self._deinstall(name)
             return
         if name in self.planes:
+            if cr.metadata.generation != self.observed.get(name):
+                return self._upgrade(name)
             self._probe(name)
-            return
+            return None
 
         def set_phase(obj: Karmada) -> None:
             obj.status.phase = "Installing"
@@ -184,12 +187,38 @@ class KarmadaOperator:
                     type=COND_READY, status="True", reason="Running",
                 ))
             else:
+                obj.status.api_ready = False
                 set_condition(obj.status.conditions, Condition(
                     type=COND_READY, status="False", reason="InstallFailed",
                 ))
         self.store.mutate(Karmada.KIND, "", name, finish)
         if ok:
             self.planes[name] = plane_box["plane"]
+            self.observed[name] = cr.metadata.generation
+            return None
+        return False  # AsyncWorker requeues with its bounded retry budget
+
+    def _upgrade(self, name: str):
+        """Reconcile a SPEC CHANGE on a live plane (the reference operator's
+        upgrade/reconfigure workflow, operator/pkg/controller/karmada):
+        checkpoint + stop the old component set, then rebuild from the SAME
+        data dir under the new spec — state survives through the WAL the way
+        the reference's control planes survive through etcd.  A failed
+        rebuild returns False so the worker retries with backoff budget."""
+        def set_phase(obj: Karmada) -> None:
+            obj.status.phase = "Upgrading"
+            obj.status.api_ready = False
+            set_condition(obj.status.conditions, Condition(
+                type=COND_READY, status="False", reason="Upgrading",
+            ))
+        self.store.mutate(Karmada.KIND, "", name, set_phase)
+
+        old = self.planes.pop(name, None)
+        if old is not None:
+            old.checkpoint()
+            old.runtime.stop()
+        self.observed.pop(name, None)
+        return self._reconcile(name)  # install path against the persisted dir
 
     def _probe(self, name: str) -> None:
         plane = self.planes[name]
@@ -215,6 +244,7 @@ class KarmadaOperator:
         """tasks/deinit: stop components; the data dir is left for the
         operator's owner to reclaim (the reference keeps etcd PVs too)."""
         plane = self.planes.pop(name, None)
+        self.observed.pop(name, None)
         if plane is not None:
             plane.checkpoint()
             plane.runtime.stop()
