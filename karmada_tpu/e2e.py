@@ -83,6 +83,7 @@ class ControlPlane:
         # members; pull members get a per-member KarmadaAgent instead
         self.push_members: Dict[str, FakeMemberCluster] = {}
         self.agents: Dict[str, object] = {}
+        self.dns_detectors: Dict[str, object] = {}
         self.interpreter = ResourceInterpreter()
         self.interpreter.attach_store(self.store)
         self.recorder = EventRecorder()
@@ -293,7 +294,20 @@ class ControlPlane:
         agent = self.agents.pop(name, None)
         if agent is not None:
             agent.stop()
+        det = self.dns_detectors.pop(name, None)
+        if det is not None:
+            det.stop()
         self.members.pop(name, None)
+
+    def enable_dns_detector(self, name: str, threshold: int = 3):
+        """Attach the service-name-resolution detector sidecar to a member
+        (cmd/service-name-resolution-detector-example); unjoin stops it."""
+        from karmada_tpu.members.dns_detector import ServiceNameResolutionDetector
+
+        det = ServiceNameResolutionDetector(
+            self.store, self.member(name), self.runtime, threshold=threshold)
+        self.dns_detectors[name] = det
+        return det
 
     def proxy(self, cluster: str, subject: str = "system:admin"):
         """`karmadactl get --cluster=...`-style passthrough to one member

@@ -85,3 +85,15 @@ def test_detector_stop_detaches_from_runtime():
     before = len(det._window)
     cp.tick()  # periodics must no longer reach the detector
     assert len(det._window) == before
+
+
+def test_control_plane_wiring_and_unjoin_teardown():
+    cp = ControlPlane()
+    cp.add_member("m1")
+    det = cp.enable_dns_detector("m1", threshold=2)
+    cp.tick()
+    assert _condition(cp, "m1") is not None
+    cp.unjoin("m1")
+    before = len(det._window)
+    cp.tick()
+    assert len(det._window) == before  # stopped with the member
