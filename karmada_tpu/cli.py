@@ -138,8 +138,10 @@ def cmd_get(args) -> int:
             manifest = o.to_manifest() if hasattr(o, "to_manifest") else o.__dict__
             print(json.dumps(manifest, default=str))
         return 0
-    rows = [[o.namespace or "-", o.name, type(o).__name__] for o in objs]
-    _print_table(rows, ["NAMESPACE", "NAME", "TYPE"])
+    from karmada_tpu.printers import render, table_for
+
+    headers, rows = table_for(args.kind, objs)
+    print(render(headers, rows))
     return 0
 
 

@@ -280,3 +280,40 @@ def test_patch_metadata_labels_and_null_semantics(tmp_path, capsys):
                "-p", '{"metadata": {"name": "x"}}') == 1
     assert run(tmp_path, "patch", "Deployment", "web", "-n", "default",
                "-p", '{"kind": "Job"}') == 1
+
+
+def test_kind_aware_printers(tmp_path, capsys):
+    assert run(tmp_path, "init") == 0
+    assert run(tmp_path, "join", "m1", "--region", "us") == 0
+    assert run(tmp_path, "apply", "-f", deployment_yaml(tmp_path)) == 0
+    capsys.readouterr()
+    assert run(tmp_path, "get", "Cluster") == 0
+    out = capsys.readouterr().out
+    assert "MODE" in out and "REGION" in out and "us" in out
+    assert run(tmp_path, "get", "Deployment", "-n", "default") == 0
+    out = capsys.readouterr().out
+    assert "KIND" in out and "REPLICAS" in out
+
+
+def test_work_printer_columns(tmp_path, capsys):
+    from karmada_tpu.cli import _load_plane
+    from karmada_tpu.models.policy import (
+        PropagationPolicy, PropagationSpec, Placement, ResourceSelector)
+    from karmada_tpu.models.meta import ObjectMeta
+
+    assert run(tmp_path, "init") == 0
+    assert run(tmp_path, "join", "m1") == 0
+    assert run(tmp_path, "apply", "-f", deployment_yaml(tmp_path)) == 0
+    cp = _load_plane(str(tmp_path / "plane"))
+    cp.apply_policy(PropagationPolicy(
+        metadata=ObjectMeta(namespace="default", name="pp"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(
+                api_version="apps/v1", kind="Deployment", name="web")],
+            placement=Placement())))
+    cp.tick()
+    cp.checkpoint()
+    capsys.readouterr()
+    assert run(tmp_path, "get", "Work") == 0
+    out = capsys.readouterr().out
+    assert "MANIFESTS" in out and "APPLIED" in out
