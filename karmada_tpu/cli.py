@@ -110,9 +110,9 @@ def cmd_unjoin(args) -> int:
 
 
 def _print_table(rows, headers) -> None:
-    widths = [max(len(str(r[i])) for r in rows + [headers]) for i in range(len(headers))]
-    for r in [headers] + rows:
-        print("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+    from karmada_tpu.printers import render
+
+    print(render(headers, rows))
 
 
 def cmd_get(args) -> int:

@@ -37,6 +37,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+import numpy as _onp
 
 jax.config.update("jax_enable_x64", True)
 
@@ -604,10 +605,8 @@ def _cluster_args(batch):
     # only cache FROZEN arrays (encode_batch(cache=...) sets writeable=False):
     # a mutable array could be modified in place between solves and the
     # identity check would then serve a stale device copy
-    import numpy as _np
-
     if all(
-        not (isinstance(a, _np.ndarray) and a.flags.writeable) for a in np_args
+        not (isinstance(a, _onp.ndarray) and a.flags.writeable) for a in np_args
     ):
         _DEVICE_SLOT[0] = (np_args, dev)
     return dev

@@ -45,7 +45,10 @@ from karmada_tpu.models.work import (
     TargetCluster,
 )
 from karmada_tpu.ops import serial
-from karmada_tpu.ops.webster import tiebreak_descending_by_uid
+from karmada_tpu.ops.webster import (
+    fnv32a_batch_odd,
+    tiebreak_descending_by_uid,
+)
 from karmada_tpu.utils.quantity import RESOURCE_CPU, RESOURCE_PODS
 
 MAX_INT32 = (1 << 31) - 1
@@ -244,30 +247,6 @@ def _route_for(
 # spec-free probe for the placement-only route: _route_for reads only
 # spec.components (empty here), so one call per distinct placement suffices
 _ROUTE_PROBE_SPEC = ResourceBindingSpec()
-
-
-def fnv32a_batch_odd(uids: List[str]) -> np.ndarray:
-    """Vectorized tiebreak_descending_by_uid over a batch: bool[n] of
-    fnv32a(uid) & 1, with empty uids False (webster.py:52-57 semantics).
-    One numpy pass per character column instead of a Python loop per byte."""
-    n = len(uids)
-    bs = [u.encode("utf-8") for u in uids]
-    lens = np.fromiter((len(x) for x in bs), np.int64, n)
-    L = int(lens.max()) if n else 0
-    if L == 0:
-        return np.zeros(n, bool)
-    flat = np.frombuffer(b"".join(bs), np.uint8)
-    starts = np.zeros(n + 1, np.int64)
-    np.cumsum(lens, out=starts[1:])
-    h = np.full(n, 0x811C9DC5, np.uint64)
-    idx0 = starts[:-1]
-    for j in range(L):
-        valid = lens > j
-        c = np.zeros(n, np.uint64)
-        c[valid] = flat[idx0[valid] + j]
-        hv = (h ^ c) * np.uint64(0x01000193) & np.uint64(0xFFFFFFFF)
-        h = np.where(valid, hv, h)
-    return ((h & np.uint64(1)).astype(bool)) & (lens > 0)
 
 
 @dataclass

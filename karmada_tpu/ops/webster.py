@@ -27,6 +27,8 @@ import heapq
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+import numpy as np
+
 # Quantization of the Webster priority votes/(2*seats+1): both the serial
 # heap below and the TPU kernel (ops/solver.webster_divide) compare
 # (votes << PRIORITY_QBITS) // (2*seats + 1) as integers.  28 bits keeps
@@ -136,3 +138,27 @@ def dispense_by_weight(
         num_replicas, weights, init, tiebreak_descending_by_uid(uid)
     )
     return {p.name: p.seats for p in parties}
+
+
+def fnv32a_batch_odd(uids):
+    """Vectorized tiebreak_descending_by_uid over a batch: bool[n] of
+    fnv32a(uid) & 1, with empty uids False (webster.py:52-57 semantics).
+    One numpy pass per character column instead of a Python loop per byte."""
+    n = len(uids)
+    bs = [u.encode("utf-8") for u in uids]
+    lens = np.fromiter((len(x) for x in bs), np.int64, n)
+    L = int(lens.max()) if n else 0
+    if L == 0:
+        return np.zeros(n, bool)
+    flat = np.frombuffer(b"".join(bs), np.uint8)
+    starts = np.zeros(n + 1, np.int64)
+    np.cumsum(lens, out=starts[1:])
+    h = np.full(n, 0x811C9DC5, np.uint64)
+    idx0 = starts[:-1]
+    for j in range(L):
+        valid = lens > j
+        c = np.zeros(n, np.uint64)
+        c[valid] = flat[idx0[valid] + j]
+        hv = (h ^ c) * np.uint64(0x01000193) & np.uint64(0xFFFFFFFF)
+        h = np.where(valid, hv, h)
+    return ((h & np.uint64(1)).astype(bool)) & (lens > 0)
