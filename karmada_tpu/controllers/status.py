@@ -244,6 +244,7 @@ class ClusterStatusController:
         runtime.register_periodic(self.collect_all)
 
     def collect_all(self) -> None:
+        from karmada_tpu.controllers.lease import renew_cluster_lease
         from karmada_tpu.utils import events as ev
 
         for name, member in self.members.items():
@@ -279,6 +280,9 @@ class ClusterStatusController:
                         )
 
             stored = self.store.mutate(Cluster.KIND, "", name, update)
+            # heartbeat lease: proves THIS collector is alive, independent
+            # of the member's own health (cluster_status_controller.go:399)
+            renew_cluster_lease(self.store, name)
             self._export_gauges(stored)
             ready = member.healthy
             if self._last_ready.get(name) != ready:

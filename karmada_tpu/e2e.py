@@ -106,6 +106,13 @@ class ControlPlane:
         self.cluster_status = ClusterStatusController(
             self.store, self.runtime, self.push_members, recorder=self.recorder
         )
+        # lease staleness monitor: a dead collector/agent degrades its
+        # cluster to Ready=Unknown (controllers/lease.py)
+        from karmada_tpu.controllers.lease import ClusterLeaseMonitor
+
+        self.lease_monitor = ClusterLeaseMonitor(
+            self.store, self.runtime, recorder=self.recorder
+        )
         self.cluster_taints = ClusterTaintController(self.store, self.runtime)
         # taint-driven evictions pace through the rate-limited queue
         # (cluster/eviction_worker.go); lifecycle handles join/unjoin
@@ -285,6 +292,12 @@ class ControlPlane:
 
         try:
             self.store.delete(Cluster.KIND, "", name)
+        except NotFoundError:
+            pass
+        from karmada_tpu.controllers.lease import LEASE_NAMESPACE, Lease
+
+        try:
+            self.store.delete(Lease.KIND, LEASE_NAMESPACE, name)
         except NotFoundError:
             pass
         self.descheduler_estimator.deregister(name)

@@ -1,0 +1,128 @@
+"""Cluster lease heartbeats: collector liveness vs member health.
+
+Reference: cluster_status_controller.go:399 (initLeaseController, leases in
+the karmada-cluster namespace) + the control plane's monitor grace period.
+A dead COLLECTOR (not a dead member) must degrade its cluster to
+Ready=Unknown, which the condition-driven taint path then acts on.
+"""
+
+from __future__ import annotations
+
+from karmada_tpu.controllers.lease import (
+    LEASE_NAMESPACE,
+    ClusterLeaseMonitor,
+    Lease,
+    renew_cluster_lease,
+)
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.cluster import COND_CLUSTER_READY, Cluster
+from karmada_tpu.models.meta import get_condition
+from karmada_tpu.store.store import ObjectStore
+from karmada_tpu.store.worker import Runtime
+
+
+def test_collector_renews_lease_each_cycle():
+    import time as _time
+
+    cp = ControlPlane()
+    cp.add_member("m1")
+    cp.tick()
+    lease = cp.store.get(Lease.KIND, LEASE_NAMESPACE, "m1")
+    first = lease.renew_time
+    _time.sleep(0.02)
+    cp.tick()
+    lease = cp.store.get(Lease.KIND, LEASE_NAMESPACE, "m1")
+    assert lease.renew_time > first  # strictly newer: renewal really ran
+    # healthy member + fresh lease: Ready stays True
+    cond = get_condition(
+        cp.store.get(Cluster.KIND, "", "m1").status.conditions,
+        COND_CLUSTER_READY)
+    assert cond.status == "True"
+
+
+def test_stale_lease_degrades_to_unknown_and_taints():
+    store = ObjectStore()
+    runtime = Runtime()
+    clock = {"now": 1000.0}
+    from karmada_tpu.models.meta import ObjectMeta
+    from karmada_tpu.models.cluster import ClusterSpec
+
+    store.create(Cluster(metadata=ObjectMeta(name="m1"),
+                         spec=ClusterSpec()))
+    renew_cluster_lease(store, "m1", clock=lambda: clock["now"])
+    monitor = ClusterLeaseMonitor(store, runtime, grace_multiplier=4.0,
+                                  clock=lambda: clock["now"])
+
+    monitor.check_all()  # fresh: no degradation
+    cond = get_condition(store.get(Cluster.KIND, "", "m1").status.conditions,
+                         COND_CLUSTER_READY)
+    assert cond is None
+
+    clock["now"] += 1000.0  # far past 4 x 10s grace
+    monitor.check_all()
+    cond = get_condition(store.get(Cluster.KIND, "", "m1").status.conditions,
+                         COND_CLUSTER_READY)
+    assert cond is not None and cond.status == "Unknown"
+
+    # recovery is owned by the collector: a renewed lease alone does not
+    # flip Ready back (the next successful collect cycle does)
+    renew_cluster_lease(store, "m1", clock=lambda: clock["now"])
+    monitor.check_all()
+    cond = get_condition(store.get(Cluster.KIND, "", "m1").status.conditions,
+                         COND_CLUSTER_READY)
+    assert cond.status == "Unknown"
+
+
+def test_dead_collector_in_control_plane_taints_cluster():
+    cp = ControlPlane()
+    cp.add_member("m1")
+    cp.tick()
+    # simulate collector death: stop heartbeating for m1 but keep the
+    # Cluster object (the member did not unjoin — its agent just died)
+    del cp.cluster_status.members["m1"]
+    # age the lease far past grace
+
+    def age(lease: Lease) -> None:
+        lease.renew_time -= 10_000.0
+    cp.store.mutate(Lease.KIND, LEASE_NAMESPACE, "m1", age)
+    cp.tick()
+    cluster = cp.store.get(Cluster.KIND, "", "m1")
+    cond = get_condition(cluster.status.conditions, COND_CLUSTER_READY)
+    assert cond.status == "Unknown"
+    from karmada_tpu.controllers.failover import TAINT_NOT_READY
+
+    assert any(t.key == TAINT_NOT_READY for t in cluster.spec.taints)
+
+
+def test_unjoin_deletes_lease():
+    cp = ControlPlane()
+    cp.add_member("m1")
+    cp.tick()
+    assert cp.store.try_get(Lease.KIND, LEASE_NAMESPACE, "m1") is not None
+    cp.unjoin("m1")
+    assert cp.store.try_get(Lease.KIND, LEASE_NAMESPACE, "m1") is None
+
+
+def test_slow_sync_period_widens_grace():
+    """A sync period longer than the lease duration must not flap healthy
+    clusters to Unknown (review finding: grace follows the real cadence)."""
+    store = ObjectStore()
+    runtime = Runtime(periodic_interval_s=60.0)
+    clock = {"now": 1000.0}
+    from karmada_tpu.models.cluster import ClusterSpec
+    from karmada_tpu.models.meta import ObjectMeta
+
+    store.create(Cluster(metadata=ObjectMeta(name="m1"), spec=ClusterSpec()))
+    renew_cluster_lease(store, "m1", clock=lambda: clock["now"])
+    monitor = ClusterLeaseMonitor(store, runtime, grace_multiplier=4.0,
+                                  clock=lambda: clock["now"])
+    clock["now"] += 120.0  # stale by the 10s-lease yardstick, fresh for 60s sync
+    monitor.check_all()
+    cond = get_condition(store.get(Cluster.KIND, "", "m1").status.conditions,
+                         COND_CLUSTER_READY)
+    assert cond is None  # within 4 x 60s: no degradation
+    clock["now"] += 200.0  # now beyond 4 x 60s
+    monitor.check_all()
+    cond = get_condition(store.get(Cluster.KIND, "", "m1").status.conditions,
+                         COND_CLUSTER_READY)
+    assert cond is not None and cond.status == "Unknown"
