@@ -628,6 +628,16 @@ def cmd_serve(args) -> int:
     if args.feature_gates:
         cp.gates.set_from_string(args.feature_gates)
     cp.runtime._periodic_interval_s = args.sync_period  # noqa: SLF001
+    # bind the observability endpoint BEFORE starting controller threads:
+    # a port clash must fail fast, not skip the shutdown/checkpoint path
+    obs = None
+    if args.metrics_port >= 0:
+        from karmada_tpu.utils.httpserve import ObservabilityServer
+
+        obs = ObservabilityServer(store=cp.store)
+        url = obs.start(port=args.metrics_port)
+        print(f"observability endpoint at {url} "
+              "(/metrics /healthz /readyz /debug/state)")
     cp.runtime.serve()
     print(f"serving control plane from {args.dir} "
           f"(backend={args.backend}, {len(cp.members)} members); ctrl-c to stop")
@@ -641,6 +651,8 @@ def cmd_serve(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if obs is not None:
+            obs.stop()
         cp.runtime.stop()
         cp.checkpoint()
     return 0
@@ -767,6 +779,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="periodic resync interval seconds")
     sv.add_argument("--checkpoint-period", type=float, default=30.0,
                     help="WAL compaction interval seconds")
+    sv.add_argument("--metrics-port", type=int, default=-1,
+                    help="serve /metrics,/healthz,/readyz,/debug/state on "
+                         "127.0.0.1:PORT (0 = ephemeral, -1 = disabled)")
     return p
 
 

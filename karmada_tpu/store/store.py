@@ -286,6 +286,16 @@ class ObjectStore:
             snapshot = [copy.deepcopy(o) for o in self._objects.values()]
         return iter(snapshot)
 
+    def counts_by_kind(self) -> Dict[str, int]:
+        """Object tally per kind without copying any values (observability
+        endpoints poll this; a deepcopy snapshot would hold the store lock
+        proportional to total payload)."""
+        with self._lock:
+            counts: Dict[str, int] = {}
+            for kind, _, _ in self._objects:
+                counts[kind] = counts.get(kind, 0) + 1
+            return counts
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._objects)

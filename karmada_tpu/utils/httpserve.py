@@ -1,0 +1,83 @@
+"""Observability HTTP endpoint for long-running processes.
+
+Reference: every binary exposes Prometheus metrics plus an opt-in pprof
+server (pkg/sharedcli/profileflag/profileflag.go:58-70). Here one small
+ThreadingHTTPServer serves:
+
+    /metrics   Prometheus text exposition (utils/metrics.REGISTRY)
+    /healthz   liveness ("ok")
+    /readyz    readiness: the supplied probe callback (e.g. store reachable)
+    /debug/state   JSON object-count snapshot per kind (the pprof analog:
+                   what is this plane holding right now)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Optional
+
+
+class ObservabilityServer:
+    def __init__(
+        self,
+        store=None,
+        registry=None,
+        ready_probe: Optional[Callable[[], bool]] = None,
+    ) -> None:
+        from karmada_tpu.utils.metrics import REGISTRY
+
+        self.store = store
+        self.registry = registry if registry is not None else REGISTRY
+        self.ready_probe = ready_probe
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _state(self) -> dict:
+        counts = self.store.counts_by_kind() if self.store is not None else {}
+        return {"objects_by_kind": counts,
+                "total": sum(counts.values())}
+
+    def start(self, port: int = 0, host: str = "127.0.0.1") -> str:
+        import http.server
+
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server convention
+                if self.path == "/metrics":
+                    body = outer.registry.dump().encode()
+                    ctype = "text/plain; version=0.0.4"
+                    code = 200
+                elif self.path == "/healthz":
+                    body, ctype, code = b"ok", "text/plain", 200
+                elif self.path == "/readyz":
+                    ok = outer.ready_probe() if outer.ready_probe else True
+                    body = b"ok" if ok else b"not ready"
+                    ctype, code = "text/plain", (200 if ok else 503)
+                elif self.path == "/debug/state":
+                    body = json.dumps(outer._state()).encode()
+                    ctype, code = "application/json", 200
+                else:
+                    body, ctype, code = b"not found", "text/plain", 404
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet per-request stderr
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        h, p = self._httpd.server_address
+        return f"http://{h}:{p}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,42 @@
+"""Observability HTTP endpoint (profileflag/metrics-server analog)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.utils.httpserve import ObservabilityServer
+
+
+def fetch(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def test_endpoints_serve_metrics_health_and_state():
+    cp = ControlPlane()
+    cp.add_member("m1")
+    cp.tick()
+    ready = {"ok": True}
+    srv = ObservabilityServer(store=cp.store,
+                              ready_probe=lambda: ready["ok"])
+    base = srv.start()
+    try:
+        status, body = fetch(base + "/metrics")
+        assert status == 200 and "karmada_" in body
+        status, body = fetch(base + "/healthz")
+        assert status == 200 and body == "ok"
+        status, body = fetch(base + "/readyz")
+        assert status == 200
+        ready["ok"] = False
+        try:
+            status, _ = fetch(base + "/readyz")
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 503
+        status, body = fetch(base + "/debug/state")
+        state = json.loads(body)
+        assert status == 200 and state["objects_by_kind"].get("Cluster") == 1
+    finally:
+        srv.stop()
