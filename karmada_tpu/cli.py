@@ -772,7 +772,8 @@ def build_parser() -> argparse.ArgumentParser:
     tk.add_argument("--backend", default="serial")
 
     sv = sub.add_parser("serve")
-    sv.add_argument("--backend", choices=["serial", "device"], default="device")
+    sv.add_argument("--backend", choices=["serial", "native", "device"],
+                    default="device")
     sv.add_argument("--feature-gates", default="",
                     help="A=true,B=false (pkg/features registry names)")
     sv.add_argument("--sync-period", type=float, default=0.5,

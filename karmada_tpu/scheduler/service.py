@@ -60,7 +60,7 @@ class Scheduler:
         store: ObjectStore,
         runtime: Runtime,
         estimators: Optional[Sequence] = None,
-        backend: str = "device",  # device | serial
+        backend: str = "device",  # device | native | serial
         enable_empty_workload_propagation: bool = False,
         batch_window: int = 4096,
         queue: Optional[SchedulingQueue] = None,
@@ -109,6 +109,13 @@ class Scheduler:
         # worker (_cycle); one lock guards every queue operation
         self._queue_lock = threading.Lock()
         self.queue = queue if queue is not None else SchedulingQueue()
+        self._native_snap = None  # (clusters list, NativeSnapshot)
+        if backend == "native":
+            # warm the g++ build at startup so the first scheduling cycle
+            # never blocks on a synchronous compile
+            from karmada_tpu import native as native_mod
+
+            native_mod.load()
         self.worker = runtime.register(AsyncWorker("scheduler", self._cycle))
         runtime.register_periodic(self._periodic_flush)
         store.bus.subscribe(self._on_event)
@@ -323,6 +330,67 @@ class Scheduler:
         return cache
 
     # -- backend dispatch ---------------------------------------------------
+    def _solve_native(
+        self,
+        items: List[Tuple[ResourceBindingSpec, ResourceBindingStatus]],
+        clusters: List[Cluster],
+        out: List[object],
+    ) -> List[int]:
+        """backend="native": the compiled C++ pipeline (karmada_tpu/native)
+        schedules the whole batch on host; bindings in its documented
+        unsupported classes (multi-component, vanished prev clusters,
+        resource modelings) fall through to the Python serial path, as does
+        everything when the toolchain is absent or empty-workload
+        propagation is on (a native no-op for that flag would silently drop
+        zero-replica propagation).  Returns the handled indices."""
+        from karmada_tpu import native as native_mod
+
+        if self.enable_empty_workload_propagation or not native_mod.available():
+            return []
+        # the native pipeline hardcodes GeneralEstimator capacity math; a
+        # custom estimator tier (accurate gRPC clients etc.) must win, so
+        # anything beyond the plain GeneralEstimator routes to serial
+        if not all(type(e) is GeneralEstimator for e in self.estimators):
+            return []
+        t0 = time.perf_counter()
+        # one snapshot per cluster list: the affinity-failover loop re-solves
+        # against the same snapshot object each round (EncoderCache analog)
+        cached = self._native_snap
+        if cached is not None and cached[0] is clusters:
+            snap = cached[1]
+        else:
+            snap = native_mod.NativeSnapshot(
+                clusters, native_mod.collect_res_names(items))
+            self._native_snap = (clusters, snap)
+        nb = native_mod.marshal_batch(items, snap)
+        t1 = time.perf_counter()
+        sched_metrics.STEP_LATENCY.observe(
+            t1 - t0, schedule_step=sched_metrics.STEP_ENCODE
+        )
+        results = native_mod.run_marshaled(nb, snap)
+        sched_metrics.STEP_LATENCY.observe(
+            time.perf_counter() - t1, schedule_step=sched_metrics.STEP_SOLVE
+        )
+        handled: List[int] = []
+        for i, (st, targets) in enumerate(results):
+            if st == native_mod.STATUS_OK:
+                out[i] = targets
+            elif st == native_mod.STATUS_FIT_ERROR:
+                spec_i, status_i = items[i]
+                _, diagnosis = serial.find_clusters_that_fit(
+                    spec_i, status_i, clusters)
+                out[i] = serial.FitError(diagnosis)
+            elif st == native_mod.STATUS_UNSCHEDULABLE:
+                out[i] = serial.UnschedulableError(
+                    "insufficient capacity (native)")
+            elif st == native_mod.STATUS_NO_CLUSTER:
+                out[i] = serial.NoClusterAvailableError(
+                    "no clusters available to schedule")
+            else:  # STATUS_UNSUPPORTED: serial fallback owns it
+                continue
+            handled.append(i)
+        return handled
+
     def _solve(
         self,
         items: List[Tuple[ResourceBindingSpec, ResourceBindingStatus]],
@@ -332,7 +400,9 @@ class Scheduler:
         cal = serial.make_cal_available(self.estimators)
         out: List[object] = [None] * len(items)
         device_idx: List[int] = []
-        if self.backend == "device" and items:
+        if self.backend == "native" and items:
+            device_idx = self._solve_native(items, clusters, out)
+        elif self.backend == "device" and items:
             t0 = time.perf_counter()
             cindex = tensors.ClusterIndex.build(clusters)
             batch = tensors.encode_batch(

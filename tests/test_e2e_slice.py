@@ -211,3 +211,25 @@ def test_device_backend_end_to_end():
     for tc in rb.spec.clusters:
         applied = plane.member(tc.name).get("Deployment", "default", "nginx")
         assert applied.manifest["spec"]["replicas"] == tc.replicas
+
+
+def test_native_backend_schedules_like_serial():
+    """backend="native": the C++ pipeline drives real scheduling decisions
+    with serial fallback for its unsupported classes."""
+    from karmada_tpu import native as native_mod
+
+    if not native_mod.available():
+        pytest.skip(f"native unavailable: {native_mod.build_error()}")
+
+    results = {}
+    for backend in ("serial", "native"):
+        cp = ControlPlane(backend=backend)
+        cp.add_member("m1", cpu_milli=64_000)
+        cp.add_member("m2", cpu_milli=32_000)
+        cp.apply(nginx(replicas=6))
+        cp.apply_policy(policy())
+        cp.tick()
+        rb = cp.store.get("ResourceBinding", "default", "nginx-deployment")
+        results[backend] = {tc.name: tc.replicas for tc in rb.spec.clusters}
+        assert sum(results[backend].values()) == 6, backend
+    assert results["native"] == results["serial"]
