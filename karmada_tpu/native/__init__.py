@@ -69,8 +69,11 @@ _FIELD_CODE = {
 
 _W_CAP = (1 << 31) - 1  # int32-class weights only (matches reference MaxInt32)
 
-_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "serial_solver.cc")
-_SO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_serial_solver.so")
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "serial_solver.cc")
+_SO = os.path.join(_DIR, "_serial_solver.so")
+_ENC_SRC = os.path.join(_DIR, "encode_fast.c")
+_ENC_SO = os.path.join(_DIR, "_encode_fast.so")
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -117,6 +120,54 @@ def build_error() -> Optional[str]:
 
 def available() -> bool:
     return load() is not None
+
+
+# -- encode fast path (CPython extension) ------------------------------------
+
+_enc_mod = None
+_enc_error: Optional[str] = None
+
+
+def load_encode_fast():
+    """The _encode_fast extension module, building it on demand; None when
+    the toolchain or headers are unavailable (callers fall back to the
+    Python loop)."""
+    global _enc_mod, _enc_error
+    with _lib_lock:
+        if _enc_mod is not None:
+            return _enc_mod
+        if _enc_error is not None:
+            return None
+        try:
+            import sysconfig
+
+            if (not os.path.exists(_ENC_SO)
+                    or os.path.getmtime(_ENC_SO) < os.path.getmtime(_ENC_SRC)):
+                inc = sysconfig.get_path("include")
+                r = subprocess.run(
+                    ["gcc", "-O2", "-shared", "-fPIC", f"-I{inc}",
+                     "-o", _ENC_SO + ".tmp", _ENC_SRC],
+                    capture_output=True, text=True, timeout=180,
+                )
+                if r.returncode != 0:
+                    _enc_error = f"gcc failed: {r.stderr[-800:]}"
+                    return None
+                os.replace(_ENC_SO + ".tmp", _ENC_SO)
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "karmada_tpu.native._encode_fast", _ENC_SO)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _enc_mod = mod
+            return _enc_mod
+        except Exception as e:  # noqa: BLE001 — optional acceleration only
+            _enc_error = f"encode_fast unavailable: {e!r}"
+            return None
+
+
+def encode_fast_error() -> Optional[str]:
+    return _enc_error
 
 
 # ---------------------------------------------------------------------------

@@ -377,17 +377,24 @@ def encode_batch(
     prev_entries: List[List[Tuple[int, int]]] = [[] for _ in range(B)]
     evict_entries: List[List[int]] = [[] for _ in range(B)]
 
-    eff_placements: List[Placement] = []
     n_regions = len(region_names)
     # per-call pid -> placement-only route (spec-free: _route_for reads only
     # spec.components, empty on the common path)
     route_by_pid: Dict[int, int] = {}
+    # id(placement) -> (placement, pid, route): the C fast path's identity
+    # registry (entries pinned by holding the placement in the tuple);
+    # populated only when the extension is driving (use_fast flag)
+    pid_route_by_id: Dict[int, tuple] = {}
+    use_fast = [False]
     uids: List[str] = []
     on_device = (ROUTE_DEVICE, ROUTE_DEVICE_SPREAD)
     cindex_get = cindex.index.get
-    for b, (spec, status) in enumerate(items):
+
+    def encode_one(b: int, set_uid: bool = True) -> None:
+        """The full (slow) per-binding encoding — also the C fast path's
+        miss callback, registering vocabulary so later bindings hit."""
+        spec, status = items[b]
         placement = _effective_placement(spec, status)
-        eff_placements.append(placement)
         # only SHARED placement objects (placement is spec.placement) are
         # worth memoizing — _effective_placement builds fresh objects for
         # the affinity-resolution path, which would never hit and would pin
@@ -407,6 +414,8 @@ def encode_batch(
             placements.append(placement)
             route_by_pid[pid] = _route_for(_ROUTE_PROBE_SPEC, placement,
                                            n_regions)
+        if use_fast[0] and placement is spec.placement:
+            pid_route_by_id[id(placement)] = (placement, pid, route_by_pid[pid])
         placement_id[b] = pid
         r = (route_by_pid[pid] if not spec.components
              else _route_for(spec, placement, n_regions))
@@ -451,7 +460,10 @@ def encode_batch(
 
         nrep = spec.replicas
         replicas[b] = nrep
-        uids.append(spec.resource.uid)
+        if set_uid:
+            uid_desc[b] = tiebreak_descending_by_uid(spec.resource.uid)
+        else:
+            uids.append(spec.resource.uid)
         fresh[b] = serial.reschedule_required(spec, status)
         is_workload = (nrep > 0 or rr is not None) and len(spec.components) <= 1
         non_workload[b] = not is_workload
@@ -485,8 +497,28 @@ def encode_batch(
                 if ci is not None:
                     evict_entries[b].append(ci)
         route[b] = r
+
+    fast = None
     if nB:
-        uid_desc[:nB] = fnv32a_batch_odd(uids)
+        from karmada_tpu import native as _native
+
+        fast = _native.load_encode_fast()
+    if fast is not None:
+        # the C loop fills arrays for common-shape bindings and calls
+        # encode_one inline on misses (which registers vocabulary, so one
+        # miss per distinct placement/class/GVK, not per binding)
+        use_fast[0] = True
+        items_list = items if isinstance(items, list) else list(items)
+        fast.encode_fast(
+            items_list, pid_route_by_id, gvks, classes,
+            placement_id, gvk_id, class_id, replicas, uid_desc, fresh,
+            non_workload, nw_shortcut, route, KERNEL_REPLICA_CAP, encode_one,
+        )
+    else:
+        for b in range(nB):
+            encode_one(b, set_uid=False)
+        if nB:
+            uid_desc[:nB] = fnv32a_batch_odd(uids)
 
     # rows the host path owns must not schedule NOR consume wave capacity on
     # device (their device results are discarded; charging them would price

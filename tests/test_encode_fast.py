@@ -1,0 +1,85 @@
+"""The C encode fast path must be tensor-identical to the Python loop.
+
+karmada_tpu/native/encode_fast.c handles common-shape bindings and calls
+the Python slow path (encode_one) on vocabulary misses and odd shapes;
+behavior is DEFINED by the Python loop, so every SolverBatch field must
+match bit-for-bit with the extension disabled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+import numpy as np
+import numpy.testing as npt
+import pytest
+
+import bench
+from karmada_tpu import native
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.models.work import GracefulEvictionTask, TargetCluster
+from karmada_tpu.ops import tensors
+
+FIELDS = [
+    "placement_id", "gvk_id", "class_id", "replicas", "uid_desc",
+    "fresh", "non_workload", "nw_shortcut", "route", "b_valid",
+    "prev_idx", "prev_val", "evict_idx", "pl_mask", "pl_strategy",
+    "pl_static_w", "avail_milli", "req_milli", "req_pods", "api_ok",
+]
+
+pytestmark = pytest.mark.skipif(
+    native.load_encode_fast() is None,
+    reason=f"encode_fast unavailable: {native.encode_fast_error()}",
+)
+
+
+@pytest.fixture
+def no_fast(monkeypatch):
+    """Force the Python fallback for the control encoding."""
+    monkeypatch.setattr(native, "_enc_mod", None)
+    monkeypatch.setattr(native, "_enc_error", "disabled for parity test")
+
+
+@pytest.mark.parametrize("seed", [3, 29])
+def test_fast_path_tensor_parity(seed, no_fast, monkeypatch):
+    rng = random.Random(seed)
+    clusters = bench.build_fleet(rng, 200)
+    placements = bench.build_placements(rng, [c.name for c in clusters])
+    items = bench.build_bindings(rng, 1024, placements)
+    # corner shapes the fast path must hand back to Python: previous
+    # assignments, eviction tasks, reschedule triggers, zero replicas
+    extra = []
+    for k in range(48):
+        spec, st = items[k]
+        extra.append((dataclasses.replace(
+            spec,
+            clusters=[TargetCluster(name=clusters[k % 200].name, replicas=2)],
+            graceful_eviction_tasks=(
+                [GracefulEvictionTask(from_cluster=clusters[0].name)]
+                if k % 3 == 0 else []),
+            reschedule_triggered_at=(50.0 if k % 2 else None),
+            replicas=(0 if k % 5 == 0 else spec.replicas),
+        ), st))
+    # huge replica counts must take the host route from BOTH paths
+    spec0, st0 = items[0]
+    extra.append((dataclasses.replace(
+        spec0, replicas=tensors.KERNEL_REPLICA_CAP + 1), st0))
+    # list pairs (not tuples) must not crash the extension
+    extra.append(list(items[1]))
+    items = items + extra
+
+    est = GeneralEstimator()
+    cindex = tensors.ClusterIndex.build(clusters)
+
+    slow = tensors.encode_batch(items, cindex, est, cache=tensors.EncoderCache())
+    # re-enable the real extension for the fast encoding
+    monkeypatch.setattr(native, "_enc_mod", None)
+    monkeypatch.setattr(native, "_enc_error", None)
+    assert native.load_encode_fast() is not None
+    fast = tensors.encode_batch(items, cindex, est, cache=tensors.EncoderCache())
+
+    for f in FIELDS:
+        npt.assert_array_equal(
+            np.asarray(getattr(fast, f)), np.asarray(getattr(slow, f)),
+            err_msg=f)
