@@ -73,7 +73,10 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "serial_solver.cc")
 _SO = os.path.join(_DIR, "_serial_solver.so")
 _ENC_SRC = os.path.join(_DIR, "encode_fast.c")
-_ENC_SO = os.path.join(_DIR, "_encode_fast.so")
+# ABI-tagged filename: a CPython-API extension must never be loaded into a
+# different interpreter version than the one that built it
+_ENC_SO = os.path.join(
+    _DIR, f"_encode_fast.{__import__('sys').implementation.cache_tag}.so")
 
 _lib = None
 _lib_lock = threading.Lock()

@@ -345,9 +345,140 @@ fail1:
   return NULL;
 }
 
+/* decode_fast(bounds, c_arr, vv, name_rank, names, non_workload, status,
+ *             tc_type, empty_prop, out)
+ *
+ * Builds the per-binding TargetCluster lists for every binding whose
+ * status is 0 and whose out[] slot is still None (errors are Python's).
+ * bounds: int64[nb+1] row boundaries into c_arr/vv (row-major COO);
+ * name_rank orders construction so each list is name-sorted without a
+ * Python sort. Returns None.
+ */
+static PyObject *decode_fast(PyObject *self, PyObject *args) {
+  PyObject *a_bounds, *a_c, *a_v, *a_rank, *names, *a_nw, *a_status;
+  PyObject *tc_type, *out;
+  int empty_prop = 0;
+  if (!PyArg_ParseTuple(args, "OOOOOOOOpO", &a_bounds, &a_c, &a_v, &a_rank,
+                        &names, &a_nw, &a_status, &tc_type, &empty_prop,
+                        &out))
+    return NULL;
+
+  Py_buffer b_bounds, b_c, b_v, b_rank, b_nw, b_status;
+  if (PyObject_GetBuffer(a_bounds, &b_bounds, PyBUF_SIMPLE) < 0) return NULL;
+  if (PyObject_GetBuffer(a_c, &b_c, PyBUF_SIMPLE) < 0) goto dfail1;
+  if (PyObject_GetBuffer(a_v, &b_v, PyBUF_SIMPLE) < 0) goto dfail2;
+  if (PyObject_GetBuffer(a_rank, &b_rank, PyBUF_SIMPLE) < 0) goto dfail3;
+  if (PyObject_GetBuffer(a_nw, &b_nw, PyBUF_SIMPLE) < 0) goto dfail4;
+  if (PyObject_GetBuffer(a_status, &b_status, PyBUF_SIMPLE) < 0) goto dfail5;
+
+  const int64_t *bounds = (const int64_t *)b_bounds.buf;
+  const int64_t *c_arr = (const int64_t *)b_c.buf;
+  const int64_t *v_arr = (const int64_t *)b_v.buf;
+  const int64_t *rank = (const int64_t *)b_rank.buf;
+  const uint8_t *nw = (const uint8_t *)b_nw.buf;
+  const int32_t *status = (const int32_t *)b_status.buf;
+  Py_ssize_t nb = PyList_GET_SIZE(out);
+
+  for (Py_ssize_t b = 0; b < nb; b++) {
+    if (status[b] != 0) continue;               /* error: Python's slot */
+    if (PyList_GET_ITEM(out, b) != Py_None) continue;
+    int64_t lo = bounds[b], hi = bounds[b + 1];
+    int64_t m = hi - lo;
+    /* wide rows (fleet-wide Duplicated / non-workload selections) would
+     * make the insertion sort quadratic — Python's timsort owns them */
+    if (m > 256) continue;
+    PyObject *targets = PyList_New(0);
+    if (targets == NULL) goto dloop_error;
+
+    /* insertion-sort the row by name rank (rows are tiny) */
+    int64_t order[64];
+    int use_stack = (m <= 64);
+    int64_t *ord = order;
+    if (!use_stack) {
+      ord = (int64_t *)PyMem_Malloc(sizeof(int64_t) * (size_t)m);
+      if (ord == NULL) {
+        Py_DECREF(targets);
+        goto dloop_error;
+      }
+    }
+    for (int64_t j = 0; j < m; j++) ord[j] = lo + j;
+    for (int64_t j = 1; j < m; j++) {
+      int64_t key = ord[j];
+      int64_t kr = rank[c_arr[key]];
+      int64_t i = j - 1;
+      while (i >= 0 && rank[c_arr[ord[i]]] > kr) {
+        ord[i + 1] = ord[i];
+        i--;
+      }
+      ord[i + 1] = key;
+    }
+
+    int is_nw = nw[b];
+    int ok = 1;
+    for (int64_t j = 0; j < m && ok; j++) {
+      int64_t e = ord[j];
+      int64_t v = v_arr[e];
+      long out_rep;
+      if (is_nw) {
+        out_rep = 0;
+      } else if (v > 0) {
+        out_rep = (long)v;
+      } else if (empty_prop) {
+        out_rep = 0;
+      } else {
+        continue;
+      }
+      PyObject *name = PyList_GET_ITEM(names, c_arr[e]); /* borrowed */
+      PyObject *rep = PyLong_FromLong(out_rep);
+      if (rep == NULL) {
+        ok = 0;
+        break;
+      }
+      PyObject *tc = PyObject_CallFunctionObjArgs(tc_type, name, rep, NULL);
+      Py_DECREF(rep);
+      if (tc == NULL || PyList_Append(targets, tc) < 0) {
+        Py_XDECREF(tc);
+        ok = 0;
+        break;
+      }
+      Py_DECREF(tc);
+    }
+    if (!use_stack) PyMem_Free(ord);
+    if (!ok) {
+      Py_DECREF(targets);
+      goto dloop_error;
+    }
+    if (PyList_SetItem(out, b, targets) < 0) goto dloop_error; /* steals */
+  }
+
+  PyBuffer_Release(&b_status);
+  PyBuffer_Release(&b_nw);
+  PyBuffer_Release(&b_rank);
+  PyBuffer_Release(&b_v);
+  PyBuffer_Release(&b_c);
+  PyBuffer_Release(&b_bounds);
+  Py_RETURN_NONE;
+
+dloop_error:
+  PyBuffer_Release(&b_status);
+dfail5:
+  PyBuffer_Release(&b_nw);
+dfail4:
+  PyBuffer_Release(&b_rank);
+dfail3:
+  PyBuffer_Release(&b_v);
+dfail2:
+  PyBuffer_Release(&b_c);
+dfail1:
+  PyBuffer_Release(&b_bounds);
+  return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"encode_fast", encode_fast, METH_VARARGS,
      "Fast per-binding encode loop; returns count handled."},
+    {"decode_fast", decode_fast, METH_VARARGS,
+     "Fast per-binding result-list construction."},
     {NULL, NULL, 0, NULL},
 };
 

@@ -914,16 +914,36 @@ def decode_compact(
         "decode_compact requires row-major (ascending) COO input"
     )
     bounds = np.searchsorted(b_arr, np.arange(nb + 1))
-    status = np.asarray(status).tolist()
+    status_arr = np.ascontiguousarray(np.asarray(status), np.int32)
     non_workload = batch.non_workload
-    out: List = []
+    out: List = [None] * nb
+
+    # error slots are Python's (diagnosis construction); unknown nonzero
+    # statuses with no mapped error fall through to target construction
+    for b in np.nonzero(status_arr[:nb] != 0)[0]:
+        err = _status_error(batch, int(b), int(status_arr[b]), items)
+        if err is not None:
+            out[int(b)] = err
+
+    from karmada_tpu import native as _native
+
+    fast = _native.load_encode_fast()
+    if fast is not None:
+        fast.decode_fast(
+            np.ascontiguousarray(bounds, np.int64),
+            np.ascontiguousarray(c_arr, np.int64),
+            np.ascontiguousarray(vv, np.int64),
+            np.ascontiguousarray(batch.name_rank, np.int64),
+            names, np.ascontiguousarray(non_workload[:nb], np.uint8),
+            status_arr, TargetCluster,
+            bool(enable_empty_workload_propagation), out,
+        )
+
+    # Python builder: every slot the C path did not fill (fallback mode,
+    # or nonzero-status bindings whose error mapped to None)
     for b in range(nb):
-        st = status[b]
-        if st != 0:
-            err = _status_error(batch, b, int(st), items)
-            if err is not None:
-                out.append(err)
-                continue
+        if out[b] is not None:
+            continue
         lo, hi = bounds[b], bounds[b + 1]
         cs = c_arr[lo:hi].tolist()
         vs = vv[lo:hi].tolist()
@@ -941,5 +961,5 @@ def decode_compact(
                     if v == 0
                 ]
         targets.sort(key=lambda t: t.name)
-        out.append(targets)
+        out[b] = targets
     return out

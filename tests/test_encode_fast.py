@@ -83,3 +83,41 @@ def test_fast_path_tensor_parity(seed, no_fast, monkeypatch):
         npt.assert_array_equal(
             np.asarray(getattr(fast, f)), np.asarray(getattr(slow, f)),
             err_msg=f)
+
+
+@pytest.mark.parametrize("empty_prop", [False, True])
+def test_decode_fast_parity(no_fast, monkeypatch, empty_prop):
+    """decode_fast must build identical target lists to the Python builder,
+    including zero-replica lanes, non-workload rows, and error slots."""
+    rng = random.Random(11)
+    clusters = bench.build_fleet(rng, 64)
+    placements = bench.build_placements(rng, [c.name for c in clusters])
+    items = bench.build_bindings(rng, 256, placements)
+    est = GeneralEstimator()
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch(items, cindex, est,
+                                 cache=tensors.EncoderCache())
+    nb, C = batch.n_bindings, batch.C
+    rows = []
+    for b in range(nb):
+        ks = sorted(rng.sample(range(batch.n_clusters), rng.randint(0, 5)))
+        rows += [(b * C + c, rng.randint(0, 3)) for c in ks]
+    idx = np.array([r[0] for r in rows] or [0], np.int32)
+    val = np.array([r[1] for r in rows] or [0], np.int32)
+    status = np.zeros(batch.B, np.int32)
+    status[3] = tensors.STATUS_UNSCHEDULABLE  # error slot stays Python's
+
+    kw = dict(enable_empty_workload_propagation=empty_prop, items=items)
+    slow = tensors.decode_compact(batch, idx, val, status, **kw)
+    monkeypatch.setattr(native, "_enc_mod", None)
+    monkeypatch.setattr(native, "_enc_error", None)
+    assert native.load_encode_fast() is not None
+    fast = tensors.decode_compact(batch, idx, val, status, **kw)
+
+    assert len(fast) == len(slow)
+    for b, (f, s) in enumerate(zip(fast, slow)):
+        if isinstance(s, Exception):
+            assert type(f) is type(s), b
+            continue
+        assert [(t.name, t.replicas) for t in f] == \
+               [(t.name, t.replicas) for t in s], b
