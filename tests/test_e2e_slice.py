@@ -233,3 +233,34 @@ def test_native_backend_schedules_like_serial():
         results[backend] = {tc.name: tc.replicas for tc in rb.spec.clusters}
         assert sum(results[backend].values()) == 6, backend
     assert results["native"] == results["serial"]
+
+
+def test_native_backend_affinity_failover_loop():
+    """ClusterAffinities multi-term failover under backend="native": the
+    first term has no feasible cluster, the scheduler must fail over to
+    the second term (snapshot reused across rounds)."""
+    from karmada_tpu import native as native_mod
+
+    if not native_mod.available():
+        pytest.skip(f"native unavailable: {native_mod.build_error()}")
+    from karmada_tpu.models.policy import ClusterAffinityTerm
+
+    cp = ControlPlane(backend="native")
+    cp.add_member("m1")
+    cp.add_member("m2")
+    manifest = nginx(replicas=4)
+    cp.apply(manifest)
+    pol = policy()
+    pol.spec.placement.cluster_affinity = None
+    pol.spec.placement.cluster_affinities = [
+        ClusterAffinityTerm(affinity_name="primary", affinity=ClusterAffinity(
+            cluster_names=["absent-a", "absent-b"])),
+        ClusterAffinityTerm(affinity_name="backup", affinity=ClusterAffinity(
+            cluster_names=["m1", "m2"])),
+    ]
+    cp.apply_policy(pol)
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "nginx-deployment")
+    assert sum(tc.replicas for tc in rb.spec.clusters) == 4
+    assert {tc.name for tc in rb.spec.clusters} <= {"m1", "m2"}
+    assert rb.status.scheduler_observed_affinity_name == "backup"
