@@ -31,11 +31,11 @@ SIM_CAPACITY_ANNOTATION = "karmada.io/simulated-capacity"
 VERSION = "karmada-tpu v0.3"
 
 
-def _load_plane(directory: str, backend: str = "serial"):
+def _load_plane(directory: str, backend: str = "serial", waves: int = 8):
     from karmada_tpu.e2e import ControlPlane
     from karmada_tpu.models.cluster import Cluster
 
-    cp = ControlPlane(backend=backend, persist_dir=directory)
+    cp = ControlPlane(backend=backend, persist_dir=directory, waves=waves)
     # rehydrate feature gates persisted by `addons enable/disable`
     gates_cm = cp.store.try_get("ConfigMap", "karmada-system", "feature-gates")
     if gates_cm is not None:
@@ -611,7 +611,7 @@ def cmd_deinit(args) -> int:
 
 
 def cmd_tick(args) -> int:
-    cp = _load_plane(args.dir, backend=args.backend)
+    cp = _load_plane(args.dir, backend=args.backend, waves=args.waves)
     n = cp.tick()
     cp.checkpoint()
     print(f"{n} reconciles")
@@ -624,7 +624,7 @@ def cmd_serve(args) -> int:
     scheduler / webhook processes rolled into one, Runtime.serve)."""
     import time as _time
 
-    cp = _load_plane(args.dir, backend=args.backend)
+    cp = _load_plane(args.dir, backend=args.backend, waves=args.waves)
     if args.feature_gates:
         cp.gates.set_from_string(args.feature_gates)
     cp.runtime._periodic_interval_s = args.sync_period  # noqa: SLF001
@@ -770,6 +770,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     tk = sub.add_parser("tick")
     tk.add_argument("--backend", default="serial")
+    tk.add_argument("--waves", type=int, default=8)
 
     sv = sub.add_parser("serve")
     sv.add_argument("--backend", choices=["serial", "native", "device"],
@@ -780,6 +781,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="periodic resync interval seconds")
     sv.add_argument("--checkpoint-period", type=float, default=30.0,
                     help="WAL compaction interval seconds")
+    sv.add_argument("--waves", type=int, default=8,
+                    help="capacity-contention waves per solver chunk "
+                         "(batch size = strict one-at-a-time semantics)")
     sv.add_argument("--metrics-port", type=int, default=-1,
                     help="serve /metrics,/healthz,/readyz,/debug/state on "
                          "127.0.0.1:PORT (0 = ephemeral, -1 = disabled)")

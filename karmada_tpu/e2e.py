@@ -62,6 +62,7 @@ class ControlPlane:
         clock=None,
         persist_dir: Optional[str] = None,
         eviction_rate: float = 100.0,
+        waves: int = 8,
     ) -> None:
         self.clock = clock if clock is not None else time.time
         from karmada_tpu.utils.events import EventRecorder
@@ -89,7 +90,7 @@ class ControlPlane:
         self.recorder = EventRecorder()
         self.detector = ResourceDetector(self.store, self.runtime, self.interpreter)
         self.scheduler = Scheduler(self.store, self.runtime, backend=backend,
-                                   recorder=self.recorder)
+                                   recorder=self.recorder, waves=waves)
         self.binding_controller = BindingController(
             self.store, self.runtime, self.interpreter
         )
