@@ -6,9 +6,10 @@ Mirrors SURVEY.md section 3.5:
   recovery removes it (grace periods collapsed to immediate for the
   deterministic runtime; the serve-mode wrapper can delay enqueues).
 * NoExecuteTaintManager -- pkg/controllers/cluster/taint_manager.go:101:
-  bindings targeting a NoExecute-tainted cluster are evicted unless their
-  placement tolerates the taint (tolerationSeconds honored as
-  immediate-vs-never in pump mode).
+  bindings targeting a NoExecute-tainted cluster are evicted once the
+  matching toleration's tolerationSeconds expire (untolerated taints evict
+  immediately; a taint cleared before the deadline cancels the pending
+  eviction).
 * GracefulEvictionController -- pkg/controllers/gracefuleviction/
   evictiontask.go:38-116: an eviction task drains only once the binding's
   *other* clusters report healthy replacement (or the grace period lapses);
@@ -59,6 +60,7 @@ def evict_cluster(
     producer: str,
     grace_period_seconds: Optional[int] = None,
     suppress_deletion: Optional[bool] = None,
+    now: Optional[float] = None,
 ) -> bool:
     """binding_types.go GracefulEvict semantics; returns True if changed."""
     target = next((t for t in rb.spec.clusters if t.name == cluster), None)
@@ -74,7 +76,7 @@ def evict_cluster(
         producer=producer,
         grace_period_seconds=grace_period_seconds,
         suppress_deletion=suppress_deletion,
-        creation_timestamp=time.time(),
+        creation_timestamp=now if now is not None else time.time(),
     ))
     return True
 
@@ -82,8 +84,9 @@ def evict_cluster(
 class ClusterTaintController:
     """Ready=False <-> not-ready NoExecute taint."""
 
-    def __init__(self, store: ObjectStore, runtime: Runtime) -> None:
+    def __init__(self, store: ObjectStore, runtime: Runtime, clock=None) -> None:
         self.store = store
+        self.clock = clock if clock is not None else time.time
         self.worker = runtime.register(AsyncWorker("cluster-taint", self._reconcile))
         store.bus.subscribe(self._on_event, kind=Cluster.KIND)
 
@@ -104,51 +107,117 @@ class ClusterTaintController:
             def add(c: Cluster) -> None:
                 c.spec.taints.append(Taint(
                     key=TAINT_NOT_READY, effect=EFFECT_NO_EXECUTE,
-                    time_added=time.time(),
+                    time_added=self.clock(),
                 ))
             self.store.mutate(Cluster.KIND, "", name, add)
 
 
 class NoExecuteTaintManager:
-    """Evict bindings from NoExecute-tainted clusters (taint_manager.go:101).
+    """Evict bindings from NoExecute-tainted clusters (taint_manager.go:101),
+    honoring tolerationSeconds: a tolerated taint delays the eviction until
+    the toleration expires, and a taint removed before that deadline
+    cancels it (the reference's needEviction/tolerationTime semantics —
+    a brief flap never evicts a workload with the defaulted 300s
+    not-ready toleration).
 
-    With an eviction_queue attached, evictions flow through the
+    With an eviction_queue attached, due evictions flow through the
     rate-limited queue (cluster/eviction_worker.go) instead of executing
     inline — a mass cluster failure then drains gradually."""
 
     def __init__(self, store: ObjectStore, runtime: Runtime,
-                 eviction_queue=None) -> None:
+                 eviction_queue=None, clock=None) -> None:
+        import threading
+
         self.store = store
         self.eviction_queue = eviction_queue
+        self.clock = clock if clock is not None else time.time
+        # (ns, name, cluster) -> deadline: tolerated taints awaiting expiry;
+        # touched by the worker AND the periodic flush (separate threads in
+        # serve mode), so every access holds the lock
+        self._pending: Dict[tuple, float] = {}
+        self._pending_lock = threading.Lock()
         self.worker = runtime.register(AsyncWorker("taint-manager", self._reconcile))
+        runtime.register_periodic(self._flush_deadlines)
         store.bus.subscribe(self._on_event, kind=Cluster.KIND)
 
     def _on_event(self, event: Event) -> None:
         taints = [t for t in event.obj.spec.taints if t.effect == EFFECT_NO_EXECUTE]
-        if taints:
+        had = event.old is not None and any(
+            t.effect == EFFECT_NO_EXECUTE for t in event.old.spec.taints)
+        # taint cleared is as important as taint added: pending deadlines
+        # for the recovered cluster must be CANCELLED, not left to burn
+        # rate-limited queue tokens at their stale expiry
+        if taints or had:
             self.worker.enqueue(event.obj.name)
 
-    def _tolerated(self, rb: ResourceBinding, taint: Taint) -> bool:
+    def _eviction_due(self, rb: ResourceBinding, taints, now: float):
+        """None = never (all taints tolerated forever); otherwise the
+        timestamp at which eviction is due (<= now means due immediately).
+        k8s/karmada semantics: due at the MINIMUM expiry across taints,
+        where an untolerated taint is due immediately and a matching
+        toleration without seconds tolerates that taint forever."""
         placement = rb.spec.placement
         tolerations = placement.cluster_tolerations if placement else []
-        return any(t.tolerates(taint) for t in tolerations)
+        due = None
+        for taint in taints:
+            matching = [t for t in tolerations if t.tolerates(taint)]
+            if not matching:
+                return now
+            secs = [t.toleration_seconds for t in matching]
+            if any(s is None for s in secs):
+                continue  # tolerated forever
+            start = taint.time_added if taint.time_added is not None else now
+            d = start + min(secs)
+            due = d if due is None else min(due, d)
+        return due
+
+    def _cancel_cluster(self, cluster_name: str) -> None:
+        with self._pending_lock:
+            for key in [k for k in self._pending if k[2] == cluster_name]:
+                self._pending.pop(key, None)
 
     def _reconcile(self, cluster_name) -> None:
         cluster = self.store.try_get(Cluster.KIND, "", cluster_name)
         if cluster is None:
+            self._cancel_cluster(cluster_name)
             return
         taints = [t for t in cluster.spec.taints if t.effect == EFFECT_NO_EXECUTE]
         if not taints:
+            self._cancel_cluster(cluster_name)
             return
+        now = self.clock()
         for rb in self.store.list(ResourceBinding.KIND):
             if not any(t.name == cluster_name for t in rb.spec.clusters):
                 continue
-            if all(self._tolerated(rb, taint) for taint in taints):
-                continue
-            if self.eviction_queue is not None:
-                self.eviction_queue.add((rb.namespace, rb.name, cluster_name))
+            due = self._eviction_due(rb, taints, now)
+            key = (rb.namespace, rb.name, cluster_name)
+            if due is None:
+                with self._pending_lock:
+                    self._pending.pop(key, None)
+            elif due > now:
+                with self._pending_lock:
+                    self._pending[key] = due
             else:
-                self.evict_one((rb.namespace, rb.name, cluster_name))
+                with self._pending_lock:
+                    self._pending.pop(key, None)
+                if self.eviction_queue is not None:
+                    self.eviction_queue.add(key)
+                else:
+                    self.evict_one(key)
+
+    def _flush_deadlines(self) -> None:
+        """Expired toleration deadlines become evictions; evict_one
+        re-verifies, so a taint cleared in the meantime cancels cleanly."""
+        now = self.clock()
+        with self._pending_lock:
+            due_now = [k for k, d in self._pending.items() if d <= now]
+            for key in due_now:
+                self._pending.pop(key, None)
+        for key in due_now:
+            if self.eviction_queue is not None:
+                self.eviction_queue.add(key)
+            else:
+                self.evict_one(key)
 
     def evict_one(self, key) -> None:
         """One paced eviction; re-verifies the decision at processing time
@@ -163,13 +232,15 @@ class NoExecuteTaintManager:
         rb = self.store.try_get(ResourceBinding.KIND, ns, name)
         if rb is None or not any(t.name == cluster_name for t in rb.spec.clusters):
             return
-        if all(self._tolerated(rb, taint) for taint in taints):
-            return
+        due = self._eviction_due(rb, taints, self.clock())
+        if due is None or due > self.clock():
+            return  # toleration re-verified: cancelled or not yet expired
 
         def do_evict(obj: ResourceBinding) -> None:
             evict_cluster(
                 obj, cluster_name,
                 reason="TaintUntolerated", producer="taint-manager",
+                now=self.clock(),
             )
 
         try:
@@ -182,8 +253,10 @@ class GracefulEvictionController:
     """Drain eviction tasks once replacement is healthy or grace expires."""
 
     def __init__(self, store: ObjectStore, runtime: Runtime,
-                 grace_period_s: float = DEFAULT_GRACE_PERIOD_S) -> None:
+                 grace_period_s: float = DEFAULT_GRACE_PERIOD_S,
+                 clock=None) -> None:
         self.store = store
+        self.clock = clock if clock is not None else time.time
         self.grace_period_s = grace_period_s
         self.worker = runtime.register(AsyncWorker("graceful-eviction", self._reconcile))
         store.bus.subscribe(self._on_event, kind=ResourceBinding.KIND)
@@ -215,7 +288,7 @@ class GracefulEvictionController:
         rb = self.store.try_get(ResourceBinding.KIND, ns, name)
         if rb is None or not rb.spec.graceful_eviction_tasks:
             return
-        now = time.time()
+        now = self.clock()
         ready = self._replacement_ready(rb)
         keep = []
         for task in rb.spec.graceful_eviction_tasks:
@@ -253,8 +326,10 @@ class ApplicationFailoverController:
     yet ready) never flaps even with tolerationSeconds=0.
     """
 
-    def __init__(self, store: ObjectStore, runtime: Runtime) -> None:
+    def __init__(self, store: ObjectStore, runtime: Runtime,
+                 clock=None) -> None:
         self.store = store
+        self.clock = clock if clock is not None else time.time
         self._unhealthy_since: Dict[tuple, float] = {}
         self._round = 0
         self._seen_round: Dict[tuple, int] = {}
@@ -271,7 +346,7 @@ class ApplicationFailoverController:
         toleration = getattr(rb.spec.failover, "toleration_seconds",
                              DEFAULT_TOLERATION_S)
         purge = getattr(rb.spec.failover, "purge_mode", PURGE_GRACIOUSLY)
-        now = time.time()
+        now = self.clock()
         to_evict = []
         unhealthy_now = set()
         for item in rb.status.aggregated_status:
@@ -306,6 +381,7 @@ class ApplicationFailoverController:
                     changed = evict_cluster(
                         obj, cluster, reason="ApplicationUnhealthy",
                         producer="app-failover", suppress_deletion=True,
+                        now=now,
                     ) or changed
                 else:
                     changed = evict_cluster(
@@ -313,6 +389,7 @@ class ApplicationFailoverController:
                         producer="app-failover",
                         grace_period_seconds=getattr(
                             rb.spec.failover, "grace_period_seconds", None),
+                        now=now,
                     ) or changed
             # the spec change alone re-triggers scheduling; steady mode then
             # tops the lost replicas back up without disrupting survivors

@@ -63,6 +63,9 @@ class ControlPlane:
         persist_dir: Optional[str] = None,
         eviction_rate: float = 100.0,
         waves: int = 8,
+        # --default-not-ready/unreachable-toleration-seconds (webhook flags,
+        # 300 in the reference); None disables the defaulted tolerations
+        default_toleration_seconds: Optional[int] = 300,
     ) -> None:
         self.clock = clock if clock is not None else time.time
         from karmada_tpu.utils.events import EventRecorder
@@ -77,7 +80,10 @@ class ControlPlane:
             self.store = load_store(persist_dir, admission=self.admission)
         else:
             self.store = ObjectStore(admission=self.admission)
-        install_default_webhooks(self.admission, self.store, self.gates)
+        install_default_webhooks(
+            self.admission, self.store, self.gates,
+            default_toleration_seconds=default_toleration_seconds,
+        )
         self.runtime = Runtime()
         self.members: Dict[str, FakeMemberCluster] = {}
         # the push-side execution/status controllers only drive PUSH-mode
@@ -114,7 +120,8 @@ class ControlPlane:
         self.lease_monitor = ClusterLeaseMonitor(
             self.store, self.runtime, recorder=self.recorder
         )
-        self.cluster_taints = ClusterTaintController(self.store, self.runtime)
+        self.cluster_taints = ClusterTaintController(self.store, self.runtime,
+                                                     clock=self.clock)
         # taint-driven evictions pace through the rate-limited queue
         # (cluster/eviction_worker.go); lifecycle handles join/unjoin
         from karmada_tpu.controllers.cluster import (
@@ -123,16 +130,19 @@ class ControlPlane:
         )
 
         self.cluster_lifecycle = ClusterLifecycleController(self.store, self.runtime)
-        self.taint_manager = NoExecuteTaintManager(self.store, self.runtime)
+        self.taint_manager = NoExecuteTaintManager(self.store, self.runtime,
+                                                   clock=self.clock)
         self.eviction_queue = RateLimitedEvictionQueue(
             self.runtime, self.taint_manager.evict_one,
             rate_per_s=eviction_rate, clock=self.clock,
         )
         self.taint_manager.eviction_queue = self.eviction_queue
         self.graceful_eviction = GracefulEvictionController(
-            self.store, self.runtime, grace_period_s=eviction_grace_period_s
+            self.store, self.runtime, grace_period_s=eviction_grace_period_s,
+            clock=self.clock,
         )
-        self.app_failover = ApplicationFailoverController(self.store, self.runtime)
+        self.app_failover = ApplicationFailoverController(self.store, self.runtime,
+                                                          clock=self.clock)
         self.namespace_sync = NamespaceSyncController(self.store, self.runtime)
         self.dependencies = DependenciesDistributor(
             self.store, self.runtime, self.interpreter

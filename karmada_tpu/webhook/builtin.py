@@ -66,12 +66,42 @@ def validate_propagation_policy(op, p, old) -> Optional[str]:
     return _validate_placement(p.spec.placement)
 
 
+class DefaultPropagationPolicy:
+    """Mutating defaults (pkg/webhook/propagationpolicy/mutating.go),
+    including the default NoExecute tolerations for the not-ready and
+    unreachable cluster taints (webhook flags
+    --default-not-ready-toleration-seconds /
+    --default-unreachable-toleration-seconds, 300s): a briefly-flapping
+    cluster must not evict workloads the moment it is tainted."""
+
+    NOT_READY = "cluster.karmada.io/not-ready"
+    UNREACHABLE = "cluster.karmada.io/unreachable"
+
+    def __init__(self, toleration_seconds: Optional[int] = 300) -> None:
+        self.toleration_seconds = toleration_seconds
+
+    def __call__(self, op, p, old) -> None:
+        from karmada_tpu.models.policy import Toleration
+
+        if not p.spec.preemption:
+            p.spec.preemption = "Never"
+        if p.spec.conflict_resolution not in ("Abort", "Overwrite"):
+            p.spec.conflict_resolution = "Abort"
+        placement = p.spec.placement
+        if placement is None or self.toleration_seconds is None:
+            return
+        present = {t.key for t in placement.cluster_tolerations}
+        for key in (self.NOT_READY, self.UNREACHABLE):
+            if key not in present:
+                placement.cluster_tolerations.append(Toleration(
+                    key=key, operator="Exists", effect="NoExecute",
+                    toleration_seconds=self.toleration_seconds,
+                ))
+
+
 def default_propagation_policy(op, p, old) -> None:
-    """Mutating defaults (pkg/webhook/propagationpolicy/mutating.go)."""
-    if not p.spec.preemption:
-        p.spec.preemption = "Never"
-    if p.spec.conflict_resolution not in ("Abort", "Overwrite"):
-        p.spec.conflict_resolution = "Abort"
+    """Module-level default chain with the reference's 300s tolerations."""
+    DefaultPropagationPolicy()(op, p, old)
 
 
 # -- OverridePolicy ---------------------------------------------------------
@@ -222,10 +252,12 @@ class QuotaEnforcer:
 
 
 def install_default_webhooks(
-    registry: AdmissionRegistry, store, gates: Optional[FeatureGates] = None
+    registry: AdmissionRegistry, store, gates: Optional[FeatureGates] = None,
+    default_toleration_seconds: Optional[int] = 300,
 ) -> None:
+    defaulter = DefaultPropagationPolicy(default_toleration_seconds)
     for kind in (PropagationPolicy.KIND, ClusterPropagationPolicy.KIND):
-        registry.register_mutating(kind, default_propagation_policy)
+        registry.register_mutating(kind, defaulter)
         registry.register_validating(kind, validate_propagation_policy)
     for kind in (OverridePolicy.KIND, ClusterOverridePolicy.KIND):
         registry.register_validating(kind, validate_override_policy)

@@ -120,7 +120,8 @@ def test_eviction_rate_limits_mass_failure():
     """A zone outage with 6 affected bindings drains at the configured
     2/second instead of stampeding all six through rescheduling at once."""
     clock = FakeClock()
-    cp = ControlPlane(backend="serial", clock=clock, eviction_rate=2.0)
+    cp = ControlPlane(backend="serial", clock=clock, eviction_rate=2.0,
+                      default_toleration_seconds=None)
     cp.add_member("m1", cpu_milli=64_000)
     cp.add_member("m2", cpu_milli=64_000)
     cp.tick()
@@ -151,7 +152,8 @@ def test_eviction_rate_limits_mass_failure():
 
 def test_eviction_rate_zero_halts():
     clock = FakeClock()
-    cp = ControlPlane(backend="serial", clock=clock, eviction_rate=0.0)
+    cp = ControlPlane(backend="serial", clock=clock, eviction_rate=0.0,
+                      default_toleration_seconds=None)
     cp.add_member("m1", cpu_milli=64_000)
     cp.add_member("m2", cpu_milli=64_000)
     cp.tick()

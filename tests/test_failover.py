@@ -52,7 +52,8 @@ def deployment(replicas=6, volumes=None):
 
 
 def test_cluster_failure_evicts_and_reschedules():
-    cp = ControlPlane(eviction_grace_period_s=0)
+    cp = ControlPlane(eviction_grace_period_s=0,
+                      default_toleration_seconds=None)
     cp.add_member("m1", cpu_milli=64_000)
     cp.add_member("m2", cpu_milli=64_000)
     cp.tick()
@@ -197,3 +198,55 @@ def test_dependencies_follow_parent_schedule():
     assert attached.spec.required_by[0].clusters == rb.spec.clusters
     for t in rb.spec.clusters:
         assert cp.member(t.name).get("ConfigMap", "default", "app-config") is not None
+
+
+def test_toleration_seconds_delays_and_cancels_eviction():
+    """Defaulted 300s not-ready tolerations (webhook
+    --default-not-ready-toleration-seconds): a taint evicts only after the
+    toleration expires, and a taint cleared before the deadline cancels
+    the pending eviction — a brief flap never evicts (taint_manager.go
+    tolerationTime semantics)."""
+    import time as _time
+
+    clock = {"now": 1000.0}
+    cp = ControlPlane(clock=lambda: clock["now"])
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.add_member("m2", cpu_milli=64_000)
+    cp.apply_policy(dynamic_policy())
+    cp.apply(deployment(replicas=4))
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    # the defaulting webhook injected the 300s tolerations
+    tols = {t.key: t.toleration_seconds
+            for t in rb.spec.placement.cluster_tolerations}
+    assert tols.get("cluster.karmada.io/not-ready") == 300
+
+    # flap: cluster goes unhealthy (taint added), recovers quickly
+    cp.member("m1").healthy = False
+    cp.tick()
+    from karmada_tpu.models.cluster import Cluster
+
+    cluster = cp.store.get(Cluster.KIND, "", "m1")
+    assert any(t.key.endswith("not-ready") for t in cluster.spec.taints)
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    assert not rb.spec.graceful_eviction_tasks  # tolerated: no eviction yet
+    clock["now"] += 60.0
+    cp.member("m1").healthy = True
+    cp.tick()
+    clock["now"] += 600.0  # well past where the deadline would have been
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    assert not rb.spec.graceful_eviction_tasks  # cancelled by recovery
+
+    # sustained failure: eviction fires once the toleration expires, and
+    # the replicas land on the healthy survivor (the graceful task drains
+    # in the same round because the replacement is immediately healthy)
+    cp.member("m2").healthy = False
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    assert any(t.name == "m2" for t in rb.spec.clusters)  # still tolerated
+    clock["now"] += 301.0
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    assert not any(t.name == "m2" for t in rb.spec.clusters)
+    assert sum(t.replicas for t in rb.spec.clusters) == 4

@@ -47,7 +47,7 @@ def wait_for(predicate, timeout=20.0, interval=0.05):
 
 @pytest.fixture
 def served_plane():
-    cp = ControlPlane(backend="serial")
+    cp = ControlPlane(backend="serial", default_toleration_seconds=None)
     cp.runtime._periodic_interval_s = 0.05  # noqa: SLF001 — fast soak ticks
     cp.add_member("m1", cpu_milli=64_000)
     cp.add_member("m2", cpu_milli=64_000)
