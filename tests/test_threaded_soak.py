@@ -1,0 +1,98 @@
+"""Opt-in threaded chaos soak (serve-mode threads + live churn).
+
+Run with KARMADA_TPU_SOAK=1 (takes ~2 minutes); the fast deterministic
+variant lives in tests/test_chaos_convergence.py. This harness found the
+round-3 flap-storm wedge that motivated the tolerationSeconds work.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+
+import pytest
+
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+    ClusterPreferences,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ReplicaSchedulingStrategy,
+    ResourceSelector,
+)
+from karmada_tpu.models.work import ResourceBinding
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("KARMADA_TPU_SOAK") != "1",
+    reason="threaded soak is opt-in: set KARMADA_TPU_SOAK=1",
+)
+
+
+def _dep(name, replicas):
+    return {"apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"replicas": replicas, "template": {"spec": {"containers": [
+                {"name": "c", "resources": {"requests": {"cpu": "100m"}}}]}}}}
+
+
+def _policy(i, target):
+    return PropagationPolicy(
+        metadata=ObjectMeta(namespace="default", name=f"p-{i}"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(
+                api_version="apps/v1", kind="Deployment", name=target)],
+            placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS)))))
+
+
+@pytest.mark.parametrize("modes", [("Push",) * 6, ("Push", "Pull") * 3])
+def test_threaded_chaos_soak(tmp_path, modes):
+    cp = ControlPlane(backend="serial", persist_dir=str(tmp_path / "plane"))
+    for i, mode in enumerate(modes):
+        cp.add_member(f"m{i}", cpu_milli=48_000, sync_mode=mode)
+    cp.runtime.serve()
+    rng = random.Random(1)
+    apps = []
+    try:
+        for i in range(10):
+            n = f"app-{i}"
+            cp.apply(_dep(n, rng.randint(2, 8)))
+            cp.apply_policy(_policy(i, n))
+            apps.append(n)
+
+        end = time.time() + 90
+        while time.time() < end:
+            a = rng.randrange(4)
+            if a == 0:
+                m = cp.member(f"m{rng.randrange(len(modes))}")
+                m.healthy = rng.random() < 0.8
+            elif a == 1:
+                cp.apply(_dep(rng.choice(apps), rng.randint(1, 10)))
+            elif a == 2:
+                cp.checkpoint()
+            time.sleep(0.05)
+        for i in range(len(modes)):
+            cp.member(f"m{i}").healthy = True
+        time.sleep(5)
+    finally:
+        cp.runtime.stop()
+    cp.checkpoint()
+
+    for n in apps:
+        rb = cp.store.get(ResourceBinding.KIND, "default", f"{n}-deployment")
+        want = cp.store.get("Deployment", "default", n).manifest["spec"]["replicas"]
+        got = sum(tc.replicas for tc in rb.spec.clusters)
+        assert got == want, (n, got, want)
+        for tc in rb.spec.clusters:
+            obj = cp.member(tc.name).get("Deployment", "default", n)
+            assert obj is not None, (n, tc.name)
+            assert obj.manifest["spec"]["replicas"] == tc.replicas
