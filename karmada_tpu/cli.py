@@ -200,6 +200,21 @@ def cmd_top(args) -> int:
     from karmada_tpu.models.cluster import Cluster
 
     cp = _load_plane(args.dir)
+    if args.what == "pods":
+        # merged PodMetrics across clusters (pkg/karmadactl/top pods via
+        # the metrics adapter fan-out)
+        rows = []
+        for pm in cp.metrics_provider.pod_metrics(
+                "Deployment", args.namespace or "default", args.name or ""):
+            usage = pm.get("usage", {})
+            rows.append([
+                pm.get("cluster", "-"), pm.get("name", "-"),
+                f"{usage.get('cpu', 0)}m",
+                f"{usage.get('memory', 0) // 1000 // (1 << 20)}Mi",
+            ])
+        _print_table(rows or [["-", "-", "-", "-"]],
+                     ["CLUSTER", "POD", "CPU", "MEMORY"])
+        return 0
     rows = []
     for c in cp.store.list(Cluster.KIND):
         s = c.status.resource_summary
@@ -700,7 +715,9 @@ def build_parser() -> argparse.ArgumentParser:
         c.add_argument("name")
 
     t = sub.add_parser("top")
-    t.add_argument("what", choices=["clusters"])
+    t.add_argument("what", choices=["clusters", "pods"])
+    t.add_argument("name", nargs="?", help="workload name (pods)")
+    t.add_argument("-n", "--namespace", default="")
 
     i = sub.add_parser("interpret")
     i.add_argument("-f", "--filename", required=True)

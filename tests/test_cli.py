@@ -317,3 +317,26 @@ def test_work_printer_columns(tmp_path, capsys):
     assert run(tmp_path, "get", "Work") == 0
     out = capsys.readouterr().out
     assert "MANIFESTS" in out and "APPLIED" in out
+
+
+def test_top_pods(tmp_path, capsys):
+    assert run(tmp_path, "init") == 0
+    assert run(tmp_path, "join", "m1") == 0
+    assert run(tmp_path, "apply", "-f", deployment_yaml(tmp_path)) == 0
+    from karmada_tpu.cli import _load_plane
+    from karmada_tpu.models.meta import ObjectMeta
+    from karmada_tpu.models.policy import (
+        Placement, PropagationPolicy, PropagationSpec, ResourceSelector)
+
+    cp = _load_plane(str(tmp_path / "plane"))
+    cp.apply_policy(PropagationPolicy(
+        metadata=ObjectMeta(namespace="default", name="pp"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(
+                api_version="apps/v1", kind="Deployment", name="web")],
+            placement=Placement())))
+    cp.tick(); cp.checkpoint()
+    capsys.readouterr()
+    assert run(tmp_path, "top", "pods", "web", "-n", "default") == 0
+    out = capsys.readouterr().out
+    assert "CLUSTER" in out and "m1" in out and "CPU" in out
