@@ -118,15 +118,30 @@ def _print_table(rows, headers) -> None:
 def cmd_get(args) -> int:
     cp = _load_plane(args.dir)
     if args.cluster:
-        try:
-            handle = cp.proxy(args.cluster)
-            objs = (
-                [handle.get(args.kind, args.namespace, args.name)]
-                if args.name else handle.list(args.kind, args.namespace or None)
-            )
-        except Exception as e:  # noqa: BLE001 — ProxyDenied / unknown cluster
-            print(f"cluster proxy error: {e}", file=sys.stderr)
+        handle = _proxy_handle(cp, args.cluster)
+        if handle is None:
             return 1
+        if args.kind in ("Pod", "pods") and not (
+                args.name and handle.get("Pod", args.namespace, args.name)):
+            # the member's synthesized pod plane (admitted replicas) — what
+            # `kubectl get pods` shows.  A name naming a real standalone Pod
+            # object falls through to the manifest path below.
+            pods = [p for p in handle.pods(args.namespace or None)
+                    if not args.name or p["name"] == args.name]
+            if args.output == "json":
+                for p in pods:
+                    print(json.dumps(p))
+                return 0
+            _print_table(
+                [[p["name"], p["namespace"], p["owner"],
+                  "True" if p["ready"] else "False"] for p in pods]
+                or [["-", "-", "-", "-"]],
+                ["NAME", "NAMESPACE", "OWNER", "READY"])
+            return 0
+        objs = (
+            [handle.get(args.kind, args.namespace, args.name)]
+            if args.name else handle.list(args.kind, args.namespace or None)
+        )
         objs = [o for o in objs if o is not None]
     elif args.name:
         o = cp.store.try_get(args.kind, args.namespace, args.name)
@@ -156,6 +171,142 @@ def cmd_apply(args) -> int:
         print(f"{manifest.get('kind')}/{manifest['metadata']['name']} applied")
     _finish(cp)
     return 0
+
+
+def cmd_create(args) -> int:
+    """Like apply, but refuses to overwrite (pkg/karmadactl/create /
+    kubectl create semantics)."""
+    import yaml
+
+    cp = _load_plane(args.dir)
+    with open(args.filename) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    conflicts = 0
+    for manifest in docs:
+        kind = manifest.get("kind")
+        meta = manifest.get("metadata", {})
+        ns, name = meta.get("namespace", ""), meta.get("name", "")
+        if cp.store.try_get(kind, ns, name) is not None:
+            # kubectl create: report the conflict, keep creating the rest
+            print(f"{kind}/{name} already exists", file=sys.stderr)
+            conflicts += 1
+            continue
+        cp.apply(manifest)
+        print(f"{kind}/{name} created")
+    _finish(cp)
+    return 1 if conflicts else 0
+
+
+def cmd_edit(args) -> int:
+    """Open the object in $EDITOR and apply the result
+    (pkg/karmadactl/edit / kubectl edit semantics).  Identity fields
+    (kind/name/namespace) must survive the edit."""
+    import os
+    import subprocess
+    import tempfile
+
+    cp = _load_plane(args.dir)
+    obj = cp.store.try_get(args.kind, args.namespace, args.name)
+    if obj is None:
+        print(f"{args.kind}/{args.name} not found", file=sys.stderr)
+        return 1
+    if not hasattr(obj, "manifest"):
+        print(f"{args.kind} is a typed API object; edit it with apply/patch",
+              file=sys.stderr)
+        return 1
+    manifest = obj.to_manifest()
+    editor = os.environ.get("EDITOR", "vi")
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".json", delete=False) as f:
+        json.dump(manifest, f, indent=2, default=str)
+        path = f.name
+    try:
+        rc = subprocess.call(f"{editor} {path}", shell=True)
+        if rc != 0:
+            print(f"editor exited {rc}; edit cancelled", file=sys.stderr)
+            return 1
+        with open(path) as f:
+            try:
+                edited = json.load(f)
+            except json.JSONDecodeError as e:
+                print(f"edited object is not valid JSON: {e}", file=sys.stderr)
+                return 1
+    finally:
+        os.unlink(path)
+    if edited == manifest:
+        print("no changes")
+        return 0
+    emeta = edited.get("metadata", {})
+    if (edited.get("kind") != args.kind or emeta.get("name") != args.name
+            or emeta.get("namespace", "") != (args.namespace or "")):
+        print("cannot change kind/name/namespace in an edit", file=sys.stderr)
+        return 1
+    cp.apply(edited)
+    _finish(cp)
+    print(f"{args.kind}/{args.name} edited")
+    return 0
+
+
+def _proxy_handle(cp, cluster: str):
+    try:
+        return cp.proxy(cluster)
+    except Exception as e:  # noqa: BLE001 — ProxyDenied / unknown cluster
+        print(f"cluster proxy error: {e}", file=sys.stderr)
+        return None
+
+
+def _err_text(e: Exception) -> str:
+    """str(KeyError) reprs its argument (stray quotes); unwrap it."""
+    return e.args[0] if isinstance(e, KeyError) and e.args else str(e)
+
+
+def _stream_pod_logs(args, tail, header: str = "") -> int:
+    cp = _load_plane(args.dir)
+    handle = _proxy_handle(cp, args.cluster)
+    if handle is None:
+        return 1
+    try:
+        lines = handle.logs(args.namespace or "default", args.pod, tail=tail)
+    except Exception as e:  # noqa: BLE001 — pod not found
+        print(_err_text(e), file=sys.stderr)
+        return 1
+    if header:
+        print(header)
+    for line in lines:
+        print(line)
+    return 0
+
+
+def cmd_logs(args) -> int:
+    """Stream a member pod's log through the cluster proxy
+    (pkg/karmadactl/logs)."""
+    return _stream_pod_logs(args, args.tail)
+
+
+def cmd_exec(args) -> int:
+    """Run a command in a member pod through the cluster proxy
+    (pkg/karmadactl/exec)."""
+    cp = _load_plane(args.dir)
+    handle = _proxy_handle(cp, args.cluster)
+    if handle is None:
+        return 1
+    try:
+        rc, out = handle.exec(args.namespace or "default", args.pod,
+                              args.cmd)
+    except Exception as e:  # noqa: BLE001 — pod not found
+        print(_err_text(e), file=sys.stderr)
+        return 1
+    if out:
+        print(out)
+    return rc
+
+
+def cmd_attach(args) -> int:
+    """Attach to a member pod's output stream (pkg/karmadactl/attach).
+    The simulator has no interactive session; attach shows the live tail."""
+    return _stream_pod_logs(
+        args, tail=10,
+        header=f"attached to {args.pod} in {args.cluster} (simulated stream)")
 
 
 def cmd_promote(args) -> int:
@@ -704,6 +855,32 @@ def build_parser() -> argparse.ArgumentParser:
     a = sub.add_parser("apply")
     a.add_argument("-f", "--filename", required=True)
 
+    cr = sub.add_parser("create")
+    cr.add_argument("-f", "--filename", required=True)
+
+    ed = sub.add_parser("edit")
+    ed.add_argument("kind")
+    ed.add_argument("name")
+    ed.add_argument("-n", "--namespace", default="")
+
+    lg = sub.add_parser("logs")
+    lg.add_argument("pod")
+    lg.add_argument("--cluster", required=True)
+    lg.add_argument("-n", "--namespace", default="default")
+    lg.add_argument("--tail", type=int, default=None)
+
+    xc = sub.add_parser("exec")
+    xc.add_argument("pod")
+    xc.add_argument("--cluster", required=True)
+    xc.add_argument("-n", "--namespace", default="default")
+    xc.add_argument("cmd", nargs="*",
+                    help="command to run (flags go after --)")
+
+    at = sub.add_parser("attach")
+    at.add_argument("pod")
+    at.add_argument("--cluster", required=True)
+    at.add_argument("-n", "--namespace", default="default")
+
     pr = sub.add_parser("promote")
     pr.add_argument("kind")
     pr.add_argument("name")
@@ -829,6 +1006,11 @@ COMMANDS = {
     "unjoin": cmd_unjoin,
     "get": cmd_get,
     "apply": cmd_apply,
+    "create": cmd_create,
+    "edit": cmd_edit,
+    "logs": cmd_logs,
+    "exec": cmd_exec,
+    "attach": cmd_attach,
     "promote": cmd_promote,
     "cordon": cmd_cordon,
     "uncordon": lambda a: cmd_cordon(a, uncordon=True),

@@ -58,6 +58,17 @@ class FakeMemberCluster:
     # Unset workloads idle at 10% of their request (something nonzero for
     # utilization math without claiming precision the simulator lacks).
     load: Dict[tuple, Dict[str, int]] = field(default_factory=dict)
+    # per-workload lifecycle journal: (kind, ns, name) -> lines.  This is
+    # what `karmadactl logs/attach` stream through the cluster proxy — the
+    # simulator's honest stand-in for container stdout (the reference
+    # streams real kubelet logs, pkg/karmadactl/logs).
+    journal: Dict[tuple, List[str]] = field(default_factory=dict)
+    _JOURNAL_CAP = 200
+
+    def _log(self, kind: str, namespace: str, name: str, line: str) -> None:
+        lines = self.journal.setdefault((kind, namespace, name), [])
+        lines.append(line)
+        del lines[:-self._JOURNAL_CAP]
 
     def effective_nodes(self) -> List[FakeNode]:
         """Explicit node list, or one synthetic node holding all capacity."""
@@ -76,11 +87,14 @@ class FakeMemberCluster:
         obj = Unstructured.from_manifest(manifest)
         existing = self.store.try_get(obj.KIND, obj.namespace, obj.name)
         if existing is None:
+            self._log(obj.KIND, obj.namespace, obj.name, "created")
             return self.store.create(obj)
         assert isinstance(existing, Unstructured)
         merged = copy.deepcopy(manifest)
         if existing.manifest.get("status") is not None and "status" not in merged:
             merged["status"] = existing.manifest["status"]
+        if existing.spec_view() != obj.spec_view():
+            self._log(obj.KIND, obj.namespace, obj.name, "spec updated")
         existing.manifest = merged
         existing.metadata.labels = dict(
             deep_get(merged, "metadata.labels", {}) or {})
@@ -95,6 +109,9 @@ class FakeMemberCluster:
     def delete(self, kind: str, namespace: str, name: str) -> None:
         try:
             self.store.delete(kind, namespace, name)
+            # drop the journal with the workload: no pod can read it anymore
+            # and keys must not accumulate across churn in serve mode
+            self.journal.pop((kind, namespace, name), None)
         except NotFoundError:
             pass
 
@@ -245,6 +262,11 @@ class FakeMemberCluster:
                     "availableReplicas": ready,
                 }
                 if m.get("status") != status:
+                    prev_ready = deep_get(m, "status.readyReplicas", 0) or 0
+                    if prev_ready != ready:
+                        self._log(kind, obj.namespace, obj.name,
+                                  f"readyReplicas {prev_ready} -> {ready}")
+
                     def setst(o, status=status):
                         o.manifest["status"] = status
                     self.store.mutate(kind, obj.namespace, obj.name, setst)
@@ -256,3 +278,80 @@ class FakeMemberCluster:
                     def setst(o, status=status):
                         o.manifest["status"] = status
                     self.store.mutate(kind, obj.namespace, obj.name, setst)
+
+    # -- pod plane (what karmadactl exec/logs/attach reach via the proxy) ---
+    _POD_OWNERS = ("Deployment", "StatefulSet", "ReplicaSet", "Job")
+
+    def list_pods(self, namespace: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Synthesized pod views: one per admitted replica of every applied
+        workload, plus standalone Pod objects.  The reference lists real
+        pods through the cluster proxy (pkg/karmadactl/get); the simulator
+        derives them from the admission plan."""
+        plan = self.admission_plan()
+        pods: List[Dict[str, Any]] = []
+        for obj in sorted(self.store.items(), key=lambda o: (o.KIND, o.namespace, o.name)):
+            if not isinstance(obj, Unstructured):
+                continue
+            if namespace is not None and obj.namespace != namespace:
+                continue
+            if obj.KIND == "Pod":
+                pods.append({"name": obj.name, "namespace": obj.namespace,
+                             "owner": "Pod/" + obj.name, "ready": True})
+            elif obj.KIND in self._POD_OWNERS:
+                ready = plan.get((obj.KIND, obj.namespace, obj.name), 0)
+                for i in range(ready):
+                    pods.append({
+                        "name": f"{obj.name}-{i}", "namespace": obj.namespace,
+                        "owner": f"{obj.KIND}/{obj.name}", "ready": True,
+                    })
+        return pods
+
+    def _resolve_pod(self, namespace: str, pod: str) -> Optional[tuple]:
+        """Pod name -> owning workload key, or None."""
+        for p in self.list_pods(namespace):
+            if p["name"] == pod:
+                kind, name = p["owner"].split("/", 1)
+                return (kind, namespace, name)
+        return None
+
+    def pod_logs(self, namespace: str, pod: str,
+                 tail: Optional[int] = None) -> List[str]:
+        """The pod's stream: its workload's lifecycle journal prefixed with
+        a startup line (reference: kubelet container logs via proxy,
+        pkg/karmadactl/logs)."""
+        key = self._resolve_pod(namespace, pod)
+        if key is None:
+            raise NotFoundError(f"pod {namespace}/{pod} not found in {self.name}")
+        lines = [f"{pod} started on {self.name}"]
+        lines += self.journal.get(key, [])
+        # kubectl --tail semantics: 0 = nothing, negative = everything,
+        # more-than-available = everything
+        if tail is not None and tail >= 0:
+            lines = lines[max(len(lines) - tail, 0):] if tail else []
+        return lines
+
+    def pod_exec(self, namespace: str, pod: str,
+                 command: List[str]) -> tuple:
+        """Simulated in-container command execution -> (exit_code, output).
+        A few commands answer from real simulator state; the rest echo a
+        simulated marker (the reference streams an SPDY exec session,
+        pkg/karmadactl/exec)."""
+        key = self._resolve_pod(namespace, pod)
+        if key is None:
+            raise NotFoundError(f"pod {namespace}/{pod} not found in {self.name}")
+        if not command:
+            return (1, "no command")
+        prog = command[0]
+        if prog == "hostname":
+            return (0, pod)
+        if prog == "env":
+            kind, ns, name = key
+            load = self.load.get(key, {})
+            lines = [f"KARMADA_CLUSTER={self.name}",
+                     f"POD_NAMESPACE={ns}",
+                     f"WORKLOAD={kind}/{name}"]
+            if load:
+                lines.append("LOAD=" + ",".join(
+                    f"{k}:{v}" for k, v in sorted(load.items())))
+            return (0, "\n".join(lines))
+        return (0, f"(simulated) {' '.join(command)}")

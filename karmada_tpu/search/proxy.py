@@ -124,3 +124,15 @@ class ProxyHandle:
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         self._member.delete(kind, namespace, name)
+
+    # pod subresources (reference: pods/{log,exec,attach} through the
+    # aggregated proxy — pkg/karmadactl/{logs,exec,attach})
+    def pods(self, namespace: Optional[str] = None) -> List[Dict[str, Any]]:
+        return self._member.list_pods(namespace)
+
+    def logs(self, namespace: str, pod: str,
+             tail: Optional[int] = None) -> List[str]:
+        return self._member.pod_logs(namespace, pod, tail=tail)
+
+    def exec(self, namespace: str, pod: str, command: List[str]) -> tuple:
+        return self._member.pod_exec(namespace, pod, command)

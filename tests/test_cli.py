@@ -340,3 +340,161 @@ def test_top_pods(tmp_path, capsys):
     assert run(tmp_path, "top", "pods", "web", "-n", "default") == 0
     out = capsys.readouterr().out
     assert "CLUSTER" in out and "m1" in out and "CPU" in out
+
+
+def test_create_refuses_overwrite(tmp_path, capsys):
+    run(tmp_path, "init")
+    assert run(tmp_path, "create", "-f", deployment_yaml(tmp_path)) == 0
+    assert "created" in capsys.readouterr().out
+    assert run(tmp_path, "create", "-f", deployment_yaml(tmp_path)) == 1
+    assert "already exists" in capsys.readouterr().err
+
+
+def test_edit_template_with_editor(tmp_path, capsys, monkeypatch):
+    run(tmp_path, "init")
+    run(tmp_path, "apply", "-f", deployment_yaml(tmp_path))
+    bump = tmp_path / "bump.py"
+    bump.write_text(
+        "import json, sys\n"
+        "p = sys.argv[1]\n"
+        "d = json.load(open(p))\n"
+        "d['spec']['replicas'] = 7\n"
+        "json.dump(d, open(p, 'w'))\n"
+    )
+    monkeypatch.setenv("EDITOR", f"python3 {bump}")
+    capsys.readouterr()
+    assert run(tmp_path, "edit", "Deployment", "web", "-n", "default") == 0
+    assert run(tmp_path, "get", "Deployment", "web", "-n", "default",
+               "-o", "json") == 0
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")]
+    assert json.loads(out[-1])["spec"]["replicas"] == 7
+
+
+def test_edit_rejects_identity_change(tmp_path, capsys, monkeypatch):
+    run(tmp_path, "init")
+    run(tmp_path, "apply", "-f", deployment_yaml(tmp_path))
+    rename = tmp_path / "rename.py"
+    rename.write_text(
+        "import json, sys\n"
+        "p = sys.argv[1]\n"
+        "d = json.load(open(p))\n"
+        "d['metadata']['name'] = 'other'\n"
+        "json.dump(d, open(p, 'w'))\n"
+    )
+    monkeypatch.setenv("EDITOR", f"python3 {rename}")
+    assert run(tmp_path, "edit", "Deployment", "web", "-n", "default") == 1
+    assert "cannot change" in capsys.readouterr().err
+
+
+def _propagate_web(tmp_path):
+    """init + join m1 + duplicate-propagate the web deployment, ticked to
+    ready so the member synthesizes pods."""
+    run(tmp_path, "init")
+    run(tmp_path, "join", "m1")
+    run(tmp_path, "apply", "-f", deployment_yaml(tmp_path))
+    from karmada_tpu.cli import _load_plane
+    from karmada_tpu.models.meta import ObjectMeta
+    from karmada_tpu.models.policy import (
+        Placement, PropagationPolicy, PropagationSpec, ResourceSelector)
+
+    cp = _load_plane(str(tmp_path / "plane"))
+    cp.apply_policy(PropagationPolicy(
+        metadata=ObjectMeta(namespace="default", name="pp"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(
+                api_version="apps/v1", kind="Deployment", name="web")],
+            placement=Placement())))
+    cp.tick()
+    cp.checkpoint()
+
+
+def test_logs_through_cluster_proxy(tmp_path, capsys):
+    _propagate_web(tmp_path)
+    capsys.readouterr()
+    assert run(tmp_path, "logs", "web-0", "--cluster", "m1",
+               "-n", "default") == 0
+    out = capsys.readouterr().out
+    assert "web-0 started on m1" in out
+    assert "created" in out
+    # the lifecycle journal recorded the readiness transition
+    assert "readyReplicas" in out
+    # --tail bounds the stream
+    assert run(tmp_path, "logs", "web-0", "--cluster", "m1",
+               "-n", "default", "--tail", "1") == 0
+    assert len(capsys.readouterr().out.splitlines()) == 1
+    # unknown pod is an error, not an empty stream
+    assert run(tmp_path, "logs", "nope-0", "--cluster", "m1",
+               "-n", "default") == 1
+
+
+def test_exec_and_attach_through_cluster_proxy(tmp_path, capsys):
+    _propagate_web(tmp_path)
+    capsys.readouterr()
+    assert run(tmp_path, "exec", "web-0", "--cluster", "m1",
+               "-n", "default", "--", "hostname") == 0
+    assert capsys.readouterr().out.strip() == "web-0"
+    assert run(tmp_path, "exec", "web-0", "--cluster", "m1",
+               "-n", "default", "--", "env") == 0
+    out = capsys.readouterr().out
+    assert "KARMADA_CLUSTER=m1" in out and "WORKLOAD=Deployment/web" in out
+    assert run(tmp_path, "attach", "web-0", "--cluster", "m1",
+               "-n", "default") == 0
+    assert "attached to web-0 in m1" in capsys.readouterr().out
+
+
+def test_get_pods_through_cluster_proxy(tmp_path, capsys):
+    _propagate_web(tmp_path)
+    capsys.readouterr()
+    assert run(tmp_path, "get", "Pod", "--cluster", "m1") == 0
+    out = capsys.readouterr().out
+    assert "web-0" in out and "Deployment/web" in out and "OWNER" in out
+    assert run(tmp_path, "get", "Pod", "web-1", "--cluster", "m1",
+               "-o", "json") == 0
+    got = json.loads(capsys.readouterr().out.strip())
+    assert got == {"name": "web-1", "namespace": "default",
+                   "owner": "Deployment/web", "ready": True}
+
+
+def test_logs_tail_zero_is_empty(tmp_path, capsys):
+    _propagate_web(tmp_path)
+    capsys.readouterr()
+    assert run(tmp_path, "logs", "web-0", "--cluster", "m1",
+               "-n", "default", "--tail", "0") == 0
+    assert capsys.readouterr().out == ""
+
+
+def test_get_named_standalone_pod_shows_manifest(tmp_path, capsys):
+    _propagate_web(tmp_path)
+    from karmada_tpu.cli import _load_plane
+    from karmada_tpu.models.meta import ObjectMeta
+    from karmada_tpu.models.policy import (
+        Placement, PropagationPolicy, PropagationSpec, ResourceSelector)
+
+    # propagate a standalone Pod so the member rehydrates it from Works
+    cp = _load_plane(str(tmp_path / "plane"))
+    cp.apply({"apiVersion": "v1", "kind": "Pod",
+              "metadata": {"namespace": "default", "name": "solo"},
+              "spec": {"containers": [{"name": "c"}]}})
+    cp.apply_policy(PropagationPolicy(
+        metadata=ObjectMeta(namespace="default", name="pp-pod"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(
+                api_version="v1", kind="Pod", name="solo")],
+            placement=Placement())))
+    cp.tick()
+    cp.checkpoint()
+    capsys.readouterr()
+    # a real Pod object answers with its full manifest, not the summary
+    assert run(tmp_path, "get", "Pod", "solo", "--cluster", "m1",
+               "-n", "default", "-o", "json") == 0
+    got = json.loads(capsys.readouterr().out.strip())
+    assert got["spec"] == {"containers": [{"name": "c"}]}
+    # tail larger than the stream returns everything (kubectl semantics)
+    assert run(tmp_path, "logs", "web-0", "--cluster", "m1",
+               "-n", "default", "--tail", "999") == 0
+    assert "web-0 started on m1" in capsys.readouterr().out
+    # not-found errors print clean text, no KeyError repr quotes
+    assert run(tmp_path, "logs", "nope-0", "--cluster", "m1",
+               "-n", "default") == 1
+    err = capsys.readouterr().err
+    assert err.startswith("pod default/nope-0 not found")
