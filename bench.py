@@ -94,6 +94,133 @@ def force_cpu_fallback() -> None:
 
     force_cpu()
 
+
+# -- watchdog ----------------------------------------------------------------
+# The probe bounds backend *init* hangs, but the tunnel can also stall
+# MID-RUN (observed this round: probe ok in 0.2 s, then a dispatch blocked
+# forever on the relay socket).  A hung XLA call cannot be interrupted
+# in-process, so by default main() re-executes itself as an --inner child
+# that emits heartbeat lines on stderr at every phase boundary and chunk.
+# "Progress" is child output OR CPU time advancing anywhere in the child's
+# process group (local XLA compiles are silent but burn CPU; a relay hang
+# is silent AND idle).  The parent only intervenes after
+# `--no-progress-timeout` seconds of neither, then falls back to a
+# loudly-labelled CPU run recording why.
+
+_HB_ON = False
+
+
+def _hb(msg: str) -> None:
+    if _HB_ON:
+        print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _pgroup_cpu_s(pgid: int) -> float:
+    """Total utime+stime (seconds) of every process in a process group —
+    the probe runs as a grandchild, so walk /proc rather than just the
+    child pid."""
+    total = 0.0
+    tick = os.sysconf("SC_CLK_TCK")
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                parts = f.read().rsplit(") ", 1)[-1].split()
+            # fields after comm: state=0, ppid=1, pgrp=2, ..., utime=11, stime=12
+            if int(parts[2]) == pgid:
+                total += (int(parts[11]) + int(parts[12])) / tick
+        except (OSError, IndexError, ValueError):
+            continue  # raced with process exit
+    return total
+
+
+def run_with_watchdog(argv, no_progress_timeout: float) -> int:
+    import threading
+
+    cmd = [sys.executable, os.path.abspath(__file__), *argv, "--inner"]
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, start_new_session=True,  # own process group: kill takes
+    )                                       # hung XLA/relay threads with it
+    last_progress = [time.monotonic()]
+    stdout_lines: list = []
+
+    def drain(stream, sink) -> None:
+        for line in stream:
+            last_progress[0] = time.monotonic()
+            if sink is not None:
+                sink.append(line)
+            else:
+                sys.stderr.write(line)
+                sys.stderr.flush()
+
+    threads = [
+        threading.Thread(target=drain, args=(proc.stdout, stdout_lines), daemon=True),
+        threading.Thread(target=drain, args=(proc.stderr, None), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    hung = False
+    cpu_seen = 0.0
+    while proc.poll() is None:
+        cpu_now = _pgroup_cpu_s(proc.pid)
+        if cpu_now > cpu_seen + 0.5:  # compiling/solving counts as progress
+            cpu_seen = cpu_now
+            last_progress[0] = time.monotonic()
+        idle = time.monotonic() - last_progress[0]
+        if idle > no_progress_timeout:
+            hung = True
+            import signal
+
+            print(f"[bench] no output and no CPU for {idle:.0f}s: killing "
+                  "the device attempt, falling back to CPU",
+                  file=sys.stderr, flush=True)
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                proc.kill()
+            break
+        time.sleep(2.0)
+    proc.wait()
+    for t in threads:
+        t.join(timeout=5.0)
+
+    result_line = next(
+        (ln for ln in reversed(stdout_lines) if ln.strip().startswith("{")), None)
+    if result_line is not None:
+        # even a killed child may have printed a completed result first
+        # (hang during teardown) — a real measurement always wins
+        sys.stdout.write(result_line)
+        sys.stdout.flush()
+        return 0 if hung else (proc.returncode or 0)
+
+    # device attempt hung (or died without a result): CPU fallback, marked
+    why = (f"device attempt hung ({no_progress_timeout:.0f}s without progress)"
+           if hung else
+           f"device attempt died rc={proc.returncode} without a result")
+    fb = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), *argv,
+         "--inner", "--force-cpu"],
+        stdout=subprocess.PIPE, text=True,
+    )
+    fb_line = next(
+        (ln for ln in reversed((fb.stdout or "").splitlines())
+         if ln.strip().startswith("{")), None)
+    if fb_line is None:
+        print(json.dumps({"metric": "bench failed", "value": 0,
+                          "unit": "bindings/s", "vs_baseline": 0,
+                          "detail": {"error": why,
+                                     "fallback_rc": fb.returncode}}))
+        return 1
+    try:
+        payload = json.loads(fb_line)
+        payload.setdefault("detail", {})["tpu_attempt"] = why
+        print(json.dumps(payload))
+    except json.JSONDecodeError:
+        sys.stdout.write(fb_line + "\n")
+    return fb.returncode or 0
+
 from karmada_tpu.estimator.general import GeneralEstimator
 from karmada_tpu.models.cluster import (
     APIEnablement,
@@ -296,6 +423,7 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
                                 schedule_step=sm.STEP_DECODE)
         chunk_lat.append(encode_span + (time.perf_counter() - t1))
         chunk_wall.append(time.perf_counter() - tc)
+        _hb(f"chunk {len(chunk_wall)} finalized ({len(part)} bindings)")
 
     for lo in range(0, n, chunk):
         tc = time.perf_counter()
@@ -388,10 +516,22 @@ def main() -> None:
     ap.add_argument("--probe-timeout", type=float, default=330.0)
     ap.add_argument("--waves", type=int, default=8,
                     help="capacity-contention waves per solver chunk")
+    ap.add_argument("--inner", action="store_true",
+                    help="run the bench in this process (no watchdog parent)")
+    ap.add_argument("--no-progress-timeout", type=float, default=600.0,
+                    help="watchdog: kill the device attempt after this many "
+                         "seconds with neither output nor CPU activity, "
+                         "then CPU-fallback")
     args = ap.parse_args()
     if args.quick:
         args.bindings, args.clusters, args.chunk = 2048, 256, 1024
         args.serial_sample = 32
+
+    if not args.inner and not args.force_cpu:
+        argv = [a for a in sys.argv[1:]]  # replayed verbatim into the child
+        raise SystemExit(run_with_watchdog(argv, args.no_progress_timeout))
+    global _HB_ON
+    _HB_ON = args.inner
 
     # backend bring-up (before any backend init in this process)
     enable_persistent_compile_cache()
@@ -408,6 +548,7 @@ def main() -> None:
             force_cpu_fallback()
             platform = "cpu (fallback: device probe failed)"
     on_tpu = probe["ok"] and "tpu" in str(platform).lower()
+    _hb(f"probe done: platform={platform}")
 
     rng = random.Random(0)
     clusters = build_fleet(rng, args.clusters)
@@ -418,6 +559,7 @@ def main() -> None:
 
     try:
         # warmup: compile every chunk shape once (full chunk + any tail shape)
+        _hb("compile warmup starting")
         t_compile = time.perf_counter()
         cache = tensors.EncoderCache()
         run_batched(items[: min(args.chunk, len(items))], cindex, estimator,
@@ -427,11 +569,13 @@ def main() -> None:
             run_batched(items[:tail], cindex, estimator, args.chunk, cache,
                         waves=args.waves)
         compile_s = time.perf_counter() - t_compile
+        _hb(f"compile warmup done in {compile_s:.1f}s; timed run starting")
 
         (elapsed, solve_s, scheduled, chunk_lat, chunk_wall,
          failures) = run_batched(
             items, cindex, estimator, args.chunk, cache, waves=args.waves)
         throughput = args.bindings / elapsed
+        _hb(f"timed run done: {throughput:.1f} bindings/s")
 
         # descheduler rebalance loop (BASELINE config 5, second half):
         # one chunk of previously-scheduled bindings re-assigned with prev
@@ -443,6 +587,7 @@ def main() -> None:
             reb_items, cindex, estimator, args.chunk, cache, waves=args.waves)
         rebalance_bps = len(reb_items) / reb_elapsed if reb_elapsed > 0 else 0.0
 
+        _hb("serial controls starting")
         # serial control: prefer the C++ control (Go-equivalent); it is fast
         # enough to run a much larger sample than the Python port
         native_sample = items[:: max(1, len(items) // (args.serial_sample * 32))][

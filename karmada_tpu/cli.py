@@ -121,7 +121,9 @@ def cmd_get(args) -> int:
         handle = _proxy_handle(cp, args.cluster)
         if handle is None:
             return 1
-        if args.kind in ("Pod", "pods") and not (
+        if args.kind == "pods":  # kubectl-style lowercase alias
+            args.kind = "Pod"
+        if args.kind == "Pod" and not (
                 args.name and handle.get("Pod", args.namespace, args.name)):
             # the member's synthesized pod plane (admitted replicas) — what
             # `kubectl get pods` shows.  A name naming a real standalone Pod

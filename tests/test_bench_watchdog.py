@@ -1,0 +1,86 @@
+"""bench.py watchdog: hang detection, fallback, and result preservation.
+
+The device tunnel can stall mid-run (observed: probe ok, then a dispatch
+blocked forever on the relay socket).  These tests drive
+bench.run_with_watchdog against stub children so no backend is touched.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+STUB = """
+import os, sys, time, json
+mode = os.environ.get("WD_MODE")
+if "--force-cpu" in sys.argv:
+    print(json.dumps({"metric": "CPU-FALLBACK (NOT TPU) x", "value": 1,
+                      "unit": "b/s", "vs_baseline": 0, "detail": {}}))
+    sys.exit(0)
+if mode == "result_then_hang":
+    print("[bench] working", file=sys.stderr, flush=True)
+    print(json.dumps({"metric": "tpu x", "value": 42, "unit": "b/s",
+                      "vs_baseline": 9.0, "detail": {"platform": "tpu"}}),
+          flush=True)
+    time.sleep(1000)   # teardown hang: cpu-idle, silent
+elif mode == "clean":
+    print(json.dumps({"metric": "tpu x", "value": 7, "unit": "b/s",
+                      "vs_baseline": 2.0, "detail": {}}))
+elif mode == "silent_hang":
+    time.sleep(1000)
+"""
+
+
+@pytest.fixture
+def stub_bench(tmp_path, monkeypatch):
+    stub = tmp_path / "stub_bench.py"
+    stub.write_text(STUB)
+    real_abspath = os.path.abspath
+    monkeypatch.setattr(
+        os.path, "abspath",
+        lambda p: str(stub) if str(p).endswith("bench.py") else real_abspath(p),
+    )
+
+    def run(mode, timeout=4.0):
+        monkeypatch.setenv("WD_MODE", mode)
+        return bench.run_with_watchdog([], timeout)
+    return run
+
+
+def test_clean_child_result_passes_through(stub_bench, capfd):
+    rc = stub_bench("clean")
+    assert rc == 0
+    out = capfd.readouterr().out
+    assert json.loads(out.splitlines()[-1])["value"] == 7
+
+
+def test_completed_result_survives_teardown_hang(stub_bench, capfd):
+    """A child that prints the result and then hangs in teardown is killed,
+    but its real measurement is kept — never replaced by a CPU re-run."""
+    rc = stub_bench("result_then_hang")
+    assert rc == 0
+    d = json.loads(capfd.readouterr().out.splitlines()[-1])
+    assert d["value"] == 42 and d["detail"]["platform"] == "tpu"
+
+
+def test_silent_hang_falls_back_loudly(stub_bench, capfd):
+    rc = stub_bench("silent_hang")
+    assert rc == 0
+    out, err = capfd.readouterr()
+    d = json.loads(out.splitlines()[-1])
+    assert d["metric"].startswith("CPU-FALLBACK")
+    assert "hung" in d["detail"]["tpu_attempt"]
+    assert "killing the device attempt" in err
+
+
+def test_pgroup_cpu_accounting_sees_own_group():
+    pg = os.getpgid(0)
+    c0 = bench._pgroup_cpu_s(pg)
+    x = 0
+    for i in range(10**7):
+        x += i
+    assert bench._pgroup_cpu_s(pg) > c0

@@ -498,3 +498,10 @@ def test_get_named_standalone_pod_shows_manifest(tmp_path, capsys):
                "-n", "default") == 1
     err = capsys.readouterr().err
     assert err.startswith("pod default/nope-0 not found")
+
+
+def test_get_pods_lowercase_alias(tmp_path, capsys):
+    _propagate_web(tmp_path)
+    capsys.readouterr()
+    assert run(tmp_path, "get", "pods", "--cluster", "m1") == 0
+    assert "web-0" in capsys.readouterr().out
