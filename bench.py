@@ -23,7 +23,7 @@ import subprocess
 import sys
 import time
 
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -135,6 +135,19 @@ def _pgroup_cpu_s(pgid: int) -> float:
     return total
 
 
+def _last_json_line(lines) -> Optional[str]:
+    """Newest stdout line that parses as a JSON object — the ONE-line
+    result contract (a SIGKILL can truncate a partially-flushed line)."""
+    for ln in reversed(list(lines)):
+        if ln.strip().startswith("{"):
+            try:
+                json.loads(ln)
+                return ln
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
 def run_with_watchdog(argv, no_progress_timeout: float) -> int:
     import threading
 
@@ -165,7 +178,11 @@ def run_with_watchdog(argv, no_progress_timeout: float) -> int:
     cpu_seen = 0.0
     while proc.poll() is None:
         cpu_now = _pgroup_cpu_s(proc.pid)
-        if cpu_now > cpu_seen + 0.5:  # compiling/solving counts as progress
+        # any change counts as progress: an increase is compile/solve work,
+        # a DROP means a subprocess (e.g. the probe) exited — also activity,
+        # and the baseline must follow it down or the child gets no CPU
+        # credit until it re-exceeds the departed process's accrued time
+        if abs(cpu_now - cpu_seen) > 0.5:
             cpu_seen = cpu_now
             last_progress[0] = time.monotonic()
         idle = time.monotonic() - last_progress[0]
@@ -186,8 +203,7 @@ def run_with_watchdog(argv, no_progress_timeout: float) -> int:
     for t in threads:
         t.join(timeout=5.0)
 
-    result_line = next(
-        (ln for ln in reversed(stdout_lines) if ln.strip().startswith("{")), None)
+    result_line = _last_json_line(stdout_lines)
     if result_line is not None:
         # even a killed child may have printed a completed result first
         # (hang during teardown) — a real measurement always wins
@@ -204,21 +220,16 @@ def run_with_watchdog(argv, no_progress_timeout: float) -> int:
          "--inner", "--force-cpu"],
         stdout=subprocess.PIPE, text=True,
     )
-    fb_line = next(
-        (ln for ln in reversed((fb.stdout or "").splitlines())
-         if ln.strip().startswith("{")), None)
+    fb_line = _last_json_line((fb.stdout or "").splitlines())
     if fb_line is None:
         print(json.dumps({"metric": "bench failed", "value": 0,
                           "unit": "bindings/s", "vs_baseline": 0,
                           "detail": {"error": why,
                                      "fallback_rc": fb.returncode}}))
         return 1
-    try:
-        payload = json.loads(fb_line)
-        payload.setdefault("detail", {})["tpu_attempt"] = why
-        print(json.dumps(payload))
-    except json.JSONDecodeError:
-        sys.stdout.write(fb_line + "\n")
+    payload = json.loads(fb_line)  # pre-validated by _last_json_line
+    payload.setdefault("detail", {})["tpu_attempt"] = why
+    print(json.dumps(payload))
     return fb.returncode or 0
 
 from karmada_tpu.estimator.general import GeneralEstimator

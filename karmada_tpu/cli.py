@@ -117,12 +117,12 @@ def _print_table(rows, headers) -> None:
 
 def cmd_get(args) -> int:
     cp = _load_plane(args.dir)
+    if args.kind == "pods":  # kubectl-style lowercase alias
+        args.kind = "Pod"
     if args.cluster:
         handle = _proxy_handle(cp, args.cluster)
         if handle is None:
             return 1
-        if args.kind == "pods":  # kubectl-style lowercase alias
-            args.kind = "Pod"
         if args.kind == "Pod" and not (
                 args.name and handle.get("Pod", args.namespace, args.name)):
             # the member's synthesized pod plane (admitted replicas) — what

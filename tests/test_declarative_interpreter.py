@@ -313,3 +313,150 @@ def test_thirdparty_volcano_aggregate_status():
     merged = interp.aggregate_status(volcano, items)
     assert merged["status"]["running"] == 5
     assert merged["status"]["state"]["phase"] == "Running"
+
+
+def test_thirdparty_kruise_family():
+    """Round-3 bundle completion: the remaining Kruise kinds
+    (default/thirdparty/resourcecustomizations/apps.kruise.io/)."""
+    interp = ResourceInterpreter()
+
+    ds = {"apiVersion": "apps.kruise.io/v1alpha1", "kind": "DaemonSet",
+          "metadata": {"namespace": "d", "name": "ds", "generation": 2},
+          "status": {"observedGeneration": 2, "desiredNumberScheduled": 3,
+                     "updatedNumberScheduled": 3, "numberAvailable": 3,
+                     "numberReady": 3, "currentNumberScheduled": 3}}
+    assert interp.get_replicas(ds)[0] == 0  # not divisible
+    assert interp.interpret_health(ds) == "Healthy"
+    ds["status"]["numberAvailable"] = 1  # rollout not available yet
+    assert interp.interpret_health(ds) == "Unhealthy"
+
+    ss = {"apiVersion": "apps.kruise.io/v1alpha1", "kind": "SidecarSet",
+          "metadata": {"namespace": "d", "name": "ss"},
+          "status": {"matchedPods": 0}}
+    assert interp.interpret_health(ss) == "Healthy"  # nothing to update
+    ss["status"] = {"matchedPods": 4, "updatedPods": 2}
+    assert interp.interpret_health(ss) == "Unhealthy"
+
+    ud = {"apiVersion": "apps.kruise.io/v1alpha1", "kind": "UnitedDeployment",
+          "metadata": {"namespace": "d", "name": "ud", "generation": 1},
+          "spec": {"replicas": 6, "template": {"statefulSetTemplate": {
+              "spec": {"template": {"spec": {"containers": [
+                  {"name": "c",
+                   "resources": {"requests": {"cpu": "500m"}}}]}}}}}},
+          "status": {"observedGeneration": 1, "updatedReplicas": 6}}
+    replicas, req = interp.get_replicas(ud)
+    assert replicas == 6 and req.resource_request["cpu"].milli == 500
+    revised = interp.revise_replica(ud, 2)
+    assert revised["spec"]["replicas"] == 2
+    assert interp.interpret_health(ud) == "Healthy"
+
+    bj = {"apiVersion": "apps.kruise.io/v1alpha1", "kind": "BroadcastJob",
+          "metadata": {"namespace": "d", "name": "bj"},
+          "spec": {"parallelism": 5},
+          "status": {"desired": 5, "active": 5, "failed": 0, "succeeded": 0}}
+    assert interp.get_replicas(bj)[0] == 5
+    assert interp.revise_replica(bj, 2)["spec"]["parallelism"] == 2
+    assert interp.interpret_health(bj) == "Healthy"
+    bj["status"]["failed"] = 1
+    assert interp.interpret_health(bj) == "Unhealthy"
+
+    from karmada_tpu.models.work import AggregatedStatusItem
+
+    acj = {"apiVersion": "apps.kruise.io/v1alpha1", "kind": "AdvancedCronJob",
+           "metadata": {"namespace": "d", "name": "acj"}}
+    merged = interp.aggregate_status(acj, [
+        AggregatedStatusItem(cluster_name="m1", status={
+            "active": [{"name": "j1"}], "lastScheduleTime": "t1"}),
+        AggregatedStatusItem(cluster_name="m2", status={
+            "active": [{"name": "j2"}], "lastScheduleTime": "t2"}),
+    ])
+    assert len(merged["status"]["active"]) == 2
+    assert merged["status"]["lastScheduleTime"] == "t2"
+
+
+def test_thirdparty_workflow_and_notebook():
+    interp = ResourceInterpreter()
+
+    wf = {"apiVersion": "argoproj.io/v1alpha1", "kind": "Workflow",
+          "metadata": {"namespace": "d", "name": "wf"},
+          "spec": {"parallelism": 4}, "status": {"phase": "Running"}}
+    assert interp.get_replicas(wf)[0] == 4
+    assert interp.revise_replica(wf, 2)["spec"]["parallelism"] == 2
+    assert interp.interpret_health(wf) == "Healthy"
+    wf["status"]["phase"] = "Failed"
+    assert interp.interpret_health(wf) == "Unhealthy"
+
+    nb = {"apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+          "metadata": {"namespace": "d", "name": "nb"},
+          "spec": {"template": {"spec": {"containers": [
+              {"name": "c", "resources": {"requests": {"memory": "1Gi"}}}]}}},
+          "status": {"containerState": {
+              "waiting": {"reason": "ContainerCreating"}}}}
+    assert interp.get_replicas(nb)[0] == 1
+    assert interp.interpret_health(nb) == "Healthy"  # still creating
+    nb["status"]["containerState"] = {"waiting": {"reason": "CrashLoopBackOff"}}
+    assert interp.interpret_health(nb) == "Unhealthy"
+    nb["status"]["containerState"] = {"running": {"startedAt": "t"}}
+    assert interp.interpret_health(nb) == "Healthy"
+
+
+def test_thirdparty_mpijob_components_and_revise():
+    interp = ResourceInterpreter()
+    mpi = {"apiVersion": "kubeflow.org/v2beta1", "kind": "MPIJob",
+           "metadata": {"namespace": "d", "name": "mpi"},
+           "spec": {"mpiReplicaSpecs": {"Launcher": {"replicas": 1},
+                                        "Worker": {"replicas": 4}}},
+           "status": {"conditions": [{"type": "Running", "status": "True"}]}}
+    assert interp.get_replicas(mpi)[0] == 5
+    comps = {c.name: c.replicas for c in interp.get_components(mpi)}
+    assert comps == {"Launcher": 1, "Worker": 4}
+    revised = interp.revise_replica(mpi, 3)
+    assert revised["spec"]["mpiReplicaSpecs"]["Worker"]["replicas"] == 2
+    assert interp.get_replicas(revised)[0] == 3
+    assert interp.interpret_health(mpi) == "Healthy"
+    mpi["status"]["conditions"].append({"type": "Failed", "status": "True"})
+    assert interp.interpret_health(mpi) == "Unhealthy"
+
+
+def test_thirdparty_gitops_and_policy_kinds():
+    """Flux kustomize/source kinds gate health on the Ready condition's
+    REASON, not just its status; Kyverno prefers the status.ready bool."""
+    interp = ResourceInterpreter()
+
+    def ready(reason):
+        return [{"type": "Ready", "status": "True", "reason": reason}]
+
+    km = {"apiVersion": "kustomize.toolkit.fluxcd.io/v1", "kind": "Kustomization",
+          "metadata": {"namespace": "d", "name": "k"},
+          "status": {"conditions": ready("ReconciliationSucceeded")}}
+    assert interp.interpret_health(km) == "Healthy"
+    km["status"]["conditions"] = ready("Progressing")
+    assert interp.interpret_health(km) == "Unhealthy"
+
+    for api, kind, reason in (
+        ("source.toolkit.fluxcd.io/v1", "GitRepository", "Succeeded"),
+        ("source.toolkit.fluxcd.io/v1beta2", "Bucket", "Succeeded"),
+        ("source.toolkit.fluxcd.io/v1beta2", "HelmChart", "ChartPullSucceeded"),
+        ("source.toolkit.fluxcd.io/v1beta2", "HelmRepository",
+         "IndexationSucceeded"),
+        ("source.toolkit.fluxcd.io/v1beta2", "OCIRepository", "Succeeded"),
+    ):
+        obj = {"apiVersion": api, "kind": kind,
+               "metadata": {"namespace": "d", "name": "x"},
+               "status": {"conditions": ready(reason)}}
+        assert interp.interpret_health(obj) == "Healthy", kind
+        assert interp.get_replicas(obj)[0] == 0
+        obj["status"]["conditions"] = ready("FetchFailed")
+        assert interp.interpret_health(obj) == "Unhealthy", kind
+
+    for kind in ("ClusterPolicy", "Policy"):
+        pol = {"apiVersion": "kyverno.io/v1", "kind": kind,
+               "metadata": {"namespace": "d", "name": "p"},
+               "status": {"ready": True}}
+        assert interp.interpret_health(pol) == "Healthy", kind
+        pol["status"] = {"ready": False,
+                         "conditions": ready("Succeeded")}
+        # explicit ready: false wins over a stale Ready condition
+        assert interp.interpret_health(pol) == "Unhealthy", kind
+        pol["status"] = {"conditions": ready("Succeeded")}
+        assert interp.interpret_health(pol) == "Healthy", kind
