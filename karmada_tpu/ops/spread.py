@@ -14,11 +14,15 @@ construction.  Placements with provider/zone spread, spread-by-label, or
 more than MAX_DEVICE_REGIONS regions route to the full serial host path.
 
 Flow (ops.spread.solve_spread):
-  phase A (device)  group tensors per binding: score/avail/value [B_s, G]
+  phase A (device)  group scalars per binding: score/avail/value [B_s, G]
   host              serial.select_groups over G scalars -> chosen regions
-  phase B (device)  cluster pick inside chosen regions -> feasible mask
-  main kernel       solver.schedule_batch with that mask as the placement
-                    row (spread disabled) -> replica assignment
+  phase B (device)  ONE fused jit: cluster pick inside chosen regions ->
+                    placement mask -> solver._schedule_core assignment ->
+                    compact COO extraction.  Only [B, G] scalars and the
+                    compact result ever cross the device boundary — a
+                    remote-attached backend ships every jit output to the
+                    host, so plane-sized outputs are the cost (see
+                    solver.schedule_compact).
 """
 
 from __future__ import annotations
@@ -36,6 +40,8 @@ from karmada_tpu.ops.solver import (
     MAX_INT32,
     _AVAIL_CAP,
     _capacity_estimates,
+    _compact_of,
+    _schedule_core,
 )
 
 WEIGHT_UNIT = serial.WEIGHT_UNIT  # 1000 (group_clusters.go:139)
@@ -123,22 +129,15 @@ _group_info_vmap = jax.vmap(
 )
 
 
-@partial(jax.jit, static_argnames=("G",))
-def spread_group_info(
-    # cluster axis
-    cluster_valid, deleting, name_rank, pods_allowed, has_summary,
-    avail_milli, has_alloc, api_ok, region_id,
-    # request classes
-    req_milli, req_is_cpu, req_pods, est_override,
-    # placement rows
-    pl_mask, pl_tol_bypass,
-    # per spread-binding rows
-    placement_id, gvk_id, class_id, replicas, region_min, cluster_min,
-    duplicated, nw_shortcut, prev_idx, prev_val, evict_idx,
-    *, G: int,
+def _spread_planes(
+    cluster_valid, deleting, pods_allowed, has_summary, avail_milli,
+    has_alloc, api_ok, req_milli, req_is_cpu, req_pods, est_override,
+    pl_mask, pl_tol_bypass, placement_id, gvk_id, class_id, replicas,
+    nw_shortcut, prev_idx, prev_val, evict_idx,
 ):
-    """Phase A: per-binding region-group tensors + the per-binding cluster
-    sort order and feasible/availability planes phase B reuses."""
+    """The [B, C] feasibility/availability/score planes both phases need.
+    Traced INSIDE each phase's jit (phase B recomputes them rather than
+    shipping ~600 MB of plane outputs over the host link)."""
     B = placement_id.shape[0]
     C = cluster_valid.shape[0]
     Q = req_milli.shape[0]
@@ -185,12 +184,36 @@ def spread_group_info(
     # group availability includes already-assigned replicas
     # (group_clusters_with_score: tc.replicas + assigned)
     avail_sel = avail_cal + prev_rep * prev_present
+    return feasible, avail_sel, score
 
-    score_g, avail_g, value_g, order = _group_info_vmap(
+
+@partial(jax.jit, static_argnames=("G",))
+def spread_group_info(
+    # cluster axis
+    cluster_valid, deleting, name_rank, pods_allowed, has_summary,
+    avail_milli, has_alloc, api_ok, region_id,
+    # request classes
+    req_milli, req_is_cpu, req_pods, est_override,
+    # placement rows
+    pl_mask, pl_tol_bypass,
+    # per spread-binding rows
+    placement_id, gvk_id, class_id, replicas, region_min, cluster_min,
+    duplicated, nw_shortcut, prev_idx, prev_val, evict_idx,
+    *, G: int,
+):
+    """Phase A: per-binding region-group scalars [B, G] + a feasibility
+    flag [B] — the ONLY outputs; the planes stay on device."""
+    feasible, avail_sel, score = _spread_planes(
+        cluster_valid, deleting, pods_allowed, has_summary, avail_milli,
+        has_alloc, api_ok, req_milli, req_is_cpu, req_pods, est_override,
+        pl_mask, pl_tol_bypass, placement_id, gvk_id, class_id, replicas,
+        nw_shortcut, prev_idx, prev_val, evict_idx,
+    )
+    score_g, avail_g, value_g, _order = _group_info_vmap(
         feasible, avail_sel, score, name_rank, region_id,
         replicas, region_min, cluster_min, duplicated, G,
     )
-    return score_g, avail_g, value_g, order, feasible, avail_sel, score
+    return score_g, avail_g, value_g, jnp.any(feasible, axis=1)
 
 
 def _pick_one(order, feasible, avail_sel, score, name_rank, region_id,
@@ -226,11 +249,54 @@ def _pick_one(order, feasible, avail_sel, score, name_rank, region_id,
 _pick_vmap = jax.vmap(_pick_one, in_axes=(0, 0, 0, 0, None, None, 0, 0, None))
 
 
-@partial(jax.jit, static_argnames=("G",))
-def spread_pick_clusters(order, feasible, avail_sel, score, name_rank,
-                         region_id, chosen, cluster_max, *, G: int):
-    return _pick_vmap(order, feasible, avail_sel, score, name_rank,
-                      region_id, chosen, cluster_max, G)
+@partial(jax.jit, static_argnames=("G", "waves", "max_nnz"))
+def spread_assign_compact(
+    # cluster axis
+    cluster_valid, deleting, name_rank, pods_allowed, has_summary,
+    avail_milli, has_alloc, api_ok, region_id,
+    # request classes
+    req_milli, req_is_cpu, req_pods, est_override,
+    # placement rows
+    pl_mask, pl_tol_bypass,
+    # per live-binding rows
+    placement_id, gvk_id, class_id, replicas, nw_shortcut,
+    prev_idx, prev_val, evict_idx,
+    chosen, cluster_max,
+    strategy, static_w, ignore_avail, uid_desc, fresh, non_workload, b_valid,
+    *, G: int, waves: int, max_nnz: int,
+):
+    """Phase B + assignment, FUSED: recompute the planes, pick clusters in
+    the chosen regions, and run the main assignment kernel with the pick as
+    the placement mask — one jit whose only outputs are the compact COO
+    result (the per-binding [B, C] pick mask never leaves the device)."""
+    B = placement_id.shape[0]
+    C = cluster_valid.shape[0]
+    feasible, avail_sel, score = _spread_planes(
+        cluster_valid, deleting, pods_allowed, has_summary, avail_milli,
+        has_alloc, api_ok, req_milli, req_is_cpu, req_pods, est_override,
+        pl_mask, pl_tol_bypass, placement_id, gvk_id, class_id, replicas,
+        nw_shortcut, prev_idx, prev_val, evict_idx,
+    )
+    key = _sort_key(score, avail_sel, name_rank[None, :], feasible)
+    order = jnp.argsort(key, axis=1)
+    sel = _pick_vmap(order, feasible, avail_sel, score, name_rank,
+                     region_id, chosen, cluster_max, G)
+    rep, selected, status = _schedule_core(
+        cluster_valid, deleting, name_rank, pods_allowed, has_summary,
+        avail_milli, has_alloc, api_ok,
+        req_milli, req_is_cpu, req_pods, est_override,
+        sel,                             # pl_mask: row i is binding i's pick
+        jnp.ones((B, C), bool),          # tolerations folded into the pick
+        strategy, static_w,
+        jnp.zeros((B,), bool),           # cluster spread consumed by the pick
+        jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
+        ignore_avail,
+        b_valid, jnp.arange(B, dtype=jnp.int32), gvk_id, class_id,
+        replicas, uid_desc, fresh, non_workload, nw_shortcut,
+        prev_idx, prev_val, evict_idx,
+        waves=waves,
+    )
+    return _compact_of(rep, selected, status, max_nnz)
 
 
 def solve_spread(
@@ -245,9 +311,7 @@ def solve_spread(
     Returns {binding_index: List[TargetCluster] | Exception} in the same
     result vocabulary as tensors.decode_* (serial error classes).
     """
-    from karmada_tpu.models.work import TargetCluster
     from karmada_tpu.ops import tensors as T
-    from karmada_tpu.ops.solver import schedule_batch
 
     if not len(spread_idx):
         return {}
@@ -267,32 +331,30 @@ def solve_spread(
     cluster_min = batch.pl_sc_min[pid]
     cluster_max = np.where(batch.pl_has_cluster_sc[pid], batch.pl_sc_max[pid], 0)
 
-    score_g, avail_g, value_g, order, feasible, avail_sel, score = (
-        spread_group_info(
-            batch.cluster_valid, batch.deleting, batch.name_rank,
-            batch.pods_allowed, batch.has_summary, batch.avail_milli,
-            batch.has_alloc, batch.api_ok, batch.region_id,
-            batch.req_milli, batch.req_is_cpu, batch.req_pods,
-            batch.est_override,
-            batch.pl_mask, batch.pl_tol_bypass,
-            pid, batch.gvk_id[idx], batch.class_id[idx],
-            batch.replicas[idx], region_min, cluster_min, duplicated,
-            batch.nw_shortcut[idx],
-            batch.prev_idx[idx], batch.prev_val[idx], batch.evict_idx[idx],
-            G=G,
-        )
+    score_g, avail_g, value_g, feas_any = spread_group_info(
+        batch.cluster_valid, batch.deleting, batch.name_rank,
+        batch.pods_allowed, batch.has_summary, batch.avail_milli,
+        batch.has_alloc, batch.api_ok, batch.region_id,
+        batch.req_milli, batch.req_is_cpu, batch.req_pods,
+        batch.est_override,
+        batch.pl_mask, batch.pl_tol_bypass,
+        pid, batch.gvk_id[idx], batch.class_id[idx],
+        batch.replicas[idx], region_min, cluster_min, duplicated,
+        batch.nw_shortcut[idx],
+        batch.prev_idx[idx], batch.prev_val[idx], batch.evict_idx[idx],
+        G=G,
     )
     score_g = np.asarray(score_g)
     avail_g = np.asarray(avail_g)
     value_g = np.asarray(value_g)
-    feasible_np = np.asarray(feasible)
+    feas_any = np.asarray(feas_any)
 
     # -- host DFS over G-level scalars: serial.select_groups itself --------
     out = {}
     chosen = np.zeros((len(idx), G), bool)
     for row in range(n_spread):
         b = idx[row]
-        if not feasible_np[row].any():
+        if not feas_any[row]:
             _, diagnosis = serial.find_clusters_that_fit(
                 items[b][0], items[b][1], batch.cluster_index.clusters
             )
@@ -328,78 +390,57 @@ def solve_spread(
     live = [r for r in range(n_spread) if int(idx[r]) not in out]
     if not live:
         return out
-    # pad phase B's batch axis too (same jit-signature stability)
+    # pad the fused phase's batch axis too (same jit-signature stability)
     n_live = len(live)
-    live_np = np.asarray(live + [live[0]] * (T._next_pow2(n_live, 8) - n_live),  # noqa: SLF001
-                         np.int64)
-    sel = spread_pick_clusters(
-        np.asarray(order)[live_np], feasible_np[live_np],
-        np.asarray(avail_sel)[live_np], np.asarray(score)[live_np],
-        batch.name_rank, batch.region_id, chosen[live_np],
-        cluster_max[live_np].astype(np.int64), G=G,
-    )
-    sel = np.asarray(sel)[:n_live]
-    live_np = live_np[:n_live]
-
-    # -- assignment: the main kernel with the picked clusters as the mask --
-    Bs = T._next_pow2(len(live), 8)  # noqa: SLF001
+    Bs = T._next_pow2(n_live, 8)  # noqa: SLF001
     C = batch.C
+    live_np = np.asarray(live + [live[0]] * (Bs - n_live), np.int64)
     lidx = idx[live_np]
-    pl_mask = np.zeros((Bs, C), bool)
-    pl_mask[: len(live)] = sel
-    pad = lambda a, fill=0: np.concatenate(  # noqa: E731
-        [a, np.full((Bs - len(live),) + a.shape[1:], fill, a.dtype)]
-    )
+    lpid = pid[live_np]
     b_valid = np.zeros(Bs, bool)
-    b_valid[: len(live)] = True
-    rep, selected, status = schedule_batch(
-        batch.cluster_valid, batch.deleting, batch.name_rank,
-        batch.pods_allowed, batch.has_summary, batch.avail_milli,
-        batch.has_alloc, batch.api_ok,
-        batch.req_milli, batch.req_is_cpu, batch.req_pods, batch.est_override,
-        pl_mask,
-        np.ones((Bs, C), bool),  # tolerations already folded into the pick
-        pad(batch.pl_strategy[pid][live_np]),
-        pad(batch.pl_static_w[pid][live_np]),
-        np.zeros(Bs, bool),  # cluster spread consumed by the pick
-        np.zeros(Bs, np.int32), np.zeros(Bs, np.int32),
-        pad(batch.pl_ignore_avail[pid][live_np]),
-        b_valid,
-        np.arange(Bs, dtype=np.int32),  # placement row i belongs to binding i
-        pad(batch.gvk_id[lidx]), pad(batch.class_id[lidx], -1),
-        pad(batch.replicas[lidx]), pad(batch.uid_desc[lidx]),
-        pad(batch.fresh[lidx]), pad(batch.non_workload[lidx]),
-        pad(batch.nw_shortcut[lidx]),
-        pad(batch.prev_idx[lidx], -1), pad(batch.prev_val[lidx]),
-        pad(batch.evict_idx[lidx], -1),
-        waves=waves,
-    )
-    rep = np.asarray(rep)
-    selected = np.asarray(selected)
+    b_valid[:n_live] = True
+
+    def assign(max_nnz):
+        return spread_assign_compact(
+            batch.cluster_valid, batch.deleting, batch.name_rank,
+            batch.pods_allowed, batch.has_summary, batch.avail_milli,
+            batch.has_alloc, batch.api_ok, batch.region_id,
+            batch.req_milli, batch.req_is_cpu, batch.req_pods,
+            batch.est_override,
+            batch.pl_mask, batch.pl_tol_bypass,
+            lpid, batch.gvk_id[lidx], batch.class_id[lidx],
+            batch.replicas[lidx], batch.nw_shortcut[lidx],
+            batch.prev_idx[lidx], batch.prev_val[lidx], batch.evict_idx[lidx],
+            chosen[live_np], cluster_max[live_np].astype(np.int64),
+            batch.pl_strategy[lpid], batch.pl_static_w[lpid],
+            batch.pl_ignore_avail[lpid], batch.uid_desc[lidx],
+            batch.fresh[lidx], batch.non_workload[lidx], b_valid,
+            G=G, waves=waves, max_nnz=max_nnz,
+        )
+
+    max_nnz = min(max(Bs * 16, 1 << 12), Bs * C)
+    cidx, cval, status, nnz = assign(max_nnz)
+    while int(nnz) > max_nnz and max_nnz < Bs * C:
+        max_nnz = min(max_nnz * 4, Bs * C)
+        cidx, cval, status, nnz = assign(max_nnz)
+
+    # remap the sub-batch COO rows onto the chunk's binding axis and reuse
+    # the one shared decoder (tensors.decode_compact, incl. its native fast
+    # path).  lidx ascends (spread_idx and `live` both preserve chunk
+    # order), so the remap keeps the decoder's row-major contract.
+    cidx = np.asarray(cidx)
+    cval = np.asarray(cval)
     status = np.asarray(status)
-    names = batch.cluster_index.names
-    for row, b in enumerate(lidx):
-        err = T._status_error(batch, int(b), int(status[row]), items)  # noqa: SLF001
-        if err is not None:
-            out[int(b)] = err
-            continue
-        row_rep = rep[row]
-        targets = [
-            TargetCluster(name=names[i], replicas=int(row_rep[i]))
-            for i in np.nonzero(row_rep[: batch.n_clusters] > 0)[0]
-        ]
-        if batch.non_workload[b]:
-            targets = [
-                TargetCluster(name=names[i], replicas=0)
-                for i in np.nonzero(selected[row, : batch.n_clusters])[0]
-            ]
-        elif enable_empty_workload_propagation:
-            have = {t.name for t in targets}
-            targets += [
-                TargetCluster(name=names[i], replicas=0)
-                for i in np.nonzero(selected[row, : batch.n_clusters])[0]
-                if names[i] not in have
-            ]
-        targets.sort(key=lambda t: t.name)
-        out[int(b)] = targets
+    keep = (cidx >= 0) & (cidx // C < n_live)  # drop -1 pads and padded rows
+    rows = cidx[keep] // C
+    remapped_idx = (lidx[rows] * C + cidx[keep] % C).astype(np.int64)
+    status_full = np.zeros((batch.n_bindings,), np.int32)
+    status_full[lidx[:n_live]] = status[:n_live]
+    decoded = T.decode_compact(
+        batch, remapped_idx, cval[keep], status_full,
+        enable_empty_workload_propagation=enable_empty_workload_propagation,
+        items=items,
+    )
+    for b in lidx[:n_live]:
+        out[int(b)] = decoded[int(b)]
     return out

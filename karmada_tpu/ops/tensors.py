@@ -891,13 +891,13 @@ def decode_compact(
     non-workload propagation and empty-workload propagation.
 
     CONTRACT: idx must be ascending among its >=0 entries (row-major
-    binding order) — _compact_extract's jnp.nonzero guarantees this; any
+    binding order) — solver._compact_of's jnp.nonzero guarantees this; any
     other producer must sort first (asserted below).
     """
     names = batch.cluster_index.names
     C = batch.C
     nb = batch.n_bindings
-    # vectorized COO split: _compact_extract emits row-major (b ascending)
+    # vectorized COO split: solver._compact_of emits row-major (b ascending)
     # order, so per-binding runs are contiguous and searchsorted finds them
     idx = np.asarray(idx)
     val = np.asarray(val)
