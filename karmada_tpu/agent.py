@@ -44,16 +44,20 @@ class KarmadaAgent:
         self.member = member
         scoped = {member.name: member}
         # the same controller implementations the push plane runs, scoped
-        # to this one member — agent.go registers the identical set
-        self.execution = ExecutionController(
-            control_store, runtime, scoped, interpreter, recorder=recorder
-        )
-        self.work_status = WorkStatusController(
-            control_store, runtime, scoped, interpreter
-        )
-        self.cluster_status = ClusterStatusController(
-            control_store, runtime, scoped, recorder=recorder
-        )
+        # to this one member — agent.go registers the identical set.  The
+        # agent is its own binary in the reference with its own controller
+        # flag, so the control plane's --controllers list must not govern
+        # these registrations.
+        with runtime.ungoverned():
+            self.execution = ExecutionController(
+                control_store, runtime, scoped, interpreter, recorder=recorder
+            )
+            self.work_status = WorkStatusController(
+                control_store, runtime, scoped, interpreter
+            )
+            self.cluster_status = ClusterStatusController(
+                control_store, runtime, scoped, recorder=recorder
+            )
         self._control_store = control_store
         self._runtime = runtime
 

@@ -31,11 +31,20 @@ SIM_CAPACITY_ANNOTATION = "karmada.io/simulated-capacity"
 VERSION = "karmada-tpu v0.3"
 
 
-def _load_plane(directory: str, backend: str = "serial", waves: int = 8):
+def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
+                controllers: Optional[str] = None):
+    """controllers=None rehydrates the persisted --controllers spec; an
+    explicit spec is also persisted so later invocations honor it."""
     from karmada_tpu.e2e import ControlPlane
     from karmada_tpu.models.cluster import Cluster
 
-    cp = ControlPlane(backend=backend, persist_dir=directory, waves=waves)
+    cp = ControlPlane(backend=backend, persist_dir=directory, waves=waves,
+                      controllers=controllers)
+    if controllers is not None:
+        cp.apply({"apiVersion": "v1", "kind": "ConfigMap",
+                  "metadata": {"namespace": "karmada-system",
+                               "name": "controller-manager"},
+                  "data": {"controllers": controllers}})
     # rehydrate feature gates persisted by `addons enable/disable`
     gates_cm = cp.store.try_get("ConfigMap", "karmada-system", "feature-gates")
     if gates_cm is not None:
@@ -779,7 +788,12 @@ def cmd_deinit(args) -> int:
 
 
 def cmd_tick(args) -> int:
-    cp = _load_plane(args.dir, backend=args.backend, waves=args.waves)
+    try:
+        cp = _load_plane(args.dir, backend=args.backend, waves=args.waves,
+                         controllers=args.controllers)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
     n = cp.tick()
     cp.checkpoint()
     print(f"{n} reconciles")
@@ -792,7 +806,12 @@ def cmd_serve(args) -> int:
     scheduler / webhook processes rolled into one, Runtime.serve)."""
     import time as _time
 
-    cp = _load_plane(args.dir, backend=args.backend, waves=args.waves)
+    try:
+        cp = _load_plane(args.dir, backend=args.backend, waves=args.waves,
+                         controllers=args.controllers)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
     if args.feature_gates:
         cp.gates.set_from_string(args.feature_gates)
     cp.runtime._periodic_interval_s = args.sync_period  # noqa: SLF001
@@ -967,12 +986,19 @@ def build_parser() -> argparse.ArgumentParser:
     tk = sub.add_parser("tick")
     tk.add_argument("--backend", default="serial")
     tk.add_argument("--waves", type=int, default=8)
+    tk.add_argument("--controllers", default=None,
+                    help="enable/disable list (see serve --controllers)")
 
     sv = sub.add_parser("serve")
     sv.add_argument("--backend", choices=["serial", "native", "device"],
                     default="device")
     sv.add_argument("--feature-gates", default="",
                     help="A=true,B=false (pkg/features registry names)")
+    sv.add_argument("--controllers", default=None,
+                    help="enable/disable list: '*' all, '-name' disables, "
+                         "a bare allowlist runs only those (reference "
+                         "--controllers flag); persisted on the plane, "
+                         "omit to keep the last choice")
     sv.add_argument("--sync-period", type=float, default=0.5,
                     help="periodic resync interval seconds")
     sv.add_argument("--checkpoint-period", type=float, default=30.0,

@@ -94,7 +94,7 @@ class CertRotationController:
         self.ttl_seconds = ttl_seconds
         self.clock = clock
         self._seq = 0
-        runtime.register_periodic(self.run_once)
+        runtime.register_periodic(self.run_once, name="cert-rotation")
 
     def run_once(self) -> None:
         now = self.clock()

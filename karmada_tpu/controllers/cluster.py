@@ -34,7 +34,7 @@ class ClusterLifecycleController:
         store.bus.subscribe(self._on_event, kind=Cluster.KIND)
         # finalizer-held Works drain asynchronously: the periodic resync
         # retries deleting clusters until their execution space empties
-        runtime.register_periodic(self._resync_deleting)
+        runtime.register_periodic(self._resync_deleting, name="cluster-lifecycle")
 
     def _on_event(self, event: Event) -> None:
         self.worker.enqueue(event.obj.name)
@@ -127,6 +127,7 @@ class RateLimitedEvictionQueue:
         process: Callable[[Hashable], None],
         rate_per_s: float = 10.0,
         clock: Callable[[], float] = time.time,
+        controller_name: Optional[str] = None,
     ) -> None:
         self.process = process
         self.rate = rate_per_s
@@ -135,7 +136,9 @@ class RateLimitedEvictionQueue:
         self._tokens = max(rate_per_s, 1.0) if rate_per_s > 0 else 0.0
         self._burst = max(rate_per_s, 1.0)
         self._last = clock()
-        runtime.register_periodic(self.drain)
+        # the owning controller's enablement switch governs the drain; a
+        # generic utility must not hard-code any controller's name
+        runtime.register_periodic(self.drain, name=controller_name)
 
     def add(self, key: Hashable) -> None:
         self._pending.setdefault(key, None)

@@ -40,7 +40,7 @@ class Descheduler:
         self.store = store
         self.members = members
         self.estimator = estimator
-        runtime.register_periodic(self.run_once)
+        runtime.register_periodic(self.run_once, name="descheduler")
 
     def _stuck_replicas(self, cluster: str, resource) -> int:
         if self.estimator is not None:

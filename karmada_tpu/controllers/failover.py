@@ -137,7 +137,7 @@ class NoExecuteTaintManager:
         self._pending: Dict[tuple, float] = {}
         self._pending_lock = threading.Lock()
         self.worker = runtime.register(AsyncWorker("taint-manager", self._reconcile))
-        runtime.register_periodic(self._flush_deadlines)
+        runtime.register_periodic(self._flush_deadlines, name="taint-manager")
         store.bus.subscribe(self._on_event, kind=Cluster.KIND)
 
     def _on_event(self, event: Event) -> None:
@@ -260,7 +260,7 @@ class GracefulEvictionController:
         self.grace_period_s = grace_period_s
         self.worker = runtime.register(AsyncWorker("graceful-eviction", self._reconcile))
         store.bus.subscribe(self._on_event, kind=ResourceBinding.KIND)
-        runtime.register_periodic(self.resync)
+        runtime.register_periodic(self.resync, name="graceful-eviction")
 
     def resync(self) -> None:
         for rb in self.store.list(ResourceBinding.KIND):
@@ -333,7 +333,7 @@ class ApplicationFailoverController:
         self._unhealthy_since: Dict[tuple, float] = {}
         self._round = 0
         self._seen_round: Dict[tuple, int] = {}
-        runtime.register_periodic(self.run_once)
+        runtime.register_periodic(self.run_once, name="application-failover")
 
     def run_once(self) -> None:
         self._round += 1

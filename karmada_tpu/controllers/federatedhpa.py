@@ -149,7 +149,7 @@ class FederatedHPAController:
         # (ns, name) -> [(timestamp, old_replicas, new_replicas)]
         self._scale_events: Dict[Tuple[str, str], List[Tuple[float, int, int]]] = {}
         self.worker = runtime.register(AsyncWorker("federatedhpa", self._reconcile))
-        runtime.register_periodic(self.run_once)
+        runtime.register_periodic(self.run_once, name="federatedhpa")
         store.bus.subscribe(self._on_event, kind=FederatedHPA.KIND)
 
     def _on_event(self, event: Event) -> None:
@@ -319,7 +319,7 @@ class CronFederatedHPAController:
         self.store = store
         self.clock = clock
         self._last_check: Dict[Tuple[str, str], float] = {}
-        runtime.register_periodic(self.run_once)
+        runtime.register_periodic(self.run_once, name="cronfederatedhpa")
 
     def run_once(self) -> None:
         now = self.clock()
@@ -459,7 +459,7 @@ class DeploymentReplicasSyncer:
 
     def __init__(self, store: ObjectStore, runtime: Runtime) -> None:
         self.store = store
-        runtime.register_periodic(self.run_once)
+        runtime.register_periodic(self.run_once, name="replicas-syncer")
 
     def run_once(self) -> None:
         for rb in self.store.list(ResourceBinding.KIND):

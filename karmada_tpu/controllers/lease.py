@@ -93,7 +93,7 @@ class ClusterLeaseMonitor:
         self.grace_multiplier = grace_multiplier
         self.clock = clock
         self.recorder = recorder if recorder is not None else EventRecorder()
-        runtime.register_periodic(self.check_all)
+        runtime.register_periodic(self.check_all, name="cluster-lease")
 
     def check_all(self) -> None:
         from karmada_tpu.utils import events as ev

@@ -241,7 +241,7 @@ class ClusterStatusController:
         self.members = members
         self.recorder = recorder if recorder is not None else EventRecorder()
         self._last_ready: Dict[str, bool] = {}
-        runtime.register_periodic(self.collect_all)
+        runtime.register_periodic(self.collect_all, name="cluster-status")
 
     def collect_all(self) -> None:
         from karmada_tpu.controllers.lease import renew_cluster_lease

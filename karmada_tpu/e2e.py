@@ -66,6 +66,13 @@ class ControlPlane:
         # --default-not-ready/unreachable-toleration-seconds (webhook flags,
         # 300 in the reference); None disables the defaulted tolerations
         default_toleration_seconds: Optional[int] = 300,
+        # --controllers= enable/disable list ("*", "-name", allowlist);
+        # filtered at the Runtime (store/worker.parse_controllers).  None
+        # rehydrates the spec persisted by `karmadactl serve/tick
+        # --controllers` (karmada-system/controller-manager ConfigMap) so
+        # every CLI invocation against the plane honors the operator's
+        # choice, not just the serve process.
+        controllers: Optional[str] = None,
     ) -> None:
         self.clock = clock if clock is not None else time.time
         from karmada_tpu.utils.events import EventRecorder
@@ -84,7 +91,27 @@ class ControlPlane:
             self.admission, self.store, self.gates,
             default_toleration_seconds=default_toleration_seconds,
         )
-        self.runtime = Runtime()
+        rehydrated = controllers is None
+        if rehydrated:
+            cm = self.store.try_get(
+                "ConfigMap", "karmada-system", "controller-manager")
+            controllers = (
+                cm.manifest.get("data", {}).get("controllers", "*")
+                if cm is not None else "*"
+            )
+        try:
+            self.runtime = Runtime(controllers=controllers)
+        except ValueError:
+            if not rehydrated:
+                raise  # an explicit bad spec must fail loudly
+            # a stale persisted spec (name vocabulary drift) must not brick
+            # the plane: run everything and let the operator re-set it
+            import warnings
+
+            warnings.warn(
+                f"ignoring invalid persisted --controllers spec "
+                f"{controllers!r}; running all controllers", stacklevel=2)
+            self.runtime = Runtime()
         self.members: Dict[str, FakeMemberCluster] = {}
         # the push-side execution/status controllers only drive PUSH-mode
         # members; pull members get a per-member KarmadaAgent instead
@@ -135,6 +162,7 @@ class ControlPlane:
         self.eviction_queue = RateLimitedEvictionQueue(
             self.runtime, self.taint_manager.evict_one,
             rate_per_s=eviction_rate, clock=self.clock,
+            controller_name="taint-manager",
         )
         self.taint_manager.eviction_queue = self.eviction_queue
         self.graceful_eviction = GracefulEvictionController(

@@ -117,7 +117,7 @@ class Scheduler:
 
             native_mod.load()
         self.worker = runtime.register(AsyncWorker("scheduler", self._cycle))
-        runtime.register_periodic(self._periodic_flush)
+        runtime.register_periodic(self._periodic_flush, name="scheduler")
         store.bus.subscribe(self._on_event)
 
     # -- event wiring -------------------------------------------------------

@@ -42,7 +42,7 @@ class UnifiedAuthController:
         # resync every round: members rebuilt out-of-band (restart
         # rehydration) must regain the impersonation RBAC without waiting
         # for a Cluster event
-        runtime.register_periodic(self._resync)
+        runtime.register_periodic(self._resync, name="unified-auth")
 
     def _resync(self) -> None:
         for c in self.store.list(Cluster.KIND):

@@ -104,19 +104,95 @@ class AsyncWorker:
             self._cv.notify_all()
 
 
+# the names --controllers= governs: the controller-manager's controller
+# set.  Workers OUTSIDE this set (the scheduler, the operator, the search
+# cache, agent CSR approval) are separate binaries in the reference and
+# are never subject to the flag.
+GOVERNED_CONTROLLERS = frozenset({
+    "detector", "deps-distributor", "binding", "execution", "work-status",
+    "binding-status", "cluster-status", "cluster-lifecycle", "cluster-lease",
+    "taint-manager", "cluster-taint", "taint-policy", "graceful-eviction",
+    "application-failover", "remedy", "namespace-sync", "unified-auth",
+    "frq", "federatedhpa", "cronfederatedhpa", "hpa-marker",
+    "replicas-syncer", "mcs", "mci", "endpointslice-collect",
+    "endpointslice-dispatch", "rebalancer", "cert-rotation", "descheduler",
+})
+
+# internal worker names that ride a governed controller's switch
+_CONTROLLER_ALIAS = {"detector-policy": "detector"}
+
+
+def parse_controllers(spec: str) -> tuple:
+    """`--controllers=` list semantics (controllermanager.go enablement
+    filtering): "*" enables everything not explicitly disabled; "-name"
+    disables; without "*", only listed names run.  Unknown names are
+    rejected up front (the reference controller-manager refuses to start
+    on a typoed controller name)."""
+    names = [s.strip() for s in (spec or "*").split(",") if s.strip()]
+    star = "*" in names
+    disabled = {n[1:] for n in names if n.startswith("-")}
+    enabled = {n for n in names if n != "*" and not n.startswith("-")}
+    unknown = (disabled | enabled) - GOVERNED_CONTROLLERS
+    if unknown:
+        raise ValueError(
+            f"unknown controller name(s) {sorted(unknown)}; "
+            f"valid names: {sorted(GOVERNED_CONTROLLERS)}"
+        )
+    return star, enabled, disabled
+
+
 class Runtime:
     """Holds every controller's worker; runs them deterministically (pump)
-    or in background threads (serve)."""
+    or in background threads (serve).
 
-    def __init__(self, periodic_interval_s: float = 0.5) -> None:
+    `controllers` filters which reconcile workers and periodic hooks run,
+    by name — the reference's `--controllers=` enable/disable list.  A
+    disabled controller still constructs (its worker registers but never
+    pumps; its periodic hooks are dropped), matching "registered but not
+    started"."""
+
+    def __init__(self, periodic_interval_s: float = 0.5,
+                 controllers: str = "*") -> None:
         self.workers: List[AsyncWorker] = []
         self._threads: List[threading.Thread] = []
         self._periodic: List[Callable[[], None]] = []
         self._periodic_interval_s = periodic_interval_s
         self._stop_event = threading.Event()
+        self._ctrl_star, self._ctrl_on, self._ctrl_off = parse_controllers(
+            controllers)
+        self._disabled_workers: set = set()
+        self._ungoverned_depth = 0
+
+    def controller_enabled(self, name: Optional[str]) -> bool:
+        if self._ungoverned_depth > 0:
+            return True  # inside an ungoverned() block (agent machinery)
+        name = _CONTROLLER_ALIAS.get(name, name)
+        if name is None or name not in GOVERNED_CONTROLLERS:
+            return True  # infrastructure (scheduler/operator/search/...)
+        if name in self._ctrl_off:
+            return False
+        return self._ctrl_star or name in self._ctrl_on
+
+    def ungoverned(self):
+        """Context manager: registrations inside bypass the --controllers
+        filter.  Pull-mode agents reuse the controller CLASSES (and thus
+        their worker names) but are the reference's separate agent binary
+        with its own flag — the control plane's list must not kill them."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            self._ungoverned_depth += 1
+            try:
+                yield
+            finally:
+                self._ungoverned_depth -= 1
+        return _cm()
 
     def register(self, worker: AsyncWorker) -> AsyncWorker:
         self.workers.append(worker)
+        if not self.controller_enabled(worker.name):
+            self._disabled_workers.add(worker)
         return worker
 
     def unregister(self, worker: AsyncWorker) -> None:
@@ -127,9 +203,14 @@ class Runtime:
             self.workers.remove(worker)
         except ValueError:
             pass
+        self._disabled_workers.discard(worker)
 
-    def register_periodic(self, fn: Callable[[], None]) -> None:
-        """A resync-style hook invoked once per pump round (or per serve tick)."""
+    def register_periodic(self, fn: Callable[[], None],
+                          name: Optional[str] = None) -> None:
+        """A resync-style hook invoked once per pump round (or per serve
+        tick); `name` subjects it to the `controllers` enablement filter."""
+        if not self.controller_enabled(name):
+            return
         self._periodic.append(fn)
 
     def unregister_periodic(self, fn: Callable[[], None]) -> None:
@@ -145,6 +226,8 @@ class Runtime:
         for _ in range(max_rounds):
             progressed = False
             for w in self.workers:
+                if w in self._disabled_workers:
+                    continue
                 while w.process_one(block=False):
                     progressed = True
                     total += 1
@@ -161,6 +244,8 @@ class Runtime:
     # -- threaded mode -----------------------------------------------------
     def serve(self) -> None:
         for w in self.workers:
+            if w in self._disabled_workers:
+                continue
             t = threading.Thread(target=self._run_worker, args=(w,), daemon=True,
                                  name=f"worker-{w.name}")
             t.start()

@@ -1,0 +1,133 @@
+"""--controllers= enable/disable list (controllermanager.go enablement
+filtering): disabled controllers register but never run — neither their
+reconcile workers nor their periodic hooks."""
+
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ResourceSelector,
+)
+from karmada_tpu.store.worker import parse_controllers
+
+
+def deployment(name="web", replicas=2):
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"replicas": replicas, "template": {"spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "100m"}}}]}}},
+    }
+
+
+def policy(cp, name="pp"):
+    cp.apply_policy(PropagationPolicy(
+        metadata=ObjectMeta(namespace="default", name=name),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(
+                api_version="apps/v1", kind="Deployment")],
+            placement=Placement())))
+
+
+def test_parse_controllers_semantics():
+    star, on, off = parse_controllers("*")
+    assert star and not on and not off
+    star, on, off = parse_controllers("*,-descheduler,-mcs")
+    assert star and off == {"descheduler", "mcs"}
+    star, on, off = parse_controllers("detector,binding")
+    assert not star and on == {"detector", "binding"}
+    # default/empty means everything
+    assert parse_controllers("")[0] and parse_controllers(None)[0]
+    # unknown names are rejected up front (reference refuses to start)
+    import pytest
+
+    with pytest.raises(ValueError, match="taint-manger"):
+        parse_controllers("*,-taint-manger")
+
+
+def test_disabled_namespace_sync_does_not_propagate():
+    cp = ControlPlane(controllers="*,-namespace-sync")
+    cp.add_member("m1")
+    cp.apply({"apiVersion": "v1", "kind": "Namespace",
+              "metadata": {"name": "team-a", "namespace": ""}})
+    cp.tick()
+    assert cp.members["m1"].get("Namespace", "", "team-a") is None
+    # the rest of the plane still works end to end
+    policy(cp)
+    cp.apply(deployment())
+    cp.tick()
+    assert cp.members["m1"].get("Deployment", "default", "web") is not None
+
+
+def test_disabled_detector_stops_the_pipeline_at_the_source():
+    from karmada_tpu.models.work import ResourceBinding
+
+    cp = ControlPlane(controllers="*,-detector")
+    cp.add_member("m1")
+    policy(cp)
+    cp.apply(deployment())
+    cp.tick()
+    assert not list(cp.store.list(ResourceBinding.KIND))
+
+
+def test_allowlist_mode_runs_only_listed_controllers():
+    from karmada_tpu.models.work import ResourceBinding, Work
+
+    # detector creates bindings; scheduler schedules; but the binding
+    # controller (not listed) never renders Work objects
+    # the scheduler is a separate binary in the reference — never governed
+    cp = ControlPlane(controllers="detector,deps-distributor")
+    cp.add_member("m1")
+    policy(cp)
+    cp.apply(deployment())
+    cp.tick()
+    rbs = list(cp.store.list(ResourceBinding.KIND))
+    assert len(rbs) == 1
+    assert rbs[0].spec.clusters  # scheduled
+    assert not list(cp.store.list(Work.KIND))  # binding controller off
+
+
+def test_pull_agent_exempt_from_controller_filter():
+    """Disabling 'execution' stops PUSH-side syncs but must not kill the
+    pull-mode agent, which reuses the same controller classes (the agent
+    is its own binary with its own flag in the reference)."""
+    cp = ControlPlane(controllers="*,-execution,-work-status")
+    cp.add_member("pull-m", sync_mode="Pull")
+    cp.add_member("push-m")
+    policy(cp)
+    cp.apply(deployment())
+    cp.tick()
+    # the pull member's agent applied its Work; the push member got nothing
+    assert cp.members["pull-m"].get("Deployment", "default", "web") is not None
+    assert cp.members["push-m"].get("Deployment", "default", "web") is None
+
+
+def test_detector_alias_covers_policy_worker():
+    """'-detector' must disable BOTH detector workers (the policy queue is
+    an internal alias, not a separately addressable controller)."""
+    from karmada_tpu.models.work import ResourceBinding
+
+    cp = ControlPlane(controllers="*,-detector")
+    cp.add_member("m1")
+    policy(cp)
+    cp.apply(deployment())
+    cp.tick()
+    assert not list(cp.store.list(ResourceBinding.KIND))
+
+
+def test_controllers_spec_persists_across_cli_invocations(tmp_path):
+    from karmada_tpu.cli import main
+
+    d = str(tmp_path / "plane")
+    assert main(["--dir", d, "init"]) == 0
+    assert main(["--dir", d, "join", "m1"]) == 0
+    # tick with an explicit spec persists it
+    assert main(["--dir", d, "tick", "--controllers",
+                 "*,-namespace-sync"]) == 0
+    cp = ControlPlane(persist_dir=d)  # rehydrates the persisted spec
+    assert not cp.runtime.controller_enabled("namespace-sync")
+    assert cp.runtime.controller_enabled("binding")
+    # an invalid explicit spec is refused with a clean error
+    assert main(["--dir", d, "tick", "--controllers", "*,-nope"]) == 1
