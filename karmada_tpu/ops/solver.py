@@ -585,8 +585,17 @@ def _schedule_core(
 schedule_batch = partial(jax.jit, static_argnames=("waves",))(_schedule_core)
 
 
-def _compact_of(rep, sel, status, max_nnz: int):
-    mask = (sel | (rep > 0)).ravel()
+def _compact_of(rep, sel, status, non_workload, max_nnz: int,
+                keep_sel: bool = False):
+    """Selected-but-zero lanes are extracted only where a consumer exists:
+    non-workload bindings always (their targets ARE the selection), every
+    binding only under empty-workload propagation (keep_sel).  A plain
+    Divided binding's selection is its whole feasible set — extracting it
+    unconditionally degenerates the 'compact' result to dense size on
+    full-fleet placements (measured: ~12M entries at 100k x 5k, escalating
+    the extraction cap to a ~270 MB D2H per chunk)."""
+    wanted_sel = sel if keep_sel else (sel & non_workload[:, None])
+    mask = (wanted_sel | (rep > 0)).ravel()
     nnz = jnp.sum(mask.astype(jnp.int32))
     (idx,) = jnp.nonzero(mask, size=max_nnz, fill_value=-1)
     val = jnp.where(idx >= 0, rep.ravel()[jnp.maximum(idx, 0)], 0)
@@ -594,13 +603,19 @@ def _compact_of(rep, sel, status, max_nnz: int):
             status.astype(jnp.int32), nnz)
 
 
-@partial(jax.jit, static_argnames=("waves", "max_nnz"))
-def schedule_compact(*args, waves: int, max_nnz: int):
+# positional index of the non_workload arg in _schedule_core's signature
+# (schedule_compact receives the same tuple via *args)
+_NON_WORKLOAD_ARG = 27
+
+
+@partial(jax.jit, static_argnames=("waves", "max_nnz", "keep_sel"))
+def schedule_compact(*args, waves: int, max_nnz: int, keep_sel: bool = False):
     """The full cycle with the sparse COO extraction FUSED into one jitted
     program: the dense [B, C] result planes never become jit outputs, so
     only idx/val/status/nnz (~max_nnz ints) ever leave the device."""
     rep, sel, status = _schedule_core(*args, waves=waves)
-    return _compact_of(rep, sel, status, max_nnz)
+    return _compact_of(rep, sel, status, args[_NON_WORKLOAD_ARG], max_nnz,
+                       keep_sel=keep_sel)
 
 
 # Single-generation device-transfer cache for the chunk-stable cluster-side
@@ -658,18 +673,27 @@ def solve(batch, waves: int = 1):
     return np.asarray(rep), np.asarray(sel), np.asarray(status)
 
 
-def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0):
+def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
+                     keep_sel: bool = False):
     """Enqueue the fused device solve WITHOUT forcing the result (jax
     dispatch is async): returns an opaque handle for finalize_compact.
     Lets a caller overlap host work (encode of the next chunk, decode of
-    the previous) with the device execution of this one."""
+    the previous) with the device execution of this one.
+
+    keep_sel extracts every selected lane (empty-workload propagation);
+    leave False otherwise — see _compact_of."""
     assert batch.C <= 8192, "cluster axis must be <= 8192 per solve call"
     dense_nnz = batch.B * batch.C
     if max_nnz <= 0:
-        max_nnz = min(max(batch.B * 16, 1 << 14), dense_nnz)
+        # keep_sel ships whole selections (feasible-set scale on full-fleet
+        # placements): start at dense rather than guaranteeing escalation
+        # re-solves + recompiles on every chunk
+        max_nnz = dense_nnz if keep_sel else min(
+            max(batch.B * 16, 1 << 14), dense_nnz)
     args = _batch_args(batch)
-    first = schedule_compact(*args, waves=waves, max_nnz=max_nnz)
-    return (args, waves, first, max_nnz, dense_nnz)
+    first = schedule_compact(*args, waves=waves, max_nnz=max_nnz,
+                             keep_sel=keep_sel)
+    return (args, waves, keep_sel, first, max_nnz, dense_nnz)
 
 
 def finalize_compact(handle):
@@ -681,17 +705,22 @@ def finalize_compact(handle):
     every-binding-selects-most-clusters mixes)."""
     import numpy as np
 
-    args, waves, first, max_nnz, dense_nnz = handle
+    args, waves, keep_sel, first, max_nnz, dense_nnz = handle
     idx, val, st, nnz = first
     while int(nnz) > max_nnz and max_nnz < dense_nnz:
         max_nnz = min(max_nnz * 4, dense_nnz)
-        idx, val, st, nnz = schedule_compact(*args, waves=waves, max_nnz=max_nnz)
+        idx, val, st, nnz = schedule_compact(*args, waves=waves,
+                                             max_nnz=max_nnz,
+                                             keep_sel=keep_sel)
     return np.asarray(idx), np.asarray(val), np.asarray(st), int(nnz)
 
 
-def solve_compact(batch, waves: int = 1, max_nnz: int = 0):
+def solve_compact(batch, waves: int = 1, max_nnz: int = 0,
+                  keep_sel: bool = False):
     """Device-side solve + sparse result extraction: D2H ships only the
     (binding, cluster, replicas) nonzeros instead of the dense [B, C] int64
     plane (x100+ less traffic on realistic mixes).  Escalates max_nnz x4 on
     overflow, capped at B*C (== dense)."""
-    return finalize_compact(dispatch_compact(batch, waves=waves, max_nnz=max_nnz))
+    return finalize_compact(dispatch_compact(batch, waves=waves,
+                                             max_nnz=max_nnz,
+                                             keep_sel=keep_sel))

@@ -249,7 +249,7 @@ def _pick_one(order, feasible, avail_sel, score, name_rank, region_id,
 _pick_vmap = jax.vmap(_pick_one, in_axes=(0, 0, 0, 0, None, None, 0, 0, None))
 
 
-@partial(jax.jit, static_argnames=("G", "waves", "max_nnz"))
+@partial(jax.jit, static_argnames=("G", "waves", "max_nnz", "keep_sel"))
 def spread_assign_compact(
     # cluster axis
     cluster_valid, deleting, name_rank, pods_allowed, has_summary,
@@ -263,7 +263,7 @@ def spread_assign_compact(
     prev_idx, prev_val, evict_idx,
     chosen, cluster_max,
     strategy, static_w, ignore_avail, uid_desc, fresh, non_workload, b_valid,
-    *, G: int, waves: int, max_nnz: int,
+    *, G: int, waves: int, max_nnz: int, keep_sel: bool = False,
 ):
     """Phase B + assignment, FUSED: recompute the planes, pick clusters in
     the chosen regions, and run the main assignment kernel with the pick as
@@ -296,7 +296,8 @@ def spread_assign_compact(
         prev_idx, prev_val, evict_idx,
         waves=waves,
     )
-    return _compact_of(rep, selected, status, max_nnz)
+    return _compact_of(rep, selected, status, non_workload, max_nnz,
+                       keep_sel=keep_sel)
 
 
 def solve_spread(
@@ -416,9 +417,11 @@ def solve_spread(
             batch.pl_ignore_avail[lpid], batch.uid_desc[lidx],
             batch.fresh[lidx], batch.non_workload[lidx], b_valid,
             G=G, waves=waves, max_nnz=max_nnz,
+            keep_sel=enable_empty_workload_propagation,
         )
 
-    max_nnz = min(max(Bs * 16, 1 << 12), Bs * C)
+    max_nnz = (Bs * C if enable_empty_workload_propagation
+               else min(max(Bs * 16, 1 << 12), Bs * C))
     cidx, cval, status, nnz = assign(max_nnz)
     while int(nnz) > max_nnz and max_nnz < Bs * C:
         max_nnz = min(max_nnz * 4, Bs * C)

@@ -423,7 +423,10 @@ class Scheduler:
             # it while the host walks the spread bindings' DFS ping-pong
             handle = None
             if device_idx:
-                handle = dispatch_compact(batch, waves=self.waves)
+                handle = dispatch_compact(
+                    batch, waves=self.waves,
+                    keep_sel=self.enable_empty_workload_propagation,
+                )
             if spread_idx:
                 from karmada_tpu.ops.spread import solve_spread
 

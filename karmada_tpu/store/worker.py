@@ -13,6 +13,7 @@ contract with two execution modes:
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 import traceback
@@ -173,21 +174,17 @@ class Runtime:
             return False
         return self._ctrl_star or name in self._ctrl_on
 
+    @contextlib.contextmanager
     def ungoverned(self):
         """Context manager: registrations inside bypass the --controllers
         filter.  Pull-mode agents reuse the controller CLASSES (and thus
         their worker names) but are the reference's separate agent binary
         with its own flag — the control plane's list must not kill them."""
-        import contextlib
-
-        @contextlib.contextmanager
-        def _cm():
-            self._ungoverned_depth += 1
-            try:
-                yield
-            finally:
-                self._ungoverned_depth -= 1
-        return _cm()
+        self._ungoverned_depth += 1
+        try:
+            yield
+        finally:
+            self._ungoverned_depth -= 1
 
     def register(self, worker: AsyncWorker) -> AsyncWorker:
         self.workers.append(worker)

@@ -359,3 +359,36 @@ def test_device_fit_error_carries_serial_diagnosis():
     except serial.FitError as e:
         assert got.diagnosis == e.diagnosis
         assert len(got.diagnosis) == len(clusters)
+
+
+def test_compact_extraction_excludes_plain_selection_lanes():
+    """A full-fleet Divided binding's selection is its whole feasible set;
+    the COO extraction must NOT ship those zero-replica lanes (regression:
+    they degenerated the compact result to dense size at 100k x 5k, a
+    ~270 MB D2H per chunk).  keep_sel (empty-workload propagation) and
+    non-workload bindings still get their selected lanes."""
+    import random
+
+    import bench
+    from karmada_tpu.ops import tensors
+    from karmada_tpu.ops.solver import solve_compact
+    from karmada_tpu.estimator.general import GeneralEstimator
+
+    rng = random.Random(5)
+    clusters = bench.build_fleet(rng, 256)
+    # dynamic-weight over the whole fleet: feasible/selected on all lanes
+    placements = [p for p in bench.build_placements(rng, [c.name for c in clusters])
+                  if p.replica_scheduling is not None
+                  and p.cluster_affinity is None
+                  and not p.spread_constraints][:2]
+    assert placements
+    items = bench.build_bindings(rng, 32, placements)
+    batch = tensors.encode_batch(items, tensors.ClusterIndex.build(clusters),
+                                 GeneralEstimator())
+    idx, val, status, nnz = solve_compact(batch, waves=2)
+    # without keep_sel: only actual assignments ship (< a few per binding)
+    assert int(nnz) <= 32 * 16, int(nnz)
+    assert (val[idx >= 0] > 0).all()
+    # with keep_sel: the selection lanes (whole fleet) are included
+    _, val_k, _, nnz_k = solve_compact(batch, waves=2, keep_sel=True)
+    assert int(nnz_k) > 32 * 64, int(nnz_k)
