@@ -313,12 +313,16 @@ def _select_by_cluster(
     return in_sel, unsched
 
 
-def _schedule_one(
-    feasible, avail_cal, prev_present, prev_rep, name_rank,
+def _assign_lanes(
+    feasible, avail_cal, prev_present, prev_rep, name_rank, rank_webster,
     n, strategy, has_sc, sc_min, sc_max, ignore_avail,
     static_w, uid_desc, fresh, non_workload, valid,
 ):
-    """One binding against [C] cluster lanes; vmapped over the batch."""
+    """One binding against its lane axis (full [C] or a compact top-K
+    gather — the math is lane-count agnostic).  rank_webster is a
+    DENSIFIED 0..L-1 rank in rank_eff order (Webster's tie-key packing
+    seat*L + rank requires rank < L); name_rank keeps original values for
+    the 13-bit packed sort keys."""
     C = feasible.shape[0]
     i64 = lambda x: jnp.asarray(x, jnp.int64)
     n = i64(n)
@@ -337,7 +341,6 @@ def _schedule_one(
     sel_count = jnp.sum(sel)
 
     # ---- assignment ------------------------------------------------------
-    rank_eff = jnp.where(uid_desc, C - 1 - name_rank, name_rank)
     scheduled_rep = jnp.where(sel & prev_present, prev_rep, 0)
     assigned = jnp.sum(scheduled_rep)
 
@@ -398,7 +401,7 @@ def _schedule_one(
     )
     seats = webster_divide(
         jnp.where(run_webster, target, 0), w, jnp.zeros((C,), jnp.int64),
-        active & run_webster, rank_eff,
+        active & run_webster, rank_webster,
     )
 
     rep = base + seats
@@ -417,6 +420,109 @@ def _schedule_one(
     status = jnp.where(valid, status, STATUS_OK).astype(jnp.int32)
     rep = jnp.where((status == STATUS_OK) & valid, rep, 0)
     sel = sel & (status == STATUS_OK) & valid
+    return rep, sel, status
+
+
+# ---------------------------------------------------------------------------
+# Compact lanes: the division/selection math per binding only ever involves
+# a bounded set of lanes, so at large C it runs on a top-K gather instead of
+# the full cluster axis (the while-loop passes were ~97% of kernel volume at
+# C=8192).  Exactness argument, per sub-algorithm with target/sc_max <= 64
+# (the encoder routes bigger bindings to the serial host path):
+#   * Webster: a lane wins a seat only if its first-seat priority clears the
+#     award threshold; at most `target` lanes outrank the marginal weight,
+#     and tie awards go to the first r lanes in rank_eff order — so the top
+#     128 by (w desc, rank_eff asc) contain every possible winner.
+#   * Aggregated prefix: <= target lanes, ties by name ASC — top 128 by
+#     (w desc, name asc).
+#   * Selection + swap loop: keyed (score, avail, name asc); score>0 only on
+#     prev lanes (all gathered), swaps take max-avail candidates — top 128
+#     by (avail_sel desc, name asc).
+#   * scale-down / Steady seats: previous-assignment lanes, all gathered.
+# Duplicated-without-spread and non-workload selection are wide formulas
+# computed outside the gather (they touch no expensive loop).
+
+from karmada_tpu.ops.tensors import (  # noqa: E402
+    COMPACT_DIVISION_CAP,
+    COMPACT_LANES,
+    COMPACT_PREV_CAP,
+    COMPACT_SELECTION_CAP,
+)
+
+_G_PREV, _G_TOPK = COMPACT_PREV_CAP, 2 * COMPACT_DIVISION_CAP
+assert COMPACT_LANES == _G_PREV + 3 * _G_TOPK, "lane geometry out of sync"
+# the selection path consumes up to sc_max picks + sc_max swap-ins from the
+# avail-ordered gather; its cap must not outgrow the division-derived budget
+assert COMPACT_SELECTION_CAP <= COMPACT_DIVISION_CAP, "selection cap too big"
+
+
+def _gather_lanes(feasible, avail_sel, w_gather, prev_present, name_rank,
+                  rank_eff):
+    """The union-of-top-K lane set for one binding: indices[K] plus a
+    validity mask (duplicates and junk lanes disabled)."""
+    C = feasible.shape[0]
+    nr = jnp.asarray(name_rank, jnp.int64)
+    wq = jnp.clip(w_gather, 0, _AVAIL_CAP) << 13
+    aq = jnp.clip(avail_sel, 0, _AVAIL_CAP) << 13
+    NEG = jnp.int64(-1)
+    key_prev = jnp.where(prev_present, (8191 - nr), NEG)
+    key_w_rank = jnp.where(feasible, wq | (8191 - rank_eff), NEG)
+    key_w_name = jnp.where(feasible, wq | (8191 - nr), NEG)
+    key_a_name = jnp.where(feasible, aq | (8191 - nr), NEG)
+    _, ip = lax.top_k(key_prev, _G_PREV)
+    _, iw = lax.top_k(key_w_rank, _G_TOPK)
+    _, inm = lax.top_k(key_w_name, _G_TOPK)
+    _, ia = lax.top_k(key_a_name, _G_TOPK)
+    lanes = jnp.concatenate([ip, iw, inm, ia])  # [K]
+    lanes = jnp.sort(lanes)
+    dup = jnp.concatenate(
+        [jnp.zeros((1,), bool), lanes[1:] == lanes[:-1]])
+    return lanes, ~dup
+
+
+def _schedule_one(
+    feasible, avail_cal, prev_present, prev_rep, name_rank,
+    n, strategy, has_sc, sc_min, sc_max, ignore_avail,
+    static_w, uid_desc, fresh, non_workload, valid,
+):
+    """One binding; vmapped over the batch.  Small cluster axes run the
+    lane math directly; large ones gather COMPACT_LANES first."""
+    C = feasible.shape[0]
+    rank_eff = jnp.where(uid_desc, C - 1 - name_rank, name_rank)
+    if C <= COMPACT_LANES:
+        return _assign_lanes(
+            feasible, avail_cal, prev_present, prev_rep, name_rank, rank_eff,
+            n, strategy, has_sc, sc_min, sc_max, ignore_avail,
+            static_w, uid_desc, fresh, non_workload, valid,
+        )
+
+    avail_sel = avail_cal + prev_rep * prev_present
+    w_gather = jnp.where(strategy == STRAT_STATIC, static_w, avail_sel)
+    lanes, lane_ok = _gather_lanes(
+        feasible, avail_sel, w_gather, prev_present, name_rank, rank_eff)
+    g = lambda a: a[lanes]
+    feas_k = g(feasible) & lane_ok
+    rank_eff_k = g(rank_eff)
+    # densify rank_eff to 0..K-1 preserving order (Webster's tie-key
+    # packing needs rank < lane count)
+    rank_webster = _positions(jnp.where(lane_ok, rank_eff_k,
+                                        (jnp.int64(1) << 40) + lanes))
+    rep_k, sel_k, status = _assign_lanes(
+        feas_k, g(avail_cal), g(prev_present) & lane_ok, g(prev_rep),
+        g(name_rank), rank_webster,
+        n, strategy, has_sc, sc_min, sc_max, ignore_avail,
+        g(static_w), uid_desc, fresh, non_workload, valid,
+    )
+    rep = jnp.zeros((C,), jnp.int64).at[lanes].add(
+        jnp.where(lane_ok, rep_k, 0))
+    sel_scatter = jnp.zeros((C,), bool).at[lanes].max(sel_k & lane_ok)
+    ok = (status == STATUS_OK) & valid
+    # wide formulas for the pieces whose result legitimately spans the
+    # full feasible set (no expensive loop involved)
+    dup_wide = (strategy == STRAT_DUPLICATED) & ~has_sc
+    rep = jnp.where(dup_wide & ok, jnp.asarray(n, jnp.int64) * feasible, rep)
+    rep = jnp.where(non_workload, 0, rep)
+    sel = jnp.where(has_sc, sel_scatter, feasible & ok)
     return rep, sel, status
 
 

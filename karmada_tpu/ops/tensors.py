@@ -68,6 +68,7 @@ ROUTE_UNSUPPORTED = 3
 ROUTE_VANISHED_PREV = 4  # prev assignment names a cluster outside the snapshot
 ROUTE_HUGE_REPLICAS = 5  # replica count beyond the kernel's 2^25 cap
 ROUTE_DEVICE_SPREAD = 6  # region spread: device group math + host DFS
+ROUTE_COMPACT_CAP = 7  # beyond the compact-lane gather's exactness caps
 
 # the device spread path enumerates region groups as fixed lanes
 MAX_DEVICE_REGIONS = 16
@@ -77,6 +78,15 @@ MAX_DEVICE_REGIONS = 16
 # must take the arbitrary-precision host path
 KERNEL_REPLICA_CAP = (1 << 25) - 1
 KERNEL_WEIGHT_CAP = (1 << 34) - 1
+
+# compact-lane geometry (ops/solver._schedule_one): above COMPACT_LANES
+# clusters the kernel runs its division/selection loops on a top-K gather
+# whose exactness holds only under these per-binding bounds; bindings
+# exceeding them route to the serial host path (ROUTE_COMPACT_CAP)
+COMPACT_LANES = 400
+COMPACT_DIVISION_CAP = 64    # replicas (and thus any Webster target)
+COMPACT_SELECTION_CAP = 64   # cluster spread-constraint MaxGroups
+COMPACT_PREV_CAP = 16        # previous-assignment cluster count
 
 # result status codes (must match ops/solver.py)
 STATUS_OK = 0
@@ -204,10 +214,17 @@ def _placement_key(p: Placement) -> str:
 
 
 def _route_for(
-    spec: ResourceBindingSpec, placement: Placement, n_regions: int = 0
+    spec: ResourceBindingSpec, placement: Placement, n_regions: int = 0,
+    compact: bool = False,
 ) -> int:
     scs = placement.spread_constraints
     if scs and not serial.should_ignore_spread_constraint(placement):
+        if compact and any(
+            sc.spread_by_field == SPREAD_BY_FIELD_CLUSTER
+            and sc.max_groups > COMPACT_SELECTION_CAP
+            for sc in scs
+        ):
+            return ROUTE_COMPACT_CAP
         has_region = False
         for sc in scs:
             if sc.spread_by_field in (
@@ -389,6 +406,8 @@ def encode_batch(
     uids: List[str] = []
     on_device = (ROUTE_DEVICE, ROUTE_DEVICE_SPREAD)
     cindex_get = cindex.index.get
+    compact = C > COMPACT_LANES
+    rep_cap = COMPACT_DIVISION_CAP if compact else KERNEL_REPLICA_CAP
 
     def encode_one(b: int, set_uid: bool = True) -> None:
         """The full (slow) per-binding encoding — also the C fast path's
@@ -413,12 +432,12 @@ def encode_batch(
             pid = pkeys[key] = len(placements)
             placements.append(placement)
             route_by_pid[pid] = _route_for(_ROUTE_PROBE_SPEC, placement,
-                                           n_regions)
+                                           n_regions, compact)
         if use_fast[0] and placement is spec.placement:
             pid_route_by_id[id(placement)] = (placement, pid, route_by_pid[pid])
         placement_id[b] = pid
         r = (route_by_pid[pid] if not spec.components
-             else _route_for(spec, placement, n_regions))
+             else _route_for(spec, placement, n_regions, compact))
 
         g = (spec.resource.api_version, spec.resource.kind)
         gid = gvks.get(g)
@@ -491,6 +510,15 @@ def encode_batch(
                 r = ROUTE_HUGE_REPLICAS
         elif nrep > KERNEL_REPLICA_CAP and r in on_device:
             r = ROUTE_HUGE_REPLICAS
+        if compact and r in on_device:
+            # compact-lane exactness bounds (see COMPACT_* above); the
+            # division cap does not apply to Duplicated, whose replica
+            # count is a wide broadcast rather than a Webster target
+            divides = (placement.replica_scheduling_type()
+                       != REPLICA_SCHEDULING_DUPLICATED)
+            if ((divides and nrep > COMPACT_DIVISION_CAP)
+                    or len(prev_entries[b]) > COMPACT_PREV_CAP):
+                r = ROUTE_COMPACT_CAP
         if spec.graceful_eviction_tasks:
             for task in spec.graceful_eviction_tasks:
                 ci = cindex_get(task.from_cluster)
@@ -512,7 +540,7 @@ def encode_batch(
         fast.encode_fast(
             items_list, pid_route_by_id, gvks, classes,
             placement_id, gvk_id, class_id, replicas, uid_desc, fresh,
-            non_workload, nw_shortcut, route, KERNEL_REPLICA_CAP, encode_one,
+            non_workload, nw_shortcut, route, rep_cap, encode_one,
         )
     else:
         for b in range(nB):

@@ -392,3 +392,71 @@ def test_compact_extraction_excludes_plain_selection_lanes():
     # with keep_sel: the selection lanes (whole fleet) are included
     _, val_k, _, nnz_k = solve_compact(batch, waves=2, keep_sel=True)
     assert int(nnz_k) > 32 * 64, int(nnz_k)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_batch_parity_random_compact_lanes(seed):
+    """C=600 > COMPACT_LANES: the kernel's top-K gather path must stay
+    bit-identical to serial — including Webster tie blocks, the static
+    all-equal-weight fallback, aggregated prefixes, selection swaps, and
+    uid-flipped tiebreak order, all of which constrain WHICH lanes the
+    gather must contain."""
+    run_parity(seed, n_clusters=600, n_bindings=16)
+
+
+def test_compact_cap_routing():
+    """Bindings beyond the compact-lane exactness bounds route to the
+    serial host path at large C, and stay on-device at small C."""
+    rng = random.Random(3)
+    names = [f"member-{i:03d}" for i in range(600)]
+    clusters = [mk_cluster(rng, nm) for nm in names]
+    placement = Placement(replica_scheduling=ReplicaSchedulingStrategy(
+        replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+        replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+        weight_preference=ClusterPreferences(
+            dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+    ))
+
+    def binding(replicas, prev_n=0, dup=False, sc_max=0):
+        pl = placement
+        if dup:
+            pl = Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED))
+        if sc_max:
+            pl = Placement(
+                spread_constraints=[SpreadConstraint(
+                    spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+                    min_groups=1, max_groups=sc_max)],
+                replica_scheduling=pl.replica_scheduling,
+            )
+        spec = ResourceBindingSpec(
+            resource=ObjectReference(api_version=GVK[0], kind=GVK[1],
+                                     namespace="d", name="x", uid="u"),
+            replicas=replicas, placement=pl,
+        )
+        if prev_n:
+            spec.clusters = [TargetCluster(name=n, replicas=1)
+                             for n in names[:prev_n]]
+        return spec, ResourceBindingStatus()
+
+    items = [
+        binding(50),            # divided, under cap -> device
+        binding(100),           # divided, over the 64-replica cap -> host
+        binding(100, dup=True),  # duplicated: replica cap does not apply
+        binding(10, prev_n=20),  # 20 prev clusters > 16 cap -> host
+        binding(10, sc_max=80),  # selection cap -> host
+    ]
+    batch = tensors.encode_batch(
+        items, tensors.ClusterIndex.build(clusters), GeneralEstimator())
+    assert batch.route[0] == tensors.ROUTE_DEVICE
+    assert batch.route[1] == tensors.ROUTE_COMPACT_CAP
+    assert batch.route[2] == tensors.ROUTE_DEVICE
+    assert batch.route[3] == tensors.ROUTE_COMPACT_CAP
+    assert batch.route[4] == tensors.ROUTE_COMPACT_CAP
+
+    # the same bindings at small C all stay on-device (no gather, no caps)
+    small = clusters[:16]
+    batch_small = tensors.encode_batch(
+        [binding(100), binding(10, prev_n=10), binding(10, sc_max=80)],
+        tensors.ClusterIndex.build(small), GeneralEstimator())
+    assert (batch_small.route == tensors.ROUTE_DEVICE).all()
