@@ -95,6 +95,207 @@ def force_cpu_fallback() -> None:
     force_cpu()
 
 
+# -- checkpointing -----------------------------------------------------------
+# The tunnel drops mid-run (observed r3: the chip answered for ~2h windows
+# and vanished mid-bench, losing everything).  The timed run therefore
+# checkpoints per chunk to bench_ckpt/chunks.jsonl: a re-run with the same
+# config + source digest + platform kind skips finished chunks and keeps
+# their measurements, so a relay drop costs one chunk, not the run.  A
+# completed TPU result is also persisted whole (tpu_latest.json) so the
+# round-end bench can report the real measurement even if the chip is down
+# at that exact moment (marked `cached` with its timestamp — an honest
+# labelled measurement beats a CPU fallback number).
+
+def _repo_dir() -> str:
+    return os.path.dirname(os.path.abspath(__file__))
+
+
+def default_ckpt_dir() -> str:
+    return os.path.join(_repo_dir(), "bench_ckpt")
+
+
+_SOLVER_SOURCES = ("karmada_tpu/ops/solver.py", "karmada_tpu/ops/tensors.py",
+                   "karmada_tpu/ops/spread.py", "karmada_tpu/ops/serial.py",
+                   "bench.py")
+# serial-control cache key: the control's own code AND everything that
+# shapes the synthetic workload it runs (a cached baseline measured on a
+# different workload would silently corrupt the reported speedup)
+_SERIAL_SOURCES = ("karmada_tpu/ops/serial.py",
+                   "karmada_tpu/native/serial_solver.cc",
+                   "karmada_tpu/estimator/general.py",
+                   "bench.py")
+
+
+def source_digest(sources=_SOLVER_SOURCES) -> str:
+    """Digest of the named sources: chunks measured against different code
+    must never be mixed into one aggregate."""
+    import hashlib
+
+    h = hashlib.sha1()
+    for rel in sources:
+        p = os.path.join(_repo_dir(), rel)
+        try:
+            with open(p, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"?")
+    return h.hexdigest()[:16]
+
+
+def config_sig(args, platform_kind: str) -> str:
+    return (f"b{args.bindings}-c{args.clusters}-k{args.chunk}"
+            f"-w{args.waves}-{platform_kind}-{source_digest()}")
+
+
+def load_ckpt(path: str, sig: str):
+    """Return (done: {chunk_idx: record}, rebalance_rec, prior_elapsed_s).
+
+    prior_elapsed_s sums, per earlier session, that session's span (max
+    t_rel among its chunks) — the honest elapsed contribution of work
+    already done.  Aggregate results are marked `resumed` downstream."""
+    done: Dict[int, dict] = {}
+    reb = None
+    sessions: Dict[str, float] = {}
+    try:
+        with open(path) as f:
+            for ln in f:
+                try:
+                    rec = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue  # torn final line from a killed run
+                if rec.get("sig") != sig:
+                    continue
+                if rec.get("kind") == "rebalance":
+                    reb = rec
+                    continue
+                ci = int(rec["ci"])
+                if ci in done:
+                    # first-wins: a concurrent duplicate run of the same
+                    # sig must not add its span to prior_elapsed twice
+                    continue
+                done[ci] = rec
+                s = rec.get("session", "?")
+                sessions[s] = max(sessions.get(s, 0.0), float(rec["t_rel"]))
+    except OSError:
+        pass
+    return done, reb, sum(sessions.values())
+
+
+class ChunkLog:
+    """Append-only per-chunk measurement log (one JSON line per chunk)."""
+
+    def __init__(self, path: str, sig: str) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self.path, self.sig = path, sig
+        import uuid
+
+        self.session = uuid.uuid4().hex[:8]
+        self.t0 = time.perf_counter()
+        # advisory exclusive lock: two concurrent runs of the same config
+        # (watcher + a manual run) interleaving chunk records would corrupt
+        # the resume aggregation; the loser runs uncheckpointed
+        self.disabled = False
+        try:
+            import fcntl
+
+            self._lockf = open(path + ".lock", "w")
+            fcntl.flock(self._lockf, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            self.disabled = True
+            print("[bench] another bench holds the checkpoint lock; this "
+                  "run will not checkpoint", file=sys.stderr, flush=True)
+
+    def reset_t0(self) -> None:
+        """Start the session span at the TIMED run, not at warmup: t_rel
+        reconstructs each session's elapsed contribution on resume."""
+        self.t0 = time.perf_counter()
+
+    def append(self, **rec) -> None:
+        if self.disabled:
+            return
+        rec.update(sig=self.sig, session=self.session,
+                   t_rel=round(time.perf_counter() - self.t0, 3))
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+
+def _serial_cache_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, "serial_controls.json")
+
+
+def load_serial_cache(ckpt_dir: str, key: str) -> Optional[dict]:
+    try:
+        with open(_serial_cache_path(ckpt_dir)) as f:
+            return json.load(f).get(key)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def save_serial_cache(ckpt_dir: str, key: str, rec: dict) -> None:
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = _serial_cache_path(ckpt_dir)
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        data = {}
+    data[key] = rec
+    with open(path, "w") as f:
+        json.dump(data, f)
+
+
+def _tpu_latest_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, "tpu_latest.json")
+
+
+def load_tpu_latest(ckpt_dir: str, args) -> Optional[dict]:
+    """A completed TPU measurement for THIS config (any source digest —
+    the digest it ran against is recorded inside for the reader)."""
+    try:
+        with open(_tpu_latest_path(ckpt_dir)) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    cfg = rec.get("config", {})
+    if (cfg.get("bindings") == args.bindings
+            and cfg.get("clusters") == args.clusters
+            and cfg.get("chunk") == args.chunk
+            and cfg.get("waves") == args.waves):
+        return rec
+    return None
+
+
+def save_tpu_latest(ckpt_dir: str, args, payload: dict) -> None:
+    os.makedirs(ckpt_dir, exist_ok=True)
+    rec = {
+        "config": {"bindings": args.bindings, "clusters": args.clusters,
+                   "chunk": args.chunk, "waves": args.waves},
+        "source_digest": source_digest(),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "payload": payload,
+    }
+    with open(_tpu_latest_path(ckpt_dir), "w") as f:
+        json.dump(rec, f)
+
+
+def emit_cached_tpu(rec: dict, why_no_live: str) -> None:
+    """Print a persisted TPU measurement as the round result, unmissably
+    labelled as a cached (but real, on-chip) measurement."""
+    payload = dict(rec["payload"])
+    detail = dict(payload.get("detail", {}))
+    detail.update(
+        cached=True,
+        measured_at=rec.get("measured_at"),
+        cached_source_digest=rec.get("source_digest"),
+        live_attempt=why_no_live,
+    )
+    payload["detail"] = detail
+    payload["metric"] = payload["metric"] + " [cached on-TPU measurement]"
+    print(json.dumps(payload))
+
+
 # -- watchdog ----------------------------------------------------------------
 # The probe bounds backend *init* hangs, but the tunnel can also stall
 # MID-RUN (observed this round: probe ok in 0.2 s, then a dispatch blocked
@@ -148,7 +349,8 @@ def _last_json_line(lines) -> Optional[str]:
     return None
 
 
-def run_with_watchdog(argv, no_progress_timeout: float) -> int:
+def run_with_watchdog(argv, no_progress_timeout: float,
+                      cpu_fallback: bool = True) -> int:
     import threading
 
     cmd = [sys.executable, os.path.abspath(__file__), *argv, "--inner"]
@@ -215,9 +417,16 @@ def run_with_watchdog(argv, no_progress_timeout: float) -> int:
     why = (f"device attempt hung ({no_progress_timeout:.0f}s without progress)"
            if hung else
            f"device attempt died rc={proc.returncode} without a result")
+    if not cpu_fallback:
+        # watcher mode: finished chunks are checkpointed; report and let
+        # the caller retry when the device answers again
+        print(json.dumps({"metric": "device attempt failed (no-cpu-fallback)",
+                          "value": 0, "unit": "bindings/s", "vs_baseline": 0,
+                          "detail": {"error": why}}))
+        return 3
     fb = subprocess.run(
         [sys.executable, os.path.abspath(__file__), *argv,
-         "--inner", "--force-cpu"],
+         "--inner", "--force-cpu", "--prefer-cached"],
         stdout=subprocess.PIPE, text=True,
     )
     fb_line = _last_json_line((fb.stdout or "").splitlines())
@@ -378,11 +587,17 @@ def build_bindings(rng: random.Random, n_bindings: int, placements):
     return items
 
 
-def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8):
+def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8,
+                ckpt_done=None, ckpt_log=None):
     """Returns (elapsed_s, solve_s, scheduled_count, chunk_lat, chunk_wall):
     chunk_lat is each chunk's OWN work (encode span + finalize span);
     chunk_wall is its submit-to-results wall time, which under pipelining
     also contains the interleaved work of neighboring chunks.
+
+    ckpt_done ({chunk_idx: record}) skips chunks a previous session already
+    measured, folding their stored counts/latencies into the aggregates;
+    ckpt_log (ChunkLog) records each newly finalized chunk.  Both optional
+    — the warmup/rebalance callers leave them off.
 
     Uses the production path end to end: shared EncoderCache across chunks,
     jitted compact solve (sparse COO results — the dense [B, C] plane is
@@ -409,7 +624,7 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
 
     def finalize(entry) -> None:
         nonlocal scheduled, solve_s
-        handle, batch, part, tc, encode_span = entry
+        handle, batch, part, tc, encode_span, ci = entry
         t1 = time.perf_counter()
         idx, val, status, _nnz = finalize_compact(handle)
         spread_idx = [
@@ -421,22 +636,45 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
         solve_s += t2 - t1
         sm.STEP_LATENCY.observe(t2 - t1, schedule_step=sm.STEP_SOLVE)
         decoded = tensors.decode_compact(batch, idx, val, status)
+        n_ok = 0
+        chunk_failures: Dict[str, int] = {}
         for i in range(len(part)):
             d = spread_res[i] if i in spread_res else decoded[i]
             if batch.route[i] in (tensors.ROUTE_DEVICE,
                                   tensors.ROUTE_DEVICE_SPREAD):
                 if isinstance(d, Exception):
                     k = type(d).__name__
-                    failures[k] = failures.get(k, 0) + 1
+                    chunk_failures[k] = chunk_failures.get(k, 0) + 1
                 else:
-                    scheduled += 1
+                    n_ok += 1
+        scheduled += n_ok
+        for k, v in chunk_failures.items():
+            failures[k] = failures.get(k, 0) + v
         sm.STEP_LATENCY.observe(time.perf_counter() - t2,
                                 schedule_step=sm.STEP_DECODE)
-        chunk_lat.append(encode_span + (time.perf_counter() - t1))
-        chunk_wall.append(time.perf_counter() - tc)
-        _hb(f"chunk {len(chunk_wall)} finalized ({len(part)} bindings)")
+        lat = encode_span + (time.perf_counter() - t1)
+        wall = time.perf_counter() - tc
+        chunk_lat.append(lat)
+        chunk_wall.append(wall)
+        if ckpt_log is not None:
+            ckpt_log.append(ci=ci, n=len(part), scheduled=n_ok,
+                            failures=chunk_failures, lat=round(lat, 4),
+                            wall=round(wall, 4),
+                            solve_s=round(t2 - t1, 4))
+        _hb(f"chunk {ci + 1} finalized ({len(part)} bindings)")
 
     for lo in range(0, n, chunk):
+        ci = lo // chunk
+        if ckpt_done and ci in ckpt_done:
+            rec = ckpt_done[ci]
+            scheduled += int(rec["scheduled"])
+            for k, v in rec.get("failures", {}).items():
+                failures[k] = failures.get(k, 0) + int(v)
+            chunk_lat.append(float(rec["lat"]))
+            chunk_wall.append(float(rec["wall"]))
+            solve_s += float(rec.get("solve_s", 0.0))
+            _hb(f"chunk {ci + 1} restored from checkpoint")
+            continue
         tc = time.perf_counter()
         part = items[lo : lo + chunk]
         batch = tensors.encode_batch(part, cindex, estimator, cache=cache)
@@ -445,7 +683,7 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
         handle = dispatch_compact(batch, waves=waves)
         if pending is not None:
             finalize(pending)
-        pending = (handle, batch, part, tc, t1 - tc)
+        pending = (handle, batch, part, tc, t1 - tc, ci)
     if pending is not None:
         finalize(pending)
     return (time.perf_counter() - t0, solve_s, scheduled, chunk_lat,
@@ -533,6 +771,20 @@ def main() -> None:
                     help="watchdog: kill the device attempt after this many "
                          "seconds with neither output nor CPU activity, "
                          "then CPU-fallback")
+    ap.add_argument("--ckpt-dir", default=default_ckpt_dir(),
+                    help="per-chunk checkpoint + cached-controls directory")
+    ap.add_argument("--fresh", action="store_true",
+                    help="ignore chunk checkpoints, cached serial controls "
+                         "and the persisted TPU result; measure everything")
+    ap.add_argument("--prefer-cached", action="store_true",
+                    help="with --force-cpu: report a persisted on-TPU "
+                         "measurement instead of running on CPU (set by "
+                         "the watchdog's fallback re-exec; an explicit "
+                         "--force-cpu run stays a CPU run)")
+    ap.add_argument("--no-cpu-fallback", action="store_true",
+                    help="exit nonzero instead of re-running on host CPU "
+                         "when the device attempt hangs or dies (watcher "
+                         "mode: checkpoints keep the finished chunks)")
     args = ap.parse_args()
     if args.quick:
         args.bindings, args.clusters, args.chunk = 2048, 256, 1024
@@ -540,7 +792,9 @@ def main() -> None:
 
     if not args.inner and not args.force_cpu:
         argv = [a for a in sys.argv[1:]]  # replayed verbatim into the child
-        raise SystemExit(run_with_watchdog(argv, args.no_progress_timeout))
+        raise SystemExit(run_with_watchdog(
+            argv, args.no_progress_timeout,
+            cpu_fallback=not args.no_cpu_fallback))
     global _HB_ON
     _HB_ON = args.inner
 
@@ -561,6 +815,25 @@ def main() -> None:
     on_tpu = probe["ok"] and "tpu" in str(platform).lower()
     _hb(f"probe done: platform={platform}")
 
+    if (not on_tpu and not args.fresh
+            and (not args.force_cpu or args.prefer_cached)):
+        # no chip right now, but a completed on-chip measurement of this
+        # exact config from earlier in the round is a better round result
+        # than a CPU-fallback number — print it, clearly labelled
+        cached = load_tpu_latest(args.ckpt_dir, args)
+        if cached is not None:
+            emit_cached_tpu(cached, why_no_live=str(
+                probe["attempts"][-1].get("err", "probe failed")
+                if probe.get("attempts") else "probe failed"))
+            return
+        if args.no_cpu_fallback and not args.force_cpu:
+            print(json.dumps({"metric": "device probe failed "
+                                        "(no-cpu-fallback)",
+                              "value": 0, "unit": "bindings/s",
+                              "vs_baseline": 0,
+                              "detail": {"backend_probe": probe}}))
+            raise SystemExit(3)
+
     rng = random.Random(0)
     clusters = build_fleet(rng, args.clusters)
     placements = build_placements(rng, [c.name for c in clusters])
@@ -569,56 +842,111 @@ def main() -> None:
     cindex = tensors.ClusterIndex.build(clusters)
 
     try:
-        # warmup: compile every chunk shape once (full chunk + any tail shape)
-        _hb("compile warmup starting")
-        t_compile = time.perf_counter()
-        cache = tensors.EncoderCache()
-        run_batched(items[: min(args.chunk, len(items))], cindex, estimator,
-                    args.chunk, cache, waves=args.waves)
-        tail = len(items) % args.chunk
-        if tail:
-            run_batched(items[:tail], cindex, estimator, args.chunk, cache,
-                        waves=args.waves)
-        compile_s = time.perf_counter() - t_compile
-        _hb(f"compile warmup done in {compile_s:.1f}s; timed run starting")
+        # resumable checkpoints: a relay drop mid-run costs one chunk
+        sig = config_sig(args, "tpu" if on_tpu else "cpu")
+        chunks_path = os.path.join(args.ckpt_dir, "chunks.jsonl")
+        if args.fresh:
+            ckpt_done, reb_rec, prior_elapsed = {}, None, 0.0
+            ckpt_log = None
+        else:
+            ckpt_done, reb_rec, prior_elapsed = load_ckpt(chunks_path, sig)
+            ckpt_log = ChunkLog(chunks_path, sig)
+        n_chunks = (len(items) + args.chunk - 1) // args.chunk
+        n_restored = sum(1 for ci in range(n_chunks) if ci in ckpt_done)
+        _hb(f"checkpoint: {n_restored}/{n_chunks} chunks restored"
+            f" (+{prior_elapsed:.1f}s prior elapsed)")
 
+        cache = tensors.EncoderCache()
+        compile_s = 0.0
+        if n_restored < n_chunks or reb_rec is None:
+            # warmup: compile every chunk shape once (full + any tail shape)
+            _hb("compile warmup starting")
+            t_compile = time.perf_counter()
+            run_batched(items[: min(args.chunk, len(items))], cindex,
+                        estimator, args.chunk, cache, waves=args.waves)
+            tail = len(items) % args.chunk
+            if tail and (n_chunks - 1) not in ckpt_done:
+                run_batched(items[:tail], cindex, estimator, args.chunk,
+                            cache, waves=args.waves)
+            compile_s = time.perf_counter() - t_compile
+            _hb(f"compile warmup done in {compile_s:.1f}s; timed run starting")
+
+        if ckpt_log is not None:
+            ckpt_log.reset_t0()
         (elapsed, solve_s, scheduled, chunk_lat, chunk_wall,
          failures) = run_batched(
-            items, cindex, estimator, args.chunk, cache, waves=args.waves)
+            items, cindex, estimator, args.chunk, cache, waves=args.waves,
+            ckpt_done=ckpt_done, ckpt_log=ckpt_log)
+        elapsed += prior_elapsed
         throughput = args.bindings / elapsed
         _hb(f"timed run done: {throughput:.1f} bindings/s")
 
         # descheduler rebalance loop (BASELINE config 5, second half):
         # one chunk of previously-scheduled bindings re-assigned with prev
         # seats (Steady scale-up/down + Fresh reschedule triggers)
-        reb_items = build_rebalance_items(
-            rng, items[: args.chunk], [c.name for c in clusters])
-        cache.reset_for_cycle()
-        reb_elapsed, _, reb_ok, _, _, _ = run_batched(
-            reb_items, cindex, estimator, args.chunk, cache, waves=args.waves)
-        rebalance_bps = len(reb_items) / reb_elapsed if reb_elapsed > 0 else 0.0
-
-        _hb("serial controls starting")
-        # serial control: prefer the C++ control (Go-equivalent); it is fast
-        # enough to run a much larger sample than the Python port
-        native_sample = items[:: max(1, len(items) // (args.serial_sample * 32))][
-            : args.serial_sample * 32
-        ]
-        nat = run_serial_native(native_sample, clusters)
-        sample = items[:: max(1, len(items) // args.serial_sample)][: args.serial_sample]
-        serial_elapsed, _ = run_serial(sample, clusters, estimator)
-        py_serial_throughput = (
-            len(sample) / serial_elapsed if serial_elapsed > 0 else 0.0
-        )
-        native_ok = nat is not None and nat[0] > 0
-        if native_ok:
-            serial_throughput = len(native_sample) / nat[0]
-            serial_lang = "c++ -O2 (native Go-equivalent control)"
+        if reb_rec is not None:
+            rebalance_bps = float(reb_rec["bps"])
+            reb_ok = int(reb_rec["ok"])
+            _hb("rebalance restored from checkpoint")
         else:
-            serial_throughput = py_serial_throughput
-            serial_lang = (
-                "python (Go-port control; Go itself would be ~10-100x faster)"
+            reb_items = build_rebalance_items(
+                rng, items[: args.chunk], [c.name for c in clusters])
+            cache.reset_for_cycle()
+            reb_elapsed, _, reb_ok, _, _, _ = run_batched(
+                reb_items, cindex, estimator, args.chunk, cache,
+                waves=args.waves)
+            rebalance_bps = (len(reb_items) / reb_elapsed
+                             if reb_elapsed > 0 else 0.0)
+            if ckpt_log is not None:
+                ckpt_log.append(kind="rebalance", ci=-1,
+                                bps=round(rebalance_bps, 2), ok=reb_ok)
+
+        # serial controls are platform-independent (pure host CPU): measure
+        # once per config, cache, and never spend a chip window on them
+        serial_key = (f"b{args.bindings}-c{args.clusters}"
+                      f"-s{args.serial_sample}-{source_digest(_SERIAL_SOURCES)}")
+        cached_serial = (None if args.fresh
+                         else load_serial_cache(args.ckpt_dir, serial_key))
+        if cached_serial is not None:
+            _hb("serial controls restored from cache")
+            serial_throughput = cached_serial["serial_bps"]
+            py_serial_throughput = cached_serial["py_serial_bps"]
+            serial_lang = cached_serial["serial_lang"]
+            native_ok = cached_serial["native_ok"]
+            n_native_sample = cached_serial["native_sample"]
+            n_py_sample = cached_serial["py_sample"]
+        else:
+            _hb("serial controls starting")
+            # prefer the C++ control (Go-equivalent); it is fast enough to
+            # run a much larger sample than the Python port
+            native_sample = items[
+                :: max(1, len(items) // (args.serial_sample * 32))][
+                : args.serial_sample * 32]
+            nat = run_serial_native(native_sample, clusters)
+            sample = items[:: max(1, len(items) // args.serial_sample)][
+                : args.serial_sample]
+            serial_elapsed, _ = run_serial(sample, clusters, estimator)
+            py_serial_throughput = (
+                len(sample) / serial_elapsed if serial_elapsed > 0 else 0.0
             )
+            native_ok = nat is not None and nat[0] > 0
+            if native_ok:
+                serial_throughput = len(native_sample) / nat[0]
+                serial_lang = "c++ -O2 (native Go-equivalent control)"
+            else:
+                serial_throughput = py_serial_throughput
+                serial_lang = ("python (Go-port control; Go itself would be "
+                               "~10-100x faster)")
+            n_native_sample = len(native_sample) if native_ok else len(sample)
+            n_py_sample = len(sample)
+            save_serial_cache(args.ckpt_dir, serial_key, {
+                "serial_bps": serial_throughput,
+                "py_serial_bps": py_serial_throughput,
+                "serial_lang": serial_lang, "native_ok": native_ok,
+                "native_sample": n_native_sample, "py_sample": n_py_sample,
+                "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             time.gmtime()),
+            })
         speedup = throughput / serial_throughput if serial_throughput > 0 else 0.0
     except Exception as e:  # noqa: BLE001 — leave a diagnostic trail, not a traceback
         import traceback
@@ -641,7 +969,7 @@ def main() -> None:
     # non-TPU results are labelled in the headline metric and report 0
     # speedup so no dashboard can mistake them for the real thing
     prefix = "" if on_tpu else "CPU-FALLBACK (NOT TPU) "
-    print(json.dumps({
+    payload = {
         "metric": f"{prefix}scheduled bindings/sec, {args.bindings} bindings x "
                   f"{args.clusters} clusters (end-to-end batched)",
         "value": round(throughput, 1),
@@ -668,9 +996,15 @@ def main() -> None:
             "rebalance_ok": reb_ok,
             "serial_bindings_per_s": round(serial_throughput, 2),
             "serial_python_bindings_per_s": round(py_serial_throughput, 2),
-            "serial_sample": len(native_sample) if native_ok else len(sample),
-            "serial_python_sample": len(sample),
+            "serial_sample": n_native_sample,
+            "serial_python_sample": n_py_sample,
+            "serial_cached": cached_serial is not None,
             "chunk": args.chunk,
+            # resumability: >0 restored chunks means this aggregate spans
+            # multiple sessions (relay drops between them); elapsed sums
+            # each session's own span
+            "resumed_chunks": n_restored,
+            "sessions_elapsed_prior_s": round(prior_elapsed, 1),
             # honesty note (BASELINE.md): the >=50x north star is against a
             # serial *Go-equivalent* path.  The control here is the compiled
             # C++ serial scheduler (native/serial_solver.cc, golden-tested
@@ -678,7 +1012,12 @@ def main() -> None:
             # Python port is reported alongside for continuity.
             "serial_lang": serial_lang,
         },
-    }))
+    }
+    print(json.dumps(payload))
+    if on_tpu:
+        # --fresh bypasses cache READS only: a deliberate fresh on-chip
+        # measurement is exactly the one worth persisting
+        save_tpu_latest(args.ckpt_dir, args, payload)
     if args.metrics:
         from karmada_tpu.utils.metrics import REGISTRY
 

@@ -208,6 +208,14 @@ from karmada_tpu.ops.tensors import (  # noqa: E402
 _AVAIL_BITS = 34  # avail values clamped below 2^34 for key packing
 _AVAIL_CAP = (1 << _AVAIL_BITS) - 1
 
+# Cluster-lane index bits in the packed sort keys.  The selection key packs
+# (score[8b] | avail[34b] | lane[21b]) = 63 bits, so int64 admits fleets up
+# to 2^21 clusters per solve call (the r3 design packed 13 bits / 8192
+# lanes, which capped real-world fleets; VERDICT r3 item 2).
+_LANE_BITS = 21
+_LANE_MASK = (1 << _LANE_BITS) - 1
+MAX_CLUSTER_LANES = 1 << _LANE_BITS
+
 
 def _capacity_estimates(
     req_milli, req_is_cpu, req_pods, avail_milli, has_alloc, pods_allowed,
@@ -258,12 +266,12 @@ def _select_by_cluster(
     exactly like _select_by_available_resource in ops/serial.py.
     """
     C = feasible.shape[0]
-    BIG = jnp.int64(1) << 62
+    BIG = jnp.int64(MAX_INT64)  # larger than any real packed key
     fcount = jnp.sum(feasible)
     avail_c = jnp.clip(avail, 0, _AVAIL_CAP)
     key = (
-        ((200 - score).astype(jnp.int64) << 47)
-        | ((_AVAIL_CAP - avail_c) << 13)
+        ((200 - score).astype(jnp.int64) << (_AVAIL_BITS + _LANE_BITS))
+        | ((_AVAIL_CAP - avail_c) << _LANE_BITS)
         | name_rank
     )
     key = jnp.where(feasible, key, BIG)
@@ -286,7 +294,10 @@ def _select_by_cluster(
             rest = feasible & ~in_sel
             # max avail, ties to smallest rest position (serial list order)
             cand = jnp.where(
-                rest, (avail_c << 13) | (8191 - jnp.clip(rest_pos, 0, 8191)), -1
+                rest,
+                (avail_c << _LANE_BITS)
+                | (_LANE_MASK - jnp.clip(rest_pos, 0, _LANE_MASK)),
+                -1,
             )
             best = jnp.argmax(cand)
             found = (cand[best] >= 0) & (avail[best] > avail[cur])
@@ -322,7 +333,7 @@ def _assign_lanes(
     gather — the math is lane-count agnostic).  rank_webster is a
     DENSIFIED 0..L-1 rank in rank_eff order (Webster's tie-key packing
     seat*L + rank requires rank < L); name_rank keeps original values for
-    the 13-bit packed sort keys."""
+    the _LANE_BITS-wide lane field of the packed sort keys."""
     C = feasible.shape[0]
     i64 = lambda x: jnp.asarray(x, jnp.int64)
     n = i64(n)
@@ -377,8 +388,8 @@ def _assign_lanes(
     prior = scale_up & (scheduled_rep > 0)
     wc = jnp.clip(w, 0, _AVAIL_CAP)
     agg_key = (
-        (jnp.where(prior, 0, 1).astype(jnp.int64) << 48)
-        | ((_AVAIL_CAP - wc) << 13)
+        (jnp.where(prior, 0, 1).astype(jnp.int64) << (_AVAIL_BITS + _LANE_BITS))
+        | ((_AVAIL_CAP - wc) << _LANE_BITS)
         | name_rank
     )
     agg_key = jnp.where(active, agg_key, (jnp.int64(1) << 62))
@@ -462,13 +473,13 @@ def _gather_lanes(feasible, avail_sel, w_gather, prev_present, name_rank,
     validity mask (duplicates and junk lanes disabled)."""
     C = feasible.shape[0]
     nr = jnp.asarray(name_rank, jnp.int64)
-    wq = jnp.clip(w_gather, 0, _AVAIL_CAP) << 13
-    aq = jnp.clip(avail_sel, 0, _AVAIL_CAP) << 13
+    wq = jnp.clip(w_gather, 0, _AVAIL_CAP) << _LANE_BITS
+    aq = jnp.clip(avail_sel, 0, _AVAIL_CAP) << _LANE_BITS
     NEG = jnp.int64(-1)
-    key_prev = jnp.where(prev_present, (8191 - nr), NEG)
-    key_w_rank = jnp.where(feasible, wq | (8191 - rank_eff), NEG)
-    key_w_name = jnp.where(feasible, wq | (8191 - nr), NEG)
-    key_a_name = jnp.where(feasible, aq | (8191 - nr), NEG)
+    key_prev = jnp.where(prev_present, (_LANE_MASK - nr), NEG)
+    key_w_rank = jnp.where(feasible, wq | (_LANE_MASK - rank_eff), NEG)
+    key_w_name = jnp.where(feasible, wq | (_LANE_MASK - nr), NEG)
+    key_a_name = jnp.where(feasible, aq | (_LANE_MASK - nr), NEG)
     _, ip = lax.top_k(key_prev, _G_PREV)
     _, iw = lax.top_k(key_w_rank, _G_TOPK)
     _, inm = lax.top_k(key_w_name, _G_TOPK)
@@ -773,8 +784,9 @@ def solve(batch, waves: int = 1):
     hot path uses solve_compact to avoid the dense D2H transfer."""
     import numpy as np
 
-    # packed sort keys reserve 13 bits for the cluster lane
-    assert batch.C <= 8192, "cluster axis must be <= 8192 per solve call"
+    # packed sort keys reserve _LANE_BITS bits for the cluster lane
+    assert batch.C <= MAX_CLUSTER_LANES, \
+        f"cluster axis must be <= {MAX_CLUSTER_LANES} per solve call"
     rep, sel, status = schedule_batch(*_batch_args(batch), waves=waves)
     return np.asarray(rep), np.asarray(sel), np.asarray(status)
 
@@ -788,7 +800,8 @@ def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
 
     keep_sel extracts every selected lane (empty-workload propagation);
     leave False otherwise — see _compact_of."""
-    assert batch.C <= 8192, "cluster axis must be <= 8192 per solve call"
+    assert batch.C <= MAX_CLUSTER_LANES, \
+        f"cluster axis must be <= {MAX_CLUSTER_LANES} per solve call"
     dense_nnz = batch.B * batch.C
     if max_nnz <= 0:
         # keep_sel ships whole selections (feasible-set scale on full-fleet

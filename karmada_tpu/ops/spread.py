@@ -38,14 +38,17 @@ import jax.numpy as jnp
 from karmada_tpu.ops import serial
 from karmada_tpu.ops.solver import (
     MAX_INT32,
+    MAX_INT64,
+    _AVAIL_BITS,
     _AVAIL_CAP,
+    _LANE_BITS,
     _capacity_estimates,
     _compact_of,
     _schedule_core,
 )
 
 WEIGHT_UNIT = serial.WEIGHT_UNIT  # 1000 (group_clusters.go:139)
-_BIG = jnp.int64(1) << 62
+_BIG = jnp.int64(MAX_INT64)  # larger than any real packed key
 
 
 def _sort_key(score, avail, name_rank, feasible):
@@ -53,8 +56,8 @@ def _sort_key(score, avail, name_rank, feasible):
     name asc (util.go) — same packing as the solver's selection key."""
     avail_c = jnp.clip(avail, 0, _AVAIL_CAP)
     key = (
-        ((200 - score).astype(jnp.int64) << 47)
-        | ((_AVAIL_CAP - avail_c) << 13)
+        ((200 - score).astype(jnp.int64) << (_AVAIL_BITS + _LANE_BITS))
+        | ((_AVAIL_CAP - avail_c) << _LANE_BITS)
         | name_rank
     )
     return jnp.where(feasible, key, _BIG)

@@ -404,6 +404,16 @@ def test_batch_parity_random_compact_lanes(seed):
     run_parity(seed, n_clusters=600, n_bindings=16)
 
 
+def test_batch_parity_wide_cluster_axis():
+    """C=16,384 — above the r3 13-bit lane cap (8192): the widened 21-bit
+    key packing (solver._LANE_BITS) must keep the compact-lane path
+    bit-identical to serial at fleet sizes the old packing rejected."""
+    from karmada_tpu.ops import solver
+
+    assert solver.MAX_CLUSTER_LANES >= 16384
+    run_parity(3, n_clusters=16384 - 5, n_bindings=6)
+
+
 def test_compact_cap_routing():
     """Bindings beyond the compact-lane exactness bounds route to the
     serial host path at large C, and stay on-device at small C."""
