@@ -198,7 +198,9 @@ class ChunkLog:
         try:
             import fcntl
 
-            self._lockf = open(path + ".lock", "w")
+            # per-sig lock: concurrent runs of DIFFERENT configs are safe
+            # (append-only single-line writes, load filters by sig)
+            self._lockf = open(f"{path}.{sig[:40]}.lock", "w")
             fcntl.flock(self._lockf, fcntl.LOCK_EX | fcntl.LOCK_NB)
         except OSError:
             self.disabled = True
@@ -846,11 +848,12 @@ def main() -> None:
         sig = config_sig(args, "tpu" if on_tpu else "cpu")
         chunks_path = os.path.join(args.ckpt_dir, "chunks.jsonl")
         if args.fresh:
+            # --fresh bypasses checkpoint READS only; newly measured chunks
+            # are still recorded (sig-gated, so later resumes stay correct)
             ckpt_done, reb_rec, prior_elapsed = {}, None, 0.0
-            ckpt_log = None
         else:
             ckpt_done, reb_rec, prior_elapsed = load_ckpt(chunks_path, sig)
-            ckpt_log = ChunkLog(chunks_path, sig)
+        ckpt_log = ChunkLog(chunks_path, sig)
         n_chunks = (len(items) + args.chunk - 1) // args.chunk
         n_restored = sum(1 for ci in range(n_chunks) if ci in ckpt_done)
         _hb(f"checkpoint: {n_restored}/{n_chunks} chunks restored"

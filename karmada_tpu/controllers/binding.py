@@ -116,7 +116,10 @@ class BindingController:
         targets = self._target_clusters(rb)
         completions = self._job_completions(rb, manifest, targets)
 
-        eviction = {t.from_cluster for t in rb.spec.graceful_eviction_tasks}
+        # Immediately-purged clusters do not keep their old Work alive; the
+        # task itself survives only as the injection payload carrier
+        eviction = {t.from_cluster for t in rb.spec.graceful_eviction_tasks
+                    if t.purge_mode != "Immediately"}
         keep = set()
         for target in targets:
             # never materialize a Work for a cluster that no longer exists:
@@ -130,12 +133,44 @@ class BindingController:
             if target.name in completions:
                 m = self.interpreter.revise_job_completions(m, completions[target.name])
             m = self.overrides.apply(m, self._cluster(target.name))
+            m = self._inject_preserved_state(rb, target, m, len(targets))
             suspend = self._suspended(rb, target.name)
             self._ensure_work(rb, target.name, m, suspend)
             keep.add(target.name)
         # graceful eviction: keep the old Work until the task drains
         keep |= eviction
         self._remove_works(ns, name, keep)
+
+    def _inject_preserved_state(self, rb: ResourceBinding,
+                                target: TargetCluster, manifest: Dict,
+                                n_targets: int) -> Dict:
+        """StatefulFailoverInjection (binding/common.go:171-207
+        injectReservedLabelState): merge the last eviction task's preserved
+        label state into the replacement cluster's rendered workload.
+        Restrictions mirror the reference: single-target bindings only,
+        latest task must be an Immediately/Directly purge with a non-empty
+        payload, and the move-to cluster must not be one the application
+        ran on before the failover."""
+        from karmada_tpu.utils.features import GATES
+
+        if not GATES.enabled("StatefulFailoverInjection"):
+            return manifest
+        if n_targets > 1 or not rb.spec.graceful_eviction_tasks:
+            return manifest
+        task = rb.spec.graceful_eviction_tasks[-1]
+        if task.purge_mode not in ("Immediately", "Directly"):
+            return manifest
+        if target.name in task.clusters_before_failover:
+            return manifest
+        if not task.preserved_label_state:
+            return manifest
+        m = dict(manifest)
+        meta = dict(m.get("metadata") or {})
+        labels = dict(meta.get("labels") or {})
+        labels.update(task.preserved_label_state)
+        meta["labels"] = labels
+        m["metadata"] = meta
+        return m
 
     def _suspended(self, rb: ResourceBinding, cluster: str) -> bool:
         s = rb.spec.suspension

@@ -53,6 +53,56 @@ PURGE_GRACIOUSLY = "Graciously"
 PURGE_NEVER = "Never"
 
 
+def parse_json_path(status, path: str) -> str:
+    """Evaluate a k8s-jsonpath-style expression against a collected status
+    dict (helper/failover.go:47-62 parseJSONValue with AllowMissingKeys
+    false).  Supports the subset state-preservation rules use in practice:
+    `{.a.b[0].c}` / `.a.b` / `a.b` — dotted fields with integer indexing.
+    Raises KeyError/IndexError on a missing segment."""
+    expr = path.strip()
+    if expr.startswith("{") and expr.endswith("}"):
+        expr = expr[1:-1].strip()
+    expr = expr.lstrip(".")
+    cur = status
+    if expr:
+        for part in expr.split("."):
+            fieldname, _, idxpart = part.partition("[")
+            indices = ([s.rstrip("]") for s in idxpart.split("[")]
+                       if idxpart else [])
+            if fieldname:
+                if not isinstance(cur, dict) or fieldname not in cur:
+                    raise KeyError(
+                        f"jsonpath {path!r}: missing field {fieldname!r}")
+                cur = cur[fieldname]
+            for idx in indices:
+                if not isinstance(cur, (list, tuple)):
+                    raise KeyError(f"jsonpath {path!r}: {fieldname!r} "
+                                   "is not an array")
+                i = int(idx)
+                if i >= len(cur):
+                    raise KeyError(f"jsonpath {path!r}: index {i} out of "
+                                   f"range")
+                cur = cur[i]
+    if isinstance(cur, bool):
+        return "true" if cur else "false"
+    if isinstance(cur, str):
+        return cur
+    if isinstance(cur, (int, float)):
+        return str(cur)
+    import json
+
+    return json.dumps(cur, sort_keys=True)
+
+
+def build_preserved_label_state(rules, status) -> Dict[str, str]:
+    """helper/failover.go:30-45 BuildPreservedLabelState: every rule must
+    resolve (a missing path aborts the whole build)."""
+    out: Dict[str, str] = {}
+    for rule in rules:
+        out[rule.alias_label_name] = parse_json_path(status, rule.json_path)
+    return out
+
+
 def evict_cluster(
     rb: ResourceBinding,
     cluster: str,
@@ -61,6 +111,9 @@ def evict_cluster(
     grace_period_seconds: Optional[int] = None,
     suppress_deletion: Optional[bool] = None,
     now: Optional[float] = None,
+    purge_mode: str = "",
+    preserved_label_state: Optional[Dict[str, str]] = None,
+    clusters_before_failover: Optional[list] = None,
 ) -> bool:
     """binding_types.go GracefulEvict semantics; returns True if changed."""
     target = next((t for t in rb.spec.clusters if t.name == cluster), None)
@@ -77,6 +130,9 @@ def evict_cluster(
         grace_period_seconds=grace_period_seconds,
         suppress_deletion=suppress_deletion,
         creation_timestamp=now if now is not None else time.time(),
+        purge_mode=purge_mode,
+        preserved_label_state=dict(preserved_label_state or {}),
+        clusters_before_failover=list(clusters_before_failover or []),
     ))
     return True
 
@@ -327,12 +383,14 @@ class ApplicationFailoverController:
     """
 
     def __init__(self, store: ObjectStore, runtime: Runtime,
-                 clock=None) -> None:
+                 clock=None, recorder=None) -> None:
         self.store = store
         self.clock = clock if clock is not None else time.time
+        self.recorder = recorder
         self._unhealthy_since: Dict[tuple, float] = {}
         self._round = 0
         self._seen_round: Dict[tuple, int] = {}
+        self._deferral_logged: set = set()
         runtime.register_periodic(self.run_once, name="application-failover")
 
     def run_once(self) -> None:
@@ -340,6 +398,51 @@ class ApplicationFailoverController:
         for rb in self.store.list(ResourceBinding.KIND):
             if rb.spec.failover is not None:
                 self._reconcile(rb)
+
+    def _task_state(self, rb: ResourceBinding, cluster: str):
+        """StatefulFailoverInjection payload for evicting `cluster`
+        (applicationfailover/common.go:139-170 buildTaskOptions): preserved
+        labels extracted from the failed cluster's collected status, plus
+        the pre-failover cluster set.  Returns (preserved, before, ok);
+        ok=False means the status needed by the rules has not been
+        collected yet — the eviction must wait (the reference surfaces an
+        error and retries)."""
+        from karmada_tpu.utils.features import GATES
+
+        rules = getattr(rb.spec.failover, "state_preservation", None) or []
+        if not rules or not GATES.enabled("StatefulFailoverInjection"):
+            return {}, [], True
+        item = next((i for i in rb.status.aggregated_status
+                     if i.cluster_name == cluster), None)
+        if item is None or item.status is None:
+            self._defer_event(rb, cluster,
+                              "application status not collected yet")
+            return {}, [], False
+        try:
+            preserved = build_preserved_label_state(rules, item.status)
+        except (KeyError, ValueError, IndexError) as e:
+            self._defer_event(rb, cluster,
+                              f"state preservation rule failed: {e}")
+            return {}, [], False
+        return preserved, [t.name for t in rb.spec.clusters], True
+
+    def _defer_event(self, rb: ResourceBinding, cluster: str,
+                     why: str) -> None:
+        """A deferred eviction must never be invisible: the reference
+        surfaces buildTaskOptions errors on every retry (common.go:147);
+        here the deferral lands in the event journal (coalesced) and on
+        stderr once per (binding, cluster)."""
+        msg = (f"application failover of cluster {cluster!r} deferred: "
+               f"{why}")
+        if self.recorder is not None:
+            self.recorder.event(rb, "Warning", "EvictionDeferred", msg)
+        key = (rb.namespace, rb.name, cluster)
+        if key not in self._deferral_logged:
+            self._deferral_logged.add(key)
+            import sys
+
+            print(f"[app-failover] {rb.namespace}/{rb.name}: {msg}",
+                  file=sys.stderr, flush=True)
 
     def _reconcile(self, rb: ResourceBinding) -> None:
         ns, name = rb.namespace, rb.name
@@ -368,20 +471,46 @@ class ApplicationFailoverController:
         if not to_evict:
             return
 
+        evicted: list = []
+
         def update(obj: ResourceBinding) -> None:
             changed = False
+            evicted.clear()  # mutate may retry the closure
             for cluster in to_evict:
+                preserved, before_fo, ok = self._task_state(obj, cluster)
+                if not ok:
+                    # state-preservation rules configured but the failed
+                    # cluster's status is not collected yet: keep the
+                    # workload until the payload can be built (common.go:
+                    # 147-151 returns an error and retries)
+                    continue
+                evicted.append(cluster)
                 if purge == PURGE_IMMEDIATELY:
-                    before = len(obj.spec.clusters)
-                    obj.spec.clusters = [
-                        t for t in obj.spec.clusters if t.name != cluster
-                    ]
-                    changed = changed or len(obj.spec.clusters) != before
+                    if preserved:
+                        # an Immediately task carries the injection payload
+                        # (binding/common.go:171-207 injects ONLY from
+                        # Immediately/Directly tasks); the Work itself is
+                        # not kept alive for Immediately purges
+                        changed = evict_cluster(
+                            obj, cluster, reason="ApplicationUnhealthy",
+                            producer="app-failover", now=now,
+                            purge_mode=PURGE_IMMEDIATELY,
+                            preserved_label_state=preserved,
+                            clusters_before_failover=before_fo,
+                        ) or changed
+                    else:
+                        before = len(obj.spec.clusters)
+                        obj.spec.clusters = [
+                            t for t in obj.spec.clusters if t.name != cluster
+                        ]
+                        changed = changed or len(obj.spec.clusters) != before
                 elif purge == PURGE_NEVER:
                     changed = evict_cluster(
                         obj, cluster, reason="ApplicationUnhealthy",
                         producer="app-failover", suppress_deletion=True,
-                        now=now,
+                        now=now, purge_mode=PURGE_NEVER,
+                        preserved_label_state=preserved,
+                        clusters_before_failover=before_fo,
                     ) or changed
                 else:
                     changed = evict_cluster(
@@ -389,12 +518,16 @@ class ApplicationFailoverController:
                         producer="app-failover",
                         grace_period_seconds=getattr(
                             rb.spec.failover, "grace_period_seconds", None),
-                        now=now,
+                        now=now, purge_mode=PURGE_GRACIOUSLY,
+                        preserved_label_state=preserved,
+                        clusters_before_failover=before_fo,
                     ) or changed
             # the spec change alone re-triggers scheduling; steady mode then
             # tops the lost replicas back up without disrupting survivors
 
         self.store.mutate(ResourceBinding.KIND, ns, name, update)
-        for cluster in to_evict:
+        # deferred evictions (payload not collectable yet) keep their
+        # tracking state so they fire as soon as the status arrives
+        for cluster in evicted:
             self._unhealthy_since.pop((ns, name, cluster), None)
             self._seen_round.pop((ns, name, cluster), None)

@@ -169,8 +169,9 @@ class ControlPlane:
             self.store, self.runtime, grace_period_s=eviction_grace_period_s,
             clock=self.clock,
         )
-        self.app_failover = ApplicationFailoverController(self.store, self.runtime,
-                                                          clock=self.clock)
+        self.app_failover = ApplicationFailoverController(
+            self.store, self.runtime, clock=self.clock,
+            recorder=self.recorder)
         self.namespace_sync = NamespaceSyncController(self.store, self.runtime)
         self.dependencies = DependenciesDistributor(
             self.store, self.runtime, self.interpreter

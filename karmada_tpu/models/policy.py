@@ -190,13 +190,26 @@ class Placement:
 
 
 @dataclass
+class StatePreservationRule:
+    """One state-preservation extraction rule (propagation_types.go:385-420
+    StatePreservation.Rules): pull `json_path` out of the failed cluster's
+    collected status and re-inject it as label `alias_label_name` on the
+    replacement cluster's rendered workload."""
+
+    alias_label_name: str = ""
+    json_path: str = ""
+
+
+@dataclass
 class FailoverBehavior:
     # application failover
     toleration_seconds: int = 300
     decision_conditions_toleration_seconds: Optional[int] = None
     purge_mode: str = "Graciously"  # Immediately | Graciously | Never
     grace_period_seconds: Optional[int] = None
-    stateful_preserved_label_state: Dict[str, str] = field(default_factory=dict)
+    # StatefulFailoverInjection (alpha, gated): state data preserved across
+    # failover events (propagation_types.go StatePreservation)
+    state_preservation: List[StatePreservationRule] = field(default_factory=list)
 
 
 @dataclass

@@ -95,7 +95,12 @@ class GracefulEvictionTask:
     grace_period_seconds: Optional[int] = None
     suppress_deletion: Optional[bool] = None
     creation_timestamp: float = 0.0
-    cluster_before_failover: List[str] = field(default_factory=list)
+    # how the legacy application on from_cluster is purged; recorded so the
+    # binding controller can decide whether preserved state may be injected
+    # (binding/common.go:171-207: only Immediately/Directly tasks inject)
+    purge_mode: str = ""
+    # StatefulFailoverInjection payload (binding_types.go:330-353)
+    clusters_before_failover: List[str] = field(default_factory=list)
     preserved_label_state: Dict[str, str] = field(default_factory=dict)
 
 

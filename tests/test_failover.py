@@ -250,3 +250,146 @@ def test_toleration_seconds_delays_and_cancels_eviction():
     rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
     assert not any(t.name == "m2" for t in rb.spec.clusters)
     assert sum(t.replicas for t in rb.spec.clusters) == 4
+
+
+def test_stateful_failover_injection_propagates_preserved_labels():
+    """StatefulFailoverInjection (gated): on application failover the
+    evicted cluster's collected status fields are preserved on the eviction
+    task and re-injected as labels into the replacement cluster's rendered
+    Work (reference applicationfailover/common.go:139-170 buildTaskOptions
+    + binding/common.go:171-207 injectReservedLabelState).  Surgical
+    controller-level flow: the payload only lives while the eviction task
+    does, so each hop is asserted mid-flight."""
+    from karmada_tpu.controllers.binding import BindingController, work_name
+    from karmada_tpu.controllers.failover import ApplicationFailoverController
+    from karmada_tpu.models.cluster import Cluster
+    from karmada_tpu.models.policy import StatePreservationRule
+    from karmada_tpu.models.unstructured import Unstructured
+    from karmada_tpu.models.work import (
+        AggregatedStatusItem,
+        ObjectReference,
+        ResourceBindingSpec,
+        TargetCluster,
+    )
+    from karmada_tpu.store.store import ObjectStore
+    from karmada_tpu.store.worker import Runtime
+    from karmada_tpu.utils.features import GATES
+
+    GATES.set("StatefulFailoverInjection", True)
+    try:
+        store = ObjectStore()
+        runtime = Runtime()
+        clock = [1000.0]
+        afc = ApplicationFailoverController(store, runtime,
+                                            clock=lambda: clock[0])
+        BindingController(store, runtime)
+        for m in ("m1", "m2"):
+            store.create(Cluster(metadata=ObjectMeta(name=m)))
+        store.create(Unstructured.from_manifest(deployment(4)))
+        rb = ResourceBinding(
+            metadata=ObjectMeta(name="app-deployment", namespace="default"),
+            spec=ResourceBindingSpec(
+                resource=ObjectReference(api_version="apps/v1",
+                                         kind="Deployment",
+                                         namespace="default", name="app",
+                                         uid="u1"),
+                replicas=4,
+                clusters=[TargetCluster(name="m1", replicas=4)],
+                failover=FailoverBehavior(
+                    toleration_seconds=0, purge_mode="Immediately",
+                    state_preservation=[
+                        StatePreservationRule(
+                            "failover.karmada.io/observed-replicas",
+                            "{.replicas}"),
+                        StatePreservationRule(
+                            "failover.karmada.io/ready", ".readyReplicas"),
+                    ]),
+            ),
+        )
+        rb.status.aggregated_status = [AggregatedStatusItem(
+            cluster_name="m1",
+            status={"replicas": 4, "readyReplicas": 0},
+            applied=True, health="Unhealthy",
+        )]
+        store.create(rb)
+        runtime.pump()
+
+        # two periodic rounds (eviction needs a prior unhealthy sighting)
+        afc.run_once()
+        clock[0] += 1.0
+        afc.run_once()
+        rb = store.get(ResourceBinding.KIND, "default", "app-deployment")
+        assert not rb.spec.clusters  # m1 evicted
+        task = rb.spec.graceful_eviction_tasks[-1]
+        assert task.purge_mode == "Immediately"
+        assert task.clusters_before_failover == ["m1"]
+        assert task.preserved_label_state[
+            "failover.karmada.io/observed-replicas"] == "4"
+        assert task.preserved_label_state[
+            "failover.karmada.io/ready"] == "0"
+
+        # scheduler re-places onto m2 (single target) -> render injects
+        def reschedule(obj):
+            obj.spec.clusters = [TargetCluster(name="m2", replicas=4)]
+        store.mutate(ResourceBinding.KIND, "default", "app-deployment",
+                     reschedule)
+        runtime.pump()
+        rb = store.get(ResourceBinding.KIND, "default", "app-deployment")
+        w = store.get(Work.KIND, "karmada-es-m2", work_name(rb))
+        labels = w.spec.workload[0]["metadata"].get("labels", {})
+        assert labels.get("failover.karmada.io/observed-replicas") == "4"
+        assert labels.get("failover.karmada.io/ready") == "0"
+        # Immediately purge: the old cluster's Work is NOT kept alive
+        assert store.try_get(Work.KIND, "karmada-es-m1",
+                             work_name(rb)) is None
+        # the template itself is NOT mutated -- injection is render-scoped
+        tmpl = store.get("Deployment", "default", "app")
+        assert "failover.karmada.io/observed-replicas" not in (
+            tmpl.manifest["metadata"].get("labels") or {})
+    finally:
+        GATES.set("StatefulFailoverInjection", False)
+
+
+def test_stateful_failover_injection_gate_off_by_default():
+    """With the gate off (default) the eviction path records no preserved
+    payload and rendering injects nothing."""
+    from karmada_tpu.controllers.failover import (
+        build_preserved_label_state,
+        parse_json_path,
+    )
+    from karmada_tpu.models.policy import StatePreservationRule
+
+    # jsonpath evaluator unit checks (helper/failover.go parseJSONValue)
+    st = {"replicas": 3, "conds": [{"type": "Ready", "ok": True}],
+          "name": "db-0"}
+    assert parse_json_path(st, "{.replicas}") == "3"
+    assert parse_json_path(st, ".conds[0].type") == "Ready"
+    assert parse_json_path(st, "conds[0].ok") == "true"
+    assert parse_json_path(st, "{.name}") == "db-0"
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError):
+        parse_json_path(st, "{.missing}")
+    with _pytest.raises(KeyError):
+        parse_json_path(st, ".conds[7].type")
+    assert build_preserved_label_state(
+        [StatePreservationRule("a", "{.replicas}")], st) == {"a": "3"}
+
+    cp = ControlPlane(eviction_grace_period_s=600)
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.add_member("m2", cpu_milli=64_000)
+    cp.tick()
+    cp.apply_policy(dynamic_policy(failover=FailoverBehavior(
+        toleration_seconds=0,
+        state_preservation=[StatePreservationRule("x", "{.replicas}")])))
+    cp.apply(deployment(4))
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    victim = sorted(t.name for t in rb.spec.clusters)[0]
+    cp.member(victim).cpu_allocatable_milli = 100
+    cp.tick()
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+    assert victim not in {t.name for t in rb.spec.clusters}
+    for task in rb.spec.graceful_eviction_tasks:
+        assert task.preserved_label_state == {}

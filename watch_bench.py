@@ -48,7 +48,11 @@ def main() -> int:
             f"({probe['attempts'][-1]['s']}s) — launching bench")
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py"),
-             "--no-cpu-fallback", "--probe-timeout", "120", *args],
+             "--no-cpu-fallback",
+             # the child's probe gets at least the budget the successful
+             # watcher probe needed (a slow-answering device must not pass
+             # the watcher only to time out in the child every cycle)
+             "--probe-timeout", str(int(PROBE_TIMEOUT_S)), *args],
             capture_output=True, text=True)
         line = bench._last_json_line((r.stdout or "").splitlines())
         log(f"bench rc={r.returncode}; stderr tail: "
