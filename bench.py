@@ -184,7 +184,7 @@ def load_ckpt(path: str, sig: str):
 class ChunkLog:
     """Append-only per-chunk measurement log (one JSON line per chunk)."""
 
-    def __init__(self, path: str, sig: str) -> None:
+    def __init__(self, path: str, sig: str, prune: bool = False) -> None:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         self.path, self.sig = path, sig
         import uuid
@@ -206,6 +206,25 @@ class ChunkLog:
             self.disabled = True
             print("[bench] another bench holds the checkpoint lock; this "
                   "run will not checkpoint", file=sys.stderr, flush=True)
+        if prune and not self.disabled:
+            # --fresh: retire this sig's stale records NOW (load is
+            # first-wins for concurrent-writer safety, so appending fresh
+            # records would otherwise be shadowed on the next resume)
+            try:
+                with open(self.path) as f:
+                    lines = f.readlines()
+                kept = []
+                for ln in lines:
+                    try:
+                        if json.loads(ln).get("sig") == sig:
+                            continue
+                    except json.JSONDecodeError:
+                        continue  # torn line: drop
+                    kept.append(ln)
+                with open(self.path, "w") as f:
+                    f.writelines(kept)
+            except OSError:
+                pass
 
     def reset_t0(self) -> None:
         """Start the session span at the TIMED run, not at warmup: t_rel
@@ -848,12 +867,13 @@ def main() -> None:
         sig = config_sig(args, "tpu" if on_tpu else "cpu")
         chunks_path = os.path.join(args.ckpt_dir, "chunks.jsonl")
         if args.fresh:
-            # --fresh bypasses checkpoint READS only; newly measured chunks
-            # are still recorded (sig-gated, so later resumes stay correct)
+            # --fresh bypasses checkpoint READS (and retires this sig's
+            # stale records via prune); newly measured chunks are still
+            # recorded so an interrupted fresh run resumes correctly
             ckpt_done, reb_rec, prior_elapsed = {}, None, 0.0
         else:
             ckpt_done, reb_rec, prior_elapsed = load_ckpt(chunks_path, sig)
-        ckpt_log = ChunkLog(chunks_path, sig)
+        ckpt_log = ChunkLog(chunks_path, sig, prune=args.fresh)
         n_chunks = (len(items) + args.chunk - 1) // args.chunk
         n_restored = sum(1 for ci in range(n_chunks) if ci in ckpt_done)
         _hb(f"checkpoint: {n_restored}/{n_chunks} chunks restored"

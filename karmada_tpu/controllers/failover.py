@@ -79,7 +79,10 @@ def parse_json_path(status, path: str) -> str:
                     raise KeyError(f"jsonpath {path!r}: {fieldname!r} "
                                    "is not an array")
                 i = int(idx)
-                if i >= len(cur):
+                if i < 0 or i >= len(cur):
+                    # k8s jsonpath rejects negative indices; silently
+                    # resolving them would build payloads the reference
+                    # never would
                     raise KeyError(f"jsonpath {path!r}: index {i} out of "
                                    f"range")
                 cur = cur[i]
@@ -403,28 +406,28 @@ class ApplicationFailoverController:
         """StatefulFailoverInjection payload for evicting `cluster`
         (applicationfailover/common.go:139-170 buildTaskOptions): preserved
         labels extracted from the failed cluster's collected status, plus
-        the pre-failover cluster set.  Returns (preserved, before, ok);
-        ok=False means the status needed by the rules has not been
-        collected yet — the eviction must wait (the reference surfaces an
-        error and retries)."""
+        the pre-failover cluster set.  Returns (preserved, ok); ok=False
+        means the status needed by the rules has not been collected yet —
+        the eviction must wait (the reference surfaces an error and
+        retries)."""
         from karmada_tpu.utils.features import GATES
 
         rules = getattr(rb.spec.failover, "state_preservation", None) or []
         if not rules or not GATES.enabled("StatefulFailoverInjection"):
-            return {}, [], True
+            return {}, True
         item = next((i for i in rb.status.aggregated_status
                      if i.cluster_name == cluster), None)
         if item is None or item.status is None:
             self._defer_event(rb, cluster,
                               "application status not collected yet")
-            return {}, [], False
+            return {}, False
         try:
             preserved = build_preserved_label_state(rules, item.status)
         except (KeyError, ValueError, IndexError) as e:
             self._defer_event(rb, cluster,
                               f"state preservation rule failed: {e}")
-            return {}, [], False
-        return preserved, [t.name for t in rb.spec.clusters], True
+            return {}, False
+        return preserved, True
 
     def _defer_event(self, rb: ResourceBinding, cluster: str,
                      why: str) -> None:
@@ -476,8 +479,13 @@ class ApplicationFailoverController:
         def update(obj: ResourceBinding) -> None:
             changed = False
             evicted.clear()  # mutate may retry the closure
+            # snapshot BEFORE any eviction mutates the list: every task of
+            # this pass must record the same pre-failover cluster set, or
+            # later tasks omit earlier-evicted clusters and the injection
+            # guard lets preserved state land on a pre-failover cluster
+            before_fo = [t.name for t in obj.spec.clusters]
             for cluster in to_evict:
-                preserved, before_fo, ok = self._task_state(obj, cluster)
+                preserved, ok = self._task_state(obj, cluster)
                 if not ok:
                     # state-preservation rules configured but the failed
                     # cluster's status is not collected yet: keep the
@@ -531,3 +539,6 @@ class ApplicationFailoverController:
         for cluster in evicted:
             self._unhealthy_since.pop((ns, name, cluster), None)
             self._seen_round.pop((ns, name, cluster), None)
+            # a fresh failover episode on this cluster gets its own
+            # deferral notice (and the set stays bounded)
+            self._deferral_logged.discard((ns, name, cluster))

@@ -139,12 +139,48 @@ def test_multi_template_routes_to_device_and_matches_serial():
         assert set(got_map.values()) == {0}  # propagated whole, no division
 
 
-def test_multi_component_without_single_cluster_constraint_routes_serial():
-    spec = _mt_spec(0)
-    spec.placement = Placement()
-    cindex = tensors.ClusterIndex.build([mk_cluster("m0")])
-    batch = tensors.encode_batch([(spec, ResourceBindingStatus())], cindex)
-    assert batch.route[0] == tensors.ROUTE_MULTI_COMPONENT
+def test_multi_component_without_single_cluster_constraint_on_device():
+    """Non-applicable multi-component shapes (no 1..1 cluster constraint)
+    stay on device too: serial estimates them per-replica with nil
+    requirements (allowed-pods row) and propagates with replicas 0 — the
+    kernel's non_workload path.  Parity across several placement shapes."""
+    rng = random.Random(5)
+    clusters = [
+        mk_cluster(f"m{i}", cpu=str(rng.choice([8, 16, 64])),
+                   mem=rng.choice(["32Gi", "64Gi", "256Gi"]),
+                   pods=rng.choice([10, 110]))
+        for i in range(7)
+    ]
+    shapes = [
+        Placement(),  # no constraints at all
+        Placement(spread_constraints=[SpreadConstraint(  # wider than 1..1
+            spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+            min_groups=2, max_groups=4)]),
+        Placement(spread_constraints=[SpreadConstraint(  # min 1, max 3
+            spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+            min_groups=1, max_groups=3)]),
+    ]
+    items = []
+    for b in range(9):
+        spec = _mt_spec(b, uid=f"uid-{b}")
+        spec.placement = shapes[b % len(shapes)]
+        items.append((spec, ResourceBindingStatus()))
+    est = GeneralEstimator()
+    cal = serial.make_cal_available([est])
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch(items, cindex, est)
+    assert (batch.route == tensors.ROUTE_DEVICE).all()
+    rep, sel, status = solve(batch)
+    got = tensors.decode_result(batch, rep, sel, status, items=items)
+    for b, (spec, st) in enumerate(items):
+        try:
+            want = serial.schedule(spec, st, clusters, cal)
+        except Exception as e:  # noqa: BLE001
+            assert isinstance(got[b], type(e)), (b, e, got[b])
+            continue
+        want_map = {tc.name: tc.replicas for tc in want}
+        got_map = {tc.name: tc.replicas for tc in got[b]}
+        assert got_map == want_map, f"b={b}: serial={want_map} device={got_map}"
 
 
 def test_estimator_server_component_sets():

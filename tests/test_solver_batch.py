@@ -125,6 +125,20 @@ def mk_placement(rng, names):
             spread_by_field=SPREAD_BY_FIELD_CLUSTER,
             min_groups=mn, max_groups=rng.randint(mn, 5),
         ))
+        if rng.random() < 0.3:
+            # provider/zone alongside cluster: filters only (clusters
+            # without the property drop out); selection stays by-cluster
+            # and the binding stays on device
+            from karmada_tpu.models.policy import (
+                SPREAD_BY_FIELD_PROVIDER,
+                SPREAD_BY_FIELD_ZONE,
+            )
+
+            spread.append(SpreadConstraint(
+                spread_by_field=rng.choice([SPREAD_BY_FIELD_PROVIDER,
+                                            SPREAD_BY_FIELD_ZONE]),
+                min_groups=1, max_groups=rng.randint(1, 3),
+            ))
     strat = rng.choice(["dup", "static", "dynamic", "agg"])
     if strat == "dup":
         rs = ReplicaSchedulingStrategy(replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED)
@@ -281,11 +295,11 @@ def test_topology_spread_routing():
     # region spread with few regions: the device spread path (ops/spread.py)
     batch = tensors.encode_batch([(spec_with("region"), ResourceBindingStatus())], cindex)
     assert batch.route[0] == tensors.ROUTE_DEVICE_SPREAD
-    # provider/zone spread: host (the reference only selects by
-    # cluster+region; these fail identically on the serial path)
+    # provider/zone ALONGSIDE a cluster constraint: feasibility filter
+    # only — stays on device (selection is by-cluster)
     for field in ("provider", "zone"):
         batch = tensors.encode_batch([(spec_with(field), ResourceBindingStatus())], cindex)
-        assert batch.route[0] == tensors.ROUTE_TOPOLOGY_SPREAD
+        assert batch.route[0] == tensors.ROUTE_DEVICE
 
 
 def test_jit_signature_stable_across_vocab_churn():
@@ -309,9 +323,20 @@ def test_jit_signature_stable_across_vocab_churn():
 
     # 1 placement, 1 class, 1 gvk, 2 resources
     one = [mk_binding(rng, 0, names, [mk_placement(rng, names)])]
-    # 3 placements, several classes, 2 gvks (all under the bucket minima)
+    # 3 placements, several classes, 2 gvks (all under the bucket minima);
+    # request classes pinned to 3 distinct profiles so the Q axis stays
+    # under its bucket regardless of generator drift
     placements = [mk_placement(rng, names) for _ in range(3)]
     many = [mk_binding(rng, b, names, placements) for b in range(8)]
+    profiles = [
+        {"cpu": Quantity.from_milli(100), "memory": Quantity.from_units(1)},
+        {"cpu": Quantity.from_milli(250), "memory": Quantity.from_units(2)},
+        {"cpu": Quantity.from_milli(500)},
+    ]
+    for b, (spec, _st) in enumerate(many):
+        if spec.replica_requirements is not None:
+            spec.replica_requirements.resource_request = dict(
+                profiles[b % 3])
     many[0][0].resource.kind = "StatefulSet"
 
     assert shapes(one) == shapes(many)
@@ -402,6 +427,73 @@ def test_batch_parity_random_compact_lanes(seed):
     uid-flipped tiebreak order, all of which constrain WHICH lanes the
     gather must contain."""
     run_parity(seed, n_clusters=600, n_bindings=16)
+
+
+def test_provider_zone_spread_routing():
+    """Provider/zone constraints: alongside cluster/region selection they
+    stay on device (pure feasibility filters); alone they go host for the
+    reference's 'just support cluster and region' UnschedulableError
+    (select_clusters.go:55)."""
+    from karmada_tpu.models.policy import (
+        SPREAD_BY_FIELD_PROVIDER,
+        SPREAD_BY_FIELD_ZONE,
+    )
+
+    rng = random.Random(1)
+    names = [f"member-{i:02d}" for i in range(8)]
+    clusters = [mk_cluster(rng, nm) for nm in names]
+    for c in clusters:  # deterministic usable fleet for this check
+        c.metadata.deletion_timestamp = None
+        c.spec.provider = "aws"
+        c.status.api_enablements = [APIEnablement(GVK[0], [GVK[1]])]
+
+    def binding(scs):
+        spec = ResourceBindingSpec(
+            resource=ObjectReference(api_version=GVK[0], kind=GVK[1],
+                                     namespace="ns", name="a", uid="u"),
+            replicas=4,
+            replica_requirements=ReplicaRequirements(resource_request={
+                "cpu": Quantity.from_milli(100)}),
+            placement=Placement(
+                spread_constraints=scs,
+                replica_scheduling=ReplicaSchedulingStrategy(
+                    replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                    replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                    weight_preference=ClusterPreferences(
+                        dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS)),
+            ),
+        )
+        return spec, ResourceBindingStatus()
+
+    provider_sc = SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_PROVIDER,
+                                   min_groups=1, max_groups=2)
+    zone_sc = SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_ZONE,
+                               min_groups=1, max_groups=2)
+    cluster_sc = SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+                                  min_groups=1, max_groups=3)
+    items = [
+        binding([provider_sc, cluster_sc]),   # on device
+        binding([provider_sc]),               # host: UnschedulableError
+        binding([zone_sc]),                   # host (zone filter empties
+                                              # the fleet first: FitError)
+    ]
+    cindex = tensors.ClusterIndex.build(clusters)
+    est = GeneralEstimator()
+    batch = tensors.encode_batch(items, cindex, est)
+    assert batch.route[0] == tensors.ROUTE_DEVICE
+    assert batch.route[1] == tensors.ROUTE_TOPOLOGY_SPREAD
+    assert batch.route[2] == tensors.ROUTE_TOPOLOGY_SPREAD
+
+    cal = serial.make_cal_available([est])
+    rep, sel, status = solve(batch)
+    got = tensors.decode_result(batch, rep, sel, status, items=items)
+    want = serial.schedule(items[0][0], items[0][1], clusters, cal)
+    assert ({tc.name: tc.replicas for tc in got[0]}
+            == {tc.name: tc.replicas for tc in want})
+    with pytest.raises(serial.UnschedulableError):
+        serial.schedule(items[1][0], items[1][1], clusters, cal)
+    with pytest.raises(serial.FitError):
+        serial.schedule(items[2][0], items[2][1], clusters, cal)
 
 
 def test_batch_parity_wide_cluster_axis():

@@ -34,6 +34,8 @@ from tests.test_solver_batch import GVK, mk_binding, mk_cluster
 def mk_region_cluster(rng, name, region):
     c = mk_cluster(rng, name)
     c.spec.region = region
+    if rng.random() < 0.5:
+        c.spec.zones = [f"z{rng.randint(0, 2)}"]
     # the harness randomizes taints/deleting; keep a usable fleet
     return c
 
@@ -50,6 +52,20 @@ def mk_spread_placement(rng, names):
         scs.append(SpreadConstraint(
             spread_by_field=SPREAD_BY_FIELD_CLUSTER,
             min_groups=cmin, max_groups=rng.randint(cmin, 6),
+        ))
+    if rng.random() < 0.3:
+        # provider/zone constraints only filter clusters missing the
+        # property (selection stays region+cluster) — they must not knock
+        # the binding off the device spread path
+        from karmada_tpu.models.policy import (
+            SPREAD_BY_FIELD_PROVIDER,
+            SPREAD_BY_FIELD_ZONE,
+        )
+
+        scs.append(SpreadConstraint(
+            spread_by_field=rng.choice([SPREAD_BY_FIELD_PROVIDER,
+                                        SPREAD_BY_FIELD_ZONE]),
+            min_groups=1, max_groups=rng.randint(1, 3),
         ))
     strat = rng.choice(["dup", "dynamic", "agg"])
     if strat == "dup":
