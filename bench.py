@@ -165,6 +165,13 @@ def load_ckpt(path: str, sig: str):
                     continue  # torn final line from a killed run
                 if rec.get("sig") != sig:
                     continue
+                if rec.get("kind") == "fresh":
+                    # --fresh generation marker: everything this sig
+                    # recorded before it is retired
+                    done.clear()
+                    sessions.clear()
+                    reb = None
+                    continue
                 if rec.get("kind") == "rebalance":
                     reb = rec
                     continue
@@ -207,24 +214,11 @@ class ChunkLog:
             print("[bench] another bench holds the checkpoint lock; this "
                   "run will not checkpoint", file=sys.stderr, flush=True)
         if prune and not self.disabled:
-            # --fresh: retire this sig's stale records NOW (load is
-            # first-wins for concurrent-writer safety, so appending fresh
-            # records would otherwise be shadowed on the next resume)
-            try:
-                with open(self.path) as f:
-                    lines = f.readlines()
-                kept = []
-                for ln in lines:
-                    try:
-                        if json.loads(ln).get("sig") == sig:
-                            continue
-                    except json.JSONDecodeError:
-                        continue  # torn line: drop
-                    kept.append(ln)
-                with open(self.path, "w") as f:
-                    f.writelines(kept)
-            except OSError:
-                pass
+            # --fresh: retire this sig's earlier records with an APPEND-ONLY
+            # generation marker (load_ckpt discards same-sig records seen
+            # before it).  A rewrite would race concurrent different-config
+            # appenders, which the per-sig lock deliberately allows.
+            self.append(kind="fresh")
 
     def reset_t0(self) -> None:
         """Start the session span at the TIMED run, not at warmup: t_rel

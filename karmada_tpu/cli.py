@@ -552,19 +552,9 @@ def cmd_taint(args) -> int:
 
 def _model_registry():
     """kind -> dataclass for every registered API type."""
-    import dataclasses
+    from karmada_tpu.models.codec import model_registry
 
-    from karmada_tpu.models import (autoscaling, certs, cluster, config,
-                                    extras, networking, policy, search, work)
-
-    out = {}
-    for mod in (cluster, policy, work, config, extras,
-                autoscaling, networking, search, certs):
-        for obj in vars(mod).values():
-            kind = getattr(obj, "KIND", None)
-            if dataclasses.is_dataclass(obj) and isinstance(kind, str) and kind:
-                out[kind] = obj
-    return out
+    return model_registry()
 
 
 def cmd_api_resources(args) -> int:
@@ -825,6 +815,18 @@ def cmd_serve(args) -> int:
         url = obs.start(port=args.metrics_port)
         print(f"observability endpoint at {url} "
               "(/metrics /healthz /readyz /debug/state)")
+    api = None
+    if args.api_port >= 0:
+        from karmada_tpu.search.httpapi import QueryPlaneServer
+
+        api = QueryPlaneServer(
+            cp.store, cp.members, cp.cluster_proxy,
+            search_cache=cp.search_cache,
+            metrics_provider=cp.metrics_provider)
+        api_url = api.start(port=args.api_port)
+        print(f"query plane at {api_url} "
+              "(cluster proxy, search cache, metrics adapter; "
+              f"karmadactl --server {api_url})")
     cp.runtime.serve()
     print(f"serving control plane from {args.dir} "
           f"(backend={args.backend}, {len(cp.members)} members); ctrl-c to stop")
@@ -840,14 +842,170 @@ def cmd_serve(args) -> int:
     finally:
         if obs is not None:
             obs.stop()
+        if api is not None:
+            api.stop()
         cp.runtime.stop()
         cp.checkpoint()
     return 0
 
 
+# -- remote mode (--server): the query plane over HTTP ------------------------
+# Reference: karmadactl talks to the aggregated apiserver by URL; here the
+# same four data-path verbs (get / logs / exec / top) target a plane served
+# by `karmadactl serve --api-port` (karmada_tpu/search/httpapi.py).
+
+
+def _http_json(server: str, method: str, path: str, body=None, params=None):
+    """One JSON request to the served query plane.  Returns (code, payload)."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    url = server.rstrip("/") + path
+    if params:
+        filtered = {k: v for k, v in params.items() if v not in (None, "")}
+        if filtered:
+            url += "?" + urllib.parse.urlencode(filtered)
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read() or b"null")
+        except json.JSONDecodeError:
+            payload = {"error": str(e)}
+        return e.code, payload
+    except urllib.error.URLError as e:
+        print(f"cannot reach {server}: {e.reason}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _remote_fail(code, payload) -> int:
+    msg = payload.get("error", payload) if isinstance(payload, dict) else payload
+    print(f"server error ({code}): {msg}", file=sys.stderr)
+    return 1
+
+
+def cmd_get_remote(args) -> int:
+    if args.kind == "pods":
+        args.kind = "Pod"
+    if args.cluster:
+        if args.kind == "Pod":
+            code, pods = _http_json(
+                args.server, "GET", f"/clusters/{args.cluster}/proxy/pods",
+                params={"namespace": args.namespace})
+            if code != 200:
+                return _remote_fail(code, pods)
+            pods = [p for p in pods if not args.name or p["name"] == args.name]
+            if args.output == "json":
+                for p in pods:
+                    print(json.dumps(p))
+                return 0
+            _print_table(
+                [[p["name"], p["namespace"], p["owner"],
+                  "True" if p["ready"] else "False"] for p in pods]
+                or [["-", "-", "-", "-"]],
+                ["NAME", "NAMESPACE", "OWNER", "READY"])
+            return 0
+        path = (f"/clusters/{args.cluster}/proxy/{args.kind}"
+                + (f"/{args.namespace}/{args.name}" if args.name else ""))
+        code, out = _http_json(args.server, "GET", path,
+                               params={"namespace": args.namespace})
+        if code != 200:
+            return _remote_fail(code, out)
+        manifests = out if isinstance(out, list) else [out]
+        if args.output == "json":
+            for m in manifests:
+                print(json.dumps(m))
+            return 0
+        from karmada_tpu.models.unstructured import Unstructured
+        from karmada_tpu.printers import render, table_for
+
+        objs = [Unstructured.from_manifest(m) for m in manifests]
+        headers, rows = table_for(args.kind, objs)
+        print(render(headers, rows))
+        return 0
+    if args.output == "json" or args.name:
+        path = (f"/api/{args.kind}/{args.namespace}/{args.name}"
+                if args.name else f"/api/{args.kind}")
+        code, out = _http_json(args.server, "GET", path,
+                               params={"namespace": args.namespace})
+        if code != 200:
+            return _remote_fail(code, out)
+        for m in (out if isinstance(out, list) else [out]):
+            print(json.dumps(m, default=str))
+        return 0
+    # table view rendered server-side (typed kinds need the live objects)
+    code, out = _http_json(args.server, "GET", f"/api-table/{args.kind}",
+                           params={"namespace": args.namespace})
+    if code != 200:
+        return _remote_fail(code, out)
+    _print_table(out["rows"] or [["-"] * len(out["headers"])], out["headers"])
+    return 0
+
+
+def cmd_logs_remote(args) -> int:
+    code, out = _http_json(
+        args.server, "GET",
+        f"/clusters/{args.cluster}/proxy/logs/"
+        f"{args.namespace or 'default'}/{args.pod}",
+        params={"tail": args.tail})
+    if code != 200:
+        return _remote_fail(code, out)
+    for line in out["lines"]:
+        print(line)
+    return 0
+
+
+def cmd_exec_remote(args) -> int:
+    code, out = _http_json(
+        args.server, "POST",
+        f"/clusters/{args.cluster}/proxy/exec/"
+        f"{args.namespace or 'default'}/{args.pod}",
+        body={"command": args.cmd})
+    if code != 200:
+        return _remote_fail(code, out)
+    if out.get("output"):
+        print(out["output"])
+    return int(out.get("rc", 0))
+
+
+def cmd_top_remote(args) -> int:
+    if args.what == "pods":
+        code, out = _http_json(
+            args.server, "GET",
+            f"/metrics-adapter/pods/Deployment/"
+            f"{args.namespace or 'default'}/{args.name or ''}")
+        if code != 200:
+            return _remote_fail(code, out)
+        rows = []
+        for pm in out:
+            usage = pm.get("usage", {})
+            rows.append([
+                pm.get("cluster", "-"), pm.get("name", "-"),
+                f"{usage.get('cpu', 0)}m",
+                f"{usage.get('memory', 0) // 1000 // (1 << 20)}Mi",
+            ])
+        _print_table(rows or [["-", "-", "-", "-"]],
+                     ["CLUSTER", "POD", "CPU", "MEMORY"])
+        return 0
+    code, out = _http_json(args.server, "GET", "/api-table/Cluster")
+    if code != 200:
+        return _remote_fail(code, out)
+    _print_table(out["rows"] or [["-"] * len(out["headers"])], out["headers"])
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="karmadactl", description=__doc__)
-    p.add_argument("--dir", required=True, help="control plane directory")
+    p.add_argument("--dir", default=None, help="control plane directory")
+    p.add_argument("--server", default=None,
+                   help="URL of a served query plane (karmadactl serve "
+                        "--api-port); get/logs/exec/top run over HTTP "
+                        "instead of opening --dir")
     sub = p.add_subparsers(dest="command", required=True)
 
     sub.add_parser("init")
@@ -1009,6 +1167,11 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--metrics-port", type=int, default=-1,
                     help="serve /metrics,/healthz,/readyz,/debug/state on "
                          "127.0.0.1:PORT (0 = ephemeral, -1 = disabled)")
+    sv.add_argument("--api-port", type=int, default=-1,
+                    help="serve the query plane (cluster proxy verbs, "
+                         "search cache GET/LIST/WATCH, metrics adapter) "
+                         "over HTTP on 127.0.0.1:PORT (0 = ephemeral, "
+                         "-1 = disabled); clients use --server URL")
     return p
 
 
@@ -1064,7 +1227,26 @@ COMMANDS = {
 }
 
 
+REMOTE_COMMANDS = {
+    "get": "cmd_get_remote",
+    "logs": "cmd_logs_remote",
+    "exec": "cmd_exec_remote",
+    "top": "cmd_top_remote",
+}
+
+
 def _dispatch(args) -> int:
+    if getattr(args, "server", None):
+        handler = REMOTE_COMMANDS.get(args.command)
+        if handler is None:
+            print(f"{args.command} is not available over --server "
+                  "(open the plane with --dir)", file=sys.stderr)
+            return 1
+        return globals()[handler](args)
+    if args.dir is None:
+        print("--dir is required (or --server for get/logs/exec/top)",
+              file=sys.stderr)
+        return 1
     return COMMANDS[args.command](args)
 
 

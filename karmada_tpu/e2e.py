@@ -367,7 +367,22 @@ class ControlPlane:
         (aggregated apiserver cluster proxy, proxy.go:73)."""
         return self.cluster_proxy.connect(cluster, subject)
 
-    def apply(self, manifest: dict) -> Unstructured:
+    def apply(self, manifest: dict):
+        from karmada_tpu.models.codec import from_manifest_typed
+
+        typed = from_manifest_typed(manifest)
+        if typed is not None:
+            # a registered karmada API kind: decode to the typed model so
+            # admission mutators/validators and controllers see real
+            # objects (karmadactl apply -f of a PropagationPolicy etc.)
+            existing = self.store.try_get(
+                typed.KIND, typed.namespace, typed.name)
+            if existing is None:
+                return self.store.create(typed)
+            typed.metadata.resource_version = existing.metadata.resource_version
+            typed.metadata.uid = existing.metadata.uid or typed.metadata.uid
+            typed.metadata.generation = existing.metadata.generation
+            return self.store.update(typed)
         obj = Unstructured.from_manifest(manifest)
         existing = self.store.try_get(obj.KIND, obj.namespace, obj.name)
         if existing is None:

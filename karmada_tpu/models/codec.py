@@ -1,0 +1,104 @@
+"""Manifest <-> typed-model codec.
+
+The reference's client machinery decodes YAML/JSON manifests into typed Go
+structs via generated deepcopy/scheme code; here one generic loader walks
+the dataclass tree instead (no generated code): camelCase manifest keys map
+to snake_case fields, nested dataclasses / lists / dicts / Optionals
+recurse, and `Quantity` values parse from their k8s string forms.
+
+Used by karmadactl apply/create/edit (a `PropagationPolicy` YAML becomes a
+real models.policy.PropagationPolicy, so admission mutators/validators and
+controllers see typed objects) and usable by any API ingress.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import typing
+from typing import Any, Dict, Optional
+
+from karmada_tpu.utils.quantity import Quantity
+
+
+def model_registry() -> Dict[str, type]:
+    """kind -> dataclass for every registered API type."""
+    from karmada_tpu.models import (autoscaling, certs, cluster, config,
+                                    extras, networking, policy, search, work)
+
+    out: Dict[str, type] = {}
+    for mod in (cluster, policy, work, config, extras,
+                autoscaling, networking, search, certs):
+        for obj in vars(mod).values():
+            kind = getattr(obj, "KIND", None)
+            if dataclasses.is_dataclass(obj) and isinstance(kind, str) and kind:
+                out[kind] = obj
+    return out
+
+
+_SNAKE_RE = re.compile(r"(?<!^)(?=[A-Z])")
+
+
+def _snake(key: str) -> str:
+    return _SNAKE_RE.sub("_", key).lower()
+
+
+def _load_value(tp, value):
+    """Coerce a manifest value into the annotated type."""
+    if value is None:
+        return None
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:  # Optional[X] and friends
+        for arg in typing.get_args(tp):
+            if arg is type(None):
+                continue
+            return _load_value(arg, value)
+        return value
+    if origin in (list, typing.List):
+        (item_tp,) = typing.get_args(tp) or (Any,)
+        return [_load_value(item_tp, v) for v in value]
+    if origin in (dict, typing.Dict):
+        args = typing.get_args(tp)
+        val_tp = args[1] if len(args) == 2 else Any
+        return {k: _load_value(val_tp, v) for k, v in dict(value).items()}
+    if tp is Quantity or (isinstance(tp, type) and issubclass(tp, Quantity)):
+        if isinstance(value, Quantity):
+            return value
+        return Quantity.parse(str(value))
+    if dataclasses.is_dataclass(tp):
+        return _load_dataclass(tp, value)
+    if tp is float and isinstance(value, (int, float)):
+        return float(value)
+    if tp is int and isinstance(value, str) and value.isdigit():
+        return int(value)
+    return value
+
+
+def _load_dataclass(cls, data: Dict[str, Any]):
+    if not isinstance(data, dict):
+        return data
+    hints = typing.get_type_hints(cls)
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for key, value in data.items():
+        if key in ("apiVersion", "kind"):
+            continue
+        name = key if key in fields else _snake(key)
+        if name not in fields:
+            continue  # forward-compat: unknown manifest keys are ignored
+        kwargs[name] = _load_value(hints.get(name, Any), value)
+    return cls(**kwargs)
+
+
+def from_manifest_typed(manifest: Dict[str, Any]):
+    """Decode a manifest into its registered typed model, or None when the
+    kind is not a registered API type (callers fall back to Unstructured)."""
+    kind = manifest.get("kind")
+    cls = model_registry().get(kind)
+    if cls is None:
+        return None
+    return _load_dataclass(cls, manifest)
+
+
+def registered_kind(kind: Optional[str]) -> bool:
+    return kind in model_registry() if kind else False

@@ -253,6 +253,7 @@ class NativeSnapshot:
         self.p_has_weights: List[int] = []
         self.p_weights: List[np.ndarray] = []
         self.p_spread: List[np.ndarray] = []
+        self.p_extra_score: List[np.ndarray] = []  # out-of-tree plugin sums
         self.p_unsupported: List[bool] = []
 
     def gvk_id(self, api_version: str, kind: str) -> int:
@@ -274,11 +275,17 @@ class NativeSnapshot:
         if pid is not None:
             return pid
 
+        from karmada_tpu.scheduler.plugins import REGISTRY as _PLUGINS
+
         nC = len(self.clusters)
         taint = np.zeros(nC, np.uint8)
         reason = np.zeros(nC, np.uint8)
+        extra = np.zeros(nC, np.int64)
+        plug_filters = _PLUGINS.enabled_filters()
+        plug_scores = _PLUGINS.enabled_scores()
         # evaluate the placement-level filter predicates per cluster, in the
-        # serial plugin order (taint, affinity, spread-field presence)
+        # serial plugin order (taint, affinity, spread-field presence,
+        # out-of-tree registry filters)
         dummy_spec = ResourceBindingSpec(placement=placement)
         dummy_status = ResourceBindingStatus()
         for i, c in enumerate(self.clusters):
@@ -288,6 +295,10 @@ class NativeSnapshot:
                 reason[i] = 1
             elif serial.filter_spread_constraint(dummy_spec, dummy_status, c):
                 reason[i] = 3
+            elif plug_filters and _PLUGINS.extra_filter(placement, c):
+                reason[i] = 4
+            if plug_scores:
+                extra[i] = _PLUGINS.extra_score(placement, c)
 
         strategy = serial.strategy_type(
             ResourceBindingSpec(placement=placement, replicas=1)
@@ -331,6 +342,7 @@ class NativeSnapshot:
         self.p_has_weights.append(has_weights)
         self.p_weights.append(weights)
         self.p_spread.append(spread)
+        self.p_extra_score.append(extra)
         self.p_unsupported.append(unsupported)
         return self.placement_rows[key]
 
@@ -458,6 +470,7 @@ def marshal_batch(
     p_reason = stack(snapshot.p_reason, nP, nC, np.uint8)
     p_weights = stack(snapshot.p_weights, nP, nC, np.int64)
     p_spread = stack(snapshot.p_spread, nP, 6, np.int32)
+    p_extra = stack(snapshot.p_extra_score, nP, nC, np.int64)
     p_strategy = _i32(snapshot.p_strategy or [0])
     p_ignore = _u8(snapshot.p_ignore_spread or [0])
     p_has_w = _u8(snapshot.p_has_weights or [0])
@@ -492,7 +505,8 @@ def marshal_batch(
         "nC": nC, "nR": nR, "nG": nG, "nP": nP, "nQ": nQ,
         "gvk_enabled": gvk_enabled, "p_taint": p_taint, "p_reason": p_reason,
         "p_strategy": p_strategy, "p_ignore": p_ignore, "p_has_w": p_has_w,
-        "p_weights": p_weights, "p_spread": p_spread, "class_req": class_req,
+        "p_weights": p_weights, "p_spread": p_spread, "p_extra": p_extra,
+        "class_req": class_req,
         "b_placement": b_placement, "b_gvk": b_gvk, "b_replicas": b_replicas,
         "b_class": b_class, "b_fresh": b_fresh, "b_uid_desc": b_uid_desc,
         "b_workload": b_workload, "b_zero_shortcut": b_zero_shortcut,
@@ -531,7 +545,7 @@ def run_marshaled(
         c.c_int32(a["nG"]), p(a["gvk_enabled"]),
         c.c_int32(a["nP"]), p(a["p_taint"]), p(a["p_reason"]),
         p(a["p_strategy"]), p(a["p_ignore"]), p(a["p_has_w"]),
-        p(a["p_weights"]), p(a["p_spread"]),
+        p(a["p_weights"]), p(a["p_spread"]), p(a["p_extra"]),
         c.c_int32(a["nQ"]), p(a["class_req"]),
         c.c_int32(nB), p(a["b_placement"]), p(a["b_gvk"]), p(a["b_replicas"]),
         p(a["b_class"]), p(a["b_fresh"]), p(a["b_uid_desc"]),
@@ -573,19 +587,6 @@ def schedule_batch_native(
 def _effective_placement(
     spec: ResourceBindingSpec, status: ResourceBindingStatus
 ) -> Placement:
-    """The placement the filters see — ClusterAffinities resolved to the
-    observed term (mirrors ops/tensors._effective_placement)."""
-    placement = spec.placement or Placement()
-    if placement.cluster_affinity is not None or not placement.cluster_affinities:
-        return placement
-    affinity = None
-    for term in placement.cluster_affinities:
-        if term.affinity_name == status.scheduler_observed_affinity_name:
-            affinity = term.affinity
-            break
-    return Placement(
-        cluster_affinity=affinity,
-        cluster_tolerations=placement.cluster_tolerations,
-        spread_constraints=placement.spread_constraints,
-        replica_scheduling=placement.replica_scheduling,
-    )
+    """The placement the filters see — single shared resolution so
+    out-of-tree plugins get the identical object on every backend."""
+    return serial.effective_placement(spec, status)

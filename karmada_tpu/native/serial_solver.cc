@@ -92,6 +92,7 @@ struct Snapshot {
   const uint8_t* p_has_weights;  // [P]
   const int64_t* p_weights;      // [P*C]
   const int32_t* p_spread;       // [P*6] field,min,max x2
+  const int64_t* p_extra_score;  // [P*C] out-of-tree plugin score sums
 };
 
 struct Binding {
@@ -446,7 +447,7 @@ int serial_schedule_batch(
     int32_t nP, const uint8_t* p_taint, const uint8_t* p_reason,
     const int32_t* p_strategy, const uint8_t* p_ignore_spread,
     const uint8_t* p_has_weights, const int64_t* p_weights,
-    const int32_t* p_spread,
+    const int32_t* p_spread, const int64_t* p_extra_score,
     // request classes
     int32_t nQ, const int64_t* class_req,
     // bindings
@@ -463,7 +464,7 @@ int serial_schedule_batch(
              has_summary, region_id,   region_rank, n_regions,
              pods_allowed, res_is_cpu, avail_milli, gvk_enabled,
              p_taint,      p_reason,   p_strategy, p_ignore_spread,
-             p_has_weights, p_weights, p_spread};
+             p_has_weights, p_weights, p_spread,   p_extra_score};
   (void)nQ;
   int32_t cursor = 0;
   out_off[0] = 0;
@@ -523,6 +524,7 @@ int serial_schedule_batch(
       if (!why && !targeted && taint_row[c]) why = "taint";  // TaintToleration
       if (!why && reason_row[c] == 1) why = "affinity";      // ClusterAffinity
       if (!why && reason_row[c] == 3) why = "spreadfield";   // SpreadConstraint
+      if (!why && reason_row[c] == 4) why = "plugin";        // out-of-tree
       if (!why) {                                            // ClusterEviction
         for (int32_t j = 0; j < bd.n_evict; ++j)
           if (bd.evict_idx[j] == c) {
@@ -534,8 +536,10 @@ int serial_schedule_batch(
         ++n_diagnosed;
         continue;
       }
-      // prioritizeClusters: ClusterLocality (serial.py:181-194)
-      int64_t score = (has_prev && prev_map.count(c)) ? 100 : 0;
+      // prioritizeClusters: ClusterLocality + out-of-tree plugin sums
+      // (pre-clamped on the Python side, scheduler/plugins.py)
+      int64_t score = ((has_prev && prev_map.count(c)) ? 100 : 0) +
+                      S.p_extra_score[static_cast<int64_t>(bd.placement) * S.nC + c];
       details.push_back({c, score, 0, 0});
     }
     if (details.empty()) {

@@ -149,15 +149,42 @@ FILTER_PLUGINS: List[Tuple[str, Callable]] = [
 ]
 
 
+def effective_placement(
+    spec: ResourceBindingSpec, status: ResourceBindingStatus
+) -> Placement:
+    """Resolve ClusterAffinities terms to the scheduler-observed one; the
+    single placement object out-of-tree plugins see on EVERY backend."""
+    placement = spec.placement or Placement()
+    if placement.cluster_affinity is not None or not placement.cluster_affinities:
+        return placement
+    affinity = None
+    for term in placement.cluster_affinities:
+        if term.affinity_name == status.scheduler_observed_affinity_name:
+            affinity = term.affinity
+            break
+    return Placement(
+        cluster_affinity=affinity,
+        cluster_tolerations=placement.cluster_tolerations,
+        spread_constraints=placement.spread_constraints,
+        replica_scheduling=placement.replica_scheduling,
+    )
+
+
 def find_clusters_that_fit(
     spec: ResourceBindingSpec,
     status: ResourceBindingStatus,
     clusters: List[Cluster],
 ) -> Tuple[List[Cluster], Dict[str, str]]:
     """generic_scheduler.go:119-152 (deleting clusters skipped; unhealthy
-    clusters are NOT filtered — users opt in via tolerations)."""
+    clusters are NOT filtered — users opt in via tolerations).  In-tree
+    filters run first, then enabled out-of-tree registry filters
+    (framework/runtime/registry.go), first rejection wins."""
+    from karmada_tpu.scheduler.plugins import REGISTRY
+
     feasible: List[Cluster] = []
     diagnosis: Dict[str, str] = {}
+    extra = REGISTRY.enabled_filters()
+    eff = effective_placement(spec, status) if extra else None
     for cluster in clusters:
         if cluster.metadata.deleting:
             continue
@@ -166,6 +193,11 @@ def find_clusters_that_fit(
             reason = plugin(spec, status, cluster)
             if reason is not None:
                 break
+        if reason is None and extra:
+            for _, plugin in extra:
+                reason = plugin(eff, cluster)
+                if reason is not None:
+                    break
         if reason is None:
             feasible.append(cluster)
         else:
@@ -187,11 +219,24 @@ def score_cluster_locality(spec: ResourceBindingSpec, cluster: Cluster) -> int:
 
 
 def prioritize_clusters(
-    spec: ResourceBindingSpec, clusters: List[Cluster]
+    spec: ResourceBindingSpec, clusters: List[Cluster],
+    status: Optional[ResourceBindingStatus] = None,
 ) -> List[Tuple[Cluster, int]]:
     """Sum of score plugins per cluster (generic_scheduler.go:155-183).
-    In-tree scorers: ClusterAffinity (always 0) + ClusterLocality."""
-    return [(c, MIN_CLUSTER_SCORE + score_cluster_locality(spec, c)) for c in clusters]
+    In-tree scorers: ClusterAffinity (always 0) + ClusterLocality; enabled
+    out-of-tree registry scores add on top (clamped sum, see
+    scheduler/plugins.py)."""
+    from karmada_tpu.scheduler.plugins import REGISTRY
+
+    if REGISTRY.empty() or not REGISTRY.enabled_scores():
+        return [(c, MIN_CLUSTER_SCORE + score_cluster_locality(spec, c))
+                for c in clusters]
+    eff = effective_placement(spec, status or ResourceBindingStatus())
+    return [
+        (c, MIN_CLUSTER_SCORE + score_cluster_locality(spec, c)
+         + REGISTRY.extra_score(eff, c))
+        for c in clusters
+    ]
 
 
 # ---------------------------------------------------------------------------
@@ -831,7 +876,7 @@ def schedule(
     feasible, diagnosis = find_clusters_that_fit(spec, status, clusters)
     if not feasible:
         raise FitError(diagnosis)
-    scored = prioritize_clusters(spec, feasible)
+    scored = prioritize_clusters(spec, feasible, status)
     info = group_clusters_with_score(scored, placement, spec, cal_available)
     selected = select_best_clusters(placement, info, spec.replicas)
     result = assign_replicas(selected, spec, status)

@@ -325,7 +325,8 @@ def _select_by_cluster(
 
 
 def _assign_lanes(
-    feasible, avail_cal, prev_present, prev_rep, name_rank, rank_webster,
+    feasible, avail_cal, prev_present, prev_rep, extra_score, name_rank,
+    rank_webster,
     n, strategy, has_sc, sc_min, sc_max, ignore_avail,
     static_w, uid_desc, fresh, non_workload, valid,
 ):
@@ -340,7 +341,10 @@ def _assign_lanes(
 
     fcount = jnp.sum(feasible)
     has_prev = jnp.any(prev_present)
-    score = jnp.where(has_prev & prev_present, 100, 0).astype(jnp.int64)
+    # in-tree locality (0|100) + pre-clamped out-of-tree plugin sum (<=100,
+    # scheduler/plugins.py) — total <= 200 fits the packed key's score bits
+    score = (jnp.where(has_prev & prev_present, 100, 0).astype(jnp.int64)
+             + jnp.asarray(extra_score, jnp.int64))
 
     # ---- selection -------------------------------------------------------
     sel_sc, unsched_sel = _select_by_cluster(
@@ -461,16 +465,18 @@ from karmada_tpu.ops.tensors import (  # noqa: E402
 )
 
 _G_PREV, _G_TOPK = COMPACT_PREV_CAP, 2 * COMPACT_DIVISION_CAP
-assert COMPACT_LANES == _G_PREV + 3 * _G_TOPK, "lane geometry out of sync"
+assert COMPACT_LANES == _G_PREV + 4 * _G_TOPK, "lane geometry out of sync"
 # the selection path consumes up to sc_max picks + sc_max swap-ins from the
 # avail-ordered gather; its cap must not outgrow the division-derived budget
 assert COMPACT_SELECTION_CAP <= COMPACT_DIVISION_CAP, "selection cap too big"
 
 
-def _gather_lanes(feasible, avail_sel, w_gather, prev_present, name_rank,
-                  rank_eff):
+def _gather_lanes(feasible, avail_sel, w_gather, prev_present, score,
+                  name_rank, rank_eff):
     """The union-of-top-K lane set for one binding: indices[K] plus a
-    validity mask (duplicates and junk lanes disabled)."""
+    validity mask (duplicates and junk lanes disabled).  The score-keyed
+    gather covers selection order under out-of-tree score plugins (without
+    extras, score > 0 only on prev lanes, which the prev gather covers)."""
     C = feasible.shape[0]
     nr = jnp.asarray(name_rank, jnp.int64)
     wq = jnp.clip(w_gather, 0, _AVAIL_CAP) << _LANE_BITS
@@ -480,11 +486,19 @@ def _gather_lanes(feasible, avail_sel, w_gather, prev_present, name_rank,
     key_w_rank = jnp.where(feasible, wq | (_LANE_MASK - rank_eff), NEG)
     key_w_name = jnp.where(feasible, wq | (_LANE_MASK - nr), NEG)
     key_a_name = jnp.where(feasible, aq | (_LANE_MASK - nr), NEG)
+    # the selection sort key itself: score desc, avail desc, name asc
+    key_sel = jnp.where(
+        feasible,
+        (jnp.clip(score, 0, 255) << (_AVAIL_BITS + _LANE_BITS))
+        | aq | (_LANE_MASK - nr),
+        NEG,
+    )
     _, ip = lax.top_k(key_prev, _G_PREV)
     _, iw = lax.top_k(key_w_rank, _G_TOPK)
     _, inm = lax.top_k(key_w_name, _G_TOPK)
     _, ia = lax.top_k(key_a_name, _G_TOPK)
-    lanes = jnp.concatenate([ip, iw, inm, ia])  # [K]
+    _, isel = lax.top_k(key_sel, _G_TOPK)
+    lanes = jnp.concatenate([ip, iw, inm, ia, isel])  # [K]
     lanes = jnp.sort(lanes)
     dup = jnp.concatenate(
         [jnp.zeros((1,), bool), lanes[1:] == lanes[:-1]])
@@ -492,7 +506,7 @@ def _gather_lanes(feasible, avail_sel, w_gather, prev_present, name_rank,
 
 
 def _schedule_one(
-    feasible, avail_cal, prev_present, prev_rep, name_rank,
+    feasible, avail_cal, prev_present, prev_rep, extra_score, name_rank,
     n, strategy, has_sc, sc_min, sc_max, ignore_avail,
     static_w, uid_desc, fresh, non_workload, valid,
 ):
@@ -502,15 +516,20 @@ def _schedule_one(
     rank_eff = jnp.where(uid_desc, C - 1 - name_rank, name_rank)
     if C <= COMPACT_LANES:
         return _assign_lanes(
-            feasible, avail_cal, prev_present, prev_rep, name_rank, rank_eff,
+            feasible, avail_cal, prev_present, prev_rep, extra_score,
+            name_rank, rank_eff,
             n, strategy, has_sc, sc_min, sc_max, ignore_avail,
             static_w, uid_desc, fresh, non_workload, valid,
         )
 
     avail_sel = avail_cal + prev_rep * prev_present
     w_gather = jnp.where(strategy == STRAT_STATIC, static_w, avail_sel)
+    has_prev = jnp.any(prev_present)
+    score_full = (jnp.where(has_prev & prev_present, 100, 0).astype(jnp.int64)
+                  + jnp.asarray(extra_score, jnp.int64))
     lanes, lane_ok = _gather_lanes(
-        feasible, avail_sel, w_gather, prev_present, name_rank, rank_eff)
+        feasible, avail_sel, w_gather, prev_present, score_full, name_rank,
+        rank_eff)
     g = lambda a: a[lanes]
     feas_k = g(feasible) & lane_ok
     rank_eff_k = g(rank_eff)
@@ -520,7 +539,7 @@ def _schedule_one(
                                         (jnp.int64(1) << 40) + lanes))
     rep_k, sel_k, status = _assign_lanes(
         feas_k, g(avail_cal), g(prev_present) & lane_ok, g(prev_rep),
-        g(name_rank), rank_webster,
+        g(extra_score), g(name_rank), rank_webster,
         n, strategy, has_sc, sc_min, sc_max, ignore_avail,
         g(static_w), uid_desc, fresh, non_workload, valid,
     )
@@ -539,7 +558,7 @@ def _schedule_one(
 
 _schedule_vmap = jax.vmap(
     _schedule_one,
-    in_axes=(0, 0, 0, 0, None, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0),
+    in_axes=(0, 0, 0, 0, 0, None, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0),
 )
 
 
@@ -552,6 +571,7 @@ def _schedule_core(
     # placements
     pl_mask, pl_tol_bypass, pl_strategy, pl_static_w,
     pl_has_cluster_sc, pl_sc_min, pl_sc_max, pl_ignore_avail,
+    pl_extra_score,
     # bindings
     b_valid, placement_id, gvk_id, class_id, replicas, uid_desc, fresh,
     non_workload, nw_shortcut, prev_idx, prev_val, evict_idx,
@@ -644,7 +664,8 @@ def _schedule_core(
         )
 
         rep, sel, status = _schedule_vmap(
-            feasible, avail_cal, prev_present_w, prev_rep_w, name_rank,
+            feasible, avail_cal, prev_present_w, prev_rep_w,
+            pl_extra_score[placement_id_w], name_rank,
             replicas_w, pl_strategy[placement_id_w],
             pl_has_cluster_sc[placement_id_w], pl_sc_min[placement_id_w],
             pl_sc_max[placement_id_w], pl_ignore_avail[placement_id_w],
@@ -722,7 +743,7 @@ def _compact_of(rep, sel, status, non_workload, max_nnz: int,
 
 # positional index of the non_workload arg in _schedule_core's signature
 # (schedule_compact receives the same tuple via *args)
-_NON_WORKLOAD_ARG = 27
+_NON_WORKLOAD_ARG = 28
 
 
 @partial(jax.jit, static_argnames=("waves", "max_nnz", "keep_sel"))
@@ -750,6 +771,7 @@ _CLUSTER_FIELDS = (
     "req_milli", "req_is_cpu", "req_pods", "est_override",
     "pl_mask", "pl_tol_bypass", "pl_strategy", "pl_static_w",
     "pl_has_cluster_sc", "pl_sc_min", "pl_sc_max", "pl_ignore_avail",
+    "pl_extra_score",
 )
 
 

@@ -135,8 +135,8 @@ _group_info_vmap = jax.vmap(
 def _spread_planes(
     cluster_valid, deleting, pods_allowed, has_summary, avail_milli,
     has_alloc, api_ok, req_milli, req_is_cpu, req_pods, est_override,
-    pl_mask, pl_tol_bypass, placement_id, gvk_id, class_id, replicas,
-    nw_shortcut, prev_idx, prev_val, evict_idx,
+    pl_mask, pl_tol_bypass, pl_extra_score, placement_id, gvk_id, class_id,
+    replicas, nw_shortcut, prev_idx, prev_val, evict_idx,
 ):
     """The [B, C] feasibility/availability/score planes both phases need.
     Traced INSIDE each phase's jit (phase B recomputes them rather than
@@ -181,9 +181,10 @@ def _spread_planes(
         & ~evict
     )
     has_prev = jnp.any(prev_present, axis=1)
-    score = jnp.where(
-        has_prev[:, None] & prev_present, 100, 0
-    ).astype(jnp.int64)
+    # locality + pre-clamped out-of-tree plugin scores (scheduler/plugins.py)
+    score = (jnp.where(has_prev[:, None] & prev_present, 100, 0)
+             .astype(jnp.int64)
+             + jnp.asarray(pl_extra_score, jnp.int64)[placement_id])
     # group availability includes already-assigned replicas
     # (group_clusters_with_score: tc.replicas + assigned)
     avail_sel = avail_cal + prev_rep * prev_present
@@ -198,7 +199,7 @@ def spread_group_info(
     # request classes
     req_milli, req_is_cpu, req_pods, est_override,
     # placement rows
-    pl_mask, pl_tol_bypass,
+    pl_mask, pl_tol_bypass, pl_extra_score,
     # per spread-binding rows
     placement_id, gvk_id, class_id, replicas, region_min, cluster_min,
     duplicated, nw_shortcut, prev_idx, prev_val, evict_idx,
@@ -209,8 +210,8 @@ def spread_group_info(
     feasible, avail_sel, score = _spread_planes(
         cluster_valid, deleting, pods_allowed, has_summary, avail_milli,
         has_alloc, api_ok, req_milli, req_is_cpu, req_pods, est_override,
-        pl_mask, pl_tol_bypass, placement_id, gvk_id, class_id, replicas,
-        nw_shortcut, prev_idx, prev_val, evict_idx,
+        pl_mask, pl_tol_bypass, pl_extra_score, placement_id, gvk_id,
+        class_id, replicas, nw_shortcut, prev_idx, prev_val, evict_idx,
     )
     score_g, avail_g, value_g, _order = _group_info_vmap(
         feasible, avail_sel, score, name_rank, region_id,
@@ -260,7 +261,7 @@ def spread_assign_compact(
     # request classes
     req_milli, req_is_cpu, req_pods, est_override,
     # placement rows
-    pl_mask, pl_tol_bypass,
+    pl_mask, pl_tol_bypass, pl_extra_score,
     # per live-binding rows
     placement_id, gvk_id, class_id, replicas, nw_shortcut,
     prev_idx, prev_val, evict_idx,
@@ -277,13 +278,14 @@ def spread_assign_compact(
     feasible, avail_sel, score = _spread_planes(
         cluster_valid, deleting, pods_allowed, has_summary, avail_milli,
         has_alloc, api_ok, req_milli, req_is_cpu, req_pods, est_override,
-        pl_mask, pl_tol_bypass, placement_id, gvk_id, class_id, replicas,
-        nw_shortcut, prev_idx, prev_val, evict_idx,
+        pl_mask, pl_tol_bypass, pl_extra_score, placement_id, gvk_id,
+        class_id, replicas, nw_shortcut, prev_idx, prev_val, evict_idx,
     )
     key = _sort_key(score, avail_sel, name_rank[None, :], feasible)
     order = jnp.argsort(key, axis=1)
     sel = _pick_vmap(order, feasible, avail_sel, score, name_rank,
                      region_id, chosen, cluster_max, G)
+    extra_b = jnp.asarray(pl_extra_score, jnp.int64)[placement_id]  # [B, C]
     rep, selected, status = _schedule_core(
         cluster_valid, deleting, name_rank, pods_allowed, has_summary,
         avail_milli, has_alloc, api_ok,
@@ -294,6 +296,7 @@ def spread_assign_compact(
         jnp.zeros((B,), bool),           # cluster spread consumed by the pick
         jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
         ignore_avail,
+        extra_b,                         # plugin scores, per-binding rows
         b_valid, jnp.arange(B, dtype=jnp.int32), gvk_id, class_id,
         replicas, uid_desc, fresh, non_workload, nw_shortcut,
         prev_idx, prev_val, evict_idx,
@@ -341,7 +344,7 @@ def solve_spread(
         batch.has_alloc, batch.api_ok, batch.region_id,
         batch.req_milli, batch.req_is_cpu, batch.req_pods,
         batch.est_override,
-        batch.pl_mask, batch.pl_tol_bypass,
+        batch.pl_mask, batch.pl_tol_bypass, batch.pl_extra_score,
         pid, batch.gvk_id[idx], batch.class_id[idx],
         batch.replicas[idx], region_min, cluster_min, duplicated,
         batch.nw_shortcut[idx],
@@ -411,7 +414,7 @@ def solve_spread(
             batch.has_alloc, batch.api_ok, batch.region_id,
             batch.req_milli, batch.req_is_cpu, batch.req_pods,
             batch.est_override,
-            batch.pl_mask, batch.pl_tol_bypass,
+            batch.pl_mask, batch.pl_tol_bypass, batch.pl_extra_score,
             lpid, batch.gvk_id[lidx], batch.class_id[lidx],
             batch.replicas[lidx], batch.nw_shortcut[lidx],
             batch.prev_idx[lidx], batch.prev_val[lidx], batch.evict_idx[lidx],

@@ -82,7 +82,7 @@ KERNEL_WEIGHT_CAP = (1 << 34) - 1
 # clusters the kernel runs its division/selection loops on a top-K gather
 # whose exactness holds only under these per-binding bounds; bindings
 # exceeding them route to the serial host path (ROUTE_COMPACT_CAP)
-COMPACT_LANES = 400
+COMPACT_LANES = 528  # prev(16) + 4 x top-K(128): w-rank, w-name, avail, sel-key
 COMPACT_DIVISION_CAP = 64    # replicas (and thus any Webster target)
 COMPACT_SELECTION_CAP = 64   # cluster spread-constraint MaxGroups
 COMPACT_PREV_CAP = 16        # previous-assignment cluster count
@@ -182,6 +182,9 @@ class SolverBatch:
     region_id: np.ndarray = field(default=None)  # int32[C]; -1 = no region
     region_names: List[str] = field(default=None)  # vocabulary
     pl_has_region_sc: np.ndarray = field(default=None)  # bool[P]
+    # out-of-tree score-plugin contributions (scheduler/plugins.py),
+    # pre-clamped sums per (placement, cluster)
+    pl_extra_score: np.ndarray = field(default=None)  # int64[P, C]
     pl_region_min: np.ndarray = field(default=None)  # int32[P]
     pl_region_max: np.ndarray = field(default=None)  # int32[P]
 
@@ -190,22 +193,10 @@ def _effective_placement(
     spec: ResourceBindingSpec, status: ResourceBindingStatus
 ) -> Placement:
     """Resolve ClusterAffinities terms to the observed one (the scheduler
-    service drives the failover loop; the kernel sees one affinity)."""
-    placement = spec.placement or Placement()
-    if placement.cluster_affinity is not None or not placement.cluster_affinities:
-        return placement
-    affinity = None
-    for term in placement.cluster_affinities:
-        if term.affinity_name == status.scheduler_observed_affinity_name:
-            affinity = term.affinity
-            break
-    out = Placement(
-        cluster_affinity=affinity,
-        cluster_tolerations=placement.cluster_tolerations,
-        spread_constraints=placement.spread_constraints,
-        replica_scheduling=placement.replica_scheduling,
-    )
-    return out
+    service drives the failover loop; the kernel sees one affinity).
+    Single implementation shared with the serial path so out-of-tree
+    plugins see the identical placement object on every backend."""
+    return serial.effective_placement(spec, status)
 
 
 def _placement_key(p: Placement) -> str:
@@ -308,6 +299,9 @@ class EncoderCache:
         # cluster-side tensors per chunk (they dominate per-chunk H2D)
         self.assembled_sig: Optional[tuple] = None
         self.assembled: Optional[Dict[str, np.ndarray]] = None
+        # plugin-registry generation the memoized placement rows were
+        # built against (encode_batch invalidates on change)
+        self.plugins_gen: Optional[int] = None
 
     def reset_for_cycle(self) -> None:
         """Drop the STATUS-derived fields before a new cycle's snapshot:
@@ -336,6 +330,15 @@ def encode_batch(
     between cached calls).
     """
     estimator = estimator or GeneralEstimator()
+    from karmada_tpu.scheduler.plugins import REGISTRY as _PLUGINS
+
+    if cache is not None and cache.plugins_gen != _PLUGINS.generation:
+        # out-of-tree plugin set changed: every memoized placement row
+        # (mask/score) is stale
+        cache.placement_rows = {}
+        cache.assembled_sig = None
+        cache.assembled = None
+        cache.plugins_gen = _PLUGINS.generation
     clusters = cindex.clusters
     nC = len(clusters)
     C = _next_pow2(max(nC, 1), 8)
@@ -673,6 +676,7 @@ def encode_batch(
     pl_sc_max = np.zeros(P, np.int32)
     pl_ignore_avail = np.zeros(P, bool)
     pl_has_region_sc = np.zeros(P, bool)
+    pl_extra_score = np.zeros((P, C), np.int64)
     pl_region_min = np.zeros(P, np.int32)
     pl_region_max = np.zeros(P, np.int32)
 
@@ -702,15 +706,23 @@ def encode_batch(
         if rows is None:
             mask_row = np.zeros(C, bool)
             tol_row = np.zeros(C, bool)
+            extra_row = np.zeros(C, np.int64)
             probe = _spec_with(placement)
+            plug_filters = _PLUGINS.enabled_filters()
+            plug_scores = _PLUGINS.enabled_scores()
             for i, c in enumerate(clusters):
                 # affinity + spread-property predicates (no prev bypass)
                 mask_row[i] = (
                     serial.filter_cluster_affinity(probe, dummy_status, c) is None
                     and serial.filter_spread_constraint(probe, dummy_status, c) is None
+                    # out-of-tree registry filters fold into the same mask
+                    and (not plug_filters
+                         or _PLUGINS.extra_filter(placement, c) is None)
                 )
                 # taint toleration WITHOUT the target_contains bypass
                 tol_row[i] = _tolerated(placement, c)
+                if plug_scores:
+                    extra_row[i] = _PLUGINS.extra_score(placement, c)
             # static weights (division_algorithm.go:38-72) per cluster
             static_row = np.zeros(C, np.int64)
             s = placement.replica_scheduling
@@ -729,10 +741,10 @@ def encode_batch(
                             if rule.target_cluster.matches(c):
                                 weight = max(weight, rule.weight)
                         static_row[i] = weight
-            rows = (mask_row, tol_row, static_row)
+            rows = (mask_row, tol_row, static_row, extra_row)
             if cache is not None:
                 cache.placement_rows[pkey] = rows
-        pl_mask[p], pl_tol_bypass[p], pl_static_w[p] = rows
+        pl_mask[p], pl_tol_bypass[p], pl_static_w[p], pl_extra_score[p] = rows
 
     # ---- api enablement ---------------------------------------------------
     G = _next_pow2(max(len(gvks), 1), 4)
@@ -765,6 +777,7 @@ def encode_batch(
         "pl_strategy": pl_strategy, "pl_static_w": pl_static_w,
         "pl_has_cluster_sc": pl_has_cluster_sc, "pl_sc_min": pl_sc_min,
         "pl_sc_max": pl_sc_max, "pl_ignore_avail": pl_ignore_avail,
+        "pl_extra_score": pl_extra_score,
         "region_id": region_id,
         "pl_has_region_sc": pl_has_region_sc, "pl_region_min": pl_region_min,
         "pl_region_max": pl_region_max,
@@ -802,6 +815,7 @@ def _build_solver_batch(
         pl_has_cluster_sc=shared["pl_has_cluster_sc"],
         pl_sc_min=shared["pl_sc_min"], pl_sc_max=shared["pl_sc_max"],
         pl_ignore_avail=shared["pl_ignore_avail"],
+        pl_extra_score=shared["pl_extra_score"],
         b_valid=b_valid, placement_id=placement_id, gvk_id=gvk_id,
         class_id=class_id, replicas=replicas, uid_desc=uid_desc, fresh=fresh,
         non_workload=non_workload, nw_shortcut=nw_shortcut,

@@ -198,3 +198,16 @@ class MultiClusterCache:
     def watch(self, handler: Callable[[str, Unstructured, str], None]) -> None:
         """handler(event_type, obj, cluster) on every cached change."""
         self._watchers.append(handler)
+
+    def unwatch(self, handler: Callable[[str, Unstructured, str], None]) -> None:
+        """Detach a watch handler (HTTP watch requests come and go)."""
+        try:
+            self._watchers.remove(handler)
+        except ValueError:
+            pass
+
+    def backend_of(self, registry_name: str):
+        """The named registry's backend sink (None when absent) — the
+        query surface for external backends (e.g. SqliteFTS full-text)."""
+        entry = self._backends.get(registry_name)
+        return entry[1] if entry is not None else None

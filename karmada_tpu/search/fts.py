@@ -1,0 +1,150 @@
+"""sqlite-FTS external search backend.
+
+Reference: pkg/search/backendstore/opensearch.go:127-193 — an external
+engine receiving every cached upsert/delete for offboard indexing and
+serving full-text queries.  OpenSearch itself is a network service; the
+TPU-native framework ships an embedded equivalent with the same sink
+contract: one sqlite file per registry, FTS5 when the interpreter's
+sqlite has it, plain LIKE matching otherwise.
+
+Config: `BackendStoreConfig(kind="SqliteFTS", addresses=[path])`; the
+first address is the database file (":memory:" for ephemeral).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from typing import Dict, List, Optional
+
+from karmada_tpu.models.search import BackendStoreConfig
+from karmada_tpu.models.unstructured import Unstructured
+from karmada_tpu.search.backend import BackendStore, register_backend_factory
+
+
+def _flatten_text(value) -> List[str]:
+    """Every string in the manifest tree (keys and values) — the indexed
+    document body."""
+    out: List[str] = []
+    if isinstance(value, dict):
+        for k, v in value.items():
+            out.append(str(k))
+            out.extend(_flatten_text(v))
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            out.extend(_flatten_text(v))
+    elif isinstance(value, str):
+        out.append(value)
+    else:
+        out.append(str(value))
+    return out
+
+
+class SqliteFTSBackend(BackendStore):
+    """Embedded full-text sink + query engine."""
+
+    def __init__(self, path: str = ":memory:") -> None:
+        self.path = path
+        # the cache worker thread writes, API threads query
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS docs ("
+            " cluster TEXT, kind TEXT, namespace TEXT, name TEXT,"
+            " body TEXT, manifest TEXT,"
+            " PRIMARY KEY (cluster, kind, namespace, name))")
+        self._fts = False
+        try:
+            self._conn.execute(
+                "CREATE VIRTUAL TABLE IF NOT EXISTS docs_fts USING fts5("
+                " cluster UNINDEXED, kind UNINDEXED, namespace UNINDEXED,"
+                " name UNINDEXED, body)")
+            self._fts = True
+        except sqlite3.OperationalError:
+            pass  # no FTS5 in this sqlite build: LIKE fallback below
+        self._conn.commit()
+
+    # -- sink contract ------------------------------------------------------
+    def upsert(self, cluster: str, obj: Unstructured) -> None:
+        manifest = obj.to_manifest()
+        body = " ".join(_flatten_text(manifest))
+        key = (cluster, obj.KIND, obj.namespace, obj.name)
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO docs VALUES (?,?,?,?,?,?)",
+                key + (body, json.dumps(manifest, default=str)))
+            if self._fts:
+                self._conn.execute(
+                    "DELETE FROM docs_fts WHERE cluster=? AND kind=?"
+                    " AND namespace=? AND name=?", key)
+                self._conn.execute(
+                    "INSERT INTO docs_fts VALUES (?,?,?,?,?)", key + (body,))
+            self._conn.commit()
+
+    def delete(self, cluster: str, obj: Unstructured) -> None:
+        key = (cluster, obj.KIND, obj.namespace, obj.name)
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM docs WHERE cluster=? AND kind=?"
+                " AND namespace=? AND name=?", key)
+            if self._fts:
+                self._conn.execute(
+                    "DELETE FROM docs_fts WHERE cluster=? AND kind=?"
+                    " AND namespace=? AND name=?", key)
+            self._conn.commit()
+
+    # -- query surface ------------------------------------------------------
+    def query(self, text: str, kind: Optional[str] = None,
+              cluster: Optional[str] = None, limit: int = 50) -> List[Dict]:
+        """Full-text hits: [{cluster, kind, namespace, name, manifest}]."""
+        filters, params = [], []
+        if kind:
+            filters.append("kind = ?")
+            params.append(kind)
+        if cluster:
+            filters.append("cluster = ?")
+            params.append(cluster)
+        with self._lock:
+            if self._fts:
+                where = " AND ".join(
+                    ["docs_fts MATCH ?"] + [f"d.{f}" for f in filters])
+                # quote the user text so FTS5 operators can't inject syntax
+                quoted = " ".join(
+                    '"' + t.replace('"', '""') + '"' for t in text.split())
+                rows = self._conn.execute(
+                    "SELECT d.cluster, d.kind, d.namespace, d.name,"
+                    " d.manifest FROM docs_fts f"
+                    " JOIN docs d ON d.cluster=f.cluster AND d.kind=f.kind"
+                    "  AND d.namespace=f.namespace AND d.name=f.name"
+                    f" WHERE {where} LIMIT ?",
+                    [quoted, *params, limit]).fetchall()
+            else:
+                like_terms = [f"%{t}%" for t in text.split()]
+                where = " AND ".join(
+                    ["body LIKE ?"] * len(like_terms) + filters)
+                rows = self._conn.execute(
+                    "SELECT cluster, kind, namespace, name, manifest"
+                    f" FROM docs WHERE {where} LIMIT ?",
+                    [*like_terms, *params, limit]).fetchall()
+        return [
+            {"cluster": c, "kind": k, "namespace": ns, "name": n,
+             "object": json.loads(m)}
+            for c, k, ns, n, m in rows
+        ]
+
+    def count(self) -> int:
+        with self._lock:
+            return self._conn.execute("SELECT COUNT(*) FROM docs").fetchone()[0]
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+def _factory(cfg: BackendStoreConfig) -> SqliteFTSBackend:
+    path = cfg.addresses[0] if cfg.addresses else ":memory:"
+    return SqliteFTSBackend(path)
+
+
+register_backend_factory("SqliteFTS", _factory)

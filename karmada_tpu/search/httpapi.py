@@ -1,0 +1,309 @@
+"""The query plane served over HTTP.
+
+Reference: the cluster proxy and karmada-search are REAL aggregated HTTP
+APIs in the reference (pkg/registry/cluster/storage/proxy.go:73 Connect
+forwards `clusters/{name}/proxy/...` to the member apiserver;
+pkg/search/proxy serves cache GET/LIST/WATCH; pkg/metricsadapter serves the
+custom/external metrics APIs).  This module puts the same surfaces on a TCP
+port so external clients (karmadactl --server, curl) can use the plane
+without importing it.
+
+Routes (JSON bodies; subject via the `X-Karmada-User` header, default
+`system:admin`, checked against the unified-auth synced RBAC exactly like
+in-process ClusterProxy.connect):
+
+  GET    /clusters                                   cluster names
+  GET    /clusters/{c}/proxy/pods[?namespace=]       member pod plane
+  GET    /clusters/{c}/proxy/logs/{ns}/{pod}[?tail=] pod logs
+  POST   /clusters/{c}/proxy/exec/{ns}/{pod}         {"command": [...]}
+  POST   /clusters/{c}/proxy/apply                   manifest
+  GET    /clusters/{c}/proxy/{kind}[?namespace=]     list manifests
+  GET    /clusters/{c}/proxy/{kind}/{ns}/{name}      one manifest
+  DELETE /clusters/{c}/proxy/{kind}/{ns}/{name}
+
+  GET    /search/cache/{kind}[?namespace=&cluster=]  fan-in list
+  GET    /search/cache/{kind}/{ns}/{name}[?cluster=] fan-in get
+  GET    /search/watch[?timeout=]                    JSON-lines event stream
+
+  GET    /metrics-adapter/pods/{kind}/{ns}/{name}    merged PodMetrics
+  GET    /metrics-adapter/external/{name}            scalar sample
+
+  GET    /api/{kind}[?namespace=]                    control-plane manifests
+  GET    /api/{kind}/{ns}/{name}
+  GET    /api-table/{kind}[?namespace=]              printer table (the
+                                                     karmadactl get view)
+  GET    /healthz /metrics                           liveness / Prometheus
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from karmada_tpu.search.proxy import ProxyDenied
+
+
+def _manifest_of(obj) -> dict:
+    if hasattr(obj, "to_manifest"):
+        return obj.to_manifest()
+    return json.loads(json.dumps(obj.__dict__, default=str))
+
+
+class QueryPlaneServer:
+    """One ThreadingHTTPServer for the whole query plane."""
+
+    def __init__(self, store, members, cluster_proxy, search_cache=None,
+                 metrics_provider=None, registry=None) -> None:
+        from karmada_tpu.utils.metrics import REGISTRY
+
+        self.store = store
+        self.members = members
+        self.cluster_proxy = cluster_proxy
+        self.search_cache = search_cache
+        self.metrics_provider = metrics_provider
+        self.registry = registry if registry is not None else REGISTRY
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request handling ---------------------------------------------------
+    def _handle(self, method: str, path: str, query: dict, body: Optional[dict],
+                subject: str, stream):
+        """Returns (code, payload) or ('stream', generator) for watch."""
+        parts = [p for p in path.split("/") if p]
+
+        if method == "GET" and path == "/healthz":
+            return 200, {"status": "ok"}
+        if method == "GET" and path == "/metrics":
+            return 200, self.registry.dump()
+
+        if parts[:1] == ["clusters"] and len(parts) == 1 and method == "GET":
+            from karmada_tpu.models.cluster import Cluster
+
+            return 200, [c.name for c in self.store.list(Cluster.KIND)]
+
+        if parts[:1] == ["clusters"] and len(parts) >= 3 and parts[2] == "proxy":
+            return self._handle_proxy(method, parts[1], parts[3:], query,
+                                      body, subject)
+
+        if parts[:2] == ["search", "cache"] and self.search_cache is not None:
+            cluster = (query.get("cluster") or [None])[0]
+            ns = (query.get("namespace") or [None])[0]
+            if len(parts) == 3 and method == "GET":
+                objs = self.search_cache.list(parts[2], namespace=ns,
+                                              cluster=cluster)
+                return 200, [o.to_manifest() for o in objs]
+            if len(parts) == 5 and method == "GET":
+                obj = self.search_cache.get(parts[2], parts[3], parts[4],
+                                            cluster=cluster)
+                if obj is None:
+                    return 404, {"error": "not found"}
+                return 200, obj.to_manifest()
+
+        if parts[:2] == ["search", "watch"] and self.search_cache is not None:
+            timeout = float((query.get("timeout") or ["5"])[0])
+            return "stream", self._watch_stream(timeout)
+
+        if parts[:2] == ["search", "query"] and self.search_cache is not None:
+            # full-text query against a registry's external backend
+            # (pkg/search REST over the opensearch backendstore)
+            reg = (query.get("registry") or [None])[0]
+            text = (query.get("q") or [""])[0]
+            if not reg or not text:
+                return 400, {"error": "registry= and q= required"}
+            backend = self.search_cache.backend_of(reg)
+            if backend is None or not hasattr(backend, "query"):
+                return 404, {"error": f"registry {reg!r} has no queryable "
+                                      "backend"}
+            return 200, backend.query(
+                text,
+                kind=(query.get("kind") or [None])[0],
+                cluster=(query.get("cluster") or [None])[0])
+
+        if parts[:2] == ["metrics-adapter", "pods"] and len(parts) in (4, 5) \
+                and self.metrics_provider is not None:
+            # len 4: no workload name -> all of the kind in the namespace
+            return 200, self.metrics_provider.pod_metrics(
+                parts[2], parts[3], parts[4] if len(parts) == 5 else "")
+        if parts[:2] == ["metrics-adapter", "external"] and len(parts) == 3 \
+                and self.metrics_provider is not None:
+            v = self.metrics_provider.external_metric(parts[2])
+            if v is None:
+                return 404, {"error": "no such metric"}
+            return 200, {"name": parts[2], "value": v}
+
+        if parts[:1] == ["api"] and method == "GET":
+            ns = (query.get("namespace") or [None])[0]
+            if len(parts) == 2:
+                objs = self.store.list(parts[1], ns)
+                return 200, [_manifest_of(o) for o in objs]
+            if len(parts) in (3, 4):
+                # len 3: cluster-scoped get (empty namespace)
+                get_ns = parts[2] if len(parts) == 4 else ""
+                o = self.store.try_get(parts[1], get_ns, parts[-1])
+                if o is None:
+                    return 404, {"error": "not found"}
+                return 200, _manifest_of(o)
+
+        if parts[:1] == ["api-table"] and len(parts) == 2 and method == "GET":
+            from karmada_tpu.printers import table_for
+
+            ns = (query.get("namespace") or [None])[0]
+            objs = self.store.list(parts[1], ns)
+            headers, rows = table_for(parts[1], objs)
+            return 200, {"headers": headers,
+                         "rows": [[str(c) for c in r] for r in rows]}
+
+        return 404, {"error": f"no route for {method} {path}"}
+
+    def _handle_proxy(self, method, cluster, rest, query, body, subject):
+        try:
+            handle = self.cluster_proxy.connect(cluster, subject=subject)
+        except ProxyDenied as e:
+            return 403, {"error": str(e)}
+        ns = (query.get("namespace") or [None])[0]
+        if method == "GET" and rest[:1] == ["pods"]:
+            return 200, handle.pods(ns)
+        if method == "GET" and rest[:1] == ["logs"] and len(rest) == 3:
+            tail = query.get("tail")
+            try:
+                lines = handle.logs(rest[1], rest[2],
+                                    tail=int(tail[0]) if tail else None)
+            except Exception as e:  # noqa: BLE001 — pod not found
+                return 404, {"error": str(e)}
+            return 200, {"lines": lines}
+        if method == "POST" and rest[:1] == ["exec"] and len(rest) == 3:
+            command = (body or {}).get("command") or []
+            try:
+                rc, out = handle.exec(rest[1], rest[2], command)
+            except Exception as e:  # noqa: BLE001
+                return 404, {"error": str(e)}
+            return 200, {"rc": rc, "output": out}
+        if method == "POST" and rest[:1] == ["apply"]:
+            if not body:
+                return 400, {"error": "manifest body required"}
+            obj = handle.apply(body)
+            return 200, obj.to_manifest()
+        if method == "GET" and len(rest) == 1:
+            return 200, [o.to_manifest() for o in handle.list(rest[0], ns)]
+        if method == "GET" and len(rest) in (2, 3):
+            # len 2: cluster-scoped get (empty namespace)
+            get_ns = rest[1] if len(rest) == 3 else ""
+            obj = handle.get(rest[0], get_ns, rest[-1])
+            if obj is None:
+                return 404, {"error": "not found"}
+            return 200, obj.to_manifest()
+        if method == "DELETE" and len(rest) in (2, 3):
+            handle.delete(rest[0], rest[1] if len(rest) == 3 else "",
+                          rest[-1])
+            return 200, {"deleted": True}
+        return 404, {"error": f"no proxy route for {method} /{'/'.join(rest)}"}
+
+    def _watch_stream(self, timeout: float):
+        """JSON-lines generator over cache events for up to `timeout` s
+        (the aggregated-API WATCH verb, chunked)."""
+        q: "queue.Queue" = queue.Queue()
+
+        def handler(event_type, obj, cluster):
+            q.put({"type": event_type, "cluster": cluster,
+                   "object": obj.to_manifest()})
+
+        self.search_cache.watch(handler)
+
+        def gen():
+            deadline = time.monotonic() + timeout
+            try:
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return
+                    try:
+                        item = q.get(timeout=min(remaining, 0.25))
+                    except queue.Empty:
+                        continue
+                    yield (json.dumps(item) + "\n").encode()
+            finally:
+                self.search_cache.unwatch(handler)
+
+        return gen()
+
+    # -- server lifecycle ---------------------------------------------------
+    def start(self, port: int = 0, host: str = "127.0.0.1") -> str:
+        import http.server
+
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _run(self, method):
+                u = urlparse(self.path)
+                query = parse_qs(u.query)
+                subject = self.headers.get("X-Karmada-User", "system:admin")
+                body = None
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    try:
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                    except json.JSONDecodeError:
+                        self._send(400, {"error": "invalid JSON body"})
+                        return
+                try:
+                    result = outer._handle(method, u.path, query, body,
+                                           subject, self)
+                except Exception as e:  # noqa: BLE001 — surface, don't die
+                    self._send(500, {"error": repr(e)})
+                    return
+                if result[0] == "stream":
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/jsonlines")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    for chunk in result[1]:
+                        self.wfile.write(
+                            f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                    return
+                self._send(*result)
+
+            def _send(self, code, payload):
+                if isinstance(payload, str):
+                    body = payload.encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    body = json.dumps(payload, default=str).encode()
+                    ctype = "application/json"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                self._run("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._run("POST")
+
+            def do_DELETE(self):  # noqa: N802
+                self._run("DELETE")
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        h, p = self._httpd.server_address
+        return f"http://{h}:{p}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

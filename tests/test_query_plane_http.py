@@ -1,0 +1,156 @@
+"""The query plane over HTTP: cluster-proxy verbs, search cache
+GET/LIST/WATCH, metrics adapter, and karmadactl --server (CLI over TCP).
+
+Reference: pkg/registry/cluster/storage/proxy.go:73 (aggregated proxy
+HTTP), pkg/search/proxy (search REST), pkg/metricsadapter (metrics APIs).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karmada_tpu.search import CACHED_FROM_ANNOTATION
+from karmada_tpu.search.httpapi import QueryPlaneServer
+from tests.test_query_plane import cp, deployment, dup_policy, registry  # noqa: F401
+
+
+@pytest.fixture
+def served(cp):  # noqa: F811 — pytest fixture chaining
+    cp.store.create(registry())
+    cp.apply_policy(dup_policy())
+    cp.apply(deployment("web"))
+    cp.tick()
+    srv = QueryPlaneServer(cp.store, cp.members, cp.cluster_proxy,
+                           search_cache=cp.search_cache,
+                           metrics_provider=cp.metrics_provider)
+    url = srv.start()
+    yield cp, url
+    srv.stop()
+
+
+def get_json(url, path, subject=None, params=""):
+    req = urllib.request.Request(url + path + params)
+    if subject:
+        req.add_header("X-Karmada-User", subject)
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_proxy_verbs_over_http(served):
+    cp, url = served
+    # list through the proxy: the Work-applied Deployment is on members
+    out = get_json(url, "/clusters/m1/proxy/Deployment")
+    assert any(m["metadata"]["name"] == "web" for m in out)
+    one = get_json(url, "/clusters/m1/proxy/Deployment/default/web")
+    assert one["metadata"]["name"] == "web"
+    # pod plane + logs + exec
+    pods = get_json(url, "/clusters/m1/proxy/pods")
+    assert pods, "admitted replicas must surface as pods"
+    pod = pods[0]
+    logs = get_json(
+        url, f"/clusters/m1/proxy/logs/{pod['namespace']}/{pod['name']}")
+    assert isinstance(logs["lines"], list)
+    req = urllib.request.Request(
+        url + f"/clusters/m1/proxy/exec/{pod['namespace']}/{pod['name']}",
+        data=json.dumps({"command": ["env"]}).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        out = json.loads(r.read())
+    assert out["rc"] == 0
+
+
+def test_proxy_denies_unknown_subject_over_http(served):
+    cp, url = served
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        get_json(url, "/clusters/m1/proxy/pods", subject="mallory")
+    assert ei.value.code == 403
+
+
+def test_search_cache_and_watch_over_http(served):
+    cp, url = served
+    objs = get_json(url, "/search/cache/Deployment")
+    assert objs, "registry-selected Deployments must be cached"
+    assert objs[0]["metadata"]["annotations"][CACHED_FROM_ANNOTATION]
+
+    # WATCH: stream events while a change lands
+    events = []
+
+    def consume():
+        req = urllib.request.Request(url + "/search/watch?timeout=3")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            for line in r:
+                line = line.strip()
+                if line:
+                    events.append(json.loads(line))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    import time
+
+    time.sleep(0.3)
+    cp.apply(deployment("web", replicas=5))
+    cp.tick()
+    t.join(timeout=10)
+    assert any(e["object"]["metadata"]["name"] == "web" for e in events)
+
+
+def test_metrics_adapter_over_http(served):
+    cp, url = served
+    pods = get_json(url, "/metrics-adapter/pods/Deployment/default/web")
+    assert pods and all("usage" in p and "cluster" in p for p in pods)
+    cp.metrics_provider.external["queue_depth"] = 42.0
+    out = get_json(url, "/metrics-adapter/external/queue_depth")
+    assert out["value"] == 42.0
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        get_json(url, "/metrics-adapter/external/nope")
+    assert ei.value.code == 404
+
+
+def test_control_plane_api_over_http(served):
+    cp, url = served
+    clusters = get_json(url, "/clusters")
+    assert set(clusters) == {"m1", "m2", "m3"}
+    table = get_json(url, "/api-table/Cluster")
+    assert "NAME" in [h.upper() for h in table["headers"]]
+    assert len(table["rows"]) == 3
+    rbs = get_json(url, "/api/ResourceBinding")
+    assert rbs, "binding manifests listable over HTTP"
+
+
+def test_cli_over_tcp(served, capsys):
+    """karmadactl --server URL: the CLI data-path verbs run over HTTP."""
+    from karmada_tpu.cli import main
+
+    cp, url = served
+    assert main(["--server", url, "get", "pods", "--cluster", "m1"]) == 0
+    out = capsys.readouterr().out
+    assert "NAME" in out and "web" in out
+
+    pods = get_json(url, "/clusters/m1/proxy/pods")
+    pod = pods[0]
+    assert main(["--server", url, "logs", pod["name"],
+                 "--cluster", "m1", "-n", pod["namespace"]]) == 0
+
+    assert main(["--server", url, "exec", pod["name"], "--cluster", "m1",
+                 "-n", pod["namespace"], "env"]) == 0
+
+    assert main(["--server", url, "top", "clusters"]) == 0
+    out = capsys.readouterr().out
+    assert "m1" in out
+
+    assert main(["--server", url, "top", "pods", "web"]) == 0
+    out = capsys.readouterr().out
+    assert "web" in out
+
+    assert main(["--server", url, "get", "Deployment", "--cluster", "m1",
+                 "-n", "default"]) == 0
+    out = capsys.readouterr().out
+    assert "web" in out
+
+    # commands that need the local plane refuse politely
+    assert main(["--server", url, "join", "m9"]) == 1

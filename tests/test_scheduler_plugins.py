@@ -1,0 +1,252 @@
+"""Out-of-tree scheduler plugin registry: an external Filter + Score plugin
+must behave bit-identically on the serial, native (C++) and device (batched
+solver) backends.
+
+Reference: pkg/scheduler/framework/interface.go:45-66 (FilterPlugin /
+ScorePlugin) + runtime/registry.go (named registry, `*,-Foo` enablement).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from karmada_tpu import native
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.models.cluster import (
+    APIEnablement,
+    Cluster,
+    ClusterSpec,
+    ClusterStatus,
+    ResourceSummary,
+)
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+    REPLICA_SCHEDULING_DUPLICATED,
+    SPREAD_BY_FIELD_CLUSTER,
+    ClusterPreferences,
+    Placement,
+    ReplicaSchedulingStrategy,
+    SpreadConstraint,
+)
+from karmada_tpu.models.work import (
+    ObjectReference,
+    ReplicaRequirements,
+    ResourceBindingSpec,
+    ResourceBindingStatus,
+)
+from karmada_tpu.ops import serial, tensors
+from karmada_tpu.ops.solver import solve
+from karmada_tpu.scheduler.plugins import EXTRA_SCORE_CAP, PluginRegistry, REGISTRY
+from karmada_tpu.utils.quantity import Quantity
+
+GVK = ("apps/v1", "Deployment")
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    yield
+    for name in ("NoSilver", "PreferEven", "Greedy"):
+        REGISTRY.unregister(name)
+    REGISTRY.set_enablement("*")
+
+
+def mk_cluster(name, cpu=32000):
+    return Cluster(
+        metadata=ObjectMeta(name=name),
+        spec=ClusterSpec(region="r1"),
+        status=ClusterStatus(
+            api_enablements=[APIEnablement(GVK[0], [GVK[1]])],
+            resource_summary=ResourceSummary(
+                allocatable={
+                    "cpu": Quantity.from_milli(cpu),
+                    "memory": Quantity.from_units(128),
+                    "pods": Quantity.from_units(110),
+                },
+            ),
+        ),
+    )
+
+
+def mk_items(names, n=10):
+    rng = random.Random(4)
+    placements = [
+        Placement(replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED)),
+        Placement(replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+            weight_preference=ClusterPreferences(
+                dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS))),
+        # selection order is where scores bite: pick 3 of many
+        Placement(
+            spread_constraints=[SpreadConstraint(
+                spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+                min_groups=1, max_groups=3)],
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS))),
+    ]
+    items = []
+    for b in range(n):
+        spec = ResourceBindingSpec(
+            resource=ObjectReference(api_version=GVK[0], kind=GVK[1],
+                                     namespace="ns", name=f"a{b}",
+                                     uid=f"u{b}"),
+            replicas=rng.choice([2, 4, 8]),
+            replica_requirements=ReplicaRequirements(resource_request={
+                "cpu": Quantity.from_milli(rng.choice([100, 250]))}),
+            placement=placements[b % len(placements)],
+        )
+        items.append((spec, ResourceBindingStatus()))
+    return items
+
+
+def filter_no_silver(placement, cluster):
+    if cluster.name.endswith(("3", "7")):
+        return "cluster(s) rejected by NoSilver plugin"
+    return None
+
+
+def score_prefer_even(placement, cluster):
+    return 60 if int(cluster.name[-1]) % 2 == 0 else 0
+
+
+def run_three_backends(items, clusters):
+    est = GeneralEstimator()
+    cal = serial.make_cal_available([est])
+    # serial
+    serial_out = []
+    for spec, st in items:
+        try:
+            serial_out.append(
+                {tc.name: tc.replicas for tc in
+                 serial.schedule(spec, st, clusters, cal)})
+        except Exception as e:  # noqa: BLE001
+            serial_out.append(type(e).__name__)
+    # device
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch(items, cindex, est)
+    assert (batch.route == tensors.ROUTE_DEVICE).all()
+    rep, sel, status = solve(batch)
+    decoded = tensors.decode_result(batch, rep, sel, status, items=items)
+    device_out = [
+        (type(d).__name__ if isinstance(d, Exception)
+         else {tc.name: tc.replicas for tc in d})
+        for d in (decoded[b] for b in range(len(items)))
+    ]
+    # native
+    native_out = None
+    if native.available():
+        snap = native.NativeSnapshot(clusters, native.collect_res_names(items))
+        native_out = []
+        for st_code, targets in native.schedule_batch_native(items, snap):
+            if st_code == native.STATUS_OK:
+                native_out.append({tc.name: tc.replicas for tc in targets})
+            else:
+                native_out.append({
+                    native.STATUS_FIT_ERROR: "FitError",
+                    native.STATUS_UNSCHEDULABLE: "UnschedulableError",
+                    native.STATUS_NO_CLUSTER: "NoClusterAvailableError",
+                }.get(st_code, f"status-{st_code}"))
+    return serial_out, device_out, native_out
+
+
+def test_plugin_filters_and_scores_agree_across_backends():
+    clusters = [mk_cluster(f"m{i}", cpu=16000 + i * 4000) for i in range(10)]
+    items = mk_items([c.name for c in clusters])
+
+    REGISTRY.register_filter("NoSilver", filter_no_silver)
+    REGISTRY.register_score("PreferEven", score_prefer_even)
+
+    serial_out, device_out, native_out = run_three_backends(items, clusters)
+    assert serial_out == device_out
+    if native_out is not None:
+        assert serial_out == native_out
+
+    # the filter really fired: no schedule lands on m3/m7
+    for out in serial_out:
+        if isinstance(out, dict):
+            assert "m3" not in out and "m7" not in out
+    # the score really fired: selection-limited bindings (max_groups=3)
+    # pick even-named clusters first
+    sel_binding = serial_out[2]
+    assert isinstance(sel_binding, dict)
+    assert all(int(n[-1]) % 2 == 0 for n in sel_binding), sel_binding
+
+
+def test_plugin_changes_results_vs_no_plugin():
+    clusters = [mk_cluster(f"m{i}") for i in range(10)]
+    items = mk_items([c.name for c in clusters])
+    base_serial, base_device, _ = run_three_backends(items, clusters)
+
+    REGISTRY.register_filter("NoSilver", filter_no_silver)
+    REGISTRY.register_score("PreferEven", score_prefer_even)
+    new_serial, new_device, _ = run_three_backends(items, clusters)
+    assert new_serial != base_serial  # plugins actually changed outcomes
+    assert new_serial == new_device
+
+    # disable via the `*,-Name` flag syntax: back to baseline
+    REGISTRY.set_enablement("*,-NoSilver,-PreferEven")
+    off_serial, off_device, _ = run_three_backends(items, clusters)
+    assert off_serial == base_serial
+    assert off_device == base_device
+
+
+def test_compact_path_parity_with_plugins():
+    """C=600 > COMPACT_LANES: the score-aware top-K gather must keep the
+    compact path bit-identical to serial when plugin scores reorder the
+    selection."""
+    clusters = [mk_cluster(f"m{i:03d}", cpu=8000 + (i % 13) * 1000)
+                for i in range(600)]
+    items = mk_items([c.name for c in clusters], n=8)
+
+    REGISTRY.register_score("PreferEven", score_prefer_even)
+    est = GeneralEstimator()
+    cal = serial.make_cal_available([est])
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch(items, cindex, est)
+    assert batch.C > tensors.COMPACT_LANES
+    rep, sel, status = solve(batch)
+    decoded = tensors.decode_result(batch, rep, sel, status, items=items)
+    for b, (spec, st) in enumerate(items):
+        want = {tc.name: tc.replicas
+                for tc in serial.schedule(spec, st, clusters, cal)}
+        got = {tc.name: tc.replicas for tc in decoded[b]}
+        assert got == want, (b, got, want)
+
+
+def test_score_clamp_and_registry_semantics():
+    r = PluginRegistry()
+    r.register_score("Greedy", lambda p, c: 10_000)
+    assert r.extra_score(Placement(), mk_cluster("m0")) == EXTRA_SCORE_CAP
+    r.register_score("Negative", lambda p, c: -50)
+    # sum then clamp: 10_000 - 50 still clamps to cap
+    assert r.extra_score(Placement(), mk_cluster("m0")) == EXTRA_SCORE_CAP
+    r.set_enablement("-Greedy")  # no star: everything else off too
+    assert r.extra_score(Placement(), mk_cluster("m0")) == 0
+    r.set_enablement("Negative")
+    assert r.extra_score(Placement(), mk_cluster("m0")) == 0  # clamp floor
+    gen0 = r.generation
+    r.unregister("Greedy")
+    assert r.generation > gen0
+
+
+def test_encoder_cache_invalidated_on_plugin_change():
+    clusters = [mk_cluster(f"m{i}") for i in range(6)]
+    items = mk_items([c.name for c in clusters], n=4)
+    cache = tensors.EncoderCache()
+    cindex = tensors.ClusterIndex.build(clusters)
+    est = GeneralEstimator()
+    b0 = tensors.encode_batch(items, cindex, est, cache=cache)
+    # real placement rows only (the P axis is pow2-padded with False rows)
+    assert b0.pl_mask[b0.placement_id[:4], :6].all()
+    REGISTRY.register_filter("NoSilver", filter_no_silver)
+    b1 = tensors.encode_batch(items, cindex, est, cache=cache)
+    assert not b1.pl_mask[b1.placement_id[0], 3]  # m3 masked out now
