@@ -27,10 +27,18 @@ from karmada_tpu.store.worker import AsyncWorker, Runtime
 PHASE_CERT = "CertificatesReady"
 PHASE_STORE = "EtcdReady"  # the store IS the framework's etcd
 PHASE_APISERVER = "ApiServerReady"
+PHASE_CRDS = "CrdsReady"
 PHASE_COMPONENTS = "ComponentsReady"
 COND_READY = "Ready"
 
-INSTALL_PHASES = [PHASE_CERT, PHASE_STORE, PHASE_APISERVER, PHASE_COMPONENTS]
+INSTALL_PHASES = [PHASE_CERT, PHASE_STORE, PHASE_APISERVER, PHASE_CRDS,
+                  PHASE_COMPONENTS]
+
+# components whose credentials the cert task issues off the CA
+# (operator/pkg/tasks/init/cert.go issues the karmada-apiserver /
+# front-proxy / etcd leaf certs; same component list here)
+CERT_COMPONENTS = ("apiserver", "front-proxy", "etcd", "scheduler",
+                   "webhook", "agent")
 
 
 @dataclass
@@ -67,6 +75,14 @@ class Karmada(TypedObject):
     status: KarmadaStatus = field(default_factory=KarmadaStatus)
 
 
+def copy_spec(spec: KarmadaSpec) -> KarmadaSpec:
+    """The rollback target must not alias live CR fields (and must track
+    future KarmadaSpec fields without hand-maintenance)."""
+    import copy
+
+    return copy.deepcopy(spec)
+
+
 class _Workflow:
     """The reference's workflow job: ordered tasks, stop on first failure
     (workflow/job.go RunTask semantics), each task reporting a condition."""
@@ -88,20 +104,75 @@ class _Workflow:
         return True
 
 
+def issue_cert_material(data_dir: str) -> Dict[str, Dict]:
+    """The cert task's material (tasks/init/cert.go): a CA secret plus one
+    derived leaf credential per component, persisted under data_dir/pki/.
+    Idempotent — an existing CA is REUSED (the reference keeps the CA
+    stable across reinstall/upgrade so member kubeconfigs stay valid)."""
+    import hashlib
+    import json
+    import secrets
+
+    pki = os.path.join(data_dir, "pki")
+    os.makedirs(pki, exist_ok=True)
+    ca_path = os.path.join(pki, "ca.json")
+    if os.path.exists(ca_path):
+        with open(ca_path) as f:
+            ca = json.load(f)
+    else:
+        ca = {"secret": secrets.token_hex(32), "created_at": time.time()}
+        with open(ca_path, "w") as f:
+            json.dump(ca, f)
+    out = {"ca": {"fingerprint": hashlib.sha256(
+        ca["secret"].encode()).hexdigest()[:16]}}
+    for comp in CERT_COMPONENTS:
+        fingerprint = hashlib.sha256(
+            (ca["secret"] + ":" + comp).encode()).hexdigest()
+        leaf = {"component": comp, "fingerprint": fingerprint[:32],
+                "issued_at": time.time(),
+                "expires_at": time.time() + 365 * 24 * 3600}
+        with open(os.path.join(pki, f"{comp}.json"), "w") as f:
+            json.dump(leaf, f)
+        out[comp] = {"fingerprint": leaf["fingerprint"]}
+    return out
+
+
 class KarmadaOperator:
     """Reconciles Karmada CRs in a MANAGEMENT store into live planes."""
 
     def __init__(self, mgmt_store: ObjectStore, runtime: Runtime,
-                 base_dir: str) -> None:
+                 base_dir: str, fault_injector=None) -> None:
         self.store = mgmt_store
         self.base_dir = base_dir
         self.planes: Dict[str, object] = {}  # name -> ControlPlane
         self.observed: Dict[str, int] = {}   # name -> reconciled generation
+        # spec the RUNNING plane was installed with (upgrade rollback target)
+        self.installed_spec: Dict[str, KarmadaSpec] = {}
+        # chaos hook: fault_injector(phase, name) raises to fail that task
+        # (same idiom as the e2e chaos harness)
+        self.fault_injector = fault_injector
         self.worker = runtime.register(AsyncWorker("karmada-operator", self._reconcile))
         mgmt_store.bus.subscribe(self._on_event, kind=Karmada.KIND)
+        # periodic resync drives the health probe of installed planes
+        runtime.register_periodic(self._resync, name="karmada-operator")
 
     def _on_event(self, event: Event) -> None:
-        self.worker.enqueue(event.obj.name)
+        # generation predicate (the reference operator's spec-change
+        # filter): the install workflow's own STATUS writes must not
+        # re-enqueue the reconcile — a failing install would otherwise
+        # re-arm its own retry forever
+        if (event.old is None
+                or event.obj.metadata.deleting
+                or event.obj.metadata.generation
+                != event.old.metadata.generation):
+            self.worker.enqueue(event.obj.name)
+
+    def _resync(self) -> None:
+        # EVERY CR, not just installed planes: a CR whose install exhausted
+        # its retry budget must revive when the fault clears, and the
+        # generation filter means no event will do it
+        for cr in self.store.list(Karmada.KIND):
+            self.worker.enqueue(cr.metadata.name)
 
     def plane(self, name: str):
         return self.planes.get(name)
@@ -117,11 +188,24 @@ class KarmadaOperator:
             self._probe(name)
             return None
 
+        ok = self._install(name, cr, cr.spec)
+        if ok:
+            self.observed[name] = cr.metadata.generation
+            self.installed_spec[name] = copy_spec(cr.spec)
+            return None
+        return False  # AsyncWorker requeues with its bounded retry budget
+
+    def _install(self, name: str, cr: Karmada, spec: KarmadaSpec) -> bool:
+        """The staged install task graph (operator/pkg/tasks/init/):
+        cert -> etcd -> apiserver -> crds -> components, each reporting a
+        condition; a failed task stops the graph, marks phase Failed, and
+        the next reconcile retries — completed phases are idempotent so
+        the retry converges from where it failed."""
         def set_phase(obj: Karmada) -> None:
             obj.status.phase = "Installing"
         self.store.mutate(Karmada.KIND, "", name, set_phase)
 
-        data_dir = cr.spec.host_data_dir or os.path.join(self.base_dir, name)
+        data_dir = spec.host_data_dir or os.path.join(self.base_dir, name)
         plane_box: Dict[str, object] = {}
 
         def report(condition: str, ok: bool, msg: str) -> None:
@@ -134,35 +218,82 @@ class KarmadaOperator:
                     obj.status.phase = "Failed"
             self.store.mutate(Karmada.KIND, "", name, upd)
 
-        wf = _Workflow()
-        # cert task: the plane's CA credential material (tasks/init/cert.go)
-        wf.add(PHASE_CERT, lambda: os.makedirs(data_dir, exist_ok=True))
-        # etcd task: bring up the persistent store (tasks/init/etcd.go)
+        def faultable(phase: str, fn: Callable[[], None]) -> Callable[[], None]:
+            def run() -> None:
+                if self.fault_injector is not None:
+                    self.fault_injector(phase, name)
+                fn()
+            return run
 
+        wf = _Workflow()
+        # cert task: CA + per-component leaf credentials on disk
+        # (tasks/init/cert.go); the CA survives reinstalls
+        def certs() -> None:
+            os.makedirs(data_dir, exist_ok=True)
+            plane_box["certs"] = issue_cert_material(data_dir)
+        wf.add(PHASE_CERT, faultable(PHASE_CERT, certs))
+
+        # etcd task: bring up the persistent store (tasks/init/etcd.go)
         def start_store() -> None:
             from karmada_tpu.store.persistence import load_store
 
             load_store(data_dir).persistence.close()
-        wf.add(PHASE_STORE, start_store)
+        wf.add(PHASE_STORE, faultable(PHASE_STORE, start_store))
 
-        # apiserver + components: the ControlPlane wires both
+        # apiserver task: the ControlPlane process set
         def start_plane() -> None:
             from karmada_tpu.e2e import ControlPlane
 
             plane_box["plane"] = ControlPlane(
-                backend=cr.spec.components.scheduler_backend,
-                enable_descheduler=cr.spec.components.descheduler,
-                feature_gates=cr.spec.feature_gates or None,
+                backend=spec.components.scheduler_backend,
+                enable_descheduler=spec.components.descheduler,
+                feature_gates=spec.feature_gates or None,
                 persist_dir=data_dir,
             )
-        wf.add(PHASE_APISERVER, start_plane)
+        wf.add(PHASE_APISERVER, faultable(PHASE_APISERVER, start_plane))
 
-        # wait task: verify the plane answers (tasks/init/wait.go) with a
-        # canary write/read/delete through the real store path
-        def verify() -> None:
+        # crds task (tasks/init/crd.go): the API surface registered in the
+        # new plane, recorded as the api-resources ConfigMap
+        def install_crds() -> None:
+            from karmada_tpu.models.codec import model_registry
+
+            plane = plane_box["plane"]
+            plane.apply({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "api-resources",
+                             "namespace": "karmada-system"},
+                "data": {"kinds": ",".join(sorted(model_registry()))},
+            })
+        wf.add(PHASE_CRDS, faultable(PHASE_CRDS, install_crds))
+
+        # components task (tasks/init/component.go): render each
+        # component's config into the plane, then verify the plane answers
+        # (tasks/init/wait.go) with a canary write/read/delete
+        def components() -> None:
             from karmada_tpu.models.unstructured import Unstructured
 
             plane = plane_box["plane"]
+            certs_out = plane_box.get("certs", {})
+            plane.apply({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "scheduler", "namespace": "karmada-system"},
+                "data": {"backend": spec.components.scheduler_backend,
+                         "cert": certs_out.get("scheduler", {}).get(
+                             "fingerprint", "")},
+            })
+            plane.apply({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "controller-manager-config",
+                             "namespace": "karmada-system"},
+                "data": {
+                    "featureGates": ",".join(
+                        f"{k}={v}" for k, v in sorted(
+                            (spec.feature_gates or {}).items())),
+                    "descheduler": str(spec.components.descheduler),
+                    "search": str(spec.components.search),
+                    "metricsAdapter": str(spec.components.metrics_adapter),
+                },
+            })
             plane.tick()
             canary = Unstructured.from_manifest({
                 "apiVersion": "v1", "kind": "ConfigMap",
@@ -175,7 +306,7 @@ class KarmadaOperator:
             assert got.manifest["data"]["probe"] == name
             plane.store.delete("ConfigMap", "karmada-system", "operator-canary")
             plane.tick()
-        wf.add(PHASE_COMPONENTS, verify)
+        wf.add(PHASE_COMPONENTS, faultable(PHASE_COMPONENTS, components))
 
         ok = wf.run(report)
 
@@ -186,6 +317,14 @@ class KarmadaOperator:
                 set_condition(obj.status.conditions, Condition(
                     type=COND_READY, status="True", reason="Running",
                 ))
+                # a clean install supersedes any stale upgrade-failure
+                # signal from an earlier rollback
+                if any(c.type == "UpgradeFailed"
+                       for c in obj.status.conditions):
+                    set_condition(obj.status.conditions, Condition(
+                        type="UpgradeFailed", status="False",
+                        reason="Recovered",
+                    ))
             else:
                 obj.status.api_ready = False
                 set_condition(obj.status.conditions, Condition(
@@ -194,9 +333,10 @@ class KarmadaOperator:
         self.store.mutate(Karmada.KIND, "", name, finish)
         if ok:
             self.planes[name] = plane_box["plane"]
-            self.observed[name] = cr.metadata.generation
-            return None
-        return False  # AsyncWorker requeues with its bounded retry budget
+        elif "plane" in plane_box:
+            # a partially-started plane must not leak its threads/WAL handle
+            plane_box["plane"].runtime.stop()
+        return ok
 
     def _upgrade(self, name: str):
         """Reconcile a SPEC CHANGE on a live plane (the reference operator's
@@ -204,7 +344,13 @@ class KarmadaOperator:
         checkpoint + stop the old component set, then rebuild from the SAME
         data dir under the new spec — state survives through the WAL the way
         the reference's control planes survive through etcd.  A failed
-        rebuild returns False so the worker retries with backoff budget."""
+        rebuild ROLLS BACK: the previous spec is reinstalled from the same
+        data dir, so the plane keeps serving while the bad spec sits in
+        phase Failed / condition UpgradeFailed for the operator's owner."""
+        cr = self.store.try_get(Karmada.KIND, "", name)
+        if cr is None:
+            return None
+
         def set_phase(obj: Karmada) -> None:
             obj.status.phase = "Upgrading"
             obj.status.api_ready = False
@@ -218,7 +364,36 @@ class KarmadaOperator:
             old.checkpoint()
             old.runtime.stop()
         self.observed.pop(name, None)
-        return self._reconcile(name)  # install path against the persisted dir
+
+        ok = self._install(name, cr, cr.spec)
+        if ok:
+            self.observed[name] = cr.metadata.generation
+            self.installed_spec[name] = copy_spec(cr.spec)
+            return None
+
+        prev = self.installed_spec.get(name)
+        if prev is None:
+            return False  # nothing to roll back to: retry the new spec
+        rolled = self._install(name, cr, prev)
+
+        def record(obj: Karmada) -> None:
+            set_condition(obj.status.conditions, Condition(
+                type="UpgradeFailed", status="True", reason="RolledBack"
+                if rolled else "RollbackFailed",
+                message="upgrade install failed; previous spec "
+                        + ("restored" if rolled else "could NOT be restored"),
+            ))
+            if rolled:
+                # the plane is serving again — on the OLD spec
+                obj.status.phase = "Running"
+                obj.status.api_ready = True
+        self.store.mutate(Karmada.KIND, "", name, record)
+        if rolled:
+            # observe the failed generation so the bad spec is not retried
+            # in a loop; a NEW generation (fixed spec) upgrades again
+            self.observed[name] = cr.metadata.generation
+            return None
+        return False
 
     def _probe(self, name: str) -> None:
         plane = self.planes[name]
@@ -245,6 +420,7 @@ class KarmadaOperator:
         operator's owner to reclaim (the reference keeps etcd PVs too)."""
         plane = self.planes.pop(name, None)
         self.observed.pop(name, None)
+        self.installed_spec.pop(name, None)
         if plane is not None:
             plane.checkpoint()
             plane.runtime.stop()

@@ -115,3 +115,140 @@ def test_spec_change_upgrades_live_plane(tmp_path):
     # observed generation is now current: a further probe does not rebuild
     runtime.tick()
     assert op.plane("prod") is new_plane
+
+
+def test_cert_material_issued_and_ca_stable(tmp_path):
+    """tasks/init/cert.go analog: CA + per-component leaf credentials on
+    disk; the CA survives reinstall so member credentials stay valid."""
+    import json
+    import os
+
+    from karmada_tpu.operator import CERT_COMPONENTS
+
+    store, runtime, op = mgmt(tmp_path)
+    store.create(Karmada(metadata=ObjectMeta(name="prod")))
+    runtime.tick()
+    pki = os.path.join(str(tmp_path), "prod", "pki")
+    with open(os.path.join(pki, "ca.json")) as f:
+        ca1 = json.load(f)
+    for comp in CERT_COMPONENTS:
+        with open(os.path.join(pki, f"{comp}.json")) as f:
+            leaf = json.load(f)
+        assert leaf["fingerprint"] and leaf["expires_at"] > leaf["issued_at"]
+    # component config rendered into the plane carries the scheduler cert
+    plane = op.plane("prod")
+    cm = plane.store.get("ConfigMap", "karmada-system", "scheduler")
+    assert cm.manifest["data"]["cert"]
+
+    # reinstall (delete CR, recreate): CA material is reused
+    store.delete(Karmada.KIND, "", "prod")
+    runtime.tick()
+    store.create(Karmada(metadata=ObjectMeta(name="prod")))
+    runtime.tick()
+    with open(os.path.join(pki, "ca.json")) as f:
+        ca2 = json.load(f)
+    assert ca1["secret"] == ca2["secret"]
+
+
+def test_install_fails_midway_then_recovers(tmp_path):
+    """A task failure mid-graph (apiserver phase) marks the earlier phases
+    True, the failed one False, phase Failed — and the next reconcile
+    completes the install once the fault clears (workflow retry
+    semantics, operator/pkg/workflow/job.go)."""
+    from karmada_tpu.operator import (
+        PHASE_APISERVER,
+        PHASE_CERT,
+        PHASE_STORE,
+    )
+
+    store = ObjectStore()
+    runtime = Runtime()
+    faults = {"armed": True}
+
+    def inject(phase, name):
+        if phase == PHASE_APISERVER and faults["armed"]:
+            raise RuntimeError("injected: apiserver bringup failed")
+
+    op = KarmadaOperator(store, runtime, base_dir=str(tmp_path),
+                         fault_injector=inject)
+    store.create(Karmada(metadata=ObjectMeta(name="prod")))
+    runtime.tick()
+    cr = store.get(Karmada.KIND, "", "prod")
+    conds = {c.type: c.status for c in cr.status.conditions}
+    assert cr.status.phase == "Failed"
+    assert conds[PHASE_CERT] == "True"
+    assert conds[PHASE_STORE] == "True"
+    assert conds[PHASE_APISERVER] == "False"
+    assert not cr.status.api_ready
+    assert op.plane("prod") is None
+
+    # the fault clears; the operator's retry completes the graph
+    faults["armed"] = False
+    op.worker.enqueue("prod")
+    runtime.tick()
+    cr = store.get(Karmada.KIND, "", "prod")
+    assert cr.status.phase == "Running"
+    assert cr.status.api_ready
+    assert op.plane("prod") is not None
+
+
+def test_failed_upgrade_rolls_back_to_previous_spec(tmp_path):
+    """Upgrade rollback: a spec change whose install fails restores the
+    previously-installed spec from the same data dir; the plane keeps
+    serving and the CR records UpgradeFailed/RolledBack."""
+    from karmada_tpu.operator import PHASE_COMPONENTS
+
+    store = ObjectStore()
+    runtime = Runtime()
+    # one-shot fault: the BAD spec's component rollout fails; the rollback
+    # install (old, known-good spec) succeeds
+    faults = {"remaining": 0}
+
+    def inject(phase, name):
+        if phase == PHASE_COMPONENTS and faults["remaining"] > 0:
+            faults["remaining"] -= 1
+            raise RuntimeError("injected: component rollout failed")
+
+    op = KarmadaOperator(store, runtime, base_dir=str(tmp_path),
+                         fault_injector=inject)
+    store.create(Karmada(metadata=ObjectMeta(name="prod"), spec=KarmadaSpec(
+        components=KarmadaComponents(descheduler=False))))
+    runtime.tick()
+    assert store.get(Karmada.KIND, "", "prod").status.phase == "Running"
+    plane_before = op.plane("prod")
+    plane_before.add_member("m1")
+    plane_before.tick()
+
+    # the upgrade's component rollout will fail (once)
+    faults["remaining"] = 1
+
+    def change(obj):
+        obj.spec.components.descheduler = True
+    store.mutate(Karmada.KIND, "", "prod", change)
+    runtime.tick()
+    cr = store.get(Karmada.KIND, "", "prod")
+    conds = {c.type: (c.status, c.reason) for c in cr.status.conditions}
+    assert conds["UpgradeFailed"] == ("True", "RolledBack")
+    assert cr.status.phase == "Running"  # rolled back and serving
+    assert cr.status.api_ready
+    plane = op.plane("prod")
+    assert plane is not None
+    # the rolled-back plane runs the OLD spec (descheduler off) even
+    # though the CR still carries the bad new spec — and kept the data
+    assert plane.descheduler is None
+    assert plane.store.try_get("Cluster", "", "m1") is not None
+
+    # a FIXED spec (new generation) upgrades cleanly afterwards
+    def change2(obj):
+        obj.spec.components.search = False  # a real spec change
+    store.mutate(Karmada.KIND, "", "prod", change2)
+    runtime.tick()
+    cr = store.get(Karmada.KIND, "", "prod")
+    assert cr.status.phase == "Running"
+    plane2 = op.plane("prod")
+    assert plane2 is not None and plane2 is not plane_before
+    # the clean upgrade records the new spec as the rollback target and
+    # clears the stale UpgradeFailed signal
+    assert op.installed_spec["prod"].components.search is False
+    up = next(c for c in cr.status.conditions if c.type == "UpgradeFailed")
+    assert up.status == "False" and up.reason == "Recovered"
