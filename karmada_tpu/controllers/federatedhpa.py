@@ -29,6 +29,7 @@ from karmada_tpu.models.autoscaling import (
     SELECT_MIN,
     TARGET_AVERAGE_VALUE,
     TARGET_UTILIZATION,
+    TARGET_VALUE,
     CronFederatedHPA,
     ExecutionHistory,
     FederatedHPA,
@@ -189,10 +190,21 @@ class FederatedHPAController:
         # k8s: every metric proposes a replica count; the max wins
         statuses: List[MetricStatusValue] = []
         proposals: List[int] = []
+        ready = max(len(samples), 1)
         for metric in hpa.spec.metrics:
-            if metric.resource is None:
+            if metric.resource is not None:
+                d, st = self.calc.desired_for_metric(metric, samples, current)
+            elif metric.pods is not None:
+                d, st = self._desired_for_pods(metric.pods, ref, ns,
+                                               current, ready)
+            elif metric.object is not None:
+                d, st = self._desired_for_object(metric.object, ns,
+                                                 current, ready)
+            elif metric.external is not None:
+                d, st = self._desired_for_external(metric.external,
+                                                   current, ready)
+            else:
                 continue
-            d, st = self.calc.desired_for_metric(metric, samples, current)
             statuses.append(st)
             proposals.append(d)
         desired = max(proposals) if proposals else current
@@ -219,6 +231,71 @@ class FederatedHPAController:
         self.store.mutate(FederatedHPA.KIND, ns, name, set_status)
 
     # -- stabilization + behavior ------------------------------------------
+    # -- non-resource metric sources (replica_calculator.go Get*Replicas) ---
+    def _desired_for_pods(self, src, ref, ns: str, current: int,
+                          ready: int) -> Tuple[int, MetricStatusValue]:
+        """Pods metric: the workload's per-pod custom series summed across
+        clusters; AverageValue semantics — desired = ceil(total / target)."""
+        got = self.metrics.custom_metric_by_name(ref.kind, ns, ref.name,
+                                                 src.metric)
+        if got is None or src.target.average_value is None:
+            # no samples, or a misconfigured target (Pods metrics are
+            # AverageValue-only in autoscaling/v2): hold, never explode
+            return current, MetricStatusValue(name=src.metric)
+        target = max(src.target.average_value, 1)
+        desired = int(math.ceil(got["value"] / target))
+        return desired, MetricStatusValue(
+            name=src.metric,
+            current_average_value=int(got["value"] / ready))
+
+    def _desired_for_object(self, src, ns: str, current: int,
+                            ready: int) -> Tuple[int, MetricStatusValue]:
+        """Object metric: one described object's merged value.  Value
+        target scales the ready count by value/target; AverageValue divides
+        the value across pods."""
+        obj = src.described_object
+        got = self.metrics.custom_metric_by_name(obj.kind, ns, obj.name,
+                                                 src.metric)
+        if got is None:
+            return current, MetricStatusValue(name=src.metric)
+        value = got["value"]
+        status = MetricStatusValue(name=src.metric,
+                                   current_average_value=int(value / ready))
+        if (src.target.type == TARGET_AVERAGE_VALUE
+                and src.target.average_value is not None):
+            desired = int(math.ceil(value / max(src.target.average_value, 1)))
+        elif src.target.type == TARGET_VALUE and src.target.value is not None:
+            ratio = value / max(src.target.value, 1)
+            desired = current if abs(ratio - 1.0) <= TOLERANCE else int(
+                math.ceil(ratio * ready))
+        else:
+            # misconfigured target (e.g. the Utilization default, or the
+            # matching value field unset): hold current
+            return current, status
+        return desired, status
+
+    def _desired_for_external(self, src, current: int,
+                              ready: int) -> Tuple[int, MetricStatusValue]:
+        """External metric: selector-filtered labeled series summed.  Value
+        target scales ready by total/target; AverageValue divides."""
+        values = self.metrics.external_metric_values(
+            src.metric, src.selector or None)
+        if not values:
+            return current, MetricStatusValue(name=src.metric)
+        total = sum(float(v.get("value", 0)) for v in values)
+        status = MetricStatusValue(name=src.metric,
+                                   current_average_value=int(total / ready))
+        if (src.target.type == TARGET_AVERAGE_VALUE
+                and src.target.average_value is not None):
+            desired = int(math.ceil(total / max(src.target.average_value, 1)))
+        elif src.target.type == TARGET_VALUE and src.target.value is not None:
+            ratio = total / max(src.target.value, 1)
+            desired = current if abs(ratio - 1.0) <= TOLERANCE else int(
+                math.ceil(ratio * ready))
+        else:
+            return current, status  # misconfigured target: hold current
+        return desired, status
+
     def _stabilize(self, ns: str, name: str, hpa: FederatedHPA,
                    current: int, desired: int) -> int:
         """Record the recommendation; within the stabilization window the

@@ -58,6 +58,10 @@ class FakeMemberCluster:
     # Unset workloads idle at 10% of their request (something nonzero for
     # utilization math without claiming precision the simulator lacks).
     load: Dict[tuple, Dict[str, int]] = field(default_factory=dict)
+    # custom metric series this member serves (custom.metrics.k8s.io):
+    # (kind, namespace, name, metric) -> value — the simulator's stand-in
+    # for an in-cluster custom-metrics API (prometheus-adapter etc.)
+    custom_metrics: Dict[tuple, float] = field(default_factory=dict)
     # per-workload lifecycle journal: (kind, ns, name) -> lines.  This is
     # what `karmadactl logs/attach` stream through the cluster proxy — the
     # simulator's honest stand-in for container stdout (the reference

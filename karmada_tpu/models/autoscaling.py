@@ -9,13 +9,14 @@ rules) federated across member clusters, plus cron-driven scaling.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from karmada_tpu.models.meta import Condition, ObjectMeta, TypedObject
 
 # metric target types (autoscaling/v2)
 TARGET_UTILIZATION = "Utilization"
 TARGET_AVERAGE_VALUE = "AverageValue"
+TARGET_VALUE = "Value"
 
 # scaling policy types
 POLICY_PODS = "Pods"
@@ -38,6 +39,7 @@ class MetricTarget:
     type: str = TARGET_UTILIZATION
     average_utilization: Optional[int] = None  # percent of request
     average_value: Optional[int] = None  # milli-units per pod
+    value: Optional[int] = None  # absolute (Object/External Value targets)
 
 
 @dataclass
@@ -47,9 +49,40 @@ class ResourceMetricSource:
 
 
 @dataclass
+class PodsMetricSource:
+    """custom.metrics.k8s.io per-pod series (autoscaling/v2 PodsMetricSource);
+    served multi-cluster by the metrics adapter's custom provider."""
+
+    metric: str = ""
+    target: MetricTarget = field(default_factory=MetricTarget)  # AverageValue
+
+
+@dataclass
+class ObjectMetricSource:
+    """A single object's custom metric (autoscaling/v2 ObjectMetricSource)."""
+
+    described_object: CrossVersionObjectReference = field(
+        default_factory=CrossVersionObjectReference)
+    metric: str = ""
+    target: MetricTarget = field(default_factory=MetricTarget)  # Value | AverageValue
+
+
+@dataclass
+class ExternalMetricSource:
+    """external.metrics.k8s.io series (autoscaling/v2 ExternalMetricSource)."""
+
+    metric: str = ""
+    selector: Dict[str, str] = field(default_factory=dict)
+    target: MetricTarget = field(default_factory=MetricTarget)  # Value | AverageValue
+
+
+@dataclass
 class MetricSpec:
-    type: str = "Resource"
+    type: str = "Resource"  # Resource | Pods | Object | External
     resource: Optional[ResourceMetricSource] = None
+    pods: Optional[PodsMetricSource] = None
+    object: Optional[ObjectMetricSource] = None
+    external: Optional[ExternalMetricSource] = None
 
 
 @dataclass

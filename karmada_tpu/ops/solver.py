@@ -472,11 +472,13 @@ assert COMPACT_SELECTION_CAP <= COMPACT_DIVISION_CAP, "selection cap too big"
 
 
 def _gather_lanes(feasible, avail_sel, w_gather, prev_present, score,
-                  name_rank, rank_eff):
+                  name_rank, rank_eff, use_extra: bool):
     """The union-of-top-K lane set for one binding: indices[K] plus a
     validity mask (duplicates and junk lanes disabled).  The score-keyed
-    gather covers selection order under out-of-tree score plugins (without
-    extras, score > 0 only on prev lanes, which the prev gather covers)."""
+    5th gather covers selection order under out-of-tree score plugins;
+    without them (use_extra=False, the common case — statically known per
+    compile) score > 0 only on prev lanes, which the prev gather already
+    covers, so the kernel keeps the 4-group lane volume."""
     C = feasible.shape[0]
     nr = jnp.asarray(name_rank, jnp.int64)
     wq = jnp.clip(w_gather, 0, _AVAIL_CAP) << _LANE_BITS
@@ -486,19 +488,22 @@ def _gather_lanes(feasible, avail_sel, w_gather, prev_present, score,
     key_w_rank = jnp.where(feasible, wq | (_LANE_MASK - rank_eff), NEG)
     key_w_name = jnp.where(feasible, wq | (_LANE_MASK - nr), NEG)
     key_a_name = jnp.where(feasible, aq | (_LANE_MASK - nr), NEG)
-    # the selection sort key itself: score desc, avail desc, name asc
-    key_sel = jnp.where(
-        feasible,
-        (jnp.clip(score, 0, 255) << (_AVAIL_BITS + _LANE_BITS))
-        | aq | (_LANE_MASK - nr),
-        NEG,
-    )
     _, ip = lax.top_k(key_prev, _G_PREV)
     _, iw = lax.top_k(key_w_rank, _G_TOPK)
     _, inm = lax.top_k(key_w_name, _G_TOPK)
     _, ia = lax.top_k(key_a_name, _G_TOPK)
-    _, isel = lax.top_k(key_sel, _G_TOPK)
-    lanes = jnp.concatenate([ip, iw, inm, ia, isel])  # [K]
+    groups = [ip, iw, inm, ia]
+    if use_extra:
+        # the selection sort key itself: score desc, avail desc, name asc
+        key_sel = jnp.where(
+            feasible,
+            (jnp.clip(score, 0, 255) << (_AVAIL_BITS + _LANE_BITS))
+            | aq | (_LANE_MASK - nr),
+            NEG,
+        )
+        _, isel = lax.top_k(key_sel, _G_TOPK)
+        groups.append(isel)
+    lanes = jnp.concatenate(groups)  # [K]
     lanes = jnp.sort(lanes)
     dup = jnp.concatenate(
         [jnp.zeros((1,), bool), lanes[1:] == lanes[:-1]])
@@ -509,6 +514,7 @@ def _schedule_one(
     feasible, avail_cal, prev_present, prev_rep, extra_score, name_rank,
     n, strategy, has_sc, sc_min, sc_max, ignore_avail,
     static_w, uid_desc, fresh, non_workload, valid,
+    *, use_extra: bool = True,
 ):
     """One binding; vmapped over the batch.  Small cluster axes run the
     lane math directly; large ones gather COMPACT_LANES first."""
@@ -529,7 +535,7 @@ def _schedule_one(
                   + jnp.asarray(extra_score, jnp.int64))
     lanes, lane_ok = _gather_lanes(
         feasible, avail_sel, w_gather, prev_present, score_full, name_rank,
-        rank_eff)
+        rank_eff, use_extra)
     g = lambda a: a[lanes]
     feas_k = g(feasible) & lane_ok
     rank_eff_k = g(rank_eff)
@@ -556,10 +562,17 @@ def _schedule_one(
     return rep, sel, status
 
 
-_schedule_vmap = jax.vmap(
-    _schedule_one,
-    in_axes=(0, 0, 0, 0, 0, None, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0),
-)
+def _schedule_vmap_for(use_extra: bool):
+    """vmapped kernel per static plugin-score mode (two compile variants:
+    the no-plugin one keeps the 4-group gather volume)."""
+    return jax.vmap(
+        partial(_schedule_one, use_extra=use_extra),
+        in_axes=(0, 0, 0, 0, 0, None, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0),
+    )
+
+
+_SCHEDULE_VMAPS = {True: _schedule_vmap_for(True),
+                   False: _schedule_vmap_for(False)}
 
 
 def _schedule_core(
@@ -575,7 +588,7 @@ def _schedule_core(
     # bindings
     b_valid, placement_id, gvk_id, class_id, replicas, uid_desc, fresh,
     non_workload, nw_shortcut, prev_idx, prev_val, evict_idx,
-    *, waves: int = 1,
+    *, waves: int = 1, use_extra: bool = True,
 ):
     """The full cycle: returns (rep[B,C] int64, selected[B,C] bool, status[B]).
 
@@ -663,7 +676,7 @@ def _schedule_core(
             & ~evict_w
         )
 
-        rep, sel, status = _schedule_vmap(
+        rep, sel, status = _SCHEDULE_VMAPS[use_extra](
             feasible, avail_cal, prev_present_w, prev_rep_w,
             pl_extra_score[placement_id_w], name_rank,
             replicas_w, pl_strategy[placement_id_w],
@@ -720,7 +733,8 @@ def _schedule_core(
 # environment runs) materializes every jit OUTPUT to the host, so returning
 # the dense [B, C] planes costs ~300 MB of D2H per chunk regardless of what
 # the caller reads — measured as the entire chunk budget at 4096x8192.
-schedule_batch = partial(jax.jit, static_argnames=("waves",))(_schedule_core)
+schedule_batch = partial(jax.jit,
+                         static_argnames=("waves", "use_extra"))(_schedule_core)
 
 
 def _compact_of(rep, sel, status, non_workload, max_nnz: int,
@@ -746,12 +760,14 @@ def _compact_of(rep, sel, status, non_workload, max_nnz: int,
 _NON_WORKLOAD_ARG = 28
 
 
-@partial(jax.jit, static_argnames=("waves", "max_nnz", "keep_sel"))
-def schedule_compact(*args, waves: int, max_nnz: int, keep_sel: bool = False):
+@partial(jax.jit, static_argnames=("waves", "max_nnz", "keep_sel",
+                                   "use_extra"))
+def schedule_compact(*args, waves: int, max_nnz: int, keep_sel: bool = False,
+                     use_extra: bool = True):
     """The full cycle with the sparse COO extraction FUSED into one jitted
     program: the dense [B, C] result planes never become jit outputs, so
     only idx/val/status/nnz (~max_nnz ints) ever leave the device."""
-    rep, sel, status = _schedule_core(*args, waves=waves)
+    rep, sel, status = _schedule_core(*args, waves=waves, use_extra=use_extra)
     return _compact_of(rep, sel, status, args[_NON_WORKLOAD_ARG], max_nnz,
                        keep_sel=keep_sel)
 
@@ -791,6 +807,12 @@ def _cluster_args(batch):
     return dev
 
 
+def _use_extra(batch) -> bool:
+    """Static per-compile plugin-score mode: the encoder's extra-score rows
+    are all-zero unless an out-of-tree score plugin is registered."""
+    return bool(batch.pl_extra_score.any())
+
+
 def _batch_args(batch):
     return _cluster_args(batch) + (
         # binding-axis tensors change every chunk: no caching value
@@ -809,7 +831,8 @@ def solve(batch, waves: int = 1):
     # packed sort keys reserve _LANE_BITS bits for the cluster lane
     assert batch.C <= MAX_CLUSTER_LANES, \
         f"cluster axis must be <= {MAX_CLUSTER_LANES} per solve call"
-    rep, sel, status = schedule_batch(*_batch_args(batch), waves=waves)
+    rep, sel, status = schedule_batch(*_batch_args(batch), waves=waves,
+                                      use_extra=_use_extra(batch))
     return np.asarray(rep), np.asarray(sel), np.asarray(status)
 
 
@@ -832,9 +855,10 @@ def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
         max_nnz = dense_nnz if keep_sel else min(
             max(batch.B * 16, 1 << 14), dense_nnz)
     args = _batch_args(batch)
+    use_extra = _use_extra(batch)
     first = schedule_compact(*args, waves=waves, max_nnz=max_nnz,
-                             keep_sel=keep_sel)
-    return (args, waves, keep_sel, first, max_nnz, dense_nnz)
+                             keep_sel=keep_sel, use_extra=use_extra)
+    return (args, waves, keep_sel, first, max_nnz, dense_nnz, use_extra)
 
 
 def finalize_compact(handle):
@@ -846,13 +870,14 @@ def finalize_compact(handle):
     every-binding-selects-most-clusters mixes)."""
     import numpy as np
 
-    args, waves, keep_sel, first, max_nnz, dense_nnz = handle
+    args, waves, keep_sel, first, max_nnz, dense_nnz, use_extra = handle
     idx, val, st, nnz = first
     while int(nnz) > max_nnz and max_nnz < dense_nnz:
         max_nnz = min(max_nnz * 4, dense_nnz)
         idx, val, st, nnz = schedule_compact(*args, waves=waves,
                                              max_nnz=max_nnz,
-                                             keep_sel=keep_sel)
+                                             keep_sel=keep_sel,
+                                             use_extra=use_extra)
     return np.asarray(idx), np.asarray(val), np.asarray(st), int(nnz)
 
 

@@ -45,6 +45,7 @@ from karmada_tpu.ops.solver import (
     _capacity_estimates,
     _compact_of,
     _schedule_core,
+    _use_extra,
 )
 
 WEIGHT_UNIT = serial.WEIGHT_UNIT  # 1000 (group_clusters.go:139)
@@ -253,7 +254,8 @@ def _pick_one(order, feasible, avail_sel, score, name_rank, region_id,
 _pick_vmap = jax.vmap(_pick_one, in_axes=(0, 0, 0, 0, None, None, 0, 0, None))
 
 
-@partial(jax.jit, static_argnames=("G", "waves", "max_nnz", "keep_sel"))
+@partial(jax.jit, static_argnames=("G", "waves", "max_nnz", "keep_sel",
+                                   "use_extra"))
 def spread_assign_compact(
     # cluster axis
     cluster_valid, deleting, name_rank, pods_allowed, has_summary,
@@ -268,6 +270,7 @@ def spread_assign_compact(
     chosen, cluster_max,
     strategy, static_w, ignore_avail, uid_desc, fresh, non_workload, b_valid,
     *, G: int, waves: int, max_nnz: int, keep_sel: bool = False,
+    use_extra: bool = True,
 ):
     """Phase B + assignment, FUSED: recompute the planes, pick clusters in
     the chosen regions, and run the main assignment kernel with the pick as
@@ -300,7 +303,7 @@ def spread_assign_compact(
         b_valid, jnp.arange(B, dtype=jnp.int32), gvk_id, class_id,
         replicas, uid_desc, fresh, non_workload, nw_shortcut,
         prev_idx, prev_val, evict_idx,
-        waves=waves,
+        waves=waves, use_extra=use_extra,
     )
     return _compact_of(rep, selected, status, non_workload, max_nnz,
                        keep_sel=keep_sel)
@@ -406,6 +409,7 @@ def solve_spread(
     lpid = pid[live_np]
     b_valid = np.zeros(Bs, bool)
     b_valid[:n_live] = True
+    use_extra = _use_extra(batch)  # one shared predicate, hoisted off retries
 
     def assign(max_nnz):
         return spread_assign_compact(
@@ -424,6 +428,7 @@ def solve_spread(
             batch.fresh[lidx], batch.non_workload[lidx], b_valid,
             G=G, waves=waves, max_nnz=max_nnz,
             keep_sel=enable_empty_workload_propagation,
+            use_extra=use_extra,
         )
 
     max_nnz = (Bs * C if enable_empty_workload_propagation

@@ -128,12 +128,38 @@ class QueryPlaneServer:
             # len 4: no workload name -> all of the kind in the namespace
             return 200, self.metrics_provider.pod_metrics(
                 parts[2], parts[3], parts[4] if len(parts) == 5 else "")
+        if parts[:2] == ["metrics-adapter", "nodes"] and len(parts) == 2 \
+                and self.metrics_provider is not None:
+            return 200, self.metrics_provider.node_metrics()
+        if parts[:2] == ["metrics-adapter", "custom-list"] \
+                and self.metrics_provider is not None:
+            return 200, self.metrics_provider.list_all_metrics()
+        if parts[:2] == ["metrics-adapter", "custom"] and len(parts) == 6 \
+                and self.metrics_provider is not None:
+            out = self.metrics_provider.custom_metric_by_name(
+                parts[2], parts[3], parts[4], parts[5])
+            if out is None:
+                return 404, {"error": "no such metric"}
+            return 200, out
+        if parts[:2] == ["metrics-adapter", "custom-selector"] \
+                and len(parts) == 5 and self.metrics_provider is not None:
+            selector = {
+                k: v[0] for k, v in query.items()
+                if k not in ("namespace",)
+            }
+            return 200, self.metrics_provider.custom_metric_by_selector(
+                parts[2], parts[3], selector or None, parts[4])
         if parts[:2] == ["metrics-adapter", "external"] and len(parts) == 3 \
                 and self.metrics_provider is not None:
-            v = self.metrics_provider.external_metric(parts[2])
-            if v is None:
-                return 404, {"error": "no such metric"}
-            return 200, {"name": parts[2], "value": v}
+            selector = {k: v[0] for k, v in query.items()}
+            values = self.metrics_provider.external_metric_values(
+                parts[2], selector or None)
+            if not values:
+                return 404, {"error": "no such metric (or selector matched "
+                                      "no samples)"}
+            # the scalar aggregate is the sum over the FILTERED samples
+            total = sum(float(s.get("value", 0)) for s in values)
+            return 200, {"name": parts[2], "value": total, "values": values}
 
         if parts[:1] == ["api"] and method == "GET":
             ns = (query.get("namespace") or [None])[0]

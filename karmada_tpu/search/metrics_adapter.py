@@ -5,9 +5,17 @@ externalmetrics}.go — implements metrics.k8s.io / custom.metrics.k8s.io /
 external.metrics.k8s.io by querying every relevant member cluster and
 merging.  The FederatedHPA controller consumes this exact surface.
 
-Here the provider fans out to the member simulators' pod-metrics endpoints
-and merges, keeping the reference's shape: a list of per-pod samples with
-usage + request, tagged with the origin cluster.
+All three provider families fan out to the member simulators and merge,
+keeping the reference's shapes:
+  * resource metrics: per-pod usage+request samples and per-node usage,
+    tagged with the origin cluster (resourcemetrics.go GetPodMetrics /
+    GetNodeMetrics);
+  * custom metrics: object-scoped series queried by name or by label
+    selector across members, merged with per-cluster samples plus the
+    summed value (custommetrics.go GetMetricByName/GetMetricBySelector/
+    ListAllMetrics);
+  * external metrics: labeled series filtered by a metric selector
+    (externalmetrics.go GetExternalMetric).
 """
 
 from __future__ import annotations
@@ -15,11 +23,19 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 
+def _labels_match(selector: Optional[Dict[str, str]],
+                  labels: Dict[str, str]) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
 class MultiClusterMetricsProvider:
     def __init__(self, members) -> None:
         self.members = members  # name -> FakeMemberCluster
-        # external metric series: name -> value (pluggable for tests)
-        self.external: Dict[str, float] = {}
+        # external metric series: name -> scalar (back-compat) OR a list of
+        # {"labels": {...}, "value": float} samples (pluggable for tests)
+        self.external: Dict[str, object] = {}
 
     def pod_metrics(
         self,
@@ -43,6 +59,106 @@ class MultiClusterMetricsProvider:
                 out.append(sample)
         return out
 
+    def node_metrics(self, clusters: Optional[List[str]] = None) -> List[dict]:
+        """Merged NodeMetrics across members (resourcemetrics.go
+        GetNodeMetrics): usage apportioned over each member's nodes by
+        their share of the member's cpu capacity."""
+        out: List[dict] = []
+        targets = clusters if clusters is not None else list(self.members)
+        for cname in targets:
+            member = self.members.get(cname)
+            if member is None or not member.healthy:
+                continue
+            used = member.used_milli()
+            nodes = member.effective_nodes()
+            total_cpu = max(sum(n.cpu_milli for n in nodes), 1)
+            for n in nodes:
+                share = n.cpu_milli / total_cpu
+                out.append({
+                    "name": n.name, "cluster": cname,
+                    "usage": {res: int(v * share) for res, v in used.items()},
+                    "allocatable": {"cpu": n.cpu_milli,
+                                    "memory": n.memory_milli,
+                                    "pods": n.pods},
+                })
+        return out
+
+    # -- custom.metrics.k8s.io ----------------------------------------------
+    def custom_metric_by_name(self, kind: str, namespace: str, name: str,
+                              metric: str,
+                              clusters: Optional[List[str]] = None) -> Optional[dict]:
+        """custommetrics.go GetMetricByName: query every member for the
+        object's series and merge — per-cluster samples plus the summed
+        value (the reference returns the multi-cluster aggregate)."""
+        samples = []
+        targets = clusters if clusters is not None else list(self.members)
+        for cname in targets:
+            member = self.members.get(cname)
+            if member is None or not member.healthy:
+                continue
+            v = member.custom_metrics.get((kind, namespace, name, metric))
+            if v is not None:
+                samples.append({"cluster": cname, "value": float(v)})
+        if not samples:
+            return None
+        return {"metric": metric, "kind": kind, "namespace": namespace,
+                "name": name, "value": sum(s["value"] for s in samples),
+                "samples": samples}
+
+    def custom_metric_by_selector(self, kind: str, namespace: str,
+                                  selector: Optional[Dict[str, str]],
+                                  metric: str) -> List[dict]:
+        """custommetrics.go GetMetricBySelector: objects of `kind` in
+        `namespace` matching the label selector, across all members."""
+        out: List[dict] = []
+        seen = set()
+        for cname, member in self.members.items():
+            if not member.healthy:
+                continue
+            for (k, ns, name, m), _v in member.custom_metrics.items():
+                if k != kind or ns != namespace or m != metric:
+                    continue
+                obj = member.get(kind, ns, name)
+                labels = (obj.metadata.labels if obj is not None else {})
+                if not _labels_match(selector, labels):
+                    continue
+                if (ns, name) in seen:
+                    continue
+                seen.add((ns, name))
+                merged = self.custom_metric_by_name(kind, ns, name, metric)
+                if merged is not None:
+                    out.append(merged)
+        return out
+
+    def list_all_metrics(self) -> List[str]:
+        """custommetrics.go ListAllMetrics: every metric name any member
+        serves, deduplicated."""
+        names = set()
+        for member in self.members.values():
+            for (_k, _ns, _n, metric) in member.custom_metrics:
+                names.add(metric)
+        return sorted(names)
+
+    # -- external.metrics.k8s.io --------------------------------------------
     def external_metric(self, name: str) -> Optional[float]:
-        """externalmetrics.go GetExternalMetric (test-pluggable series)."""
-        return self.external.get(name)
+        """externalmetrics.go GetExternalMetric, scalar view (sums labeled
+        samples; back-compat for scalar series)."""
+        series = self.external.get(name)
+        if series is None:
+            return None
+        if isinstance(series, (int, float)):
+            return float(series)
+        return sum(float(s.get("value", 0)) for s in series)
+
+    def external_metric_values(self, name: str,
+                               selector: Optional[Dict[str, str]] = None) -> List[dict]:
+        """Labeled external samples filtered by the metric selector."""
+        series = self.external.get(name)
+        if series is None:
+            return []
+        if isinstance(series, (int, float)):
+            samples = [{"labels": {}, "value": float(series)}]
+        else:
+            samples = [dict(s) for s in series]
+        return [s for s in samples
+                if _labels_match(selector, s.get("labels") or {})]

@@ -275,3 +275,86 @@ def test_suspended_rule_does_not_fire(env):
     clock.advance(61)
     cp.tick()
     assert template_replicas(cp) == 4
+
+
+def _hpa_with_metric(metric_spec, min_r=1, max_r=20):
+    return FederatedHPA(
+        metadata=ObjectMeta(name="web-hpa-custom", namespace="default"),
+        spec=FederatedHPASpec(
+            scale_target_ref=CrossVersionObjectReference(
+                api_version="apps/v1", kind="Deployment", name="web"),
+            min_replicas=min_r, max_replicas=max_r,
+            metrics=[metric_spec],
+        ),
+    )
+
+
+def test_pods_metric_scales_on_custom_series(env):
+    """Pods metric (custom.metrics.k8s.io through the adapter): the merged
+    per-pod series drives replicas — desired = ceil(total / averageValue)."""
+    from karmada_tpu.models.autoscaling import PodsMetricSource
+
+    cp, clock = env
+    cp.store.delete("FederatedHPA", "default", "web-hpa")
+    cp.store.create(_hpa_with_metric(MetricSpec(type="Pods", pods=PodsMetricSource(
+        metric="requests_per_s",
+        target=MetricTarget(type="AverageValue", average_value=100),
+    ))))
+    # members serve 350+450=800 rps for the workload -> 8 replicas
+    cp.members["m1"].custom_metrics[
+        ("Deployment", "default", "web", "requests_per_s")] = 350.0
+    cp.members["m2"].custom_metrics[
+        ("Deployment", "default", "web", "requests_per_s")] = 450.0
+    for _ in range(3):
+        clock.advance(60)
+        cp.tick()
+    dep = cp.store.get("Deployment", "default", "web")
+    assert dep.manifest["spec"]["replicas"] == 8
+
+
+def test_external_metric_with_selector(env):
+    """External metric: selector-filtered labeled series, AverageValue."""
+    from karmada_tpu.models.autoscaling import ExternalMetricSource
+
+    cp, clock = env
+    cp.store.delete("FederatedHPA", "default", "web-hpa")
+    cp.store.create(_hpa_with_metric(MetricSpec(
+        type="External",
+        external=ExternalMetricSource(
+            metric="queue_depth", selector={"queue": "payments"},
+            target=MetricTarget(type="AverageValue", average_value=10),
+        ))))
+    cp.metrics_provider.external["queue_depth"] = [
+        {"labels": {"queue": "payments"}, "value": 60.0},
+        {"labels": {"queue": "other"}, "value": 900.0},  # filtered out
+    ]
+    for _ in range(3):
+        clock.advance(60)
+        cp.tick()
+    dep = cp.store.get("Deployment", "default", "web")
+    assert dep.manifest["spec"]["replicas"] == 6
+
+
+def test_object_metric_value_target(env):
+    """Object metric with a Value target: ratio value/target scales the
+    ready pod count."""
+    from karmada_tpu.models.autoscaling import ObjectMetricSource
+
+    cp, clock = env
+    cp.store.delete("FederatedHPA", "default", "web-hpa")
+    cp.store.create(_hpa_with_metric(MetricSpec(
+        type="Object",
+        object=ObjectMetricSource(
+            described_object=CrossVersionObjectReference(
+                api_version="apps/v1", kind="Deployment", name="web"),
+            metric="backlog",
+            target=MetricTarget(type="Value", value=100),
+        ))))
+    cp.members["m1"].custom_metrics[
+        ("Deployment", "default", "web", "backlog")] = 300.0
+    clock.advance(60)
+    cp.tick()
+    dep = cp.store.get("Deployment", "default", "web")
+    ready_before = 2  # initial replicas
+    # ratio 3.0 over the ready pods at evaluation time
+    assert dep.manifest["spec"]["replicas"] >= 2 * 3

@@ -367,3 +367,86 @@ def test_fts_query_over_http(cp, tmp_path):
         assert any(h["name"] == "http-findable" for h in hits)
     finally:
         srv.stop()
+
+
+def test_metrics_provider_families(cp):
+    """The three provider families (pkg/metricsadapter/provider/): node
+    metrics fan-out, custom metrics by name/selector with multi-cluster
+    merge, and labeled external series."""
+    cp.store.create(registry())
+    cp.apply_policy(dup_policy())
+    cp.apply(deployment("svc"))
+    cp.tick()
+    mp = cp.metrics_provider
+
+    # resource metrics: every healthy member contributes its node(s)
+    nodes = mp.node_metrics()
+    assert {n["cluster"] for n in nodes} == {"m1", "m2", "m3"}
+    assert all(n["allocatable"]["cpu"] > 0 for n in nodes)
+    cp.members["m2"].healthy = False
+    assert {n["cluster"] for n in mp.node_metrics()} == {"m1", "m3"}
+    cp.members["m2"].healthy = True
+
+    # custom metrics: member-served series merge across clusters
+    cp.members["m1"].custom_metrics[
+        ("Deployment", "default", "svc", "requests_per_s")] = 120.0
+    cp.members["m2"].custom_metrics[
+        ("Deployment", "default", "svc", "requests_per_s")] = 80.0
+    got = mp.custom_metric_by_name("Deployment", "default", "svc",
+                                   "requests_per_s")
+    assert got["value"] == 200.0
+    assert {s["cluster"]: s["value"] for s in got["samples"]} == {
+        "m1": 120.0, "m2": 80.0}
+    assert mp.custom_metric_by_name("Deployment", "default", "svc",
+                                    "nope") is None
+    assert mp.list_all_metrics() == ["requests_per_s"]
+    # selector path: matches on the member object's labels
+    by_sel = mp.custom_metric_by_selector("Deployment", "default", None,
+                                          "requests_per_s")
+    assert len(by_sel) == 1 and by_sel[0]["value"] == 200.0
+    assert mp.custom_metric_by_selector(
+        "Deployment", "default", {"tier": "gold"}, "requests_per_s") == []
+
+    # external metrics: labeled series + scalar back-compat
+    mp.external["queue_depth"] = [
+        {"labels": {"queue": "payments"}, "value": 31.0},
+        {"labels": {"queue": "emails"}, "value": 7.0},
+    ]
+    assert mp.external_metric("queue_depth") == 38.0
+    vals = mp.external_metric_values("queue_depth", {"queue": "payments"})
+    assert vals == [{"labels": {"queue": "payments"}, "value": 31.0}]
+    mp.external["flat"] = 5
+    assert mp.external_metric_values("flat") == [{"labels": {}, "value": 5.0}]
+
+
+def test_metrics_families_over_http(cp):
+    import json as _json
+    import urllib.request
+
+    from karmada_tpu.search.httpapi import QueryPlaneServer
+
+    cp.store.create(registry())
+    cp.apply_policy(dup_policy())
+    cp.apply(deployment("svc"))
+    cp.tick()
+    cp.members["m1"].custom_metrics[
+        ("Deployment", "default", "svc", "rps")] = 9.0
+    cp.metrics_provider.external["queue_depth"] = [
+        {"labels": {"queue": "a"}, "value": 3.0}]
+    srv = QueryPlaneServer(cp.store, cp.members, cp.cluster_proxy,
+                           search_cache=cp.search_cache,
+                           metrics_provider=cp.metrics_provider)
+    url = srv.start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(url + path, timeout=10) as r:
+                return _json.loads(r.read())
+        assert {n["cluster"] for n in get("/metrics-adapter/nodes")} == {
+            "m1", "m2", "m3"}
+        assert get("/metrics-adapter/custom-list") == ["rps"]
+        got = get("/metrics-adapter/custom/Deployment/default/svc/rps")
+        assert got["value"] == 9.0
+        ext = get("/metrics-adapter/external/queue_depth?queue=a")
+        assert ext["value"] == 3.0 and ext["values"][0]["labels"] == {"queue": "a"}
+    finally:
+        srv.stop()
