@@ -277,7 +277,8 @@ def load_tpu_latest(ckpt_dir: str, args) -> Optional[dict]:
     if (cfg.get("bindings") == args.bindings
             and cfg.get("clusters") == args.clusters
             and cfg.get("chunk") == args.chunk
-            and cfg.get("waves") == args.waves):
+            and cfg.get("waves") == args.waves
+            and cfg.get("carry", False) == getattr(args, "carry", False)):
         return rec
     return None
 
@@ -286,7 +287,8 @@ def save_tpu_latest(ckpt_dir: str, args, payload: dict) -> None:
     os.makedirs(ckpt_dir, exist_ok=True)
     rec = {
         "config": {"bindings": args.bindings, "clusters": args.clusters,
-                   "chunk": args.chunk, "waves": args.waves},
+                   "chunk": args.chunk, "waves": args.waves,
+                   "carry": getattr(args, "carry", False)},
         "source_digest": source_digest(),
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "payload": payload,
@@ -603,7 +605,7 @@ def build_bindings(rng: random.Random, n_bindings: int, placements):
 
 
 def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8,
-                ckpt_done=None, ckpt_log=None):
+                ckpt_done=None, ckpt_log=None, carry: bool = False):
     """Returns (elapsed_s, solve_s, scheduled_count, chunk_lat, chunk_wall):
     chunk_lat is each chunk's OWN work (encode span + finalize span);
     chunk_wall is its submit-to-results wall time, which under pipelining
@@ -613,6 +615,13 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
     measured, folding their stored counts/latencies into the aggregates;
     ckpt_log (ChunkLog) records each newly finalized chunk.  Both optional
     — the warmup/rebalance callers leave them off.
+
+    carry=True threads the consumed-capacity accumulators chunk to chunk
+    (solver carry-in/out): chunk k+1 prices against everything chunks <=k
+    consumed — sequential-equivalent accounting at chunk granularity.  It
+    SERIALIZES the pipeline (each dispatch needs the previous carry) and is
+    incompatible with checkpoint resume (a skipped chunk's consumption
+    would be lost).
 
     Uses the production path end to end: shared EncoderCache across chunks,
     jitted compact solve (sparse COO results — the dense [B, C] plane is
@@ -639,14 +648,24 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
 
     def finalize(entry) -> None:
         nonlocal scheduled, solve_s
-        handle, batch, part, tc, encode_span, ci = entry
+        handle, batch, part, tc, encode_span, ci, used0 = entry
         t1 = time.perf_counter()
-        idx, val, status, _nnz = finalize_compact(handle)
+        fin = finalize_compact(handle)
+        idx, val, status, _nnz = fin[:4]
+        if len(fin) == 5:  # carry mode: absorb the main kernel's delta
+            carry_state.absorb(batch, fin[4], used0)
         spread_idx = [
             i for i in range(len(part))
             if batch.route[i] == tensors.ROUTE_DEVICE_SPREAD
         ]
-        spread_res = solve_spread(batch, part, spread_idx, waves=waves)
+        if carry:
+            spread_res, used_sp = solve_spread(
+                batch, part, spread_idx, waves=waves, collect_used=True,
+                used0=used0)
+            if used_sp is not None:
+                carry_state.absorb(batch, used_sp, used0)
+        else:
+            spread_res = solve_spread(batch, part, spread_idx, waves=waves)
         t2 = time.perf_counter()
         solve_s += t2 - t1
         sm.STEP_LATENCY.observe(t2 - t1, schedule_step=sm.STEP_SOLVE)
@@ -678,6 +697,9 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
                             solve_s=round(t2 - t1, 4))
         _hb(f"chunk {ci + 1} finalized ({len(part)} bindings)")
 
+    assert not (carry and ckpt_done), \
+        "--carry is incompatible with checkpoint resume"
+    carry_state = tensors.CarryState() if carry else None
     for lo in range(0, n, chunk):
         ci = lo // chunk
         if ckpt_done and ci in ckpt_done:
@@ -695,10 +717,18 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
         batch = tensors.encode_batch(part, cindex, estimator, cache=cache)
         t1 = time.perf_counter()
         sm.STEP_LATENCY.observe(t1 - tc, schedule_step=sm.STEP_ENCODE)
-        handle = dispatch_compact(batch, waves=waves)
-        if pending is not None:
-            finalize(pending)
-        pending = (handle, batch, part, tc, t1 - tc, ci)
+        if carry:
+            used0 = carry_state.used0_for(batch)
+            handle = dispatch_compact(batch, waves=waves, with_used=True,
+                                      used0=used0)
+            # the next dispatch needs this chunk's carry-out: finalize
+            # immediately (sequential accounting forfeits pipeline overlap)
+            finalize((handle, batch, part, tc, t1 - tc, ci, used0))
+        else:
+            handle = dispatch_compact(batch, waves=waves)
+            if pending is not None:
+                finalize(pending)
+            pending = (handle, batch, part, tc, t1 - tc, ci, None)
     if pending is not None:
         finalize(pending)
     return (time.perf_counter() - t0, solve_s, scheduled, chunk_lat,
@@ -780,6 +810,11 @@ def main() -> None:
     ap.add_argument("--probe-timeout", type=float, default=330.0)
     ap.add_argument("--waves", type=int, default=8,
                     help="capacity-contention waves per solver chunk")
+    ap.add_argument("--carry", action="store_true",
+                    help="thread consumed-capacity accumulators chunk to "
+                         "chunk (sequential-equivalent accounting at chunk "
+                         "granularity; serializes the pipeline and "
+                         "disables checkpoint resume)")
     ap.add_argument("--inner", action="store_true",
                     help="run the bench in this process (no watchdog parent)")
     ap.add_argument("--no-progress-timeout", type=float, default=600.0,
@@ -860,14 +895,17 @@ def main() -> None:
         # resumable checkpoints: a relay drop mid-run costs one chunk
         sig = config_sig(args, "tpu" if on_tpu else "cpu")
         chunks_path = os.path.join(args.ckpt_dir, "chunks.jsonl")
-        if args.fresh:
+        if args.fresh or args.carry:
             # --fresh bypasses checkpoint READS (and retires this sig's
             # stale records via prune); newly measured chunks are still
-            # recorded so an interrupted fresh run resumes correctly
+            # recorded so an interrupted fresh run resumes correctly.
+            # --carry cannot resume (a skipped chunk's consumption would
+            # vanish from the accounting).
             ckpt_done, reb_rec, prior_elapsed = {}, None, 0.0
         else:
             ckpt_done, reb_rec, prior_elapsed = load_ckpt(chunks_path, sig)
-        ckpt_log = ChunkLog(chunks_path, sig, prune=args.fresh)
+        ckpt_log = (None if args.carry
+                    else ChunkLog(chunks_path, sig, prune=args.fresh))
         n_chunks = (len(items) + args.chunk - 1) // args.chunk
         n_restored = sum(1 for ci in range(n_chunks) if ci in ckpt_done)
         _hb(f"checkpoint: {n_restored}/{n_chunks} chunks restored"
@@ -893,7 +931,7 @@ def main() -> None:
         (elapsed, solve_s, scheduled, chunk_lat, chunk_wall,
          failures) = run_batched(
             items, cindex, estimator, args.chunk, cache, waves=args.waves,
-            ckpt_done=ckpt_done, ckpt_log=ckpt_log)
+            ckpt_done=ckpt_done, ckpt_log=ckpt_log, carry=args.carry)
         elapsed += prior_elapsed
         throughput = args.bindings / elapsed
         _hb(f"timed run done: {throughput:.1f} bindings/s")
@@ -995,6 +1033,7 @@ def main() -> None:
         "detail": {
             "platform": platform,
             "waves": args.waves,
+            "carry": args.carry,
             "cpu_fallback_speedup": None if on_tpu else round(speedup, 2),
             "backend_probe": probe,
             "batched_elapsed_s": round(elapsed, 3),

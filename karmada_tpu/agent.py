@@ -13,12 +13,23 @@ skip Pull clusters entirely (they could not reach them), and the agent's
 scoped instances drive the same Work/status machinery from the member's
 side.  The data flow is identical either way — SURVEY §2.9: push vs pull
 only inverts who drives the member-cluster writes.
+
+The agent owns TWO liveness loops of its own, like the reference binary:
+* its scoped ClusterStatusController renews the cluster Lease every
+  collection round (cluster_status_controller.go:399 initLeaseController
+  — the lease is the AGENT's heartbeat; controllers/lease.py's monitor
+  degrades the cluster to Ready=Unknown when it goes stale), and
+* a cert-rotation loop scoped to its OWN ClusterCredential
+  (cert_rotation_controller.go:89 runs inside the agent, not the
+  control-plane manager).
 """
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
+from karmada_tpu.controllers.certificates import CertRotationController
 from karmada_tpu.controllers.execution import ExecutionController
 from karmada_tpu.controllers.status import (
     ClusterStatusController,
@@ -40,6 +51,7 @@ class KarmadaAgent:
         runtime: Runtime,
         interpreter: Optional[ResourceInterpreter] = None,
         recorder=None,
+        clock=None,
     ) -> None:
         self.member = member
         scoped = {member.name: member}
@@ -58,6 +70,12 @@ class KarmadaAgent:
             self.cluster_status = ClusterStatusController(
                 control_store, runtime, scoped, recorder=recorder
             )
+            # the agent rotates ITS OWN credential (the reference runs the
+            # rotation controller inside the agent binary)
+            self.cert_rotation = CertRotationController(
+                control_store, runtime, cluster=member.name,
+                clock=clock if clock is not None else time.time,
+            )
         self._control_store = control_store
         self._runtime = runtime
 
@@ -72,6 +90,7 @@ class KarmadaAgent:
         self._runtime.unregister(self.execution.worker)
         self._runtime.unregister(self.work_status.worker)
         self._runtime.unregister_periodic(self.cluster_status.collect_all)
+        self._runtime.unregister_periodic(self.cert_rotation.run_once)
         self._control_store.bus.unsubscribe(self.execution._on_event)  # noqa: SLF001
         self._control_store.bus.unsubscribe(self.execution._on_cluster_event)  # noqa: SLF001
         self.execution.members.pop(self.member.name, None)

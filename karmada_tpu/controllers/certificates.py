@@ -10,7 +10,7 @@ ttl elapsed).
 from __future__ import annotations
 
 import time
-from typing import Callable
+from typing import Callable, Optional
 
 from karmada_tpu.models.certs import (
     AGENT_SIGNER,
@@ -83,22 +83,36 @@ class AgentCsrApprover:
 
 class CertRotationController:
     """Renew credentials approaching expiry by posting a fresh agent CSR
-    (which the approver then honors)."""
+    (which the approver then honors).
+
+    In the reference this loop runs INSIDE each karmada-agent for its own
+    credential (cmd/agent/app/agent.go registers
+    cert_rotation_controller.go); pass `cluster` to scope an instance to
+    one agent's identity — KarmadaAgent does."""
 
     def __init__(self, store: ObjectStore, runtime: Runtime,
                  rotation_threshold: float = 0.8,
                  ttl_seconds: int = 30 * 24 * 3600,
-                 clock: Callable[[], float] = time.time) -> None:
+                 clock: Callable[[], float] = time.time,
+                 cluster: Optional[str] = None) -> None:
         self.store = store
         self.threshold = rotation_threshold
         self.ttl_seconds = ttl_seconds
         self.clock = clock
+        self.cluster = cluster
         self._seq = 0
         runtime.register_periodic(self.run_once, name="cert-rotation")
 
     def run_once(self) -> None:
         now = self.clock()
-        for cred in self.store.list(ClusterCredential.KIND):
+        if self.cluster is not None:
+            # agent-scoped: fetch only its own identity (N agents must not
+            # each scan all N credentials every round)
+            cred = self.store.try_get(ClusterCredential.KIND, "", self.cluster)
+            creds = [cred] if cred is not None else []
+        else:
+            creds = self.store.list(ClusterCredential.KIND)
+        for cred in creds:
             issued = cred.status.issued_at or now
             expires = cred.status.expires_at
             if expires is None:

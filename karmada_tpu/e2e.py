@@ -234,16 +234,13 @@ class ControlPlane:
         self.rebalancer = WorkloadRebalancerController(self.store, self.runtime)
         self.taint_policies = ClusterTaintPolicyController(self.store, self.runtime)
         self.remedies = RemedyController(self.store, self.runtime)
-        # agent CSR approval + credential rotation
-        from karmada_tpu.controllers.certificates import (
-            AgentCsrApprover,
-            CertRotationController,
-        )
+        # agent CSR approval (control-plane side); credential ROTATION is
+        # agent-owned — each KarmadaAgent runs its own scoped loop, like
+        # the reference's agent binary (cert_rotation_controller.go)
+        from karmada_tpu.controllers.certificates import AgentCsrApprover
 
         self.csr_approver = AgentCsrApprover(self.store, self.runtime,
                                              clock=self.clock)
-        self.cert_rotation = CertRotationController(self.store, self.runtime,
-                                                    clock=self.clock)
         self.quotas = FederatedResourceQuotaController(self.store, self.runtime)
         # restart story (SURVEY §5 checkpoint/resume): a restored store
         # resyncs every object through freshly wired controllers, exactly
@@ -299,7 +296,7 @@ class ControlPlane:
             bootstrap_agent_csr(self.store, name)
             self.agents[name] = KarmadaAgent(
                 self.store, member, self.runtime, self.interpreter,
-                recorder=self.recorder,
+                recorder=self.recorder, clock=self.clock,
             )
         else:
             # work_status shares the push_members dict by reference; only

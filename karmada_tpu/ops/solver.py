@@ -588,7 +588,8 @@ def _schedule_core(
     # bindings
     b_valid, placement_id, gvk_id, class_id, replicas, uid_desc, fresh,
     non_workload, nw_shortcut, prev_idx, prev_val, evict_idx,
-    *, waves: int = 1, use_extra: bool = True,
+    used0_milli=None, used0_pods=None, used0_sets=None,
+    *, waves: int = 1, use_extra: bool = True, with_used: bool = False,
 ):
     """The full cycle: returns (rep[B,C] int64, selected[B,C] bool, status[B]).
 
@@ -686,7 +687,7 @@ def _schedule_core(
             uid_desc_w, fresh_w, non_workload_w, b_valid_w,
         )
 
-        if waves > 1:
+        if waves > 1 or with_used:
             # New consumption only: replicas KEPT from the previous
             # assignment are already reflected in the snapshot's
             # allocated/allocating totals (cluster_status controller), so
@@ -711,21 +712,32 @@ def _schedule_core(
         (b_valid, placement_id, gvk_id, class_id, replicas, uid_desc, fresh,
          non_workload, nw_shortcut, prev_rep, prev_present, evict),
     )
+    # carry-in: a previous batch of the SAME cycle already consumed this
+    # much (scheduler second-pass repack / cross-batch continuity)
     carry0 = (
-        jnp.zeros_like(avail_milli),
-        jnp.zeros_like(pods_allowed),
-        jnp.zeros_like(est_override),
+        (jnp.asarray(used0_milli, avail_milli.dtype) if used0_milli is not None
+         else jnp.zeros_like(avail_milli)),                       # [C, R]
+        (jnp.asarray(used0_pods, pods_allowed.dtype) if used0_pods is not None
+         else jnp.zeros_like(pods_allowed)),                      # [C]
+        (jnp.asarray(used0_sets, est_override.dtype) if used0_sets is not None
+         else jnp.zeros_like(est_override)),                      # [Q, C]
     )
     if waves == 1:
-        _, (rep, sel, status) = wave_step(carry0, jax.tree.map(lambda a: a[0], xs))
+        used, (rep, sel, status) = wave_step(
+            carry0, jax.tree.map(lambda a: a[0], xs))
+        if with_used:
+            return rep, sel, status, used
         return rep, sel, status
-    _, (rep, sel, status) = lax.scan(wave_step, carry0, xs)
+    used, (rep, sel, status) = lax.scan(wave_step, carry0, xs)
     C = rep.shape[-1]
-    return (
+    out = (
         rep.reshape(B, C),
         sel.reshape(B, C),
         status.reshape(B),
     )
+    if with_used:
+        return out + (used,)
+    return out
 
 
 # Dense-output entry point (tests, small callers).  The PRODUCTION path is
@@ -761,15 +773,26 @@ _NON_WORKLOAD_ARG = 28
 
 
 @partial(jax.jit, static_argnames=("waves", "max_nnz", "keep_sel",
-                                   "use_extra"))
+                                   "use_extra", "with_used"))
 def schedule_compact(*args, waves: int, max_nnz: int, keep_sel: bool = False,
-                     use_extra: bool = True):
+                     use_extra: bool = True, with_used: bool = False):
     """The full cycle with the sparse COO extraction FUSED into one jitted
     program: the dense [B, C] result planes never become jit outputs, so
-    only idx/val/status/nnz (~max_nnz ints) ever leave the device."""
-    rep, sel, status = _schedule_core(*args, waves=waves, use_extra=use_extra)
-    return _compact_of(rep, sel, status, args[_NON_WORKLOAD_ARG], max_nnz,
-                       keep_sel=keep_sel)
+    only idx/val/status/nnz (~max_nnz ints) ever leave the device.
+    with_used additionally returns the consumed-capacity accumulators
+    (used_milli [C,R], used_pods [C], used_sets [Q,C]) — the carry for a
+    second-pass repack or a later batch of the same cycle."""
+    core = _schedule_core(*args, waves=waves, use_extra=use_extra,
+                          with_used=with_used)
+    if with_used:
+        rep, sel, status, used = core
+    else:
+        rep, sel, status = core
+    compact = _compact_of(rep, sel, status, args[_NON_WORKLOAD_ARG], max_nnz,
+                          keep_sel=keep_sel)
+    if with_used:
+        return compact + tuple(used)
+    return compact
 
 
 # Single-generation device-transfer cache for the chunk-stable cluster-side
@@ -837,14 +860,17 @@ def solve(batch, waves: int = 1):
 
 
 def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
-                     keep_sel: bool = False):
+                     keep_sel: bool = False, with_used: bool = False,
+                     used0=None):
     """Enqueue the fused device solve WITHOUT forcing the result (jax
     dispatch is async): returns an opaque handle for finalize_compact.
     Lets a caller overlap host work (encode of the next chunk, decode of
     the previous) with the device execution of this one.
 
     keep_sel extracts every selected lane (empty-workload propagation);
-    leave False otherwise — see _compact_of."""
+    leave False otherwise — see _compact_of.  with_used adds the consumed-
+    capacity accumulators to the result; used0 (um, up, usets) carries a
+    previous batch's consumption in."""
     assert batch.C <= MAX_CLUSTER_LANES, \
         f"cluster axis must be <= {MAX_CLUSTER_LANES} per solve call"
     dense_nnz = batch.B * batch.C
@@ -855,14 +881,19 @@ def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
         max_nnz = dense_nnz if keep_sel else min(
             max(batch.B * 16, 1 << 14), dense_nnz)
     args = _batch_args(batch)
+    if used0 is not None:
+        args = args + tuple(used0)
     use_extra = _use_extra(batch)
     first = schedule_compact(*args, waves=waves, max_nnz=max_nnz,
-                             keep_sel=keep_sel, use_extra=use_extra)
-    return (args, waves, keep_sel, first, max_nnz, dense_nnz, use_extra)
+                             keep_sel=keep_sel, use_extra=use_extra,
+                             with_used=with_used)
+    return (args, waves, keep_sel, first, max_nnz, dense_nnz, use_extra,
+            with_used)
 
 
 def finalize_compact(handle):
-    """Force a dispatch_compact handle: (idx, val, status, nnz) numpy.
+    """Force a dispatch_compact handle: (idx, val, status, nnz) numpy —
+    plus (used_milli, used_pods, used_sets) when dispatched with_used.
 
     nnz > max_nnz escalates by re-running the fused solve with a 4x larger
     extraction cap (one recompile + re-execute per new cap — rare: the
@@ -870,23 +901,32 @@ def finalize_compact(handle):
     every-binding-selects-most-clusters mixes)."""
     import numpy as np
 
-    args, waves, keep_sel, first, max_nnz, dense_nnz, use_extra = handle
-    idx, val, st, nnz = first
+    (args, waves, keep_sel, first, max_nnz, dense_nnz, use_extra,
+     with_used) = handle
+    res = first
+    nnz = res[3]
     while int(nnz) > max_nnz and max_nnz < dense_nnz:
         max_nnz = min(max_nnz * 4, dense_nnz)
-        idx, val, st, nnz = schedule_compact(*args, waves=waves,
-                                             max_nnz=max_nnz,
-                                             keep_sel=keep_sel,
-                                             use_extra=use_extra)
-    return np.asarray(idx), np.asarray(val), np.asarray(st), int(nnz)
+        res = schedule_compact(*args, waves=waves, max_nnz=max_nnz,
+                               keep_sel=keep_sel, use_extra=use_extra,
+                               with_used=with_used)
+        nnz = res[3]
+    idx, val, st = res[0], res[1], res[2]
+    out = (np.asarray(idx), np.asarray(val), np.asarray(st), int(nnz))
+    if with_used:
+        return out + (tuple(np.asarray(u) for u in res[4:7]),)
+    return out
 
 
 def solve_compact(batch, waves: int = 1, max_nnz: int = 0,
-                  keep_sel: bool = False):
+                  keep_sel: bool = False, with_used: bool = False,
+                  used0=None):
     """Device-side solve + sparse result extraction: D2H ships only the
     (binding, cluster, replicas) nonzeros instead of the dense [B, C] int64
     plane (x100+ less traffic on realistic mixes).  Escalates max_nnz x4 on
     overflow, capped at B*C (== dense)."""
     return finalize_compact(dispatch_compact(batch, waves=waves,
                                              max_nnz=max_nnz,
-                                             keep_sel=keep_sel))
+                                             keep_sel=keep_sel,
+                                             with_used=with_used,
+                                             used0=used0))

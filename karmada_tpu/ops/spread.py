@@ -255,7 +255,7 @@ _pick_vmap = jax.vmap(_pick_one, in_axes=(0, 0, 0, 0, None, None, 0, 0, None))
 
 
 @partial(jax.jit, static_argnames=("G", "waves", "max_nnz", "keep_sel",
-                                   "use_extra"))
+                                   "use_extra", "with_used"))
 def spread_assign_compact(
     # cluster axis
     cluster_valid, deleting, name_rank, pods_allowed, has_summary,
@@ -269,8 +269,9 @@ def spread_assign_compact(
     prev_idx, prev_val, evict_idx,
     chosen, cluster_max,
     strategy, static_w, ignore_avail, uid_desc, fresh, non_workload, b_valid,
+    used0_milli=None, used0_pods=None, used0_sets=None,
     *, G: int, waves: int, max_nnz: int, keep_sel: bool = False,
-    use_extra: bool = True,
+    use_extra: bool = True, with_used: bool = False,
 ):
     """Phase B + assignment, FUSED: recompute the planes, pick clusters in
     the chosen regions, and run the main assignment kernel with the pick as
@@ -289,7 +290,7 @@ def spread_assign_compact(
     sel = _pick_vmap(order, feasible, avail_sel, score, name_rank,
                      region_id, chosen, cluster_max, G)
     extra_b = jnp.asarray(pl_extra_score, jnp.int64)[placement_id]  # [B, C]
-    rep, selected, status = _schedule_core(
+    core = _schedule_core(
         cluster_valid, deleting, name_rank, pods_allowed, has_summary,
         avail_milli, has_alloc, api_ok,
         req_milli, req_is_cpu, req_pods, est_override,
@@ -303,10 +304,18 @@ def spread_assign_compact(
         b_valid, jnp.arange(B, dtype=jnp.int32), gvk_id, class_id,
         replicas, uid_desc, fresh, non_workload, nw_shortcut,
         prev_idx, prev_val, evict_idx,
-        waves=waves, use_extra=use_extra,
+        used0_milli, used0_pods, used0_sets,
+        waves=waves, use_extra=use_extra, with_used=with_used,
     )
-    return _compact_of(rep, selected, status, non_workload, max_nnz,
-                       keep_sel=keep_sel)
+    if with_used:
+        rep, selected, status, used = core
+    else:
+        rep, selected, status = core
+    compact = _compact_of(rep, selected, status, non_workload, max_nnz,
+                          keep_sel=keep_sel)
+    if with_used:
+        return compact + tuple(used)
+    return compact
 
 
 def solve_spread(
@@ -315,16 +324,24 @@ def solve_spread(
     spread_idx: Sequence[int],
     waves: int = 1,
     enable_empty_workload_propagation: bool = False,
+    collect_used: bool = False,
+    used0=None,
 ):
     """Schedule the ROUTE_DEVICE_SPREAD bindings of one chunk.
 
     Returns {binding_index: List[TargetCluster] | Exception} in the same
-    result vocabulary as tensors.decode_* (serial error classes).
+    result vocabulary as tensors.decode_* (serial error classes); with
+    collect_used, returns (out, used|None) where used = (um, up, usets)
+    numpy accumulators of the spread bindings' consumption; used0 carries
+    a previous batch's consumption into the ASSIGNMENT kernel (the phase-A
+    group scoring and the in-region pick still see the raw snapshot —
+    selection order is score-driven, assignment is the capacity-honest
+    step).
     """
     from karmada_tpu.ops import tensors as T
 
     if not len(spread_idx):
-        return {}
+        return ({}, None) if collect_used else {}
     # pad the phase A batch axis so jit signatures stay stable as the
     # spread-binding count varies chunk to chunk (row 0 repeats as inert
     # padding: its results are simply never read back)
@@ -399,7 +416,7 @@ def solve_spread(
 
     live = [r for r in range(n_spread) if int(idx[r]) not in out]
     if not live:
-        return out
+        return (out, None) if collect_used else out
     # pad the fused phase's batch axis too (same jit-signature stability)
     n_live = len(live)
     Bs = T._next_pow2(n_live, 8)  # noqa: SLF001
@@ -426,17 +443,22 @@ def solve_spread(
             batch.pl_strategy[lpid], batch.pl_static_w[lpid],
             batch.pl_ignore_avail[lpid], batch.uid_desc[lidx],
             batch.fresh[lidx], batch.non_workload[lidx], b_valid,
+            used0[0] if used0 is not None else None,
+            used0[1] if used0 is not None else None,
+            used0[2] if used0 is not None else None,
             G=G, waves=waves, max_nnz=max_nnz,
             keep_sel=enable_empty_workload_propagation,
-            use_extra=use_extra,
+            use_extra=use_extra, with_used=collect_used,
         )
 
     max_nnz = (Bs * C if enable_empty_workload_propagation
                else min(max(Bs * 16, 1 << 12), Bs * C))
-    cidx, cval, status, nnz = assign(max_nnz)
-    while int(nnz) > max_nnz and max_nnz < Bs * C:
+    res = assign(max_nnz)
+    while int(res[3]) > max_nnz and max_nnz < Bs * C:
         max_nnz = min(max_nnz * 4, Bs * C)
-        cidx, cval, status, nnz = assign(max_nnz)
+        res = assign(max_nnz)
+    cidx, cval, status, nnz = res[:4]
+    used = (tuple(np.asarray(u) for u in res[4:7]) if collect_used else None)
 
     # remap the sub-batch COO rows onto the chunk's binding axis and reuse
     # the one shared decoder (tensors.decode_compact, incl. its native fast
@@ -457,4 +479,4 @@ def solve_spread(
     )
     for b in lidx[:n_live]:
         out[int(b)] = decoded[int(b)]
-    return out
+    return (out, used) if collect_used else out

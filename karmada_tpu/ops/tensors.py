@@ -185,6 +185,10 @@ class SolverBatch:
     # out-of-tree score-plugin contributions (scheduler/plugins.py),
     # pre-clamped sums per (placement, cluster)
     pl_extra_score: np.ndarray = field(default=None)  # int64[P, C]
+    # axis vocabularies, for remapping carry-over capacity accumulators
+    # between batches of one cycle (scheduler second-pass repack)
+    res_names: List[str] = field(default=None)  # R-axis order
+    class_keys: List = field(default=None)  # Q-axis order (canonical keys)
     pl_region_min: np.ndarray = field(default=None)  # int32[P]
     pl_region_max: np.ndarray = field(default=None)  # int32[P]
 
@@ -593,6 +597,7 @@ def encode_batch(
             cache.assembled, B, C, nB, nC, b_valid, placement_id, gvk_id,
             class_id, replicas, uid_desc, fresh, non_workload, nw_shortcut,
             prev_idx, prev_val, evict_idx, route, cindex, region_names,
+            list(res_names), list(classes),
         )
 
     # ---- capacity tensors -------------------------------------------------
@@ -793,6 +798,7 @@ def encode_batch(
         shared, B, C, nB, nC, b_valid, placement_id, gvk_id, class_id,
         replicas, uid_desc, fresh, non_workload, nw_shortcut,
         prev_idx, prev_val, evict_idx, route, cindex, region_names,
+        list(res_names), list(classes),
     )
 
 
@@ -800,6 +806,7 @@ def _build_solver_batch(
     shared, B, C, nB, nC, b_valid, placement_id, gvk_id, class_id,
     replicas, uid_desc, fresh, non_workload, nw_shortcut,
     prev_idx, prev_val, evict_idx, route, cindex, region_names,
+    res_names=None, class_keys=None,
 ) -> SolverBatch:
     return SolverBatch(
         B=B, C=C, n_bindings=nB, n_clusters=nC,
@@ -825,7 +832,80 @@ def _build_solver_batch(
         pl_has_region_sc=shared["pl_has_region_sc"],
         pl_region_min=shared["pl_region_min"],
         pl_region_max=shared["pl_region_max"],
+        res_names=res_names or [], class_keys=class_keys or [],
     )
+
+
+def remap_used(used, from_batch: SolverBatch, to_batch: SolverBatch):
+    """Transport consumed-capacity accumulators (solver carry-out) between
+    TWO batches of the same cycle whose resource/class vocabularies may
+    differ: columns map by resource NAME, class rows by canonical key.
+    Resources/classes absent from the target batch are dropped (nothing in
+    it consults them); absent-from-source entries start at zero.
+
+    For a CHAIN of batches use CarryState instead — pairwise remapping
+    through an intermediate batch whose vocabulary lacks a resource would
+    silently drop that resource's accumulated consumption."""
+    um, up, us = used
+    um2 = np.zeros_like(to_batch.avail_milli)
+    r1 = {n: i for i, n in enumerate(from_batch.res_names)}
+    for r2, name in enumerate(to_batch.res_names):
+        if name in r1:
+            um2[:, r2] = um[:, r1[name]]
+    us2 = np.zeros_like(to_batch.est_override)
+    q1 = {k: i for i, k in enumerate(from_batch.class_keys)}
+    for q2, key in enumerate(to_batch.class_keys):
+        if key in q1:
+            us2[q2] = us[q1[key]]
+    return um2, np.asarray(up), us2
+
+
+class CarryState:
+    """Vocabulary-stable transport for chained consumed-capacity carry.
+
+    Accumulators live keyed by resource NAME / class KEY (never by a
+    batch's padded axis), so a resource absent from an intermediate
+    batch's vocabulary survives to the next batch that requests it.
+    Per batch: `used0_for(batch)` renders the carry into the batch's
+    vocabulary; after the solve, `absorb(batch, used_out, used0)` adds the
+    batch's OWN consumption (carry-out minus carry-in) back into the
+    stable store."""
+
+    def __init__(self) -> None:
+        self.milli: Dict[str, np.ndarray] = {}  # name -> int64[C]
+        self.pods: Optional[np.ndarray] = None  # int64[C]
+        self.sets: Dict = {}  # class key -> int64[C]
+
+    def used0_for(self, batch: SolverBatch):
+        um = np.zeros_like(batch.avail_milli)
+        for r, name in enumerate(batch.res_names):
+            if name in self.milli:
+                um[:, r] = self.milli[name]
+        up = (self.pods.copy() if self.pods is not None
+              else np.zeros_like(batch.pods_allowed))
+        us = np.zeros_like(batch.est_override)
+        for q, key in enumerate(batch.class_keys):
+            if key in self.sets:
+                us[q] = self.sets[key]
+        return um, up, us
+
+    def absorb(self, batch: SolverBatch, used_out, used0) -> None:
+        um_out, up_out, us_out = used_out
+        um0, up0, us0 = used0
+        for r, name in enumerate(batch.res_names):
+            own = np.asarray(um_out)[:, r] - um0[:, r]
+            if name in self.milli:
+                self.milli[name] = self.milli[name] + own
+            else:
+                self.milli[name] = own.copy()
+        own_p = np.asarray(up_out) - up0
+        self.pods = own_p.copy() if self.pods is None else self.pods + own_p
+        for q, key in enumerate(batch.class_keys):
+            own_s = np.asarray(us_out)[q] - us0[q]
+            if key in self.sets:
+                self.sets[key] = self.sets[key] + own_s
+            else:
+                self.sets[key] = own_s.copy()
 
 
 def _spec_with(placement: Placement) -> ResourceBindingSpec:

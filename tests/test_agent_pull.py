@@ -141,3 +141,28 @@ def test_certificate_rotation_renews_before_expiry():
     rotated = cp.store.get(ClusterCredential.KIND, "", "pull-1")
     assert rotated.status.rotations >= 1
     assert rotated.status.expires_at > cred.status.expires_at
+
+
+def test_agent_owns_its_rotation_scope():
+    """The agent's rotation loop touches ONLY its own credential
+    (cert_rotation_controller.go runs inside the agent binary): another
+    pull member's credential is rotated by that member's OWN agent, and
+    stopping an agent stops its loop."""
+    clock = FakeClock()
+    cp = mixed_plane(clock=clock)
+    cp.add_member("pull-2", sync_mode="Pull")
+    cp.tick()
+    cred1 = cp.store.get(ClusterCredential.KIND, "", "pull-1")
+    ttl = cred1.status.expires_at - cred1.status.issued_at
+    assert cp.agents["pull-1"].cert_rotation.cluster == "pull-1"
+    assert cp.agents["pull-2"].cert_rotation.cluster == "pull-2"
+
+    # stop pull-2's agent: its credential must NOT rotate anymore, while
+    # pull-1's (live agent) does
+    cp.agents["pull-2"].stop()
+    clock.advance(ttl * 0.9)
+    cp.tick()
+    assert cp.store.get(ClusterCredential.KIND, "",
+                        "pull-1").status.rotations >= 1
+    assert cp.store.get(ClusterCredential.KIND, "",
+                        "pull-2").status.rotations == 0

@@ -353,3 +353,89 @@ def test_wave_contention_divergence_full_chunk():
     B = 4096
     ok_w, ok_b, n_diff, totals_equal = _divergence(B, 128, waves=8)
     _assert_divergence_bounds(B, ok_w, ok_b, n_diff, totals_equal)
+
+
+def test_capacity_carry_across_batches_matches_combined_solve():
+    """Cross-batch capacity continuity: solving batch A (with_used) and
+    then batch B with A's carry (used0) must equal solving A+B as ONE
+    batch under the same wave order — the accumulators transport the
+    consumed-capacity state exactly."""
+    import numpy as np
+
+    import bench
+    from karmada_tpu.ops.solver import solve_compact
+
+    rng = random.Random(2)
+    clusters = bench.build_fleet(rng, 32)
+    placements = bench.build_placements(rng, [c.name for c in clusters])
+    items = bench.build_bindings(rng, 64, placements)
+    est = GeneralEstimator()
+    cindex = tensors.ClusterIndex.build(clusters)
+
+    a_items, b_items = items[:32], items[32:]
+
+    # combined reference: one batch, one binding per wave (exact order)
+    batch_ab = tensors.encode_batch(items, cindex, est)
+    i_ab, v_ab, s_ab, _ = solve_compact(batch_ab, waves=64)
+    combined = tensors.decode_compact(batch_ab, i_ab, v_ab, s_ab)
+
+    # split: A first (collect carry), then B against A's residual
+    batch_a = tensors.encode_batch(a_items, cindex, est)
+    _, _, _, _, used = solve_compact(batch_a, waves=32, with_used=True)
+    batch_b = tensors.encode_batch(b_items, cindex, est)
+    used0 = tensors.remap_used(used, batch_a, batch_b)
+    i_b, v_b, s_b, _ = solve_compact(batch_b, waves=32, used0=used0)
+    split_b = tensors.decode_compact(batch_b, i_b, v_b, s_b)
+
+    for j in range(len(b_items)):
+        want = combined[32 + j]
+        got = split_b[j]
+        if isinstance(want, Exception):
+            assert isinstance(got, type(want)), (j, want, got)
+            continue
+        assert not isinstance(got, Exception), (j, got)
+        assert ({t.name: t.replicas for t in got}
+                == {t.name: t.replicas for t in want}), j
+
+
+def test_carry_state_survives_vocabulary_gaps():
+    """CarryState (chained transport): consumption of a resource absent
+    from an INTERMEDIATE batch's vocabulary must survive to a later batch
+    that requests it (pairwise remap_used would drop it)."""
+    import numpy as np
+
+    from karmada_tpu.ops.solver import solve_compact
+
+    clusters = [mk_cluster("m1", cpu_milli=10**9, mem_units=10, pods=10**6)]
+    cindex = tensors.ClusterIndex.build(clusters)
+    est = GeneralEstimator()
+
+    def mem_binding(b, replicas):
+        spec, st = mk_binding(b, replicas=replicas, cpu_milli=10, mem_units=1)
+        return spec, st
+
+    def cpu_binding(b, replicas):
+        spec, st = mk_binding(b, replicas=replicas, cpu_milli=10, mem_units=0)
+        spec.replica_requirements.resource_request.pop("memory")
+        return spec, st
+
+    state = tensors.CarryState()
+
+    def run(items, waves=1):
+        batch = tensors.encode_batch(items, cindex, est)
+        used0 = state.used0_for(batch)
+        i, v, s, _n, used = solve_compact(batch, waves=waves, used0=used0,
+                                          with_used=True)
+        state.absorb(batch, used, used0)
+        return tensors.decode_compact(batch, i, v, s)
+
+    # chunk 1 consumes 8 of the 10 memory units
+    r1 = run([mem_binding(0, 8)])
+    assert not isinstance(r1[0], Exception)
+    # chunk 2's vocabulary has NO memory resource at all
+    r2 = run([cpu_binding(1, 5)])
+    assert not isinstance(r2[0], Exception)
+    assert "memory" in state.milli  # survived the gap
+    # chunk 3 wants 8 memory units: only 2 remain -> honest failure
+    r3 = run([mem_binding(2, 8)])
+    assert isinstance(r3[0], serial.UnschedulableError), r3[0]
