@@ -917,12 +917,15 @@ def main() -> None:
             # warmup: compile every chunk shape once (full + any tail shape)
             _hb("compile warmup starting")
             t_compile = time.perf_counter()
+            # warmup must match the timed run's jit signatures: carry mode
+            # compiles the with_used variants + the used0 operands
             run_batched(items[: min(args.chunk, len(items))], cindex,
-                        estimator, args.chunk, cache, waves=args.waves)
+                        estimator, args.chunk, cache, waves=args.waves,
+                        carry=args.carry)
             tail = len(items) % args.chunk
             if tail and (n_chunks - 1) not in ckpt_done:
                 run_batched(items[:tail], cindex, estimator, args.chunk,
-                            cache, waves=args.waves)
+                            cache, waves=args.waves, carry=args.carry)
             compile_s = time.perf_counter() - t_compile
             _hb(f"compile warmup done in {compile_s:.1f}s; timed run starting")
 

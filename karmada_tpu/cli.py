@@ -358,10 +358,32 @@ def cmd_cordon(args, uncordon: bool = False) -> int:
     return 0
 
 
+def _node_rows(node_metrics) -> list:
+    rows = []
+    for n in node_metrics:
+        alloc = n.get("allocatable", {})
+        usage = n.get("usage", {})
+        cpu_alloc = max(alloc.get("cpu", 0), 1)
+        rows.append([
+            n.get("cluster", "-"), n.get("name", "-"),
+            f"{usage.get('cpu', 0)}m",
+            f"{100 * usage.get('cpu', 0) // cpu_alloc}%",
+            f"{alloc.get('pods', 0)}",
+        ])
+    return rows
+
+
 def cmd_top(args) -> int:
     from karmada_tpu.models.cluster import Cluster
 
     cp = _load_plane(args.dir)
+    if args.what == "nodes":
+        # merged NodeMetrics across members (pkg/karmadactl/top nodes via
+        # the metrics adapter's resource provider)
+        rows = _node_rows(cp.metrics_provider.node_metrics())
+        _print_table(rows or [["-"] * 5],
+                     ["CLUSTER", "NODE", "CPU", "CPU%", "PODS"])
+        return 0
     if args.what == "pods":
         # merged PodMetrics across clusters (pkg/karmadactl/top pods via
         # the metrics adapter fan-out)
@@ -974,6 +996,13 @@ def cmd_exec_remote(args) -> int:
 
 
 def cmd_top_remote(args) -> int:
+    if args.what == "nodes":
+        code, out = _http_json(args.server, "GET", "/metrics-adapter/nodes")
+        if code != 200:
+            return _remote_fail(code, out)
+        _print_table(_node_rows(out) or [["-"] * 5],
+                     ["CLUSTER", "NODE", "CPU", "CPU%", "PODS"])
+        return 0
     if args.what == "pods":
         code, out = _http_json(
             args.server, "GET",
@@ -1071,7 +1100,7 @@ def build_parser() -> argparse.ArgumentParser:
         c.add_argument("name")
 
     t = sub.add_parser("top")
-    t.add_argument("what", choices=["clusters", "pods"])
+    t.add_argument("what", choices=["clusters", "pods", "nodes"])
     t.add_argument("name", nargs="?", help="workload name (pods)")
     t.add_argument("-n", "--namespace", default="")
 

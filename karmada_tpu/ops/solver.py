@@ -745,8 +745,9 @@ def _schedule_core(
 # environment runs) materializes every jit OUTPUT to the host, so returning
 # the dense [B, C] planes costs ~300 MB of D2H per chunk regardless of what
 # the caller reads — measured as the entire chunk budget at 4096x8192.
-schedule_batch = partial(jax.jit,
-                         static_argnames=("waves", "use_extra"))(_schedule_core)
+schedule_batch = partial(
+    jax.jit,
+    static_argnames=("waves", "use_extra", "with_used"))(_schedule_core)
 
 
 def _compact_of(rep, sel, status, non_workload, max_nnz: int,

@@ -505,3 +505,12 @@ def test_get_pods_lowercase_alias(tmp_path, capsys):
     capsys.readouterr()
     assert run(tmp_path, "get", "pods", "--cluster", "m1") == 0
     assert "web-0" in capsys.readouterr().out
+
+
+def test_top_nodes(tmp_path, capsys):
+    run(tmp_path, "init")
+    run(tmp_path, "join", "m1", "--cpu", "32")
+    run(tmp_path, "join", "m2", "--cpu", "64")
+    assert run(tmp_path, "top", "nodes") == 0
+    out = capsys.readouterr().out
+    assert "m1-node-0" in out and "m2-node-0" in out and "CPU%" in out

@@ -143,6 +143,10 @@ def test_cli_over_tcp(served, capsys):
     out = capsys.readouterr().out
     assert "m1" in out
 
+    assert main(["--server", url, "top", "nodes"]) == 0
+    out = capsys.readouterr().out
+    assert "m1-node-0" in out
+
     assert main(["--server", url, "top", "pods", "web"]) == 0
     out = capsys.readouterr().out
     assert "web" in out
