@@ -76,6 +76,16 @@ class KarmadaAgent:
                 control_store, runtime, cluster=member.name,
                 clock=clock if clock is not None else time.time,
             )
+            # endpointslice collection runs INSIDE the member for pull mode
+            # (agent.go registers endpointsliceCollect; the control plane
+            # cannot watch an unreachable member's slices)
+            from karmada_tpu.controllers.mcs import (
+                EndpointSliceCollectController,
+            )
+
+            self.eps_collect = EndpointSliceCollectController(
+                control_store, runtime, scoped,
+            )
         self._control_store = control_store
         self._runtime = runtime
 
@@ -91,6 +101,7 @@ class KarmadaAgent:
         self._runtime.unregister(self.work_status.worker)
         self._runtime.unregister_periodic(self.cluster_status.collect_all)
         self._runtime.unregister_periodic(self.cert_rotation.run_once)
+        self.eps_collect.detach(self._runtime)
         self._control_store.bus.unsubscribe(self.execution._on_event)  # noqa: SLF001
         self._control_store.bus.unsubscribe(self.execution._on_cluster_event)  # noqa: SLF001
         self.execution.members.pop(self.member.name, None)

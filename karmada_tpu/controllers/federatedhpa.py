@@ -38,6 +38,7 @@ from karmada_tpu.models.autoscaling import (
     MetricStatusValue,
 )
 from karmada_tpu.models.meta import deep_get
+from karmada_tpu.webhook.admission import AdmissionDenied
 from karmada_tpu.models.work import ResourceBinding
 from karmada_tpu.store.store import Event, NotFoundError, ObjectStore
 from karmada_tpu.store.worker import AsyncWorker, Runtime
@@ -462,6 +463,11 @@ class CronFederatedHPAController:
             return "Succeed", ""
         except NotFoundError:
             return "Failed", f"target {ref.kind}/{ref.name} not found"
+        except AdmissionDenied as e:
+            # a rule pushing the FHPA into an invalid shape (e.g.
+            # targetMinReplicas above maxReplicas) is a FAILED execution in
+            # the history, never a crashed controller round
+            return "Failed", f"admission rejected the scale: {e}"
 
 
 # -- HpaScaleTargetMarker + DeploymentReplicasSyncer -------------------------

@@ -247,6 +247,8 @@ class EndpointSliceCollectController:
             AsyncWorker("endpointslice-collect", self._reconcile)
         )
         self._subscribed: set = set()
+        # cluster -> (member, bus handler): teardown needs the exact refs
+        self._member_handlers: Dict[str, tuple] = {}
         for name in list(members):
             self.watch_member(name)
         # resync when exports change
@@ -258,9 +260,26 @@ class EndpointSliceCollectController:
             return
         self._subscribed.add(cluster)
         member = self.members[cluster]
-        member.store.bus.subscribe(self._member_event(cluster))
+        handler = self._member_event(cluster)
+        self._member_handlers[cluster] = (member, handler)
+        member.store.bus.subscribe(handler)
         for obj in member.store.list("EndpointSlice"):
             self.worker.enqueue((cluster, obj.namespace, obj.name, False))
+
+    def unwatch_member(self, cluster: str) -> None:
+        """Unjoin teardown for one member: bus handler off, refs dropped."""
+        self._subscribed.discard(cluster)
+        entry = self._member_handlers.pop(cluster, None)
+        if entry is not None:
+            member, handler = entry
+            member.store.bus.unsubscribe(handler)
+
+    def detach(self, runtime: Runtime) -> None:
+        """Full teardown (agent-scoped instances unwind on unregister)."""
+        runtime.unregister(self.worker)
+        self.store.bus.unsubscribe(self._on_export_event)
+        for cluster in list(self._member_handlers):
+            self.unwatch_member(cluster)
 
     def _member_event(self, cluster: str):
         def handler(event: Event) -> None:

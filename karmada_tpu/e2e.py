@@ -227,8 +227,11 @@ class ControlPlane:
 
         self.mcs = MultiClusterServiceController(self.store, self.runtime)
         self.mci = MultiClusterIngressController(self.store, self.runtime)
+        # PUSH members only: a pull member is unreachable from the control
+        # plane — its agent runs a scoped collect controller inside
+        # (cmd/agent/app/agent.go's endpointsliceCollect registration)
         self.eps_collect = EndpointSliceCollectController(
-            self.store, self.runtime, self.members
+            self.store, self.runtime, self.push_members
         )
         self.eps_dispatch = EndpointSliceDispatchController(self.store, self.runtime)
         self.rebalancer = WorkloadRebalancerController(self.store, self.runtime)
@@ -310,7 +313,8 @@ class ControlPlane:
 
         server = AccurateEstimatorServer(member)
         self.descheduler_estimator.register(name, LocalTransport(server.handle))
-        self.eps_collect.watch_member(name)
+        if sync_mode != "Pull":
+            self.eps_collect.watch_member(name)
         self.cluster_status.collect_all()
         for agent in self.agents.values():
             agent.cluster_status.collect_all()
@@ -339,7 +343,7 @@ class ControlPlane:
             pass
         self.descheduler_estimator.deregister(name)
         self.work_status.members.pop(name, None)
-        self.eps_collect._subscribed.discard(name)  # noqa: SLF001
+        self.eps_collect.unwatch_member(name)
         self.push_members.pop(name, None)
         agent = self.agents.pop(name, None)
         if agent is not None:

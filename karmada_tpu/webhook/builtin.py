@@ -164,6 +164,66 @@ def validate_frq(op, q, old) -> Optional[str]:
     return None
 
 
+def validate_federated_hpa(op, hpa, old) -> Optional[str]:
+    """FederatedHPA admission (reference pkg/webhook/federatedhpa):
+    structural bounds plus metric-target coherence — a target whose type
+    doesn't match its set value field would otherwise silently hold the
+    workload at current replicas forever (controllers/federatedhpa.py
+    refuses to guess)."""
+    from karmada_tpu.models.autoscaling import (
+        TARGET_AVERAGE_VALUE,
+        TARGET_UTILIZATION,
+        TARGET_VALUE,
+    )
+
+    s = hpa.spec
+    if s.max_replicas < 1:
+        return "maxReplicas must be >= 1"
+    if s.min_replicas < 1 or s.min_replicas > s.max_replicas:
+        return "minReplicas must be in [1, maxReplicas]"
+    if not s.scale_target_ref.kind or not s.scale_target_ref.name:
+        return "scaleTargetRef.kind and .name are required"
+
+    def check_target(where: str, target, allowed) -> Optional[str]:
+        if target.type not in allowed:
+            return (f"{where}: target type {target.type!r} not supported "
+                    f"(allowed: {sorted(allowed)})")
+        field_of = {TARGET_UTILIZATION: target.average_utilization,
+                    TARGET_AVERAGE_VALUE: target.average_value,
+                    TARGET_VALUE: target.value}
+        if field_of[target.type] is None:
+            return (f"{where}: target type {target.type!r} requires its "
+                    "matching value field")
+        if field_of[target.type] <= 0:
+            return f"{where}: target value must be positive"
+        return None
+
+    for i, m in enumerate(s.metrics):
+        where = f"metrics[{i}]"
+        if m.resource is not None:
+            err = check_target(where, m.resource.target,
+                               {TARGET_UTILIZATION, TARGET_AVERAGE_VALUE})
+        elif m.pods is not None:
+            if not m.pods.metric:
+                return f"{where}: pods.metric name is required"
+            err = check_target(where, m.pods.target, {TARGET_AVERAGE_VALUE})
+        elif m.object is not None:
+            if not m.object.metric or not m.object.described_object.name:
+                return f"{where}: object.metric and describedObject required"
+            err = check_target(where, m.object.target,
+                               {TARGET_VALUE, TARGET_AVERAGE_VALUE})
+        elif m.external is not None:
+            if not m.external.metric:
+                return f"{where}: external.metric name is required"
+            err = check_target(where, m.external.target,
+                               {TARGET_VALUE, TARGET_AVERAGE_VALUE})
+        else:
+            return f"{where}: one of resource/pods/object/external required"
+        if err:
+            return err
+    return None
+
+
 # -- ResourceBinding: FederatedResourceQuota enforcement --------------------
 
 
@@ -265,3 +325,6 @@ def install_default_webhooks(
     registry.register_validating(ResourceBinding.KIND, QuotaEnforcer(store, gates))
     registry.register_validating(ResourceInterpreterWebhook.KIND,
                                  validate_interpreter_webhook)
+    from karmada_tpu.models.autoscaling import FederatedHPA
+
+    registry.register_validating(FederatedHPA.KIND, validate_federated_hpa)

@@ -210,3 +210,60 @@ def test_interpreter_webhook_empty_operations_denied():
                 endpoint="local:x",
                 rules=[InterpreterRule(api_versions=["apps/v1"],
                                        kinds=["*"], operations=[])])))
+
+
+def test_federated_hpa_validation():
+    """FederatedHPA admission: structural bounds + metric-target coherence
+    (a mismatched target type/value field must be rejected at admission,
+    not silently held at current replicas by the controller)."""
+    from karmada_tpu.models.autoscaling import (
+        CrossVersionObjectReference,
+        ExternalMetricSource,
+        FederatedHPA,
+        FederatedHPASpec,
+        MetricSpec,
+        MetricTarget,
+        PodsMetricSource,
+        ResourceMetricSource,
+    )
+    from karmada_tpu.webhook.builtin import validate_federated_hpa
+
+    def hpa(**kw):
+        spec = FederatedHPASpec(
+            scale_target_ref=CrossVersionObjectReference(
+                "apps/v1", "Deployment", "web"),
+            min_replicas=1, max_replicas=10,
+            metrics=[MetricSpec(resource=ResourceMetricSource(
+                name="cpu", target=MetricTarget(
+                    type="Utilization", average_utilization=60)))],
+        )
+        for k, v in kw.items():
+            setattr(spec, k, v)
+        return FederatedHPA(metadata=ObjectMeta(name="h", namespace="ns"),
+                            spec=spec)
+
+    assert validate_federated_hpa("CREATE", hpa(), None) is None
+    assert "maxReplicas" in validate_federated_hpa(
+        "CREATE", hpa(max_replicas=0), None)
+    assert "minReplicas" in validate_federated_hpa(
+        "CREATE", hpa(min_replicas=12), None)
+    # pods metric with the wrong target type (the default Utilization)
+    bad_pods = hpa(metrics=[MetricSpec(type="Pods", pods=PodsMetricSource(
+        metric="rps", target=MetricTarget(average_value=100)))])
+    assert "not supported" in validate_federated_hpa("CREATE", bad_pods, None)
+    # external AverageValue without the matching field
+    bad_ext = hpa(metrics=[MetricSpec(type="External",
+                                      external=ExternalMetricSource(
+        metric="q", target=MetricTarget(type="AverageValue")))])
+    assert "matching value field" in validate_federated_hpa(
+        "CREATE", bad_ext, None)
+    # empty metric spec
+    assert "one of" in validate_federated_hpa(
+        "CREATE", hpa(metrics=[MetricSpec(resource=None)]), None)
+    # the store path enforces it end to end
+    from karmada_tpu.e2e import ControlPlane
+    from karmada_tpu.webhook.admission import AdmissionDenied
+
+    cp = ControlPlane()
+    with pytest.raises(AdmissionDenied):
+        cp.store.create(hpa(max_replicas=0))

@@ -358,3 +358,32 @@ def test_object_metric_value_target(env):
     ready_before = 2  # initial replicas
     # ratio 3.0 over the ready pods at evaluation time
     assert dep.manifest["spec"]["replicas"] >= 2 * 3
+
+
+def test_cron_rule_pushing_invalid_shape_records_failed_execution(env):
+    """A CronFederatedHPA rule whose targetMinReplicas exceeds the HPA's
+    maxReplicas is rejected by admission — recorded as a Failed execution,
+    never a crashed controller round."""
+    from karmada_tpu.models.autoscaling import (
+        CronFederatedHPA,
+        CronFederatedHPARule,
+        CronFederatedHPASpec,
+    )
+
+    cp, clock = env
+    cp.store.create(CronFederatedHPA(
+        metadata=ObjectMeta(name="boom", namespace="default"),
+        spec=CronFederatedHPASpec(
+            scale_target_ref=CrossVersionObjectReference(
+                "autoscaling.karmada.io/v1alpha1", "FederatedHPA", "web-hpa"),
+            rules=[CronFederatedHPARule(
+                name="bad", schedule="* * * * *",
+                target_min_replicas=99)],  # > maxReplicas=10
+        )))
+    cp.tick()  # first sync registers; rules fire only for FUTURE slots
+    clock.advance(61)
+    cp.tick()  # must not raise
+    cron = cp.store.get("CronFederatedHPA", "default", "boom")
+    hist = cron.status.execution_histories[0]
+    assert hist.last_result == "Failed"
+    assert "admission rejected" in hist.message

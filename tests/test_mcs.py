@@ -211,3 +211,34 @@ def test_mci_without_mcs_goes_everywhere(cp):
     cp.tick()
     for m in ("m1", "m2", "m3"):
         assert cp.members[m].get("Ingress", "default", "wide") is not None
+
+
+def test_pull_member_slices_collected_by_agent(cp):
+    """Pull-mode members are unreachable from the control plane: their
+    EndpointSlices are collected by the AGENT's scoped controller
+    (agent.go registers endpointsliceCollect), not the central one."""
+    cp.add_member("pull-1", sync_mode="Pull")
+    cp.tick()
+    # the CENTRAL collector must not watch the pull member
+    assert "pull-1" not in cp.eps_collect.members
+    assert "pull-1" not in cp.eps_collect._subscribed
+    # ...but the agent's scoped collector does
+    assert "pull-1" in cp.agents["pull-1"].eps_collect.members
+
+    cp.apply(service())
+    cp.store.create(ServiceExport(metadata=ObjectMeta(name="web",
+                                                      namespace="default")))
+    cp.tick()
+    cp.members["pull-1"].apply(endpoint_slice("web-xyz", "web"))
+    cp.tick()
+    assert cp.store.try_get(
+        "EndpointSlice", "default",
+        _collected_name("pull-1", "default", "web-xyz")) is not None
+
+    # agent teardown unwinds the collection wiring
+    cp.agents["pull-1"].stop()
+    cp.members["pull-1"].apply(endpoint_slice("web-late", "web"))
+    cp.tick()
+    assert cp.store.try_get(
+        "EndpointSlice", "default",
+        _collected_name("pull-1", "default", "web-late")) is None
