@@ -275,7 +275,11 @@ class NativeSnapshot:
         if pid is not None:
             return pid
 
-        from karmada_tpu.scheduler.plugins import REGISTRY as _PLUGINS
+        from karmada_tpu.scheduler.plugins import (
+            REGISTRY as _PLUGINS,
+            eval_filters,
+            eval_scores,
+        )
 
         nC = len(self.clusters)
         taint = np.zeros(nC, np.uint8)
@@ -295,10 +299,10 @@ class NativeSnapshot:
                 reason[i] = 1
             elif serial.filter_spread_constraint(dummy_spec, dummy_status, c):
                 reason[i] = 3
-            elif plug_filters and _PLUGINS.extra_filter(placement, c):
+            elif plug_filters and eval_filters(plug_filters, placement, c):
                 reason[i] = 4
             if plug_scores:
-                extra[i] = _PLUGINS.extra_score(placement, c)
+                extra[i] = eval_scores(plug_scores, placement, c)
 
         strategy = serial.strategy_type(
             ResourceBindingSpec(placement=placement, replicas=1)

@@ -179,7 +179,7 @@ def find_clusters_that_fit(
     clusters are NOT filtered — users opt in via tolerations).  In-tree
     filters run first, then enabled out-of-tree registry filters
     (framework/runtime/registry.go), first rejection wins."""
-    from karmada_tpu.scheduler.plugins import REGISTRY
+    from karmada_tpu.scheduler.plugins import REGISTRY, eval_filters
 
     feasible: List[Cluster] = []
     diagnosis: Dict[str, str] = {}
@@ -194,10 +194,7 @@ def find_clusters_that_fit(
             if reason is not None:
                 break
         if reason is None and extra:
-            for _, plugin in extra:
-                reason = plugin(eff, cluster)
-                if reason is not None:
-                    break
+            reason = eval_filters(extra, eff, cluster)
         if reason is None:
             feasible.append(cluster)
         else:
@@ -226,15 +223,16 @@ def prioritize_clusters(
     In-tree scorers: ClusterAffinity (always 0) + ClusterLocality; enabled
     out-of-tree registry scores add on top (clamped sum, see
     scheduler/plugins.py)."""
-    from karmada_tpu.scheduler.plugins import REGISTRY
+    from karmada_tpu.scheduler.plugins import REGISTRY, eval_scores
 
-    if REGISTRY.empty() or not REGISTRY.enabled_scores():
+    scorers = REGISTRY.enabled_scores()
+    if not scorers:
         return [(c, MIN_CLUSTER_SCORE + score_cluster_locality(spec, c))
                 for c in clusters]
     eff = effective_placement(spec, status or ResourceBindingStatus())
     return [
         (c, MIN_CLUSTER_SCORE + score_cluster_locality(spec, c)
-         + REGISTRY.extra_score(eff, c))
+         + eval_scores(scorers, eff, c))
         for c in clusters
     ]
 

@@ -324,6 +324,16 @@ def _select_by_cluster(
     return in_sel, unsched
 
 
+def _locality_score(prev_present, extra_score) -> jnp.ndarray:
+    """Cluster score along the last axis: in-tree locality (100 on previous
+    clusters when any exist — generic_scheduler.go ClusterLocality) plus the
+    pre-clamped out-of-tree plugin sum (<=100, scheduler/plugins.py); total
+    <= 200 fits the packed sort keys' score bits."""
+    has_prev = jnp.any(prev_present, axis=-1, keepdims=True)
+    return (jnp.where(has_prev & prev_present, 100, 0).astype(jnp.int64)
+            + jnp.asarray(extra_score, jnp.int64))
+
+
 def _assign_lanes(
     feasible, avail_cal, prev_present, prev_rep, extra_score, name_rank,
     rank_webster,
@@ -340,11 +350,7 @@ def _assign_lanes(
     n = i64(n)
 
     fcount = jnp.sum(feasible)
-    has_prev = jnp.any(prev_present)
-    # in-tree locality (0|100) + pre-clamped out-of-tree plugin sum (<=100,
-    # scheduler/plugins.py) — total <= 200 fits the packed key's score bits
-    score = (jnp.where(has_prev & prev_present, 100, 0).astype(jnp.int64)
-             + jnp.asarray(extra_score, jnp.int64))
+    score = _locality_score(prev_present, extra_score)
 
     # ---- selection -------------------------------------------------------
     sel_sc, unsched_sel = _select_by_cluster(
@@ -396,7 +402,7 @@ def _assign_lanes(
         | ((_AVAIL_CAP - wc) << _LANE_BITS)
         | name_rank
     )
-    agg_key = jnp.where(active, agg_key, (jnp.int64(1) << 62))
+    agg_key = jnp.where(active, agg_key, jnp.int64(MAX_INT64))
     agg_pos = _positions(agg_key)
     w_sorted = jnp.zeros((C,), jnp.int64).at[agg_pos].set(jnp.where(active, w, 0))
     cum_excl = jnp.cumsum(w_sorted) - w_sorted
@@ -530,9 +536,7 @@ def _schedule_one(
 
     avail_sel = avail_cal + prev_rep * prev_present
     w_gather = jnp.where(strategy == STRAT_STATIC, static_w, avail_sel)
-    has_prev = jnp.any(prev_present)
-    score_full = (jnp.where(has_prev & prev_present, 100, 0).astype(jnp.int64)
-                  + jnp.asarray(extra_score, jnp.int64))
+    score_full = _locality_score(prev_present, extra_score)
     lanes, lane_ok = _gather_lanes(
         feasible, avail_sel, w_gather, prev_present, score_full, name_rank,
         rank_eff, use_extra)

@@ -44,6 +44,7 @@ from karmada_tpu.ops.solver import (
     _LANE_BITS,
     _capacity_estimates,
     _compact_of,
+    _locality_score,
     _schedule_core,
     _use_extra,
 )
@@ -181,11 +182,8 @@ def _spread_planes(
         & (api_ok[gvk_id] | prev_present)
         & ~evict
     )
-    has_prev = jnp.any(prev_present, axis=1)
-    # locality + pre-clamped out-of-tree plugin scores (scheduler/plugins.py)
-    score = (jnp.where(has_prev[:, None] & prev_present, 100, 0)
-             .astype(jnp.int64)
-             + jnp.asarray(pl_extra_score, jnp.int64)[placement_id])
+    score = _locality_score(prev_present,
+                            jnp.asarray(pl_extra_score, jnp.int64)[placement_id])
     # group availability includes already-assigned replicas
     # (group_clusters_with_score: tc.replicas + assigned)
     avail_sel = avail_cal + prev_rep * prev_present

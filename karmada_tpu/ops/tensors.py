@@ -686,6 +686,12 @@ def encode_batch(
     pl_region_max = np.zeros(P, np.int32)
 
     dummy_status = ResourceBindingStatus()
+    # one registry snapshot per encode: single lock acquisition, and every
+    # placement row of this batch sees the same plugin set
+    from karmada_tpu.scheduler.plugins import eval_filters, eval_scores
+
+    plug_filters = _PLUGINS.enabled_filters()
+    plug_scores = _PLUGINS.enabled_scores()
     for p, placement in enumerate(placements):
         strategy = serial.strategy_type(_spec_with(placement))
         pl_strategy[p] = {
@@ -713,8 +719,6 @@ def encode_batch(
             tol_row = np.zeros(C, bool)
             extra_row = np.zeros(C, np.int64)
             probe = _spec_with(placement)
-            plug_filters = _PLUGINS.enabled_filters()
-            plug_scores = _PLUGINS.enabled_scores()
             for i, c in enumerate(clusters):
                 # affinity + spread-property predicates (no prev bypass)
                 mask_row[i] = (
@@ -722,12 +726,12 @@ def encode_batch(
                     and serial.filter_spread_constraint(probe, dummy_status, c) is None
                     # out-of-tree registry filters fold into the same mask
                     and (not plug_filters
-                         or _PLUGINS.extra_filter(placement, c) is None)
+                         or eval_filters(plug_filters, placement, c) is None)
                 )
                 # taint toleration WITHOUT the target_contains bypass
                 tol_row[i] = _tolerated(placement, c)
                 if plug_scores:
-                    extra_row[i] = _PLUGINS.extra_score(placement, c)
+                    extra_row[i] = eval_scores(plug_scores, placement, c)
             # static weights (division_algorithm.go:38-72) per cluster
             static_row = np.zeros(C, np.int64)
             s = placement.replica_scheduling

@@ -108,23 +108,32 @@ class PluginRegistry:
     def extra_filter(self, placement, cluster) -> Optional[str]:
         """First rejection reason among enabled out-of-tree filters, in
         registration order (mirrors the in-tree chain's first-hit-wins)."""
-        for _, fn in self.enabled_filters():
-            reason = fn(placement, cluster)
-            if reason is not None:
-                return reason
-        return None
+        return eval_filters(self.enabled_filters(), placement, cluster)
 
     def extra_score(self, placement, cluster) -> int:
         """Sum of enabled out-of-tree scores, clamped to
         [0, EXTRA_SCORE_CAP] — the single clamp every backend shares."""
-        total = 0
-        for _, fn in self.enabled_scores():
-            total += int(fn(placement, cluster))
-        return max(0, min(total, EXTRA_SCORE_CAP))
+        return eval_scores(self.enabled_scores(), placement, cluster)
 
-    def empty(self) -> bool:
-        with self._lock:
-            return not self._filters and not self._scores
+
+def eval_filters(filters, placement, cluster) -> Optional[str]:
+    """First rejection among pre-fetched (name, fn) filters — encoders
+    hoist `enabled_filters()` once and evaluate O(placements x clusters)
+    times without re-taking the registry lock."""
+    for _, fn in filters:
+        reason = fn(placement, cluster)
+        if reason is not None:
+            return reason
+    return None
+
+
+def eval_scores(scores, placement, cluster) -> int:
+    """Clamped sum over pre-fetched (name, fn) scorers — THE clamp every
+    backend shares."""
+    total = 0
+    for _, fn in scores:
+        total += int(fn(placement, cluster))
+    return max(0, min(total, EXTRA_SCORE_CAP))
 
 
 # process-wide default instance; components accept an injected one in tests
