@@ -28,7 +28,7 @@ from typing import Optional
 
 SIM_CAPACITY_ANNOTATION = "karmada.io/simulated-capacity"
 
-VERSION = "karmada-tpu v0.3"
+VERSION = "karmada-tpu v0.4"
 
 
 def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
