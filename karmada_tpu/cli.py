@@ -844,7 +844,8 @@ def cmd_serve(args) -> int:
         api = QueryPlaneServer(
             cp.store, cp.members, cp.cluster_proxy,
             search_cache=cp.search_cache,
-            metrics_provider=cp.metrics_provider)
+            metrics_provider=cp.metrics_provider,
+            apply_fn=cp.apply, auth=cp.unified_auth)
         api_url = api.start(port=args.api_port)
         print(f"query plane at {api_url} "
               "(cluster proxy, search cache, metrics adapter; "
@@ -873,8 +874,8 @@ def cmd_serve(args) -> int:
 
 # -- remote mode (--server): the query plane over HTTP ------------------------
 # Reference: karmadactl talks to the aggregated apiserver by URL; here the
-# same four data-path verbs (get / logs / exec / top) target a plane served
-# by `karmadactl serve --api-port` (karmada_tpu/search/httpapi.py).
+# data-path verbs (get / logs / exec / top / apply / delete) target a plane
+# served by `karmadactl serve --api-port` (karmada_tpu/search/httpapi.py).
 
 
 def _http_json(server: str, method: str, path: str, body=None, params=None):
@@ -993,6 +994,36 @@ def cmd_exec_remote(args) -> int:
     if out.get("output"):
         print(out["output"])
     return int(out.get("rc", 0))
+
+
+def cmd_apply_remote(args) -> int:
+    """karmadactl --server apply -f: manifests POST to the served plane's
+    /api/apply (typed codec + admission run server-side)."""
+    import yaml
+
+    with open(args.filename) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    rc = 0
+    for manifest in docs:
+        code, out = _http_json(args.server, "POST", "/api/apply",
+                               body=manifest)
+        if code != 200:
+            _remote_fail(code, out)
+            rc = 1
+            continue
+        name = (manifest.get("metadata") or {}).get("name", "?")
+        print(f"{manifest.get('kind')}/{name} applied")
+    return rc
+
+
+def cmd_delete_remote(args) -> int:
+    path = (f"/api/{args.kind}/{args.namespace}/{args.name}"
+            if args.namespace else f"/api/{args.kind}/{args.name}")
+    code, out = _http_json(args.server, "DELETE", path)
+    if code != 200:
+        return _remote_fail(code, out)
+    print(f"{args.kind}/{args.name} deleted")
+    return 0
 
 
 def cmd_top_remote(args) -> int:
@@ -1261,6 +1292,8 @@ REMOTE_COMMANDS = {
     "logs": "cmd_logs_remote",
     "exec": "cmd_exec_remote",
     "top": "cmd_top_remote",
+    "apply": "cmd_apply_remote",
+    "delete": "cmd_delete_remote",
 }
 
 

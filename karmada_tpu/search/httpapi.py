@@ -29,7 +29,11 @@ in-process ClusterProxy.connect):
   GET    /metrics-adapter/external/{name}            scalar sample
 
   GET    /api/{kind}[?namespace=]                    control-plane manifests
-  GET    /api/{kind}/{ns}/{name}
+  GET    /api/{kind}[/{ns}]/{name}
+  POST   /api/apply                                  manifest (typed codec +
+                                                     admission; subject-gated,
+                                                     403 when served read-only)
+  DELETE /api/{kind}[/{ns}]/{name}                   subject-gated
   GET    /api-table/{kind}[?namespace=]              printer table (the
                                                      karmadactl get view)
   GET    /healthz /metrics                           liveness / Prometheus
@@ -57,7 +61,8 @@ class QueryPlaneServer:
     """One ThreadingHTTPServer for the whole query plane."""
 
     def __init__(self, store, members, cluster_proxy, search_cache=None,
-                 metrics_provider=None, registry=None) -> None:
+                 metrics_provider=None, registry=None, apply_fn=None,
+                 auth=None) -> None:
         from karmada_tpu.utils.metrics import REGISTRY
 
         self.store = store
@@ -66,8 +71,22 @@ class QueryPlaneServer:
         self.search_cache = search_cache
         self.metrics_provider = metrics_provider
         self.registry = registry if registry is not None else REGISTRY
+        # control-plane writes (karmadactl --server apply/delete): the
+        # plane's apply entry (typed codec + admission); None = read-only.
+        # `auth` (UnifiedAuthController) gates writes by the X-Karmada-User
+        # subject, same trust root as the cluster-proxy verbs.
+        self.apply_fn = apply_fn
+        self.auth = auth
         self._httpd = None
         self._thread: Optional[threading.Thread] = None
+
+    def _write_denied(self, subject: str) -> Optional[str]:
+        if self.apply_fn is None:
+            return "this plane is served read-only"
+        if self.auth is not None and subject not in self.auth.subjects:
+            return (f"subject {subject!r} not authorized for control-plane "
+                    "writes (unified auth)")
+        return None
 
     # -- request handling ---------------------------------------------------
     def _handle(self, method: str, path: str, query: dict, body: Optional[dict],
@@ -160,6 +179,42 @@ class QueryPlaneServer:
             # the scalar aggregate is the sum over the FILTERED samples
             total = sum(float(s.get("value", 0)) for s in values)
             return 200, {"name": parts[2], "value": total, "values": values}
+
+        if parts[:1] == ["api"] and method == "POST" and len(parts) == 2 \
+                and parts[1] == "apply":
+            denied = self._write_denied(subject)
+            if denied:
+                return 403, {"error": denied}
+            if (not body or "kind" not in body
+                    or not (body.get("metadata") or {}).get("name")):
+                return 400, {"error": "manifest with kind and metadata.name "
+                                      "required"}
+            from karmada_tpu.store.store import ConflictError
+
+            last = None
+            for _ in range(4):
+                # serve mode: controller threads mutate concurrently; a
+                # read-modify-write conflict is retryable, not an error
+                try:
+                    return 200, _manifest_of(self.apply_fn(body))
+                except ConflictError as e:
+                    last = e
+                except Exception as e:  # noqa: BLE001 — admission denials
+                    return 422, {"error": str(e)}
+            return 409, {"error": f"conflict persisted across retries: {last}"}
+
+        if parts[:1] == ["api"] and method == "DELETE" and len(parts) in (3, 4):
+            denied = self._write_denied(subject)
+            if denied:
+                return 403, {"error": denied}
+            ns = parts[2] if len(parts) == 4 else ""
+            try:
+                self.store.delete(parts[1], ns, parts[-1])
+            except KeyError:
+                return 404, {"error": "not found"}
+            except Exception as e:  # noqa: BLE001
+                return 422, {"error": str(e)}
+            return 200, {"deleted": True}
 
         if parts[:1] == ["api"] and method == "GET":
             ns = (query.get("namespace") or [None])[0]
