@@ -632,7 +632,11 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
     chunk k-1 is finalized/decoded and chunk k+1 encoded while the device
     works — host and device overlap instead of strictly alternating.
     """
-    from karmada_tpu.ops.solver import dispatch_compact, finalize_compact
+    from karmada_tpu.ops.solver import (
+        dispatch_compact,
+        finalize_compact,
+        solve_big,
+    )
     from karmada_tpu.ops.spread import solve_spread
     from karmada_tpu.scheduler import metrics as sm
 
@@ -658,6 +662,15 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
             i for i in range(len(part))
             if batch.route[i] == tensors.ROUTE_DEVICE_SPREAD
         ]
+        big_idx = [
+            i for i in range(len(part))
+            if batch.route[i] == tensors.ROUTE_DEVICE_BIG
+        ]
+        # tier-2 sub-solve (carry note: big bindings neither receive nor
+        # contribute carry — the bench mix has none; the scheduler service
+        # solves whole cycles where the same snapshot discipline applies)
+        big_res = solve_big(part, big_idx, cindex, estimator, cache,
+                            waves=waves)
         if carry:
             spread_res, used_sp = solve_spread(
                 batch, part, spread_idx, waves=waves, collect_used=True,
@@ -673,9 +686,15 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
         n_ok = 0
         chunk_failures: Dict[str, int] = {}
         for i in range(len(part)):
-            d = spread_res[i] if i in spread_res else decoded[i]
+            if i in spread_res:
+                d = spread_res[i]
+            elif i in big_res:
+                d = big_res[i]
+            else:
+                d = decoded[i]
             if batch.route[i] in (tensors.ROUTE_DEVICE,
-                                  tensors.ROUTE_DEVICE_SPREAD):
+                                  tensors.ROUTE_DEVICE_SPREAD,
+                                  tensors.ROUTE_DEVICE_BIG):
                 if isinstance(d, Exception):
                     k = type(d).__name__
                     chunk_failures[k] = chunk_failures.get(k, 0) + 1

@@ -465,9 +465,13 @@ def _assign_lanes(
 
 from karmada_tpu.ops.tensors import (  # noqa: E402
     COMPACT_DIVISION_CAP,
+    COMPACT_DIVISION_CAP_BIG,
     COMPACT_LANES,
+    COMPACT_LANES_BIG,
     COMPACT_PREV_CAP,
+    COMPACT_PREV_CAP_BIG,
     COMPACT_SELECTION_CAP,
+    COMPACT_SELECTION_CAP_BIG,
 )
 
 _G_PREV, _G_TOPK = COMPACT_PREV_CAP, 2 * COMPACT_DIVISION_CAP
@@ -476,9 +480,21 @@ assert COMPACT_LANES == _G_PREV + 4 * _G_TOPK, "lane geometry out of sync"
 # avail-ordered gather; its cap must not outgrow the division-derived budget
 assert COMPACT_SELECTION_CAP <= COMPACT_DIVISION_CAP, "selection cap too big"
 
+# gather geometry per compile tier: (g_prev, g_topk, direct_max).  The
+# "big" tier serves ROUTE_DEVICE_BIG sub-solves (caps 8x tier-1); its
+# exactness argument is the same, scaled.
+_TIERS = {
+    "std": (_G_PREV, _G_TOPK, COMPACT_LANES),
+    "big": (COMPACT_PREV_CAP_BIG, 2 * COMPACT_DIVISION_CAP_BIG,
+            COMPACT_LANES_BIG),
+}
+assert COMPACT_LANES_BIG == COMPACT_PREV_CAP_BIG + 8 * COMPACT_DIVISION_CAP_BIG
+assert COMPACT_SELECTION_CAP_BIG <= COMPACT_DIVISION_CAP_BIG
+
 
 def _gather_lanes(feasible, avail_sel, w_gather, prev_present, score,
-                  name_rank, rank_eff, use_extra: bool):
+                  name_rank, rank_eff, use_extra: bool,
+                  g_prev: int = _G_PREV, g_topk: int = _G_TOPK):
     """The union-of-top-K lane set for one binding: indices[K] plus a
     validity mask (duplicates and junk lanes disabled).  The score-keyed
     5th gather covers selection order under out-of-tree score plugins;
@@ -494,10 +510,10 @@ def _gather_lanes(feasible, avail_sel, w_gather, prev_present, score,
     key_w_rank = jnp.where(feasible, wq | (_LANE_MASK - rank_eff), NEG)
     key_w_name = jnp.where(feasible, wq | (_LANE_MASK - nr), NEG)
     key_a_name = jnp.where(feasible, aq | (_LANE_MASK - nr), NEG)
-    _, ip = lax.top_k(key_prev, _G_PREV)
-    _, iw = lax.top_k(key_w_rank, _G_TOPK)
-    _, inm = lax.top_k(key_w_name, _G_TOPK)
-    _, ia = lax.top_k(key_a_name, _G_TOPK)
+    _, ip = lax.top_k(key_prev, g_prev)
+    _, iw = lax.top_k(key_w_rank, g_topk)
+    _, inm = lax.top_k(key_w_name, g_topk)
+    _, ia = lax.top_k(key_a_name, g_topk)
     groups = [ip, iw, inm, ia]
     if use_extra:
         # the selection sort key itself: score desc, avail desc, name asc
@@ -507,7 +523,7 @@ def _gather_lanes(feasible, avail_sel, w_gather, prev_present, score,
             | aq | (_LANE_MASK - nr),
             NEG,
         )
-        _, isel = lax.top_k(key_sel, _G_TOPK)
+        _, isel = lax.top_k(key_sel, g_topk)
         groups.append(isel)
     lanes = jnp.concatenate(groups)  # [K]
     lanes = jnp.sort(lanes)
@@ -520,13 +536,14 @@ def _schedule_one(
     feasible, avail_cal, prev_present, prev_rep, extra_score, name_rank,
     n, strategy, has_sc, sc_min, sc_max, ignore_avail,
     static_w, uid_desc, fresh, non_workload, valid,
-    *, use_extra: bool = True,
+    *, use_extra: bool = True, tier: str = "std",
 ):
     """One binding; vmapped over the batch.  Small cluster axes run the
-    lane math directly; large ones gather COMPACT_LANES first."""
+    lane math directly; large ones gather the tier's lane budget first."""
+    g_prev, g_topk, direct_max = _TIERS[tier]
     C = feasible.shape[0]
     rank_eff = jnp.where(uid_desc, C - 1 - name_rank, name_rank)
-    if C <= COMPACT_LANES:
+    if C <= direct_max:
         return _assign_lanes(
             feasible, avail_cal, prev_present, prev_rep, extra_score,
             name_rank, rank_eff,
@@ -539,7 +556,7 @@ def _schedule_one(
     score_full = _locality_score(prev_present, extra_score)
     lanes, lane_ok = _gather_lanes(
         feasible, avail_sel, w_gather, prev_present, score_full, name_rank,
-        rank_eff, use_extra)
+        rank_eff, use_extra, g_prev, g_topk)
     g = lambda a: a[lanes]
     feas_k = g(feasible) & lane_ok
     rank_eff_k = g(rank_eff)
@@ -566,17 +583,19 @@ def _schedule_one(
     return rep, sel, status
 
 
-def _schedule_vmap_for(use_extra: bool):
-    """vmapped kernel per static plugin-score mode (two compile variants:
-    the no-plugin one keeps the 4-group gather volume)."""
+def _schedule_vmap_for(use_extra: bool, tier: str):
+    """vmapped kernel per static (plugin-score mode, lane tier) pair —
+    the common no-plugin std variant keeps the 4-group/528-lane volume."""
     return jax.vmap(
-        partial(_schedule_one, use_extra=use_extra),
+        partial(_schedule_one, use_extra=use_extra, tier=tier),
         in_axes=(0, 0, 0, 0, 0, None, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0),
     )
 
 
-_SCHEDULE_VMAPS = {True: _schedule_vmap_for(True),
-                   False: _schedule_vmap_for(False)}
+_SCHEDULE_VMAPS = {
+    (ue, tier): _schedule_vmap_for(ue, tier)
+    for ue in (True, False) for tier in _TIERS
+}
 
 
 def _schedule_core(
@@ -594,6 +613,7 @@ def _schedule_core(
     non_workload, nw_shortcut, prev_idx, prev_val, evict_idx,
     used0_milli=None, used0_pods=None, used0_sets=None,
     *, waves: int = 1, use_extra: bool = True, with_used: bool = False,
+    tier: str = "std",
 ):
     """The full cycle: returns (rep[B,C] int64, selected[B,C] bool, status[B]).
 
@@ -681,7 +701,7 @@ def _schedule_core(
             & ~evict_w
         )
 
-        rep, sel, status = _SCHEDULE_VMAPS[use_extra](
+        rep, sel, status = _SCHEDULE_VMAPS[(use_extra, tier)](
             feasible, avail_cal, prev_present_w, prev_rep_w,
             pl_extra_score[placement_id_w], name_rank,
             replicas_w, pl_strategy[placement_id_w],
@@ -751,7 +771,8 @@ def _schedule_core(
 # the caller reads — measured as the entire chunk budget at 4096x8192.
 schedule_batch = partial(
     jax.jit,
-    static_argnames=("waves", "use_extra", "with_used"))(_schedule_core)
+    static_argnames=("waves", "use_extra", "with_used",
+                     "tier"))(_schedule_core)
 
 
 def _compact_of(rep, sel, status, non_workload, max_nnz: int,
@@ -778,9 +799,10 @@ _NON_WORKLOAD_ARG = 28
 
 
 @partial(jax.jit, static_argnames=("waves", "max_nnz", "keep_sel",
-                                   "use_extra", "with_used"))
+                                   "use_extra", "with_used", "tier"))
 def schedule_compact(*args, waves: int, max_nnz: int, keep_sel: bool = False,
-                     use_extra: bool = True, with_used: bool = False):
+                     use_extra: bool = True, with_used: bool = False,
+                     tier: str = "std"):
     """The full cycle with the sparse COO extraction FUSED into one jitted
     program: the dense [B, C] result planes never become jit outputs, so
     only idx/val/status/nnz (~max_nnz ints) ever leave the device.
@@ -788,7 +810,7 @@ def schedule_compact(*args, waves: int, max_nnz: int, keep_sel: bool = False,
     (used_milli [C,R], used_pods [C], used_sets [Q,C]) — the carry for a
     second-pass repack or a later batch of the same cycle."""
     core = _schedule_core(*args, waves=waves, use_extra=use_extra,
-                          with_used=with_used)
+                          with_used=with_used, tier=tier)
     if with_used:
         rep, sel, status, used = core
     else:
@@ -850,7 +872,7 @@ def _batch_args(batch):
     )
 
 
-def solve(batch, waves: int = 1):
+def solve(batch, waves: int = 1, tier: str = "std"):
     """Run schedule_batch over an ops/tensors.SolverBatch; dense numpy
     results (rep[B,C], sel[B,C], status[B]).  Tests and small callers; the
     hot path uses solve_compact to avoid the dense D2H transfer."""
@@ -860,13 +882,13 @@ def solve(batch, waves: int = 1):
     assert batch.C <= MAX_CLUSTER_LANES, \
         f"cluster axis must be <= {MAX_CLUSTER_LANES} per solve call"
     rep, sel, status = schedule_batch(*_batch_args(batch), waves=waves,
-                                      use_extra=_use_extra(batch))
+                                      use_extra=_use_extra(batch), tier=tier)
     return np.asarray(rep), np.asarray(sel), np.asarray(status)
 
 
 def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
                      keep_sel: bool = False, with_used: bool = False,
-                     used0=None):
+                     used0=None, tier: str = "std"):
     """Enqueue the fused device solve WITHOUT forcing the result (jax
     dispatch is async): returns an opaque handle for finalize_compact.
     Lets a caller overlap host work (encode of the next chunk, decode of
@@ -891,9 +913,9 @@ def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
     use_extra = _use_extra(batch)
     first = schedule_compact(*args, waves=waves, max_nnz=max_nnz,
                              keep_sel=keep_sel, use_extra=use_extra,
-                             with_used=with_used)
+                             with_used=with_used, tier=tier)
     return (args, waves, keep_sel, first, max_nnz, dense_nnz, use_extra,
-            with_used)
+            with_used, tier)
 
 
 def finalize_compact(handle):
@@ -907,14 +929,14 @@ def finalize_compact(handle):
     import numpy as np
 
     (args, waves, keep_sel, first, max_nnz, dense_nnz, use_extra,
-     with_used) = handle
+     with_used, tier) = handle
     res = first
     nnz = res[3]
     while int(nnz) > max_nnz and max_nnz < dense_nnz:
         max_nnz = min(max_nnz * 4, dense_nnz)
         res = schedule_compact(*args, waves=waves, max_nnz=max_nnz,
                                keep_sel=keep_sel, use_extra=use_extra,
-                               with_used=with_used)
+                               with_used=with_used, tier=tier)
         nnz = res[3]
     idx, val, st = res[0], res[1], res[2]
     out = (np.asarray(idx), np.asarray(val), np.asarray(st), int(nnz))
@@ -923,9 +945,35 @@ def finalize_compact(handle):
     return out
 
 
+def solve_big(items, idx_list, cindex, estimator, cache, waves: int = 1,
+              enable_empty_workload_propagation: bool = False):
+    """Solve one chunk's ROUTE_DEVICE_BIG bindings (beyond the tier-1
+    compact caps) as their own sub-batch on the big lane tier, the same
+    sub-batch pattern as ops/spread.solve_spread.  Returns
+    {original_index: List[TargetCluster] | Exception}."""
+    from karmada_tpu.ops import tensors as T
+
+    if not idx_list:
+        return {}
+    sub = [items[i] for i in idx_list]
+    batch2 = T.encode_batch(sub, cindex, estimator, cache=cache)
+    # in a parent batch big rows are host-invalid; in THIS sub-batch they
+    # are the payload (binding-axis arrays are fresh per encode: writable)
+    batch2.b_valid[:len(sub)] = batch2.route == T.ROUTE_DEVICE_BIG
+    idx, val, st, _nnz = solve_compact(
+        batch2, waves=waves, tier="big",
+        keep_sel=enable_empty_workload_propagation)
+    decoded = T.decode_compact(
+        batch2, idx, val, st,
+        enable_empty_workload_propagation=enable_empty_workload_propagation,
+        items=sub)
+    return {idx_list[j]: decoded[j] for j in range(len(sub))
+            if batch2.route[j] == T.ROUTE_DEVICE_BIG}
+
+
 def solve_compact(batch, waves: int = 1, max_nnz: int = 0,
                   keep_sel: bool = False, with_used: bool = False,
-                  used0=None):
+                  used0=None, tier: str = "std"):
     """Device-side solve + sparse result extraction: D2H ships only the
     (binding, cluster, replicas) nonzeros instead of the dense [B, C] int64
     plane (x100+ less traffic on realistic mixes).  Escalates max_nnz x4 on
@@ -934,4 +982,4 @@ def solve_compact(batch, waves: int = 1, max_nnz: int = 0,
                                              max_nnz=max_nnz,
                                              keep_sel=keep_sel,
                                              with_used=with_used,
-                                             used0=used0))
+                                             used0=used0, tier=tier))

@@ -67,7 +67,8 @@ ROUTE_UNSUPPORTED = 3  # (2 was ROUTE_MULTI_COMPONENT, retired in r4)
 ROUTE_VANISHED_PREV = 4  # prev assignment names a cluster outside the snapshot
 ROUTE_HUGE_REPLICAS = 5  # replica count beyond the kernel's 2^25 cap
 ROUTE_DEVICE_SPREAD = 6  # region spread: device group math + host DFS
-ROUTE_COMPACT_CAP = 7  # beyond the compact-lane gather's exactness caps
+ROUTE_COMPACT_CAP = 7  # beyond EVERY compact tier's exactness caps -> host
+ROUTE_DEVICE_BIG = 8  # beyond tier-1 caps: the big-tier device sub-solve
 
 # the device spread path enumerates region groups as fixed lanes
 MAX_DEVICE_REGIONS = 16
@@ -86,6 +87,15 @@ COMPACT_LANES = 528  # prev(16) + 4 x top-K(128): w-rank, w-name, avail, sel-key
 COMPACT_DIVISION_CAP = 64    # replicas (and thus any Webster target)
 COMPACT_SELECTION_CAP = 64   # cluster spread-constraint MaxGroups
 COMPACT_PREV_CAP = 16        # previous-assignment cluster count
+
+# tier-2 ("big") geometry: bindings beyond the tier-1 caps run in a
+# SEPARATE big-lane sub-solve (ROUTE_DEVICE_BIG, solver tier="big") with
+# 8x the caps instead of falling to the serial host; only counts beyond
+# the big caps route to host (ROUTE_COMPACT_CAP)
+COMPACT_DIVISION_CAP_BIG = 512
+COMPACT_SELECTION_CAP_BIG = 512
+COMPACT_PREV_CAP_BIG = 128
+COMPACT_LANES_BIG = 4224  # prev(128) + 4 x top-K(1024)
 
 # result status codes (must match ops/solver.py)
 STATUS_OK = 0
@@ -212,14 +222,10 @@ def _route_for(
     compact: bool = False,
 ) -> int:
     scs = placement.spread_constraints
+    big = False
     if scs and not serial.should_ignore_spread_constraint(placement):
-        if compact and any(
-            sc.spread_by_field == SPREAD_BY_FIELD_CLUSTER
-            and sc.max_groups > COMPACT_SELECTION_CAP
-            for sc in scs
-        ):
-            return ROUTE_COMPACT_CAP
         has_region = has_cluster = has_other_field = False
+        cluster_max = 0
         for sc in scs:
             if sc.spread_by_field in (
                 SPREAD_BY_FIELD_PROVIDER,
@@ -235,12 +241,20 @@ def _route_for(
                 has_region = True
             if sc.spread_by_field == SPREAD_BY_FIELD_CLUSTER:
                 has_cluster = True
+                cluster_max = max(cluster_max, sc.max_groups)
             if sc.spread_by_label:
                 return ROUTE_UNSUPPORTED
         if has_region:
+            # the spread pipeline's assignment runs tier-1 only
+            if compact and cluster_max > COMPACT_SELECTION_CAP:
+                return ROUTE_COMPACT_CAP
             if 0 < n_regions <= MAX_DEVICE_REGIONS and len(spec.components) <= 1:
                 return ROUTE_DEVICE_SPREAD
             return ROUTE_TOPOLOGY_SPREAD
+        if compact and cluster_max > COMPACT_SELECTION_CAP:
+            if cluster_max > COMPACT_SELECTION_CAP_BIG:
+                return ROUTE_COMPACT_CAP
+            big = True  # tier-2 selection: the big-lane sub-solve
         if has_other_field and not has_cluster:
             # provider/zone with NEITHER region nor cluster: the reference
             # fails these ('just support cluster and region spread
@@ -259,7 +273,7 @@ def _route_for(
     # per-replica with nil requirements (the allowed-pods row) and replicas
     # 0, which is exactly the kernel's non_workload selection path — both
     # run on device (VERDICT r3 item 4; ROUTE_MULTI_COMPONENT retired)
-    return ROUTE_DEVICE
+    return ROUTE_DEVICE_BIG if big else ROUTE_DEVICE
 
 
 # spec-free probe for the placement-only route: _route_for reads only
@@ -417,7 +431,7 @@ def encode_batch(
     pid_route_by_id: Dict[int, tuple] = {}
     use_fast = [False]
     uids: List[str] = []
-    on_device = (ROUTE_DEVICE, ROUTE_DEVICE_SPREAD)
+    on_device = (ROUTE_DEVICE, ROUTE_DEVICE_SPREAD, ROUTE_DEVICE_BIG)
     cindex_get = cindex.index.get
     compact = C > COMPACT_LANES
     rep_cap = COMPACT_DIVISION_CAP if compact else KERNEL_REPLICA_CAP
@@ -530,9 +544,19 @@ def encode_batch(
             # count is a wide broadcast rather than a Webster target
             divides = (placement.replica_scheduling_type()
                        != REPLICA_SCHEDULING_DUPLICATED)
-            if ((divides and nrep > COMPACT_DIVISION_CAP)
-                    or len(prev_entries[b]) > COMPACT_PREV_CAP):
+            nprev = len(prev_entries[b])
+            over1 = ((divides and nrep > COMPACT_DIVISION_CAP)
+                     or nprev > COMPACT_PREV_CAP)
+            over2 = ((divides and nrep > COMPACT_DIVISION_CAP_BIG)
+                     or nprev > COMPACT_PREV_CAP_BIG)
+            if r == ROUTE_DEVICE_SPREAD:
+                # the spread pipeline's assignment runs tier-1 only
+                if over1:
+                    r = ROUTE_COMPACT_CAP
+            elif over2:
                 r = ROUTE_COMPACT_CAP
+            elif over1 or r == ROUTE_DEVICE_BIG:
+                r = ROUTE_DEVICE_BIG
         if spec.graceful_eviction_tasks:
             for task in spec.graceful_eviction_tasks:
                 ci = cindex_get(task.from_cluster)

@@ -32,7 +32,11 @@ from karmada_tpu.models.work import (
     TargetCluster,
 )
 from karmada_tpu.ops import serial, tensors
-from karmada_tpu.ops.solver import dispatch_compact, finalize_compact
+from karmada_tpu.ops.solver import (
+    dispatch_compact,
+    finalize_compact,
+    solve_big,
+)
 from karmada_tpu.webhook.admission import AdmissionDenied
 from karmada_tpu.scheduler import metrics as sched_metrics
 from karmada_tpu.scheduler.queue import QueuedBindingInfo, SchedulingQueue
@@ -419,6 +423,10 @@ class Scheduler:
                 i for i in range(len(items))
                 if batch.route[i] == tensors.ROUTE_DEVICE_SPREAD
             ]
+            big_idx = [
+                i for i in range(len(items))
+                if batch.route[i] == tensors.ROUTE_DEVICE_BIG
+            ]
             # dispatch the main solve FIRST (async), so the device crunches
             # it while the host walks the spread bindings' DFS ping-pong
             handle = None
@@ -442,6 +450,20 @@ class Scheduler:
                     time.perf_counter() - t_sp,
                     schedule_step=sched_metrics.STEP_SOLVE,
                 )
+            if big_idx:
+                # tier-2 sub-solve for bindings beyond the compact caps
+                t_big = time.perf_counter()
+                for i, res in solve_big(
+                    items, big_idx, cindex, self._general,
+                    self._encoder_cache(clusters), waves=self.waves,
+                    enable_empty_workload_propagation=(
+                        self.enable_empty_workload_propagation),
+                ).items():
+                    out[i] = res
+                sched_metrics.STEP_LATENCY.observe(
+                    time.perf_counter() - t_big,
+                    schedule_step=sched_metrics.STEP_SOLVE,
+                )
             if device_idx:
                 t1 = time.perf_counter()
                 idx, val, status, _nnz = finalize_compact(handle)
@@ -459,7 +481,7 @@ class Scheduler:
                 )
                 for i in device_idx:
                     out[i] = decoded[i]
-            device_idx = device_idx + spread_idx
+            device_idx = device_idx + spread_idx + big_idx
         device_set = set(device_idx)
         host_idx = [i for i in range(len(items)) if i not in device_set]
         if host_idx:

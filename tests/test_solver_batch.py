@@ -507,8 +507,9 @@ def test_batch_parity_wide_cluster_axis():
 
 
 def test_compact_cap_routing():
-    """Bindings beyond the compact-lane exactness bounds route to the
-    serial host path at large C, and stay on-device at small C."""
+    """Bindings beyond the tier-1 compact caps route to the big-tier
+    device sub-solve at large C; beyond the big caps they route host;
+    at small C everything stays on the direct device path."""
     rng = random.Random(3)
     names = [f"member-{i:03d}" for i in range(600)]
     clusters = [mk_cluster(rng, nm) for nm in names]
@@ -543,18 +544,24 @@ def test_compact_cap_routing():
 
     items = [
         binding(50),            # divided, under cap -> device
-        binding(100),           # divided, over the 64-replica cap -> host
+        binding(100),           # divided, over the 64-replica cap -> BIG tier
         binding(100, dup=True),  # duplicated: replica cap does not apply
-        binding(10, prev_n=20),  # 20 prev clusters > 16 cap -> host
-        binding(10, sc_max=80),  # selection cap -> host
+        binding(10, prev_n=20),  # 20 prev clusters > 16 cap -> BIG tier
+        binding(10, sc_max=80),  # selection 64 < 80 <= 512 -> BIG tier
+        binding(600),            # beyond the big division cap -> host
+        binding(10, prev_n=140),  # beyond the big prev cap -> host
+        binding(10, sc_max=600),  # beyond the big selection cap -> host
     ]
     batch = tensors.encode_batch(
         items, tensors.ClusterIndex.build(clusters), GeneralEstimator())
     assert batch.route[0] == tensors.ROUTE_DEVICE
-    assert batch.route[1] == tensors.ROUTE_COMPACT_CAP
+    assert batch.route[1] == tensors.ROUTE_DEVICE_BIG
     assert batch.route[2] == tensors.ROUTE_DEVICE
-    assert batch.route[3] == tensors.ROUTE_COMPACT_CAP
-    assert batch.route[4] == tensors.ROUTE_COMPACT_CAP
+    assert batch.route[3] == tensors.ROUTE_DEVICE_BIG
+    assert batch.route[4] == tensors.ROUTE_DEVICE_BIG
+    assert batch.route[5] == tensors.ROUTE_COMPACT_CAP
+    assert batch.route[6] == tensors.ROUTE_COMPACT_CAP
+    assert batch.route[7] == tensors.ROUTE_COMPACT_CAP
 
     # the same bindings at small C all stay on-device (no gather, no caps)
     small = clusters[:16]
@@ -562,3 +569,81 @@ def test_compact_cap_routing():
         [binding(100), binding(10, prev_n=10), binding(10, sc_max=80)],
         tensors.ClusterIndex.build(small), GeneralEstimator())
     assert (batch_small.route == tensors.ROUTE_DEVICE).all()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_big_tier_parity(seed):
+    """ROUTE_DEVICE_BIG (replicas/prev/MaxGroups beyond the tier-1 caps):
+    the big-lane sub-solve must stay bit-identical to serial."""
+    from karmada_tpu.ops.solver import solve_big
+
+    rng = random.Random(seed)
+    names = [f"member-{i:03d}" for i in range(700)]
+    clusters = [mk_cluster(rng, nm) for nm in names]
+
+    def big_binding(b):
+        style = b % 3
+        if style == 0:  # big replica count
+            pl = Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS)))
+            spec = ResourceBindingSpec(
+                resource=ObjectReference(api_version=GVK[0], kind=GVK[1],
+                                         namespace="d", name=f"a{b}",
+                                         uid=f"u{b}"),
+                replicas=rng.randint(65, 400), placement=pl)
+        elif style == 1:  # wide selection
+            pl = Placement(
+                spread_constraints=[SpreadConstraint(
+                    spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+                    min_groups=2, max_groups=rng.randint(65, 300))],
+                replica_scheduling=ReplicaSchedulingStrategy(
+                    replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                    replica_division_preference=REPLICA_DIVISION_AGGREGATED))
+            spec = ResourceBindingSpec(
+                resource=ObjectReference(api_version=GVK[0], kind=GVK[1],
+                                         namespace="d", name=f"a{b}",
+                                         uid=f"u{b}"),
+                replicas=rng.randint(5, 60), placement=pl)
+        else:  # many previous clusters (steady scale paths)
+            pl = Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS)))
+            prev_n = rng.randint(17, 100)
+            spec = ResourceBindingSpec(
+                resource=ObjectReference(api_version=GVK[0], kind=GVK[1],
+                                         namespace="d", name=f"a{b}",
+                                         uid=f"u{b}"),
+                replicas=rng.randint(30, 120), placement=pl,
+                clusters=[TargetCluster(name=n, replicas=1)
+                          for n in rng.sample(names, prev_n)])
+        if rng.random() < 0.4:
+            spec.replica_requirements = ReplicaRequirements(resource_request={
+                "cpu": Quantity.from_milli(rng.choice([100, 250]))})
+        return spec, ResourceBindingStatus()
+
+    items = [big_binding(b) for b in range(6)]
+    est = GeneralEstimator()
+    cal = serial.make_cal_available([est])
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch(items, cindex, est)
+    big_idx = [i for i in range(len(items))
+               if batch.route[i] == tensors.ROUTE_DEVICE_BIG]
+    assert big_idx, "scenario must exercise the big tier"
+    # waves=1: the serial comparison is per-binding against the untouched
+    # snapshot (contention parity is covered by test_contention)
+    got = solve_big(items, big_idx, cindex, est, None, waves=1)
+    for i in big_idx:
+        spec, st = items[i]
+        try:
+            want = {tc.name: tc.replicas
+                    for tc in serial.schedule(spec, st, clusters, cal)}
+        except Exception as e:  # noqa: BLE001
+            assert isinstance(got[i], type(e)), (seed, i, e, got[i])
+            continue
+        gm = {tc.name: tc.replicas for tc in got[i]}
+        assert gm == want, (seed, i)
