@@ -5,6 +5,8 @@ in-process: detector matching, batched/serial scheduling, Work rendering
 with overrides, member apply, and status reflection back to the template.
 """
 
+import os
+
 import pytest
 
 from karmada_tpu.e2e import ControlPlane
@@ -264,3 +266,68 @@ def test_native_backend_affinity_failover_loop():
     assert sum(tc.replicas for tc in rb.spec.clusters) == 4
     assert {tc.name for tc in rb.spec.clusters} <= {"m1", "m2"}
     assert rb.status.scheduler_observed_affinity_name == "backup"
+
+
+@pytest.mark.skipif(os.environ.get("KARMADA_TPU_SOAK") != "1",
+                    reason="600-member fleet e2e is opt-in (slow)")
+def test_big_tier_through_scheduler_service():
+    """ROUTE_DEVICE_BIG end to end through the scheduler SERVICE at a
+    fleet large enough to engage the compact tiers (C > 528): a
+    150-replica workload and a 200-cluster spread canary both schedule on
+    the device path."""
+    from karmada_tpu.e2e import ControlPlane
+    from karmada_tpu.models.meta import ObjectMeta
+    from karmada_tpu.models.policy import (
+        DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+        REPLICA_DIVISION_AGGREGATED,
+        REPLICA_DIVISION_WEIGHTED,
+        REPLICA_SCHEDULING_DIVIDED,
+        SPREAD_BY_FIELD_CLUSTER,
+        ClusterPreferences,
+        Placement,
+        PropagationPolicy,
+        PropagationSpec,
+        ReplicaSchedulingStrategy,
+        ResourceSelector,
+        SpreadConstraint,
+    )
+    from karmada_tpu.models.work import ResourceBinding
+
+    cp = ControlPlane(backend="device")
+    for i in range(600):
+        cp.add_member(f"m{i:03d}", cpu_milli=16_000)
+    cp.tick()
+    cp.apply_policy(PropagationPolicy(
+        metadata=ObjectMeta(name="wide", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(
+                api_version="apps/v1", kind="Deployment", name="huge")],
+            placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS))))))
+    cp.apply_policy(PropagationPolicy(
+        metadata=ObjectMeta(name="canary", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(
+                api_version="apps/v1", kind="Deployment", name="probe")],
+            placement=Placement(
+                spread_constraints=[SpreadConstraint(
+                    spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+                    min_groups=150, max_groups=200)],
+                replica_scheduling=ReplicaSchedulingStrategy(
+                    replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                    replica_division_preference=REPLICA_DIVISION_AGGREGATED)))))
+    for name, reps in (("huge", 150), ("probe", 40)):
+        cp.apply({"apiVersion": "apps/v1", "kind": "Deployment",
+                  "metadata": {"name": name, "namespace": "default"},
+                  "spec": {"replicas": reps,
+                           "template": {"spec": {"containers": [
+                               {"name": "c", "resources": {
+                                   "requests": {"cpu": "50m"}}}]}}}})
+    cp.tick()
+    for name, reps in (("huge", 150), ("probe", 40)):
+        rb = cp.store.get(ResourceBinding.KIND, "default",
+                          f"{name}-deployment")
+        assert sum(t.replicas for t in rb.spec.clusters) == reps, name
