@@ -658,10 +658,7 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
         idx, val, status, _nnz = fin[:4]
         if len(fin) == 5:  # carry mode: absorb the main kernel's delta
             carry_state.absorb(batch, fin[4], used0)
-        spread_idx = [
-            i for i in range(len(part))
-            if batch.route[i] == tensors.ROUTE_DEVICE_SPREAD
-        ]
+        spread_groups = tensors.spread_groups(batch, part)
         big_idx = [
             i for i in range(len(part))
             if batch.route[i] == tensors.ROUTE_DEVICE_BIG
@@ -671,14 +668,18 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
         # solves whole cycles where the same snapshot discipline applies)
         big_res = solve_big(part, big_idx, cindex, estimator, cache,
                             waves=waves)
-        if carry:
-            spread_res, used_sp = solve_spread(
-                batch, part, spread_idx, waves=waves, collect_used=True,
-                used0=used0)
-            if used_sp is not None:
-                carry_state.absorb(batch, used_sp, used0)
-        else:
-            spread_res = solve_spread(batch, part, spread_idx, waves=waves)
+        spread_res: Dict[int, object] = {}
+        for (axis, tier), idxs in spread_groups.items():
+            if carry:
+                res_g, used_sp = solve_spread(
+                    batch, part, idxs, waves=waves, collect_used=True,
+                    used0=used0, axis=axis, tier=tier)
+                if used_sp is not None:
+                    carry_state.absorb(batch, used_sp, used0)
+            else:
+                res_g = solve_spread(batch, part, idxs, waves=waves,
+                                     axis=axis, tier=tier)
+            spread_res.update(res_g)
         t2 = time.perf_counter()
         solve_s += t2 - t1
         sm.STEP_LATENCY.observe(t2 - t1, schedule_step=sm.STEP_SOLVE)
@@ -694,6 +695,7 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
                 d = decoded[i]
             if batch.route[i] in (tensors.ROUTE_DEVICE,
                                   tensors.ROUTE_DEVICE_SPREAD,
+                                  tensors.ROUTE_DEVICE_SPREAD_BIG,
                                   tensors.ROUTE_DEVICE_BIG):
                 if isinstance(d, Exception):
                     k = type(d).__name__

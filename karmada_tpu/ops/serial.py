@@ -129,6 +129,10 @@ def filter_spread_constraint(
             return "cluster(s) did not have region property"
         if sc.spread_by_field == SPREAD_BY_FIELD_ZONE and not cluster.zones_effective():
             return "cluster(s) did not have zones property"
+        if sc.spread_by_label and not cluster.metadata.labels.get(
+            sc.spread_by_label
+        ):
+            return "cluster(s) did not have spread label " + sc.spread_by_label
     return None
 
 
@@ -267,11 +271,25 @@ class GroupClustersInfo:
     providers: Dict[str, GroupInfo] = field(default_factory=dict)
     regions: Dict[str, GroupInfo] = field(default_factory=dict)
     zones: Dict[str, GroupInfo] = field(default_factory=dict)
+    # spread-by-label groups (label VALUE -> group) for the placement's
+    # first label constraint's key — this framework's extension beyond the
+    # reference, whose scheduler never implemented SpreadByLabel
+    # (select_clusters.go:55 fails it); group math mirrors regions
+    labels: Dict[str, GroupInfo] = field(default_factory=dict)
 
 
 def _sort_clusters(infos: List[ClusterDetailInfo]) -> None:
     """spreadconstraint/util.go sortClusters: score desc, available desc, name asc."""
     infos.sort(key=lambda c: (-c.score, -c.available_replicas, c.name))
+
+
+def _label_constraint(placement: Placement) -> Optional[SpreadConstraint]:
+    """First spread-by-label constraint — its key is the group axis
+    (further label constraints filter only; ops/tensors.spread_axis_of)."""
+    for sc in placement.spread_constraints:
+        if sc.spread_by_label:
+            return sc
+    return None
 
 
 def _spread_constraint(placement: Placement, by_field: str) -> Optional[SpreadConstraint]:
@@ -403,6 +421,19 @@ def group_clusters_with_score(
         for g in info.regions.values():
             g.score = _calc_group_score(g.clusters, spec, mg)
 
+    # label values (framework extension; group math mirrors regions)
+    label_sc = _label_constraint(placement)
+    if label_sc is not None:
+        for ci in info.clusters:
+            value = ci.cluster.metadata.labels.get(label_sc.spread_by_label)
+            if not value:
+                continue
+            g = info.labels.setdefault(value, GroupInfo(name=value))
+            g.clusters.append(ci)
+            g.available_replicas += ci.available_replicas
+        for g in info.labels.values():
+            g.score = _calc_group_score(g.clusters, spec, label_sc.min_groups)
+
     # providers
     if _spread_constraint(placement, SPREAD_BY_FIELD_PROVIDER) is not None:
         for ci in info.clusters:
@@ -505,6 +536,15 @@ def select_best_clusters(
     sc_map = {sc.spread_by_field: sc for sc in placement.spread_constraints}
     if SPREAD_BY_FIELD_REGION in sc_map:
         return _select_by_region(sc_map, info)
+    label_sc = _label_constraint(placement)
+    if label_sc is not None:
+        # framework extension: label-value groups select exactly like
+        # regions (the reference fails SpreadByLabel outright)
+        return _select_by_groups(
+            label_sc,
+            sc_map.get(SPREAD_BY_FIELD_CLUSTER, SpreadConstraint()),
+            info.labels,
+        )
     if SPREAD_BY_FIELD_CLUSTER in sc_map:
         return _select_by_cluster(sc_map[SPREAD_BY_FIELD_CLUSTER], info, need_replicas)
     raise UnschedulableError("just support cluster and region spread constraint")
@@ -560,27 +600,40 @@ def _select_by_region(
     sc_map: Dict[str, SpreadConstraint], info: GroupClustersInfo
 ) -> List[ClusterDetailInfo]:
     """select_clusters_by_region.go:27-118."""
-    region_sc = sc_map[SPREAD_BY_FIELD_REGION]
-    cluster_sc = sc_map.get(SPREAD_BY_FIELD_CLUSTER, SpreadConstraint())
-    if len(info.regions) < region_sc.min_groups:
+    return _select_by_groups(
+        sc_map[SPREAD_BY_FIELD_REGION],
+        sc_map.get(SPREAD_BY_FIELD_CLUSTER, SpreadConstraint()),
+        info.regions,
+    )
+
+
+def _select_by_groups(
+    group_sc: SpreadConstraint,
+    cluster_sc: SpreadConstraint,
+    groups_map: Dict[str, GroupInfo],
+) -> List[ClusterDetailInfo]:
+    """select_clusters_by_region.go:27-118, generalized over any group map
+    (regions, or label-value groups — the framework's SpreadByLabel
+    extension reuses the identical selection)."""
+    if len(groups_map) < group_sc.min_groups:
         raise UnschedulableError(
             "the number of feasible region is less than spreadConstraint.MinGroups"
         )
     groups = [
         _DfsGroup(name=g.name, value=len(g.clusters), weight=g.score)
-        for g in info.regions.values()
+        for g in groups_map.values()
     ]
     chosen = select_groups(
-        groups, region_sc.min_groups, region_sc.max_groups, cluster_sc.min_groups
+        groups, group_sc.min_groups, group_sc.max_groups, cluster_sc.min_groups
     )
     if not chosen:
         raise UnschedulableError(
             "the number of clusters is less than the cluster spreadConstraint.MinGroups"
         )
-    regions = [info.regions[g.name] for g in chosen]
+    picked = [groups_map[g.name] for g in chosen]
     selected: List[ClusterDetailInfo] = []
     candidates: List[ClusterDetailInfo] = []
-    for r in regions:
+    for r in picked:
         selected.append(r.clusters[0])
         candidates.extend(r.clusters[1:])
     need_cnt = len(candidates) + len(selected)

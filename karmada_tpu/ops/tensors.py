@@ -17,9 +17,12 @@ dedup axes of the domain:
     (pkg/estimator/client/general.go:336 math stays bit-equal via
     estimator/general.py).
 
-Bindings the kernel cannot represent (region/provider/zone spread
-constraints requiring the group-selection DFS, multi-component workloads)
-are routed back to the serial host path; `route` marks them.
+Bindings the kernel cannot represent (provider/zone-only spread selection,
+groupless topologies, vanished previous clusters, counts beyond every
+compact tier's exactness caps) are routed back to the serial host path;
+`route` marks them.  Region and spread-by-label topologies run the device
+spread pipeline (ops/spread.py) with no group-count ceiling; bindings
+beyond the tier-1 compact caps run the big lane tier (ROUTE_*_BIG).
 """
 
 from __future__ import annotations
@@ -62,16 +65,14 @@ STRAT_NON_WORKLOAD = 4
 
 # route reasons
 ROUTE_DEVICE = 0
-ROUTE_TOPOLOGY_SPREAD = 1  # provider/zone spread or >16 regions -> serial host
+ROUTE_TOPOLOGY_SPREAD = 1  # provider/zone-only spread, or no groups -> serial
 ROUTE_UNSUPPORTED = 3  # (2 was ROUTE_MULTI_COMPONENT, retired in r4)
 ROUTE_VANISHED_PREV = 4  # prev assignment names a cluster outside the snapshot
 ROUTE_HUGE_REPLICAS = 5  # replica count beyond the kernel's 2^25 cap
-ROUTE_DEVICE_SPREAD = 6  # region spread: device group math + host DFS
+ROUTE_DEVICE_SPREAD = 6  # region/label spread: device group math + host DFS
 ROUTE_COMPACT_CAP = 7  # beyond EVERY compact tier's exactness caps -> host
 ROUTE_DEVICE_BIG = 8  # beyond tier-1 caps: the big-tier device sub-solve
-
-# the device spread path enumerates region groups as fixed lanes
-MAX_DEVICE_REGIONS = 16
+ROUTE_DEVICE_SPREAD_BIG = 9  # spread whose assignment needs the big tier
 
 # the device kernel clamps seat targets at 2^25-1 (ops/solver._N_CAP) and
 # Webster weights at 2^34-1 (ops/solver._W_CAP); bindings above either cap
@@ -188,9 +189,11 @@ class SolverBatch:
     # host-side routing / metadata
     route: np.ndarray = field(default=None)  # int32[n_bindings] ROUTE_*
     cluster_index: ClusterIndex = field(default=None)
-    # region topology (device spread path, ops/spread.py)
+    # group topology (device spread path, ops/spread.py)
     region_id: np.ndarray = field(default=None)  # int32[C]; -1 = no region
     region_names: List[str] = field(default=None)  # vocabulary
+    # spread-by-label group axes: label key -> (group_id int32[C], values)
+    label_axes: Dict[str, Tuple[np.ndarray, List[str]]] = field(default=None)
     pl_has_region_sc: np.ndarray = field(default=None)  # bool[P]
     # out-of-tree score-plugin contributions (scheduler/plugins.py),
     # pre-clamped sums per (placement, cluster)
@@ -219,13 +222,14 @@ def _placement_key(p: Placement) -> str:
 
 def _route_for(
     spec: ResourceBindingSpec, placement: Placement, n_regions: int = 0,
-    compact: bool = False,
+    compact: bool = False, label_axis_fn=None,
 ) -> int:
     scs = placement.spread_constraints
     big = False
     if scs and not serial.should_ignore_spread_constraint(placement):
         has_region = has_cluster = has_other_field = False
-        cluster_max = 0
+        cluster_max = region_max = label_max = 0
+        label_key = None
         for sc in scs:
             if sc.spread_by_field in (
                 SPREAD_BY_FIELD_PROVIDER,
@@ -239,17 +243,31 @@ def _route_for(
                 has_other_field = True
             if sc.spread_by_field == SPREAD_BY_FIELD_REGION:
                 has_region = True
+                region_max = max(region_max, sc.max_groups)
             if sc.spread_by_field == SPREAD_BY_FIELD_CLUSTER:
                 has_cluster = True
                 cluster_max = max(cluster_max, sc.max_groups)
-            if sc.spread_by_label:
-                return ROUTE_UNSUPPORTED
-        if has_region:
-            # the spread pipeline's assignment runs tier-1 only
-            if compact and cluster_max > COMPACT_SELECTION_CAP:
+            if sc.spread_by_label and label_key is None:
+                # first label key is the group axis (ops/spread.py);
+                # further label constraints filter only
+                label_key = sc.spread_by_label
+                label_max = sc.max_groups
+        if has_region or label_key is not None:
+            # grouped-topology selection (region axis wins over label)
+            if has_region:
+                n_groups, group_max = n_regions, region_max
+            else:
+                n_groups = label_axis_fn(label_key) if label_axis_fn else 0
+                group_max = label_max
+            # the pick selects first-of-each-chosen-group plus extras up to
+            # the cluster constraint: its lane bound decides the tier
+            sel_bound = max(cluster_max, min(group_max, n_groups))
+            if compact and sel_bound > COMPACT_SELECTION_CAP_BIG:
                 return ROUTE_COMPACT_CAP
-            if 0 < n_regions <= MAX_DEVICE_REGIONS and len(spec.components) <= 1:
-                return ROUTE_DEVICE_SPREAD
+            spread_big = compact and sel_bound > COMPACT_SELECTION_CAP
+            if n_groups > 0 and len(spec.components) <= 1:
+                return (ROUTE_DEVICE_SPREAD_BIG if spread_big
+                        else ROUTE_DEVICE_SPREAD)
             return ROUTE_TOPOLOGY_SPREAD
         if compact and cluster_max > COMPACT_SELECTION_CAP:
             if cluster_max > COMPACT_SELECTION_CAP_BIG:
@@ -281,6 +299,39 @@ def _route_for(
 _ROUTE_PROBE_SPEC = ResourceBindingSpec()
 
 
+def spread_groups(batch: "SolverBatch", items) -> Dict[Tuple[str, str], List[int]]:
+    """Group a chunk's ROUTE_DEVICE_SPREAD(_BIG) bindings by (axis, tier)
+    — the unit of one ops/spread.solve_spread call (the group-id plane
+    differs per axis, the assignment lane budget per tier).  The single
+    authority all callers (scheduler service, bench, tests) share."""
+    groups: Dict[Tuple[str, str], List[int]] = {}
+    for i in range(batch.n_bindings):
+        r = batch.route[i]
+        if r in (ROUTE_DEVICE_SPREAD, ROUTE_DEVICE_SPREAD_BIG):
+            spec, status = items[i]
+            axis = spread_axis_of(serial.effective_placement(spec, status)) or ""
+            tier = "big" if r == ROUTE_DEVICE_SPREAD_BIG else "std"
+            groups.setdefault((axis, tier), []).append(i)
+    return groups
+
+
+def spread_axis_of(placement: Placement) -> Optional[str]:
+    """The group axis a ROUTE_DEVICE_SPREAD(_BIG) placement selects over:
+    "" = region (batch.region_id), a label key = batch.label_axes[key],
+    None = no grouped-topology selection.  Callers use it to group spread
+    bindings per solve_spread call (the group-id plane differs per axis)."""
+    scs = placement.spread_constraints
+    if not scs or serial.should_ignore_spread_constraint(placement):
+        return None
+    label_key = None
+    for sc in scs:
+        if sc.spread_by_field == SPREAD_BY_FIELD_REGION:
+            return ""
+        if sc.spread_by_label and label_key is None:
+            label_key = sc.spread_by_label
+    return label_key
+
+
 @dataclass
 class _SetClass:
     """Request class for a multi-template workload: capacity is counted in
@@ -310,6 +361,10 @@ class EncoderCache:
         self.placement_keys: Dict[int, Tuple[object, str]] = {}
         # cluster lane -> allowed pod count (snapshot-stable per cycle)
         self.pods_allowed: Optional[np.ndarray] = None
+        # spread-by-label group axes, keyed by label key (cluster labels
+        # are part of the owner's cache signature — scheduler/service.py
+        # builds a fresh cache when any cluster label changes)
+        self.label_rows: Dict[str, Tuple[np.ndarray, List[str]]] = {}
 
         # assembled cluster/placement tensor set, reused VERBATIM (same
         # numpy objects) across chunks whose vocabulary matches — the
@@ -422,6 +477,34 @@ def encode_batch(
     evict_entries: List[List[int]] = [[] for _ in range(B)]
 
     n_regions = len(region_names)
+    # spread-by-label group axes, built lazily per label key (O(C) each,
+    # memoized across chunks via the cache — cluster labels are stable
+    # within a cycle's snapshot)
+    label_axes: Dict[str, Tuple[np.ndarray, List[str]]] = {}
+
+    def label_axis(key: str) -> int:
+        entry = label_axes.get(key)
+        if entry is None:
+            entry = None if cache is None else cache.label_rows.get(key)
+            if entry is None:
+                gid = np.full(C, -1, np.int32)
+                values: List[str] = []
+                vids: Dict[str, int] = {}
+                for ci_, c_ in enumerate(clusters):
+                    v = c_.metadata.labels.get(key)
+                    if not v:
+                        continue
+                    vid = vids.get(v)
+                    if vid is None:
+                        vid = vids[v] = len(values)
+                        values.append(v)
+                    gid[ci_] = vid
+                entry = (gid, values)
+                if cache is not None:
+                    cache.label_rows[key] = entry
+            label_axes[key] = entry
+        return len(entry[1])
+
     # per-call pid -> placement-only route (spec-free: _route_for reads only
     # spec.components, empty on the common path)
     route_by_pid: Dict[int, int] = {}
@@ -431,7 +514,8 @@ def encode_batch(
     pid_route_by_id: Dict[int, tuple] = {}
     use_fast = [False]
     uids: List[str] = []
-    on_device = (ROUTE_DEVICE, ROUTE_DEVICE_SPREAD, ROUTE_DEVICE_BIG)
+    on_device = (ROUTE_DEVICE, ROUTE_DEVICE_SPREAD, ROUTE_DEVICE_BIG,
+                 ROUTE_DEVICE_SPREAD_BIG)
     cindex_get = cindex.index.get
     compact = C > COMPACT_LANES
     rep_cap = COMPACT_DIVISION_CAP if compact else KERNEL_REPLICA_CAP
@@ -459,12 +543,12 @@ def encode_batch(
             pid = pkeys[key] = len(placements)
             placements.append(placement)
             route_by_pid[pid] = _route_for(_ROUTE_PROBE_SPEC, placement,
-                                           n_regions, compact)
+                                           n_regions, compact, label_axis)
         if use_fast[0] and placement is spec.placement:
             pid_route_by_id[id(placement)] = (placement, pid, route_by_pid[pid])
         placement_id[b] = pid
         r = (route_by_pid[pid] if not spec.components
-             else _route_for(spec, placement, n_regions, compact))
+             else _route_for(spec, placement, n_regions, compact, label_axis))
 
         g = (spec.resource.api_version, spec.resource.kind)
         gid = gvks.get(g)
@@ -549,10 +633,13 @@ def encode_batch(
                      or nprev > COMPACT_PREV_CAP)
             over2 = ((divides and nrep > COMPACT_DIVISION_CAP_BIG)
                      or nprev > COMPACT_PREV_CAP_BIG)
-            if r == ROUTE_DEVICE_SPREAD:
-                # the spread pipeline's assignment runs tier-1 only
-                if over1:
+            if r in (ROUTE_DEVICE_SPREAD, ROUTE_DEVICE_SPREAD_BIG):
+                # the spread pipeline's assignment picks its tier like the
+                # main path: tier-1 caps -> big tier, big caps -> host
+                if over2:
                     r = ROUTE_COMPACT_CAP
+                elif over1:
+                    r = ROUTE_DEVICE_SPREAD_BIG
             elif over2:
                 r = ROUTE_COMPACT_CAP
             elif over1 or r == ROUTE_DEVICE_BIG:
@@ -621,7 +708,7 @@ def encode_batch(
             cache.assembled, B, C, nB, nC, b_valid, placement_id, gvk_id,
             class_id, replicas, uid_desc, fresh, non_workload, nw_shortcut,
             prev_idx, prev_val, evict_idx, route, cindex, region_names,
-            list(res_names), list(classes),
+            list(res_names), list(classes), label_axes,
         )
 
     # ---- capacity tensors -------------------------------------------------
@@ -726,6 +813,7 @@ def encode_batch(
         }.get(strategy, STRAT_DUPLICATED)
         pl_ignore_avail[p] = serial.should_ignore_available_resource(placement)
         if not serial.should_ignore_spread_constraint(placement):
+            label_sc = None
             for sc in placement.spread_constraints:
                 if sc.spread_by_field == SPREAD_BY_FIELD_CLUSTER:
                     pl_has_cluster_sc[p] = True
@@ -735,6 +823,13 @@ def encode_batch(
                     pl_has_region_sc[p] = True
                     pl_region_min[p] = sc.min_groups
                     pl_region_max[p] = sc.max_groups
+                elif sc.spread_by_label and label_sc is None:
+                    label_sc = sc
+            if label_sc is not None and not pl_has_region_sc[p]:
+                # label group axis (region wins when both are present —
+                # spread_axis_of): the group min/max rows are shared
+                pl_region_min[p] = label_sc.min_groups
+                pl_region_max[p] = label_sc.max_groups
 
         pkey = _placement_key(placement)
         rows = None if cache is None else cache.placement_rows.get(pkey)
@@ -826,7 +921,7 @@ def encode_batch(
         shared, B, C, nB, nC, b_valid, placement_id, gvk_id, class_id,
         replicas, uid_desc, fresh, non_workload, nw_shortcut,
         prev_idx, prev_val, evict_idx, route, cindex, region_names,
-        list(res_names), list(classes),
+        list(res_names), list(classes), label_axes,
     )
 
 
@@ -834,7 +929,7 @@ def _build_solver_batch(
     shared, B, C, nB, nC, b_valid, placement_id, gvk_id, class_id,
     replicas, uid_desc, fresh, non_workload, nw_shortcut,
     prev_idx, prev_val, evict_idx, route, cindex, region_names,
-    res_names=None, class_keys=None,
+    res_names=None, class_keys=None, label_axes=None,
 ) -> SolverBatch:
     return SolverBatch(
         B=B, C=C, n_bindings=nB, n_clusters=nC,
@@ -857,6 +952,7 @@ def _build_solver_batch(
         prev_idx=prev_idx, prev_val=prev_val, evict_idx=evict_idx,
         route=route, cluster_index=cindex,
         region_id=shared["region_id"], region_names=region_names,
+        label_axes=label_axes or {},
         pl_has_region_sc=shared["pl_has_region_sc"],
         pl_region_min=shared["pl_region_min"],
         pl_region_max=shared["pl_region_max"],

@@ -419,10 +419,8 @@ class Scheduler:
                 i for i in range(len(items))
                 if batch.route[i] == tensors.ROUTE_DEVICE
             ]
-            spread_idx = [
-                i for i in range(len(items))
-                if batch.route[i] == tensors.ROUTE_DEVICE_SPREAD
-            ]
+            spread_groups = tensors.spread_groups(batch, items)
+            spread_idx = [i for g in spread_groups.values() for i in g]
             big_idx = [
                 i for i in range(len(items))
                 if batch.route[i] == tensors.ROUTE_DEVICE_BIG
@@ -435,17 +433,19 @@ class Scheduler:
                     batch, waves=self.waves,
                     keep_sel=self.enable_empty_workload_propagation,
                 )
-            if spread_idx:
+            if spread_groups:
                 from karmada_tpu.ops.spread import solve_spread
 
                 t_sp = time.perf_counter()
-                for i, res in solve_spread(
-                    batch, items, spread_idx, waves=self.waves,
-                    enable_empty_workload_propagation=(
-                        self.enable_empty_workload_propagation
-                    ),
-                ).items():
-                    out[i] = res
+                for (axis, tier), idxs in spread_groups.items():
+                    for i, res in solve_spread(
+                        batch, items, idxs, waves=self.waves,
+                        enable_empty_workload_propagation=(
+                            self.enable_empty_workload_propagation
+                        ),
+                        axis=axis, tier=tier,
+                    ).items():
+                        out[i] = res
                 sched_metrics.STEP_LATENCY.observe(
                     time.perf_counter() - t_sp,
                     schedule_step=sched_metrics.STEP_SOLVE,
