@@ -35,44 +35,15 @@ import numpy as np
 # interrupted in-process), then — if healthy — run the bench in THIS process
 # against the same backend.  A persistent compilation cache (enabled below)
 # makes the in-process warm-up cheap across runs.  When the probe fails the
-# bench still runs on host CPU, but the result is marked unmissably
-# (metric prefixed CPU-FALLBACK, vs_baseline forced to 0): a number whose
-# hardware silently changed is worse than no number.
+# bench degrades to the fastest WORKING backend — the native C++ serial
+# pipeline, ~13x faster than XLA:CPU batched on this workload — and the
+# result is marked unmissably (metric prefixed CPU-FALLBACK, vs_baseline
+# forced to 0): a number whose hardware silently changed is worse than no
+# number, and a fallback slower than the serial loop it replaces is an
+# operational bug (the probe/resolution policy is shared with
+# `karmadactl serve` via karmada_tpu/utils/deviceprobe.py).
 
-_PROBE_SNIPPET = (
-    "import jax, jax.numpy as jnp;"
-    "d = jax.devices();"
-    "jax.jit(lambda a: a @ a)(jnp.ones((128, 128), jnp.bfloat16))"
-    ".block_until_ready();"
-    "print('PLATFORM=' + d[0].platform)"
-)
-
-
-def probe_backend(timeout_s: float = 330.0) -> dict:
-    """Probe default-backend health out-of-process. Returns a diagnostic dict."""
-    diag = {"ok": False, "platform": None, "attempts": []}
-    t0 = time.perf_counter()
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", _PROBE_SNIPPET],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-        elapsed = round(time.perf_counter() - t0, 1)
-        for line in r.stdout.splitlines():
-            if line.startswith("PLATFORM="):
-                diag.update(ok=True, platform=line.split("=", 1)[1])
-                diag["attempts"].append({"ok": True, "s": elapsed})
-                return diag
-        diag["attempts"].append({
-            "ok": False, "s": elapsed, "rc": r.returncode,
-            "err": (r.stderr or r.stdout)[-400:],
-        })
-    except subprocess.TimeoutExpired:
-        diag["attempts"].append({
-            "ok": False, "s": round(time.perf_counter() - t0, 1),
-            "err": f"probe timed out after {timeout_s}s (backend init hang)",
-        })
-    return diag
+from karmada_tpu.utils.deviceprobe import probe_backend  # noqa: F401 (re-export: watch_bench.py uses bench.probe_backend)
 
 
 def enable_persistent_compile_cache() -> None:
@@ -148,13 +119,14 @@ def config_sig(args, platform_kind: str) -> str:
 
 
 def load_ckpt(path: str, sig: str):
-    """Return (done: {chunk_idx: record}, rebalance_rec, prior_elapsed_s).
+    """Return (done: {chunk_idx: record}, prior_elapsed_s).
 
     prior_elapsed_s sums, per earlier session, that session's span (max
     t_rel among its chunks) — the honest elapsed contribution of work
-    already done.  Aggregate results are marked `resumed` downstream."""
+    already done.  Aggregate results are marked `resumed` downstream.
+    The forward and rebalance passes checkpoint under distinct sigs into
+    the same file."""
     done: Dict[int, dict] = {}
-    reb = None
     sessions: Dict[str, float] = {}
     try:
         with open(path) as f:
@@ -170,10 +142,6 @@ def load_ckpt(path: str, sig: str):
                     # recorded before it is retired
                     done.clear()
                     sessions.clear()
-                    reb = None
-                    continue
-                if rec.get("kind") == "rebalance":
-                    reb = rec
                     continue
                 ci = int(rec["ci"])
                 if ci in done:
@@ -185,7 +153,7 @@ def load_ckpt(path: str, sig: str):
                 sessions[s] = max(sessions.get(s, 0.0), float(rec["t_rel"]))
     except OSError:
         pass
-    return done, reb, sum(sessions.values())
+    return done, sum(sessions.values())
 
 
 class ChunkLog:
@@ -206,8 +174,13 @@ class ChunkLog:
             import fcntl
 
             # per-sig lock: concurrent runs of DIFFERENT configs are safe
-            # (append-only single-line writes, load filters by sig)
-            self._lockf = open(f"{path}.{sig[:40]}.lock", "w")
+            # (append-only single-line writes, load filters by sig); hash
+            # the sig so near-identical sigs (forward vs "-reb" rebalance
+            # pass) never truncate onto the same lock file
+            import hashlib
+
+            sig_tag = hashlib.sha1(sig.encode()).hexdigest()[:16]
+            self._lockf = open(f"{path}.{sig_tag}.lock", "w")
             fcntl.flock(self._lockf, fcntl.LOCK_EX | fcntl.LOCK_NB)
         except OSError:
             self.disabled = True
@@ -817,6 +790,189 @@ def run_serial_native(items, clusters):
     return elapsed, n_ok
 
 
+def _run_native_chunked(items, clusters, chunk: int, cal):
+    """Run the full scenario through the native C++ backend in
+    `chunk`-sized slices (same granularity as the device path, so the
+    p99 numbers are comparable).  Marshaling is input prep (the analog of
+    the reference reading informer caches / the device path's untimed
+    H2D) and is reported separately; the timed region is the solve.
+    Bindings the native pipeline marks UNSUPPORTED fall through to the
+    Python serial path exactly like scheduler/service.py, timed.
+
+    Returns (solve_s, marshal_s, ok, failures, chunk_lat)."""
+    from karmada_tpu import native as native_mod
+
+    snap = native_mod.NativeSnapshot(
+        clusters, native_mod.collect_res_names(items))
+    solve_s = marshal_s = 0.0
+    ok = 0
+    failures: Dict[str, int] = {}
+    chunk_lat = []
+    for lo in range(0, len(items), chunk):
+        part = items[lo : lo + chunk]
+        t0 = time.perf_counter()
+        nb = native_mod.marshal_batch(part, snap)
+        t1 = time.perf_counter()
+        results = native_mod.run_marshaled(nb, snap)
+        unsupported = [i for i, (st, _) in enumerate(results)
+                       if st == native_mod.STATUS_UNSUPPORTED]
+        for i in unsupported:
+            spec, status = part[i]
+            try:
+                serial.schedule(spec, status, clusters, cal)
+                results[i] = (native_mod.STATUS_OK, None)
+            except Exception as e:  # noqa: BLE001 — per-binding failure class
+                failures[type(e).__name__] = (
+                    failures.get(type(e).__name__, 0) + 1)
+                results[i] = (-1, None)
+        t2 = time.perf_counter()
+        marshal_s += t1 - t0
+        solve_s += t2 - t1
+        chunk_lat.append(t2 - t1)
+        for st, _ in results:
+            if st == native_mod.STATUS_OK:
+                ok += 1
+            elif st == native_mod.STATUS_UNSCHEDULABLE:
+                failures["UnschedulableError"] = (
+                    failures.get("UnschedulableError", 0) + 1)
+            elif st == native_mod.STATUS_FIT_ERROR:
+                failures["FitError"] = failures.get("FitError", 0) + 1
+            elif st == native_mod.STATUS_NO_CLUSTER:
+                failures["NoClusterAvailableError"] = (
+                    failures.get("NoClusterAvailableError", 0) + 1)
+        _hb(f"native chunk {lo // chunk + 1} done")
+    return solve_s, marshal_s, ok, failures, chunk_lat
+
+
+def measure_serial_controls(args, items, clusters, estimator) -> dict:
+    """Measure (or restore from cache) the serial control throughputs —
+    platform-independent pure host CPU work, measured once per config and
+    never allowed to spend a chip window.  Single authority for BOTH the
+    device bench and the native fallback (a drifted copy once mislabelled
+    a Python-speed control as the C++ Go-equivalent baseline)."""
+    serial_key = (f"b{args.bindings}-c{args.clusters}"
+                  f"-s{args.serial_sample}-{source_digest(_SERIAL_SOURCES)}")
+    cached = (None if args.fresh
+              else load_serial_cache(args.ckpt_dir, serial_key))
+    if cached is not None:
+        _hb("serial controls restored from cache")
+        return dict(cached, cached=True)
+    _hb("serial controls starting")
+    # prefer the C++ control (Go-equivalent); it is fast enough to run a
+    # much larger sample than the Python port
+    native_sample = items[
+        :: max(1, len(items) // (args.serial_sample * 32))][
+        : args.serial_sample * 32]
+    nat = run_serial_native(native_sample, clusters)
+    sample = items[:: max(1, len(items) // args.serial_sample)][
+        : args.serial_sample]
+    serial_elapsed, _ = run_serial(sample, clusters, estimator)
+    py_serial_bps = (len(sample) / serial_elapsed
+                     if serial_elapsed > 0 else 0.0)
+    native_ok = nat is not None and nat[0] > 0
+    if native_ok:
+        serial_bps = len(native_sample) / nat[0]
+        serial_lang = "c++ -O2 (native Go-equivalent control)"
+    else:
+        serial_bps = py_serial_bps
+        serial_lang = ("python (Go-port control; Go itself would be "
+                       "~10-100x faster)")
+    rec = {
+        "serial_bps": serial_bps, "py_serial_bps": py_serial_bps,
+        "serial_lang": serial_lang, "native_ok": native_ok,
+        "native_sample": len(native_sample) if native_ok else len(sample),
+        "py_sample": len(sample),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    save_serial_cache(args.ckpt_dir, serial_key, rec)
+    return dict(rec, cached=False)
+
+
+def run_native_fallback(args, rng, clusters, items, estimator, cindex,
+                        probe, platform) -> None:
+    """The no-accelerator bench path: measure the native C++ backend over
+    the FULL config (forward + rebalance), plus an XLA:CPU batched
+    subsample for comparison.  The headline is the fastest backend actually
+    available — `serve --backend device` degrades to native the same way
+    (utils/deviceprobe.resolve_backend), so this is what a production
+    deployment would really run on this host."""
+    cal = serial.make_cal_available([estimator])
+    _hb("native fallback: forward pass starting")
+    solve_s, marshal_s, ok, failures, chunk_lat = _run_native_chunked(
+        items, clusters, args.chunk, cal)
+    throughput = len(items) / solve_s if solve_s > 0 else 0.0
+    _hb(f"native fallback forward done: {throughput:.1f} bindings/s")
+
+    # descheduler rebalance loop (BASELINE config 5, second half) over ALL
+    # bindings — prev seats seed Steady scale-up/down and Fresh paths
+    reb_items = build_rebalance_items(rng, items, [c.name for c in clusters])
+    reb_solve_s, _, reb_ok, _, reb_lat = _run_native_chunked(
+        reb_items, clusters, args.chunk, cal)
+    reb_bps = len(reb_items) / reb_solve_s if reb_solve_s > 0 else 0.0
+    _hb(f"native fallback rebalance done: {reb_bps:.1f} bindings/s")
+
+    # XLA:CPU batched comparison subsample (the device program on host):
+    # reported so the reroute decision stays auditable round over round
+    xla_bps = None
+    n_xla = min(args.xla_cpu_sample, len(items))
+    if n_xla > 0:
+        cache = tensors.EncoderCache()
+        sample = items[:n_xla]
+        run_batched(sample[: args.chunk], cindex, estimator, args.chunk,
+                    cache, waves=args.waves)  # compile warmup
+        tail = n_xla % args.chunk
+        if tail:
+            run_batched(sample[:tail], cindex, estimator, args.chunk,
+                        cache, waves=args.waves)
+        xla_elapsed, _, _, _, _, _ = run_batched(
+            sample, cindex, estimator, args.chunk, cache, waves=args.waves)
+        xla_bps = n_xla / xla_elapsed if xla_elapsed > 0 else 0.0
+        _hb(f"XLA:CPU comparison sample done: {xla_bps:.1f} bindings/s")
+
+    # serial controls (cached off-window like the device path)
+    sc = measure_serial_controls(args, items, clusters, estimator)
+    serial_bps = sc["serial_bps"]
+    speedup = throughput / serial_bps if serial_bps > 0 else 0.0
+    payload = {
+        "metric": (f"CPU-FALLBACK (NOT TPU; native C++ backend) scheduled "
+                   f"bindings/sec, {args.bindings} bindings x "
+                   f"{args.clusters} clusters"),
+        "value": round(throughput, 1),
+        "unit": "bindings/s",
+        "vs_baseline": 0,  # not a TPU measurement, never reported as one
+        "detail": {
+            "platform": platform,
+            "fallback_backend": "native",
+            # the operational invariant VERDICT r4 demanded: the fallback
+            # must be at least as fast as the serial control it replaces
+            "cpu_fallback_speedup": round(speedup, 2),
+            "xla_cpu_batched_bps": (round(xla_bps, 1)
+                                    if xla_bps is not None else None),
+            "xla_cpu_sample": n_xla,
+            "backend_probe": probe,
+            "batched_solve_s": round(solve_s, 3),
+            "marshal_s": round(marshal_s, 3),
+            "p99_chunk_latency_s": round(
+                float(np.percentile(chunk_lat, 99)), 4) if chunk_lat else None,
+            "scheduled_ok": ok,
+            "failed_by_class": failures,
+            "rebalance_bindings_per_s": round(reb_bps, 1),
+            "rebalance_ok": reb_ok,
+            "rebalance_p99_chunk_s": round(
+                float(np.percentile(reb_lat, 99)), 4) if reb_lat else None,
+            "serial_bindings_per_s": round(serial_bps, 2),
+            "serial_python_bindings_per_s": round(sc["py_serial_bps"], 2),
+            "serial_sample": sc["native_sample"],
+            "serial_python_sample": sc["py_sample"],
+            "serial_cached": sc["cached"],
+            "chunk": args.chunk,
+            "waves": args.waves,
+            "serial_lang": sc["serial_lang"],
+        },
+    }
+    print(json.dumps(payload))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--bindings", type=int, default=100_000)
@@ -856,6 +1012,16 @@ def main() -> None:
                     help="exit nonzero instead of re-running on host CPU "
                          "when the device attempt hangs or dies (watcher "
                          "mode: checkpoints keep the finished chunks)")
+    ap.add_argument("--fallback-backend", choices=["native", "xla-cpu"],
+                    default="native",
+                    help="what to measure when no accelerator answers: the "
+                         "native C++ backend (the production serve reroute, "
+                         "~13x faster than the XLA program on host CPU) or "
+                         "the XLA:CPU batched path (exercises the device-"
+                         "path code end to end on host)")
+    ap.add_argument("--xla-cpu-sample", type=int, default=8192,
+                    help="bindings for the XLA:CPU batched comparison "
+                         "sample inside the native fallback (0 disables)")
     args = ap.parse_args()
     if args.quick:
         args.bindings, args.clusters, args.chunk = 2048, 256, 1024
@@ -884,6 +1050,13 @@ def main() -> None:
             force_cpu_fallback()
             platform = "cpu (fallback: device probe failed)"
     on_tpu = probe["ok"] and "tpu" in str(platform).lower()
+    # same accelerator vocabulary as serve's reroute policy: a live GPU run
+    # is a real device measurement (just not the TPU headline), only a
+    # CPU-or-dead probe degrades to the native fallback
+    from karmada_tpu.utils.deviceprobe import ACCELERATOR_PLATFORMS
+
+    on_accel = probe["ok"] and any(
+        p in str(platform).lower() for p in ACCELERATOR_PLATFORMS)
     _hb(f"probe done: platform={platform}")
 
     if (not on_tpu and not args.fresh
@@ -912,9 +1085,38 @@ def main() -> None:
     estimator = GeneralEstimator()
     cindex = tensors.ClusterIndex.build(clusters)
 
+    if not on_accel and args.fallback_backend == "native":
+        # no accelerator: measure what production would actually run here —
+        # serve's device backend degrades to the native C++ pipeline, so
+        # the fallback bench does too (XLA:CPU batched is measured as a
+        # labelled comparison subsample inside)
+        from karmada_tpu import native as native_mod
+
+        if native_mod.available():
+            try:
+                run_native_fallback(args, rng, clusters, items, estimator,
+                                    cindex, probe, platform)
+                return
+            except Exception as e:  # noqa: BLE001 — diagnostic trail
+                import traceback
+
+                print(json.dumps({
+                    "metric": "bench failed (native fallback)", "value": 0,
+                    "unit": "bindings/s", "vs_baseline": 0,
+                    "detail": {"error": repr(e),
+                               "trace_tail": traceback.format_exc()[-800:]},
+                }))
+                raise SystemExit(1)
+        print("[bench] native toolchain unavailable; falling back to the "
+              "XLA:CPU batched path", file=sys.stderr, flush=True)
+
     try:
         # resumable checkpoints: a relay drop mid-run costs one chunk
-        sig = config_sig(args, "tpu" if on_tpu else "cpu")
+        # three hardware kinds: chunks measured on different hardware must
+        # never fold into one aggregate on resume
+        sig = config_sig(
+            args, "tpu" if on_tpu else ("accel" if on_accel else "cpu"))
+        sig_reb = sig + "-reb"  # the rebalance pass checkpoints separately
         chunks_path = os.path.join(args.ckpt_dir, "chunks.jsonl")
         if args.fresh or args.carry:
             # --fresh bypasses checkpoint READS (and retires this sig's
@@ -922,19 +1124,23 @@ def main() -> None:
             # recorded so an interrupted fresh run resumes correctly.
             # --carry cannot resume (a skipped chunk's consumption would
             # vanish from the accounting).
-            ckpt_done, reb_rec, prior_elapsed = {}, None, 0.0
+            ckpt_done, prior_elapsed = {}, 0.0
+            reb_done, reb_prior = {}, 0.0
         else:
-            ckpt_done, reb_rec, prior_elapsed = load_ckpt(chunks_path, sig)
+            ckpt_done, prior_elapsed = load_ckpt(chunks_path, sig)
+            reb_done, reb_prior = load_ckpt(chunks_path, sig_reb)
         ckpt_log = (None if args.carry
                     else ChunkLog(chunks_path, sig, prune=args.fresh))
         n_chunks = (len(items) + args.chunk - 1) // args.chunk
         n_restored = sum(1 for ci in range(n_chunks) if ci in ckpt_done)
-        _hb(f"checkpoint: {n_restored}/{n_chunks} chunks restored"
+        n_reb_restored = sum(1 for ci in range(n_chunks) if ci in reb_done)
+        _hb(f"checkpoint: {n_restored}/{n_chunks} forward + "
+            f"{n_reb_restored}/{n_chunks} rebalance chunks restored"
             f" (+{prior_elapsed:.1f}s prior elapsed)")
 
         cache = tensors.EncoderCache()
         compile_s = 0.0
-        if n_restored < n_chunks or reb_rec is None:
+        if n_restored < n_chunks or n_reb_restored < n_chunks:
             # warmup: compile every chunk shape once (full + any tail shape)
             _hb("compile warmup starting")
             t_compile = time.perf_counter()
@@ -944,7 +1150,10 @@ def main() -> None:
                         estimator, args.chunk, cache, waves=args.waves,
                         carry=args.carry)
             tail = len(items) % args.chunk
-            if tail and (n_chunks - 1) not in ckpt_done:
+            # the tail shape is needed by BOTH the forward and rebalance
+            # passes — warm it unless both already checkpointed their tail
+            if tail and ((n_chunks - 1) not in ckpt_done
+                         or (n_chunks - 1) not in reb_done):
                 run_batched(items[:tail], cindex, estimator, args.chunk,
                             cache, waves=args.waves, carry=args.carry)
             compile_s = time.perf_counter() - t_compile
@@ -960,73 +1169,32 @@ def main() -> None:
         throughput = args.bindings / elapsed
         _hb(f"timed run done: {throughput:.1f} bindings/s")
 
-        # descheduler rebalance loop (BASELINE config 5, second half):
-        # one chunk of previously-scheduled bindings re-assigned with prev
-        # seats (Steady scale-up/down + Fresh reschedule triggers)
-        if reb_rec is not None:
-            rebalance_bps = float(reb_rec["bps"])
-            reb_ok = int(reb_rec["ok"])
-            _hb("rebalance restored from checkpoint")
-        else:
-            reb_items = build_rebalance_items(
-                rng, items[: args.chunk], [c.name for c in clusters])
-            cache.reset_for_cycle()
-            reb_elapsed, _, reb_ok, _, _, _ = run_batched(
-                reb_items, cindex, estimator, args.chunk, cache,
-                waves=args.waves)
-            rebalance_bps = (len(reb_items) / reb_elapsed
-                             if reb_elapsed > 0 else 0.0)
-            if ckpt_log is not None:
-                ckpt_log.append(kind="rebalance", ci=-1,
-                                bps=round(rebalance_bps, 2), ok=reb_ok)
+        # descheduler rebalance loop (BASELINE config 5, second half) over
+        # ALL bindings: previously-scheduled bindings re-assigned with prev
+        # seats (Steady scale-up/down + Fresh reschedule triggers),
+        # chunked + checkpointed exactly like the forward pass
+        _hb("rebalance pass starting")
+        reb_items = build_rebalance_items(
+            rng, items, [c.name for c in clusters])
+        reb_log = (None if args.carry
+                   else ChunkLog(chunks_path, sig_reb, prune=args.fresh))
+        cache.reset_for_cycle()
+        if reb_log is not None:
+            reb_log.reset_t0()
+        (reb_elapsed, _, reb_ok, reb_lat, _, reb_failures) = run_batched(
+            reb_items, cindex, estimator, args.chunk, cache,
+            waves=args.waves, ckpt_done=reb_done, ckpt_log=reb_log)
+        reb_elapsed += reb_prior
+        rebalance_bps = (len(reb_items) / reb_elapsed
+                         if reb_elapsed > 0 else 0.0)
+        _hb(f"rebalance pass done: {rebalance_bps:.1f} bindings/s")
 
         # serial controls are platform-independent (pure host CPU): measure
         # once per config, cache, and never spend a chip window on them
-        serial_key = (f"b{args.bindings}-c{args.clusters}"
-                      f"-s{args.serial_sample}-{source_digest(_SERIAL_SOURCES)}")
-        cached_serial = (None if args.fresh
-                         else load_serial_cache(args.ckpt_dir, serial_key))
-        if cached_serial is not None:
-            _hb("serial controls restored from cache")
-            serial_throughput = cached_serial["serial_bps"]
-            py_serial_throughput = cached_serial["py_serial_bps"]
-            serial_lang = cached_serial["serial_lang"]
-            native_ok = cached_serial["native_ok"]
-            n_native_sample = cached_serial["native_sample"]
-            n_py_sample = cached_serial["py_sample"]
-        else:
-            _hb("serial controls starting")
-            # prefer the C++ control (Go-equivalent); it is fast enough to
-            # run a much larger sample than the Python port
-            native_sample = items[
-                :: max(1, len(items) // (args.serial_sample * 32))][
-                : args.serial_sample * 32]
-            nat = run_serial_native(native_sample, clusters)
-            sample = items[:: max(1, len(items) // args.serial_sample)][
-                : args.serial_sample]
-            serial_elapsed, _ = run_serial(sample, clusters, estimator)
-            py_serial_throughput = (
-                len(sample) / serial_elapsed if serial_elapsed > 0 else 0.0
-            )
-            native_ok = nat is not None and nat[0] > 0
-            if native_ok:
-                serial_throughput = len(native_sample) / nat[0]
-                serial_lang = "c++ -O2 (native Go-equivalent control)"
-            else:
-                serial_throughput = py_serial_throughput
-                serial_lang = ("python (Go-port control; Go itself would be "
-                               "~10-100x faster)")
-            n_native_sample = len(native_sample) if native_ok else len(sample)
-            n_py_sample = len(sample)
-            save_serial_cache(args.ckpt_dir, serial_key, {
-                "serial_bps": serial_throughput,
-                "py_serial_bps": py_serial_throughput,
-                "serial_lang": serial_lang, "native_ok": native_ok,
-                "native_sample": n_native_sample, "py_sample": n_py_sample,
-                "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                             time.gmtime()),
-            })
-        speedup = throughput / serial_throughput if serial_throughput > 0 else 0.0
+        sc = measure_serial_controls(args, items, clusters, estimator)
+        serial_throughput = sc["serial_bps"]
+        speedup = (throughput / serial_throughput
+                   if serial_throughput > 0 else 0.0)
     except Exception as e:  # noqa: BLE001 — leave a diagnostic trail, not a traceback
         import traceback
 
@@ -1047,7 +1215,12 @@ def main() -> None:
     # a benchmark whose hardware silently changed is not a benchmark:
     # non-TPU results are labelled in the headline metric and report 0
     # speedup so no dashboard can mistake them for the real thing
-    prefix = "" if on_tpu else "CPU-FALLBACK (NOT TPU) "
+    if on_tpu:
+        prefix = ""
+    elif on_accel:
+        prefix = f"NON-TPU ACCELERATOR ({platform}) "
+    else:
+        prefix = "CPU-FALLBACK (NOT TPU) "
     payload = {
         "metric": f"{prefix}scheduled bindings/sec, {args.bindings} bindings x "
                   f"{args.clusters} clusters (end-to-end batched)",
@@ -1074,11 +1247,15 @@ def main() -> None:
             "failed_by_class": failures,
             "rebalance_bindings_per_s": round(rebalance_bps, 1),
             "rebalance_ok": reb_ok,
+            "rebalance_failed_by_class": reb_failures,
+            "rebalance_p99_chunk_s": round(
+                float(np.percentile(reb_lat, 99)), 4) if reb_lat else None,
+            "rebalance_resumed_chunks": n_reb_restored,
             "serial_bindings_per_s": round(serial_throughput, 2),
-            "serial_python_bindings_per_s": round(py_serial_throughput, 2),
-            "serial_sample": n_native_sample,
-            "serial_python_sample": n_py_sample,
-            "serial_cached": cached_serial is not None,
+            "serial_python_bindings_per_s": round(sc["py_serial_bps"], 2),
+            "serial_sample": sc["native_sample"],
+            "serial_python_sample": sc["py_sample"],
+            "serial_cached": sc["cached"],
             "chunk": args.chunk,
             # resumability: >0 restored chunks means this aggregate spans
             # multiple sessions (relay drops between them); elapsed sums
@@ -1090,7 +1267,7 @@ def main() -> None:
             # C++ serial scheduler (native/serial_solver.cc, golden-tested
             # against ops/serial.py) when the toolchain is available; the
             # Python port is reported alongside for continuity.
-            "serial_lang": serial_lang,
+            "serial_lang": sc["serial_lang"],
         },
     }
     print(json.dumps(payload))

@@ -32,12 +32,25 @@ VERSION = "karmada-tpu v0.4"
 
 
 def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
-                controllers: Optional[str] = None):
+                controllers: Optional[str] = None,
+                probe_device: bool = False, probe_timeout: float = 240.0):
     """controllers=None rehydrates the persisted --controllers spec; an
-    explicit spec is also persisted so later invocations honor it."""
+    explicit spec is also persisted so later invocations honor it.
+
+    probe_device=True (the long-lived serve path) health-checks the device
+    backend out-of-process first and degrades backend="device" to the
+    fastest working backend (native C++, else serial) when no accelerator
+    answers — the batched scheduler must never run slower than the serial
+    loop it replaces (utils/deviceprobe.resolve_backend)."""
     from karmada_tpu.e2e import ControlPlane
     from karmada_tpu.models.cluster import Cluster
 
+    if probe_device and backend == "device":
+        from karmada_tpu.utils.deviceprobe import resolve_backend
+
+        backend, diag = resolve_backend(backend, probe_timeout_s=probe_timeout)
+        if backend != "device":
+            print(f"WARNING: {diag['degraded']}", file=sys.stderr)
     cp = ControlPlane(backend=backend, persist_dir=directory, waves=waves,
                       controllers=controllers)
     if controllers is not None:
@@ -820,7 +833,9 @@ def cmd_serve(args) -> int:
 
     try:
         cp = _load_plane(args.dir, backend=args.backend, waves=args.waves,
-                         controllers=args.controllers)
+                         controllers=args.controllers,
+                         probe_device=not args.no_probe,
+                         probe_timeout=args.probe_timeout)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 1
@@ -852,7 +867,8 @@ def cmd_serve(args) -> int:
               f"karmadactl --server {api_url})")
     cp.runtime.serve()
     print(f"serving control plane from {args.dir} "
-          f"(backend={args.backend}, {len(cp.members)} members); ctrl-c to stop")
+          f"(backend={cp.scheduler.backend}, {len(cp.members)} members); "
+          "ctrl-c to stop")
     try:
         next_checkpoint = _time.time() + args.checkpoint_period
         while True:
@@ -1227,6 +1243,17 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--metrics-port", type=int, default=-1,
                     help="serve /metrics,/healthz,/readyz,/debug/state on "
                          "127.0.0.1:PORT (0 = ephemeral, -1 = disabled)")
+    sv.add_argument("--probe-timeout", type=float, default=240.0,
+                    help="device-backend health probe budget (seconds; "
+                         "matches the bench/watcher budgets — device init "
+                         "over the tunnel has been observed to need "
+                         "minutes); a failed probe reroutes --backend "
+                         "device to the native C++ backend instead of XLA "
+                         "on host CPU")
+    sv.add_argument("--no-probe", action="store_true",
+                    help="skip the device health probe and run --backend "
+                         "device on whatever platform jax initialises "
+                         "(tests / known-good hardware)")
     sv.add_argument("--api-port", type=int, default=-1,
                     help="serve the query plane (cluster proxy verbs, "
                          "search cache GET/LIST/WATCH, metrics adapter) "

@@ -49,6 +49,14 @@ def force_cpu(n_devices: int | None = None) -> bool:
 
         jax.config.update("jax_platforms", "cpu")
         if initialized:
+            # a backend that already IS the requested state (CPU platform
+            # with at least the requested device count — e.g. the test
+            # conftest pinned an 8-device mesh and a caller re-pins for 2)
+            # needs no warning: the pin is in effect, just not ours
+            devices = jax.devices()
+            if devices and devices[0].platform == "cpu" and (
+                    n_devices is None or len(devices) >= n_devices):
+                return True
             warnings.warn(
                 "jax backend already initialized before force_cpu(); the CPU "
                 "pin (and any virtual device count) may not take effect",
