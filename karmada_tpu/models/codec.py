@@ -92,13 +92,71 @@ def _load_dataclass(cls, data: Dict[str, Any]):
 
 def from_manifest_typed(manifest: Dict[str, Any]):
     """Decode a manifest into its registered typed model, or None when the
-    kind is not a registered API type (callers fall back to Unstructured)."""
+    kind is not a registered API type (callers fall back to Unstructured).
+
+    A manifest arriving at a registered SERVED (non-storage) version is
+    converted up to the storage version first (models/conversion.py) — the
+    decode half of the reference's CRD conversion webhook."""
     kind = manifest.get("kind")
     cls = model_registry().get(kind)
     if cls is None:
         return None
+    api_version = manifest.get("apiVersion")
+    if api_version and api_version != cls.API_VERSION:
+        from karmada_tpu.models.conversion import REGISTRY as conv
+
+        if not conv.served(kind, api_version):
+            # rejecting beats silently decoding version-specific fields
+            # into nothing (a v9 manifest's renamed field would vanish)
+            raise ValueError(
+                f"{kind} is not served at apiVersion {api_version!r}; "
+                f"served: {conv.served_versions(kind)}")
+        manifest = conv.to_storage(manifest)
     return _load_dataclass(cls, manifest)
 
 
 def registered_kind(kind: Optional[str]) -> bool:
     return kind in model_registry() if kind else False
+
+
+def _camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(p[:1].upper() + p[1:] for p in rest)
+
+
+def _dump_value(value):
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        out = {}
+        for f in dataclasses.fields(value):
+            v = getattr(value, f.name)
+            # lean manifests: omit fields still at their default (the
+            # loader refills them), keep everything the user set
+            if f.default is not dataclasses.MISSING and v == f.default:
+                continue
+            if (f.default_factory is not dataclasses.MISSING  # type: ignore[misc]
+                    and v == f.default_factory()):  # type: ignore[misc]
+                continue
+            out[_camel(f.name)] = _dump_value(v)
+        return out
+    if isinstance(value, Quantity):
+        return str(value)
+    if isinstance(value, list):
+        return [_dump_value(v) for v in value]
+    if isinstance(value, dict):
+        # mapping KEYS are data (resource names, label keys): never cameled
+        return {k: _dump_value(v) for k, v in value.items()}
+    return value
+
+
+def to_manifest_typed(obj, version: Optional[str] = None) -> Dict[str, Any]:
+    """Encode a typed model back into a camelCase manifest (inverse of
+    from_manifest_typed; field defaults are omitted).  `version` re-encodes
+    at a registered served version via models/conversion.py — the encode
+    half of the reference's CRD conversion webhook."""
+    manifest = {"apiVersion": type(obj).API_VERSION, "kind": type(obj).KIND}
+    manifest.update(_dump_value(obj))
+    if version and version != type(obj).API_VERSION:
+        from karmada_tpu.models.conversion import REGISTRY as conv
+
+        manifest = conv.convert(manifest, version)
+    return manifest

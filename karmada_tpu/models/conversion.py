@@ -1,0 +1,123 @@
+"""Multi-version API conversion registry (CRD conversion-webhook parity).
+
+The reference serves several versions per API group and converts between
+them through the webhook's `/convert` endpoint
+(/root/reference/cmd/webhook/app/webhook.go:186-232 wires
+ConversionReview handling; pkg/apis/work carries the v1alpha1/v1alpha2
+pair).  Evolving a live control plane's schema without rewriting stored
+objects is the capability; the machinery here is the k8s hub-and-spoke
+model made explicit:
+
+- every kind's dataclass in models/ IS the hub (storage) version — the
+  store holds exactly one representation, like etcd's storage version;
+- additional *served* versions register manifest-level up/down converters
+  (conversions are renames/moves of unstructured fields, exactly what a
+  CRD conversion webhook sees — it converts unstructured objects, not
+  typed ones);
+- ingress (codec.from_manifest_typed) converts served -> storage before
+  decoding; egress (codec.to_manifest_typed(version=...)) converts
+  storage -> served after encoding.  Reads and watches can therefore ask
+  for any served version while the store round-trips one schema.
+
+Served today: work.karmada.io/v1alpha1 `Work` is also served at
+work.karmada.io/v1alpha2, where `spec.suspendDispatching` is renamed to
+`spec.suspend` (the field-rename class of schema evolution).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+Manifest = Dict[str, Any]
+Converter = Callable[[Manifest], Manifest]
+
+
+class ConversionRegistry:
+    """(kind, served_version) -> up/down converters to the storage version."""
+
+    def __init__(self) -> None:
+        # (kind, version) -> (to_storage, from_storage)
+        self._by_version: Dict[Tuple[str, str], Tuple[Converter, Converter]] = {}
+
+    def register(self, kind: str, version: str,
+                 to_storage: Converter, from_storage: Converter) -> None:
+        self._by_version[(kind, version)] = (to_storage, from_storage)
+
+    def served(self, kind: str, version: str) -> bool:
+        if self._by_version.get((kind, version)) is not None:
+            return True
+        from karmada_tpu.models.codec import model_registry
+
+        cls = model_registry().get(kind)
+        return cls is not None and cls.API_VERSION == version
+
+    def served_versions(self, kind: str) -> List[str]:
+        from karmada_tpu.models.codec import model_registry
+
+        out = []
+        cls = model_registry().get(kind)
+        if cls is not None:
+            out.append(cls.API_VERSION)
+        out.extend(v for (k, v) in self._by_version if k == kind)
+        return out
+
+    def storage_version(self, kind: str) -> Optional[str]:
+        from karmada_tpu.models.codec import model_registry
+
+        cls = model_registry().get(kind)
+        return cls.API_VERSION if cls is not None else None
+
+    def to_storage(self, manifest: Manifest) -> Manifest:
+        """Convert a served-version manifest up to the storage version."""
+        kind = manifest.get("kind", "")
+        version = manifest.get("apiVersion", "")
+        if version == self.storage_version(kind):
+            return manifest
+        pair = self._by_version.get((kind, version))
+        if pair is None:
+            raise KeyError(f"{kind} has no served version {version!r}")
+        out = pair[0](copy.deepcopy(manifest))
+        out["apiVersion"] = self.storage_version(kind)
+        return out
+
+    def convert(self, manifest: Manifest, target_version: str) -> Manifest:
+        """The /convert verb: any served version -> any served version,
+        always routed through the storage hub (spoke-to-spoke conversions
+        compose the two halves — no N^2 converter matrix)."""
+        kind = manifest.get("kind", "")
+        if manifest.get("apiVersion") == target_version:
+            return manifest
+        hub = self.to_storage(manifest)
+        if target_version == self.storage_version(kind):
+            return hub
+        pair = self._by_version.get((kind, target_version))
+        if pair is None:
+            raise KeyError(f"{kind} has no served version {target_version!r}")
+        out = pair[1](copy.deepcopy(hub))
+        out["apiVersion"] = target_version
+        return out
+
+
+REGISTRY = ConversionRegistry()
+
+
+def _rename(spec: Manifest, old: str, new: str) -> None:
+    if old in spec:
+        spec[new] = spec.pop(old)
+
+
+def _work_v1alpha2_to_storage(m: Manifest) -> Manifest:
+    _rename(m.get("spec") or {}, "suspend", "suspendDispatching")
+    return m
+
+
+def _work_storage_to_v1alpha2(m: Manifest) -> Manifest:
+    _rename(m.get("spec") or {}, "suspendDispatching", "suspend")
+    return m
+
+
+WORK_V1ALPHA2 = "work.karmada.io/v1alpha2"
+
+REGISTRY.register("Work", WORK_V1ALPHA2,
+                  _work_v1alpha2_to_storage, _work_storage_to_v1alpha2)

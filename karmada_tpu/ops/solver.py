@@ -918,6 +918,17 @@ def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
             with_used, tier)
 
 
+def wait_compact(handle) -> None:
+    """Block until a dispatch_compact handle's device work finishes WITHOUT
+    copying anything to host: lets the scheduler service time the device
+    solve separately from the D2H copy (finalize_compact).  The rare
+    escalation re-solve (nnz overflow) still happens inside finalize and is
+    accounted to the D2H stage there."""
+    import jax
+
+    jax.block_until_ready(handle[3])
+
+
 def finalize_compact(handle):
     """Force a dispatch_compact handle: (idx, val, status, nnz) numpy —
     plus (used_milli, used_pods, used_sets) when dispatched with_used.

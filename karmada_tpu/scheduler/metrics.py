@@ -15,7 +15,9 @@ RESULT_UNSCHEDULABLE = "unschedulable"
 SCHEDULE_TYPE_RECONCILE = "reconcile"
 
 STEP_ENCODE = "Encode"
-STEP_SOLVE = "Solve"
+STEP_H2D = "H2D"      # host->device transfer + async launch (dispatch)
+STEP_SOLVE = "Solve"  # device execution wait
+STEP_D2H = "D2H"      # device->host result copy (+ rare nnz escalation)
 STEP_DECODE = "Decode"
 STEP_SERIAL = "Serial"
 

@@ -36,6 +36,7 @@ from karmada_tpu.ops.solver import (
     dispatch_compact,
     finalize_compact,
     solve_big,
+    wait_compact,
 )
 from karmada_tpu.webhook.admission import AdmissionDenied
 from karmada_tpu.scheduler import metrics as sched_metrics
@@ -429,9 +430,14 @@ class Scheduler:
             # it while the host walks the spread bindings' DFS ping-pong
             handle = None
             if device_idx:
+                t_h2d = time.perf_counter()
                 handle = dispatch_compact(
                     batch, waves=self.waves,
                     keep_sel=self.enable_empty_workload_propagation,
+                )
+                sched_metrics.STEP_LATENCY.observe(
+                    time.perf_counter() - t_h2d,
+                    schedule_step=sched_metrics.STEP_H2D,
                 )
             if spread_groups:
                 from karmada_tpu.ops.spread import solve_spread
@@ -466,9 +472,15 @@ class Scheduler:
                 )
             if device_idx:
                 t1 = time.perf_counter()
-                idx, val, status, _nnz = finalize_compact(handle)
+                wait_compact(handle)  # device execution wait ...
                 sched_metrics.STEP_LATENCY.observe(
                     time.perf_counter() - t1, schedule_step=sched_metrics.STEP_SOLVE
+                )
+                t_d2h = time.perf_counter()  # ... then the result copy
+                idx, val, status, _nnz = finalize_compact(handle)
+                sched_metrics.STEP_LATENCY.observe(
+                    time.perf_counter() - t_d2h,
+                    schedule_step=sched_metrics.STEP_D2H,
                 )
                 t2 = time.perf_counter()
                 decoded = tensors.decode_compact(

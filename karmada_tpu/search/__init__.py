@@ -2,6 +2,7 @@ from karmada_tpu.search.cache import CACHED_FROM_ANNOTATION, MultiClusterCache
 from karmada_tpu.search.proxy import ClusterProxy, ProxyDenied, UnifiedAuthController
 from karmada_tpu.search.metrics_adapter import MultiClusterMetricsProvider
 from karmada_tpu.search import fts as _fts  # registers the SqliteFTS factory
+from karmada_tpu.search import remote as _remote  # registers RemoteTCP
 
 __all__ = [
     "CACHED_FROM_ANNOTATION",

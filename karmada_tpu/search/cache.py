@@ -195,6 +195,13 @@ class MultiClusterCache:
                 and (cluster is None or c == cluster)
             ]
 
+    def has_kind(self, kind: str) -> bool:
+        """Whether any registry currently selects this kind (the proxy
+        cache plugin's SupportRequest: cached GVRs are served from here,
+        everything else falls through the chain)."""
+        with self._lock:
+            return any(k == kind for (_, k) in self._selected)
+
     def watch(self, handler: Callable[[str, Unstructured, str], None]) -> None:
         """handler(event_type, obj, cluster) on every cached change."""
         self._watchers.append(handler)

@@ -51,7 +51,15 @@ from urllib.parse import parse_qs, urlparse
 from karmada_tpu.search.proxy import ProxyDenied
 
 
-def _manifest_of(obj) -> dict:
+def _manifest_of(obj, version: Optional[str] = None) -> dict:
+    """Encode an object for the wire; `version` re-encodes typed models at
+    a served API version (models/conversion.py) — the read half of
+    multi-version serving."""
+    from karmada_tpu.models.codec import registered_kind, to_manifest_typed
+
+    if registered_kind(getattr(obj, "KIND", None)) and not hasattr(
+            obj, "to_manifest"):
+        return to_manifest_typed(obj, version=version)
     if hasattr(obj, "to_manifest"):
         return obj.to_manifest()
     return json.loads(json.dumps(obj.__dict__, default=str))
@@ -62,7 +70,8 @@ class QueryPlaneServer:
 
     def __init__(self, store, members, cluster_proxy, search_cache=None,
                  metrics_provider=None, registry=None, apply_fn=None,
-                 auth=None) -> None:
+                 auth=None, proxy_plugins=None) -> None:
+        from karmada_tpu.search.proxyframework import default_registry
         from karmada_tpu.utils.metrics import REGISTRY
 
         self.store = store
@@ -71,6 +80,12 @@ class QueryPlaneServer:
         self.search_cache = search_cache
         self.metrics_provider = metrics_provider
         self.registry = registry if registry is not None else REGISTRY
+        # resource reads route through the proxy plugin chain (cache ->
+        # cluster -> karmada, out-of-tree plugins interpose by order);
+        # pass a ProxyPluginRegistry to customize
+        self.proxy_plugins = (proxy_plugins if proxy_plugins is not None
+                              else default_registry(store, cluster_proxy,
+                                                    search_cache))
         # control-plane writes (karmadactl --server apply/delete): the
         # plane's apply entry (typed codec + admission); None = read-only.
         # `auth` (UnifiedAuthController) gates writes by the X-Karmada-User
@@ -109,18 +124,28 @@ class QueryPlaneServer:
                                       body, subject)
 
         if parts[:2] == ["search", "cache"] and self.search_cache is not None:
-            cluster = (query.get("cluster") or [None])[0]
-            ns = (query.get("namespace") or [None])[0]
+            # resource reads run the proxy plugin chain: the cache plugin
+            # serves registry-cached kinds, everything else falls through
+            # (cluster / karmada / out-of-tree interposers, by order)
+            from karmada_tpu.search.proxyframework import ProxyRequest
+
+            flat = {k: v[0] for k, v in query.items()}
             if len(parts) == 3 and method == "GET":
-                objs = self.search_cache.list(parts[2], namespace=ns,
-                                              cluster=cluster)
-                return 200, [o.to_manifest() for o in objs]
+                handler = self.proxy_plugins.route(ProxyRequest(
+                    verb="list", kind=parts[2],
+                    namespace=flat.get("namespace", ""), query=flat))
+                if handler is None:
+                    return 404, {"error": "no proxy plugin supports this "
+                                          "request"}
+                return handler()
             if len(parts) == 5 and method == "GET":
-                obj = self.search_cache.get(parts[2], parts[3], parts[4],
-                                            cluster=cluster)
-                if obj is None:
-                    return 404, {"error": "not found"}
-                return 200, obj.to_manifest()
+                handler = self.proxy_plugins.route(ProxyRequest(
+                    verb="get", kind=parts[2], namespace=parts[3],
+                    name=parts[4], query=flat))
+                if handler is None:
+                    return 404, {"error": "no proxy plugin supports this "
+                                          "request"}
+                return handler()
 
         if parts[:2] == ["search", "watch"] and self.search_cache is not None:
             timeout = float((query.get("timeout") or ["5"])[0])
@@ -216,18 +241,63 @@ class QueryPlaneServer:
                 return 422, {"error": str(e)}
             return 200, {"deleted": True}
 
-        if parts[:1] == ["api"] and method == "GET":
+        if parts[:1] == ["api"] and method == "GET" and len(parts) >= 2:
             ns = (query.get("namespace") or [None])[0]
+            # ?version= serves any registered API version of the kind
+            # (multi-version read; models/conversion.py)
+            version = (query.get("version") or [None])[0]
+            if version is not None:
+                from karmada_tpu.models.conversion import REGISTRY as conv
+
+                if not conv.served(parts[1], version):
+                    return 400, {"error": f"{parts[1]} is not served at "
+                                          f"{version!r}; served: "
+                                          f"{conv.served_versions(parts[1])}"}
             if len(parts) == 2:
                 objs = self.store.list(parts[1], ns)
-                return 200, [_manifest_of(o) for o in objs]
+                return 200, [_manifest_of(o, version) for o in objs]
             if len(parts) in (3, 4):
                 # len 3: cluster-scoped get (empty namespace)
                 get_ns = parts[2] if len(parts) == 4 else ""
                 o = self.store.try_get(parts[1], get_ns, parts[-1])
                 if o is None:
                     return 404, {"error": "not found"}
-                return 200, _manifest_of(o)
+                return 200, _manifest_of(o, version)
+
+        if parts[:1] == ["api-watch"] and len(parts) == 2 and method == "GET":
+            # control-plane store WATCH, servable at any registered version.
+            # Validate the version HERE: the watch handler runs on store
+            # writer threads, where a conversion KeyError would break
+            # control-plane writes, not just this request.
+            timeout = float((query.get("timeout") or ["5"])[0])
+            version = (query.get("version") or [None])[0]
+            if version is not None:
+                from karmada_tpu.models.conversion import REGISTRY as conv
+
+                if not conv.served(parts[1], version):
+                    return 400, {"error": f"{parts[1]} is not served at "
+                                          f"{version!r}; served: "
+                                          f"{conv.served_versions(parts[1])}"}
+            return "stream", self._store_watch_stream(
+                parts[1], timeout, version)
+
+        if path == "/convert" and method == "POST":
+            # the CRD conversion-webhook verb (ConversionReview equivalent:
+            # desiredAPIVersion + objects in, converted objects out)
+            from karmada_tpu.models.conversion import REGISTRY as conv
+
+            desired = (body or {}).get("desiredAPIVersion")
+            objs = (body or {}).get("objects")
+            if not desired or not isinstance(objs, list):
+                return 400, {"error": "desiredAPIVersion and objects[] "
+                                      "required"}
+            converted = []
+            for m in objs:
+                try:
+                    converted.append(conv.convert(m, desired))
+                except KeyError as e:
+                    return 422, {"error": str(e)}
+            return 200, {"objects": converted}
 
         if parts[:1] == ["api-table"] and len(parts) == 2 and method == "GET":
             from karmada_tpu.printers import table_for
@@ -241,11 +311,32 @@ class QueryPlaneServer:
         return 404, {"error": f"no route for {method} {path}"}
 
     def _handle_proxy(self, method, cluster, rest, query, body, subject):
+        ns = (query.get("namespace") or [None])[0]
+        # resource GETs run the proxy plugin chain (the ClusterPlugin does
+        # its own authenticated connect); the chain exhausting means no
+        # plugin — in-tree or interposed — claimed the request
+        if method == "GET" and len(rest) in (1, 2, 3) and rest[:1] not in (
+                ["pods"], ["logs"]):
+            from karmada_tpu.search.proxyframework import ProxyRequest
+
+            if len(rest) == 1:
+                req = ProxyRequest(verb="list", kind=rest[0],
+                                   namespace=ns or "", cluster=cluster,
+                                   query={"subject": subject})
+            else:
+                # len 2: cluster-scoped get (empty namespace)
+                req = ProxyRequest(verb="get", kind=rest[0],
+                                   namespace=rest[1] if len(rest) == 3 else "",
+                                   name=rest[-1], cluster=cluster,
+                                   query={"subject": subject})
+            handler_fn = self.proxy_plugins.route(req)
+            if handler_fn is None:
+                return 404, {"error": "no proxy plugin supports this request"}
+            return handler_fn()
         try:
             handle = self.cluster_proxy.connect(cluster, subject=subject)
         except ProxyDenied as e:
             return 403, {"error": str(e)}
-        ns = (query.get("namespace") or [None])[0]
         if method == "GET" and rest[:1] == ["pods"]:
             return 200, handle.pods(ns)
         if method == "GET" and rest[:1] == ["logs"] and len(rest) == 3:
@@ -268,20 +359,41 @@ class QueryPlaneServer:
                 return 400, {"error": "manifest body required"}
             obj = handle.apply(body)
             return 200, obj.to_manifest()
-        if method == "GET" and len(rest) == 1:
-            return 200, [o.to_manifest() for o in handle.list(rest[0], ns)]
-        if method == "GET" and len(rest) in (2, 3):
-            # len 2: cluster-scoped get (empty namespace)
-            get_ns = rest[1] if len(rest) == 3 else ""
-            obj = handle.get(rest[0], get_ns, rest[-1])
-            if obj is None:
-                return 404, {"error": "not found"}
-            return 200, obj.to_manifest()
         if method == "DELETE" and len(rest) in (2, 3):
             handle.delete(rest[0], rest[1] if len(rest) == 3 else "",
                           rest[-1])
             return 200, {"deleted": True}
         return 404, {"error": f"no proxy route for {method} /{'/'.join(rest)}"}
+
+    def _store_watch_stream(self, kind: str, timeout: float,
+                            version: Optional[str]):
+        """JSON-lines watch over control-plane store events for one kind,
+        each object encoded at the requested served version."""
+        q: "queue.Queue" = queue.Queue()
+
+        def handler(event) -> None:
+            if event.kind == kind:
+                q.put({"type": event.type,
+                       "object": _manifest_of(event.obj, version)})
+
+        self.store.bus.subscribe(handler)
+
+        def gen():
+            deadline = time.monotonic() + timeout
+            try:
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return
+                    try:
+                        item = q.get(timeout=min(remaining, 0.25))
+                    except queue.Empty:
+                        continue
+                    yield (json.dumps(item, default=str) + "\n").encode()
+            finally:
+                self.store.bus.unsubscribe(handler)
+
+        return gen()
 
     def _watch_stream(self, timeout: float):
         """JSON-lines generator over cache events for up to `timeout` s

@@ -1,0 +1,209 @@
+"""Search-proxy plugin framework (chain-of-responsibility over resources).
+
+Reference: pkg/search/proxy/framework/interface.go (Plugin = Connect +
+Order + SupportRequest) with the registry/chain wired in
+pkg/search/proxy/controller.go:79-248 — ordered plugins, ONE plugin
+handles each request: the first (smallest Order) whose SupportRequest
+says yes.  In-tree plugins live in proxy/framework/plugins/{cache,
+cluster,karmada}: serve from the multi-cluster cache, forward to a member
+cluster, fall back to the karmada control plane.
+
+Same shape as the scheduler's out-of-tree registry
+(scheduler/plugins.py): named registration, `*,-Name` enablement, and an
+interposition seam — an out-of-tree plugin with a smaller order sees the
+request before any in-tree plugin.  Handlers return `(code, payload)`
+directly (the repo's query plane speaks JSON-over-HTTP, not http.Handler).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+Handler = Callable[[], Tuple[int, object]]
+
+
+@dataclass
+class ProxyRequest:
+    """What the chain routes on (framework.ProxyRequest: GVR + verb +
+    request parts)."""
+
+    verb: str                 # get | list
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    cluster: str = ""         # "" = not member-scoped
+    query: Dict[str, str] = field(default_factory=dict)
+
+
+class ProxyPlugin:
+    """Base plugin: subclass or duck-type (name/order/support/connect)."""
+
+    name = ""
+    order = 1000
+
+    def support(self, req: ProxyRequest) -> bool:  # pragma: no cover
+        raise NotImplementedError
+
+    def connect(self, req: ProxyRequest) -> Handler:  # pragma: no cover
+        raise NotImplementedError
+
+
+class ProxyPluginRegistry:
+    """Ordered plugin chain with `*,-Name` enablement (same flag grammar as
+    scheduler --plugins)."""
+
+    def __init__(self) -> None:
+        self._plugins: Dict[str, ProxyPlugin] = {}
+        self._star = True
+        self._on: set = set()
+        self._off: set = set()
+        self._lock = threading.Lock()
+
+    def register(self, plugin: ProxyPlugin) -> None:
+        if not plugin.name:
+            raise ValueError("plugin needs a name")
+        with self._lock:
+            self._plugins[plugin.name] = plugin
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._plugins.pop(name, None)
+
+    def set_enablement(self, spec: str) -> None:
+        star, on, off = False, set(), set()
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if part == "*":
+                star = True
+            elif part.startswith("-"):
+                off.add(part[1:])
+            else:
+                on.add(part)
+        with self._lock:
+            self._star, self._on, self._off = star, on, off
+
+    def _enabled(self, name: str) -> bool:
+        if name in self._off:
+            return False
+        return self._star or name in self._on
+
+    def chain(self) -> List[ProxyPlugin]:
+        with self._lock:
+            enabled = [p for n, p in self._plugins.items() if self._enabled(n)]
+        return sorted(enabled, key=lambda p: (p.order, p.name))
+
+    def route(self, req: ProxyRequest) -> Optional[Handler]:
+        """First supporting plugin in order wins (controller.go's connect
+        walk); None when the chain is exhausted."""
+        for plugin in self.chain():
+            if plugin.support(req):
+                return plugin.connect(req)
+        return None
+
+
+# -- in-tree plugins (proxy/framework/plugins/{cache,cluster,karmada}) ------
+
+
+class CachePlugin(ProxyPlugin):
+    """Serve control-plane-scoped reads of CACHED kinds from the
+    multi-cluster cache (plugins/cache: order 0)."""
+
+    name = "Cache"
+    order = 0
+
+    def __init__(self, search_cache) -> None:
+        self.cache = search_cache
+
+    def support(self, req: ProxyRequest) -> bool:
+        return (self.cache is not None and not req.cluster
+                and req.verb in ("get", "list")
+                and self.cache.has_kind(req.kind))
+
+    def connect(self, req: ProxyRequest) -> Handler:
+        def handler():
+            cluster = req.query.get("cluster") or None
+            if req.verb == "list":
+                objs = self.cache.list(req.kind, namespace=req.namespace or None,
+                                       cluster=cluster)
+                return 200, [o.to_manifest() for o in objs]
+            obj = self.cache.get(req.kind, req.namespace, req.name,
+                                 cluster=cluster)
+            if obj is None:
+                return 404, {"error": "not found"}
+            return 200, obj.to_manifest()
+        return handler
+
+
+class ClusterPlugin(ProxyPlugin):
+    """Forward member-scoped requests to that member through the
+    authenticated cluster proxy (plugins/cluster: order 1000)."""
+
+    name = "Cluster"
+    order = 1000
+
+    def __init__(self, cluster_proxy) -> None:
+        self.proxy = cluster_proxy
+
+    def support(self, req: ProxyRequest) -> bool:
+        return bool(req.cluster) and req.verb in ("get", "list")
+
+    def connect(self, req: ProxyRequest) -> Handler:
+        def handler():
+            from karmada_tpu.search.proxy import ProxyDenied
+
+            try:
+                handle = self.proxy.connect(
+                    req.cluster, subject=req.query.get("subject",
+                                                       "system:admin"))
+            except ProxyDenied as e:
+                return 403, {"error": str(e)}
+            if req.verb == "list":
+                return 200, [o.to_manifest()
+                             for o in handle.list(req.kind,
+                                                  req.namespace or None)]
+            obj = handle.get(req.kind, req.namespace, req.name)
+            if obj is None:
+                return 404, {"error": "not found"}
+            return 200, obj.to_manifest()
+        return handler
+
+
+class KarmadaPlugin(ProxyPlugin):
+    """Terminal fallback: the karmada control plane's own store
+    (plugins/karmada: the largest order, supports everything
+    control-plane-scoped)."""
+
+    name = "Karmada"
+    order = 2000
+
+    def __init__(self, store) -> None:
+        self.store = store
+
+    def support(self, req: ProxyRequest) -> bool:
+        return not req.cluster and req.verb in ("get", "list")
+
+    def connect(self, req: ProxyRequest) -> Handler:
+        def handler():
+            from karmada_tpu.search.httpapi import _manifest_of
+
+            if req.verb == "list":
+                objs = self.store.list(req.kind, req.namespace or None)
+                return 200, [_manifest_of(o) for o in objs]
+            o = self.store.try_get(req.kind, req.namespace, req.name)
+            if o is None:
+                return 404, {"error": "not found"}
+            return 200, _manifest_of(o)
+        return handler
+
+
+def default_registry(store, cluster_proxy, search_cache) -> ProxyPluginRegistry:
+    """The in-tree chain the aggregated query plane runs."""
+    reg = ProxyPluginRegistry()
+    reg.register(CachePlugin(search_cache))
+    reg.register(ClusterPlugin(cluster_proxy))
+    reg.register(KarmadaPlugin(store))
+    return reg

@@ -1,0 +1,109 @@
+"""Networked external search sink (the OpenSearch-shaped backend).
+
+Reference: pkg/search/backendstore/opensearch.go:127-193 — an OFFBOARD
+engine behind a real network protocol receiving every cached
+upsert/delete and answering queries.  The repo's framed-TCP transport
+(estimator/wire.py: length-prefixed JSON frames, optional TLS) plays the
+role of the OpenSearch REST client; any BackendStore (typically the
+sqlite-FTS engine, search/fts.py) can be served remotely.
+
+Config: ``BackendStoreConfig(kind="RemoteTCP",
+addresses=["host:port", ...])`` — first reachable address wins, like the
+reference's multi-address OpenSearch client config.
+
+Server side: ``serve_backend(backend)`` exposes upsert/delete/query/count
+as wire methods; run it in the search process or a standalone sidecar.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from karmada_tpu.estimator.wire import TcpTransport, serve_tcp
+from karmada_tpu.models.search import BackendStoreConfig
+from karmada_tpu.models.unstructured import Unstructured
+from karmada_tpu.search.backend import BackendStore, register_backend_factory
+
+
+def serve_backend(backend: BackendStore, host: str = "127.0.0.1",
+                  port: int = 0, ssl_context=None):
+    """Serve a local BackendStore over framed TCP; returns the server
+    (``server_address`` carries the bound port; ``shutdown()`` stops it)."""
+
+    def dispatch(method: str, body: dict) -> dict:
+        if method == "upsert":
+            backend.upsert(body["cluster"],
+                           Unstructured.from_manifest(body["object"]))
+            return {"ok": True}
+        if method == "delete":
+            backend.delete(body["cluster"],
+                           Unstructured.from_manifest(body["object"]))
+            return {"ok": True}
+        if method == "query":
+            if not hasattr(backend, "query"):
+                raise RuntimeError("backend is not queryable")
+            return {"hits": backend.query(body.get("q", ""),
+                                          kind=body.get("kind"),
+                                          cluster=body.get("cluster"))}
+        if method == "count":
+            return {"count": backend.count()
+                    if hasattr(backend, "count") else -1}
+        raise RuntimeError(f"unknown method {method!r}")
+
+    return serve_tcp(dispatch, host=host, port=port, ssl_context=ssl_context)
+
+
+class RemoteTcpBackend(BackendStore):
+    """Client half: a BackendStore whose sink lives across a socket.
+
+    Delivery is at-least-once per process lifetime with one reconnect
+    attempt per call (TcpTransport); a sink outage raises out of
+    upsert/delete and the cache logs-and-continues exactly as it would for
+    a down OpenSearch."""
+
+    def __init__(self, addresses: List[str], ssl_context=None,
+                 timeout: float = 5.0) -> None:
+        if not addresses:
+            raise ValueError("RemoteTCP backend needs at least one address")
+        last: Optional[Exception] = None
+        self.transport = None
+        for addr in addresses:
+            host, _, port = addr.rpartition(":")
+            t = TcpTransport(host or "127.0.0.1", int(port),
+                             ssl_context=ssl_context, timeout=timeout)
+            try:
+                t.call("count", {})  # reachability probe
+            except Exception as e:  # noqa: BLE001 — try the next address
+                last = e
+                continue
+            self.transport = t
+            break
+        if self.transport is None:
+            raise ConnectionError(
+                f"no reachable sink among {addresses}: {last}")
+
+    def upsert(self, cluster: str, obj: Unstructured) -> None:
+        self.transport.call("upsert", {"cluster": cluster,
+                                       "object": obj.to_manifest()})
+
+    def delete(self, cluster: str, obj: Unstructured) -> None:
+        self.transport.call("delete", {"cluster": cluster,
+                                       "object": obj.to_manifest()})
+
+    def query(self, text: str, kind: Optional[str] = None,
+              cluster: Optional[str] = None) -> List[dict]:
+        return self.transport.call(
+            "query", {"q": text, "kind": kind, "cluster": cluster})["hits"]
+
+    def count(self) -> int:
+        return int(self.transport.call("count", {})["count"])
+
+    def close(self) -> None:
+        self.transport.close()
+
+
+def _factory(cfg: BackendStoreConfig) -> RemoteTcpBackend:
+    return RemoteTcpBackend(cfg.addresses)
+
+
+register_backend_factory("RemoteTCP", _factory)

@@ -6,12 +6,55 @@ import json
 import urllib.request
 
 from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ResourceSelector,
+)
 from karmada_tpu.utils.httpserve import ObservabilityServer
 
 
 def fetch(url):
     with urllib.request.urlopen(url, timeout=5) as r:
         return r.status, r.read().decode()
+
+
+def test_device_solver_stage_histograms_visible_at_metrics():
+    """A production operator of the batched design watches per-stage solver
+    latency (reference pkg/scheduler/metrics/metrics.go:93-142 publishes
+    per-step histograms): after one served cycle through the DEVICE
+    backend, /metrics must expose every pipeline stage — Encode, H2D
+    (dispatch), Solve (device wait), D2H (result copy), Decode."""
+    cp = ControlPlane(backend="device")
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.tick()
+    cp.apply_policy(PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(api_version="apps/v1",
+                                                 kind="Deployment")],
+            placement=Placement(),
+        ),
+    ))
+    cp.apply({"apiVersion": "apps/v1", "kind": "Deployment",
+              "metadata": {"name": "app", "namespace": "default"},
+              "spec": {"replicas": 2, "template": {"spec": {"containers": [
+                  {"name": "a", "resources": {"requests": {"cpu": "100m"}}}]}}}})
+    cp.tick()
+    assert cp.store.get("ResourceBinding", "default", "app-deployment").spec.clusters
+
+    srv = ObservabilityServer(store=cp.store)
+    base = srv.start()
+    try:
+        _, body = fetch(base + "/metrics")
+    finally:
+        srv.stop()
+    for stage in ("Encode", "H2D", "Solve", "D2H", "Decode"):
+        needle = ("karmada_scheduler_scheduling_algorithm_duration_seconds_count"
+                  f'{{schedule_step="{stage}"}}')
+        assert needle in body, f"stage {stage} missing from /metrics"
 
 
 def test_endpoints_serve_metrics_health_and_state():

@@ -1,0 +1,217 @@
+"""Search-proxy plugin chain + networked external search sink.
+
+Reference: pkg/search/proxy/framework (ordered chain of responsibility,
+one plugin handles each request) and pkg/search/backendstore/
+opensearch.go:127-193 (the offboard network-protocol sink).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karmada_tpu.models.search import BackendStoreConfig
+from karmada_tpu.models.unstructured import Unstructured
+from karmada_tpu.search.backend import make_backend
+from karmada_tpu.search.fts import SqliteFTSBackend
+from karmada_tpu.search.proxyframework import (
+    ProxyPlugin,
+    ProxyPluginRegistry,
+    ProxyRequest,
+    default_registry,
+)
+from karmada_tpu.search.remote import RemoteTcpBackend, serve_backend
+
+
+class _Recorder(ProxyPlugin):
+    def __init__(self, name, order, supports=True, payload=None):
+        self.name, self.order = name, order
+        self.supports = supports
+        self.payload = payload if payload is not None else {"by": name}
+        self.connects = 0
+
+    def support(self, req):
+        return self.supports
+
+    def connect(self, req):
+        def handler():
+            self.connects += 1
+            return 200, self.payload
+        return handler
+
+
+def test_smallest_order_supporting_plugin_wins():
+    reg = ProxyPluginRegistry()
+    reg.register(_Recorder("late", 300))
+    reg.register(_Recorder("early", 10))
+    reg.register(_Recorder("never", 1, supports=False))
+    code, payload = reg.route(ProxyRequest(verb="get", kind="X"))()
+    assert (code, payload) == (200, {"by": "early"})
+
+
+def test_enablement_spec_reorders_the_chain():
+    reg = ProxyPluginRegistry()
+    a, b = _Recorder("A", 1), _Recorder("B", 2)
+    reg.register(a)
+    reg.register(b)
+    reg.set_enablement("*,-A")  # disable A: B now sees the request first
+    assert reg.route(ProxyRequest(verb="get"))()[1] == {"by": "B"}
+    reg.set_enablement("A")  # bare allowlist: only A runs
+    assert reg.route(ProxyRequest(verb="get"))()[1] == {"by": "A"}
+    reg.set_enablement("-A,-B")
+    assert reg.route(ProxyRequest(verb="get")) is None
+
+
+def test_chain_exhaustion_returns_none():
+    reg = ProxyPluginRegistry()
+    reg.register(_Recorder("only", 1, supports=False))
+    assert reg.route(ProxyRequest(verb="get", kind="X")) is None
+
+
+# -- the in-tree chain over a live plane ------------------------------------
+
+from tests.test_query_plane import cp, deployment, dup_policy, registry  # noqa: F401,E402
+from karmada_tpu.search.httpapi import QueryPlaneServer  # noqa: E402
+
+
+@pytest.fixture
+def served(cp):  # noqa: F811 — pytest fixture chaining
+    cp.store.create(registry())
+    cp.apply_policy(dup_policy())
+    cp.apply(deployment("web"))
+    cp.tick()
+    srv = QueryPlaneServer(cp.store, cp.members, cp.cluster_proxy,
+                           search_cache=cp.search_cache,
+                           metrics_provider=cp.metrics_provider)
+    url = srv.start()
+    yield cp, srv, url
+    srv.stop()
+
+
+def get_json(url, path):
+    with urllib.request.urlopen(url + path, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_cached_kind_served_by_cache_plugin(served):
+    cp, srv, url = served
+    out = get_json(url, "/search/cache/Deployment")
+    assert out and out[0]["metadata"]["name"] == "web"
+
+
+def test_uncached_kind_falls_through_to_karmada_plugin(served):
+    """The reference karmada plugin serves whatever no cache/cluster plugin
+    claimed — here, a control-plane kind no registry selects."""
+    cp, srv, url = served
+    assert not cp.search_cache.has_kind("PropagationPolicy")
+    out = get_json(url, "/search/cache/PropagationPolicy")
+    assert out and out[0]["metadata"]["name"] == "pp"
+
+
+def test_out_of_tree_plugin_interposes_by_order(served):
+    cp, srv, url = served
+    intercept = _Recorder("Interpose", -10, payload={"intercepted": True})
+    srv.proxy_plugins.register(intercept)
+    try:
+        out = get_json(url, "/search/cache/Deployment")
+        assert out == {"intercepted": True}
+        assert intercept.connects == 1
+        # disable it: the cache plugin is first again
+        srv.proxy_plugins.set_enablement("*,-Interpose")
+        out = get_json(url, "/search/cache/Deployment")
+        assert isinstance(out, list) and out[0]["metadata"]["name"] == "web"
+    finally:
+        srv.proxy_plugins.unregister(intercept.name)
+        srv.proxy_plugins.set_enablement("*")
+
+
+def test_member_scoped_reads_ride_the_cluster_plugin(served):
+    cp, srv, url = served
+    # replace the chain with JUST the cluster plugin: the member read must
+    # still work, proving it is the plugin serving this route
+    srv.proxy_plugins.set_enablement("Cluster")
+    try:
+        one = get_json(url, "/clusters/m1/proxy/Deployment/default/web")
+        assert one["metadata"]["name"] == "web"
+        listed = get_json(url, "/clusters/m1/proxy/Deployment")
+        assert any(m["metadata"]["name"] == "web" for m in listed)
+    finally:
+        srv.proxy_plugins.set_enablement("*")
+
+
+# -- networked sink across a real socket ------------------------------------
+
+
+def _obj(name, kind="ConfigMap", payload="tpu solver"):
+    return Unstructured.from_manifest({
+        "apiVersion": "v1", "kind": kind,
+        "metadata": {"name": name, "namespace": "default"},
+        "data": {"note": payload},
+    })
+
+
+def test_remote_sink_upsert_query_delete_across_socket():
+    sink = SqliteFTSBackend(":memory:")
+    server = serve_backend(sink)
+    host, port = server.server_address
+    try:
+        backend = make_backend(BackendStoreConfig(
+            kind="RemoteTCP", addresses=[f"{host}:{port}"]))
+        assert isinstance(backend, RemoteTcpBackend)
+        backend.upsert("m1", _obj("alpha"))
+        backend.upsert("m2", _obj("beta", payload="other text"))
+        assert backend.count() == 2
+        hits = backend.query("solver")
+        assert [h["name"] for h in hits] == ["alpha"]
+        hits = backend.query("text", cluster="m2")
+        assert [h["name"] for h in hits] == ["beta"]
+        backend.delete("m1", _obj("alpha"))
+        assert backend.count() == 1
+        backend.close()
+    finally:
+        server.shutdown()
+
+
+def test_remote_sink_unreachable_address_fails_loudly():
+    with pytest.raises(ConnectionError):
+        RemoteTcpBackend(["127.0.0.1:1"], timeout=0.3)
+
+
+def test_remote_sink_tries_addresses_in_order():
+    sink = SqliteFTSBackend(":memory:")
+    server = serve_backend(sink)
+    host, port = server.server_address
+    try:
+        backend = RemoteTcpBackend(["127.0.0.1:1", f"{host}:{port}"],
+                                   timeout=0.5)
+        backend.upsert("m1", _obj("gamma"))
+        assert backend.count() == 1
+        backend.close()
+    finally:
+        server.shutdown()
+
+
+def test_cache_drives_remote_sink_end_to_end(cp):  # noqa: F811
+    """A ResourceRegistry pointing at a RemoteTCP sink: cached member
+    objects stream across the socket into the remote engine, and the query
+    plane's /search/query surface reaches it via backend_of."""
+    sink = SqliteFTSBackend(":memory:")
+    server = serve_backend(sink)
+    host, port = server.server_address
+    try:
+        reg = registry()
+        reg.spec.backend_store = BackendStoreConfig(
+            kind="RemoteTCP", addresses=[f"{host}:{port}"])
+        cp.store.create(reg)
+        cp.apply_policy(dup_policy())
+        cp.apply(deployment("web"))
+        cp.tick()
+        assert sink.count() >= 1  # the sink lives on the SERVER side
+        backend = cp.search_cache.backend_of(reg.metadata.name)
+        hits = backend.query("web")
+        assert any(h["name"] == "web" for h in hits)
+    finally:
+        server.shutdown()
