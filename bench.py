@@ -46,12 +46,47 @@ import numpy as np
 from karmada_tpu.utils.deviceprobe import probe_backend  # noqa: F401 (re-export: watch_bench.py uses bench.probe_backend)
 
 
+def _machine_tag() -> str:
+    """Fingerprint of the host's CPU feature set.  The repo directory
+    survives across rounds while the compute host changes; XLA's cache key
+    does NOT cover machine features, so loading another machine's AOT
+    artifact is allowed and can SIGILL (observed round 5: artifacts
+    compiled with +prefer-no-scatter loaded onto a host without it)."""
+    import hashlib
+
+    # stable identity lines only (per-boot fields like "cpu MHz" would
+    # thrash the cache on the SAME machine); when no line matches
+    # (non-x86/arm layouts, unreadable /proc) fall back to the full uname
+    # PLUS a marker so those hosts at least never share a dir with a
+    # feature-fingerprinted one
+    keys = ("flags", "Features", "model name", "vendor_id", "cpu family",
+            "CPU implementer", "CPU part")
+    ident = []
+    try:
+        with open("/proc/cpuinfo") as f:
+            seen = set()
+            for ln in f:
+                k = ln.split(":", 1)[0].strip()
+                if k in keys and k not in seen:
+                    seen.add(k)
+                    ident.append(ln.strip())
+    except OSError:
+        pass
+    if not ident:
+        import platform
+
+        ident = ["nocpuinfo", *platform.uname()]
+    return hashlib.sha1("|".join(ident).encode()).hexdigest()[:12]
+
+
 def enable_persistent_compile_cache() -> None:
-    """Compile once per machine, not once per run (must precede first jit)."""
+    """Compile once per machine, not once per run (must precede first jit).
+    The directory is keyed by the machine fingerprint so a repo moved
+    between hosts never loads a foreign AOT artifact."""
     import jax
 
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_compile_cache")
+                             ".jax_compile_cache", _machine_tag())
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -141,6 +141,20 @@ def cmd_get(args) -> int:
     cp = _load_plane(args.dir)
     if args.kind == "pods":  # kubectl-style lowercase alias
         args.kind = "Pod"
+    version = getattr(args, "api_version", "")
+    if version:
+        # honored on store reads with -o json; anything else must error
+        # rather than silently print the wrong schema
+        if args.cluster or args.output != "json":
+            print("--api-version requires -o json and a control-plane read "
+                  "(no --cluster)", file=sys.stderr)
+            return 1
+        from karmada_tpu.models.conversion import REGISTRY as conv
+
+        if not conv.served(args.kind, version):
+            print(f"{args.kind} is not served at {version!r}; served: "
+                  f"{conv.served_versions(args.kind)}", file=sys.stderr)
+            return 1
     if args.cluster:
         handle = _proxy_handle(cp, args.cluster)
         if handle is None:
@@ -173,8 +187,16 @@ def cmd_get(args) -> int:
     else:
         objs = cp.store.list(args.kind, args.namespace or None)
     if args.output == "json":
+        from karmada_tpu.models.codec import registered_kind, to_manifest_typed
+
         for o in objs:
-            manifest = o.to_manifest() if hasattr(o, "to_manifest") else o.__dict__
+            if registered_kind(getattr(o, "KIND", None)) and not hasattr(
+                    o, "to_manifest"):
+                manifest = to_manifest_typed(o, version=version or None)
+            elif hasattr(o, "to_manifest"):
+                manifest = o.to_manifest()
+            else:
+                manifest = o.__dict__
             print(json.dumps(manifest, default=str))
         return 0
     from karmada_tpu.printers import render, table_for
@@ -931,6 +953,13 @@ def _remote_fail(code, payload) -> int:
 def cmd_get_remote(args) -> int:
     if args.kind == "pods":
         args.kind = "Pod"
+    if getattr(args, "api_version", "") and (
+            args.cluster or args.output != "json"):
+        # the proxy/table branches have no versioned encoding; erroring
+        # beats silently printing the wrong schema
+        print("--api-version requires -o json and a control-plane read "
+              "(no --cluster)", file=sys.stderr)
+        return 1
     if args.cluster:
         if args.kind == "Pod":
             code, pods = _http_json(
@@ -970,8 +999,10 @@ def cmd_get_remote(args) -> int:
     if args.output == "json" or args.name:
         path = (f"/api/{args.kind}/{args.namespace}/{args.name}"
                 if args.name else f"/api/{args.kind}")
-        code, out = _http_json(args.server, "GET", path,
-                               params={"namespace": args.namespace})
+        params = {"namespace": args.namespace}
+        if getattr(args, "api_version", ""):
+            params["version"] = args.api_version
+        code, out = _http_json(args.server, "GET", path, params=params)
         if code != 200:
             return _remote_fail(code, out)
         for m in (out if isinstance(out, list) else [out]):
@@ -1106,6 +1137,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("-n", "--namespace", default="")
     g.add_argument("--cluster", default="", help="read through the cluster proxy")
     g.add_argument("-o", "--output", choices=["table", "json"], default="table")
+    g.add_argument("--api-version", default="",
+                   help="with --server -o json: serve the objects at this "
+                        "registered API version (multi-version read, e.g. "
+                        "work.karmada.io/v1alpha2 for Work)")
 
     a = sub.add_parser("apply")
     a.add_argument("-f", "--filename", required=True)
