@@ -52,7 +52,12 @@ def main() -> int:
              # the child's probe gets at least the budget the successful
              # watcher probe needed (a slow-answering device must not pass
              # the watcher only to time out in the child every cycle)
-             "--probe-timeout", str(int(PROBE_TIMEOUT_S)), *args],
+             "--probe-timeout", str(int(PROBE_TIMEOUT_S)),
+             # watcher mode retries anyway: detect a mid-run relay hang in
+             # 5 min (on-chip chunks are seconds; compiles burn CPU and
+             # count as progress) instead of the default 10 so a dead
+             # window costs one probe cycle less
+             "--no-progress-timeout", "300", *args],
             capture_output=True, text=True)
         line = bench._last_json_line((r.stdout or "").splitlines())
         log(f"bench rc={r.returncode}; stderr tail: "
