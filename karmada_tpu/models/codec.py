@@ -81,8 +81,11 @@ def _load_dataclass(cls, data: Dict[str, Any]):
     fields = {f.name: f for f in dataclasses.fields(cls)}
     kwargs = {}
     for key, value in data.items():
-        if key in ("apiVersion", "kind"):
-            continue
+        # no special-casing of the manifest envelope's apiVersion/kind:
+        # root models carry them as ClassVars (not fields), so the
+        # unknown-key skip below drops them — while NESTED dataclasses
+        # (ObjectReference, ResourceSelector) legitimately have
+        # api_version/kind as DATA fields and must receive them
         name = key if key in fields else _snake(key)
         if name not in fields:
             continue  # forward-compat: unknown manifest keys are ignored
@@ -125,6 +128,8 @@ def _camel(name: str) -> str:
 
 
 def _dump_value(value):
+    if isinstance(value, Quantity):  # a dataclass too: must win this check
+        return str(value)
     if dataclasses.is_dataclass(value) and not isinstance(value, type):
         out = {}
         for f in dataclasses.fields(value):
@@ -138,8 +143,6 @@ def _dump_value(value):
                 continue
             out[_camel(f.name)] = _dump_value(v)
         return out
-    if isinstance(value, Quantity):
-        return str(value)
     if isinstance(value, list):
         return [_dump_value(v) for v in value]
     if isinstance(value, dict):

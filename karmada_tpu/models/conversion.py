@@ -121,3 +121,71 @@ WORK_V1ALPHA2 = "work.karmada.io/v1alpha2"
 
 REGISTRY.register("Work", WORK_V1ALPHA2,
                   _work_v1alpha2_to_storage, _work_storage_to_v1alpha2)
+
+
+# -- ResourceBinding / ClusterResourceBinding at work/v1alpha1 ---------------
+# The reference's REAL legacy pair: bindings began life at v1alpha1 where
+# per-replica demand and the replica count lived INSIDE spec.resource
+# (ObjectReference.ReplicaResourceRequirements / .Replicas); the v1alpha2
+# hub hoisted them to spec.replicaRequirements.resourceRequest and
+# spec.replicas (/root/reference/pkg/apis/work/v1alpha1/
+# binding_types_conversion.go:77-128).  These converters perform the same
+# structural MOVES; the down-convert keeps only the fields v1alpha1
+# carries (resource + clusters in spec, conditions + the four
+# aggregatedStatus scalars in status), exactly like ConvertBindingSpec/
+# StatusFromHub — an old served version is inherently lossy about newer
+# spec machinery (placement, eviction tasks, components).
+
+BINDING_V1ALPHA1 = "work.karmada.io/v1alpha1"
+
+
+def _binding_v1alpha1_to_storage(m: Manifest) -> Manifest:
+    spec = m.get("spec") or {}
+    res = spec.get("resource") or {}
+    if "replicaResourceRequirements" in res:
+        spec.setdefault("replicaRequirements", {})["resourceRequest"] = (
+            res.pop("replicaResourceRequirements"))
+    if "replicas" in res:
+        spec["replicas"] = res.pop("replicas")
+    return m
+
+
+def _binding_storage_to_v1alpha1(m: Manifest) -> Manifest:
+    spec = m.get("spec") or {}
+    # only the five ObjectReference fields v1alpha1 defines survive
+    # (ConvertBindingSpecFromHub copies exactly these; hub-only fields
+    # like uid have no v1alpha1 home and must not leak into the old
+    # schema — CRD pruning there would reject them)
+    res = {k: v for k, v in (spec.get("resource") or {}).items()
+           if k in ("apiVersion", "kind", "namespace", "name",
+                    "resourceVersion")}
+    rr = spec.get("replicaRequirements") or {}
+    if "resourceRequest" in rr:  # membership: {} must round-trip as {}
+        res["replicaResourceRequirements"] = rr["resourceRequest"]
+    if "replicas" in spec:
+        res["replicas"] = spec["replicas"]
+    out_spec: Manifest = {"resource": res}
+    if "clusters" in spec:
+        out_spec["clusters"] = spec["clusters"]
+    m["spec"] = out_spec
+    status = m.get("status") or {}
+    out_status: Manifest = {}
+    if "conditions" in status:
+        out_status["conditions"] = status["conditions"]
+    if "aggregatedStatus" in status:
+        out_status["aggregatedStatus"] = [
+            {k: v for k, v in item.items()
+             if k in ("clusterName", "status", "applied", "appliedMessage")}
+            for item in status["aggregatedStatus"]
+        ]
+    if out_status:
+        m["status"] = out_status
+    elif "status" in m:
+        del m["status"]
+    return m
+
+
+for _kind in ("ResourceBinding", "ClusterResourceBinding"):
+    REGISTRY.register(_kind, BINDING_V1ALPHA1,
+                      _binding_v1alpha1_to_storage,
+                      _binding_storage_to_v1alpha1)
