@@ -33,7 +33,8 @@ VERSION = "karmada-tpu v0.4"
 
 def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                 controllers: Optional[str] = None,
-                probe_device: bool = False, probe_timeout: float = 240.0):
+                probe_device: bool = False, probe_timeout: float = 240.0,
+                device_cycle_timeout: Optional[float] = None):
     """controllers=None rehydrates the persisted --controllers spec; an
     explicit spec is also persisted so later invocations honor it.
 
@@ -52,7 +53,8 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
         if backend != "device":
             print(f"WARNING: {diag['degraded']}", file=sys.stderr)
     cp = ControlPlane(backend=backend, persist_dir=directory, waves=waves,
-                      controllers=controllers)
+                      controllers=controllers,
+                      device_cycle_timeout_s=device_cycle_timeout)
     if controllers is not None:
         cp.apply({"apiVersion": "v1", "kind": "ConfigMap",
                   "metadata": {"namespace": "karmada-system",
@@ -615,10 +617,19 @@ def _model_registry():
 
 
 def cmd_api_resources(args) -> int:
-    """List every registered API kind (pkg/karmadactl/apiresources)."""
-    rows = [[kind, cls.__module__.rsplit(".", 1)[-1], cls.__name__]
-            for kind, cls in sorted(_model_registry().items())]
-    _print_table(rows, ["KIND", "GROUP", "TYPE"])
+    """List every registered API kind with its served versions
+    (pkg/karmadactl/apiresources; the VERSIONS column marks the storage
+    version with *)."""
+    from karmada_tpu.models.conversion import REGISTRY as conv
+
+    rows = []
+    for kind, cls in sorted(_model_registry().items()):
+        versions = ",".join(
+            v + ("*" if v == cls.API_VERSION else "")
+            for v in conv.served_versions(kind))
+        rows.append([kind, cls.__module__.rsplit(".", 1)[-1],
+                     cls.__name__, versions])
+    _print_table(rows, ["KIND", "GROUP", "TYPE", "VERSIONS"])
     return 0
 
 
@@ -857,7 +868,10 @@ def cmd_serve(args) -> int:
         cp = _load_plane(args.dir, backend=args.backend, waves=args.waves,
                          controllers=args.controllers,
                          probe_device=not args.no_probe,
-                         probe_timeout=args.probe_timeout)
+                         probe_timeout=args.probe_timeout,
+                         device_cycle_timeout=(
+                             args.device_cycle_timeout
+                             if args.device_cycle_timeout > 0 else None))
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 1
@@ -1289,6 +1303,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="skip the device health probe and run --backend "
                          "device on whatever platform jax initialises "
                          "(tests / known-good hardware)")
+    sv.add_argument("--device-cycle-timeout", type=float, default=300.0,
+                    help="mid-serve death guard: a device solve cycle "
+                         "exceeding this many seconds is abandoned and the "
+                         "scheduler degrades to the fastest host backend "
+                         "permanently (0 disables)")
     sv.add_argument("--api-port", type=int, default=-1,
                     help="serve the query plane (cluster proxy verbs, "
                          "search cache GET/LIST/WATCH, metrics adapter) "

@@ -73,6 +73,10 @@ class ControlPlane:
         # every CLI invocation against the plane honors the operator's
         # choice, not just the serve process.
         controllers: Optional[str] = None,
+        # mid-serve device-death guard (scheduler/service.py): a device
+        # cycle exceeding this degrades to the fastest host backend.
+        # None disables (tests / known-good hardware).
+        device_cycle_timeout_s: Optional[float] = None,
     ) -> None:
         self.clock = clock if clock is not None else time.time
         from karmada_tpu.utils.events import EventRecorder
@@ -123,7 +127,8 @@ class ControlPlane:
         self.recorder = EventRecorder()
         self.detector = ResourceDetector(self.store, self.runtime, self.interpreter)
         self.scheduler = Scheduler(self.store, self.runtime, backend=backend,
-                                   recorder=self.recorder, waves=waves)
+                                   recorder=self.recorder, waves=waves,
+                                   device_cycle_timeout_s=device_cycle_timeout_s)
         self.binding_controller = BindingController(
             self.store, self.runtime, self.interpreter
         )

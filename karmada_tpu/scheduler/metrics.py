@@ -41,6 +41,13 @@ STEP_LATENCY = REGISTRY.histogram(
     buckets=exponential_buckets(0.001, 2, 15),
 )
 
+BACKEND_DEGRADED = REGISTRY.counter(
+    "karmada_scheduler_backend_degraded_total",
+    "Times the device backend was abandoned mid-serve (hung cycle) and "
+    "the scheduler degraded to a host backend",
+    ("to",),
+)
+
 QUEUE_INCOMING = REGISTRY.counter(
     "karmada_scheduler_queue_incoming_bindings_total",
     "Bindings added to scheduling queues by event type",

@@ -72,6 +72,13 @@ class Scheduler:
         recorder: Optional[ev.EventRecorder] = None,
         waves: int = 8,
         elector=None,  # utils.leaderelection.LeaderElector (None: always lead)
+        # a device cycle exceeding this many seconds marks the backend dead
+        # and degrades ONE-WAY to the fastest working backend (the startup
+        # probe cannot catch a tunnel that dies mid-serve, and a hung XLA
+        # dispatch is uninterruptible in-process — the stuck cycle runs on
+        # a discarded daemon thread).  None disables the guard (tests,
+        # known-good hardware).
+        device_cycle_timeout_s: Optional[float] = None,
     ) -> None:
         self.elector = elector
         if elector is not None:
@@ -98,6 +105,7 @@ class Scheduler:
         self.recorder = recorder if recorder is not None else ev.EventRecorder()
         self.store = store
         self.backend = backend
+        self.device_cycle_timeout_s = device_cycle_timeout_s
         # capacity-contention waves per solver chunk (ops/solver.py): the
         # chunk is priced in `waves` sequential waves, each seeing the
         # snapshot minus what earlier waves consumed; waves == batch size
@@ -396,6 +404,170 @@ class Scheduler:
             handled.append(i)
         return handled
 
+    def _solve_device(
+        self,
+        items: List[Tuple[ResourceBindingSpec, ResourceBindingStatus]],
+        clusters: List[Cluster],
+        cancelled: Optional[threading.Event] = None,
+    ) -> Dict[int, object]:
+        """backend="device": one batched cycle through the compact solver.
+        Returns {index: result} for every binding a device tier owns —
+        its OWN buffer, never a shared one, so the degradation guard can
+        abandon a hung cycle without racing a zombie thread's writes.
+        `cancelled` (set by the guard on abandonment) also gates every
+        shared-state write: an abandoned cycle that UNBLOCKS minutes later
+        must not pollute the live latency histograms, and the encoder
+        cache is acquired exactly once up front so a zombie never
+        repopulates what the degrade path cleared."""
+        out: Dict[int, object] = {}
+
+        def live() -> bool:
+            return cancelled is None or not cancelled.is_set()
+
+        t0 = time.perf_counter()
+        cindex = tensors.ClusterIndex.build(clusters)
+        cache = self._encoder_cache(clusters)
+        batch = tensors.encode_batch(items, cindex, self._general, cache=cache)
+        if live():
+            sched_metrics.STEP_LATENCY.observe(
+                time.perf_counter() - t0,
+                schedule_step=sched_metrics.STEP_ENCODE,
+            )
+        device_idx = [
+            i for i in range(len(items))
+            if batch.route[i] == tensors.ROUTE_DEVICE
+        ]
+        spread_groups = tensors.spread_groups(batch, items)
+        big_idx = [
+            i for i in range(len(items))
+            if batch.route[i] == tensors.ROUTE_DEVICE_BIG
+        ]
+        # dispatch the main solve FIRST (async), so the device crunches
+        # it while the host walks the spread bindings' DFS ping-pong
+        handle = None
+        if device_idx:
+            t_h2d = time.perf_counter()
+            handle = dispatch_compact(
+                batch, waves=self.waves,
+                keep_sel=self.enable_empty_workload_propagation,
+            )
+            if live():
+                sched_metrics.STEP_LATENCY.observe(
+                    time.perf_counter() - t_h2d,
+                    schedule_step=sched_metrics.STEP_H2D,
+                )
+        if spread_groups:
+            from karmada_tpu.ops.spread import solve_spread
+
+            t_sp = time.perf_counter()
+            for (axis, tier), idxs in spread_groups.items():
+                for i, res in solve_spread(
+                    batch, items, idxs, waves=self.waves,
+                    enable_empty_workload_propagation=(
+                        self.enable_empty_workload_propagation
+                    ),
+                    axis=axis, tier=tier,
+                ).items():
+                    out[i] = res
+            if live():
+                sched_metrics.STEP_LATENCY.observe(
+                    time.perf_counter() - t_sp,
+                    schedule_step=sched_metrics.STEP_SOLVE,
+                )
+        if big_idx:
+            # tier-2 sub-solve for bindings beyond the compact caps
+            t_big = time.perf_counter()
+            for i, res in solve_big(
+                items, big_idx, cindex, self._general,
+                cache, waves=self.waves,
+                enable_empty_workload_propagation=(
+                    self.enable_empty_workload_propagation),
+            ).items():
+                out[i] = res
+            if live():
+                sched_metrics.STEP_LATENCY.observe(
+                    time.perf_counter() - t_big,
+                    schedule_step=sched_metrics.STEP_SOLVE,
+                )
+        if device_idx:
+            t1 = time.perf_counter()
+            wait_compact(handle)  # device execution wait ...
+            if live():
+                sched_metrics.STEP_LATENCY.observe(
+                    time.perf_counter() - t1, schedule_step=sched_metrics.STEP_SOLVE
+                )
+            t_d2h = time.perf_counter()  # ... then the result copy
+            idx, val, status, _nnz = finalize_compact(handle)
+            if live():
+                sched_metrics.STEP_LATENCY.observe(
+                    time.perf_counter() - t_d2h,
+                    schedule_step=sched_metrics.STEP_D2H,
+                )
+            t2 = time.perf_counter()
+            decoded = tensors.decode_compact(
+                batch, idx, val, status,
+                enable_empty_workload_propagation=self.enable_empty_workload_propagation,
+                items=items,
+            )
+            if live():
+                sched_metrics.STEP_LATENCY.observe(
+                    time.perf_counter() - t2, schedule_step=sched_metrics.STEP_DECODE
+                )
+            for i in device_idx:
+                out[i] = decoded[i]
+        return out
+
+    def _solve_device_guarded(
+        self,
+        items: List[Tuple[ResourceBindingSpec, ResourceBindingStatus]],
+        clusters: List[Cluster],
+    ) -> Dict[int, object]:
+        """Run the device cycle under the mid-serve death guard: a cycle
+        exceeding device_cycle_timeout_s is abandoned on its daemon thread
+        and the scheduler degrades ONE-WAY to the fastest working backend
+        (same policy as the startup probe, utils/deviceprobe) — the
+        batched scheduler must never hang the control plane because the
+        accelerator tunnel died under it."""
+        if self.device_cycle_timeout_s is None:
+            return self._solve_device(items, clusters)
+        box: Dict[str, object] = {}
+        cancelled = threading.Event()
+
+        def run() -> None:
+            try:
+                box["res"] = self._solve_device(items, clusters,
+                                                cancelled=cancelled)
+            except Exception as e:  # noqa: BLE001 — re-raised on the caller
+                box["err"] = e
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="scheduler-device-cycle")
+        t.start()
+        t.join(self.device_cycle_timeout_s)
+        if t.is_alive():
+            cancelled.set()  # the zombie must stop touching shared state
+            from karmada_tpu import native as native_mod
+
+            self.backend = ("native" if native_mod.available() else "serial")
+            # the zombie thread still holds the old encoder cache: future
+            # cycles must never share it
+            self._enc_cache = None
+            self._enc_spec_sig = None
+            sched_metrics.BACKEND_DEGRADED.inc(to=self.backend)
+            import sys
+
+            print(
+                f"WARNING: device solve cycle exceeded "
+                f"{self.device_cycle_timeout_s:.0f}s (tunnel dead "
+                f"mid-serve?); abandoning it and degrading the scheduler "
+                f"to backend={self.backend} permanently",
+                file=sys.stderr, flush=True,
+            )
+            return {}
+        if "err" in box:
+            raise box["err"]  # type: ignore[misc]  # same surface as unguarded
+        return box.get("res", {})  # type: ignore[return-value]
+
     def _solve(
         self,
         items: List[Tuple[ResourceBindingSpec, ResourceBindingStatus]],
@@ -405,95 +577,15 @@ class Scheduler:
         cal = serial.make_cal_available(self.estimators)
         out: List[object] = [None] * len(items)
         device_idx: List[int] = []
-        if self.backend == "native" and items:
+        if self.backend == "device" and items:
+            solved = self._solve_device_guarded(items, clusters)
+            for i, res in solved.items():
+                out[i] = res
+            device_idx = list(solved.keys())
+        # not elif: the guard may have just degraded device -> native, and
+        # the CURRENT batch deserves the fast path too
+        if self.backend == "native" and items and not device_idx:
             device_idx = self._solve_native(items, clusters, out)
-        elif self.backend == "device" and items:
-            t0 = time.perf_counter()
-            cindex = tensors.ClusterIndex.build(clusters)
-            batch = tensors.encode_batch(
-                items, cindex, self._general, cache=self._encoder_cache(clusters)
-            )
-            sched_metrics.STEP_LATENCY.observe(
-                time.perf_counter() - t0, schedule_step=sched_metrics.STEP_ENCODE
-            )
-            device_idx = [
-                i for i in range(len(items))
-                if batch.route[i] == tensors.ROUTE_DEVICE
-            ]
-            spread_groups = tensors.spread_groups(batch, items)
-            spread_idx = [i for g in spread_groups.values() for i in g]
-            big_idx = [
-                i for i in range(len(items))
-                if batch.route[i] == tensors.ROUTE_DEVICE_BIG
-            ]
-            # dispatch the main solve FIRST (async), so the device crunches
-            # it while the host walks the spread bindings' DFS ping-pong
-            handle = None
-            if device_idx:
-                t_h2d = time.perf_counter()
-                handle = dispatch_compact(
-                    batch, waves=self.waves,
-                    keep_sel=self.enable_empty_workload_propagation,
-                )
-                sched_metrics.STEP_LATENCY.observe(
-                    time.perf_counter() - t_h2d,
-                    schedule_step=sched_metrics.STEP_H2D,
-                )
-            if spread_groups:
-                from karmada_tpu.ops.spread import solve_spread
-
-                t_sp = time.perf_counter()
-                for (axis, tier), idxs in spread_groups.items():
-                    for i, res in solve_spread(
-                        batch, items, idxs, waves=self.waves,
-                        enable_empty_workload_propagation=(
-                            self.enable_empty_workload_propagation
-                        ),
-                        axis=axis, tier=tier,
-                    ).items():
-                        out[i] = res
-                sched_metrics.STEP_LATENCY.observe(
-                    time.perf_counter() - t_sp,
-                    schedule_step=sched_metrics.STEP_SOLVE,
-                )
-            if big_idx:
-                # tier-2 sub-solve for bindings beyond the compact caps
-                t_big = time.perf_counter()
-                for i, res in solve_big(
-                    items, big_idx, cindex, self._general,
-                    self._encoder_cache(clusters), waves=self.waves,
-                    enable_empty_workload_propagation=(
-                        self.enable_empty_workload_propagation),
-                ).items():
-                    out[i] = res
-                sched_metrics.STEP_LATENCY.observe(
-                    time.perf_counter() - t_big,
-                    schedule_step=sched_metrics.STEP_SOLVE,
-                )
-            if device_idx:
-                t1 = time.perf_counter()
-                wait_compact(handle)  # device execution wait ...
-                sched_metrics.STEP_LATENCY.observe(
-                    time.perf_counter() - t1, schedule_step=sched_metrics.STEP_SOLVE
-                )
-                t_d2h = time.perf_counter()  # ... then the result copy
-                idx, val, status, _nnz = finalize_compact(handle)
-                sched_metrics.STEP_LATENCY.observe(
-                    time.perf_counter() - t_d2h,
-                    schedule_step=sched_metrics.STEP_D2H,
-                )
-                t2 = time.perf_counter()
-                decoded = tensors.decode_compact(
-                    batch, idx, val, status,
-                    enable_empty_workload_propagation=self.enable_empty_workload_propagation,
-                    items=items,
-                )
-                sched_metrics.STEP_LATENCY.observe(
-                    time.perf_counter() - t2, schedule_step=sched_metrics.STEP_DECODE
-                )
-                for i in device_idx:
-                    out[i] = decoded[i]
-            device_idx = device_idx + spread_idx + big_idx
         device_set = set(device_idx)
         host_idx = [i for i in range(len(items)) if i not in device_set]
         if host_idx:

@@ -102,6 +102,58 @@ def test_serve_loader_reroutes_on_dead_probe(tmp_path, monkeypatch, capsys):
     assert "rerouting to backend=native" in capsys.readouterr().err
 
 
+@pytest.mark.skipif(not native.available(), reason="no native toolchain")
+def test_hung_device_cycle_degrades_scheduler_mid_serve(monkeypatch, capsys):
+    """The startup probe cannot catch a tunnel that dies MID-serve: a
+    device cycle exceeding the guard timeout must be abandoned on its
+    thread and the scheduler degraded one-way to the native backend —
+    with the batch still scheduled (by native) in the SAME cycle."""
+    import threading
+
+    from karmada_tpu.e2e import ControlPlane
+    from karmada_tpu.scheduler import service as svc
+    from karmada_tpu.scheduler.metrics import BACKEND_DEGRADED
+
+    cp = ControlPlane(backend="device", device_cycle_timeout_s=0.3)
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.tick()
+
+    hang = threading.Event()
+
+    def stuck_solve(self, items, clusters, cancelled=None):
+        hang.wait(30)  # the XLA dispatch never returns
+        return {}
+
+    monkeypatch.setattr(svc.Scheduler, "_solve_device", stuck_solve)
+    before = BACKEND_DEGRADED.value(to="native")
+
+    from karmada_tpu.models.meta import ObjectMeta
+    from karmada_tpu.models.policy import (
+        Placement, PropagationPolicy, PropagationSpec, ResourceSelector,
+    )
+
+    cp.apply_policy(PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(api_version="apps/v1",
+                                                 kind="Deployment")],
+            placement=Placement(),
+        ),
+    ))
+    cp.apply({"apiVersion": "apps/v1", "kind": "Deployment",
+              "metadata": {"name": "app", "namespace": "default"},
+              "spec": {"replicas": 2, "template": {"spec": {"containers": [
+                  {"name": "a", "resources": {"requests": {"cpu": "100m"}}}]}}}})
+    cp.tick()
+    hang.set()  # release the zombie thread
+
+    assert cp.scheduler.backend == "native"
+    assert BACKEND_DEGRADED.value(to="native") == before + 1
+    rb = cp.store.get("ResourceBinding", "default", "app-deployment")
+    assert rb.spec.clusters, "the degraded cycle must still schedule"
+    assert "degrading the scheduler to backend=native" in capsys.readouterr().err
+
+
 def test_serve_loader_skips_probe_when_disabled(tmp_path):
     """--no-probe (tests / known-good hardware): the requested backend is
     honored without spending a probe."""
