@@ -616,6 +616,12 @@ def _model_registry():
     return model_registry()
 
 
+def _format_versions(storage: str, served) -> str:
+    """One VERSIONS rendering for local and --server api-resources: every
+    served version, the storage version starred."""
+    return ",".join(v + ("*" if v == storage else "") for v in served)
+
+
 def cmd_api_resources(args) -> int:
     """List every registered API kind with its served versions
     (pkg/karmadactl/apiresources; the VERSIONS column marks the storage
@@ -624,11 +630,10 @@ def cmd_api_resources(args) -> int:
 
     rows = []
     for kind, cls in sorted(_model_registry().items()):
-        versions = ",".join(
-            v + ("*" if v == cls.API_VERSION else "")
-            for v in conv.served_versions(kind))
         rows.append([kind, cls.__module__.rsplit(".", 1)[-1],
-                     cls.__name__, versions])
+                     cls.__name__,
+                     _format_versions(cls.API_VERSION,
+                                      conv.served_versions(kind))])
     _print_table(rows, ["KIND", "GROUP", "TYPE", "VERSIONS"])
     return 0
 
@@ -1375,7 +1380,22 @@ REMOTE_COMMANDS = {
     "top": "cmd_top_remote",
     "apply": "cmd_apply_remote",
     "delete": "cmd_delete_remote",
+    "api-resources": "cmd_api_resources_remote",
 }
+
+
+def cmd_api_resources_remote(args) -> int:
+    """api-resources over --server: the /apis discovery root, rendered in
+    the same VERSIONS format as the local command (GROUP/TYPE are local
+    implementation detail the wire payload does not carry)."""
+    code, out = _http_json(args.server, "GET", "/apis")
+    if code != 200:
+        return _remote_fail(code, out)
+    rows = [[kind, _format_versions(info["storageVersion"],
+                                    info["servedVersions"])]
+            for kind, info in sorted(out.items())]
+    _print_table(rows, ["KIND", "VERSIONS"])
+    return 0
 
 
 def _dispatch(args) -> int:

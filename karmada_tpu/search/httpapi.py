@@ -28,8 +28,14 @@ in-process ClusterProxy.connect):
   GET    /metrics-adapter/pods/{kind}/{ns}/{name}    merged PodMetrics
   GET    /metrics-adapter/external/{name}            scalar sample
 
-  GET    /api/{kind}[?namespace=]                    control-plane manifests
-  GET    /api/{kind}[/{ns}]/{name}
+  GET    /apis                                       API discovery: kinds ->
+                                                     storage/served versions
+  GET    /api/{kind}[?namespace=&version=]           control-plane manifests
+  GET    /api/{kind}[/{ns}]/{name}[?version=]        (at any served version)
+  GET    /api-watch/{kind}[?timeout=&version=]       JSON-lines store watch
+  POST   /convert                                    {desiredAPIVersion,
+                                                     objects[]} (CRD
+                                                     conversion-webhook verb)
   POST   /api/apply                                  manifest (typed codec +
                                                      admission; subject-gated,
                                                      403 when served read-only)
@@ -240,6 +246,18 @@ class QueryPlaneServer:
             except Exception as e:  # noqa: BLE001
                 return 422, {"error": str(e)}
             return 200, {"deleted": True}
+
+        if path == "/apis" and method == "GET":
+            # API discovery (the aggregated apiserver's group/version root):
+            # every registered kind with its served versions, storage first
+            from karmada_tpu.models.codec import model_registry
+            from karmada_tpu.models.conversion import REGISTRY as conv
+
+            return 200, {
+                kind: {"storageVersion": cls.API_VERSION,
+                       "servedVersions": conv.served_versions(kind)}
+                for kind, cls in sorted(model_registry().items())
+            }
 
         if parts[:1] == ["api"] and method == "GET" and len(parts) >= 2:
             ns = (query.get("namespace") or [None])[0]

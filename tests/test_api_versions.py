@@ -292,6 +292,20 @@ def test_watch_rejects_unserved_version_with_400(served_plane):
     assert ei.value.code == 400
 
 
+def test_api_discovery_lists_served_versions(served_plane):
+    """GET /apis (the aggregated apiserver's discovery root): every kind
+    with its storage + served versions."""
+    from karmada_tpu.models.conversion import BINDING_V1ALPHA1
+
+    _, url = served_plane
+    apis = get_json(url, "/apis")
+    assert apis["Work"]["storageVersion"] == V1
+    assert set(apis["Work"]["servedVersions"]) == {V1, WORK_V1ALPHA2}
+    assert BINDING_V1ALPHA1 in apis["ResourceBinding"]["servedVersions"]
+    assert apis["Cluster"]["servedVersions"] == [
+        apis["Cluster"]["storageVersion"]]
+
+
 def test_convert_endpoint_over_http(served_plane):
     _, url = served_plane
     out = post_json(url, "/convert", {
@@ -300,6 +314,16 @@ def test_convert_endpoint_over_http(served_plane):
     back = post_json(url, "/convert", {
         "desiredAPIVersion": WORK_V1ALPHA2, "objects": out["objects"]})
     assert back["objects"][0]["spec"]["suspend"] is True
+
+
+def test_cli_api_resources_remote(served_plane, capsys):
+    from karmada_tpu.cli import main
+
+    _, url = served_plane
+    assert main(["--server", url, "api-resources"]) == 0
+    out = capsys.readouterr().out
+    assert "VERSIONS" in out
+    assert "work.karmada.io/v1alpha2" in out  # Work's extra served version
 
 
 def test_cli_get_at_served_version(served_plane, capsys):
