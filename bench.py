@@ -79,14 +79,22 @@ def _machine_tag() -> str:
     return hashlib.sha1("|".join(ident).encode()).hexdigest()[:12]
 
 
-def enable_persistent_compile_cache() -> None:
+def enable_persistent_compile_cache(platform_hint: str = "cpu") -> None:
     """Compile once per machine, not once per run (must precede first jit).
-    The directory is keyed by the machine fingerprint so a repo moved
-    between hosts never loads a foreign AOT artifact."""
+
+    XLA:CPU AOT artifacts are host-feature-specific: the CPU cache dir is
+    keyed by the machine fingerprint so a repo moved between hosts never
+    loads a foreign artifact (observed SIGILL risk).  Accelerator
+    executables (TPU/GPU) target the CHIP, not the host, so
+    `platform_hint="accel"` uses one shared dir — a chip window must
+    never re-pay the long solver compiles just because the host changed
+    between rounds (the last window died exactly there, mid-warmup);
+    XLA's own cache key separates platforms within it."""
     import jax
 
+    sub = "accel-shared" if platform_hint == "accel" else _machine_tag()
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_compile_cache", _machine_tag())
+                             ".jax_compile_cache", sub)
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -1070,8 +1078,9 @@ def main() -> None:
     global _HB_ON
     _HB_ON = args.inner
 
-    # backend bring-up (before any backend init in this process)
-    enable_persistent_compile_cache()
+    # backend bring-up: probe first (out of process), THEN point the
+    # compile cache at the platform-appropriate dir — all before the first
+    # in-process jit
     if args.force_cpu:
         probe = {"ok": False, "platform": None,
                  "attempts": [{"ok": False, "err": "--force-cpu"}]}
@@ -1092,6 +1101,9 @@ def main() -> None:
 
     on_accel = probe["ok"] and any(
         p in str(platform).lower() for p in ACCELERATOR_PLATFORMS)
+    # accelerator executables target the chip, not the host: share their
+    # cache across hosts; only XLA:CPU artifacts are host-feature-bound
+    enable_persistent_compile_cache("accel" if on_accel else "cpu")
     _hb(f"probe done: platform={platform}")
 
     if (not on_tpu and not args.fresh

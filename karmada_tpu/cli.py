@@ -1407,8 +1407,8 @@ def _dispatch(args) -> int:
             return 1
         return globals()[handler](args)
     if args.dir is None:
-        print("--dir is required (or --server for get/logs/exec/top)",
-              file=sys.stderr)
+        print("--dir is required (or --server for "
+              f"{'/'.join(sorted(REMOTE_COMMANDS))})", file=sys.stderr)
         return 1
     return COMMANDS[args.command](args)
 
