@@ -77,6 +77,32 @@ def test_silent_hang_falls_back_loudly(stub_bench, capfd):
     assert "killing the device attempt" in err
 
 
+def test_partial_tpu_record_round_trips(tmp_path, capsys):
+    """A forward-only on-chip measurement persisted mid-window must be
+    reportable by a later (chip-down) bench run, loudly labelled."""
+    from types import SimpleNamespace
+
+    args = SimpleNamespace(bindings=100_000, clusters=5_000, chunk=4096,
+                           waves=8, carry=False, ckpt_dir=str(tmp_path))
+    bench.save_tpu_latest(args.ckpt_dir, args, {
+        "metric": "scheduled bindings/sec, ... (forward pass only, "
+                  "rebalance pending)",
+        "value": 98765.0, "unit": "bindings/s", "vs_baseline": 83.3,
+        "detail": {"platform": "tpu", "partial": True},
+    })
+    rec = bench.load_tpu_latest(args.ckpt_dir, args)
+    assert rec is not None
+    bench.emit_cached_tpu(rec, why_no_live="probe timed out")
+    out = json.loads(capsys.readouterr().out)
+    assert out["value"] == 98765.0 and out["vs_baseline"] == 83.3
+    assert out["detail"]["cached"] is True and out["detail"]["partial"] is True
+    assert "[cached on-TPU measurement]" in out["metric"]
+
+    # a different config must not match the record
+    other = SimpleNamespace(**{**vars(args), "clusters": 64})
+    assert bench.load_tpu_latest(other.ckpt_dir, other) is None
+
+
 def test_pgroup_cpu_accounting_sees_own_group():
     pg = os.getpgid(0)
     c0 = bench._pgroup_cpu_s(pg)
