@@ -186,7 +186,16 @@ def load_ckpt(path: str, sig: str):
                     done.clear()
                     sessions.clear()
                     continue
+                if rec.get("kind") == "rebalance" or "ci" not in rec:
+                    # legacy pre-sig_reb bench versions logged the
+                    # rebalance pass as kind="rebalance" records (ci=-1)
+                    # under the FORWARD sig: folding them in would store a
+                    # phantom done[-1] and inflate prior_elapsed, deflating
+                    # the resumed throughput
+                    continue
                 ci = int(rec["ci"])
+                if ci < 0:
+                    continue  # same legacy class, defensively
                 if ci in done:
                     # first-wins: a concurrent duplicate run of the same
                     # sig must not add its span to prior_elapsed twice
@@ -627,147 +636,80 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
     chunk_wall is its submit-to-results wall time, which under pipelining
     also contains the interleaved work of neighboring chunks.
 
+    The loop itself lives in scheduler/pipeline.run_pipeline — the SAME
+    pipelined chunk executor scheduler/service._solve_device drives, so
+    the benchmarked path IS the production path: chunk k's device solve
+    dispatches asynchronously while the host finalizes chunk k-1 and
+    encodes chunk k+1, against one shared EncoderCache, with `waves`-deep
+    capacity contention per chunk.
+
     ckpt_done ({chunk_idx: record}) skips chunks a previous session already
     measured, folding their stored counts/latencies into the aggregates;
     ckpt_log (ChunkLog) records each newly finalized chunk.  Both optional
-    — the warmup/rebalance callers leave them off.
+    — the warmup and XLA:CPU-comparison callers leave them off; the timed
+    forward and rebalance passes each thread their own (distinct sigs).
 
     carry=True threads the consumed-capacity accumulators chunk to chunk
-    (solver carry-in/out): chunk k+1 prices against everything chunks <=k
-    consumed — sequential-equivalent accounting at chunk granularity.  It
-    SERIALIZES the pipeline (each dispatch needs the previous carry) and is
-    incompatible with checkpoint resume (a skipped chunk's consumption
-    would be lost).
-
-    Uses the production path end to end: shared EncoderCache across chunks,
-    jitted compact solve (sparse COO results — the dense [B, C] plane is
-    never shipped off-device), and the real decode_compact, with
-    `waves`-deep capacity contention exactly like scheduler/service.py.
-
-    PIPELINED: chunk k's device solve is dispatched asynchronously, then
-    chunk k-1 is finalized/decoded and chunk k+1 encoded while the device
-    works — host and device overlap instead of strictly alternating.
+    (solver carry-in/out): the main solve of chunk k+1 prices against
+    everything chunks <=k consumed — sequential-equivalent accounting at
+    chunk granularity.  The carry chains DEVICE-SIDE (the executor feeds
+    chunk k's live used-out arrays as chunk k+1's used0 operands, and
+    pending spread/big contributions fold in as lazy device adds), so on
+    the steady vocabulary the pipeline stays overlapped instead of
+    serializing; the sub-solves' consumption reaches the chain at the
+    next dispatch boundary (one-chunk lag).  Incompatible with checkpoint
+    resume (a skipped chunk's consumption would be lost).
     """
-    from karmada_tpu.ops.solver import (
-        dispatch_compact,
-        finalize_compact,
-        solve_big,
-    )
-    from karmada_tpu.ops.spread import solve_spread
-    from karmada_tpu.scheduler import metrics as sm
-
-    n = len(items)
-    scheduled = 0
-    failures: Dict[str, int] = {}
-    cache = cache if cache is not None else tensors.EncoderCache()
-    t0 = time.perf_counter()
-    solve_s = 0.0
-    chunk_lat = []   # per-chunk own work: encode span + finalize span
-    chunk_wall = []  # submit -> results wall time (includes pipeline overlap)
-    pending = None  # (handle, batch, part, t_chunk_start, encode_span)
-
-    def finalize(entry) -> None:
-        nonlocal scheduled, solve_s
-        handle, batch, part, tc, encode_span, ci, used0 = entry
-        t1 = time.perf_counter()
-        fin = finalize_compact(handle)
-        idx, val, status, _nnz = fin[:4]
-        if len(fin) == 5:  # carry mode: absorb the main kernel's delta
-            carry_state.absorb(batch, fin[4], used0)
-        spread_groups = tensors.spread_groups(batch, part)
-        big_idx = [
-            i for i in range(len(part))
-            if batch.route[i] == tensors.ROUTE_DEVICE_BIG
-        ]
-        # tier-2 sub-solve (carry note: big bindings neither receive nor
-        # contribute carry — the bench mix has none; the scheduler service
-        # solves whole cycles where the same snapshot discipline applies)
-        big_res = solve_big(part, big_idx, cindex, estimator, cache,
-                            waves=waves)
-        spread_res: Dict[int, object] = {}
-        for (axis, tier), idxs in spread_groups.items():
-            if carry:
-                res_g, used_sp = solve_spread(
-                    batch, part, idxs, waves=waves, collect_used=True,
-                    used0=used0, axis=axis, tier=tier)
-                if used_sp is not None:
-                    carry_state.absorb(batch, used_sp, used0)
-            else:
-                res_g = solve_spread(batch, part, idxs, waves=waves,
-                                     axis=axis, tier=tier)
-            spread_res.update(res_g)
-        t2 = time.perf_counter()
-        solve_s += t2 - t1
-        sm.STEP_LATENCY.observe(t2 - t1, schedule_step=sm.STEP_SOLVE)
-        decoded = tensors.decode_compact(batch, idx, val, status)
-        n_ok = 0
-        chunk_failures: Dict[str, int] = {}
-        for i in range(len(part)):
-            if i in spread_res:
-                d = spread_res[i]
-            elif i in big_res:
-                d = big_res[i]
-            else:
-                d = decoded[i]
-            if batch.route[i] in (tensors.ROUTE_DEVICE,
-                                  tensors.ROUTE_DEVICE_SPREAD,
-                                  tensors.ROUTE_DEVICE_SPREAD_BIG,
-                                  tensors.ROUTE_DEVICE_BIG):
-                if isinstance(d, Exception):
-                    k = type(d).__name__
-                    chunk_failures[k] = chunk_failures.get(k, 0) + 1
-                else:
-                    n_ok += 1
-        scheduled += n_ok
-        for k, v in chunk_failures.items():
-            failures[k] = failures.get(k, 0) + v
-        sm.STEP_LATENCY.observe(time.perf_counter() - t2,
-                                schedule_step=sm.STEP_DECODE)
-        lat = encode_span + (time.perf_counter() - t1)
-        wall = time.perf_counter() - tc
-        chunk_lat.append(lat)
-        chunk_wall.append(wall)
-        if ckpt_log is not None:
-            ckpt_log.append(ci=ci, n=len(part), scheduled=n_ok,
-                            failures=chunk_failures, lat=round(lat, 4),
-                            wall=round(wall, 4),
-                            solve_s=round(t2 - t1, 4))
-        _hb(f"chunk {ci + 1} finalized ({len(part)} bindings)")
+    from karmada_tpu.scheduler import pipeline as sched_pipeline
 
     assert not (carry and ckpt_done), \
         "--carry is incompatible with checkpoint resume"
-    carry_state = tensors.CarryState() if carry else None
-    for lo in range(0, n, chunk):
-        ci = lo // chunk
-        if ckpt_done and ci in ckpt_done:
-            rec = ckpt_done[ci]
-            scheduled += int(rec["scheduled"])
-            for k, v in rec.get("failures", {}).items():
-                failures[k] = failures.get(k, 0) + int(v)
-            chunk_lat.append(float(rec["lat"]))
-            chunk_wall.append(float(rec["wall"]))
-            solve_s += float(rec.get("solve_s", 0.0))
-            _hb(f"chunk {ci + 1} restored from checkpoint")
+    n = len(items)
+    n_chunks = (n + chunk - 1) // chunk
+    cache = cache if cache is not None else tensors.EncoderCache()
+    scheduled = 0
+    failures: Dict[str, int] = {}
+    solve_s = 0.0
+    chunk_lat = []   # per-chunk own work: encode span + finalize span
+    chunk_wall = []  # submit -> results wall time (includes pipeline overlap)
+    done = ckpt_done or {}
+    for ci in range(n_chunks):
+        rec = done.get(ci)
+        if rec is None:
             continue
-        tc = time.perf_counter()
-        part = items[lo : lo + chunk]
-        batch = tensors.encode_batch(part, cindex, estimator, cache=cache)
-        t1 = time.perf_counter()
-        sm.STEP_LATENCY.observe(t1 - tc, schedule_step=sm.STEP_ENCODE)
-        if carry:
-            used0 = carry_state.used0_for(batch)
-            handle = dispatch_compact(batch, waves=waves, with_used=True,
-                                      used0=used0)
-            # the next dispatch needs this chunk's carry-out: finalize
-            # immediately (sequential accounting forfeits pipeline overlap)
-            finalize((handle, batch, part, tc, t1 - tc, ci, used0))
-        else:
-            handle = dispatch_compact(batch, waves=waves)
-            if pending is not None:
-                finalize(pending)
-            pending = (handle, batch, part, tc, t1 - tc, ci, None)
-    if pending is not None:
-        finalize(pending)
+        scheduled += int(rec["scheduled"])
+        for k, v in rec.get("failures", {}).items():
+            failures[k] = failures.get(k, 0) + int(v)
+        chunk_lat.append(float(rec["lat"]))
+        chunk_wall.append(float(rec["wall"]))
+        solve_s += float(rec.get("solve_s", 0.0))
+        _hb(f"chunk {ci + 1} restored from checkpoint")
+
+    def on_chunk(st) -> None:
+        nonlocal scheduled, solve_s
+        scheduled += st.n_ok
+        for k, v in st.failures.items():
+            failures[k] = failures.get(k, 0) + v
+        chunk_lat.append(st.own_s)
+        chunk_wall.append(st.wall_s)
+        solve_s += st.solve_s
+        if ckpt_log is not None:
+            ckpt_log.append(ci=st.index, n=st.n, scheduled=st.n_ok,
+                            failures=st.failures, lat=round(st.own_s, 4),
+                            wall=round(st.wall_s, 4),
+                            solve_s=round(st.solve_s, 4))
+        _hb(f"chunk {st.index + 1} finalized ({st.n} bindings)")
+
+    t0 = time.perf_counter()
+    sched_pipeline.run_pipeline(
+        items, cindex, estimator, chunk=chunk, waves=waves, cache=cache,
+        carry=carry, carry_spread=carry,
+        skip=(None if not done else lambda ci: ci in done),
+        on_chunk=on_chunk,
+        # the bench aggregates counts only: holding 100k result lists (and
+        # re-deriving FitError diagnosis per failed row) is pure overhead
+        collect=False, diagnose=False,
+    )
     return (time.perf_counter() - t0, solve_s, scheduled, chunk_lat,
             chunk_wall, failures)
 

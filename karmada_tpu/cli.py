@@ -34,7 +34,8 @@ VERSION = "karmada-tpu v0.4"
 def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                 controllers: Optional[str] = None,
                 probe_device: bool = False, probe_timeout: float = 240.0,
-                device_cycle_timeout: Optional[float] = None):
+                device_cycle_timeout: Optional[float] = None,
+                pipeline_chunk: int = 1024):
     """controllers=None rehydrates the persisted --controllers spec; an
     explicit spec is also persisted so later invocations honor it.
 
@@ -53,7 +54,7 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
         if backend != "device":
             print(f"WARNING: {diag['degraded']}", file=sys.stderr)
     cp = ControlPlane(backend=backend, persist_dir=directory, waves=waves,
-                      controllers=controllers,
+                      controllers=controllers, pipeline_chunk=pipeline_chunk,
                       device_cycle_timeout_s=device_cycle_timeout)
     if controllers is not None:
         cp.apply({"apiVersion": "v1", "kind": "ConfigMap",
@@ -214,11 +215,22 @@ def cmd_apply(args) -> int:
     cp = _load_plane(args.dir)
     with open(args.filename) as f:
         docs = [d for d in yaml.safe_load_all(f) if d]
+    bad = 0
     for manifest in docs:
-        cp.apply(manifest)
+        try:
+            cp.apply(manifest)
+        except ValueError as e:
+            # unserved apiVersion for a registered kind (codec
+            # from_manifest_typed): CLI convention is stderr + exit 1,
+            # never a raw traceback.  Earlier docs of the same file are
+            # already in the store — keep going so _finish still ticks
+            # and checkpoints them (kubectl apply semantics)
+            print(str(e), file=sys.stderr)
+            bad += 1
+            continue
         print(f"{manifest.get('kind')}/{manifest['metadata']['name']} applied")
     _finish(cp)
-    return 0
+    return 1 if bad else 0
 
 
 def cmd_create(args) -> int:
@@ -239,7 +251,13 @@ def cmd_create(args) -> int:
             print(f"{kind}/{name} already exists", file=sys.stderr)
             conflicts += 1
             continue
-        cp.apply(manifest)
+        try:
+            cp.apply(manifest)
+        except ValueError as e:
+            # unserved apiVersion: stderr + nonzero, like the conflicts
+            print(str(e), file=sys.stderr)
+            conflicts += 1
+            continue
         print(f"{kind}/{name} created")
     _finish(cp)
     return 1 if conflicts else 0
@@ -289,7 +307,12 @@ def cmd_edit(args) -> int:
             or emeta.get("namespace", "") != (args.namespace or "")):
         print("cannot change kind/name/namespace in an edit", file=sys.stderr)
         return 1
-    cp.apply(edited)
+    try:
+        cp.apply(edited)
+    except ValueError as e:
+        # e.g. the edit rewrote apiVersion to an unserved version
+        print(str(e), file=sys.stderr)
+        return 1
     _finish(cp)
     print(f"{args.kind}/{args.name} edited")
     return 0
@@ -876,7 +899,8 @@ def cmd_serve(args) -> int:
                          probe_timeout=args.probe_timeout,
                          device_cycle_timeout=(
                              args.device_cycle_timeout
-                             if args.device_cycle_timeout > 0 else None))
+                             if args.device_cycle_timeout > 0 else None),
+                         pipeline_chunk=args.pipeline_chunk)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 1
@@ -1294,6 +1318,11 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--waves", type=int, default=8,
                     help="capacity-contention waves per solver chunk "
                          "(batch size = strict one-at-a-time semantics)")
+    sv.add_argument("--pipeline-chunk", type=int, default=1024,
+                    help="pipelined chunk executor chunk size: scheduling "
+                         "cycles larger than this split into overlapped "
+                         "chunks with consumed-capacity carry "
+                         "(scheduler/pipeline.py)")
     sv.add_argument("--metrics-port", type=int, default=-1,
                     help="serve /metrics,/healthz,/readyz,/debug/state on "
                          "127.0.0.1:PORT (0 = ephemeral, -1 = disabled)")

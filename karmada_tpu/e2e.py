@@ -63,6 +63,8 @@ class ControlPlane:
         persist_dir: Optional[str] = None,
         eviction_rate: float = 100.0,
         waves: int = 8,
+        # pipelined chunk executor chunk size (scheduler/pipeline.py)
+        pipeline_chunk: int = 1024,
         # --default-not-ready/unreachable-toleration-seconds (webhook flags,
         # 300 in the reference); None disables the defaulted tolerations
         default_toleration_seconds: Optional[int] = 300,
@@ -128,6 +130,7 @@ class ControlPlane:
         self.detector = ResourceDetector(self.store, self.runtime, self.interpreter)
         self.scheduler = Scheduler(self.store, self.runtime, backend=backend,
                                    recorder=self.recorder, waves=waves,
+                                   pipeline_chunk=pipeline_chunk,
                                    device_cycle_timeout_s=device_cycle_timeout_s)
         self.binding_controller = BindingController(
             self.store, self.runtime, self.interpreter

@@ -929,6 +929,22 @@ def wait_compact(handle) -> None:
     jax.block_until_ready(handle[3])
 
 
+def dispatched_used(handle):
+    """The consumed-capacity accumulators of a dispatch_compact(...,
+    with_used=True) handle as LIVE device values (never materialized to
+    host): (used_milli [C, R], used_pods [C], used_sets [Q, C]).
+
+    The pipelined chunk executor (scheduler/pipeline.py) feeds these
+    straight back as the NEXT chunk's used0 operands, so the carry chains
+    device-side with no host synchronization.  Safe against the nnz
+    escalation in finalize_compact: a re-solve with a larger extraction
+    cap recomputes bit-identical accumulators (max_nnz only changes the
+    COO cap), so a chunk dispatched against the first run's accumulators
+    stays consistent."""
+    assert handle[7], "handle was not dispatched with_used=True"
+    return handle[3][4:7]
+
+
 def finalize_compact(handle):
     """Force a dispatch_compact handle: (idx, val, status, nnz) numpy —
     plus (used_milli, used_pods, used_sets) when dispatched with_used.
@@ -957,29 +973,50 @@ def finalize_compact(handle):
 
 
 def solve_big(items, idx_list, cindex, estimator, cache, waves: int = 1,
-              enable_empty_workload_propagation: bool = False):
+              enable_empty_workload_propagation: bool = False,
+              collect_used: bool = False, used0=None, from_batch=None):
     """Solve one chunk's ROUTE_DEVICE_BIG bindings (beyond the tier-1
     compact caps) as their own sub-batch on the big lane tier, the same
     sub-batch pattern as ops/spread.solve_spread.  Returns
-    {original_index: List[TargetCluster] | Exception}."""
+    {original_index: List[TargetCluster] | Exception}.
+
+    Carry (the pipelined executor's chunk accounting): `used0` carries a
+    previous batch's consumption in, given in `from_batch`'s vocabulary
+    and remapped here into the sub-batch's own (tensors.remap_used);
+    with collect_used the return becomes (out, (sub_batch, used_out,
+    used0_sub)) — the triple a caller feeds CarryState.absorb to fold
+    the big bindings' OWN consumption back into its keyed store."""
     from karmada_tpu.ops import tensors as T
 
     if not idx_list:
-        return {}
+        return ({}, None) if collect_used else {}
     sub = [items[i] for i in idx_list]
     batch2 = T.encode_batch(sub, cindex, estimator, cache=cache)
     # in a parent batch big rows are host-invalid; in THIS sub-batch they
     # are the payload (binding-axis arrays are fresh per encode: writable)
     batch2.b_valid[:len(sub)] = batch2.route == T.ROUTE_DEVICE_BIG
-    idx, val, st, _nnz = solve_compact(
+    used0_sub = None
+    if used0 is not None and from_batch is not None:
+        used0_sub = T.remap_used(used0, from_batch, batch2)
+    res = solve_compact(
         batch2, waves=waves, tier="big",
-        keep_sel=enable_empty_workload_propagation)
+        keep_sel=enable_empty_workload_propagation,
+        with_used=collect_used, used0=used0_sub)
+    idx, val, st = res[0], res[1], res[2]
     decoded = T.decode_compact(
         batch2, idx, val, st,
         enable_empty_workload_propagation=enable_empty_workload_propagation,
         items=sub)
-    return {idx_list[j]: decoded[j] for j in range(len(sub))
-            if batch2.route[j] == T.ROUTE_DEVICE_BIG}
+    out = {idx_list[j]: decoded[j] for j in range(len(sub))
+           if batch2.route[j] == T.ROUTE_DEVICE_BIG}
+    if collect_used:
+        if used0_sub is None:
+            used0_sub = tuple(
+                _onp.zeros_like(a) for a in
+                (batch2.avail_milli, batch2.pods_allowed,
+                 batch2.est_override))
+        return out, (batch2, res[4], used0_sub)
+    return out
 
 
 def solve_compact(batch, waves: int = 1, max_nnz: int = 0,

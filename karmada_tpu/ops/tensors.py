@@ -1000,6 +1000,24 @@ class CarryState:
         self.pods: Optional[np.ndarray] = None  # int64[C]
         self.sets: Dict = {}  # class key -> int64[C]
 
+    def empty(self) -> bool:
+        """True when no consumption has been absorbed yet (used0_for would
+        render all-zero accumulators)."""
+        return not self.milli and not self.sets and self.pods is None
+
+    def merge(self, other: "CarryState") -> None:
+        """Fold another keyed store into this one (additive; the pipelined
+        executor retires pending spread contributions this way)."""
+        for name, arr in other.milli.items():
+            self.milli[name] = (self.milli[name] + arr if name in self.milli
+                                else arr.copy())
+        if other.pods is not None:
+            self.pods = (other.pods.copy() if self.pods is None
+                         else self.pods + other.pods)
+        for key, arr in other.sets.items():
+            self.sets[key] = (self.sets[key] + arr if key in self.sets
+                              else arr.copy())
+
     def used0_for(self, batch: SolverBatch):
         um = np.zeros_like(batch.avail_milli)
         for r, name in enumerate(batch.res_names):

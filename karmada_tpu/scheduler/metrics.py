@@ -60,6 +60,27 @@ QUEUE_DEPTH = REGISTRY.gauge(
     ("queue",),
 )
 
+# pipelined chunk executor spans (scheduler/pipeline.py): "own" is the
+# chunk's own work (encode span + finalize/decode span), "wall" its
+# submit-to-result time — under pipelining wall also contains the
+# interleaved work of neighboring chunks, so own ~= wall means the
+# pipeline degenerated to serial while wall >> own means deep overlap
+PIPELINE_CHUNK_SPAN = "own"
+PIPELINE_CHUNK_WALL = "wall"
+
+PIPELINE_CHUNK_LATENCY = REGISTRY.histogram(
+    "karmada_scheduler_pipeline_chunk_duration_seconds",
+    "Per-chunk latency of the pipelined executor by span kind",
+    ("span",),
+    buckets=exponential_buckets(0.001, 2, 15),
+)
+
+PIPELINE_CHUNKS = REGISTRY.counter(
+    "karmada_scheduler_pipeline_chunks_total",
+    "Chunks finalized by the pipelined executor",
+    ("carry",),
+)
+
 BATCH_SIZE = REGISTRY.histogram(
     "karmada_scheduler_batch_size",
     "Bindings drained into one batched solver cycle",

@@ -1,0 +1,483 @@
+"""Pipelined chunk executor: the ONE scheduling hot loop.
+
+bench.py demonstrated the winning dispatch shape — split a cycle into
+fixed-size chunks, dispatch chunk k's compact device solve asynchronously
+(ops/solver.dispatch_compact), and overlap the host encode of chunk k+1
+plus the finalize/decode of chunk k-1 with the device execution of chunk
+k — but the production scheduler (scheduler/service._solve_device) still
+encoded whole cycles into one monolithic batch and blocked on a single
+dispatch.  This module extracts that loop into the shared subsystem both
+drive: the benchmarked path IS the production path.
+
+Stages per chunk (classic 3-deep software pipeline):
+
+  Encode   (host)    items[lo:hi] -> SolverBatch via the cycle-shared
+                     EncoderCache (tensors.encode_batch)
+  Dispatch (async)   dispatch_compact enqueues the fused device solve and
+                     returns immediately
+  Finalize (host)    spread/big sub-solves, device wait, sparse D2H,
+                     decode_compact -> per-binding results
+
+While the device crunches chunk k the host finalizes chunk k-1 and
+encodes chunk k+1 — host work (encode, DFS, COO decode) hides behind
+device work instead of strictly alternating with it.
+
+Carry threading (`carry=True`): the consumed-capacity accumulators
+(solve_compact's with_used/used0) chain chunk-to-chunk so pricing stays
+sequential-equivalent at chunk granularity — the main solve of chunk k+1
+prices against the snapshot minus everything chunks <= k consumed.  The
+chain is DEVICE-SIDE: chunk k+1's used0 operands are chunk k's live
+used-out arrays (solver.dispatched_used), so threading costs no host
+synchronization while consecutive chunks share an encoding vocabulary;
+a vocabulary change remaps on device when lossless (old resource/class
+keys all present in the new vocabulary) and otherwise closes the chain
+segment through a host-side name-keyed CarryState (tensors.CarryState),
+so consumption of a resource absent from an intermediate chunk's
+vocabulary still reaches a later chunk that prices it.  With
+`carry_spread=False` the spread/big sub-solves price against the raw
+snapshot exactly like the pre-pipeline scheduler; `carry_spread=True`
+(what both the scheduler's multi-chunk cycles and the bench's --carry
+mode use) additionally hands each chunk's carry-in to the spread and
+big-tier assignment kernels and folds those bindings' own consumption
+back into the chain at the next dispatch boundary — as lazy device-side
+adds when the pending contributions fit the next chunk's vocabulary, so
+the pipeline stays overlapped; the documented divergence from fully
+sequential accounting is a one-chunk lag (the sub-solve consumption of
+chunk k is only known at its finalize, after chunk k+1 dispatched).
+
+Cancellation: `cancelled` (the mid-serve degradation guard's event) gates
+every stage boundary and every shared-state write — metrics observations
+and the on_chunk callback are suppressed, in-flight work is abandoned,
+and the partial result is returned for the caller to discard.  An
+abandoned cycle that unblocks minutes later must not pollute the live
+histograms (scheduler/service._solve_device_guarded).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karmada_tpu.ops import tensors
+from karmada_tpu.scheduler import metrics as sm
+
+#: routes whose results the device path owns; everything else falls back
+#: to the serial host pipeline exactly as before
+DEVICE_ROUTES = (
+    tensors.ROUTE_DEVICE,
+    tensors.ROUTE_DEVICE_SPREAD,
+    tensors.ROUTE_DEVICE_SPREAD_BIG,
+    tensors.ROUTE_DEVICE_BIG,
+)
+
+
+@dataclass
+class ChunkStats:
+    """Per-chunk measurement handed to on_chunk after its finalize."""
+
+    index: int          # chunk ordinal within the cycle
+    offset: int         # first item's index
+    n: int              # bindings in the chunk
+    n_ok: int           # device-owned rows scheduled successfully
+    failures: Dict[str, int]  # device-owned failures by exception class
+    encode_s: float
+    solve_s: float      # sub-solves + device wait + sparse D2H
+    decode_s: float
+    own_s: float        # the chunk's OWN work: encode span + finalize span
+    wall_s: float       # submit-to-result (contains pipeline overlap)
+
+
+@dataclass
+class PipelineResult:
+    """Aggregate outcome of one run_pipeline call."""
+
+    results: Dict[int, object] = field(default_factory=dict)  # global index
+    scheduled: int = 0
+    failures: Dict[str, int] = field(default_factory=dict)
+    chunk_own: List[float] = field(default_factory=list)
+    chunk_wall: List[float] = field(default_factory=list)
+    solve_s: float = 0.0
+    chunks: int = 0          # finalized (skipped chunks excluded)
+    cancelled: bool = False  # the guard fired mid-cycle; results are partial
+
+
+class _CarryChain:
+    """Chunk-to-chunk consumed-capacity threading.
+
+    Invariant: the latest dispatched handle's used-out equals the
+    cumulative consumption of every chunk dispatched so far, rendered in
+    the open segment's vocabulary, PLUS the segment base (everything
+    absorbed before the segment opened).  `total` holds closed segments
+    keyed by resource name / class key; `extras` holds spread
+    contributions pending fold (carry_spread mode)."""
+
+    def __init__(self) -> None:
+        self.total = tensors.CarryState()
+        self.extras = tensors.CarryState()
+        # open segment: [sig, batch, base_np(tuple), handle|None]
+        self._seg: Optional[list] = None
+
+    @staticmethod
+    def _sig(batch) -> tuple:
+        return (batch.C, tuple(batch.res_names), tuple(batch.class_keys),
+                batch.est_override.shape[0], batch.avail_milli.shape[1])
+
+    @staticmethod
+    def _subset(from_batch, to_batch) -> bool:
+        """True when a device-side remap from_batch -> to_batch is
+        lossless: every accumulator key of the source vocabulary exists
+        in the target's (nothing to drop)."""
+        return (from_batch.C == to_batch.C
+                and set(from_batch.res_names) <= set(to_batch.res_names)
+                and set(from_batch.class_keys) <= set(to_batch.class_keys))
+
+    def _extras_fit(self, batch) -> bool:
+        """True when the pending extras render losslessly into batch's
+        vocabulary (they can ride the device chain instead of forcing a
+        segment close)."""
+        return (set(self.extras.milli) <= set(batch.res_names)
+                and set(self.extras.sets) <= set(batch.class_keys))
+
+    @staticmethod
+    def _device_remap(used, from_batch, to_batch):
+        """Re-key live device accumulators into to_batch's vocabulary
+        without materializing them (lazy jnp gathers — the chain stays
+        async).  Caller guarantees _subset(from_batch, to_batch)."""
+        import jax.numpy as jnp
+
+        um, up, us = used
+        r_src = {n: i for i, n in enumerate(from_batch.res_names)}
+        R2 = to_batch.avail_milli.shape[1]
+        idx_r = np.zeros(R2, np.int64)
+        ok_r = np.zeros(R2, bool)
+        for r2, name in enumerate(to_batch.res_names):
+            src = r_src.get(name)
+            if src is not None:
+                idx_r[r2], ok_r[r2] = src, True
+        um2 = jnp.where(ok_r[None, :], jnp.take(um, idx_r, axis=1), 0)
+        q_src = {k: i for i, k in enumerate(from_batch.class_keys)}
+        Q2 = to_batch.est_override.shape[0]
+        idx_q = np.zeros(Q2, np.int64)
+        ok_q = np.zeros(Q2, bool)
+        for q2, key in enumerate(to_batch.class_keys):
+            src = q_src.get(key)
+            if src is not None:
+                idx_q[q2], ok_q[q2] = src, True
+        us2 = jnp.where(ok_q[:, None], jnp.take(us, idx_q, axis=0), 0)
+        return um2, up, us2
+
+    def _close(self) -> None:
+        """Materialize the open segment's cumulative consumption into the
+        keyed store (host sync on the segment's last dispatched solve)."""
+        if self._seg is None:
+            return
+        _sig, batch, base, handle = self._seg
+        self._seg = None
+        if handle is None:
+            return  # segment opened but nothing dispatched: base unchanged
+        from karmada_tpu.ops.solver import dispatched_used
+
+        used = tuple(np.asarray(u) for u in dispatched_used(handle))
+        self.total.absorb(batch, used, base)
+
+    def carry_in(self, batch):
+        """The used0 operand tuple for this chunk's dispatch.  Always a
+        3-tuple of arrays (zeros when nothing consumed yet) so every
+        dispatch shares ONE jit signature."""
+        from karmada_tpu.ops.solver import dispatched_used
+
+        sig = self._sig(batch)
+        seg = self._seg
+        if seg is not None and seg[3] is not None and (
+                self.extras.empty() or self._extras_fit(batch)):
+            used = None
+            if seg[0] == sig:
+                # fast path: chain the live device arrays, no host sync
+                used = dispatched_used(seg[3])
+            elif self._subset(seg[1], batch):
+                # lossless vocabulary growth: re-key on device (async),
+                # re-base the segment in the new vocabulary
+                used = self._device_remap(
+                    dispatched_used(seg[3]), seg[1], batch)
+                base = tensors.remap_used(seg[2], seg[1], batch)
+                self._seg = [sig, batch, base, None]
+            if used is not None:
+                if not self.extras.empty():
+                    # pending sub-solve contributions ride the chain from
+                    # here: lazy device adds, no host sync; they reach the
+                    # keyed store at segment close via (used_out - base)
+                    extra = self.extras.used0_for(batch)
+                    used = tuple(u + e for u, e in zip(used, extra))
+                    self.extras = tensors.CarryState()
+                return used
+        # slow path (genuinely lossy vocabulary shrink): segment close
+        # (host sync) + keyed re-render; pending contributions retire
+        # into the cumulative store here
+        self._close()
+        if not self.extras.empty():
+            self.total.merge(self.extras)
+            self.extras = tensors.CarryState()
+        base = self.total.used0_for(batch)
+        self._seg = [sig, batch, base, None]
+        return base
+
+    def dispatched(self, batch, handle) -> None:
+        """Advance the open segment to this chunk's handle."""
+        if self._seg is None or self._seg[0] != self._sig(batch):
+            # carry_in opened/rebased the segment for this batch already;
+            # reaching here means it was never called (programming error)
+            raise AssertionError("dispatched() without a carry_in() segment")
+        self._seg[3] = handle
+
+
+@dataclass
+class _InFlight:
+    """A dispatched, not-yet-finalized chunk."""
+
+    index: int
+    offset: int
+    part: Sequence
+    batch: object
+    handle: Optional[tuple]  # None: the chunk had no compact-solve rows
+    used0: Optional[tuple]   # the dispatch's carry-in operands
+    t_submit: float
+    encode_s: float
+
+
+def run_pipeline(
+    items: Sequence[Tuple],
+    cindex: "tensors.ClusterIndex",
+    estimator,
+    *,
+    chunk: int,
+    waves: int = 8,
+    cache: Optional["tensors.EncoderCache"] = None,
+    carry: bool = True,
+    carry_spread: bool = False,
+    enable_empty_workload_propagation: bool = False,
+    cancelled: Optional[threading.Event] = None,
+    skip: Optional[Callable[[int], bool]] = None,
+    on_chunk: Optional[Callable[[ChunkStats], None]] = None,
+    collect: bool = True,
+    diagnose: bool = True,
+) -> PipelineResult:
+    """Schedule `items` (a cycle of (spec, status) pairs) through the
+    pipelined chunk executor.  Returns a PipelineResult whose `results`
+    map {global item index -> List[TargetCluster] | Exception} for every
+    binding a device tier owns (DEVICE_ROUTES); host-routed rows are
+    absent — the caller's serial fallback owns them, exactly like the
+    pre-pipeline _solve_device contract.
+
+    chunk/waves: chunk size and capacity-contention waves per chunk.
+    carry: thread the consumed-capacity accumulators chunk to chunk (see
+      module docstring).  Incompatible with `skip` (a skipped chunk's
+      consumption would vanish from the accounting).
+    carry_spread: additionally run the bench's --carry spread accounting
+      (spread sub-solves receive the chunk's carry-in and contribute
+      their consumption back, one-chunk lag).
+    cancelled: degradation-guard event; gates every stage boundary and
+      every shared-state write.
+    skip(ci): chunks to leave untouched (bench checkpoint resume) — no
+      encode, no stats, no results.
+    on_chunk(stats): called (live only) after each chunk's finalize.
+    collect: build the global results dict (the scheduler needs it; the
+      bench only aggregates counts and turns it off to keep 100k-binding
+      runs out of memory).
+    diagnose: rebuild full per-cluster FitError diagnosis for kernel
+      FIT_ERROR rows (scheduler on; bench off — it only counts classes).
+    """
+    from karmada_tpu.ops.solver import (
+        dispatch_compact,
+        finalize_compact,
+        solve_big,
+        wait_compact,
+    )
+    from karmada_tpu.ops.spread import solve_spread
+
+    res = PipelineResult()
+    n = len(items)
+    if n == 0:
+        return res
+    assert chunk > 0, "chunk size must be positive"
+    assert not (carry and skip is not None), \
+        "carry threading is incompatible with chunk skipping (resume)"
+    cache = cache if cache is not None else tensors.EncoderCache()
+    keep_sel = enable_empty_workload_propagation
+    chain = _CarryChain() if carry else None
+    carry_label = "on" if carry else "off"
+
+    def live() -> bool:
+        return cancelled is None or not cancelled.is_set()
+
+    def finalize(entry: _InFlight) -> None:
+        batch, part = entry.batch, entry.part
+        t1 = time.perf_counter()
+        sub: Dict[int, object] = {}
+        # sub-solves FIRST: they need no main result, and for a single
+        # chunk this reproduces the pre-pipeline overlap (host DFS runs
+        # while the device crunches the main dispatch)
+        spread_groups = tensors.spread_groups(batch, part)
+        big_idx = [
+            i for i in range(len(part))
+            if batch.route[i] == tensors.ROUTE_DEVICE_BIG
+        ]
+        used0_np = None
+        if (carry_spread and chain is not None and entry.used0 is not None
+                and (spread_groups or big_idx)):
+            # the chunk's carry-in; its producer solve finished before
+            # this chunk's (data dependency), so this never stalls
+            used0_np = tuple(np.asarray(u) for u in entry.used0)
+        if spread_groups:
+            t_sp = time.perf_counter()
+            for (axis, tier), idxs in spread_groups.items():
+                if used0_np is not None:
+                    res_g, used_sp = solve_spread(
+                        batch, part, idxs, waves=waves,
+                        enable_empty_workload_propagation=keep_sel,
+                        collect_used=True, used0=used0_np,
+                        axis=axis, tier=tier,
+                    )
+                    if used_sp is not None:
+                        chain.extras.absorb(batch, used_sp, used0_np)
+                else:
+                    res_g = solve_spread(
+                        batch, part, idxs, waves=waves,
+                        enable_empty_workload_propagation=keep_sel,
+                        axis=axis, tier=tier,
+                    )
+                sub.update(res_g)
+            if live():
+                sm.STEP_LATENCY.observe(
+                    time.perf_counter() - t_sp, schedule_step=sm.STEP_SOLVE)
+        if big_idx:
+            t_big = time.perf_counter()
+            if used0_np is not None:
+                big_res, big_used = solve_big(
+                    part, big_idx, cindex, estimator, cache, waves=waves,
+                    enable_empty_workload_propagation=keep_sel,
+                    collect_used=True, used0=used0_np, from_batch=batch,
+                )
+                if big_used is not None:
+                    sub_batch, used_out, used0_sub = big_used
+                    chain.extras.absorb(sub_batch, used_out, used0_sub)
+            else:
+                big_res = solve_big(
+                    part, big_idx, cindex, estimator, cache, waves=waves,
+                    enable_empty_workload_propagation=keep_sel,
+                )
+            sub.update(big_res)
+            if live():
+                sm.STEP_LATENCY.observe(
+                    time.perf_counter() - t_big, schedule_step=sm.STEP_SOLVE)
+        decode_s = 0.0
+        out_local: Dict[int, object] = {}
+        if entry.handle is not None:
+            t_w = time.perf_counter()
+            wait_compact(entry.handle)  # device execution wait ...
+            if live():
+                sm.STEP_LATENCY.observe(
+                    time.perf_counter() - t_w, schedule_step=sm.STEP_SOLVE)
+            t_d2h = time.perf_counter()  # ... then the result copy
+            fin = finalize_compact(entry.handle)
+            idx, val, status = fin[0], fin[1], fin[2]
+            if live():
+                sm.STEP_LATENCY.observe(
+                    time.perf_counter() - t_d2h, schedule_step=sm.STEP_D2H)
+            t_dec = time.perf_counter()
+            decoded = tensors.decode_compact(
+                batch, idx, val, status,
+                enable_empty_workload_propagation=keep_sel,
+                items=part if diagnose else None,
+            )
+            decode_s = time.perf_counter() - t_dec
+            if live():
+                sm.STEP_LATENCY.observe(decode_s,
+                                        schedule_step=sm.STEP_DECODE)
+            for i in range(len(part)):
+                if batch.route[i] == tensors.ROUTE_DEVICE:
+                    out_local[i] = decoded[i]
+        out_local.update(sub)
+        t_end = time.perf_counter()
+        n_ok = 0
+        chunk_failures: Dict[str, int] = {}
+        for i, r in out_local.items():
+            if isinstance(r, Exception):
+                k = type(r).__name__
+                chunk_failures[k] = chunk_failures.get(k, 0) + 1
+            else:
+                n_ok += 1
+        stats = ChunkStats(
+            index=entry.index, offset=entry.offset, n=len(part), n_ok=n_ok,
+            failures=chunk_failures,
+            encode_s=entry.encode_s,
+            solve_s=t_end - t1 - decode_s,
+            decode_s=decode_s,
+            own_s=entry.encode_s + (t_end - t1),
+            wall_s=t_end - entry.t_submit,
+        )
+        if not live():
+            return  # abandoned cycle: nothing it computed may escape
+        if collect:
+            for i, r in out_local.items():
+                res.results[entry.offset + i] = r
+        res.scheduled += n_ok
+        for k, v in chunk_failures.items():
+            res.failures[k] = res.failures.get(k, 0) + v
+        res.chunk_own.append(stats.own_s)
+        res.chunk_wall.append(stats.wall_s)
+        res.solve_s += stats.solve_s
+        res.chunks += 1
+        sm.PIPELINE_CHUNK_LATENCY.observe(stats.own_s,
+                                          span=sm.PIPELINE_CHUNK_SPAN)
+        sm.PIPELINE_CHUNK_LATENCY.observe(stats.wall_s,
+                                          span=sm.PIPELINE_CHUNK_WALL)
+        sm.PIPELINE_CHUNKS.inc(carry=carry_label)
+        if on_chunk is not None:
+            on_chunk(stats)
+
+    pending: Optional[_InFlight] = None
+    for ci in range((n + chunk - 1) // chunk):
+        if not live():
+            break
+        if skip is not None and skip(ci):
+            continue
+        lo = ci * chunk
+        part = items[lo:lo + chunk]
+        tc = time.perf_counter()
+        batch = tensors.encode_batch(part, cindex, estimator, cache=cache)
+        t1 = time.perf_counter()
+        if live():
+            sm.STEP_LATENCY.observe(t1 - tc, schedule_step=sm.STEP_ENCODE)
+        if not live():
+            break
+        # without carry an all-host chunk skips the device entirely (the
+        # pre-pipeline behavior); with carry every chunk dispatches so the
+        # chain stays contiguous (an all-invalid batch consumes nothing)
+        handle = used0 = None
+        if chain is not None or bool(np.any(batch.b_valid)):
+            t_h2d = time.perf_counter()
+            if chain is not None:
+                used0 = chain.carry_in(batch)
+            handle = dispatch_compact(
+                batch, waves=waves, keep_sel=keep_sel,
+                with_used=chain is not None, used0=used0,
+            )
+            if chain is not None:
+                chain.dispatched(batch, handle)
+            if live():
+                sm.STEP_LATENCY.observe(
+                    time.perf_counter() - t_h2d, schedule_step=sm.STEP_H2D)
+        entry = _InFlight(index=ci, offset=lo, part=part, batch=batch,
+                          handle=handle, used0=used0, t_submit=tc,
+                          encode_s=t1 - tc)
+        if pending is not None:
+            finalize(pending)
+        pending = entry
+    if pending is not None and live():
+        finalize(pending)
+    res.cancelled = not live()
+    return res

@@ -5,9 +5,11 @@ scheduler.go:335-340 worker/scheduleNext) and runs the generic pipeline per
 binding.  This service keeps the same *decision* semantics
 (doScheduleBinding :376 -- schedule when the spec generation moved, a
 reschedule was triggered, or the binding is unscheduled; honor scheduling
-suspension) but drains every pending binding per cycle into ONE batched
-solver call (ops/solver.schedule_batch), falling back to the serial pipeline
-for bindings the dense encoding routes to host (ops/tensors.route).
+suspension) but drains every pending binding per cycle into the pipelined
+chunk executor (scheduler/pipeline.py over ops/solver.schedule_compact —
+chunked async dispatch with encode/finalize overlap and chunk-to-chunk
+consumed-capacity carry), falling back to the serial pipeline for bindings
+the dense encoding routes to host (ops/tensors.route).
 
 The ClusterAffinities failover loop (scheduleResourceBinding :599-662)
 iterates ordered affinity terms; each round re-batches the still-failing
@@ -32,12 +34,6 @@ from karmada_tpu.models.work import (
     TargetCluster,
 )
 from karmada_tpu.ops import serial, tensors
-from karmada_tpu.ops.solver import (
-    dispatch_compact,
-    finalize_compact,
-    solve_big,
-    wait_compact,
-)
 from karmada_tpu.webhook.admission import AdmissionDenied
 from karmada_tpu.scheduler import metrics as sched_metrics
 from karmada_tpu.scheduler.queue import QueuedBindingInfo, SchedulingQueue
@@ -71,6 +67,11 @@ class Scheduler:
         queue: Optional[SchedulingQueue] = None,
         recorder: Optional[ev.EventRecorder] = None,
         waves: int = 8,
+        # pipelined chunk executor (scheduler/pipeline.py): cycles larger
+        # than this split into pipelined chunks with chunk-to-chunk
+        # consumed-capacity carry; cycles at or under it keep the
+        # single-dispatch path
+        pipeline_chunk: int = 1024,
         elector=None,  # utils.leaderelection.LeaderElector (None: always lead)
         # a device cycle exceeding this many seconds marks the backend dead
         # and degrades ONE-WAY to the fastest working backend (the startup
@@ -111,6 +112,7 @@ class Scheduler:
         # snapshot minus what earlier waves consumed; waves == batch size
         # is exactly the reference's one-binding-at-a-time semantics
         self.waves = max(1, waves)
+        self.pipeline_chunk = max(1, pipeline_chunk)
         self.estimators = list(estimators) if estimators else [GeneralEstimator()]
         self._general = next(
             (e for e in self.estimators if isinstance(e, GeneralEstimator)),
@@ -410,112 +412,52 @@ class Scheduler:
         clusters: List[Cluster],
         cancelled: Optional[threading.Event] = None,
     ) -> Dict[int, object]:
-        """backend="device": one batched cycle through the compact solver.
+        """backend="device": one batched cycle through the pipelined chunk
+        executor (scheduler/pipeline.py — the same loop bench.py measures).
+        The cycle's items split into pipeline_chunk-sized chunks: chunk
+        k's compact solve dispatches asynchronously while the host encodes
+        chunk k+1 and finalizes/decodes chunk k-1, and the consumed-
+        capacity accumulators thread chunk to chunk so pricing stays
+        sequential-equivalent at chunk granularity (chunk k+1 prices
+        against everything chunks <= k consumed — a FINER contention
+        granularity than the old monolithic batch's waves, i.e. strictly
+        closer to the reference's one-binding-at-a-time semantics).  A
+        cycle that fits one chunk takes the identical single-dispatch
+        path as before (no carry operands, same jit signatures).
+
         Returns {index: result} for every binding a device tier owns —
         its OWN buffer, never a shared one, so the degradation guard can
         abandon a hung cycle without racing a zombie thread's writes.
-        `cancelled` (set by the guard on abandonment) also gates every
-        shared-state write: an abandoned cycle that UNBLOCKS minutes later
-        must not pollute the live latency histograms, and the encoder
-        cache is acquired exactly once up front so a zombie never
-        repopulates what the degrade path cleared."""
-        out: Dict[int, object] = {}
+        `cancelled` (set by the guard on abandonment) gates every stage
+        boundary and every shared-state write inside the executor: an
+        abandoned cycle that UNBLOCKS minutes later must not pollute the
+        live latency histograms, and the encoder cache is acquired exactly
+        once up front so a zombie never repopulates what the degrade path
+        cleared."""
+        from karmada_tpu.scheduler import pipeline
 
-        def live() -> bool:
-            return cancelled is None or not cancelled.is_set()
-
-        t0 = time.perf_counter()
         cindex = tensors.ClusterIndex.build(clusters)
         cache = self._encoder_cache(clusters)
-        batch = tensors.encode_batch(items, cindex, self._general, cache=cache)
-        if live():
-            sched_metrics.STEP_LATENCY.observe(
-                time.perf_counter() - t0,
-                schedule_step=sched_metrics.STEP_ENCODE,
-            )
-        device_idx = [
-            i for i in range(len(items))
-            if batch.route[i] == tensors.ROUTE_DEVICE
-        ]
-        spread_groups = tensors.spread_groups(batch, items)
-        big_idx = [
-            i for i in range(len(items))
-            if batch.route[i] == tensors.ROUTE_DEVICE_BIG
-        ]
-        # dispatch the main solve FIRST (async), so the device crunches
-        # it while the host walks the spread bindings' DFS ping-pong
-        handle = None
-        if device_idx:
-            t_h2d = time.perf_counter()
-            handle = dispatch_compact(
-                batch, waves=self.waves,
-                keep_sel=self.enable_empty_workload_propagation,
-            )
-            if live():
-                sched_metrics.STEP_LATENCY.observe(
-                    time.perf_counter() - t_h2d,
-                    schedule_step=sched_metrics.STEP_H2D,
-                )
-        if spread_groups:
-            from karmada_tpu.ops.spread import solve_spread
-
-            t_sp = time.perf_counter()
-            for (axis, tier), idxs in spread_groups.items():
-                for i, res in solve_spread(
-                    batch, items, idxs, waves=self.waves,
-                    enable_empty_workload_propagation=(
-                        self.enable_empty_workload_propagation
-                    ),
-                    axis=axis, tier=tier,
-                ).items():
-                    out[i] = res
-            if live():
-                sched_metrics.STEP_LATENCY.observe(
-                    time.perf_counter() - t_sp,
-                    schedule_step=sched_metrics.STEP_SOLVE,
-                )
-        if big_idx:
-            # tier-2 sub-solve for bindings beyond the compact caps
-            t_big = time.perf_counter()
-            for i, res in solve_big(
-                items, big_idx, cindex, self._general,
-                cache, waves=self.waves,
-                enable_empty_workload_propagation=(
-                    self.enable_empty_workload_propagation),
-            ).items():
-                out[i] = res
-            if live():
-                sched_metrics.STEP_LATENCY.observe(
-                    time.perf_counter() - t_big,
-                    schedule_step=sched_metrics.STEP_SOLVE,
-                )
-        if device_idx:
-            t1 = time.perf_counter()
-            wait_compact(handle)  # device execution wait ...
-            if live():
-                sched_metrics.STEP_LATENCY.observe(
-                    time.perf_counter() - t1, schedule_step=sched_metrics.STEP_SOLVE
-                )
-            t_d2h = time.perf_counter()  # ... then the result copy
-            idx, val, status, _nnz = finalize_compact(handle)
-            if live():
-                sched_metrics.STEP_LATENCY.observe(
-                    time.perf_counter() - t_d2h,
-                    schedule_step=sched_metrics.STEP_D2H,
-                )
-            t2 = time.perf_counter()
-            decoded = tensors.decode_compact(
-                batch, idx, val, status,
-                enable_empty_workload_propagation=self.enable_empty_workload_propagation,
-                items=items,
-            )
-            if live():
-                sched_metrics.STEP_LATENCY.observe(
-                    time.perf_counter() - t2, schedule_step=sched_metrics.STEP_DECODE
-                )
-            for i in device_idx:
-                out[i] = decoded[i]
-        return out
+        carry = len(items) > self.pipeline_chunk
+        res = pipeline.run_pipeline(
+            items, cindex, self._general,
+            chunk=self.pipeline_chunk, waves=self.waves, cache=cache,
+            # single-chunk cycles need no carry: waves already price the
+            # whole cycle, and skipping it keeps the pre-pipeline jit
+            # signatures (no with_used variants on small control planes)
+            carry=carry,
+            # spread/big sub-solves join the accounting too: each chunk's
+            # sub-solves receive the carry-in and contribute their own
+            # consumption back (one-chunk lag — see pipeline.py), so a
+            # multi-chunk cycle cannot overcommit a cluster across its
+            # chunks' spread sets the way independent raw-snapshot
+            # sub-solves would
+            carry_spread=carry,
+            enable_empty_workload_propagation=(
+                self.enable_empty_workload_propagation),
+            cancelled=cancelled,
+        )
+        return res.results
 
     def _solve_device_guarded(
         self,

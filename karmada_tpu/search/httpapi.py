@@ -25,6 +25,17 @@ in-process ClusterProxy.connect):
   GET    /search/cache/{kind}/{ns}/{name}[?cluster=] fan-in get
   GET    /search/watch[?timeout=]                    JSON-lines event stream
 
+  Despite the name, /search/cache serves the FULL proxy plugin chain
+  (reference pkg/search/proxy framework semantics): the cache plugin
+  answers for kinds a ResourceRegistry selects; anything else falls
+  through the chain — cluster-proxy interposers, then the control-plane
+  store (KarmadaPlugin) — instead of returning an empty cache miss.
+  Clients that must distinguish a member-cluster cache hit from a
+  control-plane fallback check the `resource.karmada.io/cached-from-
+  cluster` annotation (search.CACHED_FROM_ANNOTATION): cache-served
+  objects carry it (naming the member cluster), store-served objects
+  never do.
+
   GET    /metrics-adapter/pods/{kind}/{ns}/{name}    merged PodMetrics
   GET    /metrics-adapter/external/{name}            scalar sample
 
@@ -132,7 +143,11 @@ class QueryPlaneServer:
         if parts[:2] == ["search", "cache"] and self.search_cache is not None:
             # resource reads run the proxy plugin chain: the cache plugin
             # serves registry-cached kinds, everything else falls through
-            # (cluster / karmada / out-of-tree interposers, by order)
+            # (cluster / karmada / out-of-tree interposers, by order) — so
+            # the cache-named endpoint can legitimately return control-
+            # plane store objects; cache hits are distinguishable by the
+            # CACHED_FROM_ANNOTATION on each returned object (see module
+            # docstring)
             from karmada_tpu.search.proxyframework import ProxyRequest
 
             flat = {k: v[0] for k, v in query.items()}

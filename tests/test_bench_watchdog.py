@@ -103,6 +103,35 @@ def test_partial_tpu_record_round_trips(tmp_path, capsys):
     assert bench.load_tpu_latest(other.ckpt_dir, other) is None
 
 
+def test_load_ckpt_skips_legacy_rebalance_records(tmp_path):
+    """Cross-version resume: a previous bench version logged the rebalance
+    pass as kind="rebalance" (ci=-1) records under the FORWARD sig.
+    load_ckpt must skip them — folding them in stored a phantom done[-1]
+    and inflated prior_elapsed, deflating resumed throughput."""
+    path = str(tmp_path / "chunks.jsonl")
+    sig = "b100-c10-k16-w8-cpu-deadbeef"
+    recs = [
+        {"sig": sig, "session": "s1", "ci": 0, "n": 16, "scheduled": 16,
+         "failures": {}, "lat": 0.5, "wall": 0.6, "solve_s": 0.3,
+         "t_rel": 1.0},
+        # legacy rebalance-pass records under the forward sig
+        {"sig": sig, "session": "s1", "kind": "rebalance", "ci": -1,
+         "n": 100, "scheduled": 100, "lat": 9.0, "wall": 9.0,
+         "t_rel": 500.0},
+        {"sig": sig, "session": "s1", "ci": -1, "n": 100, "scheduled": 100,
+         "lat": 9.0, "wall": 9.0, "t_rel": 600.0},
+        {"sig": sig, "session": "s1", "ci": 1, "n": 16, "scheduled": 15,
+         "failures": {"UnschedulableError": 1}, "lat": 0.4, "wall": 0.5,
+         "solve_s": 0.2, "t_rel": 2.0},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    done, prior = bench.load_ckpt(path, sig)
+    assert set(done) == {0, 1}
+    assert prior == 2.0  # the legacy records' t_rel never inflates it
+
+
 def test_pgroup_cpu_accounting_sees_own_group():
     pg = os.getpgid(0)
     c0 = bench._pgroup_cpu_s(pg)

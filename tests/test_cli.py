@@ -350,6 +350,32 @@ def test_create_refuses_overwrite(tmp_path, capsys):
     assert "already exists" in capsys.readouterr().err
 
 
+def unserved_work_yaml(tmp_path):
+    p = tmp_path / "work.yaml"
+    p.write_text("""
+apiVersion: work.karmada.io/v9
+kind: Work
+metadata:
+  name: w1
+  namespace: default
+spec: {}
+""")
+    return str(p)
+
+
+def test_apply_unserved_api_version_exits_cleanly(tmp_path, capsys):
+    """A registered kind at an unserved apiVersion raises ValueError in
+    the codec; apply/create must land it as stderr + exit 1 (the CLI
+    convention), never a raw traceback."""
+    run(tmp_path, "init")
+    capsys.readouterr()
+    assert run(tmp_path, "apply", "-f", unserved_work_yaml(tmp_path)) == 1
+    err = capsys.readouterr().err
+    assert "not served at apiVersion" in err
+    assert run(tmp_path, "create", "-f", unserved_work_yaml(tmp_path)) == 1
+    assert "not served at apiVersion" in capsys.readouterr().err
+
+
 def test_edit_template_with_editor(tmp_path, capsys, monkeypatch):
     run(tmp_path, "init")
     run(tmp_path, "apply", "-f", deployment_yaml(tmp_path))
