@@ -899,8 +899,12 @@ def run_native_fallback(args, rng, clusters, items, estimator, cindex,
     # XLA:CPU batched comparison subsample (the device program on host):
     # reported so the reroute decision stays auditable round over round
     xla_bps = None
+    xla_stage_timeline = None
     n_xla = min(args.xla_cpu_sample, len(items))
     if n_xla > 0:
+        from karmada_tpu import obs
+        from karmada_tpu.obs.export import latest_pipeline_timeline
+
         cache = tensors.EncoderCache()
         sample = items[:n_xla]
         run_batched(sample[: args.chunk], cindex, estimator, args.chunk,
@@ -909,8 +913,11 @@ def run_native_fallback(args, rng, clusters, items, estimator, cindex,
         if tail:
             run_batched(sample[:tail], cindex, estimator, args.chunk,
                         cache, waves=args.waves)
+        obs.TRACER.configure(capacity=2, slow_keep=0)
         xla_elapsed, _, _, _, _, _ = run_batched(
             sample, cindex, estimator, args.chunk, cache, waves=args.waves)
+        xla_stage_timeline = latest_pipeline_timeline(obs.TRACER.recorder)
+        obs.TRACER.disable()
         xla_bps = n_xla / xla_elapsed if xla_elapsed > 0 else 0.0
         _hb(f"XLA:CPU comparison sample done: {xla_bps:.1f} bindings/s")
 
@@ -934,6 +941,9 @@ def run_native_fallback(args, rng, clusters, items, estimator, cindex,
             "xla_cpu_batched_bps": (round(xla_bps, 1)
                                     if xla_bps is not None else None),
             "xla_cpu_sample": n_xla,
+            # stage attribution for the XLA path (the device program's
+            # stages exist even on host CPU; native has no such pipeline)
+            "xla_stage_timeline": xla_stage_timeline,
             "backend_probe": probe,
             "batched_solve_s": round(solve_s, 3),
             "marshal_s": round(marshal_s, 3),
@@ -1150,10 +1160,20 @@ def main() -> None:
 
         if ckpt_log is not None:
             ckpt_log.reset_t0()
+        # flight recorder (karmada_tpu/obs): armed for the timed passes
+        # only (never the warmup) so the payload carries a per-stage
+        # timeline — a throughput regression becomes attributable to
+        # encode/dispatch/wait/d2h/decode, not just a total.  Span cost is
+        # ~10 objects per multi-second chunk: noise next to device work.
+        from karmada_tpu import obs
+        from karmada_tpu.obs.export import latest_pipeline_timeline
+
+        obs.TRACER.configure(capacity=4, slow_keep=2)
         (elapsed, solve_s, scheduled, chunk_lat, chunk_wall,
          failures) = run_batched(
             items, cindex, estimator, args.chunk, cache, waves=args.waves,
             ckpt_done=ckpt_done, ckpt_log=ckpt_log, carry=args.carry)
+        stage_timeline = latest_pipeline_timeline(obs.TRACER.recorder)
         elapsed += prior_elapsed
         throughput = args.bindings / elapsed
         _hb(f"timed run done: {throughput:.1f} bindings/s")
@@ -1191,6 +1211,7 @@ def main() -> None:
                                         else "pending"),
                         "chunk": args.chunk, "waves": args.waves,
                         "resumed_chunks": n_restored,
+                        "stage_timeline": stage_timeline,
                     },
                 }
 
@@ -1215,6 +1236,8 @@ def main() -> None:
         (reb_elapsed, _, reb_ok, reb_lat, _, reb_failures) = run_batched(
             reb_items, cindex, estimator, args.chunk, cache,
             waves=args.waves, ckpt_done=reb_done, ckpt_log=reb_log)
+        reb_stage_timeline = latest_pipeline_timeline(obs.TRACER.recorder)
+        obs.TRACER.disable()
         reb_elapsed += reb_prior
         rebalance_bps = (len(reb_items) / reb_elapsed
                          if reb_elapsed > 0 else 0.0)
@@ -1285,6 +1308,10 @@ def main() -> None:
             "rebalance_p99_chunk_s": round(
                 float(np.percentile(reb_lat, 99)), 4) if reb_lat else None,
             "rebalance_resumed_chunks": n_reb_restored,
+            # per-stage timelines from the flight recorder (obs/export):
+            # regressions attribute to a pipeline stage, not just a total
+            "stage_timeline": stage_timeline,
+            "rebalance_stage_timeline": reb_stage_timeline,
             "serial_bindings_per_s": round(serial_throughput, 2),
             "serial_python_bindings_per_s": round(sc["py_serial_bps"], 2),
             "serial_sample": sc["native_sample"],

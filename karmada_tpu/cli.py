@@ -907,6 +907,21 @@ def cmd_serve(args) -> int:
     if args.feature_gates:
         cp.gates.set_from_string(args.feature_gates)
     cp.runtime._periodic_interval_s = args.sync_period  # noqa: SLF001
+    if args.trace_buffer > 0:
+        # arm the flight recorder before any controller thread runs so the
+        # very first scheduling cycle is captured (karmada_tpu/obs)
+        from karmada_tpu import obs as obs_mod
+
+        obs_mod.TRACER.configure(capacity=args.trace_buffer)
+        if args.metrics_port >= 0:
+            print(f"flight recorder on: last {args.trace_buffer} traces at "
+                  "/debug/traces (+ /debug/traces/slow, /debug/traces/ID); "
+                  "fetch with `karmadactl trace --endpoint URL`")
+        else:
+            print("WARNING: --trace-buffer is armed but --metrics-port is "
+                  "disabled, so /debug/traces is unreachable; add "
+                  "--metrics-port PORT to read the recorder",
+                  file=sys.stderr)
     # bind the observability endpoint BEFORE starting controller threads:
     # a port clash must fail fast, not skip the shutdown/checkpoint path
     obs = None
@@ -916,7 +931,7 @@ def cmd_serve(args) -> int:
         obs = ObservabilityServer(store=cp.store)
         url = obs.start(port=args.metrics_port)
         print(f"observability endpoint at {url} "
-              "(/metrics /healthz /readyz /debug/state)")
+              "(/metrics /healthz /readyz /debug/state /debug/traces)")
     api = None
     if args.api_port >= 0:
         from karmada_tpu.search.httpapi import QueryPlaneServer
@@ -950,6 +965,50 @@ def cmd_serve(args) -> int:
             api.stop()
         cp.runtime.stop()
         cp.checkpoint()
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Fetch flight-recorder traces from a serve process's observability
+    endpoint (`serve --metrics-port ... --trace-buffer N`) and render them:
+    a summary table without arguments, a text waterfall for one trace id.
+    Rendering happens client-side (karmada_tpu/obs/export) so the server
+    ships plain JSON."""
+    import urllib.error
+    import urllib.request
+
+    from karmada_tpu.obs import export
+
+    base = args.endpoint.rstrip("/")
+    path = "/debug/traces/slow" if args.slow else "/debug/traces"
+    if args.trace_id:
+        path = f"/debug/traces/{args.trace_id}?format=json"
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            payload = json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        print(f"server error ({e.code}): {e.read().decode()[:200]}",
+              file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"cannot reach {base}: {e.reason}", file=sys.stderr)
+        return 1
+    if args.trace_id:
+        print(export.render_waterfall(payload))
+        return 0
+    if not payload.get("enabled", False):
+        print("tracing is disabled on the server "
+              "(serve --trace-buffer N to arm it)", file=sys.stderr)
+        return 1
+    rows = [
+        [s["trace_id"], s["root"], str(s["spans"]),
+         f"{s['duration_ms']:.2f}", str(s["cancelled"]).lower()]
+        for s in payload.get("summaries", [])
+    ]
+    _print_table(rows or [["-"] * 5],
+                 ["TRACE", "ROOT", "SPANS", "DURATION_MS", "CANCELLED"])
+    if payload.get("dropped"):
+        print(f"({payload['dropped']} older traces dropped from the ring)")
     return 0
 
 
@@ -1259,6 +1318,17 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("api-resources")
 
+    trc = sub.add_parser("trace")
+    trc.add_argument("trace_id", nargs="?",
+                     help="render this trace's waterfall (omit to list)")
+    trc.add_argument("--endpoint", required=True,
+                     help="observability endpoint URL of a serve process "
+                          "(printed by `serve --metrics-port ... "
+                          "--trace-buffer N`)")
+    trc.add_argument("--slow", action="store_true",
+                     help="list the always-retained slowest cycles instead "
+                          "of the recent ring")
+
     ex = sub.add_parser("explain")
     ex.add_argument("kind")
 
@@ -1326,6 +1396,12 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--metrics-port", type=int, default=-1,
                     help="serve /metrics,/healthz,/readyz,/debug/state on "
                          "127.0.0.1:PORT (0 = ephemeral, -1 = disabled)")
+    sv.add_argument("--trace-buffer", type=int, default=0,
+                    help="arm the flight recorder: retain the last N "
+                         "cross-component traces (scheduler cycles, "
+                         "pipeline stages, reconciles) at /debug/traces "
+                         "plus the slowest cycles at /debug/traces/slow "
+                         "(0 = tracing disabled, zero overhead)")
     sv.add_argument("--probe-timeout", type=float, default=240.0,
                     help="device-backend health probe budget (seconds; "
                          "matches the bench/watcher budgets — device init "
@@ -1399,6 +1475,7 @@ COMMANDS = {
     "options": cmd_options,
     "tick": cmd_tick,
     "serve": cmd_serve,
+    "trace": cmd_trace,
 }
 
 
@@ -1428,6 +1505,10 @@ def cmd_api_resources_remote(args) -> int:
 
 
 def _dispatch(args) -> int:
+    if args.command == "trace":
+        # talks to a live serve process over HTTP; needs neither --dir
+        # (no plane is opened) nor --server (different endpoint)
+        return cmd_trace(args)
     if getattr(args, "server", None):
         handler = REMOTE_COMMANDS.get(args.command)
         if handler is None:

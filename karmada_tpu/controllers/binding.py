@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from karmada_tpu import obs
 from karmada_tpu.controllers.override import OverrideManager
 from karmada_tpu.interpreter import ResourceInterpreter
 from karmada_tpu.models.policy import (
@@ -121,22 +122,28 @@ class BindingController:
         eviction = {t.from_cluster for t in rb.spec.graceful_eviction_tasks
                     if t.purge_mode != "Immediately"}
         keep = set()
-        for target in targets:
-            # never materialize a Work for a cluster that no longer exists:
-            # an unjoined cluster's execution space has been drained and
-            # nothing would ever clean an orphan up
-            if self._cluster(target.name) is None:
-                continue
-            m = dict(manifest)
-            if self._divided(rb) and rb.spec.replicas > 0:
-                m = self.interpreter.revise_replica(m, target.replicas)
-            if target.name in completions:
-                m = self.interpreter.revise_job_completions(m, completions[target.name])
-            m = self.overrides.apply(m, self._cluster(target.name))
-            m = self._inject_preserved_state(rb, target, m, len(targets))
-            suspend = self._suspended(rb, target.name)
-            self._ensure_work(rb, target.name, m, suspend)
-            keep.add(target.name)
+        # flight recorder: per-target Work rendering (interpreter revise +
+        # override apply + store write) is where a binding reconcile's time
+        # goes — one span under the worker's reconcile root
+        with obs.TRACER.span(obs.SPAN_BINDING_RENDER,
+                             targets=len(targets)):
+            for target in targets:
+                # never materialize a Work for a cluster that no longer
+                # exists: an unjoined cluster's execution space has been
+                # drained and nothing would ever clean an orphan up
+                if self._cluster(target.name) is None:
+                    continue
+                m = dict(manifest)
+                if self._divided(rb) and rb.spec.replicas > 0:
+                    m = self.interpreter.revise_replica(m, target.replicas)
+                if target.name in completions:
+                    m = self.interpreter.revise_job_completions(
+                        m, completions[target.name])
+                m = self.overrides.apply(m, self._cluster(target.name))
+                m = self._inject_preserved_state(rb, target, m, len(targets))
+                suspend = self._suspended(rb, target.name)
+                self._ensure_work(rb, target.name, m, suspend)
+                keep.add(target.name)
         # graceful eviction: keep the old Work until the task drains
         keep |= eviction
         self._remove_works(ns, name, keep)

@@ -21,6 +21,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
+from karmada_tpu import obs
 from karmada_tpu.estimator.wire import (
     CapacitySnapshotResponse,
     MaxAvailableReplicasRequest,
@@ -33,6 +34,39 @@ from karmada_tpu.estimator.wire import (
 )
 from karmada_tpu.models.cluster import Cluster
 from karmada_tpu.models.work import ReplicaRequirements, TargetCluster
+
+
+def _rpc_span(cluster: str, method: str):
+    """An "estimator.rpc" span under the ambient trace, or the no-op span
+    when tracing is off OR no trace is active: a per-cluster RPC outside
+    any cycle/reconcile (e.g. a periodic-hook fan-out) must not mint
+    hundreds of single-span root traces and flood the bounded ring."""
+    tracer = obs.TRACER
+    if not tracer.enabled or tracer.current() is None:
+        return obs.NOOP_SPAN
+    return tracer.span(obs.SPAN_ESTIMATOR_RPC, cluster=cluster,
+                       method=method)
+
+
+def _traced_map(pool: ThreadPoolExecutor, fn, clusters: List[Cluster],
+                method: str) -> list:
+    """pool.map with flight-recorder spans: each per-cluster RPC runs
+    under an "estimator.rpc" span, parented (across the pool's thread
+    boundary) into whatever trace the calling thread was inside — the
+    scheduler cycle, a descheduler reconcile.  Disabled tracing, or a
+    call with no ambient trace, takes the plain pool.map path."""
+    tracer = obs.TRACER
+    parent = tracer.current() if tracer.enabled else None
+    if parent is None:
+        return list(pool.map(fn, clusters))
+
+    def traced_one(cluster: Cluster):
+        with tracer.attach(parent):
+            with tracer.span(obs.SPAN_ESTIMATOR_RPC, cluster=cluster.name,
+                             method=method):
+                return fn(cluster)
+
+    return list(pool.map(traced_one, clusters))
 
 
 class AccurateEstimatorClient:
@@ -72,7 +106,8 @@ class AccurateEstimatorClient:
             except Exception:  # noqa: BLE001 -- unreachable estimator
                 return TargetCluster(cluster.name, self._timeout_replicas)
 
-        return list(self._pool.map(one, clusters))
+        return _traced_map(self._pool, one, clusters,
+                           "MaxAvailableReplicas")
 
     def max_available_component_sets(
         self, clusters: List[Cluster], components
@@ -99,7 +134,8 @@ class AccurateEstimatorClient:
             except Exception:  # noqa: BLE001 -- unreachable estimator
                 return TargetCluster(cluster.name, self._timeout_replicas)
 
-        return list(self._pool.map(one, clusters))
+        return _traced_map(self._pool, one, clusters,
+                           "MaxAvailableComponentSets")
 
     # -- UnschedulableReplicaEstimator --------------------------------------
     def unschedulable_replicas(
@@ -112,9 +148,10 @@ class AccurateEstimatorClient:
             cluster=cluster, resource_kind=kind, namespace=namespace, name=name
         )
         try:
-            resp = UnschedulableReplicasResponse.from_json(
-                transport.call("GetUnschedulableReplicas", req.to_json())
-            )
+            with _rpc_span(cluster, "GetUnschedulableReplicas"):
+                resp = UnschedulableReplicasResponse.from_json(
+                    transport.call("GetUnschedulableReplicas", req.to_json())
+                )
             return resp.unschedulable_replicas
         except Exception:  # noqa: BLE001
             return UNAUTHENTIC_REPLICA
@@ -145,9 +182,10 @@ class SnapshotEstimator:
             if not force and time.time() - last < self.refresh_interval_s:
                 return
         try:
-            snap = CapacitySnapshotResponse.from_json(
-                transport.call("CapacitySnapshot", {})
-            )
+            with _rpc_span(cluster, "CapacitySnapshot"):
+                snap = CapacitySnapshotResponse.from_json(
+                    transport.call("CapacitySnapshot", {})
+                )
         except Exception:  # noqa: BLE001
             return
         with self._lock:

@@ -1,0 +1,69 @@
+"""Flight-recorder tracing subsystem.
+
+The reference ships per-component Prometheus metrics (pkg/metrics/) but
+no cross-component timeline; a TPU-native control plane that overlaps
+host encode with device dispatch needs a per-cycle flight recorder, not
+just counters.  This package provides it:
+
+  trace.py     Span / SpanContext (contextvars) / Tracer — the core
+  recorder.py  bounded ring of finished traces + slowest-N shelf +
+               a drop counter so truncation is never silent
+  export.py    JSON dump, text waterfall, per-stage aggregates
+
+Everything instruments against the ONE process-wide `TRACER`, disabled
+by default (zero-cost: call sites get the no-op span singleton).  It is
+armed by `karmadactl serve --trace-buffer N` (obs.TRACER.configure) and
+read back through /debug/traces* (utils/httpserve) and the `karmadactl
+trace` CLI.
+
+Span-name vocabulary (SPAN_*): declared here so the registry-collision
+test can assert every span/metric name is unique, and so the waterfall /
+bench stage timelines key on constants rather than string literals
+scattered through the hot path.
+"""
+
+from karmada_tpu.obs.trace import (  # noqa: F401 — the public surface
+    FROM_CONTEXT,
+    NOOP_SPAN,
+    NoopSpan,
+    Span,
+    Trace,
+    Tracer,
+)
+
+# the process-wide tracer every call site instruments against
+TRACER = Tracer()
+
+# -- span-name vocabulary ----------------------------------------------------
+# scheduler/service.py
+SPAN_CYCLE = "scheduler.cycle"            # one batched scheduling cycle
+SPAN_SERIAL = "scheduler.serial"          # host-serial fallback rows
+# scheduler/pipeline.py (the pipelined chunk executor)
+SPAN_PIPELINE = "pipeline.cycle"          # one run_pipeline call
+SPAN_CHUNK = "pipeline.chunk"             # submit-to-result wall span
+SPAN_ENCODE = "pipeline.encode"           # host encode of the chunk
+SPAN_DISPATCH = "pipeline.dispatch"       # H2D + async device launch
+SPAN_SPREAD = "pipeline.spread"           # spread sub-solves (finalize)
+SPAN_BIG = "pipeline.big"                 # big-tier sub-solve (finalize)
+SPAN_WAIT = "pipeline.solve_wait"         # device execution wait
+SPAN_D2H = "pipeline.d2h"                 # sparse result copy (+ escalation)
+SPAN_DECODE = "pipeline.decode"           # COO decode to per-binding results
+# estimator/client.py
+SPAN_ESTIMATOR_RPC = "estimator.rpc"      # one per-cluster estimator call
+# controllers
+SPAN_BINDING_RENDER = "binding.ensure_works"
+SPAN_DETECTOR_MATCH = "detector.match_policy"
+# store/worker.py: every reconcile is spanned "reconcile.<worker name>"
+SPAN_RECONCILE_PREFIX = "reconcile."
+
+SPAN_NAMES = (
+    SPAN_CYCLE, SPAN_SERIAL, SPAN_PIPELINE, SPAN_CHUNK, SPAN_ENCODE,
+    SPAN_DISPATCH, SPAN_SPREAD, SPAN_BIG, SPAN_WAIT, SPAN_D2H, SPAN_DECODE,
+    SPAN_ESTIMATOR_RPC, SPAN_BINDING_RENDER, SPAN_DETECTOR_MATCH,
+)
+
+# every pipeline stage a healthy device chunk must traverse (the tier-1
+# serve smoke asserts a trace covers all of them)
+PIPELINE_STAGE_SPANS = (
+    SPAN_ENCODE, SPAN_DISPATCH, SPAN_WAIT, SPAN_D2H, SPAN_DECODE,
+)

@@ -798,6 +798,24 @@ def _compact_of(rep, sel, status, non_workload, max_nnz: int,
 _NON_WORKLOAD_ARG = 28
 
 
+# flight-recorder compile attribution: a dispatch is a "miss" exactly when
+# jax.jit's own specialization cache grew across the call — correct even
+# when the signature was warmed before tracing was armed (the bench warms
+# every chunk shape untraced, then measures traced).
+def _jit_cache_size():
+    try:
+        return schedule_compact._cache_size()  # noqa: SLF001 — jax API
+    except Exception:  # noqa: BLE001 — older jax: attribution unavailable
+        return None
+
+
+def _trace_span():
+    """The ambient flight-recorder span, or None when tracing is off."""
+    from karmada_tpu import obs
+
+    return obs.TRACER.current() if obs.TRACER.enabled else None
+
+
 @partial(jax.jit, static_argnames=("waves", "max_nnz", "keep_sel",
                                    "use_extra", "with_used", "tier"))
 def schedule_compact(*args, waves: int, max_nnz: int, keep_sel: bool = False,
@@ -911,9 +929,15 @@ def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
     if used0 is not None:
         args = args + tuple(used0)
     use_extra = _use_extra(batch)
+    sp = _trace_span()
+    before = _jit_cache_size() if sp is not None else None
     first = schedule_compact(*args, waves=waves, max_nnz=max_nnz,
                              keep_sel=keep_sel, use_extra=use_extra,
                              with_used=with_used, tier=tier)
+    if before is not None:
+        after = _jit_cache_size()
+        if after is not None:
+            sp.set_attr(compile_cache="miss" if after > before else "hit")
     return (args, waves, keep_sel, first, max_nnz, dense_nnz, use_extra,
             with_used, tier)
 
@@ -961,9 +985,19 @@ def finalize_compact(handle):
     nnz = res[3]
     while int(nnz) > max_nnz and max_nnz < dense_nnz:
         max_nnz = min(max_nnz * 4, dense_nnz)
+        # the rare overflow re-solve usually recompiles (new max_nnz
+        # static): annotate the ambient span (the pipeline's d2h stage)
+        sp = _trace_span()
+        before = _jit_cache_size() if sp is not None else None
         res = schedule_compact(*args, waves=waves, max_nnz=max_nnz,
                                keep_sel=keep_sel, use_extra=use_extra,
                                with_used=with_used, tier=tier)
+        if sp is not None:
+            sp.set_attr(escalated_nnz=max_nnz)
+            after = _jit_cache_size()
+            if before is not None and after is not None:
+                sp.set_attr(
+                    compile_cache="miss" if after > before else "hit")
         nnz = res[3]
     idx, val, st = res[0], res[1], res[2]
     out = (np.asarray(idx), np.asarray(val), np.asarray(st), int(nnz))

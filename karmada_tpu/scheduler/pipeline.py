@@ -62,6 +62,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from karmada_tpu import obs
 from karmada_tpu.ops import tensors
 from karmada_tpu.scheduler import metrics as sm
 
@@ -246,6 +247,7 @@ class _InFlight:
     used0: Optional[tuple]   # the dispatch's carry-in operands
     t_submit: float
     encode_s: float
+    span: object = None      # the chunk's wall span (None: tracing off)
 
 
 def run_pipeline(
@@ -309,12 +311,30 @@ def run_pipeline(
     keep_sel = enable_empty_workload_propagation
     chain = _CarryChain() if carry else None
     carry_label = "on" if carry else "off"
+    # flight recorder: one pipeline.cycle span (child of the ambient
+    # scheduler.cycle span when the service drives us, a fresh root when
+    # the bench does); traced is the ONE guard every per-chunk call site
+    # checks so the disabled path allocates no spans at all
+    tracer = obs.TRACER
+    traced = tracer.enabled
+    cyc = (tracer.start_span(obs.SPAN_PIPELINE, items=n, chunk=chunk,
+                             waves=waves, carry=carry)
+           if traced else None)
 
     def live() -> bool:
         return cancelled is None or not cancelled.is_set()
 
     def finalize(entry: _InFlight) -> None:
         batch, part = entry.batch, entry.part
+        ch_span = entry.span
+
+        def stage(name):
+            # stage spans parent on the chunk's wall span, NOT the ambient
+            # context: chunks interleave (k+1 encodes before k finalizes),
+            # so contextvar nesting would mis-parent across chunks
+            return (tracer.start_span(name, parent=ch_span)
+                    if ch_span is not None else None)
+
         t1 = time.perf_counter()
         sub: Dict[int, object] = {}
         # sub-solves FIRST: they need no main result, and for a single
@@ -333,6 +353,7 @@ def run_pipeline(
             used0_np = tuple(np.asarray(u) for u in entry.used0)
         if spread_groups:
             t_sp = time.perf_counter()
+            sp_span = stage(obs.SPAN_SPREAD)
             for (axis, tier), idxs in spread_groups.items():
                 if used0_np is not None:
                     res_g, used_sp = solve_spread(
@@ -350,11 +371,14 @@ def run_pipeline(
                         axis=axis, tier=tier,
                     )
                 sub.update(res_g)
+            if sp_span is not None:
+                sp_span.end(groups=len(spread_groups))
             if live():
                 sm.STEP_LATENCY.observe(
                     time.perf_counter() - t_sp, schedule_step=sm.STEP_SOLVE)
         if big_idx:
             t_big = time.perf_counter()
+            big_span = stage(obs.SPAN_BIG)
             if used0_np is not None:
                 big_res, big_used = solve_big(
                     part, big_idx, cindex, estimator, cache, waves=waves,
@@ -370,6 +394,8 @@ def run_pipeline(
                     enable_empty_workload_propagation=keep_sel,
                 )
             sub.update(big_res)
+            if big_span is not None:
+                big_span.end(rows=len(big_idx))
             if live():
                 sm.STEP_LATENCY.observe(
                     time.perf_counter() - t_big, schedule_step=sm.STEP_SOLVE)
@@ -377,22 +403,36 @@ def run_pipeline(
         out_local: Dict[int, object] = {}
         if entry.handle is not None:
             t_w = time.perf_counter()
+            w_span = stage(obs.SPAN_WAIT)
             wait_compact(entry.handle)  # device execution wait ...
+            if w_span is not None:
+                w_span.end()
             if live():
                 sm.STEP_LATENCY.observe(
                     time.perf_counter() - t_w, schedule_step=sm.STEP_SOLVE)
             t_d2h = time.perf_counter()  # ... then the result copy
-            fin = finalize_compact(entry.handle)
+            d2h_span = stage(obs.SPAN_D2H)
+            if d2h_span is not None:
+                # attach: the solver annotates the AMBIENT span with the
+                # rare nnz-escalation re-solve (ops/solver.finalize_compact)
+                with tracer.attach(d2h_span):
+                    fin = finalize_compact(entry.handle)
+                d2h_span.end()
+            else:
+                fin = finalize_compact(entry.handle)
             idx, val, status = fin[0], fin[1], fin[2]
             if live():
                 sm.STEP_LATENCY.observe(
                     time.perf_counter() - t_d2h, schedule_step=sm.STEP_D2H)
             t_dec = time.perf_counter()
+            dec_span = stage(obs.SPAN_DECODE)
             decoded = tensors.decode_compact(
                 batch, idx, val, status,
                 enable_empty_workload_propagation=keep_sel,
                 items=part if diagnose else None,
             )
+            if dec_span is not None:
+                dec_span.end()
             decode_s = time.perf_counter() - t_dec
             if live():
                 sm.STEP_LATENCY.observe(decode_s,
@@ -419,6 +459,11 @@ def run_pipeline(
             own_s=entry.encode_s + (t_end - t1),
             wall_s=t_end - entry.t_submit,
         )
+        if ch_span is not None:
+            # closed even for a cancelled cycle: the trace is exactly the
+            # evidence the degradation guard otherwise discards
+            ch_span.end(n_ok=n_ok, own_s=round(stats.own_s, 6),
+                        wall_s=round(stats.wall_s, 6))
         if not live():
             return  # abandoned cycle: nothing it computed may escape
         if collect:
@@ -440,44 +485,77 @@ def run_pipeline(
             on_chunk(stats)
 
     pending: Optional[_InFlight] = None
-    for ci in range((n + chunk - 1) // chunk):
-        if not live():
-            break
-        if skip is not None and skip(ci):
-            continue
-        lo = ci * chunk
-        part = items[lo:lo + chunk]
-        tc = time.perf_counter()
-        batch = tensors.encode_batch(part, cindex, estimator, cache=cache)
-        t1 = time.perf_counter()
-        if live():
-            sm.STEP_LATENCY.observe(t1 - tc, schedule_step=sm.STEP_ENCODE)
-        if not live():
-            break
-        # without carry an all-host chunk skips the device entirely (the
-        # pre-pipeline behavior); with carry every chunk dispatches so the
-        # chain stays contiguous (an all-invalid batch consumes nothing)
-        handle = used0 = None
-        if chain is not None or bool(np.any(batch.b_valid)):
-            t_h2d = time.perf_counter()
-            if chain is not None:
-                used0 = chain.carry_in(batch)
-            handle = dispatch_compact(
-                batch, waves=waves, keep_sel=keep_sel,
-                with_used=chain is not None, used0=used0,
-            )
-            if chain is not None:
-                chain.dispatched(batch, handle)
+    try:
+        for ci in range((n + chunk - 1) // chunk):
+            if not live():
+                break
+            if skip is not None and skip(ci):
+                continue
+            lo = ci * chunk
+            part = items[lo:lo + chunk]
+            tc = time.perf_counter()
+            ch_span = enc_span = None
+            if traced:
+                ch_span = tracer.start_span(obs.SPAN_CHUNK, parent=cyc,
+                                            index=ci, offset=lo,
+                                            n=len(part))
+                enc_span = tracer.start_span(obs.SPAN_ENCODE, parent=ch_span)
+            batch = tensors.encode_batch(part, cindex, estimator,
+                                         cache=cache)
+            t1 = time.perf_counter()
+            if enc_span is not None:
+                enc_span.end()
             if live():
-                sm.STEP_LATENCY.observe(
-                    time.perf_counter() - t_h2d, schedule_step=sm.STEP_H2D)
-        entry = _InFlight(index=ci, offset=lo, part=part, batch=batch,
-                          handle=handle, used0=used0, t_submit=tc,
-                          encode_s=t1 - tc)
-        if pending is not None:
+                sm.STEP_LATENCY.observe(t1 - tc,
+                                        schedule_step=sm.STEP_ENCODE)
+            if not live():
+                break
+            # without carry an all-host chunk skips the device entirely (the
+            # pre-pipeline behavior); with carry every chunk dispatches so the
+            # chain stays contiguous (an all-invalid batch consumes nothing)
+            handle = used0 = None
+            if chain is not None or bool(np.any(batch.b_valid)):
+                t_h2d = time.perf_counter()
+                d_span = (tracer.start_span(obs.SPAN_DISPATCH,
+                                            parent=ch_span)
+                          if ch_span is not None else None)
+                if chain is not None:
+                    used0 = chain.carry_in(batch)
+                if d_span is not None:
+                    # attach: the solver annotates the ambient span with
+                    # the jit compile-cache hit/miss (ops/solver)
+                    with tracer.attach(d_span):
+                        handle = dispatch_compact(
+                            batch, waves=waves, keep_sel=keep_sel,
+                            with_used=chain is not None, used0=used0,
+                        )
+                    d_span.end()
+                else:
+                    handle = dispatch_compact(
+                        batch, waves=waves, keep_sel=keep_sel,
+                        with_used=chain is not None, used0=used0,
+                    )
+                if chain is not None:
+                    chain.dispatched(batch, handle)
+                if live():
+                    sm.STEP_LATENCY.observe(
+                        time.perf_counter() - t_h2d,
+                        schedule_step=sm.STEP_H2D)
+            entry = _InFlight(index=ci, offset=lo, part=part, batch=batch,
+                              handle=handle, used0=used0, t_submit=tc,
+                              encode_s=t1 - tc, span=ch_span)
+            if pending is not None:
+                finalize(pending)
+            pending = entry
+        if pending is not None and live():
             finalize(pending)
-        pending = entry
-    if pending is not None and live():
-        finalize(pending)
-    res.cancelled = not live()
+    finally:
+        res.cancelled = not live()
+        if cyc is not None:
+            # ending the cycle span force-closes any still-open chunk/stage
+            # spans when it is the trace root (bench); nested under a
+            # scheduler.cycle trace the root's end does the same — either
+            # way a cancelled cycle yields a COMPLETE cancelled=true trace
+            cyc.end(cancelled=res.cancelled, chunks=res.chunks,
+                    scheduled=res.scheduled)
     return res

@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from karmada_tpu import obs
 from karmada_tpu.estimator.general import GeneralEstimator
 from karmada_tpu.models.cluster import Cluster
 from karmada_tpu.models.meta import Condition, set_condition
@@ -219,7 +220,13 @@ class Scheduler:
         if todo:
             sched_metrics.BATCH_SIZE.observe(len(todo))
             clusters = list(self.store.list(Cluster.KIND))
-            outcomes = self.schedule_batch([rb for _, rb in todo], clusters)
+            # flight recorder: one scheduler.cycle span per batched cycle
+            # (child of the worker's reconcile span); the pipeline executor,
+            # serial fallback, and estimator RPCs all nest under it
+            with obs.TRACER.span(obs.SPAN_CYCLE, bindings=len(todo),
+                                 backend=self.backend):
+                outcomes = self.schedule_batch(
+                    [rb for _, rb in todo], clusters)
             # handleErr routing (scheduler.go:829-841): UnschedulableError
             # waits for a cluster event; other failures back off and retry.
             # Success needs no forget: pop_ready removed the entry, and any
@@ -474,11 +481,16 @@ class Scheduler:
             return self._solve_device(items, clusters)
         box: Dict[str, object] = {}
         cancelled = threading.Event()
+        # thread handoff: the daemon thread adopts this (worker) thread's
+        # span so the pipeline's spans parent into the cycle trace
+        tracer = obs.TRACER
+        trace_parent = tracer.current() if tracer.enabled else None
 
         def run() -> None:
             try:
-                box["res"] = self._solve_device(items, clusters,
-                                                cancelled=cancelled)
+                with tracer.attach(trace_parent):
+                    box["res"] = self._solve_device(items, clusters,
+                                                    cancelled=cancelled)
             except Exception as e:  # noqa: BLE001 — re-raised on the caller
                 box["err"] = e
 
@@ -488,6 +500,13 @@ class Scheduler:
         t.join(self.device_cycle_timeout_s)
         if t.is_alive():
             cancelled.set()  # the zombie must stop touching shared state
+            if trace_parent is not None:
+                # the abandoned cycle's trace is precisely the evidence the
+                # guard used to discard: mark it and let the root's end
+                # force-close the zombie's dangling stage spans
+                trace_parent.set_attr(
+                    cancelled=True, device_cycle_abandoned=True,
+                    timeout_s=self.device_cycle_timeout_s)
             from karmada_tpu import native as native_mod
 
             self.backend = ("native" if native_mod.available() else "serial")
@@ -532,15 +551,16 @@ class Scheduler:
         host_idx = [i for i in range(len(items)) if i not in device_set]
         if host_idx:
             t3 = time.perf_counter()
-            for i in host_idx:
-                spec, status = items[i]
-                try:
-                    out[i] = serial.schedule(
-                        spec, status, clusters, cal,
-                        enable_empty_workload_propagation=self.enable_empty_workload_propagation,
-                    )
-                except Exception as e:  # noqa: BLE001 — per-binding failure object
-                    out[i] = e
+            with obs.TRACER.span(obs.SPAN_SERIAL, bindings=len(host_idx)):
+                for i in host_idx:
+                    spec, status = items[i]
+                    try:
+                        out[i] = serial.schedule(
+                            spec, status, clusters, cal,
+                            enable_empty_workload_propagation=self.enable_empty_workload_propagation,
+                        )
+                    except Exception as e:  # noqa: BLE001 — per-binding failure object
+                        out[i] = e
             sched_metrics.STEP_LATENCY.observe(
                 time.perf_counter() - t3, schedule_step=sched_metrics.STEP_SERIAL
             )

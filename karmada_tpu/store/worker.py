@@ -20,6 +20,8 @@ import traceback
 from collections import OrderedDict
 from typing import Callable, Dict, Hashable, List, Optional
 
+from karmada_tpu import obs
+
 
 class AsyncWorker:
     """Dedup-ing work queue: enqueueing an in-queue key is a no-op; a key
@@ -34,6 +36,9 @@ class AsyncWorker:
         self._retries: Dict[Hashable, int] = {}
         self._processing: set = set()
         self._dirty: set = set()
+        # first-enqueue timestamps for the flight recorder's queue-dwell
+        # attribute; only populated while tracing is enabled
+        self._enqueued_at: Dict[Hashable, float] = {}
         self._cv = threading.Condition()
         self._stopped = False
 
@@ -42,18 +47,22 @@ class AsyncWorker:
             if key in self._processing:
                 self._dirty.add(key)
                 return
+            if obs.TRACER.enabled and key not in self._queue:
+                self._enqueued_at[key] = time.perf_counter()
             self._queue[key] = None
             self._cv.notify()
 
-    def _pop(self, block: bool) -> Optional[Hashable]:
+    def _pop(self, block: bool):
+        """Returns (key, first_enqueue_ts) — ts is None when tracing was
+        off at enqueue time (or the key was requeued internally)."""
         with self._cv:
             while not self._queue:
                 if not block or self._stopped:
-                    return None
+                    return None, None
                 self._cv.wait(timeout=0.2)
             key, _ = self._queue.popitem(last=False)
             self._processing.add(key)
-            return key
+            return key, self._enqueued_at.pop(key, None)
 
     def _done(self, key: Hashable, requeue: bool) -> None:
         with self._cv:
@@ -81,13 +90,28 @@ class AsyncWorker:
 
         A reconcile that raises (or returns False) is requeued with a retry
         budget — mirroring workqueue rate-limited requeue.
+
+        With the flight recorder armed, every reconcile runs inside a
+        "reconcile.<worker>" span carrying the key and its queue dwell
+        time — the root every controller's nested spans parent into.
         """
-        key = self._pop(block)
+        key, enq_t = self._pop(block)
         if key is None:
             return False
         requeue = False
+        tracer = obs.TRACER
         try:
-            result = self.reconcile(key)
+            if tracer.enabled:
+                span = tracer.start_span(
+                    obs.SPAN_RECONCILE_PREFIX + self.name,
+                    key=repr(key)[:120])
+                if enq_t is not None:
+                    span.set_attr(queue_dwell_s=round(
+                        time.perf_counter() - enq_t, 6))
+                with span:
+                    result = self.reconcile(key)
+            else:
+                result = self.reconcile(key)
             requeue = result is False
         except Exception:  # noqa: BLE001 — controller loops never die
             traceback.print_exc()

@@ -20,7 +20,68 @@ from __future__ import annotations
 
 import subprocess
 import sys
+import threading
 import time
+
+from karmada_tpu.utils.metrics import REGISTRY
+
+# -- probe history (the "chip never answered" condition, made visible) -------
+# The watcher log was the ONLY place 77 consecutive probe timeouts ever
+# appeared; these export the same trajectory from the serve path: last
+# outcome + a consecutive-failure counter in /metrics, and a structured
+# snapshot in /debug/state (utils/httpserve pulls last_probe()).
+PROBE_ATTEMPTS = REGISTRY.counter(
+    "karmada_device_probe_attempts_total",
+    "Device-backend health probe attempts by outcome",
+    ("ok",),
+)
+PROBE_LAST_OK = REGISTRY.gauge(
+    "karmada_device_probe_last_ok",
+    "1 when the most recent device probe answered, else 0",
+)
+PROBE_LAST_ELAPSED = REGISTRY.gauge(
+    "karmada_device_probe_last_elapsed_seconds",
+    "Wall time of the most recent device probe attempt",
+)
+PROBE_CONSECUTIVE_FAILURES = REGISTRY.gauge(
+    "karmada_device_probe_consecutive_failures",
+    "Probe failures since the last success (the chip-never-answered "
+    "trajectory)",
+)
+
+_LAST_LOCK = threading.Lock()
+_LAST: dict = {"probed": False, "ok": None, "platform": None,
+               "elapsed_s": None, "consecutive_failures": 0,
+               "at_unix": None, "error": None}
+
+
+def record_probe(diag: dict) -> None:
+    """Fold one probe_backend() result into the exported history."""
+    attempts = diag.get("attempts") or []
+    last = attempts[-1] if attempts else {}
+    ok = bool(diag.get("ok"))
+    with _LAST_LOCK:
+        _LAST.update(
+            probed=True, ok=ok,
+            platform=diag.get("platform"),
+            elapsed_s=last.get("s"),
+            at_unix=round(time.time(), 3),
+            error=None if ok else str(last.get("err", ""))[:200],
+        )
+        _LAST["consecutive_failures"] = (
+            0 if ok else _LAST["consecutive_failures"] + 1)
+        streak = _LAST["consecutive_failures"]
+    PROBE_ATTEMPTS.inc(ok=str(ok).lower())
+    PROBE_LAST_OK.set(1.0 if ok else 0.0)
+    if last.get("s") is not None:
+        PROBE_LAST_ELAPSED.set(float(last["s"]))
+    PROBE_CONSECUTIVE_FAILURES.set(streak)
+
+
+def last_probe() -> dict:
+    """Snapshot of the most recent probe outcome (for /debug/state)."""
+    with _LAST_LOCK:
+        return dict(_LAST)
 
 # jit one tiny matmul: proves the backend not only initialises but also
 # compiles + executes (a half-dead tunnel can pass init and hang dispatch)
@@ -58,6 +119,7 @@ def probe_backend(timeout_s: float = 330.0) -> dict:
             if line.startswith("PLATFORM="):
                 diag.update(ok=True, platform=line.split("=", 1)[1])
                 diag["attempts"].append({"ok": True, "s": elapsed})
+                record_probe(diag)
                 return diag
         diag["attempts"].append({
             "ok": False, "s": elapsed, "rc": r.returncode,
@@ -68,6 +130,7 @@ def probe_backend(timeout_s: float = 330.0) -> dict:
             "ok": False, "s": round(time.perf_counter() - t0, 1),
             "err": f"probe timed out after {timeout_s}s (backend init hang)",
         })
+    record_probe(diag)
     return diag
 
 
@@ -88,6 +151,10 @@ def resolve_backend(requested: str, probe_timeout_s: float = 240.0,
     if requested != "device":
         return requested, {"probed": False}
     diag = dict((probe or probe_backend)(timeout_s=probe_timeout_s))
+    if probe is not None:
+        # probe_backend records its own history; an injected probe's
+        # outcome must reach the exported trajectory the same way
+        record_probe(diag)
     platform = str(diag.get("platform") or "").lower()
     if diag.get("ok") and any(p in platform for p in ACCELERATOR_PLATFORMS):
         return "device", diag

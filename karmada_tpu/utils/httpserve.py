@@ -7,8 +7,17 @@ ThreadingHTTPServer serves:
     /metrics   Prometheus text exposition (utils/metrics.REGISTRY)
     /healthz   liveness ("ok")
     /readyz    readiness: the supplied probe callback (e.g. store reachable)
-    /debug/state   JSON object-count snapshot per kind (the pprof analog:
-                   what is this plane holding right now)
+    /debug/state   JSON snapshot: object counts per kind, the device-probe
+                   history (utils/deviceprobe), trace-recorder stats
+    /debug/traces        recent flight-recorder ring (JSON, full spans)
+    /debug/traces/slow   the always-retained slowest-cycles shelf (JSON)
+    /debug/traces/{id}   one trace as a text waterfall
+                         (?format=json for the raw trace)
+
+The trace endpoints read the process-wide tracer (karmada_tpu.obs.TRACER,
+armed by `karmadactl serve --trace-buffer N`) unless an explicit recorder
+is injected; with tracing disabled they answer {"enabled": false} rather
+than 404 so a dashboard can poll unconditionally.
 """
 
 from __future__ import annotations
@@ -24,40 +33,93 @@ class ObservabilityServer:
         store=None,
         registry=None,
         ready_probe: Optional[Callable[[], bool]] = None,
+        recorder=None,
     ) -> None:
         from karmada_tpu.utils.metrics import REGISTRY
 
         self.store = store
         self.registry = registry if registry is not None else REGISTRY
         self.ready_probe = ready_probe
+        self._recorder = recorder
         self._httpd = None
         self._thread: Optional[threading.Thread] = None
 
+    def _trace_recorder(self):
+        if self._recorder is not None:
+            return self._recorder
+        from karmada_tpu import obs
+
+        return obs.TRACER.recorder  # None while tracing is disabled
+
     def _state(self) -> dict:
+        from karmada_tpu.utils import deviceprobe
+
         counts = self.store.counts_by_kind() if self.store is not None else {}
+        rec = self._trace_recorder()
         return {"objects_by_kind": counts,
-                "total": sum(counts.values())}
+                "total": sum(counts.values()),
+                "device_probe": deviceprobe.last_probe(),
+                "traces": rec.stats() if rec is not None else None}
+
+    def _traces_payload(self, which: str) -> dict:
+        from karmada_tpu.obs import export
+
+        rec = self._trace_recorder()
+        if rec is None:
+            return {"enabled": False, "traces": []}
+        traces = rec.slowest() if which == "slow" else rec.recent()
+        return {
+            "enabled": True,
+            "dropped": rec.dropped,
+            "summaries": [export.summarize(t) for t in traces],
+            "traces": traces,
+        }
+
+    def _one_trace(self, trace_id: str, as_json: bool):
+        """(body, ctype, code) for /debug/traces/{id}."""
+        from karmada_tpu.obs import export
+
+        rec = self._trace_recorder()
+        tr = rec.get(trace_id) if rec is not None else None
+        if tr is None:
+            return (f"trace {trace_id!r} not found".encode(),
+                    "text/plain", 404)
+        if as_json:
+            return export.to_json(tr).encode(), "application/json", 200
+        return (export.render_waterfall(tr).encode() + b"\n",
+                "text/plain", 200)
 
     def start(self, port: int = 0, host: str = "127.0.0.1") -> str:
         import http.server
+        import urllib.parse
 
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server convention
-                if self.path == "/metrics":
+                parsed = urllib.parse.urlsplit(self.path)
+                path = parsed.path
+                if path == "/metrics":
                     body = outer.registry.dump().encode()
                     ctype = "text/plain; version=0.0.4"
                     code = 200
-                elif self.path == "/healthz":
+                elif path == "/healthz":
                     body, ctype, code = b"ok", "text/plain", 200
-                elif self.path == "/readyz":
+                elif path == "/readyz":
                     ok = outer.ready_probe() if outer.ready_probe else True
                     body = b"ok" if ok else b"not ready"
                     ctype, code = "text/plain", (200 if ok else 503)
-                elif self.path == "/debug/state":
+                elif path == "/debug/state":
                     body = json.dumps(outer._state()).encode()
                     ctype, code = "application/json", 200
+                elif path in ("/debug/traces", "/debug/traces/slow"):
+                    which = "slow" if path.endswith("/slow") else "recent"
+                    body = json.dumps(outer._traces_payload(which)).encode()
+                    ctype, code = "application/json", 200
+                elif path.startswith("/debug/traces/"):
+                    trace_id = path[len("/debug/traces/"):]
+                    as_json = "format=json" in (parsed.query or "")
+                    body, ctype, code = outer._one_trace(trace_id, as_json)
                 else:
                     body, ctype, code = b"not found", "text/plain", 404
                 self.send_response(code)

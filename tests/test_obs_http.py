@@ -1,0 +1,203 @@
+"""Serve-path flight-recorder smoke (tier-1, JAX_PLATFORMS=cpu, no
+device): a 2-cycle control plane with the trace buffer armed must expose
+well-formed traces covering every pipeline stage over /debug/traces, the
+`karmadactl trace` subcommand must fetch and render them, and every
+metric/span name in the registry must be unique."""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karmada_tpu import obs
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ResourceSelector,
+)
+from karmada_tpu.utils.httpserve import ObservabilityServer
+
+
+def fetch(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def deployment(name, replicas=2):
+    return {"apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"replicas": replicas,
+                     "template": {"spec": {"containers": [
+                         {"name": "a", "resources": {
+                             "requests": {"cpu": "100m"}}}]}}}}
+
+
+@pytest.fixture
+def traced_plane():
+    """A device-backend plane with --trace-buffer semantics armed and a
+    chunk size that forces the pipelined executor to split the cycle."""
+    # ring sized so the cycle traces outlive the flood of tiny
+    # reconcile traces each tick emits (eviction is the slow
+    # shelf's job, but this test reads the ring)
+    rec = obs.TRACER.configure(capacity=2048, slow_keep=8)
+    try:
+        cp = ControlPlane(backend="device", pipeline_chunk=2)
+        cp.add_member("m1", cpu_milli=64_000)
+        cp.add_member("m2", cpu_milli=64_000)
+        cp.tick()
+        cp.apply_policy(PropagationPolicy(
+            metadata=ObjectMeta(name="pp", namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[ResourceSelector(api_version="apps/v1",
+                                                     kind="Deployment")],
+                placement=Placement(),
+            ),
+        ))
+        # cycle 1: five bindings through pipeline_chunk=2 -> 3 chunks w/carry
+        for i in range(5):
+            cp.apply(deployment(f"app-{i}"))
+        cp.tick()
+        # cycle 2: two more bindings (the "2-cycle serve")
+        for i in range(5, 7):
+            cp.apply(deployment(f"app-{i}"))
+        cp.tick()
+        for i in range(7):
+            rb = cp.store.get("ResourceBinding", "default",
+                              f"app-{i}-deployment")
+            assert rb.spec.clusters, f"app-{i} never scheduled"
+        yield cp, rec
+    finally:
+        obs.TRACER.disable()
+
+
+def _scheduler_traces(traces):
+    return [t for t in traces
+            if any(s["name"] == obs.SPAN_CYCLE for s in t["spans"])]
+
+
+def test_serve_smoke_traces_cover_every_pipeline_stage(traced_plane):
+    cp, rec = traced_plane
+    srv = ObservabilityServer(store=cp.store)
+    base = srv.start()
+    try:
+        status, body = fetch(base + "/debug/traces")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["enabled"] is True
+        cycles = _scheduler_traces(payload["traces"])
+        assert len(cycles) >= 2, "expected >= 2 scheduler cycles recorded"
+        # well-formed: unique span ids, resolvable parents, end >= start
+        for tr in payload["traces"]:
+            ids = [s["span_id"] for s in tr["spans"]]
+            assert len(ids) == len(set(ids))
+            for s in tr["spans"]:
+                assert s["end_s"] >= s["start_s"] >= 0
+                assert s["parent_id"] is None or s["parent_id"] in ids
+        # the 5-binding cycle pipelined into chunks covering every stage,
+        # with demonstrable overlap (chunk k+1 submitted inside chunk k)
+        big = max(cycles, key=lambda t: len(t["spans"]))
+        names = {s["name"] for s in big["spans"]}
+        for stage in obs.PIPELINE_STAGE_SPANS:
+            assert stage in names, f"stage {stage} missing from {names}"
+        chunks = sorted((s for s in big["spans"]
+                         if s["name"] == obs.SPAN_CHUNK),
+                        key=lambda s: s["attrs"]["index"])
+        assert len(chunks) >= 2
+        assert chunks[1]["start_s"] < chunks[0]["end_s"], (
+            "encode of chunk k+1 must overlap the in-flight chunk k")
+        # reconcile roots carry the queue-dwell attribute (store/worker)
+        dwells = [s["attrs"].get("queue_dwell_s")
+                  for t in payload["traces"] for s in t["spans"]
+                  if s["name"].startswith(obs.SPAN_RECONCILE_PREFIX)]
+        assert dwells and any(d is not None and d >= 0 for d in dwells)
+
+        # slow shelf is populated and retrieval-by-id round-trips
+        status, body = fetch(base + "/debug/traces/slow")
+        slow = json.loads(body)
+        assert status == 200 and slow["summaries"], "slow shelf empty"
+        tid = big["trace_id"]
+        status, body = fetch(f"{base}/debug/traces/{tid}")
+        assert status == 200 and obs.SPAN_CHUNK in body  # text waterfall
+        status, body = fetch(f"{base}/debug/traces/{tid}?format=json")
+        assert json.loads(body)["trace_id"] == tid
+        with pytest.raises(urllib.error.HTTPError):
+            fetch(base + "/debug/traces/nosuchtrace")
+
+        # /debug/state folds in trace stats + the probe history section
+        status, body = fetch(base + "/debug/state")
+        state = json.loads(body)
+        assert state["traces"]["recent"] >= 2
+        assert state["traces"]["capacity"] == 2048
+        assert "device_probe" in state
+    finally:
+        srv.stop()
+
+
+def test_karmadactl_trace_lists_and_renders(traced_plane, capsys):
+    from karmada_tpu import cli
+
+    cp, rec = traced_plane
+    srv = ObservabilityServer(store=cp.store)
+    base = srv.start()
+    try:
+        assert cli.main(["trace", "--endpoint", base]) == 0
+        out = capsys.readouterr().out
+        assert "TRACE" in out and "DURATION_MS" in out
+        tid = next(t["trace_id"] for t in rec.recent()
+                   if any(s["name"] == obs.SPAN_CHUNK for s in t["spans"]))
+        assert tid in out or cli.main(
+            ["trace", "--endpoint", base, "--slow"]) == 0
+        assert cli.main(["trace", "--endpoint", base, tid]) == 0
+        water = capsys.readouterr().out
+        assert obs.SPAN_CHUNK in water and "|" in water
+    finally:
+        srv.stop()
+
+
+def test_trace_cli_reports_disabled_tracer():
+    from karmada_tpu import cli
+
+    assert not obs.TRACER.enabled
+    srv = ObservabilityServer()
+    base = srv.start()
+    try:
+        assert cli.main(["trace", "--endpoint", base]) == 1
+    finally:
+        srv.stop()
+
+
+def test_registry_collision_all_metric_and_span_names_unique():
+    """Every REGISTRY-declared metric name across the package and every
+    SPAN_* constant must be unique — a silent name collision would merge
+    two unrelated series (Registry.register returns the existing object)
+    or two unrelated waterfall rows."""
+    import pathlib
+
+    import karmada_tpu
+
+    pkg = pathlib.Path(karmada_tpu.__file__).parent
+    decl = re.compile(
+        r'REGISTRY\.(?:counter|gauge|histogram)\(\s*"([^"]+)"')
+    metric_names = []
+    for path in sorted(pkg.rglob("*.py")):
+        metric_names.extend(decl.findall(path.read_text()))
+    assert metric_names, "scan found no metric declarations?"
+    dupes = {n for n in metric_names if metric_names.count(n) > 1}
+    assert not dupes, f"metric name(s) declared twice: {sorted(dupes)}"
+    assert len(set(obs.SPAN_NAMES)) == len(obs.SPAN_NAMES)
+    overlap = set(metric_names) & set(obs.SPAN_NAMES)
+    assert not overlap, f"span/metric name collision: {sorted(overlap)}"
+    # declared module objects are the canonical registry entries
+    from karmada_tpu.scheduler import metrics as sm
+    from karmada_tpu.utils import deviceprobe as dp
+    from karmada_tpu.utils.metrics import REGISTRY, _Metric
+
+    for mod in (sm, dp):
+        for attr in vars(mod).values():
+            if isinstance(attr, _Metric):
+                assert REGISTRY._metrics[attr.name] is attr  # noqa: SLF001

@@ -1,0 +1,284 @@
+"""Flight recorder (karmada_tpu/obs): span propagation across the
+pipelined executor's stages and thread handoffs, exactly-once close,
+cancelled-cycle completeness, and the zero-allocation disabled path."""
+
+import random
+import threading
+
+import pytest
+
+import bench
+from karmada_tpu import obs
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.obs.export import (
+    latest_pipeline_timeline,
+    render_waterfall,
+    stage_summary,
+)
+from karmada_tpu.ops import tensors
+from karmada_tpu.scheduler import pipeline
+
+
+@pytest.fixture
+def tracer():
+    rec = obs.TRACER.configure(capacity=64, slow_keep=4)
+    yield rec
+    obs.TRACER.disable()
+
+
+def _workload(n_bindings=12, n_clusters=24):
+    rng = random.Random(0)
+    clusters = bench.build_fleet(rng, n_clusters)
+    cindex = tensors.ClusterIndex.build(clusters)
+    placements = bench.build_placements(rng, [c.name for c in clusters])
+    items = bench.build_bindings(rng, n_bindings, placements)
+    return items, cindex, GeneralEstimator()
+
+
+def _spans_well_formed(tr):
+    ids = [s["span_id"] for s in tr["spans"]]
+    assert len(ids) == len(set(ids)), "a span closed (recorded) twice"
+    by_id = {s["span_id"]: s for s in tr["spans"]}
+    for s in tr["spans"]:
+        assert s["end_s"] >= s["start_s"] >= 0
+        if s["parent_id"] is not None:
+            assert s["parent_id"] in by_id, f"orphan span {s['name']}"
+
+
+# -- core semantics ----------------------------------------------------------
+
+def test_span_closes_exactly_once_and_nests_via_context(tracer):
+    with obs.TRACER.span("root") as root:
+        with obs.TRACER.span("child"):
+            pass
+        inner = obs.TRACER.start_span("manual", parent=root)
+        inner.end()
+        inner.end()  # double close: must not duplicate the record
+    (tr,) = tracer.recent()
+    _spans_well_formed(tr)
+    names = sorted(s["name"] for s in tr["spans"])
+    assert names == ["child", "manual", "root"]
+    ids = {s["name"]: s["span_id"] for s in tr["spans"]}
+    parents = {s["name"]: s["parent_id"] for s in tr["spans"]}
+    assert parents["child"] == ids["root"]
+    assert parents["manual"] == ids["root"]
+    assert parents["root"] is None
+
+
+def test_root_end_force_closes_open_spans_as_complete_trace(tracer):
+    root = obs.TRACER.start_span("r")
+    dangling = obs.TRACER.start_span("dangling", parent=root)
+    root.end(cancelled=True)
+    (tr,) = tracer.recent()
+    assert tr["cancelled"] is True
+    _spans_well_formed(tr)
+    d = next(s for s in tr["spans"] if s["name"] == "dangling")
+    assert d["attrs"].get("unfinished") is True
+    # a zombie ending the span after finalization is ignored
+    dangling.end()
+    assert sum(1 for s in tracer.recent()[-1]["spans"]
+               if s["name"] == "dangling") == 1
+    # ... and a zombie STARTING spans under the finalized trace gets the
+    # no-op singleton instead of minting bogus root traces into the ring
+    n_before = len(tracer.recent())
+    assert obs.TRACER.start_span("late", parent=dangling) is obs.NOOP_SPAN
+    obs.TRACER.start_span("late2", parent=dangling).end()
+    assert len(tracer.recent()) == n_before
+
+
+def test_ring_eviction_is_counted_and_slow_shelf_retained(tracer):
+    # make one deliberately slow trace, then flood the ring
+    slow = obs.TRACER.start_span("slow_root")
+    slow.trace._t0 -= 10.0  # noqa: SLF001 — 10s duration without sleeping
+    slow.end()
+    for i in range(200):
+        obs.TRACER.start_span("fast").end()
+    assert tracer.dropped > 0, "ring truncation must be counted"
+    assert tracer.stats()["recent"] == tracer.capacity
+    slowest = tracer.slowest()
+    assert slowest and slowest[0]["root"] == "slow_root", (
+        "the slowest cycle must survive a ring full of fast ones")
+    assert tracer.get(slowest[0]["trace_id"]) is not None
+
+
+# -- disabled path -----------------------------------------------------------
+
+def test_disabled_tracer_allocates_no_spans():
+    assert not obs.TRACER.enabled
+    assert obs.TRACER.start_span("x") is obs.NOOP_SPAN
+    assert obs.TRACER.span("y", k=1) is obs.NOOP_SPAN
+    assert obs.TRACER.attach(None) is obs.NOOP_SPAN
+    with obs.TRACER.span("z") as sp:
+        assert sp is obs.NOOP_SPAN
+    # the worker's dwell stamps stay empty too
+    from karmada_tpu.store.worker import AsyncWorker
+
+    w = AsyncWorker("t", lambda k: None)
+    w.enqueue("k1")
+    assert not w._enqueued_at  # noqa: SLF001
+    assert w.process_one()
+
+
+def test_disabled_pipeline_records_nothing():
+    items, cindex, est = _workload(8)
+    assert not obs.TRACER.enabled
+    res = pipeline.run_pipeline(items, cindex, est, chunk=4, waves=2,
+                                carry=True)
+    assert res.scheduled > 0
+    assert obs.TRACER.recorder is None
+
+
+# -- pipeline integration ----------------------------------------------------
+
+def test_pipeline_stage_spans_parentage_and_overlap(tracer):
+    items, cindex, est = _workload(12)
+    res = pipeline.run_pipeline(items, cindex, est, chunk=4, waves=2,
+                                carry=True)
+    assert res.chunks == 3 and not res.cancelled
+    tr = tracer.recent()[-1]
+    assert tr["root"] == obs.SPAN_PIPELINE
+    _spans_well_formed(tr)
+    names = {s["name"] for s in tr["spans"]}
+    for stage in obs.PIPELINE_STAGE_SPANS:
+        assert stage in names, f"stage {stage} missing"
+    by_id = {s["span_id"]: s for s in tr["spans"]}
+    cyc = next(s for s in tr["spans"] if s["name"] == obs.SPAN_PIPELINE)
+    chunks = sorted((s for s in tr["spans"] if s["name"] == obs.SPAN_CHUNK),
+                    key=lambda s: s["attrs"]["index"])
+    assert len(chunks) == 3
+    for ch in chunks:
+        assert ch["parent_id"] == cyc["span_id"]
+    # every stage span parents to a chunk wall span
+    for s in tr["spans"]:
+        if s["name"] in obs.PIPELINE_STAGE_SPANS:
+            assert by_id[s["parent_id"]]["name"] == obs.SPAN_CHUNK
+    # pipelining: chunk k+1 submits before chunk k finalizes (wall overlap)
+    assert chunks[1]["start_s"] < chunks[0]["end_s"]
+    # compile-cache attribution: first dispatch misses, later ones hit
+    dispatches = [s for s in tr["spans"] if s["name"] == obs.SPAN_DISPATCH]
+    caches = {by_id[s["parent_id"]]["attrs"]["index"]:
+              s["attrs"].get("compile_cache") for s in dispatches}
+    assert caches[0] == "miss" or any(v == "hit" for v in caches.values())
+    # the export helpers digest it
+    assert "#" in render_waterfall(tr)
+    tl = latest_pipeline_timeline(tracer)
+    assert tl is not None and obs.SPAN_ENCODE in tl["stages"]
+    assert tl["stages"][obs.SPAN_CHUNK]["count"] == 3
+
+
+def test_pipeline_spans_cross_thread_handoff(tracer):
+    """The guarded device cycle runs run_pipeline on a daemon thread; the
+    handoff (Tracer.attach) must parent the pipeline spans into the
+    calling thread's trace, each closing exactly once."""
+    items, cindex, est = _workload(8)
+    root = obs.TRACER.start_span("guarded_cycle")
+
+    def run():
+        with obs.TRACER.attach(root):
+            pipeline.run_pipeline(items, cindex, est, chunk=4, waves=2,
+                                  carry=True)
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    root.end()
+    tr = tracer.recent()[-1]
+    assert tr["root"] == "guarded_cycle"
+    _spans_well_formed(tr)
+    cyc = next(s for s in tr["spans"] if s["name"] == obs.SPAN_PIPELINE)
+    root_rec = next(s for s in tr["spans"] if s["name"] == "guarded_cycle")
+    assert cyc["parent_id"] == root_rec["span_id"]
+    assert any(s["name"] == obs.SPAN_ENCODE for s in tr["spans"])
+
+
+def test_cancelled_cycle_yields_complete_cancelled_trace(tracer):
+    """Mid-pipeline cancellation (the degradation guard's event) still
+    produces a finalized trace marked cancelled=true — the evidence the
+    guard previously discarded — with every span closed exactly once."""
+    items, cindex, est = _workload(12)
+    ev = threading.Event()
+
+    def on_chunk(st):
+        if st.index == 0:
+            ev.set()  # cancel after the first chunk finalizes
+
+    res = pipeline.run_pipeline(items, cindex, est, chunk=4, waves=2,
+                                carry=True, cancelled=ev,
+                                on_chunk=on_chunk)
+    assert res.cancelled
+    tr = tracer.recent()[-1]
+    assert tr["cancelled"] is True
+    _spans_well_formed(tr)
+    cyc = next(s for s in tr["spans"] if s["name"] == obs.SPAN_PIPELINE)
+    assert cyc["attrs"]["cancelled"] is True
+    # chunk 0 finalized normally; a later dispatched-but-abandoned chunk's
+    # wall span was force-closed at root end (unfinished marker)
+    chunks = {s["attrs"]["index"]: s for s in tr["spans"]
+              if s["name"] == obs.SPAN_CHUNK}
+    assert 0 in chunks and "unfinished" not in chunks[0]["attrs"]
+    assert any("unfinished" in s["attrs"] for s in tr["spans"]
+               if s["name"] == obs.SPAN_CHUNK and s is not chunks[0]), (
+        "the abandoned in-flight chunk must still appear in the trace")
+
+
+def test_stage_summary_aggregates(tracer):
+    items, cindex, est = _workload(8)
+    pipeline.run_pipeline(items, cindex, est, chunk=4, waves=2, carry=True)
+    agg = stage_summary(tracer.recent()[-1])
+    assert agg[obs.SPAN_ENCODE]["count"] == 2
+    assert agg[obs.SPAN_ENCODE]["total_s"] >= agg[obs.SPAN_ENCODE]["max_s"]
+
+
+# -- satellites: probe history + watcher JSON lines --------------------------
+
+def test_device_probe_history_exported():
+    from karmada_tpu.utils import deviceprobe
+    from karmada_tpu.utils.metrics import REGISTRY
+
+    def dead_probe(timeout_s):
+        return {"ok": False, "platform": None,
+                "attempts": [{"ok": False, "s": 1.5, "rc": 1,
+                              "err": "tunnel dead"}]}
+
+    deviceprobe.resolve_backend("device", probe=dead_probe)
+    last = deviceprobe.last_probe()
+    assert last["probed"] and last["ok"] is False
+    assert last["elapsed_s"] == 1.5 and last["error"] == "tunnel dead"
+    streak = last["consecutive_failures"]
+    assert streak >= 1
+    deviceprobe.resolve_backend("device", probe=dead_probe)
+    assert deviceprobe.last_probe()["consecutive_failures"] == streak + 1
+    assert deviceprobe.PROBE_CONSECUTIVE_FAILURES.value() == streak + 1
+    assert "karmada_device_probe_consecutive_failures" in REGISTRY.dump()
+
+    def live_probe(timeout_s):
+        return {"ok": True, "platform": "tpu",
+                "attempts": [{"ok": True, "s": 30.0}]}
+
+    backend, _ = deviceprobe.resolve_backend("device", probe=live_probe)
+    assert backend == "device"
+    last = deviceprobe.last_probe()
+    assert last["ok"] and last["consecutive_failures"] == 0
+    assert last["platform"] == "tpu"
+    assert deviceprobe.PROBE_LAST_OK.value() == 1.0
+
+
+def test_watch_bench_probe_records_are_structured_json():
+    import json
+
+    import watch_bench
+
+    rec = watch_bench.probe_record(
+        {"ok": False, "platform": None,
+         "attempts": [{"ok": False, "s": 2.0, "rc": 3, "err": "boom"}]},
+        attempt=7)
+    line = json.dumps(rec)
+    parsed = json.loads(line)
+    assert parsed["event"] == "probe" and parsed["attempt"] == 7
+    assert parsed["ok"] is False and parsed["rc"] == 3
+    assert parsed["elapsed_s"] == 2.0 and "ts" in parsed
+    ok_rec = watch_bench.probe_record(
+        {"ok": True, "platform": "tpu", "attempts": [{"ok": True, "s": 9.0}]},
+        attempt=8)
+    assert ok_rec["ok"] is True and ok_rec["platform"] == "tpu"
+    assert ok_rec["rc"] is None and ok_rec["err"] is None

@@ -10,6 +10,10 @@ full-config result has been captured (bench.py persists it to
 bench_ckpt/tpu_latest.json, which the round-end bench reports even if the
 chip is down at that moment).
 
+Probe attempts are emitted as structured JSON lines ({"event": "probe",
+ts, ok, platform, elapsed_s, rc, err}) so chip-availability trajectory
+across rounds is machine-analyzable; narrative events stay human text.
+
 Run detached:  nohup python watch_bench.py > bench_ckpt/watch.log 2>&1 &
 """
 
@@ -32,16 +36,37 @@ def log(msg: str) -> None:
     print(f"[watch {time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
 
+def probe_record(probe: dict, attempt: int) -> dict:
+    """One probe attempt as a structured record: the chip-availability
+    trajectory across rounds is machine-analyzable (grep the watch log
+    for '"event": "probe"' and plot ok/elapsed over ts) instead of being
+    locked up in free text."""
+    last = (probe.get("attempts") or [{}])[-1]
+    return {
+        "event": "probe",
+        "ts": round(time.time(), 3),
+        "attempt": attempt,
+        "ok": bool(probe.get("ok")),
+        "platform": probe.get("platform"),
+        "elapsed_s": last.get("s"),
+        "rc": last.get("rc"),
+        "err": (str(last.get("err"))[:200]
+                if last.get("err") is not None else None),
+    }
+
+
+def jlog(rec: dict) -> None:
+    print(json.dumps(rec), flush=True)
+
+
 def main() -> int:
     args = sys.argv[1:]  # forwarded to bench.py (e.g. --quick)
     attempt = 0
     while True:
         attempt += 1
         probe = bench.probe_backend(timeout_s=PROBE_TIMEOUT_S)
+        jlog(probe_record(probe, attempt))
         if not (probe["ok"] and "tpu" in str(probe["platform"]).lower()):
-            err = (probe["attempts"][-1].get("err", "?")
-                   if probe.get("attempts") else "?")
-            log(f"probe {attempt}: device not available ({str(err)[:120]})")
             time.sleep(SLEEP_BETWEEN_PROBES_S)
             continue
         log(f"probe {attempt}: TPU ANSWERED "
