@@ -109,6 +109,26 @@ def force_cpu_fallback() -> None:
     force_cpu()
 
 
+def _device_topology() -> dict:
+    """Devices THIS process ended up with (call only after the first jit
+    already ran — jax.devices() on a virgin process could hang on a dead
+    tunnel, which is exactly what the out-of-process probe exists for)."""
+    try:
+        import jax
+
+        d = jax.devices()
+        return {"devices": len(d), "platform": d[0].platform}
+    except Exception:  # noqa: BLE001 — topology is advisory
+        return {"devices": None, "platform": None}
+
+
+def _mesh_info() -> dict:
+    """Active solver-mesh snapshot (never initialises a backend)."""
+    from karmada_tpu.ops import meshing
+
+    return meshing.mesh_info()
+
+
 # -- checkpointing -----------------------------------------------------------
 # The tunnel drops mid-run (observed r3: the chip answered for ~2h windows
 # and vanished mid-bench, losing everything).  The timed run therefore
@@ -934,6 +954,7 @@ def run_native_fallback(args, rng, clusters, items, estimator, cindex,
         "vs_baseline": 0,  # not a TPU measurement, never reported as one
         "detail": {
             "platform": platform,
+            "mesh": _mesh_info(),
             "fallback_backend": "native",
             # the operational invariant VERDICT r4 demanded: the fallback
             # must be at least as fast as the serial control it replaces
@@ -968,6 +989,142 @@ def run_native_fallback(args, rng, clusters, items, estimator, cindex,
     print(json.dumps(payload))
 
 
+def _targets_of(res_map):
+    """Comparable rendering of a PipelineResult.results map: exception
+    class name for failures, {cluster: replicas} for schedules."""
+    out = {}
+    for i, r in res_map.items():
+        out[i] = (type(r).__name__ if isinstance(r, Exception)
+                  else {t.name: t.replicas for t in r})
+    return out
+
+
+def run_mesh_bench(args, shape) -> int:
+    """--mesh mode: the same workload through scheduler/pipeline twice —
+    single-device, then sharded over a (bindings, clusters) mesh — with a
+    bit-identical parity check and a topology + 1-vs-N timing payload.
+    `shape` is main()'s already-parsed --mesh value: "auto" or a (B, C)
+    tuple (main runs the regular bench when it parses to None).
+
+    Always pins virtual CPU devices BEFORE backend init (the mode
+    validates that the mesh-sharded production path compiles, executes and
+    matches, and must never block on a dead accelerator tunnel).  On this
+    platform the collectives are thread rendezvous on shared host cores,
+    so the speedup tracks spare cores, not ICI (docs/PERF_NOTES.md); the
+    topology + parity fields are the signal, the on-chip run reuses the
+    identical code path.
+    """
+    from karmada_tpu.ops import meshing
+    from karmada_tpu.utils.jaxenv import force_cpu
+
+    n_dev = (max(2, args.mesh_devices) if shape == "auto"
+             else shape[0] * shape[1])
+    pinned = force_cpu(n_dev)
+    import jax
+
+    enable_persistent_compile_cache("cpu")
+    devs = jax.devices()
+    if len(devs) < n_dev:
+        print(json.dumps({
+            "metric": "mesh bench failed (devices)", "value": 0,
+            "unit": "bindings/s", "vs_baseline": 0,
+            "detail": {"error": f"need {n_dev} devices, have {len(devs)}"
+                       + ("" if pinned else
+                          " (jax initialised before the virtual-device "
+                          "pin; run bench.py --mesh in a fresh process)")},
+        }))
+        return 1
+    if shape == "auto":
+        shape = meshing.default_shape(n_dev)
+    _hb(f"mesh bench: {shape[0]}x{shape[1]} over {n_dev} virtual "
+        f"{devs[0].platform} devices")
+
+    from karmada_tpu.scheduler import pipeline as sched_pipeline
+
+    rng = random.Random(0)
+    clusters = build_fleet(rng, args.mesh_clusters)
+    placements = build_placements(rng, [c.name for c in clusters])
+    items = build_bindings(rng, args.mesh_bindings, placements)
+    estimator = GeneralEstimator()
+    cindex = tensors.ClusterIndex.build(clusters)
+    chunk, waves = args.mesh_chunk, args.waves
+
+    def leg(label):
+        """Warm the jit signatures, then time the full workload (carry on:
+        the chunk-to-chunk device-resident carry chain is exactly what
+        must survive sharding)."""
+        cache = tensors.EncoderCache()
+        sched_pipeline.run_pipeline(
+            items[:min(chunk, len(items))], cindex, estimator, chunk=chunk,
+            waves=waves, cache=cache, carry=True, carry_spread=True)
+        tail = len(items) % chunk
+        if tail:
+            sched_pipeline.run_pipeline(
+                items[:tail], cindex, estimator, chunk=chunk, waves=waves,
+                cache=cache, carry=True, carry_spread=True)
+        _hb(f"mesh bench: {label} warmup done; timing")
+        cache.reset_for_cycle()
+        t0 = time.perf_counter()
+        res = sched_pipeline.run_pipeline(
+            items, cindex, estimator, chunk=chunk, waves=waves, cache=cache,
+            carry=True, carry_spread=True)
+        elapsed = time.perf_counter() - t0
+        _hb(f"mesh bench: {label} timed leg done in {elapsed:.1f}s "
+            f"({res.scheduled} scheduled)")
+        return elapsed, res
+
+    try:
+        meshing.deactivate()
+        single_s, single_res = leg("single-device")
+        plan = meshing.activate(shape, devices=devs)
+        assert plan is not None
+        info = meshing.mesh_info()
+        sharded_s, sharded_res = leg(f"sharded {plan.shape_str}")
+    finally:
+        meshing.deactivate()
+
+    want, got = _targets_of(single_res.results), _targets_of(
+        sharded_res.results)
+    mismatches = sorted(
+        i for i in set(want) | set(got) if want.get(i) != got.get(i))
+    n = len(items)
+    payload = {
+        "metric": (f"mesh bench: sharded ({info['shape']}) vs "
+                   f"single-device compact solve, {n} bindings x "
+                   f"{args.mesh_clusters} clusters"),
+        "value": round(n / sharded_s, 1) if sharded_s > 0 else 0,
+        "unit": "bindings/s",
+        "vs_baseline": 0,  # never a TPU headline: virtual CPU topology run
+        "detail": {
+            "mesh": info,
+            "platform": devs[0].platform,
+            "devices": len(devs),
+            "single_device_s": round(single_s, 3),
+            "sharded_s": round(sharded_s, 3),
+            "mesh_speedup": (round(single_s / sharded_s, 3)
+                             if sharded_s > 0 else None),
+            "single_device_bps": (round(n / single_s, 1)
+                                  if single_s > 0 else 0),
+            "parity_ok": not mismatches,
+            "parity_mismatches": mismatches[:16],
+            "scheduled_ok": sharded_res.scheduled,
+            "failed_by_class": sharded_res.failures,
+            "bindings": n, "clusters": args.mesh_clusters,
+            "chunk": chunk, "waves": waves,
+            "note": ("virtual CPU mesh: collectives are thread rendezvous "
+                     "on host cores, so mesh_speedup tracks the host's "
+                     "spare cores (< 1 on a one-core box), not ICI; "
+                     "parity + topology are the signal "
+                     "(docs/PERF_NOTES.md 'Mesh sharding')"),
+        },
+    }
+    if mismatches:
+        payload["metric"] = "MESH PARITY FAILED: " + payload["metric"]
+        payload["value"] = 0
+    print(json.dumps(payload))
+    return 1 if mismatches else 0
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--bindings", type=int, default=100_000)
@@ -987,6 +1144,23 @@ def main() -> None:
                          "chunk (sequential-equivalent accounting at chunk "
                          "granularity; serializes the pipeline and "
                          "disables checkpoint resume)")
+    ap.add_argument("--mesh", nargs="?", const="auto", default=None,
+                    help="mesh bench mode: run the SAME workload through "
+                         "the pipelined executor single-device and sharded "
+                         "over a (bindings, clusters) device mesh "
+                         "(ops/meshing), verify bit-identical results, and "
+                         "report topology + 1-vs-N timing in one JSON "
+                         "payload.  Value is BxC (e.g. 2x4) or 'auto' "
+                         "(factor --mesh-devices).  Always runs on virtual "
+                         "CPU devices — never blocks on the tunnel.")
+    ap.add_argument("--mesh-devices", type=int, default=8,
+                    help="virtual CPU devices to pin for --mesh auto")
+    ap.add_argument("--mesh-bindings", type=int, default=256,
+                    help="--mesh workload size (kept small: the virtual "
+                         "CPU mesh emulates collectives by thread "
+                         "rendezvous on shared host cores)")
+    ap.add_argument("--mesh-clusters", type=int, default=64)
+    ap.add_argument("--mesh-chunk", type=int, default=64)
     ap.add_argument("--inner", action="store_true",
                     help="run the bench in this process (no watchdog parent)")
     ap.add_argument("--no-progress-timeout", type=float, default=600.0,
@@ -1022,12 +1196,33 @@ def main() -> None:
         args.bindings, args.clusters, args.chunk = 2048, 256, 1024
         args.serial_sample = 32
 
+    global _HB_ON
+    if args.mesh is not None:
+        # mesh mode is self-contained: virtual CPU devices only (the mode
+        # validates mesh scaling, never the tunnel — same never-block
+        # guarantee as __graft_entry__.dryrun_multichip), so no probe and
+        # no watchdog parent.  "--mesh off"/"1x1" means NO mesh — the
+        # regular bench, same vocabulary as serve --mesh.
+        from karmada_tpu.ops import meshing as _meshing
+
+        try:
+            _shape = _meshing.parse_shape(args.mesh)
+        except ValueError as e:
+            print(json.dumps({"metric": "mesh bench failed (shape)",
+                              "value": 0, "unit": "bindings/s",
+                              "vs_baseline": 0,
+                              "detail": {"error": str(e)}}))
+            raise SystemExit(1)
+        if _shape is not None:
+            _HB_ON = True
+            raise SystemExit(run_mesh_bench(args, _shape))
+        args.mesh = None  # fall through to the regular bench
+
     if not args.inner and not args.force_cpu:
         argv = [a for a in sys.argv[1:]]  # replayed verbatim into the child
         raise SystemExit(run_with_watchdog(
             argv, args.no_progress_timeout,
             cpu_fallback=not args.no_cpu_fallback))
-    global _HB_ON
     _HB_ON = args.inner
 
     # backend bring-up: probe first (out of process), THEN point the
@@ -1288,6 +1483,11 @@ def main() -> None:
             "platform": platform,
             "waves": args.waves,
             "carry": args.carry,
+            # self-describing topology: how many devices this process saw
+            # and whether a solver mesh was active (the probe's
+            # device_count inside backend_probe covers the subprocess view)
+            "device_topology": _device_topology(),
+            "mesh": _mesh_info(),
             "cpu_fallback_speedup": None if on_tpu else round(speedup, 2),
             "backend_probe": probe,
             "batched_elapsed_s": round(elapsed, 3),

@@ -35,7 +35,8 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                 controllers: Optional[str] = None,
                 probe_device: bool = False, probe_timeout: float = 240.0,
                 device_cycle_timeout: Optional[float] = None,
-                pipeline_chunk: int = 1024):
+                pipeline_chunk: int = 1024,
+                mesh: Optional[str] = None):
     """controllers=None rehydrates the persisted --controllers spec; an
     explicit spec is also persisted so later invocations honor it.
 
@@ -53,8 +54,14 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
         backend, diag = resolve_backend(backend, probe_timeout_s=probe_timeout)
         if backend != "device":
             print(f"WARNING: {diag['degraded']}", file=sys.stderr)
+    mesh_shape = None
+    if mesh:
+        from karmada_tpu.ops.meshing import parse_shape
+
+        mesh_shape = parse_shape(mesh)  # ValueError on malformed BxC
     cp = ControlPlane(backend=backend, persist_dir=directory, waves=waves,
                       controllers=controllers, pipeline_chunk=pipeline_chunk,
+                      mesh_shape=mesh_shape,
                       device_cycle_timeout_s=device_cycle_timeout)
     if controllers is not None:
         cp.apply({"apiVersion": "v1", "kind": "ConfigMap",
@@ -900,7 +907,8 @@ def cmd_serve(args) -> int:
                          device_cycle_timeout=(
                              args.device_cycle_timeout
                              if args.device_cycle_timeout > 0 else None),
-                         pipeline_chunk=args.pipeline_chunk)
+                         pipeline_chunk=args.pipeline_chunk,
+                         mesh=args.mesh)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 1
@@ -1393,6 +1401,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "cycles larger than this split into overlapped "
                          "chunks with consumed-capacity carry "
                          "(scheduler/pipeline.py)")
+    sv.add_argument("--mesh", default="off",
+                    help="solver device mesh shape BxC (bindings x "
+                         "clusters axes, e.g. 2x4), 'auto' to factor the "
+                         "live device count, or 'off' (default): shards "
+                         "every compact solve over the mesh "
+                         "(ops/meshing.py); a single-device environment "
+                         "silently falls back to the unsharded dispatch")
     sv.add_argument("--metrics-port", type=int, default=-1,
                     help="serve /metrics,/healthz,/readyz,/debug/state on "
                          "127.0.0.1:PORT (0 = ephemeral, -1 = disabled)")

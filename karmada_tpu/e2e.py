@@ -65,6 +65,9 @@ class ControlPlane:
         waves: int = 8,
         # pipelined chunk executor chunk size (scheduler/pipeline.py)
         pipeline_chunk: int = 1024,
+        # solver device mesh shape ("BxC" / (B, C) / "auto"; None = single
+        # device) — scheduler/service.py plumbs it to ops/meshing
+        mesh_shape=None,
         # --default-not-ready/unreachable-toleration-seconds (webhook flags,
         # 300 in the reference); None disables the defaulted tolerations
         default_toleration_seconds: Optional[int] = 300,
@@ -131,6 +134,7 @@ class ControlPlane:
         self.scheduler = Scheduler(self.store, self.runtime, backend=backend,
                                    recorder=self.recorder, waves=waves,
                                    pipeline_chunk=pipeline_chunk,
+                                   mesh_shape=mesh_shape,
                                    device_cycle_timeout_s=device_cycle_timeout_s)
         self.binding_controller = BindingController(
             self.store, self.runtime, self.interpreter

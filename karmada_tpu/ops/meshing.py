@@ -1,0 +1,357 @@
+"""Mesh lifecycle + shard placement for the (bindings, clusters) solver mesh.
+
+The batched scheduling program scales over two axes: bindings are
+embarrassingly data parallel, clusters are the model axis (capacity
+tensors [C, R] and per-placement masks [P, C] shard over it; cross-
+cluster reductions become XLA collectives).  This module is the single
+authority for that mapping — the PartitionSpec per SolverBatch field,
+mesh construction, and the process-wide "active mesh" the production
+dispatch path (ops/solver.py) consults.  __graft_entry__.dryrun_multichip
+is a thin wrapper over the same tables, so the dry-run's sharding IS the
+production sharding.
+
+Fallback contract: with one device, a 1x1 shape, or no activation the
+module reports no active plan and the solver dispatches exactly as
+before — no device_put with shardings, no new jit signatures, zero added
+dispatch overhead (the single `active()` check is a list read).
+
+Divisibility: jax.device_put(NamedSharding) requires every sharded
+dimension to divide by its mesh-axis size.  Batch axes are pow2-padded
+(floor 8, ops/tensors.encode_batch), so pow2 mesh axes up to 8 always
+divide; any axis that does NOT divide (odd mesh shapes, tiny G/Q/R axes)
+degrades to replication for that dimension only — always correct, the
+solver is integer math and replication merely skips the split.
+
+All jax imports are lazy: parse_shape()/mesh_info() must be callable
+from CLI/serve code paths that may never initialise a backend.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karmada_tpu.utils.metrics import REGISTRY
+
+AXIS_BINDINGS = "bindings"
+AXIS_CLUSTERS = "clusters"
+
+# -- observability ------------------------------------------------------------
+MESH_DEVICES = REGISTRY.gauge(
+    "karmada_mesh_devices",
+    "Devices in the active solver mesh (0 = single-device fallback)",
+    ("shape", "platform"),
+)
+MESH_ENABLED = REGISTRY.gauge(
+    "karmada_mesh_enabled",
+    "1 while a multi-device solver mesh is active, else 0",
+)
+
+#: canonical positional order of ops/solver._schedule_core's array args —
+#: shared with solver._batch_args and __graft_entry__ (33 fields; the
+#: optional used0_milli/used0_pods/used0_sets carry operands follow at
+#: positions 33..35)
+BATCH_FIELDS = (
+    "cluster_valid", "deleting", "name_rank", "pods_allowed", "has_summary",
+    "avail_milli", "has_alloc", "api_ok",
+    "req_milli", "req_is_cpu", "req_pods", "est_override",
+    "pl_mask", "pl_tol_bypass", "pl_strategy", "pl_static_w",
+    "pl_has_cluster_sc", "pl_sc_min", "pl_sc_max", "pl_ignore_avail",
+    "pl_extra_score",
+    "b_valid", "placement_id", "gvk_id", "class_id", "replicas", "uid_desc",
+    "fresh", "non_workload", "nw_shortcut", "prev_idx", "prev_val",
+    "evict_idx",
+)
+
+
+def parse_shape(text) -> Optional[object]:
+    """Parse a --mesh flag value.
+
+    "BxC" -> (B, C); "off" / "" / None / "1x1" -> None (fallback);
+    "auto" -> the string "auto" (resolved against the live device count
+    at activation).  Raises ValueError on anything else.
+    """
+    if text is None:
+        return None
+    if isinstance(text, tuple):
+        db, dc = text
+        if not (isinstance(db, int) and isinstance(dc, int)
+                and db >= 1 and dc >= 1):
+            raise ValueError(f"mesh axes must be ints >= 1, got {text!r}")
+        return None if (db, dc) == (1, 1) else (db, dc)
+    s = str(text).strip().lower()
+    if s in ("", "off", "none", "0", "1", "1x1"):
+        return None
+    if s == "auto":
+        return "auto"
+    try:
+        # wrong token count or non-numeric axes both land here
+        db, dc = (int(p) for p in s.split("x"))
+    except ValueError:
+        raise ValueError(
+            f"mesh shape must be BxC or 'auto', got {text!r}") from None
+    if db < 1 or dc < 1:
+        raise ValueError(f"mesh axes must be >= 1, got {text!r}")
+    if db * dc == 1:
+        return None
+    return (db, dc)
+
+
+def default_shape(n_devices: int) -> Tuple[int, int]:
+    """The dry-run's factoring: 2 x N/2 when even, else 1 x N — bindings
+    stay the short axis (data parallelism is cheap to widen later)."""
+    db = 2 if n_devices % 2 == 0 and n_devices > 1 else 1
+    return (db, n_devices // db)
+
+
+_SPECS_CACHE: List[Optional[Dict[str, object]]] = [None]
+
+
+def shard_specs() -> Dict[str, object]:
+    """PartitionSpec per SolverBatch field over a (bindings, clusters)
+    mesh: cluster-axis capacity/mask tensors are model-parallel, binding-
+    axis rows data-parallel, request classes replicated.  Sparse
+    prev/evict shard on the binding axis only (the sparse column axis
+    Kp/Ke is tiny); the kernel scatters them to dense lanes on device.
+    Built once and cached (callers must treat it as read-only): the hot
+    dispatch path looks fields up per chunk."""
+    if _SPECS_CACHE[0] is not None:
+        return _SPECS_CACHE[0]
+    from jax.sharding import PartitionSpec as P
+
+    _SPECS_CACHE[0] = {
+        # cluster axis
+        "cluster_valid": P(AXIS_CLUSTERS), "deleting": P(AXIS_CLUSTERS),
+        "name_rank": P(AXIS_CLUSTERS), "pods_allowed": P(AXIS_CLUSTERS),
+        "has_summary": P(AXIS_CLUSTERS),
+        "avail_milli": P(AXIS_CLUSTERS, None),
+        "has_alloc": P(AXIS_CLUSTERS, None),
+        "api_ok": P(None, AXIS_CLUSTERS),
+        # request classes (replicated)
+        "req_milli": P(None, None), "req_is_cpu": P(None),
+        "req_pods": P(None), "est_override": P(None, AXIS_CLUSTERS),
+        # placements: shard the cluster axis
+        "pl_mask": P(None, AXIS_CLUSTERS),
+        "pl_tol_bypass": P(None, AXIS_CLUSTERS),
+        "pl_strategy": P(None), "pl_static_w": P(None, AXIS_CLUSTERS),
+        "pl_has_cluster_sc": P(None), "pl_sc_min": P(None),
+        "pl_sc_max": P(None), "pl_ignore_avail": P(None),
+        "pl_extra_score": P(None, AXIS_CLUSTERS),
+        # binding axis: data parallel
+        "b_valid": P(AXIS_BINDINGS), "placement_id": P(AXIS_BINDINGS),
+        "gvk_id": P(AXIS_BINDINGS), "class_id": P(AXIS_BINDINGS),
+        "replicas": P(AXIS_BINDINGS), "uid_desc": P(AXIS_BINDINGS),
+        "fresh": P(AXIS_BINDINGS), "non_workload": P(AXIS_BINDINGS),
+        "nw_shortcut": P(AXIS_BINDINGS),
+        "prev_idx": P(AXIS_BINDINGS, None),
+        "prev_val": P(AXIS_BINDINGS, None),
+        "evict_idx": P(AXIS_BINDINGS, None),
+    }
+    return _SPECS_CACHE[0]
+
+
+def used_specs() -> Tuple[object, object, object]:
+    """PartitionSpecs for the consumed-capacity carry accumulators
+    (used_milli [C, R], used_pods [C], used_sets [Q, C]): cluster-sharded
+    consistently with the capacity tensors they subtract from, so the
+    chunk-to-chunk carry chain stays device-resident with no resharding
+    between chunks."""
+    from jax.sharding import PartitionSpec as P
+
+    return (P(AXIS_CLUSTERS, None), P(AXIS_CLUSTERS), P(None, AXIS_CLUSTERS))
+
+
+def build_mesh(devices: Sequence, shape: Tuple[int, int]):
+    """A (bindings, clusters) Mesh over the first db*dc devices (row-major,
+    clusters contiguous — cross-cluster collectives ride the fastest
+    links)."""
+    from jax.sharding import Mesh
+
+    db, dc = shape
+    need = db * dc
+    if len(devices) < need:
+        raise RuntimeError(
+            f"mesh shape {db}x{dc} needs {need} devices, have {len(devices)}")
+    return Mesh(
+        [[devices[i * dc + j] for j in range(dc)] for i in range(db)],
+        (AXIS_BINDINGS, AXIS_CLUSTERS),
+    )
+
+
+def _divisible_spec(spec, shape: Tuple[int, ...], axis_sizes: Dict[str, int]):
+    """Drop mesh axes a dimension cannot divide by (replicate that dim
+    instead) — device_put(NamedSharding) hard-errors on uneven splits."""
+    from jax.sharding import PartitionSpec as P
+
+    names = list(spec) + [None] * (len(shape) - len(spec))
+    out = []
+    for dim, name in zip(shape, names):
+        if name is not None and dim % axis_sizes[name] != 0:
+            name = None
+        out.append(name)
+    return P(*out)
+
+
+def sharding_for(mesh, field: str, shape: Tuple[int, ...]):
+    """The NamedSharding for one batch field's concrete shape (uneven
+    axes degraded to replication)."""
+    from jax.sharding import NamedSharding
+
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    spec = _divisible_spec(shard_specs()[field], shape, axis_sizes)
+    return NamedSharding(mesh, spec)
+
+
+def sharded_batch_args(batch, mesh) -> tuple:
+    """The full solver arg tuple (BATCH_FIELDS order) placed on the mesh."""
+    import jax
+
+    return tuple(
+        jax.device_put(getattr(batch, f),
+                       sharding_for(mesh, f, getattr(batch, f).shape))
+        for f in BATCH_FIELDS
+    )
+
+
+def wave_output_shardings(mesh, Bw: int, C: int):
+    """Shardings for one contention wave's (rep [Bw, C], sel [Bw, C],
+    status [Bw]) — the solver pins the wave scan's stacked outputs to
+    these (ops/solver._schedule_core, shard_mesh)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    bc = _divisible_spec(P(AXIS_BINDINGS, AXIS_CLUSTERS), (Bw, C),
+                         axis_sizes)
+    b = _divisible_spec(P(AXIS_BINDINGS), (Bw,), axis_sizes)
+    return (NamedSharding(mesh, bc), NamedSharding(mesh, bc),
+            NamedSharding(mesh, b))
+
+
+def scan_result_shardings(mesh, B: int, Bw: int, C: int):
+    """Shardings for the wave scan's RESHAPED results (rep [B, C],
+    sel [B, C], status [B]).  The bindings axis participates only when
+    the PER-WAVE row count Bw divides it: sharding B when Bw does not
+    (e.g. one-binding waves) back-propagates through the reshape as a
+    sharding of the scan's stacking dimension — the index dimension of
+    its dynamic-update-slice, the exact partitioner path the shard_mesh
+    pin exists to avoid (ops/solver._schedule_core docstring)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    db = axis_sizes[AXIS_BINDINGS]
+    b_ok = Bw % db == 0 and B % db == 0
+    bc = _divisible_spec(
+        P(AXIS_BINDINGS if b_ok else None, AXIS_CLUSTERS), (B, C),
+        axis_sizes)
+    b = _divisible_spec(P(AXIS_BINDINGS if b_ok else None), (B,),
+                        axis_sizes)
+    return (NamedSharding(mesh, bc), NamedSharding(mesh, bc),
+            NamedSharding(mesh, b))
+
+
+def used_shardings(mesh, used_shapes: Sequence[Tuple[int, ...]]):
+    """NamedShardings for a (used_milli, used_pods, used_sets) triple."""
+    from jax.sharding import NamedSharding
+
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return tuple(
+        NamedSharding(mesh, _divisible_spec(spec, shape, axis_sizes))
+        for spec, shape in zip(used_specs(), used_shapes)
+    )
+
+
+# -- the process-wide active mesh --------------------------------------------
+
+
+class MeshPlan:
+    """An activated mesh: the Mesh object plus the identity the solver's
+    device-transfer cache keys on (generation) and the topology the
+    observability surfaces report."""
+
+    _GEN = [0]
+
+    def __init__(self, mesh, shape: Tuple[int, int], platform: str) -> None:
+        MeshPlan._GEN[0] += 1
+        self.generation = MeshPlan._GEN[0]
+        self.mesh = mesh
+        self.shape = shape
+        self.platform = platform
+
+    @property
+    def n_devices(self) -> int:
+        return self.shape[0] * self.shape[1]
+
+    @property
+    def shape_str(self) -> str:
+        return f"{self.shape[0]}x{self.shape[1]}"
+
+
+_LOCK = threading.Lock()
+_PLAN: List[Optional[MeshPlan]] = [None]
+
+
+def activate(shape, devices: Sequence = None) -> Optional[MeshPlan]:
+    """Activate the process-wide solver mesh.
+
+    `shape` is anything parse_shape accepts ("2x4", (2, 4), "auto", "off").
+    Returns the active MeshPlan, or None when the single-device no-op
+    fallback applies (shape off/1x1, or fewer than 2 devices available) —
+    in which case any previously active mesh is deactivated."""
+    shape = parse_shape(shape)
+    if shape is None:
+        deactivate()
+        return None
+    import jax
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if shape == "auto":
+        if len(devs) < 2:
+            deactivate()
+            return None
+        shape = default_shape(len(devs))
+    if len(devs) < shape[0] * shape[1]:
+        raise RuntimeError(
+            f"mesh shape {shape[0]}x{shape[1]} needs {shape[0] * shape[1]} "
+            f"devices, have {len(devs)} — pass a smaller --mesh or 'off'")
+    mesh = build_mesh(devs, shape)
+    plan = MeshPlan(mesh, shape, devs[0].platform)
+    with _LOCK:
+        prev = _PLAN[0]
+        _PLAN[0] = plan
+    if prev is not None and (prev.shape_str != plan.shape_str
+                             or prev.platform != plan.platform):
+        # re-activation with a different topology: zero the old label or
+        # /metrics would report two meshes as simultaneously active
+        MESH_DEVICES.set(0.0, shape=prev.shape_str, platform=prev.platform)
+    MESH_ENABLED.set(1.0)
+    MESH_DEVICES.set(float(plan.n_devices), shape=plan.shape_str,
+                     platform=plan.platform)
+    return plan
+
+
+def deactivate() -> None:
+    with _LOCK:
+        plan = _PLAN[0]
+        _PLAN[0] = None
+    MESH_ENABLED.set(0.0)
+    if plan is not None:
+        MESH_DEVICES.set(0.0, shape=plan.shape_str, platform=plan.platform)
+
+
+def active() -> Optional[MeshPlan]:
+    """The active mesh plan, or None (the single-device fallback)."""
+    return _PLAN[0]
+
+
+def mesh_info() -> dict:
+    """Structured snapshot for /debug/state and bench payloads.  Never
+    initialises a jax backend: with no active plan it reports the
+    fallback without touching jax."""
+    plan = _PLAN[0]
+    if plan is None:
+        return {"enabled": False, "shape": None, "devices": 1,
+                "platform": None}
+    return {"enabled": True, "shape": plan.shape_str,
+            "devices": plan.n_devices, "platform": plan.platform,
+            "axes": {AXIS_BINDINGS: plan.shape[0],
+                     AXIS_CLUSTERS: plan.shape[1]}}

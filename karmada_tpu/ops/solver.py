@@ -3,8 +3,13 @@
 This module is the point of the whole framework: the reference scheduler's
 per-binding hot loop (reference pkg/scheduler/core/generic_scheduler.go:71-116
 -- filter, score, spread-constraint selection, replica division) re-designed
-as one vmapped, jit-compiled program over dense (bindings x clusters) tensors,
-sharded over a TPU mesh on the cluster/binding axes.
+as one vmapped, jit-compiled program over dense (bindings x clusters) tensors.
+When a device mesh is active (ops/meshing.activate — `serve --mesh BxC`,
+`bench.py --mesh`), every dispatch places its operands with the
+(bindings, clusters) NamedShardings from ops/meshing and XLA partitions
+the program across the mesh (cluster tensors model-parallel, binding rows
+data-parallel); with no active mesh the single-device dispatch below is
+byte-for-byte the pre-mesh path.
 
 Golden contract: for every supported input class, kernels here produce
 bit-identical results to the serial control path (ops/serial.py /
@@ -45,6 +50,7 @@ import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
 
 from karmada_tpu.ops.webster import PRIORITY_QBITS  # noqa: E402
+from karmada_tpu.utils.metrics import REGISTRY  # noqa: E402
 
 MAX_INT32 = (1 << 31) - 1
 MAX_INT64 = (1 << 63) - 1
@@ -613,7 +619,7 @@ def _schedule_core(
     non_workload, nw_shortcut, prev_idx, prev_val, evict_idx,
     used0_milli=None, used0_pods=None, used0_sets=None,
     *, waves: int = 1, use_extra: bool = True, with_used: bool = False,
-    tier: str = "std",
+    tier: str = "std", shard_mesh=None,
 ):
     """The full cycle: returns (rep[B,C] int64, selected[B,C] bool, status[B]).
 
@@ -627,16 +633,25 @@ def _schedule_core(
     [B, Kp], evict_idx [B, Ke], -1 padded) and are scattered to dense [B, C]
     lanes here: the dense forms are ~hundreds of MB per chunk and would be
     transfer-bound over the host<->TPU link.
+
+    `shard_mesh` (static; the active ops/meshing Mesh, None single-device)
+    pins the wave scan's stacked outputs to explicit (bindings, clusters)
+    shardings.  Without the pin the SPMD partitioner picks shardings for
+    the scan's stacking dynamic-update-slice itself and (observed on this
+    jaxlib, multi-wave + fused extraction) emits a mixed s64/s32 offset
+    compare the HLO verifier rejects; the pin keeps it on the well-trodden
+    partition-along-data-axes path and states the intended placement
+    anyway.
     """
     B = b_valid.shape[0]
     C = cluster_valid.shape[0]
     Q = req_milli.shape[0]
     # clamp to the nearest divisor of B at or below the requested count
     # (B is pow2 when padded, arbitrary otherwise) — a configured waves=8
-    # on a tiny 4-binding cycle must degrade, not crash
-    waves = max(1, min(waves, B))
-    while B % waves:
-        waves -= 1
+    # on a tiny 4-binding cycle must degrade, not crash.  _effective_waves
+    # is the single authority: the dispatch-level mesh policy (_plan_for)
+    # relies on computing the same Bw before tracing.
+    waves = _effective_waves(B, waves)
     Bw = B // waves
 
     # scatter sparse prev/evict to dense device lanes (additive: -1 padding
@@ -710,6 +725,15 @@ def _schedule_core(
             pl_static_w[placement_id_w],
             uid_desc_w, fresh_w, non_workload_w, b_valid_w,
         )
+        if shard_mesh is not None and waves > 1:
+            # pin the scan's stacked per-wave outputs (see docstring)
+            from karmada_tpu.ops import meshing
+
+            rep_s, sel_s, st_s = meshing.wave_output_shardings(
+                shard_mesh, Bw, C)
+            rep = lax.with_sharding_constraint(rep, rep_s)
+            sel = lax.with_sharding_constraint(sel, sel_s)
+            status = lax.with_sharding_constraint(status, st_s)
 
         if waves > 1 or with_used:
             # New consumption only: replicas KEPT from the previous
@@ -754,11 +778,20 @@ def _schedule_core(
         return rep, sel, status
     used, (rep, sel, status) = lax.scan(wave_step, carry0, xs)
     C = rep.shape[-1]
-    out = (
-        rep.reshape(B, C),
-        sel.reshape(B, C),
-        status.reshape(B),
-    )
+    rep, sel, status = rep.reshape(B, C), sel.reshape(B, C), status.reshape(B)
+    if shard_mesh is not None:
+        # pin the reshaped results too: without it the partitioner can
+        # back-propagate a bindings sharding of [B] through the reshape
+        # onto the scan's stacking (index) dimension when Bw doesn't
+        # divide — the same broken partitioned-DUS path (see docstring)
+        from karmada_tpu.ops import meshing
+
+        rep_s, sel_s, st_s = meshing.scan_result_shardings(
+            shard_mesh, B, Bw, C)
+        rep = lax.with_sharding_constraint(rep, rep_s)
+        sel = lax.with_sharding_constraint(sel, sel_s)
+        status = lax.with_sharding_constraint(status, st_s)
+    out = (rep, sel, status)
     if with_used:
         return out + (used,)
     return out
@@ -772,7 +805,48 @@ def _schedule_core(
 schedule_batch = partial(
     jax.jit,
     static_argnames=("waves", "use_extra", "with_used",
-                     "tier"))(_schedule_core)
+                     "tier", "shard_mesh"))(_schedule_core)
+
+
+def _mesh_plan():
+    """The process-wide active solver mesh (ops/meshing), or None — the
+    single-device fallback, in which case every placement below is the
+    identical pre-mesh dispatch (no device_put with shardings, no new jit
+    signatures)."""
+    from karmada_tpu.ops import meshing
+
+    return meshing.active()
+
+
+def _effective_waves(B: int, waves: int) -> int:
+    """The wave clamp (nearest divisor of B at or below the requested
+    count) — the ONE implementation both _schedule_core (at trace time)
+    and the dispatch-level mesh policy (_plan_for, before tracing) use:
+    the policy's Bw must equal the kernel's or a sharded dispatch could
+    reach the partitioner path _schedule_core's pin exists to avoid."""
+    waves = max(1, min(waves, B))
+    while B % waves:
+        waves -= 1
+    return waves
+
+
+def _plan_for(batch, waves: int):
+    """The mesh plan THIS dispatch should use, or None.
+
+    Chunks whose per-wave row count Bw does not divide the bindings mesh
+    axis dispatch unsharded: sharding a handful of rows per wave buys
+    nothing (the cluster tensors are what scale), and with Bw below the
+    axis size the SPMD partitioner must shard the wave scan's stacking
+    dimension — the broken partitioned-DUS path the shard_mesh pin
+    avoids (see _schedule_core).  Sharded and single-device dispatch are
+    bit-identical, so mixing per chunk is sound."""
+    plan = _mesh_plan()
+    if plan is None:
+        return None
+    Bw = batch.B // _effective_waves(batch.B, waves)
+    if Bw % plan.shape[0] != 0:
+        return None
+    return plan
 
 
 def _compact_of(rep, sel, status, non_workload, max_nnz: int,
@@ -803,10 +877,15 @@ _NON_WORKLOAD_ARG = 28
 # when the signature was warmed before tracing was armed (the bench warms
 # every chunk shape untraced, then measures traced).
 def _jit_cache_size():
-    try:
-        return schedule_compact._cache_size()  # noqa: SLF001 — jax API
+    try:  # noqa: SLF001 — jax API
+        n = schedule_compact._cache_size()
     except Exception:  # noqa: BLE001 — older jax: attribution unavailable
         return None
+    try:
+        n += schedule_compact_donated._cache_size()  # noqa: SLF001
+    except Exception:  # noqa: BLE001 — donated variant is an optimization
+        pass
+    return n
 
 
 def _trace_span():
@@ -816,11 +895,10 @@ def _trace_span():
     return obs.TRACER.current() if obs.TRACER.enabled else None
 
 
-@partial(jax.jit, static_argnames=("waves", "max_nnz", "keep_sel",
-                                   "use_extra", "with_used", "tier"))
-def schedule_compact(*args, waves: int, max_nnz: int, keep_sel: bool = False,
-                     use_extra: bool = True, with_used: bool = False,
-                     tier: str = "std"):
+def _schedule_compact_impl(*args, waves: int, max_nnz: int,
+                           keep_sel: bool = False, use_extra: bool = True,
+                           with_used: bool = False, tier: str = "std",
+                           shard_mesh=None):
     """The full cycle with the sparse COO extraction FUSED into one jitted
     program: the dense [B, C] result planes never become jit outputs, so
     only idx/val/status/nnz (~max_nnz ints) ever leave the device.
@@ -828,7 +906,8 @@ def schedule_compact(*args, waves: int, max_nnz: int, keep_sel: bool = False,
     (used_milli [C,R], used_pods [C], used_sets [Q,C]) — the carry for a
     second-pass repack or a later batch of the same cycle."""
     core = _schedule_core(*args, waves=waves, use_extra=use_extra,
-                          with_used=with_used, tier=tier)
+                          with_used=with_used, tier=tier,
+                          shard_mesh=shard_mesh)
     if with_used:
         rep, sel, status, used = core
     else:
@@ -840,14 +919,69 @@ def schedule_compact(*args, waves: int, max_nnz: int, keep_sel: bool = False,
     return compact
 
 
+_COMPACT_STATICS = ("waves", "max_nnz", "keep_sel", "use_extra", "with_used",
+                    "tier", "shard_mesh")
+schedule_compact = partial(
+    jax.jit, static_argnames=_COMPACT_STATICS)(_schedule_compact_impl)
+
+# positions of the used0_milli/used0_pods/used0_sets carry operands in the
+# *args tuple (they follow the 33 batch fields; meshing.BATCH_FIELDS is the
+# canonical order)
+_USED0_ARGNUMS = (33, 34, 35)
+
+# Donated variant of the compact dispatch: the carry used0 operands alias
+# into the used-out outputs, so the chunk-to-chunk carry updates in place
+# instead of allocating (and on narrow links, copying) a fresh accumulator
+# generation per chunk.  Donation deletes the input buffers after the call,
+# so dispatch_compact only selects this variant when the nnz-overflow
+# escalation re-solve (which would need those buffers back) is provably
+# impossible — see _nnz_bound.
+schedule_compact_donated = partial(
+    jax.jit, static_argnames=_COMPACT_STATICS,
+    donate_argnums=_USED0_ARGNUMS)(_schedule_compact_impl)
+
+DONATED_DISPATCHES = REGISTRY.counter(
+    "karmada_solver_donated_dispatches_total",
+    "Compact dispatches whose carry used0 operands were buffer-donated",
+)
+
+
+def _nnz_bound(batch) -> int:
+    """A sound host-side upper bound on the compact extraction's nnz for
+    keep_sel=False: wide rows (Duplicated strategies, whose result can
+    span every feasible cluster, and non-workload rows, whose selection is
+    extracted) count the full cluster axis; every other valid row's rep>0
+    lanes are bounded by its OWN replica target (every division mode
+    awards at most `replicas` seats, each on a distinct lane, clamped to
+    C) plus the sparse prev-assignment width (scale-up/steady keep prev
+    lanes).  Per-row replicas — not a tier cap — because small fleets
+    (C <= COMPACT_LANES, encoded compact=False) route Divided rows of ANY
+    replica count to the device.  When the bound fits max_nnz the
+    escalation re-solve can never trigger, which is exactly the
+    precondition for buffer donation (a donated dispatch cannot re-run:
+    its inputs are gone)."""
+    strat = batch.pl_strategy[batch.placement_id]
+    valid = batch.b_valid.astype(bool)
+    wide = valid & ((strat == STRAT_DUPLICATED)
+                    | batch.non_workload.astype(bool))
+    n_wide = int(_onp.sum(wide))
+    rest = valid & ~wide
+    per_row = _onp.minimum(batch.replicas, batch.C) + batch.prev_idx.shape[1]
+    return n_wide * batch.C + int(_onp.sum(per_row[rest]))
+
+
 # Single-generation device-transfer cache for the chunk-stable cluster-side
 # tensors: the encoder hands back the SAME (frozen) numpy objects across
 # chunks of a cycle (EncoderCache.assembled), so their device copies upload
 # once per cycle instead of once per chunk (~5MB/chunk over a 36MB/s link).
 # One slot only — keyed by the identity of the whole arg tuple's first
 # member and holding the numpy refs so a GC'd id can never alias — so a
-# long-running service retains exactly one stale-free generation.
-_DEVICE_SLOT: list = [None]  # (cluster_args_np_tuple, cluster_args_dev_tuple)
+# long-running service retains exactly one stale-free generation per
+# PLACEMENT: keyed by the active mesh plan's generation (0 = unsharded), so
+# a cycle that mixes sharded chunks with per-chunk mesh fallbacks (tiny
+# tail chunks, _plan_for) keeps BOTH device copies instead of thrashing
+# one slot with re-uploads; generations of retired meshes are evicted.
+_DEVICE_SLOT: dict = {}  # mesh_gen -> (cluster_np_tuple, cluster_dev_tuple)
 
 _CLUSTER_FIELDS = (
     "cluster_valid", "deleting", "name_rank", "pods_allowed", "has_summary",
@@ -859,19 +993,37 @@ _CLUSTER_FIELDS = (
 )
 
 
-def _cluster_args(batch):
+def _put(field, arr, plan):
+    """Place one solver operand: NamedSharding from the meshing spec table
+    when a mesh is active, plain default placement otherwise."""
+    if plan is None:
+        return jax.device_put(arr)
+    from karmada_tpu.ops import meshing
+
+    return jax.device_put(
+        arr, meshing.sharding_for(plan.mesh, field, arr.shape))
+
+
+def _cluster_args(batch, plan=None):
     np_args = tuple(getattr(batch, f) for f in _CLUSTER_FIELDS)
-    slot = _DEVICE_SLOT[0]
+    gen = plan.generation if plan is not None else 0
+    slot = _DEVICE_SLOT.get(gen)
     if slot is not None and all(a is b for a, b in zip(slot[0], np_args)):
         return slot[1]
-    dev = tuple(jax.device_put(a) for a in np_args)
+    dev = tuple(_put(f, a, plan) for f, a in zip(_CLUSTER_FIELDS, np_args))
     # only cache FROZEN arrays (encode_batch(cache=...) sets writeable=False):
     # a mutable array could be modified in place between solves and the
     # identity check would then serve a stale device copy
     if all(
         not (isinstance(a, _onp.ndarray) and a.flags.writeable) for a in np_args
     ):
-        _DEVICE_SLOT[0] = (np_args, dev)
+        _DEVICE_SLOT[gen] = (np_args, dev)
+        # retain only the live placements: the unsharded slot plus the
+        # ACTIVE plan's — a retired mesh's copies are never served again
+        active = _mesh_plan()
+        keep = {0, active.generation if active is not None else 0}
+        for g in [g for g in _DEVICE_SLOT if g not in keep]:
+            del _DEVICE_SLOT[g]
     return dev
 
 
@@ -881,13 +1033,21 @@ def _use_extra(batch) -> bool:
     return bool(batch.pl_extra_score.any())
 
 
-def _batch_args(batch):
-    return _cluster_args(batch) + (
-        # binding-axis tensors change every chunk: no caching value
-        batch.b_valid, batch.placement_id, batch.gvk_id, batch.class_id,
-        batch.replicas, batch.uid_desc, batch.fresh, batch.non_workload,
-        batch.nw_shortcut, batch.prev_idx, batch.prev_val, batch.evict_idx,
-    )
+_BINDING_FIELDS = (
+    "b_valid", "placement_id", "gvk_id", "class_id", "replicas", "uid_desc",
+    "fresh", "non_workload", "nw_shortcut", "prev_idx", "prev_val",
+    "evict_idx",
+)
+
+
+def _batch_args(batch, plan=None):
+    cluster = _cluster_args(batch, plan)
+    if plan is None:
+        # binding-axis tensors change every chunk: no caching value, and
+        # jit moves raw numpy for free on the single-device path
+        return cluster + tuple(getattr(batch, f) for f in _BINDING_FIELDS)
+    return cluster + tuple(
+        _put(f, getattr(batch, f), plan) for f in _BINDING_FIELDS)
 
 
 def solve(batch, waves: int = 1, tier: str = "std"):
@@ -899,14 +1059,17 @@ def solve(batch, waves: int = 1, tier: str = "std"):
     # packed sort keys reserve _LANE_BITS bits for the cluster lane
     assert batch.C <= MAX_CLUSTER_LANES, \
         f"cluster axis must be <= {MAX_CLUSTER_LANES} per solve call"
-    rep, sel, status = schedule_batch(*_batch_args(batch), waves=waves,
-                                      use_extra=_use_extra(batch), tier=tier)
+    plan = _plan_for(batch, waves)
+    rep, sel, status = schedule_batch(
+        *_batch_args(batch, plan), waves=waves, use_extra=_use_extra(batch),
+        tier=tier, shard_mesh=plan.mesh if plan is not None else None)
     return np.asarray(rep), np.asarray(sel), np.asarray(status)
 
 
 def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
                      keep_sel: bool = False, with_used: bool = False,
-                     used0=None, tier: str = "std"):
+                     used0=None, tier: str = "std",
+                     donate_used0: bool = False):
     """Enqueue the fused device solve WITHOUT forcing the result (jax
     dispatch is async): returns an opaque handle for finalize_compact.
     Lets a caller overlap host work (encode of the next chunk, decode of
@@ -915,7 +1078,18 @@ def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
     keep_sel extracts every selected lane (empty-workload propagation);
     leave False otherwise — see _compact_of.  with_used adds the consumed-
     capacity accumulators to the result; used0 (um, up, usets) carries a
-    previous batch's consumption in."""
+    previous batch's consumption in.
+
+    donate_used0=True requests buffer donation of the used0 operands into
+    the used-out outputs (in-place chunk-to-chunk carry).  It is honored
+    only when nnz overflow — whose escalation re-solve would need the
+    donated buffers back — is provably impossible (_nnz_bound, or an
+    extraction cap already at the dense ceiling); otherwise the dispatch
+    silently stays undonated.  A donated dispatch's used0 numpy operands
+    remain readable (jax copies host arrays before donating the device
+    copy), but live jax arrays passed as used0 are DELETED — callers must
+    not read them afterwards (the pipelined executor's donation policy
+    guarantees this)."""
     assert batch.C <= MAX_CLUSTER_LANES, \
         f"cluster axis must be <= {MAX_CLUSTER_LANES} per solve call"
     dense_nnz = batch.B * batch.C
@@ -925,21 +1099,55 @@ def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
         # re-solves + recompiles on every chunk
         max_nnz = dense_nnz if keep_sel else min(
             max(batch.B * 16, 1 << 14), dense_nnz)
-    args = _batch_args(batch)
+    plan = _plan_for(batch, waves)
+    args = _batch_args(batch, plan)
     if used0 is not None:
+        if plan is not None:
+            # place the carry-in (host numpy from the keyed store, or live
+            # device arrays from the chain) with the same cluster-sharded
+            # specs as the capacity tensors it offsets: the chain stays
+            # mesh-resident with ONE stable input sharding per chunk
+            # (device_put on an already-matching Array is a no-op)
+            from karmada_tpu.ops import meshing
+
+            shards = meshing.used_shardings(
+                plan.mesh, tuple(_onp.shape(u) for u in used0))
+            used0 = tuple(jax.device_put(u, s)
+                          for u, s in zip(used0, shards))
+        else:
+            # a mesh-dispatched neighbor chunk may have handed sharded
+            # accumulators to this UNSHARDED dispatch (per-chunk mesh
+            # fallback, e.g. one-binding waves): gather them onto the
+            # default device; single-device arrays pass through untouched
+            def _gather(u):
+                s = getattr(u, "sharding", None)
+                if s is not None and len(s.device_set) > 1:
+                    return jax.device_put(u, jax.devices()[0])
+                return u
+
+            used0 = tuple(_gather(u) for u in used0)
         args = args + tuple(used0)
+    donated = bool(
+        donate_used0 and used0 is not None and not keep_sel
+        and (max_nnz >= dense_nnz or _nnz_bound(batch) <= max_nnz))
+    fn = schedule_compact_donated if donated else schedule_compact
     use_extra = _use_extra(batch)
+    shard_mesh = plan.mesh if plan is not None else None
     sp = _trace_span()
     before = _jit_cache_size() if sp is not None else None
-    first = schedule_compact(*args, waves=waves, max_nnz=max_nnz,
-                             keep_sel=keep_sel, use_extra=use_extra,
-                             with_used=with_used, tier=tier)
+    first = fn(*args, waves=waves, max_nnz=max_nnz,
+               keep_sel=keep_sel, use_extra=use_extra,
+               with_used=with_used, tier=tier, shard_mesh=shard_mesh)
+    if donated:
+        DONATED_DISPATCHES.inc()
     if before is not None:
         after = _jit_cache_size()
         if after is not None:
             sp.set_attr(compile_cache="miss" if after > before else "hit")
+        if plan is not None:
+            sp.set_attr(mesh=plan.shape_str, mesh_devices=plan.n_devices)
     return (args, waves, keep_sel, first, max_nnz, dense_nnz, use_extra,
-            with_used, tier)
+            with_used, tier, donated, shard_mesh)
 
 
 def wait_compact(handle) -> None:
@@ -947,10 +1155,15 @@ def wait_compact(handle) -> None:
     copying anything to host: lets the scheduler service time the device
     solve separately from the D2H copy (finalize_compact).  The rare
     escalation re-solve (nnz overflow) still happens inside finalize and is
-    accounted to the D2H stage there."""
+    accounted to the D2H stage there.
+
+    Blocks on the compact COO outputs only: the used-out accumulators of a
+    carried chunk may already have been buffer-donated into the NEXT
+    chunk's dispatch (deleted handles), and every output of one executable
+    completes at the same time anyway."""
     import jax
 
-    jax.block_until_ready(handle[3])
+    jax.block_until_ready(handle[3][:4])
 
 
 def dispatched_used(handle):
@@ -972,18 +1185,26 @@ def dispatched_used(handle):
 def finalize_compact(handle):
     """Force a dispatch_compact handle: (idx, val, status, nnz) numpy —
     plus (used_milli, used_pods, used_sets) when dispatched with_used.
+    The used tuple is None when those accumulators were buffer-donated
+    into a later dispatch (the carry chain consumed them in place; the
+    pipelined executor never reads them from the finalize).
 
     nnz > max_nnz escalates by re-running the fused solve with a 4x larger
     extraction cap (one recompile + re-execute per new cap — rare: the
     default cap of 16 targets/binding only overflows on pathological
-    every-binding-selects-most-clusters mixes)."""
+    every-binding-selects-most-clusters mixes).  A donated dispatch cannot
+    escalate (its inputs are gone) — dispatch_compact only donates when
+    _nnz_bound proves overflow impossible."""
     import numpy as np
 
     (args, waves, keep_sel, first, max_nnz, dense_nnz, use_extra,
-     with_used, tier) = handle
+     with_used, tier, donated, shard_mesh) = handle
     res = first
     nnz = res[3]
     while int(nnz) > max_nnz and max_nnz < dense_nnz:
+        assert not donated, (
+            "donated compact dispatch overflowed its extraction cap "
+            "(_nnz_bound unsound?)")
         max_nnz = min(max_nnz * 4, dense_nnz)
         # the rare overflow re-solve usually recompiles (new max_nnz
         # static): annotate the ambient span (the pipeline's d2h stage)
@@ -991,7 +1212,8 @@ def finalize_compact(handle):
         before = _jit_cache_size() if sp is not None else None
         res = schedule_compact(*args, waves=waves, max_nnz=max_nnz,
                                keep_sel=keep_sel, use_extra=use_extra,
-                               with_used=with_used, tier=tier)
+                               with_used=with_used, tier=tier,
+                               shard_mesh=shard_mesh)
         if sp is not None:
             sp.set_attr(escalated_nnz=max_nnz)
             after = _jit_cache_size()
@@ -1002,7 +1224,12 @@ def finalize_compact(handle):
     idx, val, st = res[0], res[1], res[2]
     out = (np.asarray(idx), np.asarray(val), np.asarray(st), int(nnz))
     if with_used:
-        return out + (tuple(np.asarray(u) for u in res[4:7]),)
+        used = res[4:7]
+        if any(getattr(u, "is_deleted", None) is not None and u.is_deleted()
+               for u in used):
+            # donated downstream: the chain already consumed them in place
+            return out + (None,)
+        return out + (tuple(np.asarray(u) for u in used),)
     return out
 
 

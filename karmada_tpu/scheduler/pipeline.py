@@ -311,6 +311,9 @@ def run_pipeline(
     keep_sel = enable_empty_workload_propagation
     chain = _CarryChain() if carry else None
     carry_label = "on" if carry else "off"
+    from karmada_tpu.ops import meshing
+
+    mesh_plan = meshing.active()  # None: single-device dispatch, as before
     # flight recorder: one pipeline.cycle span (child of the ambient
     # scheduler.cycle span when the service drives us, a fresh root when
     # the bench does); traced is the ONE guard every per-chunk call site
@@ -318,7 +321,10 @@ def run_pipeline(
     tracer = obs.TRACER
     traced = tracer.enabled
     cyc = (tracer.start_span(obs.SPAN_PIPELINE, items=n, chunk=chunk,
-                             waves=waves, carry=carry)
+                             waves=waves, carry=carry,
+                             **({"mesh": mesh_plan.shape_str,
+                                 "mesh_devices": mesh_plan.n_devices}
+                                if mesh_plan is not None else {}))
            if traced else None)
 
     def live() -> bool:
@@ -406,7 +412,13 @@ def run_pipeline(
             w_span = stage(obs.SPAN_WAIT)
             wait_compact(entry.handle)  # device execution wait ...
             if w_span is not None:
-                w_span.end()
+                # under a mesh this wait covers the cross-shard collectives
+                # (all-gathers/reductions over the cluster axis), not just
+                # the local compute — mark it so a waterfall attributes a
+                # slow wait to the right cause
+                w_span.end(**({"mesh": mesh_plan.shape_str,
+                               "collective_wait": True}
+                              if mesh_plan is not None else {}))
             if live():
                 sm.STEP_LATENCY.observe(
                     time.perf_counter() - t_w, schedule_step=sm.STEP_SOLVE)
@@ -521,6 +533,19 @@ def run_pipeline(
                           if ch_span is not None else None)
                 if chain is not None:
                     used0 = chain.carry_in(batch)
+                # buffer-donation policy: the carry-in may update in place
+                # (ops/solver donated dispatch) unless this chunk's finalize
+                # still needs to READ it on host — carry_spread hands the
+                # carry-in to the spread/big sub-solves, so chunks with such
+                # rows keep their used0 alive.  The solver additionally
+                # refuses donation whenever the nnz-escalation re-solve is
+                # not provably impossible.
+                donate = (chain is not None
+                          and not (carry_spread and bool(np.isin(
+                              batch.route,
+                              (tensors.ROUTE_DEVICE_SPREAD,
+                               tensors.ROUTE_DEVICE_SPREAD_BIG,
+                               tensors.ROUTE_DEVICE_BIG)).any())))
                 if d_span is not None:
                     # attach: the solver annotates the ambient span with
                     # the jit compile-cache hit/miss (ops/solver)
@@ -528,12 +553,14 @@ def run_pipeline(
                         handle = dispatch_compact(
                             batch, waves=waves, keep_sel=keep_sel,
                             with_used=chain is not None, used0=used0,
+                            donate_used0=donate,
                         )
                     d_span.end()
                 else:
                     handle = dispatch_compact(
                         batch, waves=waves, keep_sel=keep_sel,
                         with_used=chain is not None, used0=used0,
+                        donate_used0=donate,
                     )
                 if chain is not None:
                     chain.dispatched(batch, handle)

@@ -73,6 +73,13 @@ class Scheduler:
         # consumed-capacity carry; cycles at or under it keep the
         # single-dispatch path
         pipeline_chunk: int = 1024,
+        # solver device mesh (ops/meshing): "BxC" / (B, C) shards every
+        # compact dispatch over a (bindings, clusters) mesh — cluster
+        # tensors model-parallel, binding rows data-parallel; "auto"
+        # factors the live device count; None/"off"/1x1 (or a single
+        # device) keeps the exact single-device dispatch.  Only consulted
+        # by the device backend.
+        mesh_shape=None,
         elector=None,  # utils.leaderelection.LeaderElector (None: always lead)
         # a device cycle exceeding this many seconds marks the backend dead
         # and degrades ONE-WAY to the fastest working backend (the startup
@@ -114,6 +121,9 @@ class Scheduler:
         # is exactly the reference's one-binding-at-a-time semantics
         self.waves = max(1, waves)
         self.pipeline_chunk = max(1, pipeline_chunk)
+        self.mesh_shape = mesh_shape
+        self.mesh_plan = None
+        self._mesh_tried = False
         self.estimators = list(estimators) if estimators else [GeneralEstimator()]
         self._general = next(
             (e for e in self.estimators if isinstance(e, GeneralEstimator)),
@@ -443,6 +453,7 @@ class Scheduler:
         cleared."""
         from karmada_tpu.scheduler import pipeline
 
+        self._ensure_mesh()
         cindex = tensors.ClusterIndex.build(clusters)
         cache = self._encoder_cache(clusters)
         carry = len(items) > self.pipeline_chunk
@@ -465,6 +476,37 @@ class Scheduler:
             cancelled=cancelled,
         )
         return res.results
+
+    def _ensure_mesh(self) -> None:
+        """One-shot solver-mesh activation (ops/meshing), performed INSIDE
+        the device solve path — on the guarded daemon thread when the
+        mid-serve death guard is armed — never in __init__: activation
+        enumerates jax devices, i.e. the process's first in-process
+        backend init, which can hang indefinitely on a dead accelerator
+        tunnel.  In __init__ that hang would stop the control plane from
+        ever coming up; here it is bounded by device_cycle_timeout_s and
+        degrades like any other dead device cycle.  A single-device
+        environment takes the silent no-op fallback; an explicit shape
+        larger than the device pool warns and runs unsharded (the plane
+        must come up wherever it is pointed)."""
+        if self._mesh_tried or not self.mesh_shape:
+            return
+        self._mesh_tried = True
+        from karmada_tpu.ops import meshing
+
+        try:
+            self.mesh_plan = meshing.activate(self.mesh_shape)
+        except RuntimeError as e:
+            import sys
+
+            print(f"WARNING: {e}; scheduler runs single-device",
+                  file=sys.stderr, flush=True)
+            return
+        if self.mesh_plan is not None:
+            print(f"scheduler solver mesh active: "
+                  f"{self.mesh_plan.shape_str} over "
+                  f"{self.mesh_plan.n_devices} "
+                  f"{self.mesh_plan.platform} device(s)", flush=True)
 
     def _solve_device_guarded(
         self,
@@ -514,6 +556,13 @@ class Scheduler:
             # cycles must never share it
             self._enc_cache = None
             self._enc_spec_sig = None
+            if self.mesh_plan is not None:
+                # the device backend is gone: stop reporting an active
+                # solver mesh (/debug/state, karmada_mesh_* gauges)
+                from karmada_tpu.ops import meshing
+
+                meshing.deactivate()
+                self.mesh_plan = None
             sched_metrics.BACKEND_DEGRADED.inc(to=self.backend)
             import sys
 

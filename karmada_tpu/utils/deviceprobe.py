@@ -51,8 +51,8 @@ PROBE_CONSECUTIVE_FAILURES = REGISTRY.gauge(
 
 _LAST_LOCK = threading.Lock()
 _LAST: dict = {"probed": False, "ok": None, "platform": None,
-               "elapsed_s": None, "consecutive_failures": 0,
-               "at_unix": None, "error": None}
+               "devices": None, "elapsed_s": None,
+               "consecutive_failures": 0, "at_unix": None, "error": None}
 
 
 def record_probe(diag: dict) -> None:
@@ -64,6 +64,7 @@ def record_probe(diag: dict) -> None:
         _LAST.update(
             probed=True, ok=ok,
             platform=diag.get("platform"),
+            devices=diag.get("device_count"),
             elapsed_s=last.get("s"),
             at_unix=round(time.time(), 3),
             error=None if ok else str(last.get("err", ""))[:200],
@@ -84,13 +85,17 @@ def last_probe() -> dict:
         return dict(_LAST)
 
 # jit one tiny matmul: proves the backend not only initialises but also
-# compiles + executes (a half-dead tunnel can pass init and hang dispatch)
+# compiles + executes (a half-dead tunnel can pass init and hang dispatch).
+# NDEV makes the probe topology-aware: the mesh-sharded solve path
+# (ops/meshing) and the watcher/bench payloads report how many chips
+# actually answered, not just that one did.
 _PROBE_SNIPPET = (
     "import jax, jax.numpy as jnp;"
     "d = jax.devices();"
     "jax.jit(lambda a: a @ a)(jnp.ones((128, 128), jnp.bfloat16))"
     ".block_until_ready();"
-    "print('PLATFORM=' + d[0].platform)"
+    "print('PLATFORM=' + d[0].platform);"
+    "print('NDEV=' + str(len(d)))"
 )
 
 # platforms worth running the batched XLA program on; XLA:CPU executes it
@@ -102,12 +107,15 @@ ACCELERATOR_PLATFORMS = ("tpu", "gpu", "cuda", "rocm")
 def probe_backend(timeout_s: float = 330.0) -> dict:
     """Probe default-backend health out-of-process.
 
-    Returns ``{"ok": bool, "platform": str|None, "attempts": [...]}`` —
-    ``ok`` means the subprocess initialised a backend, compiled and ran a
-    jit within the budget; ``platform`` is whatever answered (may be
-    ``cpu`` when no accelerator is attached).
+    Returns ``{"ok": bool, "platform": str|None, "device_count": int|None,
+    "attempts": [...]}`` — ``ok`` means the subprocess initialised a
+    backend, compiled and ran a jit within the budget; ``platform`` is
+    whatever answered (may be ``cpu`` when no accelerator is attached);
+    ``device_count`` is how many devices it exposed (the mesh-sharded
+    solve's scale axis).
     """
-    diag = {"ok": False, "platform": None, "attempts": []}
+    diag = {"ok": False, "platform": None, "device_count": None,
+            "attempts": []}
     t0 = time.perf_counter()
     try:
         r = subprocess.run(
@@ -115,6 +123,12 @@ def probe_backend(timeout_s: float = 330.0) -> dict:
             capture_output=True, text=True, timeout=timeout_s,
         )
         elapsed = round(time.perf_counter() - t0, 1)
+        for line in r.stdout.splitlines():
+            if line.startswith("NDEV="):
+                try:
+                    diag["device_count"] = int(line.split("=", 1)[1])
+                except ValueError:
+                    pass
         for line in r.stdout.splitlines():
             if line.startswith("PLATFORM="):
                 diag.update(ok=True, platform=line.split("=", 1)[1])

@@ -8,7 +8,8 @@ ThreadingHTTPServer serves:
     /healthz   liveness ("ok")
     /readyz    readiness: the supplied probe callback (e.g. store reachable)
     /debug/state   JSON snapshot: object counts per kind, the device-probe
-                   history (utils/deviceprobe), trace-recorder stats
+                   history (utils/deviceprobe), the active solver mesh
+                   (ops/meshing), trace-recorder stats
     /debug/traces        recent flight-recorder ring (JSON, full spans)
     /debug/traces/slow   the always-retained slowest-cycles shelf (JSON)
     /debug/traces/{id}   one trace as a text waterfall
@@ -52,6 +53,7 @@ class ObservabilityServer:
         return obs.TRACER.recorder  # None while tracing is disabled
 
     def _state(self) -> dict:
+        from karmada_tpu.ops import meshing
         from karmada_tpu.utils import deviceprobe
 
         counts = self.store.counts_by_kind() if self.store is not None else {}
@@ -59,6 +61,10 @@ class ObservabilityServer:
         return {"objects_by_kind": counts,
                 "total": sum(counts.values()),
                 "device_probe": deviceprobe.last_probe(),
+                # the active solver mesh (ops/meshing): shape, device
+                # count, platform — {"enabled": false} on the
+                # single-device fallback; never initialises a backend
+                "mesh": meshing.mesh_info(),
                 "traces": rec.stats() if rec is not None else None}
 
     def _traces_payload(self, which: str) -> dict:

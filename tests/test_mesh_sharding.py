@@ -1,0 +1,519 @@
+"""Mesh-sharded production solve (ops/meshing + ops/solver).
+
+The scheduler hot path must produce BIT-IDENTICAL results with a
+(bindings, clusters) device mesh active — sharding changes nothing but
+the wall clock.  Covered here, on the conftest's 8-virtual-CPU-device
+platform (2-device meshes for tier-1 speed; the full 8-device parity run
+is `slow`, and __graft_entry__.dryrun_multichip covers the driver path):
+
+  * parity: run_pipeline under an active mesh vs the single-device path
+    on mixed routes (device, region-spread, big-tier, host rows), the
+    big lane tier, and a multi-chunk vocabulary-GAP carry;
+  * the no-op fallback: shape off / 1x1 / one device activates nothing
+    and the solver dispatch path is byte-identical to the pre-mesh one;
+  * buffer donation (the carry used0 micro-fix): the donated dispatch
+    engages on the chain, the chain still yields sequential-equivalent
+    pricing, and the nnz bound refuses donation when escalation is
+    possible;
+  * observability: karmada_mesh_* gauges and the /debug/state mesh
+    section reflect activation;
+  * plumbing: Scheduler(mesh_shape=) end to end through the ControlPlane.
+
+conftest caveat (utils/jaxenv.py): the suite pins EIGHT virtual devices
+before jax initialises; a later force_cpu(n_devices=2) re-pin is a no-op
+by design (the backend already satisfies >= 2 CPU devices), so 2-device
+meshes here are built over jax.devices()[:2] of the 8-device platform —
+the exact pattern __graft_entry__.dryrun_multichip(2) uses.
+"""
+
+import random
+import sys
+
+import numpy as np
+import pytest
+
+import bench
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.ops import meshing, serial, tensors
+from karmada_tpu.scheduler import pipeline
+from karmada_tpu.utils.metrics import REGISTRY
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from test_pipeline_executor import (  # noqa: E402
+    _fleet,
+    _mixed_items,
+    _results_equal,
+)
+
+GVK = ("apps/v1", "Deployment")
+
+
+@pytest.fixture(autouse=True)
+def _no_mesh_leak():
+    """Every test leaves the process-wide mesh deactivated: other test
+    modules assume the single-device dispatch path."""
+    yield
+    meshing.deactivate()
+
+
+def _activate_2dev(shape=(1, 2)):
+    import jax
+
+    plan = meshing.activate(shape, devices=jax.devices()[:2])
+    assert plan is not None
+    return plan
+
+
+def _run(items, cindex, est, **kw):
+    kw.setdefault("chunk", 4)
+    kw.setdefault("waves", 2)
+    kw.setdefault("carry", True)
+    return pipeline.run_pipeline(items, cindex, est, **kw)
+
+
+# -- shape parsing / fallback -------------------------------------------------
+
+def test_parse_shape():
+    assert meshing.parse_shape("2x4") == (2, 4)
+    assert meshing.parse_shape("1X2") == (1, 2)
+    assert meshing.parse_shape((2, 4)) == (2, 4)
+    assert meshing.parse_shape("auto") == "auto"
+    for off in (None, "", "off", "none", "1x1", "1", (1, 1)):
+        assert meshing.parse_shape(off) is None
+    for bad in ("2x", "x4", "0x4", "2x4x1", "fast", "axb", (0, 4)):
+        with pytest.raises(ValueError, match="mesh"):
+            meshing.parse_shape(bad)
+
+
+def test_single_device_fallback_is_noop():
+    """shape off / 1x1 / a one-device pool activates nothing, and the
+    solver's arg placement is the identical pre-mesh path (raw numpy
+    binding args — no committed device arrays, no new jit signatures)."""
+    import jax
+
+    from karmada_tpu.ops import solver
+
+    assert meshing.activate("off") is None
+    assert meshing.activate((1, 1)) is None
+    assert meshing.activate("auto", devices=jax.devices()[:1]) is None
+    assert meshing.active() is None
+    assert meshing.mesh_info() == {"enabled": False, "shape": None,
+                                   "devices": 1, "platform": None}
+
+    clusters, cindex = _fleet(8)
+    batch = tensors.encode_batch(_mixed_items()[:2], cindex,
+                                 GeneralEstimator())
+    args = solver._batch_args(batch)  # noqa: SLF001
+    # binding-axis operands stay the raw numpy arrays (zero added
+    # dispatch overhead on the fallback path)
+    assert args[-1] is batch.evict_idx
+    assert args[-12] is batch.b_valid
+
+
+def test_activate_requires_enough_devices():
+    import jax
+
+    with pytest.raises(RuntimeError):
+        meshing.activate((2, 4), devices=jax.devices()[:2])
+
+
+def test_scheduler_falls_back_when_mesh_exceeds_devices():
+    """An explicit mesh_shape larger than the device pool must not crash
+    the control plane: the first device cycle's activation attempt warns
+    and the scheduler runs single-device (activation is deferred to the
+    guarded solve path — never __init__, where a dead-tunnel jax init
+    would hang the plane's startup)."""
+    from karmada_tpu.e2e import ControlPlane
+
+    cp = ControlPlane(backend="device", mesh_shape="16x16")
+    assert cp.scheduler.mesh_plan is None  # nothing activated at init
+    cp.scheduler._ensure_mesh()  # noqa: SLF001 — first device cycle
+    assert cp.scheduler.mesh_plan is None
+    assert meshing.active() is None
+
+
+def test_reactivation_relabels_device_gauge():
+    """Re-activating with a new shape must zero the old gauge label —
+    /metrics must never report two meshes as simultaneously active."""
+    import jax
+
+    meshing.activate((1, 2), devices=jax.devices())
+    meshing.activate((2, 1), devices=jax.devices())
+    assert meshing.MESH_DEVICES.value(shape="1x2", platform="cpu") == 0.0
+    assert meshing.MESH_DEVICES.value(shape="2x1", platform="cpu") == 2.0
+    meshing.deactivate()
+
+
+# -- parity: sharded vs single-device ----------------------------------------
+
+def test_mesh_parity_mixed_routes():
+    """run_pipeline under a 2-device cluster-sharded mesh must be
+    bit-identical to the single-device path on the mixed-route matrix
+    (plain strategies, region spread, host rows)."""
+    clusters, cindex = _fleet(24)
+    est = GeneralEstimator()
+    items = _mixed_items()
+
+    want = _run(items, cindex, est)
+    assert want.results, "reference run scheduled nothing"
+
+    _activate_2dev((1, 2))  # shard the cluster axis: the collective path
+    got = _run(items, cindex, est)
+    meshing.deactivate()
+
+    assert set(got.results) == set(want.results)
+    for i in sorted(want.results):
+        _results_equal(want.results[i], got.results[i], ctx=f"binding {i}")
+
+    # and the binding (data-parallel) axis
+    _activate_2dev((2, 1))
+    got2 = _run(items, cindex, est)
+    assert set(got2.results) == set(want.results)
+    for i in sorted(want.results):
+        _results_equal(want.results[i], got2.results[i], ctx=f"binding {i}")
+
+
+def test_mesh_parity_big_tier():
+    """ROUTE_DEVICE_BIG rows (the big lane tier, C beyond COMPACT_LANES)
+    must survive sharding bit for bit — the big sub-solve dispatches
+    through the same mesh-aware path."""
+    rng = random.Random(3)
+    clusters = bench.build_fleet(rng, 560)  # pads to C=1024 > COMPACT_LANES
+    cindex = tensors.ClusterIndex.build(clusters)
+    est = GeneralEstimator()
+    # big/small mix: replicas > COMPACT_DIVISION_CAP routes to the big tier
+    from karmada_tpu.models.policy import (
+        DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+        REPLICA_DIVISION_WEIGHTED,
+        REPLICA_SCHEDULING_DIVIDED,
+        REPLICA_SCHEDULING_DUPLICATED,
+        ClusterPreferences,
+        Placement,
+        ReplicaSchedulingStrategy,
+    )
+    from karmada_tpu.models.work import (
+        ObjectReference,
+        ReplicaRequirements,
+        ResourceBindingSpec,
+        ResourceBindingStatus,
+    )
+    from karmada_tpu.utils.quantity import Quantity
+
+    def binding(b, replicas, divided=True):
+        pl = Placement(replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=(REPLICA_SCHEDULING_DIVIDED if divided
+                                     else REPLICA_SCHEDULING_DUPLICATED),
+            replica_division_preference=(REPLICA_DIVISION_WEIGHTED
+                                         if divided else None),
+            weight_preference=(ClusterPreferences(
+                dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS)
+                if divided else None)))
+        return (
+            ResourceBindingSpec(
+                resource=ObjectReference(api_version=GVK[0], kind=GVK[1],
+                                         namespace="d", name=f"a{b}",
+                                         uid=f"u{b}"),
+                replicas=replicas,
+                replica_requirements=ReplicaRequirements(resource_request={
+                    "cpu": Quantity.from_milli(100)}),
+                placement=pl),
+            ResourceBindingStatus(),
+        )
+
+    items = [binding(0, 80), binding(1, 2, divided=False), binding(2, 82),
+             binding(3, 2, divided=False)]
+    batch = tensors.encode_batch(items, cindex, est)
+    assert (batch.route == tensors.ROUTE_DEVICE_BIG).sum() == 2
+
+    # waves=2 exercises the sharded wave scan through the big lane tier
+    want = _run(items, cindex, est, chunk=2, waves=2)
+    _activate_2dev((1, 2))
+    got = _run(items, cindex, est, chunk=2, waves=2)
+    assert set(got.results) == set(want.results)
+    for i in sorted(want.results):
+        _results_equal(want.results[i], got.results[i], ctx=f"binding {i}")
+
+
+def _capacity_builders():
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from test_contention import mk_binding, mk_cluster
+
+    return mk_cluster, mk_binding
+
+
+def test_mesh_vocabulary_gap_carry():
+    """The chunk-to-chunk carry chain must stay exact under a mesh across
+    a vocabulary GAP (chunk 1's encoding drops the consumed resource):
+    the keyed CarryState re-render and the device-side remap both operate
+    on sharded accumulators."""
+    mk_cluster, mk_binding = _capacity_builders()
+    est = GeneralEstimator()
+    clusters = [mk_cluster("m1", cpu_milli=10**9, mem_units=10,
+                           pods=10**6)]
+    cindex = tensors.ClusterIndex.build(clusters)
+
+    def mem(bi, rep):
+        return mk_binding(bi, replicas=rep, cpu_milli=10, mem_units=1)
+
+    def cpu_only(bi, rep):
+        s, st = mk_binding(bi, replicas=rep, cpu_milli=10, mem_units=0)
+        s.replica_requirements.resource_request.pop("memory")
+        return s, st
+
+    items = [mem(0, 8), cpu_only(1, 5), mem(2, 8)]
+    _activate_2dev((1, 2))
+    res = pipeline.run_pipeline(items, cindex, est, chunk=1, waves=1,
+                                carry=True)
+    assert not isinstance(res.results[0], Exception)
+    assert not isinstance(res.results[1], Exception)
+    # chunk 0 consumed all 8 memory units; chunk 2 must see that through
+    # the gap even though every accumulator in between lived mesh-sharded
+    assert isinstance(res.results[2], serial.UnschedulableError)
+
+    # growth leg: vocabulary gains a resource mid-cycle (device remap)
+    clusters2 = [mk_cluster("m1", cpu_milli=1000, mem_units=10**6,
+                            pods=10**6)]
+    cindex2 = tensors.ClusterIndex.build(clusters2)
+    a = mk_binding(0, replicas=8, cpu_milli=100, mem_units=0)
+    c = mk_binding(2, replicas=1, cpu_milli=100, mem_units=1)
+    b = mk_binding(1, replicas=8, cpu_milli=100, mem_units=0)
+    res2 = pipeline.run_pipeline([a, c, b], cindex2, est, chunk=1, waves=1,
+                                 carry=True)
+    assert not isinstance(res2.results[0], Exception)
+    assert not isinstance(res2.results[1], Exception)
+    assert isinstance(res2.results[2], serial.UnschedulableError)
+
+
+def test_mesh_tiny_chunk_waves_fallback():
+    """A chunk whose per-wave row count cannot fill the bindings mesh
+    axis (Bw=1: one-binding waves on a tiny control plane — the exact
+    `serve --mesh 2x4` startup shape) must dispatch unsharded via
+    ops/solver._plan_for and still match the mesh-off result; chunks
+    whose Bw divides keep the mesh."""
+    import jax
+
+    from karmada_tpu.ops import solver
+
+    rng = random.Random(1)
+    clusters = bench.build_fleet(rng, 4)
+    placements = bench.build_placements(rng, [c.name for c in clusters])
+    items = bench.build_bindings(rng, 4, placements)  # pads to B=8
+    est = GeneralEstimator()
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch(items, cindex, est)
+
+    plan = meshing.activate((2, 4), devices=jax.devices())
+    # Bw = 8/8 = 1 < bindings axis 2: this dispatch must fall back ...
+    assert solver._plan_for(batch, 8) is None  # noqa: SLF001
+    # ... while a divisible wave count keeps the mesh
+    assert solver._plan_for(batch, 4) is plan  # noqa: SLF001
+
+    got8 = solver.solve_compact(batch, waves=8)
+    got4 = solver.solve_compact(batch, waves=4)
+    meshing.deactivate()
+    ref8 = solver.solve_compact(batch, waves=8)
+    ref4 = solver.solve_compact(batch, waves=4)
+    for got, ref in ((got8, ref8), (got4, ref4)):
+        assert got[3] == ref[3]
+        np.testing.assert_array_equal(got[0], ref[0])
+        np.testing.assert_array_equal(got[1], ref[1])
+        np.testing.assert_array_equal(got[2], ref[2])
+
+
+@pytest.mark.slow
+def test_mesh_parity_eight_devices():
+    """Full 8-device 2x4 mesh over the bench mix — heavier (the virtual
+    CPU mesh emulates collectives by thread rendezvous), so `slow`."""
+    rng = random.Random(0)
+    clusters = bench.build_fleet(rng, 24)
+    placements = bench.build_placements(rng, [c.name for c in clusters])
+    items = bench.build_bindings(rng, 32, placements)
+    est = GeneralEstimator()
+    cindex = tensors.ClusterIndex.build(clusters)
+
+    want = _run(items, cindex, est, chunk=16, waves=2)
+    plan = meshing.activate((2, 4))
+    assert plan is not None and plan.n_devices == 8
+    got = _run(items, cindex, est, chunk=16, waves=2)
+    assert set(got.results) == set(want.results)
+    for i in sorted(want.results):
+        _results_equal(want.results[i], got.results[i], ctx=f"binding {i}")
+
+
+# -- buffer donation ----------------------------------------------------------
+
+def test_donation_chain_sequential_equivalent():
+    """The donated carry dispatch must leave the chain's pricing exactly
+    sequential-equivalent: chunked execution at one binding per wave with
+    chunk-to-chunk carry equals ONE compact solve at one binding per wave
+    — and donation must actually have engaged."""
+    from karmada_tpu.ops.solver import DONATED_DISPATCHES, solve_compact
+
+    rng = random.Random(2)
+    clusters = bench.build_fleet(rng, 32)
+    placements = bench.build_placements(rng, [c.name for c in clusters])
+    items = bench.build_bindings(rng, 64, placements)
+    est = GeneralEstimator()
+    cindex = tensors.ClusterIndex.build(clusters)
+    b0 = tensors.encode_batch(items, cindex, est)
+    dev_items = [items[i] for i in range(len(items))
+                 if b0.route[i] == tensors.ROUTE_DEVICE][:32]
+    assert len(dev_items) == 32
+
+    batch = tensors.encode_batch(dev_items, cindex, est)
+    i1, v1, s1, _ = solve_compact(batch, waves=len(dev_items))
+    ref = tensors.decode_compact(batch, i1, v1, s1)
+
+    before = DONATED_DISPATCHES.value()
+    res = pipeline.run_pipeline(dev_items, cindex, est, chunk=8, waves=8,
+                                carry=True)
+    assert DONATED_DISPATCHES.value() > before, \
+        "donation never engaged on an escalation-free carry chain"
+    for j in range(len(dev_items)):
+        _results_equal(ref[j], res.results[j], ctx=f"binding {j}")
+
+
+def test_donation_handle_flag_and_deletion():
+    """Direct handle-level contract: a donated chain dispatch marks its
+    handle, deletes the upstream used-out buffers once consumed, and
+    finalize_compact reports the donated-away used tuple as None."""
+    from karmada_tpu.ops.solver import dispatch_compact, dispatched_used, \
+        finalize_compact
+
+    mk_cluster, mk_binding = _capacity_builders()
+    clusters = [mk_cluster("m1", cpu_milli=10**6, mem_units=10**6,
+                           pods=10**6)]
+    cindex = tensors.ClusterIndex.build(clusters)
+    est = GeneralEstimator()
+    batch = tensors.encode_batch(
+        [mk_binding(0, replicas=2, cpu_milli=10, mem_units=1)], cindex, est)
+
+    h1 = dispatch_compact(batch, waves=1, with_used=True,
+                          used0=None, donate_used0=False)
+    assert h1[9] is False  # no used0: nothing to donate
+    used1 = dispatched_used(h1)
+    h2 = dispatch_compact(batch, waves=1, with_used=True,
+                          used0=used1, donate_used0=True)
+    assert h2[9] is True
+    assert all(u.is_deleted() for u in used1)
+    fin1 = finalize_compact(h1)
+    assert fin1[4] is None  # donated downstream: not materializable
+    fin2 = finalize_compact(h2)
+    assert fin2[4] is not None  # chain head: still live
+
+
+def test_donation_refused_when_escalation_possible():
+    """_nnz_bound must refuse donation whenever the extraction could
+    overflow a sub-dense cap — the escalation re-solve needs the donated
+    operands back.  The bound is per-row replicas (not a tier cap):
+    small fleets (C <= COMPACT_LANES, compact=False encoding) route
+    Divided rows of ANY replica count to the device."""
+    from types import SimpleNamespace
+
+    from karmada_tpu.ops.solver import _nnz_bound
+    from karmada_tpu.ops.tensors import STRAT_DUPLICATED, STRAT_DYNAMIC
+
+    def fake(n_dup, n_div, C=2048, Kp=4, replicas=10):
+        B = n_dup + n_div
+        strat = np.array([STRAT_DUPLICATED] * n_dup
+                         + [STRAT_DYNAMIC] * n_div, np.int32)
+        return SimpleNamespace(
+            C=C,
+            pl_strategy=strat,
+            placement_id=np.arange(B, dtype=np.int32),
+            b_valid=np.ones(B, bool),
+            non_workload=np.zeros(B, bool),
+            replicas=np.full(B, replicas, np.int64),
+            prev_idx=np.full((B, Kp), -1, np.int32),
+        )
+
+    assert _nnz_bound(fake(n_dup=0, n_div=10)) == 10 * (10 + 4)
+    # big Divided rows on a small fleet (the compact=False class): each
+    # can seat up to min(replicas, C) lanes — no 64-seat cap applies
+    assert _nnz_bound(fake(n_dup=0, n_div=200, C=512, replicas=100)) \
+        == 200 * (100 + 4)
+    # replicas beyond the fleet clamp at C
+    assert _nnz_bound(fake(n_dup=0, n_div=2, C=512, replicas=10**6)) \
+        == 2 * (512 + 4)
+    # 10 duplicated rows can each legitimately fill the cluster axis
+    assert _nnz_bound(fake(n_dup=10, n_div=0)) == 10 * 2048
+    # ... which exceeds the default sub-dense cap, so a dispatch with
+    # max_nnz = 16384 < bound must NOT donate
+    assert _nnz_bound(fake(n_dup=10, n_div=0)) > 16384
+
+
+# -- observability + plumbing -------------------------------------------------
+
+def test_mesh_gauges_and_debug_state():
+    from karmada_tpu.utils.httpserve import ObservabilityServer
+
+    plan = _activate_2dev((1, 2))
+    assert meshing.MESH_ENABLED.value() == 1.0
+    assert meshing.MESH_DEVICES.value(shape="1x2", platform="cpu") == 2.0
+    assert 'karmada_mesh_devices{shape="1x2",platform="cpu"}' \
+        in REGISTRY.dump()
+    state = ObservabilityServer()._state()  # noqa: SLF001
+    assert state["mesh"] == {
+        "enabled": True, "shape": "1x2", "devices": 2, "platform": "cpu",
+        "axes": {"bindings": 1, "clusters": 2}}
+    assert plan.shape_str == "1x2"
+
+    meshing.deactivate()
+    assert meshing.MESH_ENABLED.value() == 0.0
+    assert meshing.MESH_DEVICES.value(shape="1x2", platform="cpu") == 0.0
+    assert ObservabilityServer()._state()["mesh"]["enabled"] is False  # noqa: SLF001
+
+
+def test_scheduler_mesh_plumbing_end_to_end():
+    """ControlPlane(mesh_shape=) reaches ops/meshing through the
+    scheduler, and a device-backend cycle schedules every binding with
+    the mesh active."""
+    from karmada_tpu.e2e import ControlPlane
+    from karmada_tpu.models.meta import ObjectMeta
+    from karmada_tpu.models.policy import (
+        Placement,
+        PropagationPolicy,
+        PropagationSpec,
+        ResourceSelector,
+    )
+    from karmada_tpu.models.work import ResourceBinding
+
+    cp = ControlPlane(backend="device", pipeline_chunk=4, mesh_shape="1x2")
+    try:
+        # activation is deferred to the first device solve (never
+        # __init__: a dead-tunnel jax init must not hang plane startup)
+        assert cp.scheduler.mesh_plan is None
+        for i in range(3):
+            cp.add_member(f"m{i}", cpu_milli=64_000)
+        cp.tick()
+        cp.apply_policy(PropagationPolicy(
+            metadata=ObjectMeta(name="pp", namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[ResourceSelector(api_version=GVK[0],
+                                                     kind=GVK[1])],
+                placement=Placement())))
+        for i in range(8):
+            cp.apply({"apiVersion": GVK[0], "kind": GVK[1],
+                      "metadata": {"namespace": "default", "name": f"d{i}"},
+                      "spec": {"replicas": 2}})
+        cp.tick()
+        rbs = cp.store.list(ResourceBinding.KIND)
+        assert len(rbs) == 8
+        assert all(rb.spec.clusters for rb in rbs)
+        # the first device cycle activated the mesh
+        assert cp.scheduler.mesh_plan is not None
+        assert meshing.active() is cp.scheduler.mesh_plan
+    finally:
+        meshing.deactivate()
+
+
+def test_serve_mesh_flag_parse():
+    """`serve --mesh BxC` parses through cli._load_plane's vocabulary."""
+    from karmada_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["--dir", "/tmp/x", "serve", "--mesh", "2x4"])
+    assert args.mesh == "2x4"
+    assert meshing.parse_shape(args.mesh) == (2, 4)
+    args2 = build_parser().parse_args(["--dir", "/tmp/x", "serve"])
+    assert meshing.parse_shape(args2.mesh) is None

@@ -48,6 +48,10 @@ def probe_record(probe: dict, attempt: int) -> dict:
         "attempt": attempt,
         "ok": bool(probe.get("ok")),
         "platform": probe.get("platform"),
+        # topology: how many chips answered (the mesh-sharded solve's
+        # scale axis) — MULTICHIP payloads become self-describing instead
+        # of a stderr tail
+        "devices": probe.get("device_count"),
         "elapsed_s": last.get("s"),
         "rc": last.get("rc"),
         "err": (str(last.get("err"))[:200]
@@ -70,7 +74,9 @@ def main() -> int:
             time.sleep(SLEEP_BETWEEN_PROBES_S)
             continue
         log(f"probe {attempt}: TPU ANSWERED "
-            f"({probe['attempts'][-1]['s']}s) — launching bench")
+            f"({probe['attempts'][-1]['s']}s, "
+            f"{probe.get('device_count') or '?'} device(s)) — launching "
+            "bench")
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py"),
              "--no-cpu-fallback",
