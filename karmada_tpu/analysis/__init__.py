@@ -1,0 +1,27 @@
+"""Static-analysis subsystem behind `karmadactl vet` (+ armed runtime guards).
+
+Four AST-level passes over the package, each targeting a defect class that
+unit tests on one CPU device cannot see but real multichip topologies and
+threaded serve processes can (the PR-3 s64/s32 wave-scan bug is the type
+specimen):
+
+  * trace-safety    — Python control flow on traced values, host syncs, and
+                      dtype-defaulted constructors inside jit-compiled code
+                      (karmada_tpu/analysis/trace_safety.py)
+  * dtype-contract  — SolverBatch/carry construction sites checked against
+                      the canonical per-field dtype table
+                      (ops/tensors.FIELD_DTYPES; dtype_contract.py)
+  * spec-coverage   — every SolverBatch tensor field has a PartitionSpec
+                      entry in ops/meshing.shard_specs (spec_coverage.py)
+  * guarded-by      — `# guarded-by: <lock>` annotated attributes are only
+                      mutated inside the matching `with <lock>:` block
+                      (lock_discipline.py)
+
+`vet.run_vet` orchestrates the passes; `guards` is the armed RUNTIME mode
+(`serve --check-invariants` / KARMADA_CHECK_INVARIANTS=1): shape/dtype/NaN
+invariant checks at solver entry and d2h boundaries.  All passes are pure
+AST work — no jax import, safe in any environment.
+"""
+
+from karmada_tpu.analysis.core import Finding, Waiver  # noqa: F401
+from karmada_tpu.analysis.vet import run_vet  # noqa: F401

@@ -1,0 +1,169 @@
+"""Shared plumbing for the vet passes: findings, waivers, source loading.
+
+Waiver convention (docs/STATIC_ANALYSIS.md): a finding is suppressed by
+
+    <offending statement>  # vet: ignore[<rule>] <justification>
+
+on the statement's FIRST line, or by the same comment alone on the line
+directly above it.  The justification is mandatory — a bare ignore is
+itself reported (rule "waiver-syntax") and suppresses nothing, so every
+waiver in the tree documents why the rule does not apply.  Waivers are
+never silent: run_vet counts and enumerates them in its JSON output.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: every rule a pass can emit (CLI --rules validates against this)
+RULES = (
+    "trace-branch",      # Python if/while on a traced (jnp/lax) value
+    "trace-host-sync",   # .item()/float()/int()/np.asarray inside jit code
+    "trace-weak-int",    # dtype-defaulted jnp constructor inside jit code
+    "dtype-contract",    # construction site disagrees with FIELD_DTYPES
+    "spec-coverage",     # SolverBatch field missing from shard_specs
+    "guarded-by",        # annotated state mutated outside its lock
+    "waiver-syntax",     # vet: ignore[...] without a justification
+)
+
+_WAIVER_RE = re.compile(
+    r"#\s*vet:\s*ignore\[([A-Za-z0-9_,\- ]+)\]\s*(.*?)\s*$")
+
+
+@dataclass
+class Finding:
+    rule: str
+    file: str
+    line: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "message": self.message}
+
+
+@dataclass
+class Waiver:
+    rule: str
+    file: str
+    line: int  # line of the waived FINDING (not of the comment)
+    justification: str
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "justification": self.justification}
+
+
+@dataclass
+class SourceFile:
+    """One parsed python file, shared by every pass."""
+
+    path: str
+    text: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    # comment line -> [(rule, justification)]; a waiver on line L covers
+    # findings anchored at L (trailing comment) and L+1 (comment above)
+    waivers: Dict[int, List[Tuple[str, str]]] = field(default_factory=dict)
+
+    def waiver_for(self, rule: str, line: int) -> Optional[Tuple[int, str]]:
+        """(comment_line, justification) covering (rule, line), or None."""
+        for cline in (line, line - 1):
+            for wrule, just in self.waivers.get(cline, ()):
+                if wrule == rule and just:
+                    return cline, just
+        return None
+
+
+def _collect_waivers(lines: Sequence[str]) -> Dict[int, List[Tuple[str, str]]]:
+    out: Dict[int, List[Tuple[str, str]]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _WAIVER_RE.search(line)
+        if m is None:
+            continue
+        rules = [r.strip() for r in m.group(1).split(",") if r.strip()]
+        just = m.group(2).strip()
+        out[i] = [(r, just) for r in rules]
+    return out
+
+
+def load_file(path: str) -> Optional[SourceFile]:
+    """Parse one file; None when it is not parseable python (vet reports
+    syntax errors through the caller, never crashes on them)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        tree = ast.parse(text, filename=path)
+    except (OSError, SyntaxError, ValueError):
+        return None
+    lines = text.splitlines()
+    return SourceFile(path=path, text=text, tree=tree, lines=lines,
+                      waivers=_collect_waivers(lines))
+
+
+def collect_files(paths: Sequence[str]) -> List[SourceFile]:
+    """Every .py file under the given files/directories, parsed once.
+    __pycache__ and hidden directories are skipped."""
+    seen: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        seen.append(os.path.join(root, fn))
+        elif p.endswith(".py"):
+            seen.append(p)
+    out: List[SourceFile] = []
+    for path in seen:
+        sf = load_file(path)
+        if sf is not None:
+            out.append(sf)
+    return out
+
+
+def apply_waivers(
+    findings: Sequence[Finding], files: Sequence[SourceFile]
+) -> Tuple[List[Finding], List[Waiver]]:
+    """Split raw findings into (kept, waived); also surfaces bare ignores
+    (no justification) as waiver-syntax findings — an undocumented waiver
+    is a finding, not a suppression."""
+    by_path = {sf.path: sf for sf in files}
+    kept: List[Finding] = []
+    waived: List[Waiver] = []
+    for f in findings:
+        sf = by_path.get(f.file)
+        hit = sf.waiver_for(f.rule, f.line) if sf is not None else None
+        if hit is not None:
+            waived.append(Waiver(rule=f.rule, file=f.file, line=f.line,
+                                 justification=hit[1]))
+        else:
+            kept.append(f)
+    for sf in files:
+        for cline, entries in sf.waivers.items():
+            for rule, just in entries:
+                if not just:
+                    kept.append(Finding(
+                        rule="waiver-syntax", file=sf.path, line=cline,
+                        message=f"vet: ignore[{rule}] without a "
+                                "justification — waivers must say why",
+                    ))
+    return kept, waived
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

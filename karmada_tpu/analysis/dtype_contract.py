@@ -1,0 +1,142 @@
+"""Pass 2 — SolverBatch/carry dtype contract at construction sites.
+
+The canonical table lives WITH the data it describes
+(ops/tensors.FIELD_DTYPES / CARRY_DTYPES); this pass reads it out of the
+scanned tree's AST (no import — fixtures bring their own table) and then
+checks every ``np.zeros/ones/full/empty/asarray/array`` and ``.astype``
+construction site whose assignment target is a declared field name:
+
+    name_rank = np.zeros(C, np.int32)     # finding: table says int64
+
+That is exactly the PR-3 bug class made vet-time: an s32 array where the
+kernel contract says s64 (or vice versa) is invisible on one device and a
+mixed-dtype HLO verifier failure once the SPMD partitioner is involved.
+Constructors with *no* dtype at a declared field site are also findings
+(``np.zeros`` defaults to f64).  Dtype expressions the AST cannot resolve
+(e.g. ``other.dtype`` pass-throughs, ``zeros_like``) are left alone.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence
+
+from karmada_tpu.analysis.core import Finding, SourceFile, dotted
+
+_CTOR_DTYPE_POS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2,
+                   "asarray": 1, "array": 1}
+
+#: table variable names the pass harvests from scanned files
+TABLE_NAMES = ("FIELD_DTYPES", "CARRY_DTYPES")
+
+_DTYPE_NORMALIZE = {
+    "bool": "bool", "bool_": "bool",
+    "int32": "int32", "int64": "int64",
+    "int16": "int16", "int8": "int8",
+    "float32": "float32", "float64": "float64",
+    "int": "int64", "float": "float64",  # builtins on 64-bit linux
+}
+
+
+def resolve_dtype(node: Optional[ast.AST]) -> Optional[str]:
+    """'int64' for np.int64 / jnp.int64 / "int64" / bool / int; None when
+    the expression is dynamic (e.g. ``arr.dtype``)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return _DTYPE_NORMALIZE.get(node.value)
+    d = dotted(node)
+    if d is None:
+        return None
+    return _DTYPE_NORMALIZE.get(d.rsplit(".", 1)[-1])
+
+
+def harvest_tables(files: Sequence[SourceFile]) -> Dict[str, str]:
+    """field -> dtype string, merged from every scanned FIELD_DTYPES /
+    CARRY_DTYPES dict literal."""
+    table: Dict[str, str] = {}
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if not any(n in TABLE_NAMES for n in names):
+                continue
+            if isinstance(node.value, ast.Dict):
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(v, ast.Constant) and \
+                            isinstance(k.value, str):
+                        table[k.value] = str(v.value)
+    return table
+
+
+def _dtype_arg(call: ast.Call, attr: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return kw.value
+    pos = _CTOR_DTYPE_POS[attr]
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def _target_field(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def run(files: Sequence[SourceFile]) -> List[Finding]:
+    table = harvest_tables(files)
+    if not table:
+        return []
+    findings: List[Finding] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            fields = [f for f in (_target_field(t) for t in targets)
+                      if f in table]
+            if not fields or node.value is None:
+                continue
+            call = node.value
+            if not isinstance(call, ast.Call):
+                continue
+            d = dotted(call.func)
+            attr = d.rsplit(".", 1)[-1] if d else None
+            if isinstance(call.func, ast.Attribute) and \
+                    call.func.attr == "astype":
+                got = resolve_dtype(call.args[0] if call.args else None)
+            elif attr in _CTOR_DTYPE_POS and d is not None and "." in d:
+                got = resolve_dtype(_dtype_arg(call, attr))
+                if got is None and _dtype_arg(call, attr) is None and \
+                        attr in ("zeros", "ones", "empty", "full"):
+                    for f in fields:
+                        findings.append(Finding(
+                            rule="dtype-contract", file=sf.path,
+                            line=node.lineno,
+                            message=f"`{f}` built by np.{attr} with no "
+                                    f"dtype (defaults to float64); the "
+                                    f"contract says {table[f]}",
+                        ))
+                    continue
+            else:
+                continue
+            if got is None:
+                continue  # dynamic dtype expression: not statically checkable
+            for f in fields:
+                want = table[f]
+                if got != want:
+                    findings.append(Finding(
+                        rule="dtype-contract", file=sf.path,
+                        line=node.lineno,
+                        message=f"`{f}` constructed as {got} but the "
+                                f"canonical table (FIELD_DTYPES) says "
+                                f"{want} — the s64/s32 drift class",
+                    ))
+    return findings

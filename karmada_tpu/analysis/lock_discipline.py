@@ -1,0 +1,243 @@
+"""Pass 4 — `# guarded-by:` lock-discipline checking.
+
+Convention (docs/STATIC_ANALYSIS.md): annotate the statement that creates
+a lock-protected attribute with the lock that guards it —
+
+    self._ring = collections.deque(maxlen=cap)  # guarded-by: _lock
+    _LAST: dict = {...}                         # guarded-by: _LAST_LOCK
+
+The checker then verifies every MUTATION of the annotated attribute in
+that class (or module, for module-level state) happens lexically inside a
+``with <lock>:`` block — ``self.<lock>`` for instance locks, the bare
+name for module locks.  Mutations are: assignment / augmented assignment
+to the attribute, subscript assignment or deletion through it, and calls
+of known mutating methods on it (append, add, pop, update, ...).  Domain
+mutators beyond the builtin set are declared in the annotation:
+
+    self.queue = SchedulingQueue()  # guarded-by: _queue_lock; mutators: push,pop_ready
+
+Reads are not checked (the recorder intentionally allows brief lock-free
+reads); the analysis is compositional, RacerD-style: each attribute is
+judged against its own declared lock, with no whole-program alias
+analysis.  A nested ``def``/``lambda`` body resets the lock context — a
+``with`` around a ``def`` does not guard the deferred call.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from karmada_tpu.analysis.core import Finding, SourceFile, dotted
+
+_ANNOT_RE = re.compile(
+    r"#\s*guarded-by:\s*([A-Za-z_]\w*)"
+    r"(?:\s*;\s*mutators:\s*([A-Za-z_][\w,\s]*))?")
+
+#: builtin container mutators (dict/list/set/deque/OrderedDict)
+MUTATORS = frozenset({
+    "append", "appendleft", "add", "pop", "popitem", "update", "clear",
+    "discard", "remove", "sort", "insert", "extend", "setdefault",
+})
+
+
+class _Guarded:
+    def __init__(self, attr: str, lock: str, mutators: Set[str],
+                 line: int) -> None:
+        self.attr = attr
+        self.lock = lock
+        self.mutators = MUTATORS | mutators
+        self.line = line  # the annotated (defining) statement's line
+
+
+def _annotations(sf: SourceFile) -> Dict[Optional[str], Dict[str, _Guarded]]:
+    """scope -> {attr: _Guarded}; scope is the class name or None for
+    module level.  The annotation attaches to the assignment starting on
+    the comment's line (trailing) or the next line (comment above)."""
+    annots: Dict[int, Tuple[str, Set[str]]] = {}
+    for i, line in enumerate(sf.lines, start=1):
+        m = _ANNOT_RE.search(line)
+        if m:
+            extra = {s.strip() for s in (m.group(2) or "").split(",")
+                     if s.strip()}
+            annots[i] = (m.group(1), extra)
+    if not annots:
+        return {}
+    classes: List[Tuple[str, int, int]] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef):
+            classes.append((node.name, node.lineno,
+                            node.end_lineno or node.lineno))
+
+    def scope_of(line: int) -> Optional[str]:
+        best = None
+        for name, lo, hi in classes:
+            if lo <= line <= hi:
+                best = name  # innermost wins (walk order is outer-first)
+        return best
+
+    out: Dict[Optional[str], Dict[str, _Guarded]] = {}
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        entry = annots.get(node.lineno) or annots.get(node.lineno - 1)
+        if entry is None:
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            attr = None
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                attr = t.attr
+            elif isinstance(t, ast.Name):
+                attr = t.id
+            if attr is None:
+                continue
+            lock, extra = entry
+            out.setdefault(scope_of(node.lineno), {})[attr] = _Guarded(
+                attr, lock, extra, node.lineno)
+    return out
+
+
+def _is_attr_ref(node: ast.AST, attr: str, module_scope: bool) -> bool:
+    if isinstance(node, ast.Attribute):
+        return (node.attr == attr and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+    if module_scope and isinstance(node, ast.Name):
+        return node.id == attr
+    return False
+
+
+def _with_locks(node: ast.With) -> Set[str]:
+    locks: Set[str] = set()
+    for item in node.items:
+        d = dotted(item.context_expr)
+        if d is None:
+            continue
+        locks.add(d.rsplit(".", 1)[-1] if d.startswith("self.") else d)
+    return locks
+
+
+class _Checker:
+    """Lexical walk of one function: statements carry the with-lock stack;
+    expression subtrees are scanned for mutator calls with the stack in
+    effect at their statement — never across a nested def boundary."""
+
+    def __init__(self, sf: SourceFile, guarded: Dict[str, _Guarded],
+                 module_scope: bool, findings: List[Finding]) -> None:
+        self.sf = sf
+        self.guarded = guarded
+        self.module_scope = module_scope
+        self.findings = findings
+
+    def _flag(self, g: _Guarded, node: ast.AST, how: str) -> None:
+        prefix = "" if self.module_scope else "self."
+        self.findings.append(Finding(
+            rule="guarded-by", file=self.sf.path, line=node.lineno,
+            message=f"`{g.attr}` {how} outside `with {prefix}{g.lock}:` "
+                    f"— annotated guarded-by {g.lock}",
+        ))
+
+    def check_fn(self, fn: ast.FunctionDef) -> None:
+        for stmt in fn.body:
+            self._stmt(stmt, [], fn.name == "__init__")
+
+    def _held(self, stack: Sequence[Set[str]], lock: str) -> bool:
+        return any(lock in frame for frame in stack)
+
+    def _stmt(self, node: ast.stmt, stack: List[Set[str]],
+              init: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # deferred body: the surrounding with does NOT guard it
+            for stmt in node.body:
+                self._stmt(stmt, [], node.name == "__init__")
+            return
+        if isinstance(node, ast.With):
+            for item in node.items:
+                self._expr(item.context_expr, stack)
+            stack.append(_with_locks(node))
+            for stmt in node.body:
+                self._stmt(stmt, stack, init)
+            stack.pop()
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                self._target(t, node, stack, init)
+            if node.value is not None:
+                self._expr(node.value, stack)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._target(t, node, stack, init)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._stmt(child, stack, init)
+            elif isinstance(child, ast.excepthandler):
+                for stmt in child.body:
+                    self._stmt(stmt, stack, init)
+            elif isinstance(child, ast.expr):
+                self._expr(child, stack)
+
+    def _target(self, t: ast.AST, node: ast.stmt, stack, init: bool) -> None:
+        for attr, g in self.guarded.items():
+            if _is_attr_ref(t, attr, self.module_scope):
+                if init and isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue  # initialization in __init__
+                if node.lineno == g.line:
+                    continue  # the annotated defining statement itself
+                if not self._held(stack, g.lock):
+                    self._flag(g, node, "rebound")
+            elif isinstance(t, ast.Subscript) and \
+                    _is_attr_ref(t.value, attr, self.module_scope):
+                if not self._held(stack, g.lock):
+                    how = ("item deleted" if isinstance(node, ast.Delete)
+                           else "item assigned")
+                    self._flag(g, node, how)
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._target(el, node, stack, init)
+        if isinstance(t, ast.Subscript):
+            self._expr(t.slice, stack)
+
+    def _expr(self, e: ast.AST, stack) -> None:
+        if isinstance(e, ast.Lambda):
+            return  # deferred body
+        if isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute):
+            for attr, g in self.guarded.items():
+                if e.func.attr in g.mutators and \
+                        _is_attr_ref(e.func.value, attr, self.module_scope) \
+                        and not self._held(stack, g.lock):
+                    self._flag(g, e, f".{e.func.attr}() call")
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self._expr(child, stack)
+            else:
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, ast.expr):
+                        self._expr(sub, stack)
+
+
+def run(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        scoped = _annotations(sf)
+        if not scoped:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef) and node.name in scoped:
+                checker = _Checker(sf, scoped[node.name], False, findings)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        checker.check_fn(item)
+        if None in scoped:
+            checker = _Checker(sf, scoped[None], True, findings)
+            for item in sf.tree.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    checker.check_fn(item)
+    return findings

@@ -1,0 +1,99 @@
+"""Pass 3 — PartitionSpec coverage for every SolverBatch tensor field.
+
+Drift detector for the mesh-sharded solve path: a field added to
+``SolverBatch`` (ops/tensors.py) without a PartitionSpec entry in
+``shard_specs`` (ops/meshing.py) would silently dispatch with whatever
+default placement jax picks — correct on one device, an implicit
+all-replicate (or a crash) on a mesh.  The pass AST-extracts:
+
+  * the ndarray-annotated fields of the ``class SolverBatch`` dataclass,
+  * the string keys of the dict literal inside ``def shard_specs``,
+  * ``HOST_ONLY_FIELDS`` (fields that by design never cross the host ->
+    device boundary, e.g. ``route``),
+
+and reports both directions of drift: fields missing a spec entry, and
+spec entries naming no field (stale keys).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence, Set, Tuple
+
+from karmada_tpu.analysis.core import Finding, SourceFile, dotted
+
+
+def _ndarray_fields(tree: ast.Module) -> Tuple[int, Set[str]]:
+    """(class lineno, ndarray-annotated field names) of SolverBatch."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "SolverBatch":
+            fields: Set[str] = set()
+            for stmt in node.body:
+                if not isinstance(stmt, ast.AnnAssign):
+                    continue
+                ann = dotted(stmt.annotation)
+                if ann is not None and ann.rsplit(".", 1)[-1] == "ndarray" \
+                        and isinstance(stmt.target, ast.Name):
+                    fields.add(stmt.target.id)
+            return node.lineno, fields
+    return 0, set()
+
+
+def _spec_table(tree: ast.Module) -> Tuple[int, Set[str], Set[str]]:
+    """(shard_specs lineno, spec keys, HOST_ONLY_FIELDS entries)."""
+    line, keys = 0, set()
+    host_only: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "shard_specs":
+            line = node.lineno
+            best: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Dict):
+                    ks = {k.value for k in sub.keys
+                          if isinstance(k, ast.Constant)
+                          and isinstance(k.value, str)}
+                    if len(ks) > len(best):
+                        best = ks
+            keys = best
+        elif isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if "HOST_ONLY_FIELDS" in names:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Constant) and \
+                            isinstance(sub.value, str):
+                        host_only.add(sub.value)
+    return line, keys, host_only
+
+
+def run(files: Sequence[SourceFile]) -> List[Finding]:
+    fields_file = specs_file = None
+    fields: Set[str] = set()
+    fields_line = 0
+    keys: Set[str] = set()
+    host_only: Set[str] = set()
+    specs_line = 0
+    for sf in files:
+        line, f = _ndarray_fields(sf.tree)
+        if f and fields_file is None:
+            fields_file, fields, fields_line = sf, f, line
+        line, k, h = _spec_table(sf.tree)
+        if k and specs_file is None:
+            specs_file, keys, specs_line = sf, k, line
+            host_only = h
+    if fields_file is None or specs_file is None:
+        return []  # scanned subtree lacks one side: nothing to compare
+    findings: List[Finding] = []
+    for f in sorted(fields - keys - host_only):
+        findings.append(Finding(
+            rule="spec-coverage", file=specs_file.path, line=specs_line,
+            message=f"SolverBatch field `{f}` has no PartitionSpec entry "
+                    "in shard_specs (and is not in HOST_ONLY_FIELDS) — a "
+                    "mesh dispatch would place it by accident",
+        ))
+    for k in sorted(keys - fields):
+        findings.append(Finding(
+            rule="spec-coverage", file=specs_file.path, line=specs_line,
+            message=f"shard_specs entry `{k}` names no SolverBatch field "
+                    "— stale key",
+        ))
+    return findings

@@ -1,0 +1,291 @@
+"""Pass 1 — trace-safety inside jit-compiled code.
+
+Finds the module's *registered jit entrypoints* — functions wrapped by
+``jax.jit`` / ``partial(jax.jit, ...)`` (decorator or assignment form) or
+``jax.vmap`` — then walks the transitive closure of module-local (and
+cross-module, via ``from karmada_tpu... import``) calls from those
+bodies.  Everything reached is traced code, where three thing classes are
+defects invisible to single-device pytest:
+
+  * trace-branch    — Python ``if``/``while`` whose test contains a
+                      jnp/lax expression: the branch runs at TRACE time on
+                      a tracer (ConcretizationTypeError at best, silently
+                      baked-in branch at worst).  Static/shape branches
+                      (plain ints, None checks) are fine and not flagged.
+  * trace-host-sync — ``.item()``, ``float(...)``/``int(...)`` over jnp
+                      expressions, and ``np.asarray``/``np.array`` calls:
+                      each forces a device->host transfer inside the
+                      compiled region (or a trace error), serializing the
+                      pipelined dispatch.
+  * trace-weak-int  — ``jnp.arange/zeros/ones/full/empty`` without an
+                      explicit dtype: under jax_enable_x64 these default
+                      to s64/f64 and are exactly how the PR-3 mixed
+                      s64/s32 stacking DUS reached the SPMD partitioner.
+
+The walk is lexical (nested defs such as wave_step are visited as part of
+their parent body); attribute calls (``meshing.wave_output_shardings``)
+are trace-time host helpers and are deliberately not followed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from karmada_tpu.analysis.core import Finding, SourceFile, dotted
+
+# jnp constructors whose dtype defaults are the s64/f64 hazard, with the
+# positional index their dtype parameter occupies
+_WEAK_CTORS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2, "arange": 3}
+
+_HOST_CASTS = ("float", "int", "bool", "complex")
+
+
+class _Aliases:
+    """Per-file import names for jax.numpy / jax.lax / numpy / jax /
+    functools.partial."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.jnp: Set[str] = set()
+        self.lax: Set[str] = set()
+        self.np: Set[str] = set()
+        self.jax: Set[str] = set()
+        self.partial: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name.split(".")[0]
+                    if a.name == "jax.numpy":
+                        self.jnp.add(a.asname or "jax.numpy")
+                    elif a.name == "jax.lax":
+                        self.lax.add(a.asname or "jax.lax")
+                    elif a.name == "numpy":
+                        self.np.add(name)
+                    elif a.name == "jax":
+                        self.jax.add(name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "numpy":
+                            self.jnp.add(a.asname or "numpy")
+                        elif a.name == "lax":
+                            self.lax.add(a.asname or "lax")
+                elif node.module == "functools":
+                    for a in node.names:
+                        if a.name == "partial":
+                            self.partial.add(a.asname or "partial")
+        self.partial.add("functools.partial")
+
+    def is_jit(self, node: ast.AST) -> bool:
+        d = dotted(node)
+        return d is not None and (
+            d in {f"{j}.jit" for j in self.jax} or d == "jit")
+
+    def is_vmap(self, node: ast.AST) -> bool:
+        d = dotted(node)
+        return d is not None and d in {f"{j}.vmap" for j in self.jax}
+
+    def is_partial(self, node: ast.AST) -> bool:
+        d = dotted(node)
+        return d is not None and d in self.partial
+
+    def traced_array_call(self, node: ast.AST) -> bool:
+        """True for a Call on a jnp/lax attribute (``jnp.sum(x)``) — the
+        marker that an expression's value is traced, not static."""
+        if not isinstance(node, ast.Call):
+            return False
+        d = dotted(node.func)
+        if d is None:
+            return False
+        base = d.rsplit(".", 1)[0] if "." in d else None
+        return base is not None and (base in self.jnp or base in self.lax)
+
+
+def _wrapped_name(call: ast.Call, al: _Aliases) -> Optional[str]:
+    """F for jax.jit(F) / jax.vmap(F) / partial(jax.jit, ...)(F) /
+    jax.vmap(partial(F, ...)) shapes; None otherwise."""
+    target: Optional[ast.AST] = None
+    if al.is_jit(call.func) or al.is_vmap(call.func):
+        target = call.args[0] if call.args else None
+    elif isinstance(call.func, ast.Call):
+        inner = call.func
+        if al.is_partial(inner.func) and inner.args and (
+                al.is_jit(inner.args[0]) or al.is_vmap(inner.args[0])):
+            target = call.args[0] if call.args else None
+    if isinstance(target, ast.Call) and al.is_partial(target.func):
+        target = target.args[0] if target.args else None
+    if isinstance(target, ast.Name):
+        return target.id
+    return None
+
+
+def _decorated_jit(fn: ast.FunctionDef, al: _Aliases) -> bool:
+    for dec in fn.decorator_list:
+        if al.is_jit(dec) or al.is_vmap(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            if al.is_jit(dec.func) or al.is_vmap(dec.func):
+                return True
+            if al.is_partial(dec.func) and dec.args and (
+                    al.is_jit(dec.args[0]) or al.is_vmap(dec.args[0])):
+                return True
+    return False
+
+
+class _Module:
+    def __init__(self, sf: SourceFile) -> None:
+        self.sf = sf
+        self.aliases = _Aliases(sf.tree)
+        self.defs: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in sf.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # local name -> (source module, original name, relative level)
+        self.imports: Dict[str, Tuple[Optional[str], str, int]] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    self.imports[a.asname or a.name] = (
+                        node.module, a.name, node.level or 0)
+
+    def roots(self) -> Set[str]:
+        out: Set[str] = set()
+        for name, fn in self.defs.items():
+            if _decorated_jit(fn, self.aliases):
+                out.add(name)
+        for node in ast.walk(self.sf.tree):
+            if isinstance(node, ast.Call):
+                w = _wrapped_name(node, self.aliases)
+                if w is not None:
+                    out.add(w)
+        return out & set(self.defs)
+
+
+def _resolve_module(cur_path: str, module: Optional[str], level: int,
+                    paths: Sequence[str]) -> Optional[str]:
+    """The scanned file a from-import refers to, or None.  Modules are
+    keyed by FULL path (basenames collide: many __init__.py, two
+    metrics.py); absolute imports match by path suffix ('from
+    karmada_tpu.ops.solver import X' -> .../karmada_tpu/ops/solver.py),
+    relative imports resolve against the importing file's directory."""
+    import os
+
+    if level > 0:
+        base = os.path.dirname(cur_path)
+        for _ in range(level - 1):
+            base = os.path.dirname(base)
+        rel = (module or "").replace(".", os.sep)
+        stem = os.path.join(base, rel) if rel else base
+        for cand in (stem + ".py", os.path.join(stem, "__init__.py")):
+            cand = os.path.normpath(cand)
+            if cand in paths:
+                return cand
+        return None
+    if not module:
+        return None
+    suffix = module.replace(".", os.sep)
+    for cand_suffix in (suffix + ".py", os.path.join(suffix, "__init__.py")):
+        for path in sorted(paths):
+            if path == cand_suffix or path.endswith(os.sep + cand_suffix):
+                return path
+    return None
+
+
+def _check_body(
+    fn: ast.FunctionDef, mod: _Module, findings: List[Finding],
+    calls_out: Set[str],
+) -> None:
+    al = mod.aliases
+    path = mod.sf.path
+
+    def has_traced_expr(node: ast.AST) -> bool:
+        return any(al.traced_array_call(n) for n in ast.walk(node))
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While)) and has_traced_expr(node.test):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            findings.append(Finding(
+                rule="trace-branch", file=path, line=node.lineno,
+                message=f"Python `{kind}` on a traced value inside "
+                        f"jit-compiled `{fn.name}` — use jnp.where/"
+                        "lax.cond/lax.while_loop",
+            ))
+        elif isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                findings.append(Finding(
+                    rule="trace-host-sync", file=path, line=node.lineno,
+                    message=f".item() host sync inside jit-compiled "
+                            f"`{fn.name}`",
+                ))
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id in _HOST_CASTS and node.args and \
+                    has_traced_expr(node.args[0]):
+                findings.append(Finding(
+                    rule="trace-host-sync", file=path, line=node.lineno,
+                    message=f"{node.func.id}() of a traced value inside "
+                            f"jit-compiled `{fn.name}` forces a host sync",
+                ))
+            elif d is not None and "." in d:
+                base, attr = d.rsplit(".", 1)
+                if base in al.np and attr in ("asarray", "array"):
+                    findings.append(Finding(
+                        rule="trace-host-sync", file=path, line=node.lineno,
+                        message=f"np.{attr}() inside jit-compiled "
+                                f"`{fn.name}` materializes to host",
+                    ))
+                elif base in al.jnp and attr in _WEAK_CTORS:
+                    # a positional arg beyond the dtype slot IS the dtype
+                    # (zeros(shape, dtype), full(shape, fill, dtype), ...)
+                    dtype_pos = _WEAK_CTORS[attr]
+                    has_dtype = (
+                        len(node.args) > dtype_pos
+                        or any(k.arg == "dtype" for k in node.keywords)
+                    )
+                    if not has_dtype:
+                        findings.append(Finding(
+                            rule="trace-weak-int", file=path,
+                            line=node.lineno,
+                            message=f"jnp.{attr}() without an explicit "
+                                    f"dtype inside jit-compiled `{fn.name}` "
+                                    "defaults to s64/f64 under x64 (the "
+                                    "mixed s64/s32 SPMD bug class)",
+                        ))
+            if isinstance(node.func, ast.Name):
+                calls_out.add(node.func.id)
+            # partial(F, ...) passed onward keeps F traced
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in {p.split(".")[-1] for p in al.partial} \
+                    and node.args and isinstance(node.args[0], ast.Name):
+                calls_out.add(node.args[0].id)
+
+
+def run(files: Sequence[SourceFile]) -> List[Finding]:
+    mods = {sf.path: _Module(sf) for sf in files}
+    findings: List[Finding] = []
+    # worklist of (module path, function name), starting from jit roots
+    work: List[Tuple[str, str]] = []
+    seen: Set[Tuple[str, str]] = set()
+    for path, mod in mods.items():
+        for r in sorted(mod.roots()):
+            work.append((path, r))
+    while work:
+        path, name = work.pop()
+        if (path, name) in seen:
+            continue
+        seen.add((path, name))
+        mod = mods.get(path)
+        if mod is None or name not in mod.defs:
+            continue
+        calls: Set[str] = set()
+        _check_body(mod.defs[name], mod, findings, calls)
+        for c in sorted(calls):
+            if c in mod.defs:
+                work.append((path, c))
+            elif c in mod.imports:
+                src_module, orig, level = mod.imports[c]
+                src_path = _resolve_module(path, src_module, level, mods)
+                if src_path is not None:
+                    work.append((src_path, orig))
+    return findings

@@ -1,0 +1,132 @@
+"""`karmadactl vet` — run the four static passes and assemble the report.
+
+JSON shape (stable; bench/watch tooling ingests it):
+
+    {
+      "version": 1,
+      "clean": bool,
+      "files": <scanned file count>,
+      "findings": [{"rule", "file", "line", "message"}, ...],
+      "waivers":  [{"rule", "file", "line", "justification"}, ...],
+      "counts": {"findings": N, "waivers": M,
+                 "by_rule": {"<rule>": {"findings": n, "waivers": m}}}
+    }
+
+Exit policy (cmd_vet in cli.py): non-zero iff findings is non-empty;
+waivers never fail the run but are always enumerated.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from karmada_tpu.analysis import (
+    dtype_contract,
+    lock_discipline,
+    spec_coverage,
+    trace_safety,
+)
+from karmada_tpu.analysis.core import (
+    RULES,
+    Finding,
+    SourceFile,
+    Waiver,
+    apply_waivers,
+    collect_files,
+)
+
+#: pass name -> (runner, rules it can emit)
+PASSES = {
+    "trace-safety": (trace_safety.run,
+                     ("trace-branch", "trace-host-sync", "trace-weak-int")),
+    "dtype-contract": (dtype_contract.run, ("dtype-contract",)),
+    "spec-coverage": (spec_coverage.run, ("spec-coverage",)),
+    "lock-discipline": (lock_discipline.run, ("guarded-by",)),
+}
+
+
+@dataclass
+class VetReport:
+    files: int
+    findings: List[Finding] = field(default_factory=list)
+    waivers: List[Waiver] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def counts(self) -> dict:
+        by_rule: Dict[str, Dict[str, int]] = {}
+        for f in self.findings:
+            by_rule.setdefault(f.rule, {"findings": 0, "waivers": 0})
+            by_rule[f.rule]["findings"] += 1
+        for w in self.waivers:
+            by_rule.setdefault(w.rule, {"findings": 0, "waivers": 0})
+            by_rule[w.rule]["waivers"] += 1
+        return {"findings": len(self.findings), "waivers": len(self.waivers),
+                "by_rule": by_rule}
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "clean": self.clean,
+            "files": self.files,
+            "findings": [f.to_dict() for f in sorted(
+                self.findings, key=lambda f: (f.file, f.line, f.rule))],
+            "waivers": [w.to_dict() for w in sorted(
+                self.waivers, key=lambda w: (w.file, w.line, w.rule))],
+            "counts": self.counts(),
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    def render_text(self) -> str:
+        lines: List[str] = []
+        for f in sorted(self.findings,
+                        key=lambda f: (f.file, f.line, f.rule)):
+            lines.append(f"{f.file}:{f.line}: [{f.rule}] {f.message}")
+        c = self.counts()
+        lines.append(
+            f"vet: {c['findings']} finding(s), {c['waivers']} waiver(s) "
+            f"across {self.files} file(s)")
+        for w in sorted(self.waivers, key=lambda w: (w.file, w.line)):
+            lines.append(
+                f"  waived {w.file}:{w.line} [{w.rule}] — {w.justification}")
+        return "\n".join(lines)
+
+
+def run_vet(paths: Sequence[str],
+            rules: Optional[Sequence[str]] = None) -> VetReport:
+    """Run every pass over the python files under `paths`.
+
+    `rules` (finding-rule names from core.RULES) filters which FINDINGS
+    are kept — passes still all run, waivers are ALWAYS enumerated in
+    full (the waiver population is an audit surface, not a per-rule
+    view), and waiver-syntax problems are never hidden.
+
+    Raises ValueError on an unknown rule or a nonexistent path: a typo'd
+    path must be a usage error, never a 0-file "clean" result that lets
+    the standing gate pass vacuously.
+    """
+    import os
+
+    if rules is not None:
+        unknown = set(rules) - set(RULES)
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {sorted(unknown)}; valid: {list(RULES)}")
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        raise ValueError(f"no such path(s): {missing}")
+    files: List[SourceFile] = collect_files(paths)
+    raw: List[Finding] = []
+    for _name, (runner, _emits) in PASSES.items():
+        raw.extend(runner(files))
+    findings, waivers = apply_waivers(raw, files)
+    if rules is not None:
+        keep = set(rules) | {"waiver-syntax"}
+        findings = [f for f in findings if f.rule in keep]
+    return VetReport(files=len(files), findings=findings, waivers=waivers)

@@ -899,6 +899,13 @@ def cmd_serve(args) -> int:
     scheduler / webhook processes rolled into one, Runtime.serve)."""
     import time as _time
 
+    if args.check_invariants:
+        # arm BEFORE the plane loads: rehydration may already run solves
+        from karmada_tpu.analysis import guards
+
+        guards.arm()
+        print("runtime invariant guards armed "
+              "(solver entry + d2h boundaries; analysis/guards)")
     try:
         cp = _load_plane(args.dir, backend=args.backend, waves=args.waves,
                          controllers=args.controllers,
@@ -974,6 +981,30 @@ def cmd_serve(args) -> int:
         cp.runtime.stop()
         cp.checkpoint()
     return 0
+
+
+def cmd_vet(args) -> int:
+    """Static analysis over the control plane's own source
+    (karmada_tpu/analysis): trace-safety, dtype-contract, spec-coverage,
+    and lock-discipline passes.  Exit 0 only on zero findings; waivers
+    (`# vet: ignore[rule] <why>`) never fail the run but are always
+    enumerated.  `--format json` emits the machine-readable summary the
+    bench/watch tooling ingests."""
+    import os
+
+    from karmada_tpu.analysis.vet import run_vet
+
+    paths = args.paths or [os.path.dirname(os.path.abspath(__file__))]
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    try:
+        report = run_vet(paths, rules=rules)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    print(report.to_json() if args.format == "json"
+          else report.render_text())
+    return 0 if report.clean else 1
 
 
 def cmd_trace(args) -> int:
@@ -1337,6 +1368,20 @@ def build_parser() -> argparse.ArgumentParser:
                      help="list the always-retained slowest cycles instead "
                           "of the recent ring")
 
+    vt = sub.add_parser("vet")
+    vt.add_argument("paths", nargs="*",
+                    help="files/directories to analyze (default: the "
+                         "installed karmada_tpu package)")
+    vt.add_argument("--format", choices=["text", "json"], default="text",
+                    help="json: machine-readable findings/waivers summary "
+                         "(rule, file:line, waiver count); exit code is "
+                         "non-zero on any finding either way")
+    vt.add_argument("--rules", default="",
+                    help="comma-separated finding-rule filter (e.g. "
+                         "trace-branch,dtype-contract); all passes still "
+                         "run and waivers are always enumerated in full — "
+                         "only reported FINDINGS are filtered")
+
     ex = sub.add_parser("explain")
     ex.add_argument("kind")
 
@@ -1433,6 +1478,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "exceeding this many seconds is abandoned and the "
                          "scheduler degrades to the fastest host backend "
                          "permanently (0 disables)")
+    sv.add_argument("--check-invariants", action="store_true",
+                    help="arm the runtime invariant guards "
+                         "(karmada_tpu/analysis/guards): shape/dtype/NaN "
+                         "checks at solver entry and d2h boundaries; also "
+                         "armable via KARMADA_CHECK_INVARIANTS=1")
     sv.add_argument("--api-port", type=int, default=-1,
                     help="serve the query plane (cluster proxy verbs, "
                          "search cache GET/LIST/WATCH, metrics adapter) "
@@ -1491,6 +1541,7 @@ COMMANDS = {
     "tick": cmd_tick,
     "serve": cmd_serve,
     "trace": cmd_trace,
+    "vet": cmd_vet,
 }
 
 
@@ -1524,6 +1575,9 @@ def _dispatch(args) -> int:
         # talks to a live serve process over HTTP; needs neither --dir
         # (no plane is opened) nor --server (different endpoint)
         return cmd_trace(args)
+    if args.command == "vet":
+        # pure source analysis: no plane, no server
+        return cmd_vet(args)
     if getattr(args, "server", None):
         handler = REMOTE_COMMANDS.get(args.command)
         if handler is None:

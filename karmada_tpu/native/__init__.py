@@ -201,6 +201,7 @@ class NativeSnapshot:
         self.index: Dict[str, int] = {c.name: i for i, c in enumerate(clusters)}
         nC = len(clusters)
         order = sorted(range(nC), key=lambda i: clusters[i].name)
+        # vet: ignore[dtype-contract] int32 C++ ABI rank, not the SolverBatch field
         self.name_rank = np.zeros(nC, np.int32)
         for rank, i in enumerate(order):
             self.name_rank[i] = rank

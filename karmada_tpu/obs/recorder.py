@@ -25,10 +25,12 @@ class TraceRecorder:
     def __init__(self, capacity: int = 256, slow_keep: int = 8) -> None:
         self.capacity = max(1, int(capacity))
         self.slow_keep = max(0, int(slow_keep))
+        # guarded-by: _lock
         self._ring: "collections.deque[dict]" = collections.deque(
             maxlen=self.capacity)
-        self._slow: List[dict] = []  # ascending duration; [0] is fastest
-        self._dropped = 0
+        # guarded-by: _lock (ascending duration; [0] is fastest)
+        self._slow: List[dict] = []
+        self._dropped = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def record(self, trace: dict) -> None:

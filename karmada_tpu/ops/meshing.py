@@ -63,6 +63,11 @@ BATCH_FIELDS = (
     "evict_idx",
 )
 
+#: SolverBatch ndarray fields that by design never cross the host->device
+#: boundary (the spec-coverage vet pass exempts them from shard_specs):
+#: `route` is the host-side routing verdict the encoder leaves behind.
+HOST_ONLY_FIELDS = frozenset({"route"})
+
 
 def parse_shape(text) -> Optional[object]:
     """Parse a --mesh flag value.
@@ -137,6 +142,13 @@ def shard_specs() -> Dict[str, object]:
         "pl_has_cluster_sc": P(None), "pl_sc_min": P(None),
         "pl_sc_max": P(None), "pl_ignore_avail": P(None),
         "pl_extra_score": P(None, AXIS_CLUSTERS),
+        # spread-path rows (vet spec-coverage: these rode in with the r4
+        # spread work without spec entries — the device spread sub-solves
+        # run single-device today, but the table must stay total so a
+        # future sharded spread dispatch places them deliberately)
+        "region_id": P(AXIS_CLUSTERS),
+        "pl_has_region_sc": P(None), "pl_region_min": P(None),
+        "pl_region_max": P(None),
         # binding axis: data parallel
         "b_valid": P(AXIS_BINDINGS), "placement_id": P(AXIS_BINDINGS),
         "gvk_id": P(AXIS_BINDINGS), "class_id": P(AXIS_BINDINGS),

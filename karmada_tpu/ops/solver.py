@@ -49,6 +49,7 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
 
+from karmada_tpu.analysis import guards as _guards  # noqa: E402
 from karmada_tpu.ops.webster import PRIORITY_QBITS  # noqa: E402
 from karmada_tpu.utils.metrics import REGISTRY  # noqa: E402
 
@@ -1059,6 +1060,8 @@ def solve(batch, waves: int = 1, tier: str = "std"):
     # packed sort keys reserve _LANE_BITS bits for the cluster lane
     assert batch.C <= MAX_CLUSTER_LANES, \
         f"cluster axis must be <= {MAX_CLUSTER_LANES} per solve call"
+    if _guards.armed():
+        _guards.check_batch(batch, "solve-entry")
     plan = _plan_for(batch, waves)
     rep, sel, status = schedule_batch(
         *_batch_args(batch, plan), waves=waves, use_extra=_use_extra(batch),
@@ -1092,6 +1095,12 @@ def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
     guarantees this)."""
     assert batch.C <= MAX_CLUSTER_LANES, \
         f"cluster axis must be <= {MAX_CLUSTER_LANES} per solve call"
+    if _guards.armed():
+        # armed invariant mode (serve --check-invariants): the host->device
+        # boundary check — dtype/shape drift dies here, not in the SPMD
+        # partitioner three layers down
+        _guards.check_batch(batch, "dispatch-compact")
+        _guards.check_used(used0, "dispatch-compact carry")
     dense_nnz = batch.B * batch.C
     if max_nnz <= 0:
         # keep_sel ships whole selections (feasible-set scale on full-fleet
@@ -1223,6 +1232,10 @@ def finalize_compact(handle):
         nnz = res[3]
     idx, val, st = res[0], res[1], res[2]
     out = (np.asarray(idx), np.asarray(val), np.asarray(st), int(nnz))
+    if _guards.armed():
+        # the device->host boundary check: COO indices/values/status sanity
+        _guards.check_d2h(out[0], out[1], out[2], dense_nnz,
+                          "finalize-compact")
     if with_used:
         used = res[4:7]
         if any(getattr(u, "is_deleted", None) is not None and u.is_deleted()

@@ -382,10 +382,13 @@ def solve_spread(
     selection order is score-driven, assignment is the capacity-honest
     step).
     """
+    from karmada_tpu.analysis import guards as _guards
     from karmada_tpu.ops import tensors as T
 
     if not len(spread_idx):
         return ({}, None) if collect_used else {}
+    if _guards.armed():
+        _guards.check_batch(batch, "solve-spread")
     if axis == "":
         group_id_arr, group_names = batch.region_id, batch.region_names
     else:

@@ -104,6 +104,66 @@ STATUS_FIT_ERROR = 1
 STATUS_UNSCHEDULABLE = 2
 STATUS_NO_CLUSTER = 3
 
+# ---------------------------------------------------------------------------
+# Canonical dtype / axis contract for SolverBatch tensors.
+#
+# THE single authority on what dtype every field carries: the static
+# dtype-contract vet pass (karmada_tpu/analysis/dtype_contract.py) checks
+# every construction site in ops/ against this table at vet time, and the
+# armed runtime mode (analysis/guards.check_batch, serve --check-invariants)
+# validates live batches at solver entry against the same table.  The PR-3
+# s64/s32 wave-scan bug was exactly a drift this table now catches: an
+# int32 array where the kernel contract says int64 is invisible on one
+# device and an XLA SPMD verifier failure on a mesh.  Values are plain
+# strings so the vet pass can read them from the AST without importing.
+FIELD_DTYPES = {
+    "cluster_valid": "bool", "deleting": "bool",
+    "name_rank": "int64", "pods_allowed": "int64", "has_summary": "bool",
+    "avail_milli": "int64", "has_alloc": "bool", "api_ok": "bool",
+    "req_milli": "int64", "req_is_cpu": "bool", "req_pods": "int64",
+    "est_override": "int64",
+    "pl_mask": "bool", "pl_tol_bypass": "bool", "pl_strategy": "int32",
+    "pl_static_w": "int64", "pl_has_cluster_sc": "bool",
+    "pl_sc_min": "int32", "pl_sc_max": "int32", "pl_ignore_avail": "bool",
+    "pl_extra_score": "int64",
+    "b_valid": "bool", "placement_id": "int32", "gvk_id": "int32",
+    "class_id": "int32", "replicas": "int64", "uid_desc": "bool",
+    "fresh": "bool", "non_workload": "bool", "nw_shortcut": "bool",
+    "prev_idx": "int32", "prev_val": "int32", "evict_idx": "int32",
+    "route": "int32", "region_id": "int32",
+    "pl_has_region_sc": "bool", "pl_region_min": "int32",
+    "pl_region_max": "int32",
+}
+
+# axis names per field (B/C extents are checked against the batch by the
+# armed runtime mode; the other letters document dimensionality only)
+FIELD_AXES = {
+    "cluster_valid": ("C",), "deleting": ("C",), "name_rank": ("C",),
+    "pods_allowed": ("C",), "has_summary": ("C",),
+    "avail_milli": ("C", "R"), "has_alloc": ("C", "R"),
+    "api_ok": ("G", "C"),
+    "req_milli": ("Q", "R"), "req_is_cpu": ("R",), "req_pods": ("Q",),
+    "est_override": ("Q", "C"),
+    "pl_mask": ("P", "C"), "pl_tol_bypass": ("P", "C"),
+    "pl_strategy": ("P",), "pl_static_w": ("P", "C"),
+    "pl_has_cluster_sc": ("P",), "pl_sc_min": ("P",), "pl_sc_max": ("P",),
+    "pl_ignore_avail": ("P",), "pl_extra_score": ("P", "C"),
+    "b_valid": ("B",), "placement_id": ("B",), "gvk_id": ("B",),
+    "class_id": ("B",), "replicas": ("B",), "uid_desc": ("B",),
+    "fresh": ("B",), "non_workload": ("B",), "nw_shortcut": ("B",),
+    "prev_idx": ("B", "Kp"), "prev_val": ("B", "Kp"),
+    "evict_idx": ("B", "Ke"),
+    "route": ("nB",), "region_id": ("C",),
+    "pl_has_region_sc": ("P",), "pl_region_min": ("P",),
+    "pl_region_max": ("P",),
+}
+
+# the consumed-capacity carry triple (solver with_used / CarryState):
+# used_milli [C, R], used_pods [C], used_sets [Q, C]
+CARRY_DTYPES = {
+    "used_milli": "int64", "used_pods": "int64", "used_sets": "int64",
+}
+
 
 def _next_pow2(n: int, lo: int = 1) -> int:
     v = lo

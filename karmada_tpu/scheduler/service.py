@@ -134,6 +134,7 @@ class Scheduler:
         # the queue is touched from publisher threads (_on_event) and the
         # worker (_cycle); one lock guards every queue operation
         self._queue_lock = threading.Lock()
+        # guarded-by: _queue_lock; mutators: push,pop_ready,flush_backoff,flush_unschedulable_leftover,move_all_to_active_or_backoff,push_unschedulable_if_not_present,push_backoff_if_not_present
         self.queue = queue if queue is not None else SchedulingQueue()
         self._native_snap = None  # (clusters list, NativeSnapshot)
         if backend == "native":

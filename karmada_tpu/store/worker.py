@@ -32,13 +32,13 @@ class AsyncWorker:
         self.name = name
         self.reconcile = reconcile
         self.max_retries = max_retries
-        self._queue: "OrderedDict[Hashable, None]" = OrderedDict()
-        self._retries: Dict[Hashable, int] = {}
-        self._processing: set = set()
-        self._dirty: set = set()
+        self._queue: "OrderedDict[Hashable, None]" = OrderedDict()  # guarded-by: _cv
+        self._retries: Dict[Hashable, int] = {}  # guarded-by: _cv
+        self._processing: set = set()  # guarded-by: _cv
+        self._dirty: set = set()  # guarded-by: _cv
         # first-enqueue timestamps for the flight recorder's queue-dwell
         # attribute; only populated while tracing is enabled
-        self._enqueued_at: Dict[Hashable, float] = {}
+        self._enqueued_at: Dict[Hashable, float] = {}  # guarded-by: _cv
         self._cv = threading.Condition()
         self._stopped = False
 

@@ -50,6 +50,7 @@ PROBE_CONSECUTIVE_FAILURES = REGISTRY.gauge(
 )
 
 _LAST_LOCK = threading.Lock()
+# guarded-by: _LAST_LOCK
 _LAST: dict = {"probed": False, "ok": None, "platform": None,
                "devices": None, "elapsed_s": None,
                "consecutive_failures": 0, "at_unix": None, "error": None}

@@ -49,7 +49,7 @@ class Counter(_Metric):
 
     def __init__(self, name, help_, label_names=()):
         super().__init__(name, help_, label_names)
-        self._values: Dict[Tuple[str, ...], float] = {}
+        self._values: Dict[Tuple[str, ...], float] = {}  # guarded-by: _lock
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
         key = self._key(labels)
@@ -73,7 +73,7 @@ class Gauge(_Metric):
 
     def __init__(self, name, help_, label_names=()):
         super().__init__(name, help_, label_names)
-        self._values: Dict[Tuple[str, ...], float] = {}
+        self._values: Dict[Tuple[str, ...], float] = {}  # guarded-by: _lock
 
     def set(self, value: float, **labels: str) -> None:
         with self._lock:
@@ -102,9 +102,9 @@ class Histogram(_Metric):
     def __init__(self, name, help_, label_names=(), buckets: Optional[List[float]] = None):
         super().__init__(name, help_, label_names)
         self.buckets = sorted(buckets or exponential_buckets(0.001, 2, 15))
-        self._counts: Dict[Tuple[str, ...], List[int]] = {}
-        self._sums: Dict[Tuple[str, ...], float] = {}
-        self._totals: Dict[Tuple[str, ...], int] = {}
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}  # guarded-by: _lock
+        self._sums: Dict[Tuple[str, ...], float] = {}  # guarded-by: _lock
+        self._totals: Dict[Tuple[str, ...], int] = {}  # guarded-by: _lock
 
     def observe(self, value: float, **labels: str) -> None:
         key = self._key(labels)
@@ -166,7 +166,7 @@ class Histogram(_Metric):
 
 class Registry:
     def __init__(self) -> None:
-        self._metrics: Dict[str, _Metric] = {}
+        self._metrics: Dict[str, _Metric] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def register(self, metric: _Metric) -> _Metric:

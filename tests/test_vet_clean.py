@@ -1,0 +1,63 @@
+"""The standing tier-1 gate: `karmadactl vet` over karmada_tpu/ is clean.
+
+Any finding the analyzer reports on the live tree fails this test — the
+fix is to repair the code (or, for a deliberate exception, add a
+`# vet: ignore[rule] <why>` waiver whose justification survives review).
+Waivers are enumerated and must each carry a justification.
+"""
+
+import json
+import os
+
+from karmada_tpu.analysis.vet import run_vet
+
+PKG = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "karmada_tpu"))
+
+
+def test_vet_clean_over_package():
+    report = run_vet([PKG])
+    # sanity: the walk really covered the package, not an empty dir
+    assert report.files > 50
+    msgs = [f"{f.file}:{f.line} [{f.rule}] {f.message}"
+            for f in report.findings]
+    assert not msgs, "vet findings on the live tree:\n" + "\n".join(msgs)
+
+
+def test_vet_waivers_enumerated_and_justified():
+    report = run_vet([PKG])
+    d = report.to_dict()
+    assert d["clean"] is True
+    assert d["counts"]["waivers"] == len(d["waivers"])
+    for w in d["waivers"]:
+        assert w["justification"].strip(), w
+        assert w["rule"] in d["counts"]["by_rule"]
+    # the JSON is machine-ingestible (bench/watch tooling contract)
+    parsed = json.loads(report.to_json())
+    assert parsed["version"] == 1
+    assert set(parsed) == {"version", "clean", "files", "findings",
+                           "waivers", "counts"}
+
+
+def test_vet_covers_known_surfaces():
+    """The passes must actually be LOOKING at the hot surfaces: the
+    guarded-by annotations exist, the dtype table exists, and the jit
+    roots are discovered (an empty analysis passing trivially would be a
+    silent gate failure)."""
+    from karmada_tpu.analysis import lock_discipline, trace_safety
+    from karmada_tpu.analysis.core import collect_files
+    from karmada_tpu.analysis.dtype_contract import harvest_tables
+
+    files = collect_files([PKG])
+    table = harvest_tables(files)
+    assert "name_rank" in table and table["name_rank"] == "int64"
+    assert "used_milli" in table  # carry contract harvested too
+    annotated = [sf for sf in files
+                 if lock_discipline._annotations(sf)]  # noqa: SLF001
+    names = {os.path.basename(sf.path) for sf in annotated}
+    assert {"recorder.py", "metrics.py", "deviceprobe.py", "worker.py",
+            "service.py"} <= names
+    solver = [sf for sf in files
+              if sf.path.endswith(os.path.join("ops", "solver.py"))]
+    mod = trace_safety._Module(solver[0])  # noqa: SLF001
+    assert {"_schedule_core", "_schedule_compact_impl"} <= mod.roots()

@@ -1,0 +1,533 @@
+"""Per-pass vet fixtures: each pass catches its seeded violation and
+passes on the fixed variant; waiver + CLI + armed-runtime-guard behavior.
+"""
+
+import json
+import textwrap
+
+import numpy as np
+import pytest
+
+from karmada_tpu.analysis.vet import run_vet
+
+
+def _vet(tmp_path, name, src, extra=None):
+    (tmp_path / name).write_text(textwrap.dedent(src))
+    for fname, fsrc in (extra or {}).items():
+        (tmp_path / fname).write_text(textwrap.dedent(fsrc))
+    return run_vet([str(tmp_path)])
+
+
+# -- pass 1: trace-safety ----------------------------------------------------
+
+TRACE_BAD = """
+    from functools import partial
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def _helper(x):
+        while jnp.any(x > 0):
+            x = x - 1
+        return x
+
+    def _core(x):
+        if jnp.sum(x) > 0:
+            x = x + 1
+        y = float(jnp.max(x))
+        z = np.asarray(x)
+        w = jnp.zeros((4,))
+        return _helper(x)
+
+    solve = partial(jax.jit, static_argnames=())(_core)
+"""
+
+TRACE_FIXED = """
+    from functools import partial
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def _core(x, flag: bool):
+        if flag:  # static python bool: fine
+            x = x + 1
+        x = jnp.where(jnp.sum(x) > 0, x + 1, x)
+        w = jnp.zeros((4,), jnp.int64)
+        n = jnp.arange(8, dtype=jnp.int32)
+        return x
+
+    solve = partial(jax.jit, static_argnames=("flag",))(_core)
+"""
+
+
+def test_trace_safety_catches_seeded(tmp_path):
+    report = _vet(tmp_path, "mod.py", TRACE_BAD)
+    rules = sorted(f.rule for f in report.findings)
+    assert "trace-branch" in rules
+    assert "trace-host-sync" in rules
+    assert "trace-weak-int" in rules
+    # the transitive closure reached _helper's while-loop too
+    branch_lines = [f.line for f in report.findings
+                    if f.rule == "trace-branch"]
+    assert len(branch_lines) == 2
+
+
+def test_trace_safety_clean_on_fixed(tmp_path):
+    report = _vet(tmp_path, "mod.py", TRACE_FIXED)
+    assert report.clean, report.render_text()
+
+
+def test_trace_safety_ignores_host_code(tmp_path):
+    # the same constructs OUTSIDE jit code are host-side and legal
+    report = _vet(tmp_path, "mod.py", """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def host(x):
+            if jnp.sum(x) > 0:
+                return np.asarray(x)
+            return float(jnp.max(x))
+    """)
+    assert report.clean, report.render_text()
+
+
+def test_trace_safety_decorator_and_vmap_roots(tmp_path):
+    report = _vet(tmp_path, "mod.py", """
+        from functools import partial
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, static_argnames=("G",))
+        def phase(x, *, G):
+            return jnp.zeros((G,))
+
+        def _one(x):
+            return jnp.arange(4)
+
+        one_v = jax.vmap(_one)
+    """)
+    assert sorted(f.rule for f in report.findings) == [
+        "trace-weak-int", "trace-weak-int"]
+
+
+def test_trace_safety_cross_module_basename_collision(tmp_path):
+    # two helpers.py in different subpackages: the closure must resolve
+    # the from-import to the RIGHT one by path suffix, not basename
+    pkg = tmp_path / "pkg"
+    (pkg / "a").mkdir(parents=True)
+    (pkg / "b").mkdir()
+    for d in (pkg, pkg / "a", pkg / "b"):
+        (d / "__init__.py").write_text("")
+    (pkg / "a" / "helpers.py").write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def h(x):
+            return jnp.zeros((4,), jnp.int64)  # clean
+    """))
+    (pkg / "b" / "helpers.py").write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def h(x):
+            return jnp.zeros((4,))  # weak dtype: must be found
+    """))
+    (pkg / "b" / "core.py").write_text(textwrap.dedent("""
+        import jax
+        from pkg.b.helpers import h
+
+        def _core(x):
+            return h(x)
+
+        solve = jax.jit(_core)
+    """))
+    report = run_vet([str(tmp_path)])
+    assert [f.rule for f in report.findings] == ["trace-weak-int"]
+    assert report.findings[0].file.endswith("b/helpers.py")
+
+
+# -- pass 2: dtype-contract --------------------------------------------------
+
+# indented to match the in-test fixture literals (textwrap.dedent runs on
+# the concatenation)
+DTYPE_TABLE = """
+        FIELD_DTYPES = {"name_rank": "int64", "b_valid": "bool",
+                        "prev_val": "int32"}
+"""
+
+
+def test_dtype_contract_catches_drift(tmp_path):
+    report = _vet(tmp_path, "tensors.py", DTYPE_TABLE + """
+        import numpy as np
+
+        def build(C):
+            name_rank = np.zeros(C, np.int32)   # drift: table says int64
+            b_valid = np.zeros(C)               # missing dtype -> f64
+            prev_val = np.zeros((C, 4), np.int32)  # correct
+            return name_rank, b_valid, prev_val
+    """)
+    assert len(report.findings) == 2
+    assert all(f.rule == "dtype-contract" for f in report.findings)
+
+
+def test_dtype_contract_clean_on_fixed(tmp_path):
+    report = _vet(tmp_path, "tensors.py", DTYPE_TABLE + """
+        import numpy as np
+
+        def build(C, other):
+            name_rank = np.zeros(C, np.int64)
+            b_valid = np.zeros(C, bool)
+            prev_val = np.asarray(other, np.int32)
+            local = np.zeros(C)  # not a declared field: unchecked
+            return name_rank, b_valid, prev_val, local
+    """)
+    assert report.clean, report.render_text()
+
+
+def test_dtype_contract_checks_astype_and_attributes(tmp_path):
+    report = _vet(tmp_path, "tensors.py", DTYPE_TABLE + """
+        import numpy as np
+
+        def build(batch, raw):
+            batch.name_rank = raw.astype(np.int32)  # attribute drift
+            return batch
+    """)
+    assert [f.rule for f in report.findings] == ["dtype-contract"]
+
+
+# -- pass 3: spec-coverage ---------------------------------------------------
+
+SPEC_FIELDS = """
+    import numpy as np
+    from dataclasses import dataclass, field
+
+    @dataclass
+    class SolverBatch:
+        B: int
+        avail_milli: np.ndarray
+        region_id: np.ndarray = field(default=None)
+        route: np.ndarray = field(default=None)
+        names: list = None
+"""
+
+
+def test_spec_coverage_catches_missing_and_stale(tmp_path):
+    report = _vet(tmp_path, "tensors.py", SPEC_FIELDS, extra={
+        "meshing.py": """
+            HOST_ONLY_FIELDS = frozenset({"route"})
+
+            def shard_specs():
+                return {"avail_milli": 1, "stale_key": 2}
+        """})
+    msgs = sorted(f.message for f in report.findings)
+    assert len(msgs) == 2
+    assert "region_id" in msgs[0]   # missing spec entry
+    assert "stale_key" in msgs[1]   # spec entry with no field
+
+
+def test_spec_coverage_clean_on_fixed(tmp_path):
+    report = _vet(tmp_path, "tensors.py", SPEC_FIELDS, extra={
+        "meshing.py": """
+            HOST_ONLY_FIELDS = frozenset({"route"})
+
+            def shard_specs():
+                return {"avail_milli": 1, "region_id": 2}
+        """})
+    assert report.clean, report.render_text()
+
+
+# -- pass 4: lock-discipline -------------------------------------------------
+
+LOCK_BAD = """
+    import threading
+
+    class Ring:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._ring = []  # guarded-by: _lock
+            self._count = 0  # guarded-by: _lock
+
+        def good(self, t):
+            with self._lock:
+                self._ring.append(t)
+                self._count += 1
+
+        def bad_call(self, t):
+            self._ring.append(t)
+
+        def bad_rebind(self):
+            self._ring = []
+
+        def bad_item(self, i, t):
+            self._ring[i] = t
+
+        def bad_aug(self):
+            self._count += 1
+"""
+
+
+def test_lock_discipline_catches_seeded(tmp_path):
+    report = _vet(tmp_path, "mod.py", LOCK_BAD)
+    assert len(report.findings) == 4
+    assert all(f.rule == "guarded-by" for f in report.findings)
+
+
+def test_lock_discipline_clean_on_fixed(tmp_path):
+    report = _vet(tmp_path, "mod.py", """
+        import threading
+
+        class Ring:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ring = []  # guarded-by: _lock
+
+            def push(self, t):
+                with self._lock:
+                    self._ring.append(t)
+
+            def reset(self):
+                with self._lock:
+                    self._ring = []
+
+            def read(self):
+                return list(self._ring)  # reads are not checked
+    """)
+    assert report.clean, report.render_text()
+
+
+def test_lock_discipline_nested_def_resets_context(tmp_path):
+    # a `with` around a def does NOT guard the deferred body
+    report = _vet(tmp_path, "mod.py", """
+        import threading
+
+        class Ring:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ring = []  # guarded-by: _lock
+
+            def schedule(self):
+                with self._lock:
+                    def later():
+                        self._ring.append(1)
+                    return later
+    """)
+    assert [f.rule for f in report.findings] == ["guarded-by"]
+
+
+def test_lock_discipline_module_level_and_mutators(tmp_path):
+    report = _vet(tmp_path, "mod.py", """
+        import threading
+
+        _LOCK = threading.Lock()
+        _LAST: dict = {}  # guarded-by: _LOCK
+
+        class Q:
+            def __init__(self):
+                self._qlock = threading.Lock()
+                # guarded-by: _qlock; mutators: push
+                self.queue = object()
+
+            def ok(self):
+                with self._qlock:
+                    self.queue.push(1)
+
+            def bad(self):
+                self.queue.push(1)
+
+            def fine_read(self):
+                return self.queue.depths()
+
+        def ok():
+            with _LOCK:
+                _LAST.update(x=1)
+
+        def bad():
+            _LAST["x"] = 2
+    """)
+    assert len(report.findings) == 2
+    lines = sorted(f.line for f in report.findings)
+    assert all(f.rule == "guarded-by" for f in report.findings)
+
+
+# -- waivers -----------------------------------------------------------------
+
+def test_waiver_suppresses_and_is_counted(tmp_path):
+    report = _vet(tmp_path, "tensors.py", DTYPE_TABLE + """
+        import numpy as np
+
+        def build(C):
+            # vet: ignore[dtype-contract] fixture: deliberately int32
+            name_rank = np.zeros(C, np.int32)
+            return name_rank
+    """)
+    assert report.clean
+    assert len(report.waivers) == 1
+    w = report.waivers[0]
+    assert w.rule == "dtype-contract"
+    assert "deliberately" in w.justification
+
+
+def test_bare_waiver_is_a_finding(tmp_path):
+    report = _vet(tmp_path, "tensors.py", DTYPE_TABLE + """
+        import numpy as np
+
+        def build(C):
+            name_rank = np.zeros(C, np.int32)  # vet: ignore[dtype-contract]
+            return name_rank
+    """)
+    rules = sorted(f.rule for f in report.findings)
+    # the unjustified waiver suppresses nothing AND is itself reported
+    assert rules == ["dtype-contract", "waiver-syntax"]
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_cli_vet_json_and_exit_codes(tmp_path, capsys):
+    from karmada_tpu import cli
+
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "tensors.py").write_text(textwrap.dedent(DTYPE_TABLE + """
+        import numpy as np
+        name_rank = np.zeros(4, np.int32)
+    """))
+    rc = cli.main(["vet", str(bad), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["clean"] is False
+    assert out["counts"]["findings"] == 1
+    f = out["findings"][0]
+    assert f["rule"] == "dtype-contract" and f["line"] > 0
+
+    good = tmp_path / "good"
+    good.mkdir()
+    (good / "ok.py").write_text("x = 1\n")
+    assert cli.main(["vet", str(good), "--format", "json"]) == 0
+    assert json.loads(capsys.readouterr().out)["clean"] is True
+
+    # unknown rule filter: usage error, distinct exit code
+    assert cli.main(["vet", str(good), "--rules", "nope"]) == 2
+
+
+def test_cli_vet_rule_filter(tmp_path, capsys):
+    from karmada_tpu import cli
+
+    (tmp_path / "tensors.py").write_text(textwrap.dedent(DTYPE_TABLE + """
+        import numpy as np
+        name_rank = np.zeros(4, np.int32)
+    """))
+    rc = cli.main(["vet", str(tmp_path), "--rules", "trace-branch",
+                   "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["clean"] is True  # dtype finding filtered out
+
+
+def test_cli_vet_nonexistent_path_is_usage_error(tmp_path, capsys):
+    # a typo'd path must be exit 2, never a 0-file "clean" pass
+    from karmada_tpu import cli
+
+    rc = cli.main(["vet", str(tmp_path / "no_such_dir")])
+    err = capsys.readouterr().err
+    assert rc == 2 and "no such path" in err
+
+
+def test_rule_filter_keeps_all_waivers(tmp_path):
+    # the waiver population is an audit surface: --rules never hides it
+    report = _vet(tmp_path, "tensors.py", DTYPE_TABLE + """
+        import numpy as np
+
+        def build(C):
+            # vet: ignore[dtype-contract] fixture: deliberately int32
+            name_rank = np.zeros(C, np.int32)
+            return name_rank
+    """)
+    filtered = run_vet([str(tmp_path)], rules=["trace-branch"])
+    assert filtered.clean
+    assert len(filtered.waivers) == 1
+    assert filtered.waivers[0].rule == "dtype-contract"
+
+
+# -- armed runtime guards ----------------------------------------------------
+
+def _mini_batch():
+    from karmada_tpu.models.cluster import (
+        Cluster, ClusterSpec, ClusterStatus, ResourceSummary,
+    )
+    from karmada_tpu.models.meta import ObjectMeta
+    from karmada_tpu.models.policy import Placement
+    from karmada_tpu.models.work import (
+        ResourceBindingSpec, ResourceBindingStatus,
+    )
+    from karmada_tpu.ops import tensors as T
+    from karmada_tpu.utils.quantity import Quantity
+
+    clusters = [
+        Cluster(
+            metadata=ObjectMeta(name=f"m{i}"),
+            spec=ClusterSpec(),
+            status=ClusterStatus(resource_summary=ResourceSummary(
+                allocatable={"cpu": Quantity.from_milli(64000),
+                             "pods": Quantity.from_units(110)},
+                allocated={},
+            )),
+        )
+        for i in range(2)
+    ]
+    items = [(ResourceBindingSpec(placement=Placement(), replicas=3),
+              ResourceBindingStatus())]
+    return T.encode_batch(items, T.ClusterIndex.build(clusters))
+
+
+def test_guards_pass_on_real_batch_and_catch_drift():
+    from karmada_tpu.analysis import guards
+
+    batch = _mini_batch()
+    guards.check_batch(batch)  # canonical tables match reality
+    batch.name_rank = batch.name_rank.astype(np.int32)
+    with pytest.raises(guards.InvariantViolation, match="name_rank"):
+        guards.check_batch(batch)
+
+
+def test_guards_armed_through_solver_dispatch():
+    from karmada_tpu.analysis import guards
+    from karmada_tpu.ops import solver
+
+    guards.arm()
+    try:
+        batch = _mini_batch()
+        res = solver.solve_compact(batch, waves=1)  # clean: no raise
+        assert res[3] >= 0
+        batch.replicas = batch.replicas.astype(np.int32)
+        with pytest.raises(guards.InvariantViolation, match="replicas"):
+            solver.solve_compact(batch, waves=1)
+    finally:
+        guards.arm(False)
+
+
+def test_guards_d2h_checks():
+    from karmada_tpu.analysis import guards
+
+    ok_idx = np.array([0, 3, -1], np.int32)
+    ok_val = np.array([2, 1, 0], np.int32)
+    ok_st = np.zeros(2, np.int32)
+    guards.check_d2h(ok_idx, ok_val, ok_st, dense_nnz=16)
+    with pytest.raises(guards.InvariantViolation, match="out of range"):
+        guards.check_d2h(np.array([99], np.int32), ok_val[:1], ok_st,
+                         dense_nnz=16)
+    with pytest.raises(guards.InvariantViolation, match="status"):
+        guards.check_d2h(ok_idx, ok_val, np.array([7], np.int32),
+                         dense_nnz=16)
+    with pytest.raises(guards.InvariantViolation, match="int32"):
+        guards.check_d2h(ok_idx.astype(np.int64), ok_val, ok_st,
+                         dense_nnz=16)
+
+
+def test_guards_disarmed_is_noop():
+    from karmada_tpu.analysis import guards
+
+    assert not guards.armed()
+    batch = _mini_batch()
+    batch.replicas = batch.replicas.astype(np.int32)
+    from karmada_tpu.ops import solver
+
+    # disarmed: the drifted batch still dispatches (pre-vet behavior)
+    res = solver.solve_compact(batch, waves=1)
+    assert res[3] >= 0
