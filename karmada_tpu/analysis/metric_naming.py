@@ -1,0 +1,94 @@
+"""Pass 5 — metric naming conventions for every registry registration.
+
+Every metric the package registers (``REGISTRY.counter/gauge/histogram``
+— any ``*REGISTRY``-named receiver, covering the ``_REGISTRY`` aliases)
+must be:
+
+  * ``karmada_``-prefixed — the scrape surface is shared with upstream
+    dashboards, and an unprefixed series is unfindable next to the
+    reference's metrics;
+  * snake_case (``karmada_[a-z0-9]+(_[a-z0-9]+)*``) — the Prometheus
+    naming convention, and what every existing alert template assumes;
+  * carrying non-empty help text — ``# HELP`` is the only in-band
+    documentation a scrape consumer ever sees.
+
+The metric NAME must also be a string literal: a computed name cannot be
+vetted and would silently bypass this pass (and the registry-collision
+test), so it is itself a finding.  Help text given as a non-literal
+expression is accepted (f-strings assembling static fragments) — only a
+missing or literally-empty help fails.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Sequence
+
+from karmada_tpu.analysis.core import Finding, SourceFile, dotted
+
+_METRIC_METHODS = ("counter", "gauge", "histogram")
+_NAME_RE = re.compile(r"^karmada_[a-z0-9]+(_[a-z0-9]+)*$")
+
+
+def _registration(node: ast.Call) -> Optional[str]:
+    """The registry method name when `node` is a metric registration
+    (<...>REGISTRY.counter/gauge/histogram(...)), else None."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in _METRIC_METHODS:
+        return None
+    base = dotted(fn.value)
+    if base is None or not base.rsplit(".", 1)[-1].upper().endswith("REGISTRY"):
+        return None
+    return fn.attr
+
+
+def _arg(node: ast.Call, pos: int, *kw_names: str) -> Optional[ast.expr]:
+    if len(node.args) > pos:
+        return node.args[pos]
+    for k in node.keywords:
+        if k.arg in kw_names:
+            return k.value
+    return None
+
+
+def run(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            method = _registration(node)
+            if method is None:
+                continue
+            name_node = _arg(node, 0, "name")
+            if not (isinstance(name_node, ast.Constant)
+                    and isinstance(name_node.value, str)):
+                findings.append(Finding(
+                    rule="metric-naming", file=sf.path, line=node.lineno,
+                    message=f"REGISTRY.{method}() metric name must be a "
+                            "string literal — a computed name cannot be "
+                            "vetted for the karmada_ naming contract",
+                ))
+                continue
+            name = name_node.value
+            if not _NAME_RE.match(name):
+                findings.append(Finding(
+                    rule="metric-naming", file=sf.path, line=node.lineno,
+                    message=f"metric `{name}` violates the naming contract: "
+                            "must be karmada_-prefixed snake_case "
+                            "(karmada_[a-z0-9]+(_[a-z0-9]+)*)",
+                ))
+            help_node = _arg(node, 1, "help_", "help")
+            if help_node is None or (
+                isinstance(help_node, ast.Constant)
+                and (not isinstance(help_node.value, str)
+                     or not help_node.value.strip())
+            ):
+                findings.append(Finding(
+                    rule="metric-naming", file=sf.path, line=node.lineno,
+                    message=f"metric `{name}` has no help text — # HELP is "
+                            "the only in-band documentation a scrape "
+                            "consumer sees",
+                ))
+    return findings

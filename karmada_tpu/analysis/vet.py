@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence
 from karmada_tpu.analysis import (
     dtype_contract,
     lock_discipline,
+    metric_naming,
     spec_coverage,
     trace_safety,
 )
@@ -44,6 +45,7 @@ PASSES = {
     "dtype-contract": (dtype_contract.run, ("dtype-contract",)),
     "spec-coverage": (spec_coverage.run, ("spec-coverage",)),
     "lock-discipline": (lock_discipline.run, ("guarded-by",)),
+    "metric-naming": (metric_naming.run, ("metric-naming",)),
 }
 
 

@@ -36,7 +36,8 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                 probe_device: bool = False, probe_timeout: float = 240.0,
                 device_cycle_timeout: Optional[float] = None,
                 pipeline_chunk: int = 1024,
-                mesh: Optional[str] = None):
+                mesh: Optional[str] = None,
+                explain: float = 0.0):
     """controllers=None rehydrates the persisted --controllers spec; an
     explicit spec is also persisted so later invocations honor it.
 
@@ -62,7 +63,8 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
     cp = ControlPlane(backend=backend, persist_dir=directory, waves=waves,
                       controllers=controllers, pipeline_chunk=pipeline_chunk,
                       mesh_shape=mesh_shape,
-                      device_cycle_timeout_s=device_cycle_timeout)
+                      device_cycle_timeout_s=device_cycle_timeout,
+                      explain=explain)
     if controllers is not None:
         cp.apply({"apiVersion": "v1", "kind": "ConfigMap",
                   "metadata": {"namespace": "karmada-system",
@@ -668,11 +670,109 @@ def cmd_api_resources(args) -> int:
     return 0
 
 
+def _render_decision(d: dict) -> None:
+    """Render one explain-plane Decision: the kube-scheduler-style
+    one-liner plus the per-cluster verdict table."""
+    from karmada_tpu.obs.decisions import REASON_LABEL
+
+    print(f"BINDING: {d['key']}")
+    print(f"OUTCOME: {d['outcome']}"
+          + (f" (dominant reason: {d['reason']})" if d.get("reason") else ""))
+    print(f"MESSAGE: {d.get('message', '')}")
+    if d.get("trace_id"):
+        print(f"TRACE:   {d['trace_id']}  (karmadactl trace --endpoint "
+              f"URL {d['trace_id']})")
+    print(f"BACKEND: {d.get('backend', '?')}")
+    rows = []
+    for c in d.get("clusters", []):
+        reasons = ", ".join(REASON_LABEL.get(r, r) for r in c.get("reasons", []))
+        rows.append([
+            c["name"],
+            str(c.get("replicas", 0)),
+            "ok" if not c.get("verdict") else f"0x{c['verdict']:x}",
+            reasons or "-",
+            "-" if c.get("score") is None else str(c["score"]),
+            "-" if c.get("avail") is None else str(c["avail"]),
+            "-" if c.get("static_weight") is None else str(c["static_weight"]),
+            "-" if c.get("plugin_score") is None else str(c["plugin_score"]),
+        ])
+    if rows:
+        _print_table(rows, ["CLUSTER", "REPLICAS", "VERDICT", "REASONS",
+                            "SCORE", "AVAIL", "STATIC_W", "PLUGIN"])
+    if d.get("clusters_omitted"):
+        print(f"({d['clusters_omitted']} more rejected cluster(s) omitted; "
+              "reason_counts cover the whole fleet)")
+    if d.get("reason_counts"):
+        counts = ", ".join(f"{r}={n}" for r, n in
+                           sorted(d["reason_counts"].items()))
+        print(f"REJECTIONS: {counts}")
+
+
+def _explain_remote(args) -> int:
+    """`karmadactl explain <namespace>/<binding> --endpoint URL`: fetch a
+    placement decision from a serve process's explain plane
+    (`serve --explain --metrics-port ...`) and render it; with no binding
+    argument, list the recent decisions + the unschedulable shelf."""
+    import urllib.error
+    import urllib.request
+
+    base = args.endpoint.rstrip("/")
+    path = ("/debug/explain" if not args.kind
+            else f"/debug/explain/{args.kind}")
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            payload = json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            msg = json.loads(e.read().decode()).get("error", str(e))
+        except Exception:  # noqa: BLE001 — non-JSON error body
+            msg = str(e)
+        print(f"server error ({e.code}): {msg}", file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"cannot reach {base}: {e.reason}", file=sys.stderr)
+        return 1
+    if args.kind:
+        _render_decision(payload)
+        return 0
+    if not payload.get("enabled", False):
+        print("explain plane is disabled on the server "
+              "(serve --explain to arm it)", file=sys.stderr)
+        return 1
+    rows = [
+        [d["key"], d["outcome"], d.get("reason") or "-",
+         (d.get("message") or "")[:60]]
+        for d in payload.get("unschedulable", []) + payload.get("decisions", [])
+    ]
+    _print_table(rows or [["-"] * 4],
+                 ["BINDING", "OUTCOME", "REASON", "MESSAGE"])
+    return 0
+
+
 def cmd_explain(args) -> int:
-    """Field documentation from the dataclass tree
-    (pkg/karmadactl/explain)."""
+    """Two modes (pkg/karmadactl/explain + the explain plane):
+
+    * `karmadactl explain <Kind>` — field documentation from the
+      dataclass tree, as before;
+    * `karmadactl explain <namespace>/<binding> --endpoint URL` — the
+      per-binding placement verdict from a serve process's explain plane
+      (why it landed where it did / why it is unschedulable).
+    """
     import dataclasses
     import typing
+
+    if getattr(args, "endpoint", "") or (args.kind and "/" in args.kind):
+        if not getattr(args, "endpoint", ""):
+            print("explaining a binding decision needs --endpoint URL "
+                  "(the serve process's observability endpoint)",
+                  file=sys.stderr)
+            return 1
+        return _explain_remote(args)
+    if not args.kind:
+        print("usage: karmadactl explain <Kind> | "
+              "karmadactl explain <namespace>/<binding> --endpoint URL",
+              file=sys.stderr)
+        return 1
 
     registry = _model_registry()
     cls = registry.get(args.kind)
@@ -906,6 +1006,18 @@ def cmd_serve(args) -> int:
         guards.arm()
         print("runtime invariant guards armed "
               "(solver entry + d2h boundaries; analysis/guards)")
+    explain_rate = 0.0
+    if args.explain:
+        try:
+            explain_rate = float(args.explain)
+        except ValueError:
+            print(f"--explain rate must be a number in (0, 1], "
+                  f"got {args.explain!r}", file=sys.stderr)
+            return 1
+        if not 0.0 < explain_rate <= 1.0:
+            print(f"--explain rate must be in (0, 1], got {explain_rate}",
+                  file=sys.stderr)
+            return 1
     try:
         cp = _load_plane(args.dir, backend=args.backend, waves=args.waves,
                          controllers=args.controllers,
@@ -915,10 +1027,22 @@ def cmd_serve(args) -> int:
                              args.device_cycle_timeout
                              if args.device_cycle_timeout > 0 else None),
                          pipeline_chunk=args.pipeline_chunk,
-                         mesh=args.mesh)
+                         mesh=args.mesh, explain=explain_rate)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 1
+    if explain_rate > 0:
+        if args.metrics_port >= 0:
+            pct = f"{explain_rate:.0%}" if explain_rate < 1 else "every"
+            print(f"explain plane armed ({pct} cycle(s) sampled): "
+                  "per-binding placement verdicts at /debug/explain; "
+                  "render with `karmadactl explain NAMESPACE/BINDING "
+                  "--endpoint URL`")
+        else:
+            print("WARNING: --explain is armed but --metrics-port is "
+                  "disabled, so /debug/explain is unreachable; add "
+                  "--metrics-port PORT to read the decisions",
+                  file=sys.stderr)
     if args.feature_gates:
         cp.gates.set_from_string(args.feature_gates)
     cp.runtime._periodic_interval_s = args.sync_period  # noqa: SLF001
@@ -1383,7 +1507,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "only reported FINDINGS are filtered")
 
     ex = sub.add_parser("explain")
-    ex.add_argument("kind")
+    ex.add_argument("kind", nargs="?", default="",
+                    help="an API Kind (field docs), or namespace/binding "
+                         "with --endpoint (placement decision)")
+    ex.add_argument("--endpoint", default="",
+                    help="observability endpoint URL of a serve process "
+                         "armed with --explain; renders the binding's "
+                         "placement verdict table (omit the binding "
+                         "argument to list recent decisions)")
 
     to = sub.add_parser("token")
     to.add_argument("action", choices=["create", "list"])
@@ -1456,6 +1587,19 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--metrics-port", type=int, default=-1,
                     help="serve /metrics,/healthz,/readyz,/debug/state on "
                          "127.0.0.1:PORT (0 = ephemeral, -1 = disabled)")
+    sv.add_argument("--explain", nargs="?", const="1", default="",
+                    metavar="RATE",
+                    help="arm the explain plane: sampled scheduling "
+                         "cycles run the solver's explain jit variant "
+                         "and record per-binding placement verdicts "
+                         "(filter bitmask, score/capacity breakdown, "
+                         "dominant unschedulable reason) in a bounded "
+                         "ring at /debug/explain, rendered by "
+                         "`karmadactl explain ns/binding --endpoint URL`."
+                         "  RATE in (0, 1] samples that fraction of "
+                         "cycles (bare --explain = every cycle); the "
+                         "disarmed path compiles byte-identical to "
+                         "--explain off")
     sv.add_argument("--trace-buffer", type=int, default=0,
                     help="arm the flight recorder: retain the last N "
                          "cross-component traces (scheduler cycles, "
@@ -1578,6 +1722,10 @@ def _dispatch(args) -> int:
     if args.command == "vet":
         # pure source analysis: no plane, no server
         return cmd_vet(args)
+    if args.command == "explain":
+        # kind mode reads only the model registry; binding mode talks to
+        # a live serve process over HTTP — neither opens a plane
+        return cmd_explain(args)
     if getattr(args, "server", None):
         handler = REMOTE_COMMANDS.get(args.command)
         if handler is None:

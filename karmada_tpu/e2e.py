@@ -82,6 +82,9 @@ class ControlPlane:
         # cycle exceeding this degrades to the fastest host backend.
         # None disables (tests / known-good hardware).
         device_cycle_timeout_s: Optional[float] = None,
+        # explain plane (serve --explain[=RATE], obs/decisions): sample
+        # rate of scheduling cycles recording placement Decision records
+        explain: float = 0.0,
     ) -> None:
         self.clock = clock if clock is not None else time.time
         from karmada_tpu.utils.events import EventRecorder
@@ -135,7 +138,8 @@ class ControlPlane:
                                    recorder=self.recorder, waves=waves,
                                    pipeline_chunk=pipeline_chunk,
                                    mesh_shape=mesh_shape,
-                                   device_cycle_timeout_s=device_cycle_timeout_s)
+                                   device_cycle_timeout_s=device_cycle_timeout_s,
+                                   explain=explain)
         self.binding_controller = BindingController(
             self.store, self.runtime, self.interpreter
         )

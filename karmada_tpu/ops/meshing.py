@@ -149,6 +149,9 @@ def shard_specs() -> Dict[str, object]:
         "region_id": P(AXIS_CLUSTERS),
         "pl_has_region_sc": P(None), "pl_region_min": P(None),
         "pl_region_max": P(None),
+        # explain plane (obs/decisions bit layout): placement-static
+        # failure bits shard with the other [P, C] placement rows
+        "pl_fail_bits": P(None, AXIS_CLUSTERS),
         # binding axis: data parallel
         "b_valid": P(AXIS_BINDINGS), "placement_id": P(AXIS_BINDINGS),
         "gvk_id": P(AXIS_BINDINGS), "class_id": P(AXIS_BINDINGS),

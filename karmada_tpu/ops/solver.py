@@ -212,6 +212,66 @@ from karmada_tpu.ops.tensors import (  # noqa: E402
     STRAT_STATIC,
 )
 
+# explain-plane verdict bit layout (obs/decisions is the single authority;
+# pure int constants — no runtime dependency rides in)
+from karmada_tpu.obs.decisions import (  # noqa: E402
+    N_VERDICT_BITS,
+    VERDICT_API_ENABLEMENT,
+    VERDICT_BIT_CAPACITY,
+    VERDICT_CAPACITY,
+    VERDICT_CLUSTER_GONE,
+    VERDICT_EVICTION,
+    VERDICT_NOT_SELECTED,
+    VERDICT_TOLERATION,
+)
+
+
+def _explain_verdict(fail_static, tol_ok, api_ok_b, evict, lanes_ok,
+                     avail_cal, feasible, sel, workload, b_valid, status):
+    """The per-(binding, cluster) filter-verdict bitmask (int32 [B, C])
+    from the stage predicates the kernel already evaluates.  Bits are
+    INDEPENDENT — a cluster failing several stages carries them all; the
+    serial-parity contract (obs/decisions.first_reason) reads the lowest
+    set bit, which is the serial chain's first-rejection-wins reason.
+
+    On an UNSCHEDULABLE row (aggregate capacity shortfall in selection /
+    division) every feasible cluster carries CAPACITY: the binding's
+    demand exceeded what they offer TOGETHER, which is the kube-style
+    "insufficient capacity" story — NOT_SELECTED is reserved for trims
+    of a schedulable binding (spread max-groups, aggregated prefix)."""
+    v = fail_static.astype(jnp.int32)
+    v = v | jnp.where(tol_ok, 0, VERDICT_TOLERATION).astype(jnp.int32)
+    v = v | jnp.where(api_ok_b, 0, VERDICT_API_ENABLEMENT).astype(jnp.int32)
+    v = v | jnp.where(evict, VERDICT_EVICTION, 0).astype(jnp.int32)
+    v = v | jnp.where(lanes_ok, 0, VERDICT_CLUSTER_GONE).astype(jnp.int32)
+    unsched = (status == STATUS_UNSCHEDULABLE)[:, None]
+    v = v | jnp.where(((avail_cal <= 0) | (unsched & feasible))
+                      & workload[:, None],
+                      VERDICT_CAPACITY, 0).astype(jnp.int32)
+    v = v | jnp.where(feasible & ~sel & ~unsched, VERDICT_NOT_SELECTED,
+                      0).astype(jnp.int32)
+    return jnp.where(b_valid[:, None], v, 0).astype(jnp.int32)
+
+
+def _explain_outcome(verdict, status, cluster_valid):
+    """Per-binding outcome code (int32 [B]): low byte is the solver
+    STATUS_*, bits 8+ hold 1 + the bit index of the DOMINANT rejection
+    stage — the stage that is the first-set (serial-priority) reason on
+    the most real clusters; ties break toward the higher-priority stage
+    (argmax returns the first maximum).  A capacity-shortfall
+    UNSCHEDULABLE status always classifies as capacity."""
+    low = verdict & (-verdict)  # lowest set bit per lane (0 when clean)
+    counts = jnp.stack(
+        [jnp.sum(((low == (1 << k)) & cluster_valid[None, :])
+                 .astype(jnp.int32), axis=1)
+         for k in range(N_VERDICT_BITS)], axis=1)  # [B, n_bits]
+    dom = jnp.argmax(counts, axis=1).astype(jnp.int32)
+    any_rej = jnp.max(counts, axis=1) > 0
+    dom_code = jnp.where(any_rej, dom + 1, 0).astype(jnp.int32)
+    dom_code = jnp.where(status == STATUS_UNSCHEDULABLE,
+                         jnp.int32(VERDICT_BIT_CAPACITY + 1), dom_code)
+    return (status.astype(jnp.int32) | (dom_code << 8)).astype(jnp.int32)
+
 _AVAIL_BITS = 34  # avail values clamped below 2^34 for key packing
 _AVAIL_CAP = (1 << _AVAIL_BITS) - 1
 
@@ -619,10 +679,20 @@ def _schedule_core(
     b_valid, placement_id, gvk_id, class_id, replicas, uid_desc, fresh,
     non_workload, nw_shortcut, prev_idx, prev_val, evict_idx,
     used0_milli=None, used0_pods=None, used0_sets=None,
+    pl_fail_bits=None,
     *, waves: int = 1, use_extra: bool = True, with_used: bool = False,
-    tier: str = "std", shard_mesh=None,
+    tier: str = "std", shard_mesh=None, explain: bool = False,
 ):
     """The full cycle: returns (rep[B,C] int64, selected[B,C] bool, status[B]).
+
+    `explain` (static) is a SEPARATE jit variant emitting the explain
+    plane alongside: a per-(binding, cluster) filter-verdict bitmask, the
+    selection-score and estimator-capacity breakdown planes, and a
+    per-binding outcome code — all int32, appended to the return as one
+    (verdict[B,C], score[B,C], avail[B,C], outcome[B]) tuple.
+    `pl_fail_bits` carries the encoder's static per-placement failure
+    bits in (tensors.encode_batch(explain=True)); disarmed calls pass
+    neither and compile byte-identically to the pre-explain program.
 
     `waves` splits the chunk (in its queue-priority order) into sequential
     capacity-contention waves: wave k prices against the snapshot minus what
@@ -726,6 +796,20 @@ def _schedule_core(
             pl_static_w[placement_id_w],
             uid_desc_w, fresh_w, non_workload_w, b_valid_w,
         )
+        expl = ()
+        if explain:
+            pidw = placement_id_w
+            verdict = _explain_verdict(
+                pl_fail_bits[pidw], pl_tol_bypass[pidw] | prev_present_w,
+                api_ok[gvk_id_w] | prev_present_w, evict_w, lanes_ok,
+                avail_cal, feasible, sel,
+                ~non_workload_w & ~nw_shortcut_w, b_valid_w, status)
+            sc_pl = _locality_score(prev_present_w,
+                                    pl_extra_score[pidw])
+            ex_score = jnp.clip(sc_pl, 0, MAX_INT32).astype(jnp.int32)
+            ex_avail = jnp.clip(avail_cal, 0, MAX_INT32).astype(jnp.int32)
+            outcome = _explain_outcome(verdict, status, cluster_valid)
+            expl = (verdict, ex_score, ex_avail, outcome)
         if shard_mesh is not None and waves > 1:
             # pin the scan's stacked per-wave outputs (see docstring)
             from karmada_tpu.ops import meshing
@@ -735,6 +819,13 @@ def _schedule_core(
             rep = lax.with_sharding_constraint(rep, rep_s)
             sel = lax.with_sharding_constraint(sel, sel_s)
             status = lax.with_sharding_constraint(status, st_s)
+            if explain:
+                # the explain planes stack through the same scan DUS —
+                # same partitioner hazard, same pin
+                expl = (lax.with_sharding_constraint(expl[0], rep_s),
+                        lax.with_sharding_constraint(expl[1], rep_s),
+                        lax.with_sharding_constraint(expl[2], rep_s),
+                        lax.with_sharding_constraint(expl[3], st_s))
 
         if waves > 1 or with_used:
             # New consumption only: replicas KEPT from the previous
@@ -754,7 +845,7 @@ def _schedule_core(
             used_sets = used_sets + jax.ops.segment_sum(
                 delta, cid, num_segments=Q + 1
             )[:Q]
-        return (used_milli, used_pods, used_sets), (rep, sel, status)
+        return (used_milli, used_pods, used_sets), (rep, sel, status) + expl
 
     xs = jax.tree.map(
         lambda a: a.reshape((waves, Bw) + a.shape[1:]),
@@ -772,14 +863,22 @@ def _schedule_core(
          else jnp.zeros_like(est_override)),                      # [Q, C]
     )
     if waves == 1:
-        used, (rep, sel, status) = wave_step(
-            carry0, jax.tree.map(lambda a: a[0], xs))
+        used, ys = wave_step(carry0, jax.tree.map(lambda a: a[0], xs))
+        out = ys[:3]
         if with_used:
-            return rep, sel, status, used
-        return rep, sel, status
-    used, (rep, sel, status) = lax.scan(wave_step, carry0, xs)
+            out = out + (used,)
+        if explain:
+            out = out + (ys[3:7],)
+        return out
+    used, ys = lax.scan(wave_step, carry0, xs)
+    rep, sel, status = ys[:3]
     C = rep.shape[-1]
     rep, sel, status = rep.reshape(B, C), sel.reshape(B, C), status.reshape(B)
+    expl = ()
+    if explain:
+        verdict, ex_score, ex_avail, outcome = ys[3:7]
+        expl = (verdict.reshape(B, C), ex_score.reshape(B, C),
+                ex_avail.reshape(B, C), outcome.reshape(B))
     if shard_mesh is not None:
         # pin the reshaped results too: without it the partitioner can
         # back-propagate a bindings sharding of [B] through the reshape
@@ -792,9 +891,16 @@ def _schedule_core(
         rep = lax.with_sharding_constraint(rep, rep_s)
         sel = lax.with_sharding_constraint(sel, sel_s)
         status = lax.with_sharding_constraint(status, st_s)
+        if expl:
+            expl = (lax.with_sharding_constraint(expl[0], rep_s),
+                    lax.with_sharding_constraint(expl[1], rep_s),
+                    lax.with_sharding_constraint(expl[2], rep_s),
+                    lax.with_sharding_constraint(expl[3], st_s))
     out = (rep, sel, status)
     if with_used:
-        return out + (used,)
+        out = out + (used,)
+    if explain:
+        out = out + (expl,)
     return out
 
 
@@ -806,7 +912,7 @@ def _schedule_core(
 schedule_batch = partial(
     jax.jit,
     static_argnames=("waves", "use_extra", "with_used",
-                     "tier", "shard_mesh"))(_schedule_core)
+                     "tier", "shard_mesh", "explain"))(_schedule_core)
 
 
 def _mesh_plan():
@@ -896,32 +1002,34 @@ def _trace_span():
     return obs.TRACER.current() if obs.TRACER.enabled else None
 
 
-def _schedule_compact_impl(*args, waves: int, max_nnz: int,
+def _schedule_compact_impl(*args, pl_fail_bits=None, waves: int, max_nnz: int,
                            keep_sel: bool = False, use_extra: bool = True,
                            with_used: bool = False, tier: str = "std",
-                           shard_mesh=None):
+                           shard_mesh=None, explain: bool = False):
     """The full cycle with the sparse COO extraction FUSED into one jitted
     program: the dense [B, C] result planes never become jit outputs, so
     only idx/val/status/nnz (~max_nnz ints) ever leave the device.
     with_used additionally returns the consumed-capacity accumulators
     (used_milli [C,R], used_pods [C], used_sets [Q,C]) — the carry for a
-    second-pass repack or a later batch of the same cycle."""
-    core = _schedule_core(*args, waves=waves, use_extra=use_extra,
-                          with_used=with_used, tier=tier,
-                          shard_mesh=shard_mesh)
-    if with_used:
-        rep, sel, status, used = core
-    else:
-        rep, sel, status = core
+    second-pass repack or a later batch of the same cycle.  explain (a
+    static: its own jit variant, so the disarmed program is untouched)
+    appends the dense explain plane — verdict/score/avail [B,C] + outcome
+    [B], all int32 — which finalize_compact d2h's alongside the COO."""
+    core = _schedule_core(*args, pl_fail_bits=pl_fail_bits, waves=waves,
+                          use_extra=use_extra, with_used=with_used,
+                          tier=tier, shard_mesh=shard_mesh, explain=explain)
+    rep, sel, status = core[:3]
     compact = _compact_of(rep, sel, status, args[_NON_WORKLOAD_ARG], max_nnz,
                           keep_sel=keep_sel)
     if with_used:
-        return compact + tuple(used)
+        compact = compact + tuple(core[3])
+    if explain:
+        compact = compact + tuple(core[4 if with_used else 3])
     return compact
 
 
 _COMPACT_STATICS = ("waves", "max_nnz", "keep_sel", "use_extra", "with_used",
-                    "tier", "shard_mesh")
+                    "tier", "shard_mesh", "explain")
 schedule_compact = partial(
     jax.jit, static_argnames=_COMPACT_STATICS)(_schedule_compact_impl)
 
@@ -1072,7 +1180,7 @@ def solve(batch, waves: int = 1, tier: str = "std"):
 def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
                      keep_sel: bool = False, with_used: bool = False,
                      used0=None, tier: str = "std",
-                     donate_used0: bool = False):
+                     donate_used0: bool = False, explain: bool = False):
     """Enqueue the fused device solve WITHOUT forcing the result (jax
     dispatch is async): returns an opaque handle for finalize_compact.
     Lets a caller overlap host work (encode of the next chunk, decode of
@@ -1092,9 +1200,18 @@ def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
     remain readable (jax copies host arrays before donating the device
     copy), but live jax arrays passed as used0 are DELETED — callers must
     not read them afterwards (the pipelined executor's donation policy
-    guarantees this)."""
+    guarantees this).
+
+    explain=True dispatches the SEPARATE explain jit variant (the
+    disarmed signature is untouched — no new outputs compile into it):
+    finalize_compact then additionally returns the (verdict, score,
+    avail, outcome) int32 planes.  Requires a batch encoded with
+    tensors.encode_batch(explain=True) — its pl_fail_bits carry the
+    host-decomposed static filter stages."""
     assert batch.C <= MAX_CLUSTER_LANES, \
         f"cluster axis must be <= {MAX_CLUSTER_LANES} per solve call"
+    assert not explain or batch.explain, \
+        "explain dispatch needs a batch encoded with explain=True"
     if _guards.armed():
         # armed invariant mode (serve --check-invariants): the host->device
         # boundary check — dtype/shape drift dies here, not in the SPMD
@@ -1142,11 +1259,13 @@ def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
     fn = schedule_compact_donated if donated else schedule_compact
     use_extra = _use_extra(batch)
     shard_mesh = plan.mesh if plan is not None else None
+    pl_fb = _put("pl_fail_bits", batch.pl_fail_bits, plan) if explain else None
     sp = _trace_span()
     before = _jit_cache_size() if sp is not None else None
-    first = fn(*args, waves=waves, max_nnz=max_nnz,
+    first = fn(*args, pl_fail_bits=pl_fb, waves=waves, max_nnz=max_nnz,
                keep_sel=keep_sel, use_extra=use_extra,
-               with_used=with_used, tier=tier, shard_mesh=shard_mesh)
+               with_used=with_used, tier=tier, shard_mesh=shard_mesh,
+               explain=explain)
     if donated:
         DONATED_DISPATCHES.inc()
     if before is not None:
@@ -1156,7 +1275,7 @@ def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
         if plan is not None:
             sp.set_attr(mesh=plan.shape_str, mesh_devices=plan.n_devices)
     return (args, waves, keep_sel, first, max_nnz, dense_nnz, use_extra,
-            with_used, tier, donated, shard_mesh)
+            with_used, tier, donated, shard_mesh, explain, pl_fb)
 
 
 def wait_compact(handle) -> None:
@@ -1207,7 +1326,7 @@ def finalize_compact(handle):
     import numpy as np
 
     (args, waves, keep_sel, first, max_nnz, dense_nnz, use_extra,
-     with_used, tier, donated, shard_mesh) = handle
+     with_used, tier, donated, shard_mesh, explain, pl_fb) = handle
     res = first
     nnz = res[3]
     while int(nnz) > max_nnz and max_nnz < dense_nnz:
@@ -1219,10 +1338,11 @@ def finalize_compact(handle):
         # static): annotate the ambient span (the pipeline's d2h stage)
         sp = _trace_span()
         before = _jit_cache_size() if sp is not None else None
-        res = schedule_compact(*args, waves=waves, max_nnz=max_nnz,
+        res = schedule_compact(*args, pl_fail_bits=pl_fb, waves=waves,
+                               max_nnz=max_nnz,
                                keep_sel=keep_sel, use_extra=use_extra,
                                with_used=with_used, tier=tier,
-                               shard_mesh=shard_mesh)
+                               shard_mesh=shard_mesh, explain=explain)
         if sp is not None:
             sp.set_attr(escalated_nnz=max_nnz)
             after = _jit_cache_size()
@@ -1241,8 +1361,12 @@ def finalize_compact(handle):
         if any(getattr(u, "is_deleted", None) is not None and u.is_deleted()
                for u in used):
             # donated downstream: the chain already consumed them in place
-            return out + (None,)
-        return out + (tuple(np.asarray(u) for u in used),)
+            out = out + (None,)
+        else:
+            out = out + (tuple(np.asarray(u) for u in used),)
+    if explain:
+        off = 7 if with_used else 4
+        out = out + (tuple(np.asarray(a) for a in res[off:off + 4]),)
     return out
 
 
@@ -1295,13 +1419,16 @@ def solve_big(items, idx_list, cindex, estimator, cache, waves: int = 1,
 
 def solve_compact(batch, waves: int = 1, max_nnz: int = 0,
                   keep_sel: bool = False, with_used: bool = False,
-                  used0=None, tier: str = "std"):
+                  used0=None, tier: str = "std", explain: bool = False):
     """Device-side solve + sparse result extraction: D2H ships only the
     (binding, cluster, replicas) nonzeros instead of the dense [B, C] int64
     plane (x100+ less traffic on realistic mixes).  Escalates max_nnz x4 on
-    overflow, capped at B*C (== dense)."""
+    overflow, capped at B*C (== dense).  explain=True (armed explain
+    plane) appends the (verdict, score, avail, outcome) tuple — see
+    dispatch_compact."""
     return finalize_compact(dispatch_compact(batch, waves=waves,
                                              max_nnz=max_nnz,
                                              keep_sel=keep_sel,
                                              with_used=with_used,
-                                             used0=used0, tier=tier))
+                                             used0=used0, tier=tier,
+                                             explain=explain))

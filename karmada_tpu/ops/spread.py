@@ -55,6 +55,8 @@ from karmada_tpu.ops.solver import (
     _LANE_BITS,
     _capacity_estimates,
     _compact_of,
+    _explain_outcome,
+    _explain_verdict,
     _locality_score,
     _schedule_core,
     _use_extra,
@@ -214,7 +216,7 @@ def _spread_planes(
     # group availability includes already-assigned replicas
     # (group_clusters_with_score: tc.replicas + assigned)
     avail_sel = avail_cal + prev_rep * prev_present
-    return feasible, avail_sel, score
+    return feasible, avail_sel, score, avail_cal, prev_present, evict
 
 
 @partial(jax.jit, static_argnames=("G",))
@@ -233,7 +235,7 @@ def spread_group_info(
 ):
     """Phase A: per-binding region-group scalars [B, G] + a feasibility
     flag [B] — the ONLY outputs; the planes stay on device."""
-    feasible, avail_sel, score = _spread_planes(
+    feasible, avail_sel, score, _, _, _ = _spread_planes(
         cluster_valid, deleting, pods_allowed, has_summary, avail_milli,
         has_alloc, api_ok, req_milli, req_is_cpu, req_pods, est_override,
         pl_mask, pl_tol_bypass, pl_extra_score, placement_id, gvk_id,
@@ -283,7 +285,7 @@ _pick_vmap = jax.vmap(_pick_one, in_axes=(0, 0, None, 0, 0, None))
 
 @partial(jax.jit, static_argnames=("G", "waves", "max_nnz", "keep_sel",
                                    "use_extra", "with_used", "tier",
-                                   "shard_mesh"))
+                                   "shard_mesh", "explain"))
 def spread_assign_compact(
     # cluster axis
     cluster_valid, deleting, name_rank, pods_allowed, has_summary,
@@ -298,9 +300,10 @@ def spread_assign_compact(
     chosen, cluster_max,
     strategy, static_w, ignore_avail, uid_desc, fresh, non_workload, b_valid,
     used0_milli=None, used0_pods=None, used0_sets=None,
+    pl_fail_bits=None,
     *, G: int, waves: int, max_nnz: int, keep_sel: bool = False,
     use_extra: bool = True, with_used: bool = False, tier: str = "std",
-    shard_mesh=None,
+    shard_mesh=None, explain: bool = False,
 ):
     """Phase B + assignment, FUSED: recompute the planes, pick clusters in
     the chosen groups, and run the main assignment kernel with the pick as
@@ -314,12 +317,13 @@ def spread_assign_compact(
     it None."""
     B = placement_id.shape[0]
     C = cluster_valid.shape[0]
-    feasible, avail_sel, score = _spread_planes(
-        cluster_valid, deleting, pods_allowed, has_summary, avail_milli,
-        has_alloc, api_ok, req_milli, req_is_cpu, req_pods, est_override,
-        pl_mask, pl_tol_bypass, pl_extra_score, placement_id, gvk_id,
-        class_id, replicas, nw_shortcut, prev_idx, prev_val, evict_idx,
-    )
+    feasible, avail_sel, score, avail_cal, prev_present, evict = \
+        _spread_planes(
+            cluster_valid, deleting, pods_allowed, has_summary, avail_milli,
+            has_alloc, api_ok, req_milli, req_is_cpu, req_pods, est_override,
+            pl_mask, pl_tol_bypass, pl_extra_score, placement_id, gvk_id,
+            class_id, replicas, nw_shortcut, prev_idx, prev_val, evict_idx,
+        )
     key = _sort_key(score, avail_sel, name_rank[None, :], feasible)
     order = jnp.argsort(key, axis=1)
     sel = _pick_vmap(order, feasible, group_id, chosen, cluster_max, G)
@@ -349,7 +353,28 @@ def spread_assign_compact(
     compact = _compact_of(rep, selected, status, non_workload, max_nnz,
                           keep_sel=keep_sel)
     if with_used:
-        return compact + tuple(used)
+        compact = compact + tuple(used)
+    if explain:
+        # spread-path verdict plane: the static fail bits are the REAL
+        # placement's (gathered per binding by the caller), the pick
+        # eliminations surface as NOT_SELECTED (feasible & ~sel — the
+        # group DFS / max-groups trim "ate" those clusters), and
+        # toleration/api/eviction recompute from the same planes the
+        # phase math used.  Assignment-level trims inside the core (its
+        # pl_mask IS the pick) fold into the same NOT_SELECTED bit via
+        # the core's `selected`.
+        fb = (pl_fail_bits if pl_fail_bits is not None
+              else jnp.zeros((B, C), jnp.int32))
+        lanes_ok = cluster_valid[None, :] & ~deleting[None, :]
+        verdict = _explain_verdict(
+            fb, pl_tol_bypass[placement_id] | prev_present,
+            api_ok[gvk_id] | prev_present, evict, lanes_ok,
+            avail_cal, feasible, sel & selected,
+            ~non_workload & ~nw_shortcut, b_valid, status)
+        ex_score = jnp.clip(score, 0, MAX_INT32).astype(jnp.int32)
+        ex_avail = jnp.clip(avail_cal, 0, MAX_INT32).astype(jnp.int32)
+        outcome = _explain_outcome(verdict, status, cluster_valid)
+        compact = compact + (verdict, ex_score, ex_avail, outcome)
     return compact
 
 
@@ -363,8 +388,18 @@ def solve_spread(
     used0=None,
     axis: str = "",
     tier: str = "std",
+    explain: bool = False,
+    explain_cb=None,
 ):
     """Schedule the ROUTE_DEVICE_SPREAD(_BIG) bindings of one chunk.
+
+    `explain` dispatches the armed jit variant of the fused assignment
+    (spread_assign_compact(explain=True)) and hands each live binding's
+    explain rows to `explain_cb(binding_index, verdict_row, score_row,
+    avail_row, outcome_code)` — rows are numpy [C] slices in cluster-lane
+    order.  Bindings the group DFS failed before assignment never reach
+    the cb; their serial-classed errors in the result dict carry the
+    whole story (the pipeline builds outcome-level decisions for them).
 
     `axis` names the group axis: "" = region (batch.region_id), else a
     label key from batch.label_axes (spread-by-label grouping — group ids
@@ -482,6 +517,11 @@ def solve_spread(
     b_valid[:n_live] = True
     use_extra = _use_extra(batch)  # one shared predicate, hoisted off retries
 
+    if explain:
+        assert batch.explain, \
+            "explain spread solve needs a batch encoded with explain=True"
+    fail_b = batch.pl_fail_bits[lpid] if explain else None  # [Bs, C] rows
+
     def assign(max_nnz):
         return spread_assign_compact(
             batch.cluster_valid, batch.deleting, batch.name_rank,
@@ -500,9 +540,11 @@ def solve_spread(
             used0[0] if used0 is not None else None,
             used0[1] if used0 is not None else None,
             used0[2] if used0 is not None else None,
+            fail_b,
             G=G, waves=waves, max_nnz=max_nnz,
             keep_sel=enable_empty_workload_propagation,
             use_extra=use_extra, with_used=collect_used, tier=tier,
+            explain=explain,
         )
 
     max_nnz = (Bs * C if enable_empty_workload_propagation
@@ -513,6 +555,15 @@ def solve_spread(
         res = assign(max_nnz)
     cidx, cval, status, nnz = res[:4]
     used = (tuple(np.asarray(u) for u in res[4:7]) if collect_used else None)
+    if explain and explain_cb is not None:
+        off = 7 if collect_used else 4
+        everdict, escore, eavail, eoutcome = (
+            np.asarray(a) for a in res[off:off + 4])
+        nc = batch.n_clusters
+        for row in range(n_live):
+            b = int(lidx[row])
+            explain_cb(b, everdict[row, :nc], escore[row, :nc],
+                       eavail[row, :nc], int(eoutcome[row]))
 
     # remap the sub-batch COO rows onto the chunk's binding axis and reuse
     # the one shared decoder (tensors.decode_compact, incl. its native fast

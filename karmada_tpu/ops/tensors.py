@@ -47,6 +47,11 @@ from karmada_tpu.models.work import (
     ResourceBindingStatus,
     TargetCluster,
 )
+from karmada_tpu.obs.decisions import (  # explain bit layout (pure ints)
+    VERDICT_AFFINITY,
+    VERDICT_PLUGIN,
+    VERDICT_SPREAD_PROP,
+)
 from karmada_tpu.ops import serial
 from karmada_tpu.ops.webster import (
     fnv32a_batch_odd,
@@ -133,6 +138,7 @@ FIELD_DTYPES = {
     "route": "int32", "region_id": "int32",
     "pl_has_region_sc": "bool", "pl_region_min": "int32",
     "pl_region_max": "int32",
+    "pl_fail_bits": "int32",
 }
 
 # axis names per field (B/C extents are checked against the batch by the
@@ -156,6 +162,7 @@ FIELD_AXES = {
     "route": ("nB",), "region_id": ("C",),
     "pl_has_region_sc": ("P",), "pl_region_min": ("P",),
     "pl_region_max": ("P",),
+    "pl_fail_bits": ("P", "C"),
 }
 
 # the consumed-capacity carry triple (solver with_used / CarryState):
@@ -264,6 +271,13 @@ class SolverBatch:
     class_keys: List = field(default=None)  # Q-axis order (canonical keys)
     pl_region_min: np.ndarray = field(default=None)  # int32[P]
     pl_region_max: np.ndarray = field(default=None)  # int32[P]
+    # explain plane (obs/decisions bit layout): per-(placement, cluster)
+    # static filter-failure bits — affinity | spread-property | plugin —
+    # populated only by encode_batch(explain=True); all-zero otherwise
+    # (the `explain` flag below distinguishes "no failures" from
+    # "not computed" for dispatch-time validation)
+    pl_fail_bits: np.ndarray = field(default=None)  # int32[P, C]
+    explain: bool = False
 
 
 def _effective_placement(
@@ -412,6 +426,15 @@ class EncoderCache:
 
     def __init__(self) -> None:
         self.placement_rows: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+        # explain plane: per-placement static filter-failure bit rows
+        # (obs/decisions layout), built only under encode_batch(explain=True),
+        # plus the assembled [P, C] plane for one vocabulary.  Kept OUT of
+        # `assembled` on purpose: explain sampling alternates armed and
+        # disarmed cycles over one cache, and folding the plane into the
+        # assembled slot would thrash it (and the solver's device-transfer
+        # cache) on every toggle.
+        self.fail_rows: Dict[str, np.ndarray] = {}
+        self.fail_plane: Optional[Tuple[tuple, np.ndarray]] = None
         self.gvk_rows: Dict[Tuple[str, str], np.ndarray] = {}
         self.override_rows: Dict[Tuple, np.ndarray] = {}
         # id(placement) -> (placement, repr key): placements are shared
@@ -455,20 +478,31 @@ def encode_batch(
     estimator: Optional[GeneralEstimator] = None,
     pad_bindings: bool = True,
     cache: Optional[EncoderCache] = None,
+    explain: bool = False,
 ) -> SolverBatch:
     """Encode one scheduling cycle.  `items` are (spec, status) pairs.
 
     Pass the same `cache` across chunks of one cycle to amortize the
     placement/cluster/override host work (cluster snapshot must not change
     between cached calls).
+
+    `explain` additionally decomposes each placement's predicate row into
+    per-stage failure bits (pl_fail_bits, obs/decisions layout) — the
+    host-side half of the explain plane; the device solve emits the
+    per-binding verdicts from them (ops/solver dispatch_compact(explain)).
+    Disarmed encodes leave the plane all-zero and skip the extra filter
+    evaluations entirely.
     """
     estimator = estimator or GeneralEstimator()
     from karmada_tpu.scheduler.plugins import REGISTRY as _PLUGINS
 
     if cache is not None and cache.plugins_gen != _PLUGINS.generation:
         # out-of-tree plugin set changed: every memoized placement row
-        # (mask/score) is stale
+        # (mask/score, and the explain fail-bit rows that fold plugin
+        # rejections) is stale
         cache.placement_rows = {}
+        cache.fail_rows = {}
+        cache.fail_plane = None
         cache.assembled_sig = None
         cache.assembled = None
         cache.plugins_gen = _PLUGINS.generation
@@ -764,11 +798,16 @@ def encode_batch(
         and cache.assembled is not None
         and cache.assembled_sig == assembled_sig
     ):
+        shared_hit = cache.assembled
+        P_hit = shared_hit["pl_strategy"].shape[0]
+        fail_plane = (_fail_plane(placements, clusters, C, P_hit, cache,
+                                  assembled_sig)
+                      if explain else np.zeros((P_hit, C), np.int32))
         return _build_solver_batch(
-            cache.assembled, B, C, nB, nC, b_valid, placement_id, gvk_id,
+            shared_hit, B, C, nB, nC, b_valid, placement_id, gvk_id,
             class_id, replicas, uid_desc, fresh, non_workload, nw_shortcut,
             prev_idx, prev_val, evict_idx, route, cindex, region_names,
-            list(res_names), list(classes), label_axes,
+            list(res_names), list(classes), label_axes, explain, fail_plane,
         )
 
     # ---- capacity tensors -------------------------------------------------
@@ -855,6 +894,7 @@ def encode_batch(
     pl_extra_score = np.zeros((P, C), np.int64)
     pl_region_min = np.zeros(P, np.int32)
     pl_region_max = np.zeros(P, np.int32)
+    pl_fail_bits = np.zeros((P, C), np.int32)
 
     dummy_status = ResourceBindingStatus()
     # one registry snapshot per encode: single lock acquisition, and every
@@ -893,24 +933,51 @@ def encode_batch(
 
         pkey = _placement_key(placement)
         rows = None if cache is None else cache.placement_rows.get(pkey)
+        fb = (cache.fail_rows.get(pkey) if explain and cache is not None
+              else None)
         if rows is None:
             mask_row = np.zeros(C, bool)
             tol_row = np.zeros(C, bool)
             extra_row = np.zeros(C, np.int64)
             probe = _spec_with(placement)
+            # explain decomposition rides the SAME pass: each stage is
+            # evaluated once (without the folded mask's short-circuit)
+            # and the mask derives from the bits — never a second O(C)
+            # filter sweep for the armed encode
+            build_fb = explain and fb is None
+            fb_new = np.zeros(C, np.int32) if build_fb else None
             for i, c in enumerate(clusters):
-                # affinity + spread-property predicates (no prev bypass)
-                mask_row[i] = (
-                    serial.filter_cluster_affinity(probe, dummy_status, c) is None
-                    and serial.filter_spread_constraint(probe, dummy_status, c) is None
-                    # out-of-tree registry filters fold into the same mask
-                    and (not plug_filters
-                         or eval_filters(plug_filters, placement, c) is None)
-                )
+                if build_fb:
+                    bits = 0
+                    if serial.filter_cluster_affinity(
+                            probe, dummy_status, c) is not None:
+                        bits |= VERDICT_AFFINITY
+                    if serial.filter_spread_constraint(
+                            probe, dummy_status, c) is not None:
+                        bits |= VERDICT_SPREAD_PROP
+                    if plug_filters and eval_filters(
+                            plug_filters, placement, c) is not None:
+                        bits |= VERDICT_PLUGIN
+                    fb_new[i] = bits
+                    mask_row[i] = bits == 0
+                else:
+                    # affinity + spread-property predicates (no prev
+                    # bypass); out-of-tree registry filters fold into the
+                    # same mask
+                    mask_row[i] = (
+                        serial.filter_cluster_affinity(probe, dummy_status, c) is None
+                        and serial.filter_spread_constraint(probe, dummy_status, c) is None
+                        and (not plug_filters
+                             or eval_filters(plug_filters, placement, c) is None)
+                    )
                 # taint toleration WITHOUT the target_contains bypass
                 tol_row[i] = _tolerated(placement, c)
                 if plug_scores:
                     extra_row[i] = eval_scores(plug_scores, placement, c)
+            if build_fb:
+                fb = fb_new
+                if cache is not None:
+                    cache.fail_rows[pkey] = fb
             # static weights (division_algorithm.go:38-72) per cluster
             static_row = np.zeros(C, np.int64)
             s = placement.replica_scheduling
@@ -933,6 +1000,17 @@ def encode_batch(
             if cache is not None:
                 cache.placement_rows[pkey] = rows
         pl_mask[p], pl_tol_bypass[p], pl_static_w[p], pl_extra_score[p] = rows
+        if explain:
+            # mask rows cached from a disarmed encode: decompose the
+            # stages standalone (a cluster failing affinity AND the
+            # spread property carries both bits; the serial-parity
+            # contract compares the lowest set bit only)
+            if fb is None:
+                fb = _fail_row(placement, clusters, C, plug_filters,
+                               dummy_status)
+                if cache is not None:
+                    cache.fail_rows[pkey] = fb
+            pl_fail_bits[p] = fb
 
     # ---- api enablement ---------------------------------------------------
     G = _next_pow2(max(len(gvks), 1), 4)
@@ -976,20 +1054,75 @@ def encode_batch(
                 arr.flags.writeable = False
         cache.assembled_sig = assembled_sig
         cache.assembled = shared
+    if explain and cache is not None:
+        # the explain plane caches beside — never inside — the assembled
+        # slot (see EncoderCache.fail_plane)
+        if pl_fail_bits.flags.owndata:
+            pl_fail_bits.flags.writeable = False
+        cache.fail_plane = (assembled_sig, pl_fail_bits)
 
     return _build_solver_batch(
         shared, B, C, nB, nC, b_valid, placement_id, gvk_id, class_id,
         replicas, uid_desc, fresh, non_workload, nw_shortcut,
         prev_idx, prev_val, evict_idx, route, cindex, region_names,
-        list(res_names), list(classes), label_axes,
+        list(res_names), list(classes), label_axes, explain, pl_fail_bits,
     )
+
+
+def _fail_row(placement, clusters, C, plug_filters, dummy_status
+              ) -> np.ndarray:
+    """One placement's static filter-failure bits per cluster lane
+    (obs/decisions layout: affinity | spread-property | plugin)."""
+    from karmada_tpu.scheduler.plugins import eval_filters
+
+    fb = np.zeros(C, np.int32)
+    probe = _spec_with(placement)
+    for i, c in enumerate(clusters):
+        if serial.filter_cluster_affinity(probe, dummy_status, c) is not None:
+            fb[i] |= VERDICT_AFFINITY
+        if serial.filter_spread_constraint(probe, dummy_status, c) is not None:
+            fb[i] |= VERDICT_SPREAD_PROP
+        if plug_filters and eval_filters(plug_filters, placement,
+                                         c) is not None:
+            fb[i] |= VERDICT_PLUGIN
+    return fb
+
+
+def _fail_plane(placements, clusters, C, P, cache, sig) -> np.ndarray:
+    """The assembled [P, C] fail-bit plane for one vocabulary —
+    single-slot cached on the assembled signature so armed chunks reuse
+    it verbatim and armed/disarmed alternation (explain sampling) never
+    disturbs the assembled/device-transfer caches."""
+    if (cache is not None and cache.fail_plane is not None
+            and cache.fail_plane[0] == sig):
+        return cache.fail_plane[1]
+    from karmada_tpu.scheduler.plugins import REGISTRY as _PLUGINS
+
+    plug_filters = _PLUGINS.enabled_filters()
+    dummy_status = ResourceBindingStatus()
+    plane = np.zeros((P, C), np.int32)
+    for p, placement in enumerate(placements):
+        pkey = _placement_key(placement)
+        fb = cache.fail_rows.get(pkey) if cache is not None else None
+        if fb is None:
+            fb = _fail_row(placement, clusters, C, plug_filters,
+                           dummy_status)
+            if cache is not None:
+                cache.fail_rows[pkey] = fb
+        plane[p] = fb
+    if cache is not None:
+        if plane.flags.owndata:
+            plane.flags.writeable = False
+        cache.fail_plane = (sig, plane)
+    return plane
 
 
 def _build_solver_batch(
     shared, B, C, nB, nC, b_valid, placement_id, gvk_id, class_id,
     replicas, uid_desc, fresh, non_workload, nw_shortcut,
     prev_idx, prev_val, evict_idx, route, cindex, region_names,
-    res_names=None, class_keys=None, label_axes=None,
+    res_names=None, class_keys=None, label_axes=None, explain=False,
+    pl_fail_bits=None,
 ) -> SolverBatch:
     return SolverBatch(
         B=B, C=C, n_bindings=nB, n_clusters=nC,
@@ -1016,7 +1149,10 @@ def _build_solver_batch(
         pl_has_region_sc=shared["pl_has_region_sc"],
         pl_region_min=shared["pl_region_min"],
         pl_region_max=shared["pl_region_max"],
+        pl_fail_bits=(pl_fail_bits if pl_fail_bits is not None
+                      else np.zeros_like(shared["pl_mask"], np.int32)),
         res_names=res_names or [], class_keys=class_keys or [],
+        explain=explain,
     )
 
 

@@ -60,6 +60,16 @@ QUEUE_DEPTH = REGISTRY.gauge(
     ("queue",),
 )
 
+# unschedulable-reason accounting (explain plane, obs/decisions taxonomy):
+# every binding routed to the unschedulable queue counts under its
+# dominant rejection reason — kube-scheduler's "0/5 clusters available"
+# breakdown as a time series
+UNSCHEDULABLE = REGISTRY.counter(
+    "karmada_schedule_unschedulable_total",
+    "Bindings routed to the unschedulable queue, by dominant reason",
+    ("reason",),
+)
+
 # pipelined chunk executor spans (scheduler/pipeline.py): "own" is the
 # chunk's own work (encode span + finalize/decode span), "wall" its
 # submit-to-result time — under pipelining wall also contains the

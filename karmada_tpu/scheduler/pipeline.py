@@ -63,6 +63,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from karmada_tpu import obs
+from karmada_tpu.obs import decisions as obs_decisions
 from karmada_tpu.ops import tensors
 from karmada_tpu.scheduler import metrics as sm
 
@@ -235,6 +236,72 @@ class _CarryChain:
         self._seg[3] = handle
 
 
+def _record_decisions(recorder, batch, part, offset, keys, out_local,
+                      expl_planes, sp_expl, cyc, live: bool) -> None:
+    """Turn one finalized chunk's explain planes into Decision records.
+
+    Main-path rows (ROUTE_DEVICE) decode from the dense planes, spread
+    rows from their callback slices, and everything else the device owns
+    (big tier, group-DFS failures) gets an outcome-level decision from
+    its result object.  Dominant unschedulable reasons are attached to
+    the result exceptions either way (`exc.reason` — the queue's
+    unschedulable map and karmada_schedule_unschedulable_total read it),
+    but nothing is RECORDED for a cancelled cycle."""
+    names = batch.cluster_index.names
+    nc = batch.n_clusters
+    tid = (cyc.trace.trace_id
+           if cyc is not None and getattr(cyc, "trace", None) is not None
+           else None)
+
+    def key_of(i: int) -> str:
+        if keys is not None:
+            return keys[offset + i]
+        return obs_decisions.default_key(part[i][0])
+
+    def attach_reason(res, outcome_code) -> None:
+        _st, dom = obs_decisions.split_outcome(int(outcome_code))
+        if dom is not None and isinstance(res, Exception):
+            res.reason = dom
+
+    covered = set()
+    if expl_planes is not None:
+        verdict, score, avail, outcome = expl_planes
+        for i in range(len(part)):
+            if batch.route[i] != tensors.ROUTE_DEVICE:
+                continue
+            res_i = out_local.get(i)
+            attach_reason(res_i, outcome[i])
+            covered.add(i)
+            if not live:
+                continue
+            pid = int(batch.placement_id[i])
+            recorder.record(obs_decisions.decision_from_planes(
+                key_of(i), names, verdict[i, :nc], score[i, :nc],
+                avail[i, :nc], int(outcome[i]), res_i, trace_id=tid,
+                backend="device",
+                static_w_row=batch.pl_static_w[pid, :nc],
+                plugin_row=batch.pl_extra_score[pid, :nc]))
+    for b, (vrow, srow, arow, oc) in sp_expl.items():
+        res_b = out_local.get(b)
+        attach_reason(res_b, oc)
+        covered.add(b)
+        if not live:
+            continue
+        pid = int(batch.placement_id[b])
+        recorder.record(obs_decisions.decision_from_planes(
+            key_of(b), names, vrow, srow, arow, oc, res_b, trace_id=tid,
+            backend="device-spread",
+            static_w_row=batch.pl_static_w[pid, :nc],
+            plugin_row=batch.pl_extra_score[pid, :nc]))
+    if not live:
+        return
+    for i, r in out_local.items():
+        if i not in covered:
+            # big lane tier / group-DFS failures: outcome-level record
+            recorder.record(obs_decisions.decision_from_result(
+                key_of(i), r, nc, trace_id=tid, backend="device-big"))
+
+
 @dataclass
 class _InFlight:
     """A dispatched, not-yet-finalized chunk."""
@@ -266,6 +333,8 @@ def run_pipeline(
     on_chunk: Optional[Callable[[ChunkStats], None]] = None,
     collect: bool = True,
     diagnose: bool = True,
+    explain: Optional["obs_decisions.DecisionRecorder"] = None,
+    keys: Optional[Sequence[str]] = None,
 ) -> PipelineResult:
     """Schedule `items` (a cycle of (spec, status) pairs) through the
     pipelined chunk executor.  Returns a PipelineResult whose `results`
@@ -291,6 +360,16 @@ def run_pipeline(
       runs out of memory).
     diagnose: rebuild full per-cluster FitError diagnosis for kernel
       FIT_ERROR rows (scheduler on; bench off — it only counts classes).
+    explain: a DecisionRecorder (obs/decisions) arming the explain plane:
+      chunks encode + dispatch the explain jit variant, the per-binding
+      verdict/score/avail/outcome planes are decoded into Decision records
+      at finalize (linked to this cycle's trace id), and unschedulable
+      results get their dominant reason attached (`exc.reason`).  Main-
+      path and spread-path rows carry full per-cluster verdict tables;
+      big-tier rows record outcome-level decisions.  None (the default)
+      leaves every jit signature and transfer byte-identical to today.
+    keys: per-item binding identities ("namespace/name") for the decision
+      records; derived from each spec's workload reference when omitted.
     """
     from karmada_tpu.ops.solver import (
         dispatch_compact,
@@ -330,9 +409,16 @@ def run_pipeline(
     def live() -> bool:
         return cancelled is None or not cancelled.is_set()
 
+    armed = explain is not None
+
     def finalize(entry: _InFlight) -> None:
         batch, part = entry.batch, entry.part
         ch_span = entry.span
+        # spread-path explain rows land here via solve_spread's callback
+        sp_expl: Dict[int, tuple] = {}
+
+        def sp_cb(b, vrow, srow, arow, oc):
+            sp_expl[b] = (vrow, srow, arow, oc)
 
         def stage(name):
             # stage spans parent on the chunk's wall span, NOT the ambient
@@ -367,6 +453,7 @@ def run_pipeline(
                         enable_empty_workload_propagation=keep_sel,
                         collect_used=True, used0=used0_np,
                         axis=axis, tier=tier,
+                        explain=armed, explain_cb=sp_cb if armed else None,
                     )
                     if used_sp is not None:
                         chain.extras.absorb(batch, used_sp, used0_np)
@@ -375,6 +462,7 @@ def run_pipeline(
                         batch, part, idxs, waves=waves,
                         enable_empty_workload_propagation=keep_sel,
                         axis=axis, tier=tier,
+                        explain=armed, explain_cb=sp_cb if armed else None,
                     )
                 sub.update(res_g)
             if sp_span is not None:
@@ -407,6 +495,7 @@ def run_pipeline(
                     time.perf_counter() - t_big, schedule_step=sm.STEP_SOLVE)
         decode_s = 0.0
         out_local: Dict[int, object] = {}
+        expl_planes = None
         if entry.handle is not None:
             t_w = time.perf_counter()
             w_span = stage(obs.SPAN_WAIT)
@@ -433,6 +522,8 @@ def run_pipeline(
             else:
                 fin = finalize_compact(entry.handle)
             idx, val, status = fin[0], fin[1], fin[2]
+            if armed:
+                expl_planes = fin[-1]  # (verdict, score, avail, outcome)
             if live():
                 sm.STEP_LATENCY.observe(
                     time.perf_counter() - t_d2h, schedule_step=sm.STEP_D2H)
@@ -453,6 +544,10 @@ def run_pipeline(
                 if batch.route[i] == tensors.ROUTE_DEVICE:
                     out_local[i] = decoded[i]
         out_local.update(sub)
+        if armed:
+            _record_decisions(explain, batch, part, entry.offset, keys,
+                              out_local, expl_planes, sp_expl,
+                              cyc, live())
         t_end = time.perf_counter()
         n_ok = 0
         chunk_failures: Dict[str, int] = {}
@@ -513,7 +608,7 @@ def run_pipeline(
                                             n=len(part))
                 enc_span = tracer.start_span(obs.SPAN_ENCODE, parent=ch_span)
             batch = tensors.encode_batch(part, cindex, estimator,
-                                         cache=cache)
+                                         cache=cache, explain=armed)
             t1 = time.perf_counter()
             if enc_span is not None:
                 enc_span.end()
@@ -553,14 +648,14 @@ def run_pipeline(
                         handle = dispatch_compact(
                             batch, waves=waves, keep_sel=keep_sel,
                             with_used=chain is not None, used0=used0,
-                            donate_used0=donate,
+                            donate_used0=donate, explain=armed,
                         )
                     d_span.end()
                 else:
                     handle = dispatch_compact(
                         batch, waves=waves, keep_sel=keep_sel,
                         with_used=chain is not None, used0=used0,
-                        donate_used0=donate,
+                        donate_used0=donate, explain=armed,
                     )
                 if chain is not None:
                     chain.dispatched(batch, handle)

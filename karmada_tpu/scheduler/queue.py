@@ -79,6 +79,10 @@ class SchedulingQueue:
         # the expiry of the CURRENT backoff residence; a heap entry whose
         # expiry differs is stale (the key left and re-entered backoff)
         self._backoff_expiry: Dict[Hashable, float] = {}
+        # dominant unschedulable reason per resident unschedulable key
+        # (explain plane / classify_unschedulable taxonomy); dropped when
+        # the key leaves the unschedulable map
+        self._unsched_reason: Dict[Hashable, str] = {}
 
     # -- internals -----------------------------------------------------------
     def _move_to_active(self, info: QueuedBindingInfo) -> None:
@@ -87,6 +91,7 @@ class SchedulingQueue:
         self._info[info.key] = info
         self._where[info.key] = "active"
         self._backoff_expiry.pop(info.key, None)
+        self._unsched_reason.pop(info.key, None)
         heapq.heappush(
             self._active_heap, info._active_sort_key(next(self._seq)) + (info.key,)
         )
@@ -117,13 +122,19 @@ class SchedulingQueue:
         )
         self._move_to_active(info)
 
-    def push_unschedulable_if_not_present(self, info: QueuedBindingInfo) -> None:
-        """:288 — no-op when the key already waits in active/backoff."""
+    def push_unschedulable_if_not_present(self, info: QueuedBindingInfo,
+                                          reason: str = "") -> None:
+        """:288 — no-op when the key already waits in active/backoff.
+        `reason` is the dominant unschedulable reason (explain-plane /
+        classify_unschedulable taxonomy); the map keeps it so operators
+        can see WHY each resident binding is parked."""
         if self._where.get(info.key) in ("active", "backoff"):
             return
         info.timestamp = self.now()
         self._info[info.key] = info
         self._where[info.key] = "unschedulable"
+        if reason:
+            self._unsched_reason[info.key] = reason
 
     def push_backoff_if_not_present(self, info: QueuedBindingInfo) -> None:
         """:301 — no-op when the key already waits in active/unschedulable."""
@@ -141,6 +152,7 @@ class SchedulingQueue:
         self._info.pop(key, None)
         self._where.pop(key, None)
         self._backoff_expiry.pop(key, None)
+        self._unsched_reason.pop(key, None)
 
     # -- consumer side -------------------------------------------------------
     def pop_ready(self, max_n: Optional[int] = None) -> List[QueuedBindingInfo]:
@@ -202,6 +214,7 @@ class SchedulingQueue:
             if self.now() < expiry:
                 self._where[k] = "backoff"
                 self._backoff_expiry[k] = expiry
+                self._unsched_reason.pop(k, None)
                 heapq.heappush(self._backoff_heap, (expiry, next(self._seq), k))
             else:
                 self._move_to_active(info)
@@ -217,3 +230,14 @@ class SchedulingQueue:
 
     def has(self, key: Hashable) -> bool:
         return key in self._where
+
+    def unschedulable_reasons(self) -> Dict[str, int]:
+        """Resident unschedulable keys bucketed by dominant reason (keys
+        parked before reason accounting landed count as "unknown")."""
+        counts: Dict[str, int] = {}
+        for k, w in self._where.items():
+            if w != "unschedulable":
+                continue
+            r = self._unsched_reason.get(k, "unknown")
+            counts[r] = counts.get(r, 0) + 1
+        return counts

@@ -19,11 +19,13 @@ status.schedulerObservedAffinityName exactly like the reference.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from karmada_tpu import obs
+from karmada_tpu.obs import decisions as obs_decisions
 from karmada_tpu.estimator.general import GeneralEstimator
 from karmada_tpu.models.cluster import Cluster
 from karmada_tpu.models.meta import Condition, set_condition
@@ -88,6 +90,11 @@ class Scheduler:
         # a discarded daemon thread).  None disables the guard (tests,
         # known-good hardware).
         device_cycle_timeout_s: Optional[float] = None,
+        # explain plane (obs/decisions, serve --explain[=RATE]): sample
+        # rate in (0, 1] of scheduling cycles that run the solver's
+        # explain jit variant and record per-binding placement Decision
+        # records; 0/None keeps the disarmed hot path byte-identical.
+        explain: float = 0.0,
     ) -> None:
         self.elector = elector
         if elector is not None:
@@ -122,6 +129,11 @@ class Scheduler:
         self.waves = max(1, waves)
         self.pipeline_chunk = max(1, pipeline_chunk)
         self.mesh_shape = mesh_shape
+        self.explain = min(float(explain or 0.0), 1.0)
+        self._decisions = (obs_decisions.configure()
+                          if self.explain > 0 else None)
+        # deterministic per-scheduler sampling stream (tests, replayable)
+        self._explain_rng = random.Random(0x5EED)
         self.mesh_plan = None
         self._mesh_tried = False
         self.estimators = list(estimators) if estimators else [GeneralEstimator()]
@@ -242,10 +254,17 @@ class Scheduler:
             # waits for a cluster event; other failures back off and retry.
             # Success needs no forget: pop_ready removed the entry, and any
             # concurrent re-push is a fresh event for the next cycle.
+            # Unschedulable routings carry their dominant reason into the
+            # queue's map and karmada_schedule_unschedulable_total — the
+            # explain-armed decode attaches the solver's verdict, every
+            # other path classifies by the known message shapes.
             with self._queue_lock:
                 for (info, _), res in zip(todo, outcomes):
                     if isinstance(res, serial.UnschedulableError):
-                        self.queue.push_unschedulable_if_not_present(info)
+                        reason = obs_decisions.classify_unschedulable(res)
+                        self.queue.push_unschedulable_if_not_present(
+                            info, reason=reason)
+                        sched_metrics.UNSCHEDULABLE.inc(reason=reason)
                     elif isinstance(res, Exception):
                         self.queue.push_backoff_if_not_present(info)
             cycle_elapsed = time.perf_counter() - cycle_start
@@ -288,6 +307,10 @@ class Scheduler:
         active: List[Tuple[int, ResourceBinding]] = list(enumerate(bindings))
         results: Dict[int, object] = {}
         affinity_name: Dict[int, str] = {}
+        # explain plane: one sampling decision per cycle (every affinity
+        # round of a sampled cycle records, so a failover story is whole)
+        explain_rec = self._explain_sample()
+        keys_all = [f"{rb.namespace}/{rb.name}" for rb in bindings]
 
         while active:
             items: List[Tuple[ResourceBindingSpec, ResourceBindingStatus]] = []
@@ -300,7 +323,9 @@ class Scheduler:
                     affinity_name[i] = terms[idx].affinity_name
                 items.append((spec, status))
 
-            outcome = self._solve(items, clusters)
+            outcome = self._solve(items, clusters,
+                                  keys=[keys_all[i] for i, _ in active],
+                                  explain=explain_rec)
 
             next_active: List[Tuple[int, ResourceBinding]] = []
             for (i, rb), res in zip(active, outcome):
@@ -321,6 +346,15 @@ class Scheduler:
             # must route on the EFFECTIVE outcome
             outcomes.append(self._apply_result(rb, res, affinity_name.get(i, "")))
         return outcomes
+
+    def _explain_sample(self) -> Optional["obs_decisions.DecisionRecorder"]:
+        """The decision recorder for THIS cycle, or None: the explain
+        plane samples whole scheduling cycles at `self.explain` rate."""
+        if self._decisions is None:
+            return None
+        if self.explain >= 1.0 or self._explain_rng.random() < self.explain:
+            return self._decisions
+        return None
 
     def _initial_term(self, rb: ResourceBinding) -> int:
         """Resume from the observed affinity term (scheduler.go:599-616)."""
@@ -429,6 +463,8 @@ class Scheduler:
         items: List[Tuple[ResourceBindingSpec, ResourceBindingStatus]],
         clusters: List[Cluster],
         cancelled: Optional[threading.Event] = None,
+        keys: Optional[List[str]] = None,
+        explain=None,
     ) -> Dict[int, object]:
         """backend="device": one batched cycle through the pipelined chunk
         executor (scheduler/pipeline.py — the same loop bench.py measures).
@@ -475,6 +511,7 @@ class Scheduler:
             enable_empty_workload_propagation=(
                 self.enable_empty_workload_propagation),
             cancelled=cancelled,
+            explain=explain, keys=keys,
         )
         return res.results
 
@@ -513,6 +550,8 @@ class Scheduler:
         self,
         items: List[Tuple[ResourceBindingSpec, ResourceBindingStatus]],
         clusters: List[Cluster],
+        keys: Optional[List[str]] = None,
+        explain=None,
     ) -> Dict[int, object]:
         """Run the device cycle under the mid-serve death guard: a cycle
         exceeding device_cycle_timeout_s is abandoned on its daemon thread
@@ -521,7 +560,8 @@ class Scheduler:
         batched scheduler must never hang the control plane because the
         accelerator tunnel died under it."""
         if self.device_cycle_timeout_s is None:
-            return self._solve_device(items, clusters)
+            return self._solve_device(items, clusters, keys=keys,
+                                      explain=explain)
         box: Dict[str, object] = {}
         cancelled = threading.Event()
         # thread handoff: the daemon thread adopts this (worker) thread's
@@ -533,7 +573,9 @@ class Scheduler:
             try:
                 with tracer.attach(trace_parent):
                     box["res"] = self._solve_device(items, clusters,
-                                                    cancelled=cancelled)
+                                                    cancelled=cancelled,
+                                                    keys=keys,
+                                                    explain=explain)
             except Exception as e:  # noqa: BLE001 — re-raised on the caller
                 box["err"] = e
 
@@ -583,13 +625,16 @@ class Scheduler:
         self,
         items: List[Tuple[ResourceBindingSpec, ResourceBindingStatus]],
         clusters: List[Cluster],
+        keys: Optional[List[str]] = None,
+        explain=None,
     ) -> List[object]:
         """Returns per item either List[TargetCluster] or an Exception."""
         cal = serial.make_cal_available(self.estimators)
         out: List[object] = [None] * len(items)
         device_idx: List[int] = []
         if self.backend == "device" and items:
-            solved = self._solve_device_guarded(items, clusters)
+            solved = self._solve_device_guarded(items, clusters,
+                                                keys=keys, explain=explain)
             for i, res in solved.items():
                 out[i] = res
             device_idx = list(solved.keys())
@@ -611,6 +656,19 @@ class Scheduler:
                         )
                     except Exception as e:  # noqa: BLE001 — per-binding failure object
                         out[i] = e
+                if explain is not None:
+                    # the serial reference path records decisions too: a
+                    # FitError's per-cluster diagnosis maps onto the same
+                    # verdict bitmask (obs/decisions.bit_for_serial_reason),
+                    # so serial and device decisions stay comparable
+                    sp = obs.TRACER.current()
+                    tid = (sp.trace.trace_id if sp is not None else None)
+                    for i in host_idx:
+                        key = (keys[i] if keys is not None
+                               else obs_decisions.default_key(items[i][0]))
+                        explain.record(obs_decisions.decision_from_result(
+                            key, out[i], len(clusters), trace_id=tid,
+                            backend="serial"))
             sched_metrics.STEP_LATENCY.observe(
                 time.perf_counter() - t3, schedule_step=sched_metrics.STEP_SERIAL
             )

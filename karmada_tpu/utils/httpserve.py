@@ -14,11 +14,19 @@ ThreadingHTTPServer serves:
     /debug/traces/slow   the always-retained slowest-cycles shelf (JSON)
     /debug/traces/{id}   one trace as a text waterfall
                          (?format=json for the raw trace)
+    /debug/explain       recent explain-plane decision summaries + the
+                         always-retained unschedulable shelf (JSON)
+    /debug/explain/{namespace}/{name}
+                         one binding's full Decision (verdict table)
 
 The trace endpoints read the process-wide tracer (karmada_tpu.obs.TRACER,
 armed by `karmadactl serve --trace-buffer N`) unless an explicit recorder
 is injected; with tracing disabled they answer {"enabled": false} rather
-than 404 so a dashboard can poll unconditionally.
+than 404 so a dashboard can poll unconditionally.  The explain endpoints
+read the process-wide decision ring (obs/decisions, armed by `serve
+--explain`) the same way.  Unknown trace/decision ids answer a JSON 404
+body ({"error": ...}), and a handler exception answers a JSON 500 —
+never a closed connection.
 """
 
 from __future__ import annotations
@@ -35,6 +43,7 @@ class ObservabilityServer:
         registry=None,
         ready_probe: Optional[Callable[[], bool]] = None,
         recorder=None,
+        decisions=None,
     ) -> None:
         from karmada_tpu.utils.metrics import REGISTRY
 
@@ -42,6 +51,7 @@ class ObservabilityServer:
         self.registry = registry if registry is not None else REGISTRY
         self.ready_probe = ready_probe
         self._recorder = recorder
+        self._decisions = decisions
         self._httpd = None
         self._thread: Optional[threading.Thread] = None
 
@@ -52,12 +62,20 @@ class ObservabilityServer:
 
         return obs.TRACER.recorder  # None while tracing is disabled
 
+    def _decision_recorder(self):
+        if self._decisions is not None:
+            return self._decisions
+        from karmada_tpu.obs import decisions
+
+        return decisions.recorder()  # None while the explain plane is off
+
     def _state(self) -> dict:
         from karmada_tpu.ops import meshing
         from karmada_tpu.utils import deviceprobe
 
         counts = self.store.counts_by_kind() if self.store is not None else {}
         rec = self._trace_recorder()
+        dec = self._decision_recorder()
         return {"objects_by_kind": counts,
                 "total": sum(counts.values()),
                 "device_probe": deviceprobe.last_probe(),
@@ -65,7 +83,8 @@ class ObservabilityServer:
                 # count, platform — {"enabled": false} on the
                 # single-device fallback; never initialises a backend
                 "mesh": meshing.mesh_info(),
-                "traces": rec.stats() if rec is not None else None}
+                "traces": rec.stats() if rec is not None else None,
+                "explain": dec.stats() if dec is not None else None}
 
     def _traces_payload(self, which: str) -> dict:
         from karmada_tpu.obs import export
@@ -81,6 +100,13 @@ class ObservabilityServer:
             "traces": traces,
         }
 
+    @staticmethod
+    def _json_error(message: str, code: int):
+        """A well-formed JSON error body: unknown ids and handler faults
+        must never surface as an unhandled exception / empty response."""
+        return (json.dumps({"error": message}).encode(),
+                "application/json", code)
+
     def _one_trace(self, trace_id: str, as_json: bool):
         """(body, ctype, code) for /debug/traces/{id}."""
         from karmada_tpu.obs import export
@@ -88,12 +114,72 @@ class ObservabilityServer:
         rec = self._trace_recorder()
         tr = rec.get(trace_id) if rec is not None else None
         if tr is None:
-            return (f"trace {trace_id!r} not found".encode(),
-                    "text/plain", 404)
+            return self._json_error(f"trace {trace_id!r} not found", 404)
         if as_json:
             return export.to_json(tr).encode(), "application/json", 200
         return (export.render_waterfall(tr).encode() + b"\n",
                 "text/plain", 200)
+
+    @staticmethod
+    def _decision_summary(d: dict) -> dict:
+        return {"key": d["key"], "outcome": d["outcome"],
+                "reason": d.get("reason"), "message": d.get("message"),
+                "trace_id": d.get("trace_id"), "ts": d.get("ts"),
+                "backend": d.get("backend")}
+
+    def _explain_payload(self) -> dict:
+        rec = self._decision_recorder()
+        if rec is None:
+            return {"enabled": False, "decisions": [], "unschedulable": []}
+        return {
+            "enabled": True,
+            "stats": rec.stats(),
+            "dropped": rec.dropped,
+            "decisions": [self._decision_summary(d) for d in rec.recent()],
+            "unschedulable": [self._decision_summary(d)
+                              for d in rec.unschedulable()],
+        }
+
+    def _one_decision(self, key: str):
+        """(body, ctype, code) for /debug/explain/{namespace}/{name}."""
+        rec = self._decision_recorder()
+        if rec is None:
+            return self._json_error(
+                "explain plane is disabled (serve --explain to arm it)", 404)
+        d = rec.get(key)
+        if d is None:
+            return self._json_error(f"no decision recorded for {key!r}", 404)
+        return json.dumps(d).encode(), "application/json", 200
+
+    def _route(self, path: str, query: str):
+        """(body, ctype, code) for one GET."""
+        if path == "/metrics":
+            return (self.registry.dump().encode(),
+                    "text/plain; version=0.0.4", 200)
+        if path == "/healthz":
+            return b"ok", "text/plain", 200
+        if path == "/readyz":
+            ok = self.ready_probe() if self.ready_probe else True
+            return (b"ok" if ok else b"not ready", "text/plain",
+                    200 if ok else 503)
+        if path == "/debug/state":
+            return json.dumps(self._state()).encode(), "application/json", 200
+        if path in ("/debug/traces", "/debug/traces/slow"):
+            which = "slow" if path.endswith("/slow") else "recent"
+            return (json.dumps(self._traces_payload(which)).encode(),
+                    "application/json", 200)
+        if path.startswith("/debug/traces/"):
+            trace_id = path[len("/debug/traces/"):]
+            return self._one_trace(trace_id, "format=json" in (query or ""))
+        if path == "/debug/explain":
+            return (json.dumps(self._explain_payload()).encode(),
+                    "application/json", 200)
+        if path.startswith("/debug/explain/"):
+            key = path[len("/debug/explain/"):]
+            return self._one_decision(key)
+        if path.startswith("/debug/"):
+            return self._json_error(f"no such debug endpoint {path!r}", 404)
+        return b"not found", "text/plain", 404
 
     def start(self, port: int = 0, host: str = "127.0.0.1") -> str:
         import http.server
@@ -104,30 +190,12 @@ class ObservabilityServer:
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server convention
                 parsed = urllib.parse.urlsplit(self.path)
-                path = parsed.path
-                if path == "/metrics":
-                    body = outer.registry.dump().encode()
-                    ctype = "text/plain; version=0.0.4"
-                    code = 200
-                elif path == "/healthz":
-                    body, ctype, code = b"ok", "text/plain", 200
-                elif path == "/readyz":
-                    ok = outer.ready_probe() if outer.ready_probe else True
-                    body = b"ok" if ok else b"not ready"
-                    ctype, code = "text/plain", (200 if ok else 503)
-                elif path == "/debug/state":
-                    body = json.dumps(outer._state()).encode()
-                    ctype, code = "application/json", 200
-                elif path in ("/debug/traces", "/debug/traces/slow"):
-                    which = "slow" if path.endswith("/slow") else "recent"
-                    body = json.dumps(outer._traces_payload(which)).encode()
-                    ctype, code = "application/json", 200
-                elif path.startswith("/debug/traces/"):
-                    trace_id = path[len("/debug/traces/"):]
-                    as_json = "format=json" in (parsed.query or "")
-                    body, ctype, code = outer._one_trace(trace_id, as_json)
-                else:
-                    body, ctype, code = b"not found", "text/plain", 404
+                try:
+                    body, ctype, code = outer._route(parsed.path,
+                                                     parsed.query)
+                except Exception as e:  # noqa: BLE001 — JSON 500, never a
+                    # closed connection with no body
+                    body, ctype, code = outer._json_error(repr(e), 500)
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))

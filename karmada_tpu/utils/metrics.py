@@ -21,6 +21,18 @@ def exponential_buckets(start: float, factor: float, count: int) -> List[float]:
     return [start * (factor ** i) for i in range(count)]
 
 
+def _escape_label(value: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline would otherwise break the exposition line."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """# HELP line escaping (backslash and newline per the text format)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class _Metric:
     def __init__(self, name: str, help_: str, label_names: Sequence[str]) -> None:
         self.name = name
@@ -38,9 +50,9 @@ class _Metric:
     @staticmethod
     def _fmt_labels(names: Sequence[str], values: Sequence[str],
                     extra: Optional[Tuple[str, str]] = None) -> str:
-        pairs = [f'{n}="{v}"' for n, v in zip(names, values)]
+        pairs = [f'{n}="{_escape_label(v)}"' for n, v in zip(names, values)]
         if extra is not None:
-            pairs.append(f'{extra[0]}="{extra[1]}"')
+            pairs.append(f'{extra[0]}="{_escape_label(extra[1])}"')
         return "{" + ",".join(pairs) + "}" if pairs else ""
 
 
@@ -101,7 +113,16 @@ class Histogram(_Metric):
 
     def __init__(self, name, help_, label_names=(), buckets: Optional[List[float]] = None):
         super().__init__(name, help_, label_names)
-        self.buckets = sorted(buckets or exponential_buckets(0.001, 2, 15))
+        # exposition edge cases hardened while wiring GET /metrics:
+        # duplicate bucket bounds would double-count an observation into
+        # two identical `le` lines, and a caller-supplied +Inf bound would
+        # collide with the synthetic +Inf line _render always emits —
+        # dedupe and keep finite bounds only (int bounds coerce to float
+        # so `le` renders uniformly, e.g. le="1.0")
+        self.buckets = sorted({
+            float(b) for b in (buckets or exponential_buckets(0.001, 2, 15))
+            if math.isfinite(b)
+        })
         self._counts: Dict[Tuple[str, ...], List[int]] = {}  # guarded-by: _lock
         self._sums: Dict[Tuple[str, ...], float] = {}  # guarded-by: _lock
         self._totals: Dict[Tuple[str, ...], int] = {}  # guarded-by: _lock
@@ -192,7 +213,7 @@ class Registry:
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
         for m in metrics:
-            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# HELP {m.name} {_escape_help(m.help)}".rstrip())
             lines.append(f"# TYPE {m.name} {m.TYPE}")
             lines.extend(m._render())  # noqa: SLF001
         return "\n".join(lines) + "\n"

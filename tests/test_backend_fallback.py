@@ -120,7 +120,7 @@ def test_hung_device_cycle_degrades_scheduler_mid_serve(monkeypatch, capsys):
 
     hang = threading.Event()
 
-    def stuck_solve(self, items, clusters, cancelled=None):
+    def stuck_solve(self, items, clusters, cancelled=None, **_kw):
         hang.wait(30)  # the XLA dispatch never returns
         return {}
 

@@ -171,6 +171,29 @@ def test_trace_cli_reports_disabled_tracer():
         srv.stop()
 
 
+def test_unknown_debug_ids_return_json_404_bodies():
+    """Regression: unknown trace/decision ids (and unknown /debug/*
+    paths) must answer a well-formed JSON 404 body ({"error": ...}) —
+    never an unhandled exception or an empty 500."""
+    srv = ObservabilityServer()
+    base = srv.start()
+    try:
+        for path in ("/debug/traces/nosuchtrace",
+                     "/debug/explain/default/nosuchbinding",
+                     "/debug/nosuchendpoint"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                fetch(base + path)
+            assert ei.value.code == 404, path
+            assert ei.value.headers.get("Content-Type") == "application/json"
+            body = json.loads(ei.value.read().decode())
+            assert body.get("error"), (path, body)
+        # the disarmed explain ring polls clean, like the trace endpoints
+        status, body = fetch(base + "/debug/explain")
+        assert status == 200 and json.loads(body)["enabled"] is False
+    finally:
+        srv.stop()
+
+
 def test_registry_collision_all_metric_and_span_names_unique():
     """Every REGISTRY-declared metric name across the package and every
     SPAN_* constant must be unique — a silent name collision would merge

@@ -98,6 +98,16 @@ def main() -> int:
             try:
                 payload = json.loads(line)
                 detail = payload.get("detail", {})
+                if "explain_overhead_pct" in detail:
+                    # pass the explain-plane cost fields through as a
+                    # structured line (same contract as the probe records)
+                    jlog({"event": "explain_overhead",
+                          "ts": round(time.time(), 3),
+                          "overhead_pct": detail.get("explain_overhead_pct"),
+                          "disarmed_delta_pct": detail.get(
+                              "explain_disarmed_delta_pct"),
+                          "disarmed_new_compiles": detail.get(
+                              "explain_disarmed_new_compiles")})
                 live_tpu = ("tpu" in str(detail.get("platform", "")).lower()
                             and not detail.get("cached"))
                 if live_tpu and payload.get("value", 0) > 0:
