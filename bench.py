@@ -1173,6 +1173,91 @@ def run_mesh_bench(args, shape) -> int:
     return 1 if mismatches else 0
 
 
+def calibrate_service_model(backend: str = "serial", n: int = 128):
+    """Measure the REAL per-binding / per-cycle cost of one batched
+    scheduling cycle on this host+backend (wall clock, store writes
+    included — the serve path's true cost), returning the loadgen
+    ServiceModel the soak runs against.  With a measured model, a
+    scenario's "2x capacity" arrival rate is 2x this host's measured
+    solve throughput — the acceptance bar's overload condition."""
+    from karmada_tpu.loadgen import ServeSlice, ServiceModel, VirtualClock
+    from karmada_tpu.loadgen.driver import build_binding
+    from karmada_tpu.loadgen.scenarios import get_scenario
+    from karmada_tpu.models.cluster import Cluster
+
+    scenario = get_scenario("steady")  # fleet shape only; traffic unused
+    slice_ = ServeSlice(scenario, VirtualClock(), ServiceModel(),
+                        backend=backend)
+    clusters = list(slice_.store.list(Cluster.KIND))
+    sched = slice_.scheduler
+
+    def timed(count: int) -> float:
+        bindings = [build_binding(f"calib-{count}-{i}")
+                    for i in range(count)]
+        for rb in bindings:
+            slice_.store.create(rb)
+        # drain the enqueued cycle work so the timed call is pure
+        slice_.runtime.pump()
+        t0 = time.perf_counter()
+        sched.schedule_batch(bindings, clusters)
+        return time.perf_counter() - t0
+
+    timed(8)  # warm the path (imports, first-call caches)
+    t_one = timed(1)
+    t_n = timed(n)
+    per_binding = max((t_n - t_one) / (n - 1), 1e-6)
+    per_cycle = max(t_one - per_binding, 1e-6)
+    return ServiceModel(per_binding_s=per_binding, per_cycle_s=per_cycle)
+
+
+def run_soak(args) -> int:
+    """bench --soak SCENARIO: calibrate the service model against this
+    host's real solve cost, run the named loadgen scenario in compressed
+    virtual time, and emit the SOAK payload (ONE JSON line, detail.soak;
+    also persisted to <ckpt-dir>/soak_<scenario>.json)."""
+    from karmada_tpu.loadgen import (
+        LoadDriver, ServeSlice, VirtualClock, get_scenario,
+    )
+
+    try:
+        scenario = get_scenario(args.soak)
+    except ValueError as e:
+        print(json.dumps({"metric": "soak failed (scenario)", "value": 0,
+                          "unit": "s", "vs_baseline": 0,
+                          "detail": {"error": str(e)}}))
+        return 1
+    _hb(f"soak {scenario.name}: calibrating service model "
+        f"(backend={args.soak_backend})")
+    model = calibrate_service_model(args.soak_backend)
+    _hb(f"calibrated: per_binding={model.per_binding_s * 1e3:.3f}ms "
+        f"per_cycle={model.per_cycle_s * 1e3:.3f}ms "
+        f"(capacity ~{model.capacity_rate:.0f} bindings/s)")
+    clock = VirtualClock()
+    plane = ServeSlice(scenario, clock, model, backend=args.soak_backend)
+    driver = LoadDriver(plane, scenario, clock=clock, model=model,
+                        seed=args.soak_seed)
+    payload = driver.run()
+    payload["backend"] = args.soak_backend
+    _hb(f"soak done: injected={payload['injected']} "
+        f"scheduled={payload['scheduled']} "
+        f"admission={payload['admission']}")
+    os.makedirs(args.ckpt_dir, exist_ok=True)
+    out_path = os.path.join(args.ckpt_dir, f"soak_{scenario.name}.json")
+    with open(out_path, "w") as f:
+        json.dump(payload, f, indent=2)
+    p99 = payload["schedule_latency_s"].get("p99", 0.0)
+    print(json.dumps({
+        "metric": f"soak {scenario.name}: p99 schedule latency "
+                  f"({payload['injected']} bindings, "
+                  f"{scenario.load_factor:g}x capacity mean arrival)",
+        "value": p99,
+        "unit": "s",
+        "vs_baseline": 0,
+        "detail": {"soak": payload, "soak_path": out_path},
+    }))
+    return 0
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--bindings", type=int, default=100_000)
@@ -1192,6 +1277,24 @@ def main() -> None:
                          "chunk (sequential-equivalent accounting at chunk "
                          "granularity; serializes the pipeline and "
                          "disables checkpoint resume)")
+    ap.add_argument("--soak", default=None, metavar="SCENARIO",
+                    help="sustained-traffic soak mode (karmada_tpu/"
+                         "loadgen): calibrate this host's real per-"
+                         "binding solve cost, run the named scenario in "
+                         "compressed virtual time against the serve "
+                         "slice's admission/batch-formation machinery, "
+                         "and emit the SOAK payload (p50/p95/p99 "
+                         "schedule latency + queue dwell from flight-"
+                         "recorder spans, shed/admission counts, "
+                         "starvation ages).  Host-only: never touches "
+                         "the device tunnel.  `karmadactl loadgen` "
+                         "lists scenarios")
+    ap.add_argument("--soak-backend", choices=["serial", "native"],
+                    default="serial",
+                    help="scheduler backend the soak drives (and "
+                         "calibrates against)")
+    ap.add_argument("--soak-seed", type=int, default=0,
+                    help="deterministic arrival-process seed")
     ap.add_argument("--mesh", nargs="?", const="auto", default=None,
                     help="mesh bench mode: run the SAME workload through "
                          "the pipelined executor single-device and sharded "
@@ -1245,6 +1348,13 @@ def main() -> None:
         args.serial_sample = 32
 
     global _HB_ON
+    if args.soak is not None:
+        # soak mode is host-only and self-contained (virtual clock +
+        # measured service model; serial/native backends): no device
+        # probe, no watchdog parent — same never-block guarantee as
+        # --mesh mode
+        _HB_ON = True
+        raise SystemExit(run_soak(args))
     if args.mesh is not None:
         # mesh mode is self-contained: virtual CPU devices only (the mode
         # validates mesh scaling, never the tunnel — same never-block

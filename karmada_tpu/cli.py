@@ -37,7 +37,10 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                 device_cycle_timeout: Optional[float] = None,
                 pipeline_chunk: int = 1024,
                 mesh: Optional[str] = None,
-                explain: float = 0.0):
+                explain: float = 0.0,
+                batch_window: int = 4096,
+                batch_deadline: Optional[float] = None,
+                admission_limit: Optional[int] = None):
     """controllers=None rehydrates the persisted --controllers spec; an
     explicit spec is also persisted so later invocations honor it.
 
@@ -64,7 +67,10 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                       controllers=controllers, pipeline_chunk=pipeline_chunk,
                       mesh_shape=mesh_shape,
                       device_cycle_timeout_s=device_cycle_timeout,
-                      explain=explain)
+                      explain=explain,
+                      batch_window=batch_window,
+                      batch_deadline_s=batch_deadline,
+                      admission_limit=admission_limit)
     if controllers is not None:
         cp.apply({"apiVersion": "v1", "kind": "ConfigMap",
                   "metadata": {"namespace": "karmada-system",
@@ -1018,6 +1024,15 @@ def cmd_serve(args) -> int:
             print(f"--explain rate must be in (0, 1], got {explain_rate}",
                   file=sys.stderr)
             return 1
+    loadgen_scenario = None
+    if args.loadgen:
+        from karmada_tpu.loadgen import get_scenario
+
+        try:
+            loadgen_scenario = get_scenario(args.loadgen)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 1
     try:
         cp = _load_plane(args.dir, backend=args.backend, waves=args.waves,
                          controllers=args.controllers,
@@ -1027,7 +1042,14 @@ def cmd_serve(args) -> int:
                              args.device_cycle_timeout
                              if args.device_cycle_timeout > 0 else None),
                          pipeline_chunk=args.pipeline_chunk,
-                         mesh=args.mesh, explain=explain_rate)
+                         mesh=args.mesh, explain=explain_rate,
+                         batch_window=args.batch_window,
+                         batch_deadline=(args.batch_deadline
+                                         if args.batch_deadline > 0
+                                         else None),
+                         admission_limit=(args.admission_limit
+                                          if args.admission_limit > 0
+                                          else None))
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 1
@@ -1085,6 +1107,21 @@ def cmd_serve(args) -> int:
               "(cluster proxy, search cache, metrics adapter; "
               f"karmadactl --server {api_url})")
     cp.runtime.serve()
+    loadgen_driver = None
+    if loadgen_scenario is not None:
+        # real-time synthetic traffic against THIS plane (loadgen/driver):
+        # paced injections through the normal store paths, live state at
+        # /debug/load, admission/shed accounting in /metrics
+        from karmada_tpu.loadgen import LoadDriver
+
+        loadgen_driver = LoadDriver(
+            cp, loadgen_scenario, realtime=True,
+            realtime_rate=args.loadgen_rate, seed=args.loadgen_seed,
+        ).start()
+        print(f"load generator running: scenario {loadgen_scenario.name} "
+              f"(~{args.loadgen_rate:.0f} arrivals/s, "
+              f"{len(loadgen_driver._arrivals)} total); "  # noqa: SLF001
+              "live state at /debug/load")
     print(f"serving control plane from {args.dir} "
           f"(backend={cp.scheduler.backend}, {len(cp.members)} members); "
           "ctrl-c to stop")
@@ -1098,6 +1135,8 @@ def cmd_serve(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if loadgen_driver is not None:
+            loadgen_driver.stop()
         if obs is not None:
             obs.stop()
         if api is not None:
@@ -1129,6 +1168,65 @@ def cmd_vet(args) -> int:
     print(report.to_json() if args.format == "json"
           else report.render_text())
     return 0 if report.clean else 1
+
+
+def cmd_loadgen(args) -> int:
+    """The sustained-traffic harness front door (karmada_tpu/loadgen):
+
+      karmadactl loadgen                      list the scenario catalog
+      karmadactl loadgen --endpoint URL       live /debug/load state of a
+                                              serve process (started with
+                                              serve --loadgen SCENARIO)
+      karmadactl loadgen SCENARIO             compressed-time rehearsal
+                                              against an ephemeral
+                                              scheduler slice; prints the
+                                              SOAK payload JSON
+    """
+    import urllib.error
+    import urllib.request
+
+    from karmada_tpu.loadgen import SCENARIOS, report
+
+    if args.endpoint:
+        base = args.endpoint.rstrip("/")
+        try:
+            with urllib.request.urlopen(base + "/debug/load", timeout=10) as r:
+                state = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            print(f"server error ({e.code}): {e.read().decode()[:200]}",
+                  file=sys.stderr)
+            return 1
+        except urllib.error.URLError as e:
+            print(f"cannot reach {base}: {e.reason}", file=sys.stderr)
+            return 1
+        print(report.render_load_state(state))
+        return 0
+    if not args.scenario:
+        rows = [[s.name, str(s.n_bindings), f"{s.load_factor:g}x",
+                 "yes" if s.slow else "no", s.description]
+                for s in sorted(SCENARIOS.values(), key=lambda s: s.name)]
+        _print_table(rows, ["SCENARIO", "BINDINGS", "LOAD", "SLOW",
+                            "DESCRIPTION"])
+        print("\nrun one compressed: `karmadactl loadgen SCENARIO`; "
+              "drive a live plane: `serve --loadgen SCENARIO`")
+        return 0
+    from karmada_tpu.loadgen import (
+        LoadDriver, ServeSlice, ServiceModel, VirtualClock, get_scenario,
+    )
+
+    try:
+        scenario = get_scenario(args.scenario)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    clock = VirtualClock()
+    model = ServiceModel()
+    plane = ServeSlice(scenario, clock, model)
+    driver = LoadDriver(plane, scenario, clock=clock, model=model,
+                        seed=args.seed)
+    payload = driver.run()
+    print(json.dumps(payload, indent=2 if args.pretty else None))
+    return 0
 
 
 def cmd_trace(args) -> int:
@@ -1492,6 +1590,19 @@ def build_parser() -> argparse.ArgumentParser:
                      help="list the always-retained slowest cycles instead "
                           "of the recent ring")
 
+    lgen = sub.add_parser("loadgen")
+    lgen.add_argument("scenario", nargs="?", default="",
+                      help="scenario name to rehearse in compressed time "
+                           "(omit to list the catalog)")
+    lgen.add_argument("--endpoint", default="",
+                      help="observability endpoint URL of a serve process "
+                           "running `serve --loadgen`; renders the live "
+                           "/debug/load state instead of rehearsing")
+    lgen.add_argument("--seed", type=int, default=0,
+                      help="deterministic arrival-process seed")
+    lgen.add_argument("--pretty", action="store_true",
+                      help="indent the SOAK payload JSON")
+
     vt = sub.add_parser("vet")
     vt.add_argument("paths", nargs="*",
                     help="files/directories to analyze (default: the "
@@ -1632,6 +1743,33 @@ def build_parser() -> argparse.ArgumentParser:
                          "search cache GET/LIST/WATCH, metrics adapter) "
                          "over HTTP on 127.0.0.1:PORT (0 = ephemeral, "
                          "-1 = disabled); clients use --server URL")
+    sv.add_argument("--batch-window", type=int, default=4096,
+                    help="max bindings drained into one batched "
+                         "scheduling cycle")
+    sv.add_argument("--batch-deadline", type=float, default=0.0,
+                    help="deadline-vs-size batch formation: cut a cycle "
+                         "when --batch-window bindings are ready OR the "
+                         "oldest ready binding has waited this many "
+                         "seconds; 0 (default) cuts immediately on any "
+                         "ready binding")
+    sv.add_argument("--admission-limit", type=int, default=0,
+                    help="bounded-resident admission gate: total tracked "
+                         "bindings in the scheduling queues never exceed "
+                         "this; overflow sheds by priority with "
+                         "karmada_scheduler_admission_total accounting "
+                         "(0 = unbounded)")
+    sv.add_argument("--loadgen", default="",
+                    metavar="SCENARIO",
+                    help="drive THIS plane with real-time synthetic "
+                         "traffic from the named loadgen scenario "
+                         "(karmadactl loadgen lists the catalog); live "
+                         "state at /debug/load")
+    sv.add_argument("--loadgen-rate", type=float, default=20.0,
+                    help="mean arrival rate for --loadgen, "
+                         "arrivals/second")
+    sv.add_argument("--loadgen-seed", type=int, default=0,
+                    help="deterministic arrival-process seed for "
+                         "--loadgen")
     return p
 
 
@@ -1686,6 +1824,7 @@ COMMANDS = {
     "serve": cmd_serve,
     "trace": cmd_trace,
     "vet": cmd_vet,
+    "loadgen": cmd_loadgen,
 }
 
 
@@ -1722,6 +1861,10 @@ def _dispatch(args) -> int:
     if args.command == "vet":
         # pure source analysis: no plane, no server
         return cmd_vet(args)
+    if args.command == "loadgen":
+        # catalog/rehearsal need no plane; --endpoint talks to a live
+        # serve process over HTTP
+        return cmd_loadgen(args)
     if args.command == "explain":
         # kind mode reads only the model registry; binding mode talks to
         # a live serve process over HTTP — neither opens a plane

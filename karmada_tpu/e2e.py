@@ -85,6 +85,14 @@ class ControlPlane:
         # explain plane (serve --explain[=RATE], obs/decisions): sample
         # rate of scheduling cycles recording placement Decision records
         explain: float = 0.0,
+        # sustained-traffic controls (scheduler/service.py): batch size
+        # cap per cycle, deadline-vs-size batch formation (None = cut
+        # immediately), and the bounded-resident admission gate (None =
+        # unbounded) — serve --batch-window/--batch-deadline/
+        # --admission-limit
+        batch_window: int = 4096,
+        batch_deadline_s: Optional[float] = None,
+        admission_limit: Optional[int] = None,
     ) -> None:
         self.clock = clock if clock is not None else time.time
         from karmada_tpu.utils.events import EventRecorder
@@ -139,7 +147,10 @@ class ControlPlane:
                                    pipeline_chunk=pipeline_chunk,
                                    mesh_shape=mesh_shape,
                                    device_cycle_timeout_s=device_cycle_timeout_s,
-                                   explain=explain)
+                                   explain=explain,
+                                   batch_window=batch_window,
+                                   batch_deadline_s=batch_deadline_s,
+                                   admission_limit=admission_limit)
         self.binding_controller = BindingController(
             self.store, self.runtime, self.interpreter
         )

@@ -1,0 +1,34 @@
+"""Sustained-traffic serve harness (the load generator subsystem).
+
+Every BENCH_r*.json measures one one-shot batch; production is
+continuous arrival.  This package drives the serve plane with open-loop
+synthetic traffic and closes the loop with the scheduler's admission /
+batch-formation machinery (scheduler/queue.py, scheduler/service.py):
+
+  arrival.py    deterministic-seed arrival processes (steady Poisson,
+                diurnal sine, failover-storm burst) via thinning
+  scenarios.py  the scenario catalog: arrival shape + cluster-event
+                schedule + queue/admission tuning per named scenario
+  driver.py     LoadDriver: injects bindings and cluster events into a
+                running plane through the same store/worker paths real
+                traffic uses; compressed virtual-clock mode for tier-1
+                and bench soaks, real-time mode for `serve --loadgen`
+  report.py     SOAK payload: p50/p95/p99 schedule latency and queue
+                dwell from flight-recorder cycle spans, admission/shed
+                accounting, starvation age, per-stage utilization
+
+Exposure: `bench.py --soak SCENARIO` emits the SOAK payload,
+`watch_bench.py` streams it as an {"event": "soak", ...} line, a live
+driver publishes state at /debug/load (utils/httpserve), and
+`karmadactl loadgen` lists/renders/rehearses scenarios.
+"""
+
+from karmada_tpu.loadgen.driver import (  # noqa: F401 — public surface
+    LoadDriver,
+    RealClock,
+    ServeSlice,
+    ServiceModel,
+    VirtualClock,
+    load_state,
+)
+from karmada_tpu.loadgen.scenarios import SCENARIOS, get_scenario  # noqa: F401

@@ -60,6 +60,44 @@ QUEUE_DEPTH = REGISTRY.gauge(
     ("queue",),
 )
 
+# queue dwell (sustained-traffic serve harness): how long a binding waited
+# before pop_ready drained it, bucketed by the queue it came from —
+# "active" is a fresh external push, "backoff"/"unschedulable" entries
+# include their parked wait.  The loadgen soak report derives its dwell
+# quantiles from the same clock (scheduler/queue.py pop_ready).
+QUEUE_DWELL = REGISTRY.histogram(
+    "karmada_scheduler_queue_dwell_seconds",
+    "Seconds a binding waited in the scheduling queue before being "
+    "drained into a cycle, by queue of origin",
+    ("queue",),
+    buckets=exponential_buckets(0.001, 2, 18),
+)
+
+QUEUE_OLDEST_AGE = REGISTRY.gauge(
+    "karmada_scheduler_queue_oldest_age_seconds",
+    "Age of the oldest resident entry per scheduling queue (starvation "
+    "early warning; refreshed each cycle and periodic flush)",
+    ("queue",),
+)
+
+# bounded-queue admission gate (scheduler/queue.py push): every Push is
+# exactly one of admitted/shed, so admitted + shed == total pushes;
+# displaced counts residents evicted to make room for a higher-priority
+# newcomer (each displacement also admits that newcomer)
+ADMISSION = REGISTRY.counter(
+    "karmada_scheduler_admission_total",
+    "Scheduling-queue admission decisions under the bounded-resident "
+    "gate, by decision (admitted/shed/displaced)",
+    ("decision",),
+)
+
+OVERLOAD_MODE = REGISTRY.gauge(
+    "karmada_scheduler_overload_mode",
+    "1 while the scheduler is in overload degradation (measured queue "
+    "dwell exceeded the batch deadline): explain sampling suppressed, "
+    "batch-formation deadline widened",
+)
+
 # unschedulable-reason accounting (explain plane, obs/decisions taxonomy):
 # every binding routed to the unschedulable queue counts under its
 # dominant rejection reason — kube-scheduler's "0/5 clusters available"

@@ -50,6 +50,18 @@ REASON_UNSCHEDULABLE = "Unschedulable"
 
 _CYCLE = "__cycle__"
 
+# cap on the per-binding samples a cycle span carries (loadgen SLO
+# reporting): a 4096-binding cycle records every ~8th value instead of
+# an unbounded list; the stride rides along so aggregators can weight
+_SPAN_SAMPLE_CAP = 512
+
+
+def _span_samples(values: List[float]) -> Tuple[List[float], int]:
+    """Deterministic stride subsample of per-binding measurements for a
+    cycle span record (bounded, reproducible — no RNG on the hot path)."""
+    stride = max(1, -(-len(values) // _SPAN_SAMPLE_CAP))
+    return [round(v, 6) for v in values[::stride]], stride
+
 
 class Scheduler:
     """Watches bindings + clusters; schedules in batched cycles.
@@ -95,6 +107,26 @@ class Scheduler:
         # explain jit variant and record per-binding placement Decision
         # records; 0/None keeps the disarmed hot path byte-identical.
         explain: float = 0.0,
+        # batch formation (sustained-traffic harness): with a deadline
+        # set, a cycle is cut only when batch_window bindings are ready
+        # OR the oldest ready binding has waited batch_deadline_s —
+        # small trickles coalesce into fuller batches instead of paying
+        # the per-cycle fixed cost per binding.  None (default) keeps
+        # the legacy cut-immediately behavior.
+        batch_deadline_s: Optional[float] = None,
+        # bounded-resident admission gate (scheduler/queue.py): total
+        # tracked bindings never exceed this; overflow sheds by priority
+        # with karmada_scheduler_admission_total accounting.  Only
+        # consulted when `queue` is not supplied.  None = unbounded.
+        admission_limit: Optional[int] = None,
+        # overload degradation: when the drained batch's p95 dwell
+        # exceeds batch_deadline_s * overload_enter_factor the scheduler
+        # enters overload mode (explain sampling suppressed, effective
+        # deadline widened by overload_deadline_factor so cycles fill
+        # toward batch_window); it exits when p95 dwell drops back under
+        # the deadline.  Inert unless batch_deadline_s is set.
+        overload_enter_factor: float = 2.0,
+        overload_deadline_factor: float = 4.0,
     ) -> None:
         self.elector = elector
         if elector is not None:
@@ -143,11 +175,38 @@ class Scheduler:
         )
         self.enable_empty_workload_propagation = enable_empty_workload_propagation
         self.batch_window = batch_window
+        self.batch_deadline_s = batch_deadline_s
+        self.admission_limit = admission_limit
+        self.overload_enter_factor = overload_enter_factor
+        self.overload_deadline_factor = overload_deadline_factor
+        # overload degradation state: flipped only by _cycle (the one
+        # worker) from measured dwell; readers (explain sampling,
+        # /debug/load) take the instantaneous value
+        self._overload = False
+        # cycles where batch formation said "cut" but the pop came back
+        # empty — must stay 0 (the never-cut-an-empty-cycle invariant);
+        # counted here because an empty cut leaves no span to count
+        self._empty_cuts = 0
+        # guarded-by: _queue_lock — keys of the batch the CURRENT cycle
+        # is scheduling: their result-patch events re-push through
+        # _on_event, and those echoes are gate-exempt (the slot they
+        # reclaim is the one their own pop just freed; without the
+        # exemption each scheduled batch would displace or starve
+        # genuinely-waiting arrivals under an armed admission gate)
+        self._inflight_keys: set = set()
         # the queue is touched from publisher threads (_on_event) and the
         # worker (_cycle); one lock guards every queue operation
         self._queue_lock = threading.Lock()
+        # guarded-by: _queue_lock — the single pending deferred-cut wakeup
+        # (threading.Timer): when batch formation defers an immature
+        # trickle and no new push arrives, this re-drives the worker when
+        # the oldest entry's dwell reaches the deadline, so the cut lands
+        # on the deadline's schedule instead of the (possibly much
+        # coarser) periodic tick's
+        self._cut_timer: Optional[threading.Timer] = None
         # guarded-by: _queue_lock; mutators: push,pop_ready,flush_backoff,flush_unschedulable_leftover,move_all_to_active_or_backoff,push_unschedulable_if_not_present,push_backoff_if_not_present
-        self.queue = queue if queue is not None else SchedulingQueue()
+        self.queue = (queue if queue is not None
+                      else SchedulingQueue(max_resident=admission_limit))
         self._native_snap = None  # (clusters list, NativeSnapshot)
         if backend == "native":
             # warm the g++ build at startup so the first scheduling cycle
@@ -173,7 +232,9 @@ class Scheduler:
             ):
                 return
             with self._queue_lock:
-                self.queue.push((rb.namespace, rb.name), _priority_of(rb))
+                key = (rb.namespace, rb.name)
+                self.queue.push(key, _priority_of(rb),
+                                gate_exempt=key in self._inflight_keys)
             sched_metrics.QUEUE_INCOMING.inc(event="BindingUpdate")
             self.worker.enqueue(_CYCLE)
         elif kind == Cluster.KIND:
@@ -205,6 +266,12 @@ class Scheduler:
             moved = self.queue.flush_backoff()
             moved += self.queue.flush_unschedulable_leftover()
             ready = self.queue.depths()["active"]
+            oldest = self.queue.oldest_ages()
+        # starvation early warning: refresh the oldest-resident gauges on
+        # every tick, not only when a cycle runs — a wedged queue must be
+        # visible precisely when cycles stop happening
+        for qname, age in oldest.items():
+            sched_metrics.QUEUE_OLDEST_AGE.set(age, queue=qname)
         if moved or ready:
             self.worker.enqueue(_CYCLE)
 
@@ -222,6 +289,80 @@ class Scheduler:
             return True
         return not rb.spec.clusters and not _is_scheduled_empty(rb)
 
+    # -- batch formation ----------------------------------------------------
+    def _batch_ready_locked(self) -> bool:
+        """Deadline-vs-size batch formation (call under _queue_lock): cut a
+        cycle when batch_window bindings are ready OR the oldest ready
+        binding has waited out the deadline; never cut an empty cycle.
+        Without a deadline (the default) any non-empty activeQ cuts —
+        the legacy immediate-drain behavior.  In overload mode the
+        effective deadline widens so trickle cuts stop and cycles fill
+        toward batch_window (amortizing the per-cycle fixed cost)."""
+        depth = self.queue.depths()["active"]
+        if depth == 0:
+            return False
+        if self.batch_deadline_s is None or depth >= self.batch_window:
+            return True
+        deadline = self.batch_deadline_s * (
+            self.overload_deadline_factor if self._overload else 1.0)
+        return self.queue.oldest_active_age() >= deadline
+
+    def _arm_cut_timer_locked(self, oldest_age: float) -> None:
+        """Schedule the deferred-cut wakeup (call under _queue_lock): fire
+        when the oldest active entry's remaining time to the (possibly
+        overload-widened) deadline elapses.  At most one timer is pending;
+        firing is deferral-safe — the woken cycle re-runs
+        _batch_ready_locked and simply re-arms if still immature (e.g. an
+        injected test clock where wall time and queue time diverge), so a
+        spurious wakeup costs one no-op cycle, never an empty cut."""
+        if self._cut_timer is not None:
+            return
+        deadline = self.batch_deadline_s * (
+            self.overload_deadline_factor if self._overload else 1.0)
+        delay = max(deadline - oldest_age, 0.0) + 1e-3
+
+        def fire() -> None:
+            with self._queue_lock:
+                self._cut_timer = None
+            self.worker.enqueue(_CYCLE)
+
+        t = threading.Timer(delay, fire)
+        t.daemon = True
+        self._cut_timer = t
+        t.start()
+
+    def _update_overload(self, dwells_sorted: List[float],
+                         popped: int, active_after: int) -> None:
+        """Overload degradation driven by MEASURED dwell of the drained
+        batch: enter when p95 dwell exceeds deadline * enter_factor (the
+        queue is aging faster than cycles retire it).  While in
+        overload: explain sampling is suppressed and the batch-formation
+        deadline widens.
+
+        Exit fires only on a cycle that actually drained something
+        (`popped > 0` — a deferred no-cut invocation is exactly the
+        widened deadline doing its coalescing job, not a drain signal)
+        and then on ANY of: a sub-window cut (`popped < batch_window`),
+        the activeQ empty after the pop (the final full-window cut of a
+        backlog must not latch the mode), or p95 dwell back under the
+        deadline.  Dwell alone cannot be the only exit: while
+        overloaded, deadline-triggered cuts happen at the WIDENED
+        deadline, so their p95 could never satisfy the unwidened
+        threshold and the mode would stick forever after the storm
+        subsides."""
+        if self.batch_deadline_s is None:
+            return
+        p95 = (dwells_sorted[int(0.95 * (len(dwells_sorted) - 1))]
+               if dwells_sorted else 0.0)
+        if not self._overload:
+            if dwells_sorted and \
+                    p95 > self.batch_deadline_s * self.overload_enter_factor:
+                self._overload = True
+        elif popped > 0 and (popped < self.batch_window or active_after == 0
+                             or p95 <= self.batch_deadline_s):
+            self._overload = False
+        sched_metrics.OVERLOAD_MODE.set(1.0 if self._overload else 0.0)
+
     # -- the batched cycle --------------------------------------------------
     def _cycle(self, _key) -> None:
         if self.elector is not None and not self.elector.is_leader():
@@ -229,7 +370,18 @@ class Scheduler:
         cycle_start = time.perf_counter()
         with self._queue_lock:
             self.queue.flush_backoff()
-            infos = self.queue.pop_ready(self.batch_window)
+            # unified flush cadence: parked bindings honor
+            # max_in_unschedulable_s on the per-cycle path too, not only
+            # the slow periodic flush — otherwise a binding could outlive
+            # its unschedulable budget by a full flush interval on a busy
+            # plane whose cycles preempt the periodic tick
+            self.queue.flush_unschedulable_leftover()
+            cut = self._batch_ready_locked()
+            infos = self.queue.pop_ready(self.batch_window) if cut else []
+            if cut and not infos:
+                self._empty_cuts += 1  # invariant breach; surfaced in state
+            active_after_pop = self.queue.depths()["active"]
+        pop_now = self.queue.now()
         todo: List[Tuple[QueuedBindingInfo, ResourceBinding]] = []
         for info in infos:
             ns, name = info.key
@@ -240,63 +392,134 @@ class Scheduler:
                 continue
             info.attempts += 1
             todo.append((info, rb))
+        # queue dwell of the bindings this cycle actually schedules (same
+        # clock the queue stamps): the overload detector input and the
+        # cycle span's dwell samples.  Pops dropped by _needs_schedule
+        # (e.g. the scheduler's own result-patch re-push) are excluded —
+        # they are queue bookkeeping, not user-visible latency; the
+        # per-origin dwell HISTOGRAM in pop_ready still counts them.
+        # Skipped entirely when both consumers are disarmed (no batch
+        # deadline, tracing off) — the default serve path must not pay
+        # an O(n log n) sort per cycle for a discarded list.
+        dwells = (sorted(max(0.0, pop_now - info.timestamp)
+                         for info, _ in todo)
+                  if self.batch_deadline_s is not None or obs.TRACER.enabled
+                  else [])
+        self._update_overload(dwells, popped=len(infos),
+                              active_after=active_after_pop)
         if todo:
             sched_metrics.BATCH_SIZE.observe(len(todo))
             clusters = list(self.store.list(Cluster.KIND))
+            # the batch's result-patch re-push echoes are gate-exempt for
+            # the duration of this cycle (see _inflight_keys)
+            with self._queue_lock:
+                self._inflight_keys = {info.key for info, _ in todo}
             # flight recorder: one scheduler.cycle span per batched cycle
             # (child of the worker's reconcile span); the pipeline executor,
             # serial fallback, and estimator RPCs all nest under it
             with obs.TRACER.span(obs.SPAN_CYCLE, bindings=len(todo),
-                                 backend=self.backend):
-                outcomes = self.schedule_batch(
-                    [rb for _, rb in todo], clusters)
-            # handleErr routing (scheduler.go:829-841): UnschedulableError
-            # waits for a cluster event; other failures back off and retry.
-            # Success needs no forget: pop_ready removed the entry, and any
-            # concurrent re-push is a fresh event for the next cycle.
-            # Unschedulable routings carry their dominant reason into the
-            # queue's map and karmada_schedule_unschedulable_total — the
-            # explain-armed decode attaches the solver's verdict, every
-            # other path classifies by the known message shapes.
-            with self._queue_lock:
+                                 backend=self.backend) as cspan:
+                try:
+                    outcomes = self.schedule_batch(
+                        [rb for _, rb in todo], clusters)
+                finally:
+                    # the echoes fire inside schedule_batch (_apply_result
+                    # patches); clear even on a raise, or the keys would
+                    # stay gate-exempt across the worker's retry
+                    with self._queue_lock:
+                        self._inflight_keys = set()
+                # handleErr routing (scheduler.go:829-841): UnschedulableError
+                # waits for a cluster event; other failures back off and retry.
+                # Success needs no forget: pop_ready removed the entry, and any
+                # concurrent re-push is a fresh event for the next cycle.
+                # Unschedulable routings carry their dominant reason into the
+                # queue's map and karmada_schedule_unschedulable_total — the
+                # explain-armed decode attaches the solver's verdict, every
+                # other path classifies by the known message shapes.
+                with self._queue_lock:
+                    for (info, _), res in zip(todo, outcomes):
+                        if isinstance(res, serial.UnschedulableError):
+                            reason = obs_decisions.classify_unschedulable(res)
+                            self.queue.push_unschedulable_if_not_present(
+                                info, reason=reason)
+                            sched_metrics.UNSCHEDULABLE.inc(reason=reason)
+                        elif isinstance(res, Exception):
+                            self.queue.push_backoff_if_not_present(info)
+                cycle_elapsed = time.perf_counter() - cycle_start
+                now = self.queue.now()
+                e2es: List[float] = []
                 for (info, _), res in zip(todo, outcomes):
                     if isinstance(res, serial.UnschedulableError):
-                        reason = obs_decisions.classify_unschedulable(res)
-                        self.queue.push_unschedulable_if_not_present(
-                            info, reason=reason)
-                        sched_metrics.UNSCHEDULABLE.inc(reason=reason)
+                        result = sched_metrics.RESULT_UNSCHEDULABLE
                     elif isinstance(res, Exception):
-                        self.queue.push_backoff_if_not_present(info)
-            cycle_elapsed = time.perf_counter() - cycle_start
-            now = self.queue.now()
-            for (info, _), res in zip(todo, outcomes):
-                if isinstance(res, serial.UnschedulableError):
-                    result = sched_metrics.RESULT_UNSCHEDULABLE
-                elif isinstance(res, Exception):
-                    result = sched_metrics.RESULT_ERROR
-                else:
-                    result = sched_metrics.RESULT_SCHEDULED
-                sched_metrics.SCHEDULE_ATTEMPTS.inc(
-                    result=result,
-                    schedule_type=sched_metrics.SCHEDULE_TYPE_RECONCILE,
-                )
-                # per-binding e2e: from its first scheduling attempt (queue
-                # clock) to this outcome; floor at the cycle cost so a
-                # single-attempt binding isn't recorded as ~0
-                e2e = max(now - (info.initial_attempt_timestamp or now),
-                          cycle_elapsed)
-                sched_metrics.E2E_LATENCY.observe(
-                    e2e,
-                    result=result,
-                    schedule_type=sched_metrics.SCHEDULE_TYPE_RECONCILE,
-                )
+                        result = sched_metrics.RESULT_ERROR
+                    else:
+                        result = sched_metrics.RESULT_SCHEDULED
+                    sched_metrics.SCHEDULE_ATTEMPTS.inc(
+                        result=result,
+                        schedule_type=sched_metrics.SCHEDULE_TYPE_RECONCILE,
+                    )
+                    # per-binding e2e: from its first scheduling attempt
+                    # (queue clock) to this outcome; floor at the cycle cost
+                    # so a single-attempt binding isn't recorded as ~0
+                    e2e = max(now - (info.initial_attempt_timestamp or now),
+                              cycle_elapsed)
+                    e2es.append(e2e)
+                    sched_metrics.E2E_LATENCY.observe(
+                        e2e,
+                        result=result,
+                        schedule_type=sched_metrics.SCHEDULE_TYPE_RECONCILE,
+                    )
+                if cspan:
+                    # bounded per-binding samples on the cycle span: the
+                    # loadgen soak report derives its p50/p95/p99 schedule
+                    # latency and dwell from these (obs flight recorder),
+                    # strided deterministically so a 4096-binding cycle
+                    # stays a bounded record
+                    ds, d_stride = _span_samples(dwells)
+                    es, e_stride = _span_samples(e2es)
+                    cspan.set_attr(
+                        dwell_samples=ds, dwell_stride=d_stride,
+                        e2e_samples=es, e2e_stride=e_stride,
+                        overload=self._overload)
         with self._queue_lock:
             depths = self.queue.depths()
-            more = depths["active"] > 0
+            oldest = self.queue.oldest_ages()
+            # re-drive only when another cut is actually due: with a batch
+            # deadline armed, an immature trickle must wait out the
+            # deadline, not hot-loop the worker — the deferred-cut timer
+            # (not the coarser periodic tick) owns the wakeup, so the
+            # oldest entry's dwell cannot overshoot the deadline by a
+            # full tick interval when no further push arrives
+            more = self._batch_ready_locked()
+            if (not more and self.batch_deadline_s is not None
+                    and depths["active"] > 0):
+                self._arm_cut_timer_locked(oldest["active"])
         for qname, depth in depths.items():
             sched_metrics.QUEUE_DEPTH.set(depth, queue=qname)
+            sched_metrics.QUEUE_OLDEST_AGE.set(oldest[qname], queue=qname)
         if more:
             self.worker.enqueue(_CYCLE)
+
+    def queue_state(self) -> Dict[str, object]:
+        """One consistent snapshot of the scheduling-queue state — depths,
+        per-queue oldest-resident age, unschedulable reasons — plus the
+        batch-formation/admission config and the overload flag.  Serves
+        /debug/load and the loadgen soak report."""
+        with self._queue_lock:
+            depths = self.queue.depths()
+            oldest = self.queue.oldest_ages()
+            reasons = self.queue.unschedulable_reasons()
+        return {
+            "depths": depths,
+            "oldest_age_s": {k: round(v, 6) for k, v in oldest.items()},
+            "unschedulable_reasons": reasons,
+            "overload": self._overload,
+            "empty_cuts": self._empty_cuts,
+            "batch_window": self.batch_window,
+            "batch_deadline_s": self.batch_deadline_s,
+            "admission_limit": self.queue.max_resident,
+        }
 
     # -- core: schedule a list of bindings against a cluster snapshot ------
     def schedule_batch(
@@ -349,8 +572,11 @@ class Scheduler:
 
     def _explain_sample(self) -> Optional["obs_decisions.DecisionRecorder"]:
         """The decision recorder for THIS cycle, or None: the explain
-        plane samples whole scheduling cycles at `self.explain` rate."""
-        if self._decisions is None:
+        plane samples whole scheduling cycles at `self.explain` rate.
+        Overload degradation sheds the explain cost first — a plane that
+        cannot keep dwell under the deadline has no budget for the
+        explain jit variant's extra planes."""
+        if self._decisions is None or self._overload:
             return None
         if self.explain >= 1.0 or self._explain_rng.random() < self.explain:
             return self._decisions

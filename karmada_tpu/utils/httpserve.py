@@ -18,6 +18,11 @@ ThreadingHTTPServer serves:
                          always-retained unschedulable shelf (JSON)
     /debug/explain/{namespace}/{name}
                          one binding's full Decision (verdict table)
+    /debug/load          live load-generator state (karmada_tpu/loadgen,
+                         armed by `serve --loadgen SCENARIO`): scenario
+                         progress, admission/shed counts, queue depths
+                         and oldest-resident ages; {"enabled": false}
+                         when no driver is active
 
 The trace endpoints read the process-wide tracer (karmada_tpu.obs.TRACER,
 armed by `karmadactl serve --trace-buffer N`) unless an explicit recorder
@@ -171,6 +176,11 @@ class ObservabilityServer:
         if path.startswith("/debug/traces/"):
             trace_id = path[len("/debug/traces/"):]
             return self._one_trace(trace_id, "format=json" in (query or ""))
+        if path == "/debug/load":
+            from karmada_tpu.loadgen import driver as loadgen_driver
+
+            return (json.dumps(loadgen_driver.load_state()).encode(),
+                    "application/json", 200)
         if path == "/debug/explain":
             return (json.dumps(self._explain_payload()).encode(),
                     "application/json", 200)

@@ -108,6 +108,23 @@ def main() -> int:
                               "explain_disarmed_delta_pct"),
                           "disarmed_new_compiles": detail.get(
                               "explain_disarmed_new_compiles")})
+                if "soak" in detail:
+                    # sustained-traffic SLO summary as a structured line
+                    # (bench --soak SCENARIO payloads; the full record is
+                    # in detail.soak / the persisted soak_*.json)
+                    soak = detail["soak"]
+                    jlog({"event": "soak",
+                          "ts": round(time.time(), 3),
+                          "scenario": soak.get("scenario"),
+                          "injected": soak.get("injected"),
+                          "scheduled": soak.get("scheduled"),
+                          "p99_latency_s": soak.get(
+                              "schedule_latency_s", {}).get("p99"),
+                          "p99_dwell_s": soak.get(
+                              "queue_dwell_s", {}).get("p99"),
+                          "admission": soak.get("admission"),
+                          "overload": soak.get(
+                              "starvation", {}).get("overload_entered")})
                 live_tpu = ("tpu" in str(detail.get("platform", "")).lower()
                             and not detail.get("cached"))
                 if live_tpu and payload.get("value", 0) > 0:
