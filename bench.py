@@ -16,6 +16,7 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import random
@@ -1173,6 +1174,210 @@ def run_mesh_bench(args, shape) -> int:
     return 1 if mismatches else 0
 
 
+def run_delta_bench(args) -> int:
+    """--delta mode: steady-state cycle timing with the resident-state
+    plane (karmada_tpu/resident) against today's full re-encode path.
+
+    The full leg re-encodes and re-solves the WHOLE fleet through
+    scheduler/pipeline (what every cycle cost before the resident plane).
+    The resident legs model the watch-driven steady state: the plane has
+    adopted every binding's encoded row, then each cycle a churn fraction
+    of bindings (rv bump + replica change) and clusters (capacity delta)
+    arrives and ONLY the churned bindings are scheduled — cached rows
+    gather, misses re-encode, cluster columns advance by the delta apply.
+    steady_bps is fleet size over that cycle's wall time: the rate at
+    which one plane KEEPS n bindings placed, the number comparable to the
+    full leg's bindings/s.
+
+    Parity is asserted three ways: the timed cycle's re-encoded row count
+    must equal the churned-binding count exactly, every churned subset is
+    re-scheduled through the plain full-encode path and the placements
+    compared, and the run ends with the plane's own bit-exact audit
+    (compare_batches over a from-scratch re-encode of the whole fleet).
+    Host-only guarantee: forces XLA:CPU before backend init (the resident
+    path is the device backend's code, byte-identical on the CPU
+    fallback) — never blocks on the tunnel.
+    """
+    force_cpu_fallback()
+    enable_persistent_compile_cache("cpu")
+    import copy
+
+    from karmada_tpu.resident import ResidentState, RowToken
+    from karmada_tpu.scheduler import pipeline as sched_pipeline
+
+    try:
+        churn_levels = [float(x) for x in args.delta_churn.split(",") if x]
+        assert churn_levels and all(0 < f <= 1 for f in churn_levels)
+    except (ValueError, AssertionError):
+        print(json.dumps({"metric": "delta bench failed (churn levels)",
+                          "value": 0, "unit": "bindings/s", "vs_baseline": 0,
+                          "detail": {"error": f"bad --delta-churn "
+                                              f"{args.delta_churn!r}"}}))
+        return 1
+
+    n, nc = args.bindings, args.clusters
+    chunk, waves = args.chunk, args.waves
+    rng = random.Random(0)
+    clusters = build_fleet(rng, nc)
+    placements = build_placements(rng, [c.name for c in clusters])
+    items = build_bindings(rng, n, placements)
+    estimator = GeneralEstimator()
+    rvs = [1] * n  # the bench's resourceVersion ledger (bumped on churn)
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    _hb(f"delta bench: {n} bindings x {nc} clusters on {platform} "
+        f"(chunk {chunk}, churn {churn_levels})")
+
+    def full_cycle(sub):
+        """Today's path: full re-encode + solve of `sub` (fresh caches)."""
+        return sched_pipeline.run_pipeline(
+            sub, tensors.ClusterIndex.build(clusters), estimator,
+            chunk=chunk, waves=waves, cache=tensors.EncoderCache(),
+            carry=True, carry_spread=True)
+
+    # -- full-re-encode leg (the r05 baseline path) --------------------------
+    full_cycle(items[:min(chunk, len(items))])  # warm the chunk signature
+    tail = len(items) % chunk
+    if tail:
+        full_cycle(items[:tail])
+    _hb("delta bench: full-leg warmup done; timing full re-encode cycle")
+    t0 = time.perf_counter()
+    full_res = full_cycle(items)
+    full_s = time.perf_counter() - t0
+    full_bps = n / full_s if full_s > 0 else 0.0
+    _hb(f"delta bench: full re-encode cycle {full_s:.1f}s "
+        f"({full_bps:.1f} bindings/s, {full_res.scheduled} scheduled)")
+
+    # -- resident plane: adopt the fleet (encode only, no solve) -------------
+    state = ResidentState(estimator=estimator, audit_interval=0)
+    tokens = lambda idx: [RowToken(f"bench/{i}", rvs[i]) for i in idx]  # noqa: E731
+
+    def resident_cycle(idx):
+        """One watch-driven steady-state cycle: delta apply + schedule of
+        exactly the churned bindings against the resident plane."""
+        state.begin_cycle(clusters)
+        toks = tokens(idx)
+        sub = [items[i] for i in idx]
+
+        def encode(part, offset, armed):
+            return state.encode_cycle(
+                part, toks[offset:offset + len(part)], explain=armed)
+
+        return sched_pipeline.run_pipeline(
+            sub, state.cindex, estimator, chunk=chunk, waves=waves,
+            cache=state.enc_cache, carry=True, carry_spread=True,
+            encode=encode)
+
+    state.begin_cycle(clusters)
+    state.encode_cycle(items, tokens(range(n)))  # adopt: one full encode
+    _hb(f"delta bench: resident plane adopted {len(state.rows)} rows "
+        f"(generation {state.generation})")
+
+    def churn_bindings(idx):
+        for i in idx:
+            spec, status = items[i]
+            items[i] = (dataclasses.replace(spec, replicas=spec.replicas + 1),
+                        status)
+            rvs[i] += 1
+
+    def churn_clusters(k):
+        """Capacity deltas on k clusters (fresh objects, like a store
+        snapshot): the resident rv sweep must scatter these columns."""
+        for lane in rng.sample(range(nc), k):
+            c = copy.deepcopy(clusters[lane])
+            c.metadata.resource_version += 1
+            rs = c.status.resource_summary
+            if rs is not None and "cpu" in rs.allocated:
+                rs.allocated["cpu"] = Quantity.from_milli(
+                    rs.allocated["cpu"].milli_value() + 100)
+            clusters[lane] = c
+
+    runs = []
+    exact = True
+    for frac in churn_levels:
+        k = max(1, int(n * frac))
+        # warm this cycle size's jit signatures on a hit-only cycle (the
+        # timed cycle must carry exactly k misses, so it cannot self-warm):
+        # a RANDOM size-k subset so the spread/big sub-solve buckets match
+        # the timed subset's composition, and a cluster churn first so the
+        # delta-apply scatter compiles at the same pow2 lane bucket
+        churn_clusters(max(1, int(nc * frac)))
+        resident_cycle(sorted(rng.sample(range(n), k)))
+        churned = sorted(rng.sample(range(n), k))
+        churn_bindings(churned)
+        churn_clusters(max(1, int(nc * frac)))
+        h0, m0 = state.hits, state.misses
+        t0 = time.perf_counter()
+        res = resident_cycle(churned)
+        dt = time.perf_counter() - t0
+        hits, misses = state.hits - h0, state.misses - m0
+        exact = exact and misses == k and hits == 0
+        # parity: the same churned subset through the full-encode path
+        want = _targets_of(full_cycle([items[i] for i in churned]).results)
+        got = _targets_of(res.results)
+        mism = sorted(i for i in set(want) | set(got)
+                      if want.get(i) != got.get(i))
+        steady = n / dt if dt > 0 else 0.0
+        runs.append({
+            "churn_frac": frac, "churned": k, "cycle_s": round(dt, 4),
+            "steady_bps": round(steady, 1),
+            "churned_bps": round(k / dt, 1) if dt > 0 else 0.0,
+            "hits": hits, "misses": misses, "reencode_exact": misses == k,
+            "speedup_vs_full": (round(full_s / dt, 2) if dt > 0 else None),
+            "parity_ok": not mism, "parity_mismatches": mism[:16],
+        })
+        _hb(f"delta bench: {frac:.0%} churn cycle {dt * 1e3:.0f}ms "
+            f"(steady {steady:.0f} bindings/s, {misses} re-encoded, "
+            f"parity {'ok' if not mism else 'FAILED'})")
+
+    # -- closing bit-exact audit over the whole fleet ------------------------
+    state.begin_cycle(clusters)
+    state.encode_cycle(items, tokens(range(n)), audit=True)
+    stats = state.stats()
+    audit_green = (stats["audits"]["mismatch"] == 0
+                   and stats["audits"]["ok"] >= 1)
+    _hb(f"delta bench: closing audit {stats['audits']} "
+        f"(generation {stats['generation']})")
+
+    parity_ok = (all(r["parity_ok"] for r in runs) and exact and audit_green)
+    head = runs[0]
+    payload = {
+        "metric": (f"delta bench: resident steady-state "
+                   f"({head['churn_frac']:.0%} churn) vs full re-encode, "
+                   f"{n} bindings x {nc} clusters"),
+        "value": head["steady_bps"] if parity_ok else 0,
+        "unit": "bindings/s",
+        "vs_baseline": 0,  # never a TPU headline: XLA:CPU host run
+        "detail": {
+            "delta": {
+                "platform": platform,
+                "bindings": n, "clusters": nc,
+                "chunk": chunk, "waves": waves,
+                "full_cycle_s": round(full_s, 3),
+                "full_bps": round(full_bps, 1),
+                "churn": runs,
+                "reencode_exact": exact,
+                "audit_green": audit_green,
+                "parity_ok": parity_ok,
+                "resident": stats,
+                "note": ("steady_bps = fleet size / resident cycle wall: "
+                         "the rate one plane keeps n bindings placed when "
+                         "only the churned fraction re-enters the queue "
+                         "(docs/PERF_NOTES.md 'Delta scheduling')"),
+            },
+        },
+    }
+    if not parity_ok:
+        payload["metric"] = "DELTA PARITY FAILED: " + payload["metric"]
+    os.makedirs(args.ckpt_dir, exist_ok=True)
+    with open(os.path.join(args.ckpt_dir, "delta_bench.json"), "w") as f:
+        json.dump(payload, f, indent=2)
+    print(json.dumps(payload))
+    return 0 if parity_ok else 1
+
+
 def calibrate_service_model(backend: str = "serial", n: int = 128):
     """Measure the REAL per-binding / per-cycle cost of one batched
     scheduling cycle on this host+backend (wall clock, store writes
@@ -1304,6 +1509,19 @@ def main() -> None:
                          "payload.  Value is BxC (e.g. 2x4) or 'auto' "
                          "(factor --mesh-devices).  Always runs on virtual "
                          "CPU devices — never blocks on the tunnel.")
+    ap.add_argument("--delta", action="store_true",
+                    help="delta bench mode: steady-state scheduling-cycle "
+                         "timing with the resident-state plane (karmada_"
+                         "tpu/resident) at the --delta-churn fractions vs "
+                         "today's full re-encode path, on the same "
+                         "workload (--bindings x --clusters).  Re-encoded-"
+                         "row exactness, placement parity and the plane's "
+                         "bit-exact audit are all asserted.  Always runs "
+                         "the device-path code on XLA:CPU — never blocks "
+                         "on the tunnel.")
+    ap.add_argument("--delta-churn", default="0.01,0.10",
+                    help="comma-separated per-cycle churn fractions the "
+                         "delta bench times (default: 1%% and 10%%)")
     ap.add_argument("--mesh-devices", type=int, default=8,
                     help="virtual CPU devices to pin for --mesh auto")
     ap.add_argument("--mesh-bindings", type=int, default=256,
@@ -1355,6 +1573,13 @@ def main() -> None:
         # --mesh mode
         _HB_ON = True
         raise SystemExit(run_soak(args))
+    if args.delta:
+        # delta mode is host-only and self-contained: the resident plane's
+        # device-path code runs byte-identical on XLA:CPU (forced before
+        # backend init), so no probe and no watchdog parent — same
+        # never-block guarantee as --mesh / --soak.
+        _HB_ON = True
+        raise SystemExit(run_delta_bench(args))
     if args.mesh is not None:
         # mesh mode is self-contained: virtual CPU devices only (the mode
         # validates mesh scaling, never the tunnel — same never-block

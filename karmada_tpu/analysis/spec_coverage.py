@@ -1,18 +1,23 @@
-"""Pass 3 — PartitionSpec coverage for every SolverBatch tensor field.
+"""Pass 3 — PartitionSpec coverage for every solver-plane tensor field.
 
 Drift detector for the mesh-sharded solve path: a field added to
-``SolverBatch`` (ops/tensors.py) without a PartitionSpec entry in
+``SolverBatch`` (ops/tensors.py) — or to the resident-state plane's
+``ResidentPlane`` (resident/state.py), whose per-cycle gathered copies
+ship into the very same dispatch — without a PartitionSpec entry in
 ``shard_specs`` (ops/meshing.py) would silently dispatch with whatever
 default placement jax picks — correct on one device, an implicit
 all-replicate (or a crash) on a mesh.  The pass AST-extracts:
 
-  * the ndarray-annotated fields of the ``class SolverBatch`` dataclass,
+  * the ndarray-annotated fields of the ``class SolverBatch`` and
+    ``class ResidentPlane`` dataclasses,
   * the string keys of the dict literal inside ``def shard_specs``,
   * ``HOST_ONLY_FIELDS`` (fields that by design never cross the host ->
-    device boundary, e.g. ``route``),
+    device boundary, e.g. ``route``) and ``RESIDENT_HOST_ONLY`` (the
+    resident plane's own exemptions),
 
 and reports both directions of drift: fields missing a spec entry, and
-spec entries naming no field (stale keys).
+spec entries naming no field (stale keys).  This is the same gate that
+caught SolverBatch drift on day one, now covering the resident plane.
 """
 
 from __future__ import annotations
@@ -22,11 +27,18 @@ from typing import List, Sequence, Set, Tuple
 
 from karmada_tpu.analysis.core import Finding, SourceFile, dotted
 
+#: (dataclass, host-only exemption set) pairs covered by the pass; the
+#: exemption constant is looked up in the SAME file as its class
+COVERED_CLASSES = (
+    ("SolverBatch", "HOST_ONLY_FIELDS"),
+    ("ResidentPlane", "RESIDENT_HOST_ONLY"),
+)
 
-def _ndarray_fields(tree: ast.Module) -> Tuple[int, Set[str]]:
-    """(class lineno, ndarray-annotated field names) of SolverBatch."""
+
+def _ndarray_fields(tree: ast.Module, cls: str) -> Tuple[int, Set[str]]:
+    """(class lineno, ndarray-annotated field names) of dataclass `cls`."""
     for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef) and node.name == "SolverBatch":
+        if isinstance(node, ast.ClassDef) and node.name == cls:
             fields: Set[str] = set()
             for stmt in node.body:
                 if not isinstance(stmt, ast.AnnAssign):
@@ -39,13 +51,24 @@ def _ndarray_fields(tree: ast.Module) -> Tuple[int, Set[str]]:
     return 0, set()
 
 
-def _spec_table(tree: ast.Module) -> Tuple[int, Set[str], Set[str]]:
-    """(shard_specs lineno, spec keys, HOST_ONLY_FIELDS entries)."""
-    line, keys = 0, set()
-    host_only: Set[str] = set()
+def _const_strings(tree: ast.Module, name: str) -> Set[str]:
+    """Every string literal inside the module-level `name = ...`."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if name in names:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Constant) and \
+                            isinstance(sub.value, str):
+                        out.add(sub.value)
+    return out
+
+
+def _spec_table(tree: ast.Module) -> Tuple[int, Set[str]]:
+    """(shard_specs lineno, spec keys)."""
     for node in ast.walk(tree):
         if isinstance(node, ast.FunctionDef) and node.name == "shard_specs":
-            line = node.lineno
             best: Set[str] = set()
             for sub in ast.walk(node):
                 if isinstance(sub, ast.Dict):
@@ -54,46 +77,49 @@ def _spec_table(tree: ast.Module) -> Tuple[int, Set[str], Set[str]]:
                           and isinstance(k.value, str)}
                     if len(ks) > len(best):
                         best = ks
-            keys = best
-        elif isinstance(node, ast.Assign):
-            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
-            if "HOST_ONLY_FIELDS" in names:
-                for sub in ast.walk(node.value):
-                    if isinstance(sub, ast.Constant) and \
-                            isinstance(sub.value, str):
-                        host_only.add(sub.value)
-    return line, keys, host_only
+            return node.lineno, best
+    return 0, set()
 
 
 def run(files: Sequence[SourceFile]) -> List[Finding]:
-    fields_file = specs_file = None
-    fields: Set[str] = set()
-    fields_line = 0
+    specs_file = None
     keys: Set[str] = set()
     host_only: Set[str] = set()
     specs_line = 0
+    # cls -> (file, line, fields, extra host-only set)
+    classes: dict = {}
     for sf in files:
-        line, f = _ndarray_fields(sf.tree)
-        if f and fields_file is None:
-            fields_file, fields, fields_line = sf, f, line
-        line, k, h = _spec_table(sf.tree)
+        line, k = _spec_table(sf.tree)
         if k and specs_file is None:
             specs_file, keys, specs_line = sf, k, line
-            host_only = h
-    if fields_file is None or specs_file is None:
+            host_only = _const_strings(sf.tree, "HOST_ONLY_FIELDS")
+        for cls, exempt_name in COVERED_CLASSES:
+            line, f = _ndarray_fields(sf.tree, cls)
+            if f and cls not in classes:
+                classes[cls] = (sf, line, f,
+                                _const_strings(sf.tree, exempt_name))
+    if specs_file is None or not classes:
         return []  # scanned subtree lacks one side: nothing to compare
     findings: List[Finding] = []
-    for f in sorted(fields - keys - host_only):
-        findings.append(Finding(
-            rule="spec-coverage", file=specs_file.path, line=specs_line,
-            message=f"SolverBatch field `{f}` has no PartitionSpec entry "
-                    "in shard_specs (and is not in HOST_ONLY_FIELDS) — a "
-                    "mesh dispatch would place it by accident",
-        ))
-    for k in sorted(keys - fields):
-        findings.append(Finding(
-            rule="spec-coverage", file=specs_file.path, line=specs_line,
-            message=f"shard_specs entry `{k}` names no SolverBatch field "
-                    "— stale key",
-        ))
+    for cls, _exempt in COVERED_CLASSES:
+        if cls not in classes:
+            continue
+        _sf, _line, fields, extra = classes[cls]
+        for f in sorted(fields - keys - host_only - extra):
+            findings.append(Finding(
+                rule="spec-coverage", file=specs_file.path, line=specs_line,
+                message=f"{cls} field `{f}` has no PartitionSpec entry "
+                        "in shard_specs (and is not in HOST_ONLY_FIELDS / "
+                        "RESIDENT_HOST_ONLY) — a mesh dispatch would "
+                        "place it by accident",
+            ))
+    if "SolverBatch" in classes:
+        # stale-key drift is judged against SolverBatch only: the resident
+        # plane's fields are a subset of the batch vocabulary by design
+        for k in sorted(keys - classes["SolverBatch"][2]):
+            findings.append(Finding(
+                rule="spec-coverage", file=specs_file.path, line=specs_line,
+                message=f"shard_specs entry `{k}` names no SolverBatch "
+                        "field — stale key",
+            ))
     return findings

@@ -40,7 +40,9 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                 explain: float = 0.0,
                 batch_window: int = 4096,
                 batch_deadline: Optional[float] = None,
-                admission_limit: Optional[int] = None):
+                admission_limit: Optional[int] = None,
+                resident: bool = False,
+                resident_audit: int = 64):
     """controllers=None rehydrates the persisted --controllers spec; an
     explicit spec is also persisted so later invocations honor it.
 
@@ -70,7 +72,9 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                       explain=explain,
                       batch_window=batch_window,
                       batch_deadline_s=batch_deadline,
-                      admission_limit=admission_limit)
+                      admission_limit=admission_limit,
+                      resident=resident,
+                      resident_audit_interval=resident_audit)
     if controllers is not None:
         cp.apply({"apiVersion": "v1", "kind": "ConfigMap",
                   "metadata": {"namespace": "karmada-system",
@@ -1049,10 +1053,23 @@ def cmd_serve(args) -> int:
                                          else None),
                          admission_limit=(args.admission_limit
                                           if args.admission_limit > 0
-                                          else None))
+                                          else None),
+                         resident=args.resident,
+                         resident_audit=args.resident_audit)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 1
+    if args.resident:
+        if cp.scheduler.backend == "device":
+            print("resident-state plane armed: cluster tensors stay "
+                  "device-resident between cycles, advanced by watch "
+                  f"deltas (parity audit every {args.resident_audit} "
+                  "cycle(s)); state at /debug/resident, render with "
+                  "`karmadactl resident --endpoint URL`")
+        else:
+            print(f"WARNING: --resident needs the device backend (running "
+                  f"backend={cp.scheduler.backend}); the resident plane "
+                  "is not armed", file=sys.stderr)
     if explain_rate > 0:
         if args.metrics_port >= 0:
             pct = f"{explain_rate:.0%}" if explain_rate < 1 else "every"
@@ -1226,6 +1243,34 @@ def cmd_loadgen(args) -> int:
                         seed=args.seed)
     payload = driver.run()
     print(json.dumps(payload, indent=2 if args.pretty else None))
+    return 0
+
+
+def cmd_resident(args) -> int:
+    """Render a live serve process's resident-state plane
+    (/debug/resident): generation, vocabulary sizes, row-cache hit rate,
+    rebuild reasons, and the last parity-audit outcome — whether the
+    plane is running resident or rebuild-per-cycle at a glance."""
+    import urllib.error
+    import urllib.request
+
+    from karmada_tpu.resident import render_state
+
+    base = args.endpoint.rstrip("/")
+    url = base + "/debug/resident"
+    if args.recent:
+        url += f"?recent={args.recent}"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            state = json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        print(f"server error ({e.code}): {e.read().decode()[:200]}",
+              file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"cannot reach {base}: {e.reason}", file=sys.stderr)
+        return 1
+    print(render_state(state))
     return 0
 
 
@@ -1770,6 +1815,29 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--loadgen-seed", type=int, default=0,
                     help="deterministic arrival-process seed for "
                          "--loadgen")
+    sv.add_argument("--resident", action="store_true",
+                    help="arm the resident-state plane "
+                         "(karmada_tpu/resident, device backend only): "
+                         "cluster-side solver tensors and their device "
+                         "mirrors stay resident BETWEEN scheduling "
+                         "cycles, advanced by coalesced watch-event "
+                         "deltas, and per-binding encoded rows are "
+                         "cached so a steady-state cycle re-encodes only "
+                         "churned bindings; state at /debug/resident "
+                         "(karmadactl resident --endpoint URL)")
+    sv.add_argument("--resident-audit", type=int, default=64,
+                    metavar="N",
+                    help="resident parity-audit cadence: every Nth cycle "
+                         "re-encodes from scratch and compares bit-exact "
+                         "against the resident tensors (mismatch = "
+                         "metric + forced rebuild; 0 disables)")
+
+    rs = sub.add_parser("resident")
+    rs.add_argument("--endpoint", required=True,
+                    help="observability endpoint URL of a live serve "
+                         "process (serve --metrics-port PORT)")
+    rs.add_argument("--recent", type=int, default=0, metavar="N",
+                    help="also list the last N per-cycle hit/miss records")
     return p
 
 
@@ -1825,6 +1893,7 @@ COMMANDS = {
     "trace": cmd_trace,
     "vet": cmd_vet,
     "loadgen": cmd_loadgen,
+    "resident": cmd_resident,
 }
 
 
@@ -1865,6 +1934,9 @@ def _dispatch(args) -> int:
         # catalog/rehearsal need no plane; --endpoint talks to a live
         # serve process over HTTP
         return cmd_loadgen(args)
+    if args.command == "resident":
+        # talks to a live serve process over HTTP; no plane is opened
+        return cmd_resident(args)
     if args.command == "explain":
         # kind mode reads only the model registry; binding mode talks to
         # a live serve process over HTTP — neither opens a plane

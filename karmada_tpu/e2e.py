@@ -93,6 +93,11 @@ class ControlPlane:
         batch_window: int = 4096,
         batch_deadline_s: Optional[float] = None,
         admission_limit: Optional[int] = None,
+        # resident-state plane (karmada_tpu/resident, serve --resident):
+        # device-resident cluster tensors advanced by watch deltas +
+        # per-binding encoded-row cache; device backend only
+        resident: bool = False,
+        resident_audit_interval: int = 64,
     ) -> None:
         self.clock = clock if clock is not None else time.time
         from karmada_tpu.utils.events import EventRecorder
@@ -150,7 +155,10 @@ class ControlPlane:
                                    explain=explain,
                                    batch_window=batch_window,
                                    batch_deadline_s=batch_deadline_s,
-                                   admission_limit=admission_limit)
+                                   admission_limit=admission_limit,
+                                   resident=resident,
+                                   resident_audit_interval=(
+                                       resident_audit_interval))
         self.binding_controller = BindingController(
             self.store, self.runtime, self.interpreter
         )

@@ -16,12 +16,14 @@ evaluate 100k bindings without 100k x clusters network calls.
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from karmada_tpu import obs
+from karmada_tpu.utils.metrics import REGISTRY
 from karmada_tpu.estimator.wire import (
     CapacitySnapshotResponse,
     MaxAvailableReplicasRequest,
@@ -34,6 +36,14 @@ from karmada_tpu.estimator.wire import (
 )
 from karmada_tpu.models.cluster import Cluster
 from karmada_tpu.models.work import ReplicaRequirements, TargetCluster
+
+RPC_SKIPPED = REGISTRY.counter(
+    "karmada_estimator_rpc_skipped_total",
+    "Per-cluster estimator RPCs short-circuited because the cluster's "
+    "observed resourceVersion and the request signature were unchanged "
+    "since the previous cycle (the memoized answer served instead)",
+    ("method",),
+)
 
 
 def _rpc_span(cluster: str, method: str):
@@ -76,6 +86,22 @@ class AccurateEstimatorClient:
         self.transports: Dict[str, Transport] = {}
         self._pool = ThreadPoolExecutor(max_workers=max_workers)
         self._timeout_replicas = timeout_replicas
+        self._memo_lock = threading.Lock()
+        # guarded-by: _memo_lock — per (method, cluster): the cluster
+        # resourceVersion the memoized answers were observed at, and the
+        # successful answers keyed by request signature.  A cluster whose
+        # rv is unchanged since the last cycle re-serves the memo instead
+        # of refetching (karmada_estimator_rpc_skipped_total); any rv
+        # move drops the whole entry.  Only SUCCESSFUL responses memoize
+        # — an unreachable estimator must be retried next call, not
+        # pinned UNAUTHENTIC until the cluster happens to churn.  Each
+        # entry holds at most _MEMO_CAP signatures (a stable cluster
+        # with a diverse workload mix must not grow the scheduler
+        # process unboundedly); overflow drops the oldest insertions.
+        self._memo: Dict[Tuple[str, str], Tuple[int, Dict[str, int]]] = {}
+
+    #: per-(method, cluster) signature cap for the rv-keyed RPC memo
+    _MEMO_CAP = 256
 
     def register(self, cluster: str, transport: Transport) -> None:
         self.transports[cluster] = transport
@@ -84,6 +110,39 @@ class AccurateEstimatorClient:
         t = self.transports.pop(cluster, None)
         if t is not None:
             t.close()
+        with self._memo_lock:
+            for key in [k for k in self._memo if k[1] == cluster]:
+                del self._memo[key]
+
+    # -- rv-keyed RPC memo ---------------------------------------------------
+    @staticmethod
+    def _req_sig(payload: dict) -> str:
+        return json.dumps(payload, sort_keys=True, default=str)
+
+    def _memo_get(self, method: str, cluster: Cluster,
+                  sig: str) -> Optional[int]:
+        rv = cluster.metadata.resource_version
+        with self._memo_lock:
+            entry = self._memo.get((method, cluster.name))
+            if entry is None or entry[0] != rv:
+                return None
+            answer = entry[1].get(sig)
+        if answer is not None:
+            RPC_SKIPPED.inc(method=method)
+        return answer
+
+    def _memo_put(self, method: str, cluster: Cluster, sig: str,
+                  answer: int) -> None:
+        rv = cluster.metadata.resource_version
+        with self._memo_lock:
+            entry = self._memo.get((method, cluster.name))
+            if entry is None or entry[0] != rv:
+                entry = (rv, {})
+                self._memo[(method, cluster.name)] = entry
+            answers = entry[1]
+            while len(answers) >= self._MEMO_CAP:
+                answers.pop(next(iter(answers)))  # oldest insertion
+            answers[sig] = answer
 
     # -- ReplicaEstimator ----------------------------------------------------
     def max_available_replicas(
@@ -91,6 +150,13 @@ class AccurateEstimatorClient:
         clusters: List[Cluster],
         requirements: Optional[ReplicaRequirements],
     ) -> List[TargetCluster]:
+        # the memo key already carries the cluster name, so the request
+        # signature is computed ONCE per call from a name-free template
+        # instead of one json.dumps per cluster on the fan-out hot path
+        sig = self._req_sig(
+            MaxAvailableReplicasRequest.from_requirements(
+                "", requirements).to_json())
+
         def one(cluster: Cluster) -> TargetCluster:
             transport = self.transports.get(cluster.name)
             if transport is None:
@@ -98,10 +164,16 @@ class AccurateEstimatorClient:
             req = MaxAvailableReplicasRequest.from_requirements(
                 cluster.name, requirements
             )
+            payload = req.to_json()
+            cached = self._memo_get("MaxAvailableReplicas", cluster, sig)
+            if cached is not None:
+                return TargetCluster(cluster.name, cached)
             try:
                 resp = MaxAvailableReplicasResponse.from_json(
-                    transport.call("MaxAvailableReplicas", req.to_json())
+                    transport.call("MaxAvailableReplicas", payload)
                 )
+                self._memo_put("MaxAvailableReplicas", cluster, sig,
+                               resp.max_replicas)
                 return TargetCluster(cluster.name, resp.max_replicas)
             except Exception:  # noqa: BLE001 -- unreachable estimator
                 return TargetCluster(cluster.name, self._timeout_replicas)
@@ -119,6 +191,12 @@ class AccurateEstimatorClient:
             MaxAvailableComponentSetsResponse,
         )
 
+        # name-free signature computed once per call (see
+        # max_available_replicas)
+        sig = self._req_sig(
+            MaxAvailableComponentSetsRequest.from_components(
+                "", components).to_json())
+
         def one(cluster: Cluster) -> TargetCluster:
             transport = self.transports.get(cluster.name)
             if transport is None:
@@ -126,10 +204,17 @@ class AccurateEstimatorClient:
             req = MaxAvailableComponentSetsRequest.from_components(
                 cluster.name, components
             )
+            payload = req.to_json()
+            cached = self._memo_get("MaxAvailableComponentSets", cluster,
+                                    sig)
+            if cached is not None:
+                return TargetCluster(cluster.name, cached)
             try:
                 resp = MaxAvailableComponentSetsResponse.from_json(
-                    transport.call("MaxAvailableComponentSets", req.to_json())
+                    transport.call("MaxAvailableComponentSets", payload)
                 )
+                self._memo_put("MaxAvailableComponentSets", cluster, sig,
+                               resp.max_sets)
                 return TargetCluster(cluster.name, resp.max_sets)
             except Exception:  # noqa: BLE001 -- unreachable estimator
                 return TargetCluster(cluster.name, self._timeout_replicas)

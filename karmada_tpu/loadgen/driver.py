@@ -172,7 +172,9 @@ class ServeSlice:
     .runtime / .scheduler (a ControlPlane included) drives the same."""
 
     def __init__(self, scenario: Scenario, clock, model: ServiceModel,
-                 backend: str = "serial", explain: float = 0.0) -> None:
+                 backend: str = "serial", explain: float = 0.0,
+                 resident: bool = False,
+                 resident_audit_interval: int = 64) -> None:
         self.store = ObjectStore()
         self.runtime = Runtime()
         self.scheduler = Scheduler(
@@ -182,6 +184,8 @@ class ServeSlice:
             queue=SchedulingQueue(now=clock,
                                   max_resident=scenario.admission_limit()),
             explain=explain,
+            resident=resident,
+            resident_audit_interval=resident_audit_interval,
         )
         for i in range(scenario.n_clusters):
             self.store.create(build_cluster(f"lg-m{i}"))

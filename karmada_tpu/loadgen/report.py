@@ -162,6 +162,12 @@ def build_soak_report(driver) -> dict:
             "empty": driver.plane.scheduler.queue_state()["empty_cuts"],
         },
         "stage_utilization": _stage_utilization(recorder),
+        # resident-state plane (karmada_tpu/resident): hit rate, rebuild
+        # reasons and audit outcomes for the soak window; None when the
+        # plane runs rebuild-per-cycle
+        "resident": (driver.plane.scheduler.resident_state()
+                     if hasattr(driver.plane.scheduler, "resident_state")
+                     else None),
         "residual_queue": getattr(driver, "residual", {}),
         **{k: fs[k] for k in ("injected", "scheduled", "failed_attempts",
                               "reschedules")},

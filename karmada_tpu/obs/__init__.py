@@ -50,6 +50,10 @@ SPAN_D2H = "pipeline.d2h"                 # sparse result copy (+ escalation)
 SPAN_DECODE = "pipeline.decode"           # COO decode to per-binding results
 # estimator/client.py
 SPAN_ESTIMATOR_RPC = "estimator.rpc"      # one per-cluster estimator call
+# karmada_tpu/resident (the device-resident state plane)
+SPAN_RESIDENT_APPLY = "resident.apply"    # delta apply / structural rebuild
+SPAN_RESIDENT_ENCODE = "resident.encode"  # gather + miss-subset re-encode
+SPAN_RESIDENT_AUDIT = "resident.audit"    # bit-exact parity audit
 # controllers
 SPAN_BINDING_RENDER = "binding.ensure_works"
 SPAN_DETECTOR_MATCH = "detector.match_policy"
@@ -59,7 +63,8 @@ SPAN_RECONCILE_PREFIX = "reconcile."
 SPAN_NAMES = (
     SPAN_CYCLE, SPAN_SERIAL, SPAN_PIPELINE, SPAN_CHUNK, SPAN_ENCODE,
     SPAN_DISPATCH, SPAN_SPREAD, SPAN_BIG, SPAN_WAIT, SPAN_D2H, SPAN_DECODE,
-    SPAN_ESTIMATOR_RPC, SPAN_BINDING_RENDER, SPAN_DETECTOR_MATCH,
+    SPAN_ESTIMATOR_RPC, SPAN_RESIDENT_APPLY, SPAN_RESIDENT_ENCODE,
+    SPAN_RESIDENT_AUDIT, SPAN_BINDING_RENDER, SPAN_DETECTOR_MATCH,
 )
 
 # every pipeline stage a healthy device chunk must traverse (the tier-1

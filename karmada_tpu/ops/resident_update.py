@@ -1,0 +1,89 @@
+"""Jitted scatter-update kernels for the resident-state plane.
+
+The resident plane (karmada_tpu/resident/state.py) keeps the cluster-side
+solver tensors device-resident BETWEEN scheduling cycles; watch-event
+deltas touch a handful of cluster lanes per cycle, so advancing the
+device mirrors is a scatter of the churned rows/columns, not a re-upload
+of the whole ~5MB tensor set.  These are the only entrypoints that
+mutate resident device state:
+
+  scatter_rows(dst, lanes, rows)   dst[lanes, ...] = rows   (axis-0 lead:
+                                   the [C]- and [C, R]-shaped capacity
+                                   tensors — avail_milli, has_alloc,
+                                   pods_allowed, has_summary, deleting)
+  scatter_cols(dst, lanes, cols)   dst[:, lanes] = cols     (axis-1 lead:
+                                   the [Q, C] / [G, C] planes —
+                                   est_override, api_ok)
+
+Both donate `dst`, so on backends that support donation the update is
+in place (the old buffer is consumed); on CPU jax falls back to a
+device-side copy, which still beats the host->device re-upload.  Callers
+pad `lanes` to a pow2 bucket (karmada_tpu/resident/state.py) so the jit
+signature set stays bounded — duplicate lanes in the pad carry the same
+row and are therefore order-safe for `.at[].set`.
+
+Trace-safety: pure gather/scatter — no Python control flow on traced
+values, no host syncs, no dtype-defaulted constructors (the kernels
+construct nothing; dtypes ride in on the operands, which the resident
+plane builds against ops/tensors.FIELD_DTYPES).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def scatter_rows(dst, lanes, rows):
+    """dst[lanes, ...] = rows, donated (in place where supported)."""
+    return dst.at[lanes].set(rows)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def scatter_cols(dst, lanes, cols):
+    """dst[:, lanes] = cols, donated (in place where supported)."""
+    return dst.at[:, lanes].set(cols)
+
+
+def _pad(lanes, data, lane_axis: int):
+    """Pow2-bucket a (lanes, data) scatter so the jit signature set stays
+    bounded (same bucketing as tensors._next_pow2, floor 8): the pad
+    repeats the LAST lane/value pair, which is a no-op rewrite of the
+    same values.  Host-side helper (numpy in, numpy out)."""
+    import numpy as np
+
+    from karmada_tpu.ops.tensors import _next_pow2
+
+    k = len(lanes)
+    cap = _next_pow2(k, 8)
+    data = np.asarray(data)
+    if cap == k:
+        return np.asarray(lanes), data
+    lanes2 = np.empty(cap, np.int64)
+    lanes2[:k] = lanes
+    lanes2[k:] = lanes[-1]
+    shape = list(data.shape)
+    shape[lane_axis] = cap
+    data2 = np.empty(tuple(shape), data.dtype)
+    src = [slice(None)] * data.ndim
+    src[lane_axis] = slice(0, k)
+    pad = [slice(None)] * data.ndim
+    pad[lane_axis] = slice(k, None)
+    last = [slice(None)] * data.ndim
+    last[lane_axis] = slice(k - 1, k)
+    data2[tuple(src)] = data
+    data2[tuple(pad)] = data[tuple(last)]
+    return lanes2, data2
+
+
+def pad_lanes(lanes, rows):
+    """Pad a row scatter (rows carry the lane axis FIRST)."""
+    return _pad(lanes, rows, 0)
+
+
+def pad_lanes_cols(lanes, cols):
+    """Pad a column scatter (cols carry the lane axis LAST)."""
+    return _pad(lanes, cols, -1)

@@ -278,6 +278,14 @@ class SolverBatch:
     # "not computed" for dispatch-time validation)
     pl_fail_bits: np.ndarray = field(default=None)  # int32[P, C]
     explain: bool = False
+    # vocabulary identities for the resident-state plane
+    # (karmada_tpu/resident): the Placement objects per P row, the
+    # (api_version, kind) keys per G row, and the request objects per Q
+    # row — lets a consumer remap this batch's ids into a persistent
+    # vocabulary by KEY instead of re-deriving them from the items
+    placements: List = field(default=None)  # P-axis order
+    gvk_keys: List[Tuple[str, str]] = field(default=None)  # G-axis order
+    class_reqs: List = field(default=None)  # Q-axis order (rr | _SetClass)
 
 
 def _effective_placement(
@@ -803,12 +811,16 @@ def encode_batch(
         fail_plane = (_fail_plane(placements, clusters, C, P_hit, cache,
                                   assembled_sig)
                       if explain else np.zeros((P_hit, C), np.int32))
-        return _build_solver_batch(
+        batch = _build_solver_batch(
             shared_hit, B, C, nB, nC, b_valid, placement_id, gvk_id,
             class_id, replicas, uid_desc, fresh, non_workload, nw_shortcut,
             prev_idx, prev_val, evict_idx, route, cindex, region_names,
             list(res_names), list(classes), label_axes, explain, fail_plane,
         )
+        batch.placements = list(placements)
+        batch.gvk_keys = list(gvks)
+        batch.class_reqs = list(class_reqs)
+        return batch
 
     # ---- capacity tensors -------------------------------------------------
     # Every axis the jit signature depends on is pow2-bucketed: B, C, and
@@ -1061,12 +1073,16 @@ def encode_batch(
             pl_fail_bits.flags.writeable = False
         cache.fail_plane = (assembled_sig, pl_fail_bits)
 
-    return _build_solver_batch(
+    batch = _build_solver_batch(
         shared, B, C, nB, nC, b_valid, placement_id, gvk_id, class_id,
         replicas, uid_desc, fresh, non_workload, nw_shortcut,
         prev_idx, prev_val, evict_idx, route, cindex, region_names,
         list(res_names), list(classes), label_axes, explain, pl_fail_bits,
     )
+    batch.placements = list(placements)
+    batch.gvk_keys = list(gvks)
+    batch.class_reqs = list(class_reqs)
+    return batch
 
 
 def _fail_row(placement, clusters, C, plug_filters, dummy_status

@@ -1,0 +1,137 @@
+"""Watch-event ingestion for the resident-state plane.
+
+The reference control plane is informer-driven: components receive
+ADDED/MODIFIED/DELETED deltas, never snapshots (PAPER.md L3).  The
+resident plane mirrors that on device — but it must know which KIND of
+change each cluster event carries, because the update cost differs by
+orders of magnitude:
+
+  capacity    status-only churn (ResourceSummary, deletion timestamp):
+              scatter-update the churned cluster's capacity lanes and
+              estimator-override column in place — the steady-state path.
+  api         status.api_enablements changed: recompute that cluster's
+              api_ok column for every resident GVK (cheap, O(G)).
+  structural  membership changed (ADDED/DELETED), or spec / labels
+              changed: cluster lanes, name ranks, placement-predicate
+              columns, routes and region vocabulary may all shift — the
+              resident plane falls back losslessly to a full re-encode
+              (karmada_tpu/resident/state.py::_reset).
+
+Events are coalesced per cluster per cycle (the strongest class wins),
+exactly like an informer's per-key delta compression: a cluster that
+flapped five times between cycles is applied once.  Binding events are
+tracked only for row-cache hygiene (DELETED prunes the cached row; the
+row cache's own (key, resourceVersion) tokens handle invalidation).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from karmada_tpu.models.cluster import Cluster
+from karmada_tpu.models.work import ResourceBinding
+from karmada_tpu.store.store import DELETED, Event
+
+# coalescing order: a stronger class absorbs a weaker one for the same
+# cluster within one cycle's window
+CAPACITY = "capacity"
+API = "api"
+STRUCTURAL = "structural"
+_RANK = {CAPACITY: 0, API: 1, STRUCTURAL: 2}
+
+
+@dataclass
+class CycleDeltas:
+    """One cycle's coalesced delta set (DeltaTracker.drain)."""
+
+    structural: bool = False
+    structural_reason: str = ""
+    # cluster name -> strongest observed class (capacity | api); clusters
+    # classified structural are folded into the `structural` flag instead
+    # (the whole plane rebuilds, per-lane detail is moot)
+    clusters: Dict[str, str] = field(default_factory=dict)
+    binding_events: int = 0
+    bindings_deleted: List[Tuple[str, str]] = field(default_factory=list)
+
+    def empty(self) -> bool:
+        return (not self.structural and not self.clusters
+                and not self.bindings_deleted)
+
+
+def classify_change(old: Cluster, new: Cluster) -> Tuple[str, str]:
+    """(class, reason) for one observed cluster old->new transition.
+    Shared by the event path below and the resident plane's per-cycle
+    resourceVersion sweep (state.py), so both classify identically."""
+    if new.spec != old.spec:
+        # taints, region, provider, zone: placement predicates, name-rank
+        # neighbors and the region vocabulary can all move
+        return STRUCTURAL, "cluster-spec"
+    if new.metadata.labels != old.metadata.labels:
+        # labels drive placement label selectors and spread-by-label axes
+        return STRUCTURAL, "cluster-labels"
+    if new.status.api_enablements != old.status.api_enablements:
+        return API, "api-enablement"
+    return CAPACITY, "status"
+
+
+def classify_cluster_event(event: Event) -> Tuple[str, str]:
+    """(class, reason) for one Cluster event — see module docstring."""
+    if event.type == DELETED or event.old is None:
+        return STRUCTURAL, "membership"
+    return classify_change(event.old, event.obj)
+
+
+class DeltaTracker:
+    """Subscribes to the store's watch bus and coalesces events per
+    scheduling cycle.  drain() hands the accumulated set to the resident
+    plane and resets the window; thread-safe (publisher threads write,
+    the scheduler's device-cycle thread drains)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._clusters: Dict[str, str] = {}
+        # guarded-by: _lock
+        self._structural: Optional[str] = None
+        # guarded-by: _lock
+        self._binding_events = 0
+        # guarded-by: _lock
+        self._bindings_deleted: List[Tuple[str, str]] = []
+
+    def on_event(self, event: Event) -> None:
+        kind = event.kind
+        if kind == Cluster.KIND:
+            cls, reason = classify_cluster_event(event)
+            with self._lock:
+                if cls == STRUCTURAL:
+                    if self._structural is None:
+                        self._structural = reason
+                    return
+                name = event.obj.metadata.name
+                prev = self._clusters.get(name)
+                if prev is None or _RANK[cls] > _RANK[prev]:
+                    self._clusters[name] = cls
+        elif kind == ResourceBinding.KIND:
+            with self._lock:
+                self._binding_events += 1
+                if event.type == DELETED:
+                    m = event.obj.metadata
+                    self._bindings_deleted.append((m.namespace, m.name))
+
+    def drain(self) -> CycleDeltas:
+        """The coalesced window since the previous drain (resets it)."""
+        with self._lock:
+            out = CycleDeltas(
+                structural=self._structural is not None,
+                structural_reason=self._structural or "",
+                clusters=self._clusters,
+                binding_events=self._binding_events,
+                bindings_deleted=self._bindings_deleted,
+            )
+            self._clusters = {}
+            self._structural = None
+            self._binding_events = 0
+            self._bindings_deleted = []
+        return out

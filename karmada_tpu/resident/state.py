@@ -1,0 +1,1323 @@
+"""ResidentState: device-resident cluster tensors + incremental encode.
+
+Every scheduling cycle used to rebuild the entire SolverBatch from Python
+objects (ops/tensors.encode_batch) — BENCH_r05 shows that re-encode is
+now the wall: the solve is pipelined and mesh-sharded while the encoder
+feeds it at a fraction of the host budget.  The reference control plane
+never rebuilds: informers deliver deltas (PAPER.md L3).  This module
+mirrors that on the solver plane:
+
+  * the cluster/placement-side tensors (the exact arrays ops/solver's
+    dispatch consumes, named per ops/tensors.FIELD_DTYPES) live as
+    FROZEN copy-on-write numpy masters BETWEEN cycles, advanced by
+    coalesced watch-event deltas (resident/deltas.py) — a capacity flap
+    recomputes one cluster's lanes, not 5000 clusters' worth of Python;
+  * their device mirrors stay resident too: jitted scatter kernels
+    (ops/resident_update.py) rewrite only the churned lanes in place and
+    the result is primed into the solver's device-transfer cache
+    (ops/solver.prime_cluster_slot), so a steady-state dispatch moves
+    only the cycle's binding rows — pjit inputs already placed to match
+    the meshing PartitionSpecs skip the repartition (SNIPPETS [1]/[3]);
+  * per-binding encoded rows are cached in a slot store keyed by
+    (namespace/name, resourceVersion) under a structural generation —
+    the policy/placement side of the key rides the resourceVersion (any
+    spec or status write bumps it) plus the process-wide plugin-registry
+    generation; a cycle re-encodes ONLY churned bindings and gathers the
+    rest with vectorized fancy indexing.
+
+Misses are not re-implemented: they run through the REAL encode_batch on
+the miss subset, and the resulting mini-batch is merged by translating
+its (placement, class, GVK, resource) vocabulary into the resident one —
+row contents are bit-identical by construction, ids are remapped.  The
+same property makes the fallback lossless: any structural change
+(cluster membership/spec/labels, plugin registry, C-padding growth, a
+failed audit) resets the plane and the next cycle is one full
+encode_batch whose tensors are adopted as the new resident masters.
+
+Safety is first-class: a periodic audit re-encodes the cycle from
+scratch and compares the resident batch BIT-EXACT (vocabulary-mapped —
+resident axes may hold retired entries; every value a solve can read
+must match).  A mismatch increments karmada_resident_audits_total
+{outcome="mismatch"}, forces a rebuild, and the fresh batch serves the
+cycle.  /debug/resident and the resident.* flight-recorder spans expose
+generation, vocabulary sizes, hit rate, delta depth and audit outcomes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karmada_tpu import obs
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.models.work import ResourceBindingStatus
+from karmada_tpu.ops import serial, tensors
+from karmada_tpu.resident.deltas import (
+    API,
+    CAPACITY,
+    STRUCTURAL,
+    CycleDeltas,
+    _RANK,
+    classify_change,
+)
+from karmada_tpu.utils.metrics import REGISTRY
+
+# -- observability ------------------------------------------------------------
+RESIDENT_GENERATION = REGISTRY.gauge(
+    "karmada_resident_generation",
+    "Structural generation of the resident state plane (bumps on every "
+    "full rebuild; 0 = no plane adopted yet)",
+)
+RESIDENT_VOCAB = REGISTRY.gauge(
+    "karmada_resident_vocab_size",
+    "Entries per resident vocabulary axis",
+    ("axis",),
+)
+RESIDENT_ROWS = REGISTRY.gauge(
+    "karmada_resident_rows_cached",
+    "Encoded binding rows currently cached in the resident slot store",
+)
+RESIDENT_LOOKUPS = REGISTRY.counter(
+    "karmada_resident_row_lookups_total",
+    "Per-binding row-cache lookups by result (hit = gathered from the "
+    "resident store, miss = re-encoded via encode_batch)",
+    ("result",),
+)
+RESIDENT_REBUILDS = REGISTRY.counter(
+    "karmada_resident_rebuilds_total",
+    "Full resident-plane rebuilds (lossless fallback to encode_batch) "
+    "by reason",
+    ("reason",),
+)
+RESIDENT_AUDITS = REGISTRY.counter(
+    "karmada_resident_audits_total",
+    "Resident-vs-full-encode parity audits by outcome (a mismatch "
+    "forces a rebuild and the fresh batch serves the cycle)",
+    ("outcome",),
+)
+RESIDENT_DELTAS = REGISTRY.counter(
+    "karmada_resident_cluster_deltas_total",
+    "Coalesced cluster deltas applied to the resident plane by class",
+    ("kind",),
+)
+
+#: Resident ndarray fields that never cross the host->device boundary
+#: beyond what meshing.HOST_ONLY_FIELDS already exempts.  The slot-store
+#: arrays share the SolverBatch field names on purpose: the per-cycle
+#: GATHERED copies are what dispatch ships, under the same PartitionSpec
+#: entries (the spec-coverage vet pass checks ResidentPlane against the
+#: same table).
+RESIDENT_HOST_ONLY = frozenset()
+
+_ROUTE_DEVICE = tensors.ROUTE_DEVICE
+
+
+@dataclass
+class ResidentPlane:
+    """The persistent tensor set (numpy masters, frozen between writes).
+
+    Cluster/placement-side fields are the EXACT arrays dispatch consumes
+    (shared verbatim into every cycle's SolverBatch); binding-axis fields
+    are the slot store the per-cycle gather reads.  Field names and
+    dtypes follow ops/tensors.FIELD_DTYPES — the dtype-contract and
+    spec-coverage vet passes check this class like they check
+    SolverBatch."""
+
+    # cluster axis
+    cluster_valid: np.ndarray
+    deleting: np.ndarray
+    name_rank: np.ndarray
+    pods_allowed: np.ndarray
+    has_summary: np.ndarray
+    avail_milli: np.ndarray
+    has_alloc: np.ndarray
+    api_ok: np.ndarray
+    # request classes
+    req_milli: np.ndarray
+    req_is_cpu: np.ndarray
+    req_pods: np.ndarray
+    est_override: np.ndarray
+    # placements
+    pl_mask: np.ndarray
+    pl_tol_bypass: np.ndarray
+    pl_strategy: np.ndarray
+    pl_static_w: np.ndarray
+    pl_has_cluster_sc: np.ndarray
+    pl_sc_min: np.ndarray
+    pl_sc_max: np.ndarray
+    pl_ignore_avail: np.ndarray
+    pl_extra_score: np.ndarray
+    region_id: np.ndarray
+    pl_has_region_sc: np.ndarray
+    pl_region_min: np.ndarray
+    pl_region_max: np.ndarray
+    # binding-axis slot store (gathered per cycle)
+    placement_id: np.ndarray
+    gvk_id: np.ndarray
+    class_id: np.ndarray
+    replicas: np.ndarray
+    uid_desc: np.ndarray
+    fresh: np.ndarray
+    non_workload: np.ndarray
+    nw_shortcut: np.ndarray
+    route: np.ndarray
+    prev_idx: np.ndarray
+    prev_val: np.ndarray
+    evict_idx: np.ndarray
+
+
+#: the cluster/placement-side plane fields, in ops/solver._CLUSTER_FIELDS
+#: order (the device-slot priming contract), plus the spread-topology
+#: fields the dispatch reads off the batch
+CLUSTER_SIDE_FIELDS = (
+    "cluster_valid", "deleting", "name_rank", "pods_allowed", "has_summary",
+    "avail_milli", "has_alloc", "api_ok",
+    "req_milli", "req_is_cpu", "req_pods", "est_override",
+    "pl_mask", "pl_tol_bypass", "pl_strategy", "pl_static_w",
+    "pl_has_cluster_sc", "pl_sc_min", "pl_sc_max", "pl_ignore_avail",
+    "pl_extra_score",
+)
+SHARED_EXTRA_FIELDS = (
+    "region_id", "pl_has_region_sc", "pl_region_min", "pl_region_max",
+)
+BINDING_SLOT_FIELDS = (
+    "placement_id", "gvk_id", "class_id", "replicas", "uid_desc",
+    "fresh", "non_workload", "nw_shortcut", "route",
+)
+#: fields whose device mirror can advance by a cluster-LANE scatter
+#: (leading axis is C)
+ROW_SCATTER_FIELDS = frozenset({
+    "cluster_valid", "deleting", "name_rank", "pods_allowed", "has_summary",
+    "avail_milli", "has_alloc",
+})
+#: fields whose device mirror advances by a cluster-COLUMN scatter
+#: (trailing axis is C)
+COL_SCATTER_FIELDS = frozenset({"est_override", "api_ok"})
+
+
+class RowToken:
+    """Identity + validity of one binding's cached encoded row."""
+
+    __slots__ = ("key", "rv")
+
+    def __init__(self, key: str, rv: int) -> None:
+        self.key = key
+        self.rv = rv
+
+
+class _Row:
+    __slots__ = ("slot", "rv")
+
+    def __init__(self, slot: int, rv: int) -> None:
+        self.slot = slot
+        self.rv = rv
+
+
+def _freeze(arr: np.ndarray) -> np.ndarray:
+    if isinstance(arr, np.ndarray) and arr.flags.owndata:
+        arr.flags.writeable = False
+    return arr
+
+
+class _Txn:
+    """Copy-on-write transaction over the frozen plane masters: first
+    access of a field copies it writable; commit() freezes the copies,
+    swaps them into the plane, and reports which fields changed."""
+
+    def __init__(self, plane: ResidentPlane) -> None:
+        self.plane = plane
+        self._w: Dict[str, np.ndarray] = {}
+
+    def get(self, field: str) -> np.ndarray:
+        arr = self._w.get(field)
+        if arr is None:
+            arr = np.array(getattr(self.plane, field))  # writable copy
+            self._w[field] = arr
+        return arr
+
+    def commit(self) -> List[str]:
+        for f, arr in self._w.items():
+            setattr(self.plane, f, _freeze(arr))
+        return list(self._w)
+
+
+class _DevicePlane:
+    """Device mirrors of the cluster-side masters, advanced by the
+    ops/resident_update scatter kernels and primed into the solver's
+    device-transfer cache so dispatch never re-uploads them."""
+
+    def __init__(self) -> None:
+        self.mirrors: Dict[str, object] = {}
+        self.np_refs: Dict[str, np.ndarray] = {}
+        self.plan_gen: Optional[int] = None
+        self.broken = False  # a failed sync disables the mirror path
+
+    def sync(self, plane: ResidentPlane, dirty: Dict[str, object]) -> bool:
+        """Advance mirrors to the current masters and prime the solver
+        slot.  `dirty` maps field -> lane array for fields whose change
+        is a pure cluster-lane/column rewrite (scatter path); any other
+        identity change re-places the whole field.  Returns True when the
+        slot was primed."""
+        if self.broken:
+            return False
+        try:
+            from karmada_tpu.ops import meshing, resident_update
+            from karmada_tpu.ops import solver as solver_mod
+
+            plan = meshing.active()
+            gen = plan.generation if plan is not None else 0
+            fresh = gen != self.plan_gen
+            for f in CLUSTER_SIDE_FIELDS:
+                master = getattr(plane, f)
+                if not fresh and self.np_refs.get(f) is master:
+                    continue
+                mirror = self.mirrors.get(f)
+                lanes = None if fresh else dirty.get(f)
+                if mirror is not None and lanes is not None \
+                        and getattr(mirror, "shape", None) == master.shape:
+                    if f in ROW_SCATTER_FIELDS:
+                        lp, rows = resident_update.pad_lanes(
+                            lanes, master[lanes])
+                        mirror = resident_update.scatter_rows(
+                            mirror, lp, rows)
+                    elif f in COL_SCATTER_FIELDS:
+                        lp, cols = resident_update.pad_lanes_cols(
+                            lanes, master[..., lanes])
+                        mirror = resident_update.scatter_cols(
+                            mirror, lp, cols)
+                    else:  # no scatter shape for this field: re-place
+                        mirror = solver_mod._put(f, master, plan)  # noqa: SLF001
+                else:
+                    mirror = solver_mod._put(f, master, plan)  # noqa: SLF001
+                self.mirrors[f] = mirror
+                self.np_refs[f] = master
+            self.plan_gen = gen
+            return solver_mod.prime_cluster_slot(
+                tuple(self.np_refs[f] for f in CLUSTER_SIDE_FIELDS),
+                tuple(self.mirrors[f] for f in CLUSTER_SIDE_FIELDS),
+                gen)
+        except Exception:  # noqa: BLE001 — mirrors are an optimization:
+            # a failed device sync must degrade to plain dispatch-time
+            # uploads, never take the scheduler down — but never silently:
+            # losing the mirror path re-adds the ~5MB per-dispatch upload
+            # for the process lifetime, so the cause must be on record
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "resident device-mirror sync failed; disabling the mirror "
+                "path (dispatch falls back to per-cycle uploads)")
+            self.broken = True
+            self.mirrors = {}
+            self.np_refs = {}
+            return False
+
+
+class AuditMismatch(Exception):
+    """Raised internally when the parity audit finds divergence."""
+
+    def __init__(self, fields: List[str]) -> None:
+        super().__init__(f"resident-vs-full-encode mismatch: {fields}")
+        self.fields = fields
+
+
+class ResidentState:
+    """The device-resident state plane for ONE scheduler's device path.
+
+    Driven single-threaded from the scheduler's device cycle; stats are
+    lock-guarded for the /debug/resident reader."""
+
+    def __init__(self, estimator: Optional[GeneralEstimator] = None,
+                 audit_interval: int = 64, device_plane: bool = True,
+                 cycle_log_cap: int = 512) -> None:
+        self.estimator = estimator or GeneralEstimator()
+        self.audit_interval = max(0, int(audit_interval))
+        self.device = _DevicePlane() if device_plane else None
+
+        self.plane: Optional[ResidentPlane] = None
+        self.cindex: Optional[tensors.ClusterIndex] = None
+        self.clusters: List = []
+        self.cluster_rvs: List[int] = []
+        self.names: List[str] = []
+        self.nC = 0
+        self.C = 0
+        # vocabularies (append-only between rebuilds)
+        self.res_names: List[str] = []
+        self.class_keys: List = []
+        self.class_reqs: List = []
+        self.placements: List = []
+        self.pkeys: Dict[str, int] = {}
+        self.gvk_keys: List[Tuple[str, str]] = []
+        self.gvks: Dict[Tuple[str, str], int] = {}
+        self.region_names: List[str] = []
+        self.label_axes: Dict[str, tuple] = {}
+        self.plugins_gen: Optional[int] = None
+        self.enc_cache = tensors.EncoderCache()
+        # binding-row slot store
+        self.rows: Dict[str, _Row] = {}
+        self._free: List[int] = []
+        self._next_slot = 0
+        self.Kp = 4
+        self.Ke = 4
+        # explain plane: per-placement static fail-bit rows (+ assembled)
+        self._fail_rows: Dict[int, np.ndarray] = {}
+        self._fail_plane: Optional[Tuple[tuple, np.ndarray]] = None
+        # device-mirror dirtiness accumulated since the last sync
+        self._dirty: Dict[str, object] = {}
+        self._device_primed = False
+
+        self.generation = 0
+        self.cycles = 0
+        self._stats_lock = threading.Lock()
+        # guarded-by: _stats_lock
+        self.hits = 0
+        # guarded-by: _stats_lock
+        self.misses = 0
+        # guarded-by: _stats_lock
+        self.rebuilds: Dict[str, int] = {}
+        # guarded-by: _stats_lock
+        self.audits_ok = 0
+        # guarded-by: _stats_lock
+        self.audit_mismatches = 0
+        # guarded-by: _stats_lock
+        self.last_audit: Optional[dict] = None
+        # guarded-by: _stats_lock
+        self.last_deltas: dict = {}
+        # guarded-by: _stats_lock
+        self.cycle_log: deque = deque(maxlen=cycle_log_cap)
+
+    # -- lifecycle -----------------------------------------------------------
+    def begin_cycle(self, clusters: Sequence,
+                    deltas: Optional[CycleDeltas] = None) -> None:
+        """Advance the plane to this cycle's cluster snapshot: apply the
+        coalesced deltas, or fall back to a full rebuild on any
+        structural change.  Must run before the cycle's encode_cycle
+        calls, on the same thread.
+
+        The watch-event deltas are a HINT, not the source of truth: the
+        store's snapshot (`clusters`, deepcopies) and its watch bus are
+        not taken atomically, so an event drained this cycle may describe
+        a write the snapshot already contains (no-op apply) or a write
+        newer than it (which must NOT be applied ahead of the snapshot).
+        The resourceVersion sweep below closes that window — every lane
+        whose rv moved against the retained previous snapshot is
+        classified old-object-vs-new-object and folded into the delta
+        set, so the plane always lands exactly ON this cycle's snapshot
+        regardless of event timing."""
+        from karmada_tpu.scheduler.plugins import REGISTRY as _PLUGINS
+
+        clusters = list(clusters)
+        self.cycles += 1
+        reason = None
+        changed: Dict[str, str] = dict(deltas.clusters) if deltas else {}
+        if self.plane is None:
+            reason = "init"
+        elif self.plugins_gen != _PLUGINS.generation:
+            reason = "plugin-registry"
+        elif deltas is not None and deltas.structural:
+            reason = deltas.structural_reason or "cluster-structural"
+        elif [c.name for c in clusters] != self.names:
+            # defense in depth: membership/order drift the tracker missed
+            # (e.g. a store rebuilt underneath us) is structural too
+            reason = "membership"
+        else:
+            # the rv sweep (see docstring): O(nC) int compares per cycle
+            for lane, new in enumerate(clusters):
+                rv = new.metadata.resource_version
+                if rv == self.cluster_rvs[lane]:
+                    continue
+                cls, why = classify_change(self.clusters[lane], new)
+                if cls == STRUCTURAL:
+                    reason = why
+                    break
+                prev = changed.get(new.metadata.name)
+                if prev is None or _RANK[cls] > _RANK[prev]:
+                    changed[new.metadata.name] = cls
+        with obs.TRACER.span(obs.SPAN_RESIDENT_APPLY,
+                             clusters=len(clusters),
+                             structural=bool(reason),
+                             deltas=len(changed)):
+            if reason is not None:
+                self._reset(clusters, reason)
+            else:
+                self.clusters = clusters
+                self.cluster_rvs = [
+                    c.metadata.resource_version for c in clusters]
+                # the cycle's mini encodes / audits / big-tier sub-solves
+                # must read THIS snapshot's objects, not the adoption
+                # cycle's (capacity lives on the cluster objects)
+                self.cindex = tensors.ClusterIndex.build(clusters)
+                # per-cycle encoder-cache hygiene: placement-key pins hold
+                # the previous cycle's binding objects (id-keyed memo) and
+                # would grow without bound across a long-running plane
+                self.enc_cache.placement_keys = {}
+                if changed:
+                    self._apply(CycleDeltas(
+                        clusters=changed,
+                        binding_events=(deltas.binding_events
+                                        if deltas else 0)))
+            if deltas is not None:
+                for key in deltas.bindings_deleted:
+                    self.forget(f"{key[0]}/{key[1]}")
+        self.plugins_gen = _PLUGINS.generation
+
+    def _reset(self, clusters: List, reason: str) -> None:
+        """Drop to the lossless fallback: the next encode_cycle is one
+        full encode_batch whose tensors become the new masters."""
+        self.plane = None
+        self.cindex = tensors.ClusterIndex.build(clusters)
+        self.clusters = clusters
+        self.cluster_rvs = [c.metadata.resource_version for c in clusters]
+        self.names = [c.name for c in clusters]
+        self.nC = len(clusters)
+        self.C = tensors._next_pow2(max(self.nC, 1), 8)  # noqa: SLF001
+        self.res_names = []
+        self.class_keys = []
+        self.class_reqs = []
+        self.placements = []
+        self.pkeys = {}
+        self.gvk_keys = []
+        self.gvks = {}
+        self.region_names = []
+        self.label_axes = {}
+        self.enc_cache = tensors.EncoderCache()
+        self.rows = {}
+        self._free = []
+        self._next_slot = 0
+        self.Kp = 4
+        self.Ke = 4
+        self._fail_rows = {}
+        self._fail_plane = None
+        self._dirty = {}
+        self._device_primed = False
+        if self.device is not None:
+            # mirrors of the retired generation must not be scatter-based
+            self.device.np_refs = {}
+        self.generation += 1
+        RESIDENT_GENERATION.set(float(self.generation))
+        RESIDENT_REBUILDS.inc(reason=reason)
+        with self._stats_lock:
+            self.rebuilds[reason] = self.rebuilds.get(reason, 0) + 1
+
+    # -- delta application ---------------------------------------------------
+    def _apply(self, deltas: CycleDeltas) -> None:
+        cap_lanes: List[int] = []
+        api_lanes: List[int] = []
+        idx = self.cindex.index
+        by_lane: Dict[int, object] = {}
+        for i, c in enumerate(self.clusters):
+            by_lane[i] = c
+        for name, kind in deltas.clusters.items():
+            lane = idx.get(name)
+            if lane is None:
+                continue  # deleted and re-created within the window is
+                # membership drift; the names check in begin_cycle owns it
+            RESIDENT_DELTAS.inc(kind=kind)
+            if kind == CAPACITY:
+                cap_lanes.append(lane)
+            elif kind == API:
+                # an api change rides on a status write: refresh both
+                api_lanes.append(lane)
+                cap_lanes.append(lane)
+        if cap_lanes:
+            self._apply_capacity(sorted(set(cap_lanes)), by_lane)
+        if api_lanes:
+            self._apply_api(sorted(set(api_lanes)), by_lane)
+        with self._stats_lock:
+            self.last_deltas = {
+                "capacity": len(cap_lanes), "api": len(api_lanes),
+                "binding_events": deltas.binding_events,
+            }
+
+    def _apply_capacity(self, lanes: List[int],
+                        by_lane: Dict[int, object]) -> None:
+        """Recompute the churned clusters' capacity lanes — the identical
+        math encode_batch runs, restricted to `lanes` (bit-exactness is
+        the audit's contract)."""
+        plane = self.plane
+        txn = _Txn(plane)
+        deleting = txn.get("deleting")
+        has_summary = txn.get("has_summary")
+        pods_allowed = txn.get("pods_allowed")
+        avail_milli = txn.get("avail_milli")
+        has_alloc = txn.get("has_alloc")
+        est_override = txn.get("est_override") if self.class_keys else None
+        modeling = self.estimator.enable_resource_modeling
+        for lane in lanes:
+            c = by_lane[lane]
+            s = c.status.resource_summary
+            deleting[lane] = c.metadata.deleting
+            has_summary[lane] = s is not None
+            pods_allowed[lane] = tensors._allowed_pods(s) if s is not None \
+                else 0  # noqa: SLF001
+            avail_milli[lane, :] = 0
+            has_alloc[lane, :] = False
+            if s is not None:
+                for r, name in enumerate(self.res_names):
+                    alloc = s.allocatable.get(name)
+                    if alloc is None:
+                        continue
+                    has_alloc[lane, r] = True
+                    m = alloc.milli
+                    used = s.allocated.get(name)
+                    if used is not None:
+                        m -= used.milli
+                    ing = s.allocating.get(name)
+                    if ing is not None:
+                        m -= ing.milli
+                    avail_milli[lane, r] = m
+            if est_override is not None:
+                modeled = (modeling and s is not None
+                           and s.allocatable_modelings)
+                for q, rr in enumerate(self.class_reqs):
+                    if modeled and not isinstance(rr, tensors._SetClass):  # noqa: SLF001
+                        est_override[q, lane] = \
+                            self.estimator._max_for_cluster(c, rr)  # noqa: SLF001
+                    else:
+                        est_override[q, lane] = -1
+        changed = txn.commit()
+        lanes_arr = np.asarray(lanes, np.int64)
+        for f in changed:
+            self._mark_dirty(f, lanes_arr)
+        self._invalidate_enc_cache()
+
+    def _apply_api(self, lanes: List[int],
+                   by_lane: Dict[int, object]) -> None:
+        if not self.gvk_keys:
+            return
+        txn = _Txn(self.plane)
+        api_ok = txn.get("api_ok")
+        for lane in lanes:
+            c = by_lane[lane]
+            for g, (api_version, kind) in enumerate(self.gvk_keys):
+                api_ok[g, lane] = (
+                    c.api_enablement(api_version, kind) == serial.API_ENABLED)
+        for f in txn.commit():
+            self._mark_dirty(f, np.asarray(lanes, np.int64))
+        # gvk rows cached in the encoder are stale for these clusters
+        self.enc_cache.gvk_rows = {}
+        self._invalidate_enc_cache()
+
+    def _invalidate_enc_cache(self) -> None:
+        """Status-derived encoder-cache entries went stale: the next miss
+        encode must not reuse them.  pods_allowed re-points at the
+        (already updated) master so the O(C) rebuild is skipped."""
+        c = self.enc_cache
+        c.override_rows = {}
+        c.assembled = None
+        c.assembled_sig = None
+        c.pods_allowed = self.plane.pods_allowed if self.plane is not None \
+            else None
+
+    def _mark_dirty(self, field: str, lanes: Optional[np.ndarray]) -> None:
+        """Accumulate device-mirror dirtiness: lane-scatterable changes
+        merge their lane sets; anything else (or a second non-lane
+        change) escalates to a full re-place of that field."""
+        if self.device is None:
+            return
+        if lanes is None or (field not in ROW_SCATTER_FIELDS
+                             and field not in COL_SCATTER_FIELDS):
+            self._dirty[field] = None
+            return
+        prev = self._dirty.get(field, _MISSING)
+        if prev is _MISSING:
+            self._dirty[field] = lanes
+        elif prev is None:
+            pass  # already a full re-place
+        else:
+            self._dirty[field] = np.union1d(prev, lanes)
+        self._device_primed = False
+
+    # -- the per-cycle encoder -----------------------------------------------
+    def encode_cycle(self, items: Sequence,
+                     tokens: Optional[Sequence[Optional[RowToken]]] = None,
+                     explain: bool = False,
+                     audit: Optional[bool] = None) -> tensors.SolverBatch:
+        """Encode one cycle chunk: cached rows gather, churned rows
+        re-encode through encode_batch and merge.  Returns a SolverBatch
+        semantically identical to a fresh full encode (the audit's
+        bit-exact contract).  `audit` forces/suppresses the parity audit
+        for this call (None = cadence)."""
+        n = len(items)
+        assert self.cindex is not None, "begin_cycle() before encode_cycle()"
+        if self.plane is None:
+            # lossless fallback path: ONE full encode, adopted as masters
+            batch = tensors.encode_batch(items, self.cindex, self.estimator,
+                                         cache=self.enc_cache,
+                                         explain=explain)
+            self._adopt(batch, items, tokens)
+            RESIDENT_LOOKUPS.inc(n, result="miss")
+            with self._stats_lock:
+                self.misses += n
+            self._log_cycle(n, hits=0, misses=n, rebuilt=True)
+            self._sync_device()
+            return batch
+
+        slots = np.zeros(n, np.int64)
+        miss_pos: List[int] = []
+        hits = 0
+        for i in range(n):
+            tok = tokens[i] if tokens is not None else None
+            if tok is not None:
+                row = self.rows.get(tok.key)
+                if row is not None and row.rv == tok.rv:
+                    slots[i] = row.slot
+                    hits += 1
+                    continue
+            miss_pos.append(i)
+        with obs.TRACER.span(obs.SPAN_RESIDENT_ENCODE, items=n,
+                             hits=hits, misses=len(miss_pos)):
+            if miss_pos:
+                mini = tensors.encode_batch(
+                    [items[i] for i in miss_pos], self.cindex,
+                    self.estimator, cache=self.enc_cache)
+                self._merge(mini, miss_pos, tokens, slots)
+            batch = self._assemble(items, slots, n, explain)
+        RESIDENT_LOOKUPS.inc(hits, result="hit")
+        RESIDENT_LOOKUPS.inc(len(miss_pos), result="miss")
+        with self._stats_lock:
+            self.hits += hits
+            self.misses += len(miss_pos)
+        self._log_cycle(n, hits=hits, misses=len(miss_pos), rebuilt=False)
+        run_audit = (audit if audit is not None
+                     else (self.audit_interval > 0
+                           and self.cycles % self.audit_interval == 0))
+        if run_audit:
+            fresh = self.audit(items, batch, tokens, explain=explain)
+            if fresh is not None:
+                return fresh
+        self._sync_device()
+        return batch
+
+    def forget(self, key: str) -> None:
+        """Drop one binding's cached row (binding deleted)."""
+        row = self.rows.pop(key, None)
+        if row is not None:
+            self._free.append(row.slot)
+        RESIDENT_ROWS.set(float(len(self.rows)))
+
+    # -- adopt / merge / assemble --------------------------------------------
+    def _adopt(self, batch: tensors.SolverBatch, items: Sequence,
+               tokens: Optional[Sequence[Optional[RowToken]]]) -> None:
+        """Take a full encode's tensors as the new resident masters."""
+        n = batch.n_bindings
+        self.res_names = list(batch.res_names)
+        self.class_keys = list(batch.class_keys)
+        self.class_reqs = list(batch.class_reqs or [])
+        self.placements = list(batch.placements or [])
+        self.pkeys = {tensors._placement_key(p): i  # noqa: SLF001
+                      for i, p in enumerate(self.placements)}
+        self.gvk_keys = list(batch.gvk_keys or [])
+        self.gvks = {g: i for i, g in enumerate(self.gvk_keys)}
+        self.region_names = list(batch.region_names or [])
+        self.label_axes = dict(batch.label_axes or {})
+        self.Kp = batch.prev_idx.shape[1]
+        self.Ke = batch.evict_idx.shape[1]
+        cap = tensors._next_pow2(max(n, 64), 64)  # noqa: SLF001
+        placement_id = np.zeros(cap, np.int32)
+        gvk_id = np.zeros(cap, np.int32)
+        class_id = np.full(cap, -1, np.int32)
+        replicas = np.zeros(cap, np.int64)
+        uid_desc = np.zeros(cap, bool)
+        fresh = np.zeros(cap, bool)
+        non_workload = np.zeros(cap, bool)
+        nw_shortcut = np.zeros(cap, bool)
+        route = np.zeros(cap, np.int32)
+        prev_idx = np.full((cap, self.Kp), -1, np.int32)
+        prev_val = np.zeros((cap, self.Kp), np.int32)
+        evict_idx = np.full((cap, self.Ke), -1, np.int32)
+        placement_id[:n] = batch.placement_id[:n]
+        gvk_id[:n] = batch.gvk_id[:n]
+        class_id[:n] = batch.class_id[:n]
+        replicas[:n] = batch.replicas[:n]
+        uid_desc[:n] = batch.uid_desc[:n]
+        fresh[:n] = batch.fresh[:n]
+        non_workload[:n] = batch.non_workload[:n]
+        nw_shortcut[:n] = batch.nw_shortcut[:n]
+        route[:n] = batch.route[:n]
+        prev_idx[:n] = batch.prev_idx[:n]
+        prev_val[:n] = batch.prev_val[:n]
+        evict_idx[:n] = batch.evict_idx[:n]
+        self.plane = ResidentPlane(
+            cluster_valid=batch.cluster_valid, deleting=batch.deleting,
+            name_rank=batch.name_rank, pods_allowed=batch.pods_allowed,
+            has_summary=batch.has_summary, avail_milli=batch.avail_milli,
+            has_alloc=batch.has_alloc, api_ok=batch.api_ok,
+            req_milli=batch.req_milli, req_is_cpu=batch.req_is_cpu,
+            req_pods=batch.req_pods, est_override=batch.est_override,
+            pl_mask=batch.pl_mask, pl_tol_bypass=batch.pl_tol_bypass,
+            pl_strategy=batch.pl_strategy, pl_static_w=batch.pl_static_w,
+            pl_has_cluster_sc=batch.pl_has_cluster_sc,
+            pl_sc_min=batch.pl_sc_min, pl_sc_max=batch.pl_sc_max,
+            pl_ignore_avail=batch.pl_ignore_avail,
+            pl_extra_score=batch.pl_extra_score,
+            region_id=batch.region_id,
+            pl_has_region_sc=batch.pl_has_region_sc,
+            pl_region_min=batch.pl_region_min,
+            pl_region_max=batch.pl_region_max,
+            placement_id=placement_id, gvk_id=gvk_id, class_id=class_id,
+            replicas=replicas, uid_desc=uid_desc, fresh=fresh,
+            non_workload=non_workload, nw_shortcut=nw_shortcut, route=route,
+            prev_idx=prev_idx, prev_val=prev_val, evict_idx=evict_idx,
+        )
+        for f in (CLUSTER_SIDE_FIELDS + SHARED_EXTRA_FIELDS):
+            _freeze(getattr(self.plane, f))
+        self.rows = {}
+        self._free = []
+        self._next_slot = n
+        if tokens is not None:
+            for i in range(n):
+                tok = tokens[i]
+                if tok is not None:
+                    self.rows[tok.key] = _Row(i, tok.rv)
+        # slots of untokened rows are reusable immediately (their data was
+        # gathered into the returned batch already — it IS the batch)
+        if tokens is not None:
+            self._free.extend(i for i in range(n) if tokens[i] is None)
+        else:
+            self._free.extend(range(n))
+        self._dirty = {}  # fresh masters: full re-place on next sync
+        if self.device is not None:
+            self.device.np_refs = {}
+        self._update_vocab_gauges()
+
+    def _alloc_slots(self, k: int) -> np.ndarray:
+        out = np.empty(k, np.int64)
+        j = 0
+        while j < k and self._free:
+            out[j] = self._free.pop()
+            j += 1
+        if j < k:
+            need = self._next_slot + (k - j)
+            cap = self.plane.placement_id.shape[0]
+            if need > cap:
+                self._grow_rows(need)
+            out[j:] = np.arange(self._next_slot, need)
+            self._next_slot = need
+        return out
+
+    def _grow_rows(self, need: int) -> None:
+        cap = tensors._next_pow2(need, 64)  # noqa: SLF001
+        p = self.plane
+        for f in BINDING_SLOT_FIELDS + ("prev_idx", "prev_val", "evict_idx"):
+            old = getattr(p, f)
+            shape = (cap,) + old.shape[1:]
+            if f in ("prev_idx", "evict_idx"):
+                new = np.full(shape, -1, old.dtype)
+            else:
+                new = np.zeros(shape, old.dtype)
+            new[:old.shape[0]] = old
+            setattr(p, f, new)
+
+    def _widen_sparse(self, field: str, width: int) -> None:
+        p = self.plane
+        old = getattr(p, field)
+        fill = -1 if field in ("prev_idx", "evict_idx") else 0
+        new = np.full((old.shape[0], width), fill, old.dtype)
+        new[:, :old.shape[1]] = old
+        setattr(p, field, new)
+
+    def _merge(self, mini: tensors.SolverBatch, miss_pos: List[int],
+               tokens: Optional[Sequence[Optional[RowToken]]],
+               slots: np.ndarray) -> None:
+        """Fold a miss-subset encode into the resident state: vocabulary
+        entries append (translating new rows/columns out of the mini
+        batch), binding rows land in slots with remapped ids."""
+        nm = mini.n_bindings
+        # -- vocabulary translation maps -------------------------------------
+        rmap = np.zeros(max(len(mini.res_names), 1), np.int64)
+        for rm, name in enumerate(mini.res_names):
+            r = self._res_index(name, mini, rm)
+            rmap[rm] = r
+        pmap = np.zeros(max(len(mini.placements or []), 1), np.int32)
+        for pm, pl in enumerate(mini.placements or []):
+            pmap[pm] = self._placement_index(pl, mini, pm)
+        qmap = np.zeros(max(len(mini.class_keys), 1), np.int32)
+        for qm, key in enumerate(mini.class_keys):
+            qmap[qm] = self._class_index(key, mini, qm, rmap)
+        gmap = np.zeros(max(len(mini.gvk_keys or []), 1), np.int32)
+        for gm, gk in enumerate(mini.gvk_keys or []):
+            gmap[gm] = self._gvk_index(gk, mini, gm)
+        for lk, axis in (mini.label_axes or {}).items():
+            self.label_axes.setdefault(lk, axis)
+        # -- binding rows ----------------------------------------------------
+        if mini.prev_idx.shape[1] > self.Kp:
+            self.Kp = mini.prev_idx.shape[1]
+            self._widen_sparse("prev_idx", self.Kp)
+            self._widen_sparse("prev_val", self.Kp)
+        if mini.evict_idx.shape[1] > self.Ke:
+            self.Ke = mini.evict_idx.shape[1]
+            self._widen_sparse("evict_idx", self.Ke)
+        # reuse the slot of a key whose row went stale; allocate otherwise
+        mslots = np.empty(nm, np.int64)
+        fresh_needed: List[int] = []
+        for j, i in enumerate(miss_pos):
+            tok = tokens[i] if tokens is not None else None
+            row = self.rows.get(tok.key) if tok is not None else None
+            if row is not None:
+                mslots[j] = row.slot
+                row.rv = tok.rv
+            else:
+                fresh_needed.append(j)
+        if fresh_needed:
+            newly = self._alloc_slots(len(fresh_needed))
+            for k, j in enumerate(fresh_needed):
+                mslots[j] = newly[k]
+                tok = tokens[miss_pos[j]] if tokens is not None else None
+                if tok is not None:
+                    self.rows[tok.key] = _Row(int(newly[k]), tok.rv)
+                else:
+                    self._free.append(int(newly[k]))
+        p = self.plane
+        cid = mini.class_id[:nm]
+        p.placement_id[mslots] = pmap[mini.placement_id[:nm]]
+        p.gvk_id[mslots] = gmap[mini.gvk_id[:nm]]
+        p.class_id[mslots] = np.where(
+            cid >= 0, qmap[np.maximum(cid, 0)], -1).astype(np.int32)
+        p.replicas[mslots] = mini.replicas[:nm]
+        p.uid_desc[mslots] = mini.uid_desc[:nm]
+        p.fresh[mslots] = mini.fresh[:nm]
+        p.non_workload[mslots] = mini.non_workload[:nm]
+        p.nw_shortcut[mslots] = mini.nw_shortcut[:nm]
+        p.route[mslots] = mini.route[:nm]
+        kpm = mini.prev_idx.shape[1]
+        p.prev_idx[mslots, :] = -1
+        p.prev_val[mslots, :] = 0
+        p.prev_idx[mslots[:, None], np.arange(kpm)[None, :]] = \
+            mini.prev_idx[:nm]
+        p.prev_val[mslots[:, None], np.arange(kpm)[None, :]] = \
+            mini.prev_val[:nm]
+        kem = mini.evict_idx.shape[1]
+        p.evict_idx[mslots, :] = -1
+        p.evict_idx[mslots[:, None], np.arange(kem)[None, :]] = \
+            mini.evict_idx[:nm]
+        slots[miss_pos] = mslots
+        RESIDENT_ROWS.set(float(len(self.rows)))
+        self._update_vocab_gauges()
+
+    def _res_index(self, name: str, mini: tensors.SolverBatch,
+                   rm: int) -> int:
+        try:
+            return self.res_names.index(name)
+        except ValueError:
+            pass
+        r = len(self.res_names)
+        p = self.plane
+        R = p.avail_milli.shape[1]
+        txn = _Txn(p)
+        if r >= R:
+            R2 = R * 2
+            for f, fill in (("avail_milli", 0), ("has_alloc", False),
+                            ("req_milli", 0), ("req_is_cpu", False)):
+                old = getattr(p, f)
+                new = np.full((old.shape[0], R2) if old.ndim == 2 else (R2,),
+                              fill, old.dtype)
+                if old.ndim == 2:
+                    new[:, :R] = old
+                else:
+                    new[:R] = old
+                txn._w[f] = new  # noqa: SLF001 — txn adopts the grown copy
+        avail = txn.get("avail_milli")
+        alloc = txn.get("has_alloc")
+        is_cpu = txn.get("req_is_cpu")
+        avail[:, r] = mini.avail_milli[:, rm]
+        alloc[:, r] = mini.has_alloc[:, rm]
+        is_cpu[r] = mini.req_is_cpu[rm]
+        for f in txn.commit():
+            self._mark_dirty(f, None)
+        self.res_names.append(name)
+        return r
+
+    def _class_index(self, key, mini: tensors.SolverBatch, qm: int,
+                     rmap: np.ndarray) -> int:
+        for q, k in enumerate(self.class_keys):
+            if k == key:
+                return q
+        q = len(self.class_keys)
+        p = self.plane
+        Q = p.req_milli.shape[0]
+        txn = _Txn(p)
+        if q >= Q:
+            Q2 = Q * 2
+            for f, fill in (("req_milli", 0), ("req_pods", 1),
+                            ("est_override", -1)):
+                old = getattr(p, f)
+                new = np.full((Q2,) + old.shape[1:], fill, old.dtype)
+                new[:Q] = old
+                txn._w[f] = new  # noqa: SLF001
+        req_milli = txn.get("req_milli")
+        req_pods = txn.get("req_pods")
+        est_override = txn.get("est_override")
+        row = np.zeros(req_milli.shape[1], np.int64)
+        nR = len(mini.res_names)
+        row[rmap[:nR]] = mini.req_milli[qm, :nR]
+        req_milli[q] = row
+        req_pods[q] = mini.req_pods[qm]
+        est_override[q] = mini.est_override[qm]
+        for f in txn.commit():
+            self._mark_dirty(f, None)
+        self.class_keys.append(key)
+        reqs = mini.class_reqs or []
+        self.class_reqs.append(reqs[qm] if qm < len(reqs) else None)
+        return q
+
+    def _placement_index(self, pl, mini: tensors.SolverBatch,
+                         pm: int) -> int:
+        key = tensors._placement_key(pl)  # noqa: SLF001
+        pid = self.pkeys.get(key)
+        if pid is not None:
+            return pid
+        pid = len(self.placements)
+        p = self.plane
+        P = p.pl_strategy.shape[0]
+        txn = _Txn(p)
+        if pid >= P:
+            P2 = P * 2
+            for f in ("pl_mask", "pl_tol_bypass", "pl_strategy",
+                      "pl_static_w", "pl_has_cluster_sc", "pl_sc_min",
+                      "pl_sc_max", "pl_ignore_avail", "pl_extra_score",
+                      "pl_has_region_sc", "pl_region_min", "pl_region_max"):
+                old = getattr(p, f)
+                new = np.zeros((P2,) + old.shape[1:], old.dtype)
+                new[:P] = old
+                txn._w[f] = new  # noqa: SLF001
+        for f in ("pl_mask", "pl_tol_bypass", "pl_strategy", "pl_static_w",
+                  "pl_has_cluster_sc", "pl_sc_min", "pl_sc_max",
+                  "pl_ignore_avail", "pl_extra_score", "pl_has_region_sc",
+                  "pl_region_min", "pl_region_max"):
+            txn.get(f)[pid] = getattr(mini, f)[pm]
+        for f in txn.commit():
+            self._mark_dirty(f, None)
+        self.placements.append(pl)
+        self.pkeys[key] = pid
+        self._fail_plane = None  # the [P, C] explain plane grew
+        return pid
+
+    def _gvk_index(self, gk: Tuple[str, str], mini: tensors.SolverBatch,
+                   gm: int) -> int:
+        g = self.gvks.get(gk)
+        if g is not None:
+            return g
+        g = len(self.gvk_keys)
+        p = self.plane
+        G = p.api_ok.shape[0]
+        txn = _Txn(p)
+        if g >= G:
+            G2 = G * 2
+            old = p.api_ok
+            new = np.zeros((G2,) + old.shape[1:], old.dtype)
+            new[:G] = old
+            txn._w["api_ok"] = new  # noqa: SLF001
+        txn.get("api_ok")[g] = mini.api_ok[gm]
+        for f in txn.commit():
+            self._mark_dirty(f, None)
+        self.gvk_keys.append(gk)
+        self.gvks[gk] = g
+        return g
+
+    def _assemble(self, items: Sequence, slots: np.ndarray, n: int,
+                  explain: bool) -> tensors.SolverBatch:
+        p = self.plane
+        B = tensors._next_pow2(max(n, 1), 8)  # noqa: SLF001
+        placement_id = np.zeros(B, np.int32)
+        gvk_id = np.zeros(B, np.int32)
+        class_id = np.full(B, -1, np.int32)
+        replicas = np.zeros(B, np.int64)
+        uid_desc = np.zeros(B, bool)
+        fresh = np.zeros(B, bool)
+        non_workload = np.zeros(B, bool)
+        nw_shortcut = np.zeros(B, bool)
+        b_valid = np.zeros(B, bool)
+        prev_idx = np.full((B, self.Kp), -1, np.int32)
+        prev_val = np.zeros((B, self.Kp), np.int32)
+        evict_idx = np.full((B, self.Ke), -1, np.int32)
+        sl = slots[:n]
+        placement_id[:n] = p.placement_id[sl]
+        gvk_id[:n] = p.gvk_id[sl]
+        class_id[:n] = p.class_id[sl]
+        replicas[:n] = p.replicas[sl]
+        uid_desc[:n] = p.uid_desc[sl]
+        fresh[:n] = p.fresh[sl]
+        non_workload[:n] = p.non_workload[sl]
+        nw_shortcut[:n] = p.nw_shortcut[sl]
+        route = np.ascontiguousarray(p.route[sl], np.int32)
+        b_valid[:n] = route == _ROUTE_DEVICE
+        prev_idx[:n] = p.prev_idx[sl]
+        prev_val[:n] = p.prev_val[sl]
+        evict_idx[:n] = p.evict_idx[sl]
+        shared = {f: getattr(p, f)
+                  for f in CLUSTER_SIDE_FIELDS + SHARED_EXTRA_FIELDS}
+        fail_plane = self._ensure_fail_plane() if explain else None
+        batch = tensors._build_solver_batch(  # noqa: SLF001
+            shared, B, self.C, n, self.nC, b_valid, placement_id, gvk_id,
+            class_id, replicas, uid_desc, fresh, non_workload, nw_shortcut,
+            prev_idx, prev_val, evict_idx, route, self.cindex,
+            list(self.region_names), list(self.res_names),
+            list(self.class_keys), dict(self.label_axes), explain,
+            fail_plane)
+        batch.placements = list(self.placements)
+        batch.gvk_keys = list(self.gvk_keys)
+        batch.class_reqs = list(self.class_reqs)
+        return batch
+
+    def _ensure_fail_plane(self) -> np.ndarray:
+        """The [P, C] explain fail-bit plane over the resident placement
+        vocabulary (obs/decisions layout), cached until placements or the
+        cluster plane change structurally."""
+        P = self.plane.pl_strategy.shape[0]
+        sig = (self.generation, len(self.placements), P)
+        if self._fail_plane is not None and self._fail_plane[0] == sig:
+            return self._fail_plane[1]
+        from karmada_tpu.scheduler.plugins import REGISTRY as _PLUGINS
+
+        plug_filters = _PLUGINS.enabled_filters()
+        dummy = ResourceBindingStatus()
+        plane = np.zeros((P, self.C), np.int32)
+        for pid, pl in enumerate(self.placements):
+            fb = self._fail_rows.get(pid)
+            if fb is None:
+                fb = tensors._fail_row(pl, self.clusters, self.C,  # noqa: SLF001
+                                       plug_filters, dummy)
+                self._fail_rows[pid] = fb
+            plane[pid] = fb
+        _freeze(plane)
+        self._fail_plane = (sig, plane)
+        return plane
+
+    # -- audit ---------------------------------------------------------------
+    def audit(self, items: Sequence, batch: tensors.SolverBatch,
+              tokens: Optional[Sequence[Optional[RowToken]]] = None,
+              explain: bool = False) -> Optional[tensors.SolverBatch]:
+        """Re-encode `items` from scratch and compare bit-exact against
+        the resident batch.  On mismatch: count it, force a rebuild, and
+        return the fresh batch (which the caller must serve — `explain`
+        must match the audited batch's arming so the served batch keeps
+        its explain planes); on parity returns None."""
+        with obs.TRACER.span(obs.SPAN_RESIDENT_AUDIT, items=len(items)):
+            fresh = tensors.encode_batch(items, self.cindex, self.estimator,
+                                         explain=explain)
+            mismatches = compare_batches(batch, fresh)
+        outcome = "mismatch" if mismatches else "ok"
+        RESIDENT_AUDITS.inc(outcome=outcome)
+        with self._stats_lock:
+            if mismatches:
+                self.audit_mismatches += 1
+            else:
+                self.audits_ok += 1
+            self.last_audit = {"cycle": self.cycles, "outcome": outcome,
+                               "fields": mismatches[:8],
+                               "ts": time.time()}
+        if not mismatches:
+            return None
+        self._reset(self.clusters, "audit-mismatch")
+        # adopt the fresh encode so the plane is resident again next cycle
+        self._adopt(fresh, items, tokens)
+        self._log_cycle(len(items), hits=0, misses=len(items), rebuilt=True)
+        self._sync_device()
+        return fresh
+
+    # -- device plane --------------------------------------------------------
+    def _sync_device(self) -> None:
+        if self.device is None or self.plane is None:
+            return
+        if self._device_primed and not self._dirty:
+            return
+        primed = self.device.sync(self.plane, self._dirty)
+        self._dirty = {}
+        self._device_primed = primed
+
+    # -- introspection -------------------------------------------------------
+    def _log_cycle(self, n: int, hits: int, misses: int,
+                   rebuilt: bool) -> None:
+        with self._stats_lock:
+            self.cycle_log.append({"cycle": self.cycles, "items": n,
+                                   "hits": hits, "misses": misses,
+                                   "rebuilt": rebuilt})
+
+    def _update_vocab_gauges(self) -> None:
+        RESIDENT_VOCAB.set(float(self.nC), axis="clusters")
+        RESIDENT_VOCAB.set(float(len(self.placements)), axis="placements")
+        RESIDENT_VOCAB.set(float(len(self.class_keys)), axis="classes")
+        RESIDENT_VOCAB.set(float(len(self.res_names)), axis="resources")
+        RESIDENT_VOCAB.set(float(len(self.gvk_keys)), axis="gvks")
+        RESIDENT_ROWS.set(float(len(self.rows)))
+
+    def stats(self) -> dict:
+        """Stats payload for /debug/resident, /debug/state and the SOAK
+        report.  The counter fields are read under their lock; the plane
+        fields (generation, vocab sizes, rows) belong to the scheduler's
+        cycle thread, so a poll racing a rebuild may pair a fresh
+        generation with the retiring vocabulary for one read —
+        diagnostics-only, never consulted by the solve path."""
+        with self._stats_lock:
+            hits, misses = self.hits, self.misses
+            total = hits + misses
+            out = {
+                "enabled": True,
+                "generation": self.generation,
+                "resident": self.plane is not None,
+                "cycles": self.cycles,
+                "vocab": {
+                    "clusters": self.nC,
+                    "placements": len(self.placements),
+                    "classes": len(self.class_keys),
+                    "resources": len(self.res_names),
+                    "gvks": len(self.gvk_keys),
+                    "cluster_lanes": self.C,
+                },
+                "rows_cached": len(self.rows),
+                "row_hits": hits,
+                "row_misses": misses,
+                "hit_rate": round(hits / total, 4) if total else None,
+                "rebuilds": dict(self.rebuilds),
+                "audits": {"ok": self.audits_ok,
+                           "mismatch": self.audit_mismatches},
+                "last_audit": self.last_audit,
+                "last_deltas": self.last_deltas,
+                "device_plane": (self.device is not None
+                                 and not self.device.broken),
+                "device_primed": self._device_primed,
+            }
+        return out
+
+    def recent_cycles(self, limit: int = 64) -> List[dict]:
+        with self._stats_lock:
+            log = list(self.cycle_log)
+        return log[-limit:]
+
+
+class _Missing:
+    pass
+
+
+_MISSING = _Missing()
+
+
+# -- bit-exact comparison -----------------------------------------------------
+def compare_batches(resident: tensors.SolverBatch,
+                    fresh: tensors.SolverBatch) -> List[str]:
+    """Vocabulary-mapped bit-exact comparison of a resident batch against
+    a fresh full encode of the same (items, clusters).
+
+    The resident axes may be larger (retired vocabulary entries, padded
+    growth); every value the solve can READ must match: cluster lanes,
+    per-key placement/class/gvk/resource rows, and per-binding fields
+    with ids mapped through the key spaces.  Returns the mismatching
+    field names ([] = parity)."""
+    errs: List[str] = []
+
+    def chk(name: str, a, b) -> None:
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            errs.append(name)
+
+    if (resident.n_clusters, resident.C) != (fresh.n_clusters, fresh.C):
+        return ["cluster-axis-shape"]
+    if resident.n_bindings != fresh.n_bindings:
+        return ["binding-count"]
+    nB = fresh.n_bindings
+    for f in ("cluster_valid", "deleting", "name_rank", "pods_allowed",
+              "has_summary", "region_id"):
+        chk(f, getattr(resident, f), getattr(fresh, f))
+    chk("region_names", np.asarray(resident.region_names or [], object),
+        np.asarray(fresh.region_names or [], object))
+    # resources (by name)
+    try:
+        rmap = [resident.res_names.index(nm) for nm in fresh.res_names]
+    except ValueError:
+        return errs + ["resource-vocab"]
+    for rm, r in enumerate(rmap):
+        chk(f"avail_milli[{fresh.res_names[rm]}]",
+            resident.avail_milli[:, r], fresh.avail_milli[:, rm])
+        chk(f"has_alloc[{fresh.res_names[rm]}]",
+            resident.has_alloc[:, r], fresh.has_alloc[:, rm])
+        chk(f"req_is_cpu[{fresh.res_names[rm]}]",
+            resident.req_is_cpu[r], fresh.req_is_cpu[rm])
+    # classes (by canonical key)
+    qmap: List[int] = []
+    for key in fresh.class_keys:
+        try:
+            qmap.append(resident.class_keys.index(key))
+        except ValueError:
+            return errs + ["class-vocab"]
+    for qm, q in enumerate(qmap):
+        chk(f"req_milli[q{qm}]",
+            resident.req_milli[q][rmap], fresh.req_milli[qm,
+                                                         :len(rmap)])
+        chk(f"req_pods[q{qm}]", resident.req_pods[q], fresh.req_pods[qm])
+        chk(f"est_override[q{qm}]",
+            resident.est_override[q], fresh.est_override[qm])
+    # placements (by key)
+    pmap: List[int] = []
+    res_pk = {tensors._placement_key(p): i  # noqa: SLF001
+              for i, p in enumerate(resident.placements or [])}
+    for pl in (fresh.placements or []):
+        pid = res_pk.get(tensors._placement_key(pl))  # noqa: SLF001
+        if pid is None:
+            return errs + ["placement-vocab"]
+        pmap.append(pid)
+    for pm, pid in enumerate(pmap):
+        for f in ("pl_mask", "pl_tol_bypass", "pl_strategy", "pl_static_w",
+                  "pl_has_cluster_sc", "pl_sc_min", "pl_sc_max",
+                  "pl_ignore_avail", "pl_extra_score", "pl_has_region_sc",
+                  "pl_region_min", "pl_region_max"):
+            chk(f"{f}[p{pm}]", getattr(resident, f)[pid],
+                getattr(fresh, f)[pm])
+    # gvks (by key)
+    gmap: List[int] = []
+    res_gk = {g: i for i, g in enumerate(resident.gvk_keys or [])}
+    for gk in (fresh.gvk_keys or []):
+        g = res_gk.get(gk)
+        if g is None:
+            return errs + ["gvk-vocab"]
+        gmap.append(g)
+    for gm, g in enumerate(gmap):
+        chk(f"api_ok[{fresh.gvk_keys[gm]}]",
+            resident.api_ok[g], fresh.api_ok[gm])
+    if nB == 0:
+        return errs
+    # per-binding fields
+    for f in ("replicas", "uid_desc", "fresh", "non_workload",
+              "nw_shortcut", "b_valid"):
+        chk(f, getattr(resident, f)[:nB], getattr(fresh, f)[:nB])
+    chk("route", resident.route[:nB], fresh.route[:nB])
+    pmap_arr = np.asarray(pmap or [0], np.int32)
+    chk("placement_id", resident.placement_id[:nB],
+        pmap_arr[fresh.placement_id[:nB]])
+    gmap_arr = np.asarray(gmap or [0], np.int32)
+    chk("gvk_id", resident.gvk_id[:nB], gmap_arr[fresh.gvk_id[:nB]])
+    qmap_arr = np.asarray(qmap or [0], np.int32)
+    cid = fresh.class_id[:nB]
+    chk("class_id", resident.class_id[:nB],
+        np.where(cid >= 0, qmap_arr[np.maximum(cid, 0)], -1))
+    ra = _canon_sparse(resident.prev_idx[:nB], resident.prev_val[:nB])
+    fa = _canon_sparse(fresh.prev_idx[:nB], fresh.prev_val[:nB])
+    if not (np.array_equal(ra[0], fa[0]) and np.array_equal(ra[1], fa[1])):
+        errs.append("prev_assignment")
+    re_ = _canon_sparse(resident.evict_idx[:nB])
+    fe = _canon_sparse(fresh.evict_idx[:nB])
+    if not np.array_equal(re_[0], fe[0]):
+        errs.append("evict_entries")
+    return errs
+
+
+def _canon_sparse(idx: np.ndarray, val: Optional[np.ndarray] = None):
+    """Canonicalize a sparse (idx [B, K], val [B, K]) plane for
+    comparison across differing pad widths: rows sorted by lane with -1
+    padding last, trimmed to the widest real entry count."""
+    idx = np.asarray(idx)
+    key = np.where(idx >= 0, idx.astype(np.int64), np.int64(1) << 40)
+    order = np.argsort(key, axis=1, kind="stable")
+    idx_s = np.take_along_axis(idx, order, axis=1)
+    widths = (idx_s >= 0).sum(axis=1)
+    w = int(widths.max()) if idx_s.size else 0
+    idx_s = idx_s[:, :max(w, 1)]
+    if val is None:
+        return (idx_s, None)
+    val = np.take_along_axis(np.asarray(val), order, axis=1)[:, :max(w, 1)]
+    # val is meaningful only where idx >= 0
+    val = np.where(idx_s >= 0, val, 0)
+    return (idx_s, val)

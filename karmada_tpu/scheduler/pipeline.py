@@ -335,6 +335,7 @@ def run_pipeline(
     diagnose: bool = True,
     explain: Optional["obs_decisions.DecisionRecorder"] = None,
     keys: Optional[Sequence[str]] = None,
+    encode: Optional[Callable[[Sequence, int, bool], object]] = None,
 ) -> PipelineResult:
     """Schedule `items` (a cycle of (spec, status) pairs) through the
     pipelined chunk executor.  Returns a PipelineResult whose `results`
@@ -370,6 +371,12 @@ def run_pipeline(
       leaves every jit signature and transfer byte-identical to today.
     keys: per-item binding identities ("namespace/name") for the decision
       records; derived from each spec's workload reference when omitted.
+    encode: chunk encoder override `encode(part, offset, explain) ->
+      SolverBatch` — the resident-state plane (karmada_tpu/resident)
+      substitutes its gather-plus-miss-re-encode here; the default is a
+      plain tensors.encode_batch against `cindex`/`cache`.  The returned
+      batch must be semantically identical to a fresh full encode (the
+      resident plane's parity audit enforces exactly that contract).
     """
     from karmada_tpu.ops.solver import (
         dispatch_compact,
@@ -607,8 +614,9 @@ def run_pipeline(
                                             index=ci, offset=lo,
                                             n=len(part))
                 enc_span = tracer.start_span(obs.SPAN_ENCODE, parent=ch_span)
-            batch = tensors.encode_batch(part, cindex, estimator,
-                                         cache=cache, explain=armed)
+            batch = (encode(part, lo, armed) if encode is not None
+                     else tensors.encode_batch(part, cindex, estimator,
+                                               cache=cache, explain=armed))
             t1 = time.perf_counter()
             if enc_span is not None:
                 enc_span.end()

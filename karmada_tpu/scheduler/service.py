@@ -127,6 +127,17 @@ class Scheduler:
         # the deadline.  Inert unless batch_deadline_s is set.
         overload_enter_factor: float = 2.0,
         overload_deadline_factor: float = 4.0,
+        # resident-state plane (karmada_tpu/resident, serve --resident):
+        # keep the cluster-side solver tensors (and their device mirrors)
+        # resident BETWEEN cycles, advanced by coalesced watch-event
+        # deltas, and gather cached per-binding encoded rows so a
+        # steady-state cycle re-encodes only churned bindings.  Device
+        # backend only — the native/serial backends never build
+        # SolverBatches.  resident_audit_interval: every Nth cycle
+        # re-encodes from scratch and compares bit-exact (mismatch =>
+        # metric + forced rebuild); 0 disables the cadence.
+        resident: bool = False,
+        resident_audit_interval: int = 64,
     ) -> None:
         self.elector = elector
         if elector is not None:
@@ -208,6 +219,20 @@ class Scheduler:
         self.queue = (queue if queue is not None
                       else SchedulingQueue(max_resident=admission_limit))
         self._native_snap = None  # (clusters list, NativeSnapshot)
+        self._resident = None
+        self._delta_tracker = None
+        if resident and backend == "device":
+            from karmada_tpu import resident as resident_mod
+            from karmada_tpu.resident import DeltaTracker, ResidentState
+
+            self._resident = ResidentState(
+                estimator=self._general,
+                audit_interval=resident_audit_interval)
+            self._delta_tracker = DeltaTracker()
+            # the tracker taps the same watch bus the scheduler does; its
+            # coalesced window drains at each device cycle's begin_cycle
+            store.bus.subscribe(self._delta_tracker.on_event)
+            resident_mod.set_active(self._resident)
         if backend == "native":
             # warm the g++ build at startup so the first scheduling cycle
             # never blocks on a synchronous compile
@@ -501,6 +526,11 @@ class Scheduler:
         if more:
             self.worker.enqueue(_CYCLE)
 
+    def resident_state(self) -> Optional[Dict[str, object]]:
+        """The resident-state plane's stats snapshot, or None when the
+        plane is not armed (serves /debug/state and the SOAK report)."""
+        return self._resident.stats() if self._resident is not None else None
+
     def queue_state(self) -> Dict[str, object]:
         """One consistent snapshot of the scheduling-queue state — depths,
         per-queue oldest-resident age, unschedulable reasons — plus the
@@ -534,6 +564,23 @@ class Scheduler:
         # round of a sampled cycle records, so a failover story is whole)
         explain_rec = self._explain_sample()
         keys_all = [f"{rb.namespace}/{rb.name}" for rb in bindings]
+        tokens_all = None
+        if self._resident is not None:
+            from karmada_tpu.resident import RowToken
+
+            tokens_all = []
+            for rb, key in zip(bindings, keys_all):
+                terms = (rb.spec.placement.cluster_affinities
+                         if rb.spec.placement else [])
+                # any write to the binding (spec or status) bumps its
+                # resourceVersion, so (key, rv) is exactly the encoded
+                # row's identity; affinity-failover bindings encode
+                # against a per-round synthesized status (observed
+                # affinity name), so their rows are not snapshot-
+                # addressable and bypass the row cache
+                tokens_all.append(
+                    None if terms
+                    else RowToken(key, rb.metadata.resource_version))
 
         while active:
             items: List[Tuple[ResourceBindingSpec, ResourceBindingStatus]] = []
@@ -548,7 +595,10 @@ class Scheduler:
 
             outcome = self._solve(items, clusters,
                                   keys=[keys_all[i] for i, _ in active],
-                                  explain=explain_rec)
+                                  explain=explain_rec,
+                                  tokens=([tokens_all[i] for i, _ in active]
+                                          if tokens_all is not None
+                                          else None))
 
             next_active: List[Tuple[int, ResourceBinding]] = []
             for (i, rb), res in zip(active, outcome):
@@ -691,6 +741,7 @@ class Scheduler:
         cancelled: Optional[threading.Event] = None,
         keys: Optional[List[str]] = None,
         explain=None,
+        tokens=None,
     ) -> Dict[int, object]:
         """backend="device": one batched cycle through the pipelined chunk
         executor (scheduler/pipeline.py — the same loop bench.py measures).
@@ -717,8 +768,29 @@ class Scheduler:
         from karmada_tpu.scheduler import pipeline
 
         self._ensure_mesh()
-        cindex = tensors.ClusterIndex.build(clusters)
-        cache = self._encoder_cache(clusters)
+        encode = None
+        if self._resident is not None:
+            # resident-state plane: advance the persistent tensors by this
+            # window's coalesced watch deltas (or rebuild losslessly on a
+            # structural change), then hand the pipeline an encoder that
+            # gathers cached rows and re-encodes only the misses.  The
+            # plane's own EncoderCache/ClusterIndex replace the per-cycle
+            # ones — its invalidation is delta-precise where
+            # _encoder_cache's is signature-coarse.
+            state = self._resident
+            state.begin_cycle(
+                clusters, self._delta_tracker.drain()
+                if self._delta_tracker is not None else None)
+            cindex = state.cindex
+            cache = state.enc_cache
+            toks = tokens if tokens is not None else [None] * len(items)
+
+            def encode(part, offset, armed):  # noqa: F811 — the hook
+                return state.encode_cycle(
+                    part, toks[offset:offset + len(part)], explain=armed)
+        else:
+            cindex = tensors.ClusterIndex.build(clusters)
+            cache = self._encoder_cache(clusters)
         carry = len(items) > self.pipeline_chunk
         res = pipeline.run_pipeline(
             items, cindex, self._general,
@@ -737,7 +809,7 @@ class Scheduler:
             enable_empty_workload_propagation=(
                 self.enable_empty_workload_propagation),
             cancelled=cancelled,
-            explain=explain, keys=keys,
+            explain=explain, keys=keys, encode=encode,
         )
         return res.results
 
@@ -778,6 +850,7 @@ class Scheduler:
         clusters: List[Cluster],
         keys: Optional[List[str]] = None,
         explain=None,
+        tokens=None,
     ) -> Dict[int, object]:
         """Run the device cycle under the mid-serve death guard: a cycle
         exceeding device_cycle_timeout_s is abandoned on its daemon thread
@@ -787,7 +860,7 @@ class Scheduler:
         accelerator tunnel died under it."""
         if self.device_cycle_timeout_s is None:
             return self._solve_device(items, clusters, keys=keys,
-                                      explain=explain)
+                                      explain=explain, tokens=tokens)
         box: Dict[str, object] = {}
         cancelled = threading.Event()
         # thread handoff: the daemon thread adopts this (worker) thread's
@@ -801,7 +874,8 @@ class Scheduler:
                     box["res"] = self._solve_device(items, clusters,
                                                     cancelled=cancelled,
                                                     keys=keys,
-                                                    explain=explain)
+                                                    explain=explain,
+                                                    tokens=tokens)
             except Exception as e:  # noqa: BLE001 — re-raised on the caller
                 box["err"] = e
 
@@ -825,6 +899,18 @@ class Scheduler:
             # cycles must never share it
             self._enc_cache = None
             self._enc_spec_sig = None
+            if self._resident is not None:
+                # the device backend is gone and the zombie may still be
+                # mid-encode inside the plane: detach it (the degraded
+                # backends never build SolverBatches) and stop reporting
+                # a resident plane at /debug/resident
+                from karmada_tpu import resident as resident_mod
+
+                if self._delta_tracker is not None:
+                    self.store.bus.unsubscribe(self._delta_tracker.on_event)
+                self._resident = None
+                self._delta_tracker = None
+                resident_mod.set_active(None)
             if self.mesh_plan is not None:
                 # the device backend is gone: stop reporting an active
                 # solver mesh (/debug/state, karmada_mesh_* gauges)
@@ -853,6 +939,7 @@ class Scheduler:
         clusters: List[Cluster],
         keys: Optional[List[str]] = None,
         explain=None,
+        tokens=None,
     ) -> List[object]:
         """Returns per item either List[TargetCluster] or an Exception."""
         cal = serial.make_cal_available(self.estimators)
@@ -860,7 +947,8 @@ class Scheduler:
         device_idx: List[int] = []
         if self.backend == "device" and items:
             solved = self._solve_device_guarded(items, clusters,
-                                                keys=keys, explain=explain)
+                                                keys=keys, explain=explain,
+                                                tokens=tokens)
             for i, res in solved.items():
                 out[i] = res
             device_idx = list(solved.keys())

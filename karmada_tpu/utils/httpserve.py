@@ -23,6 +23,11 @@ ThreadingHTTPServer serves:
                          progress, admission/shed counts, queue depths
                          and oldest-resident ages; {"enabled": false}
                          when no driver is active
+    /debug/resident      resident-state plane (karmada_tpu/resident,
+                         armed by `serve --resident`): generation, vocab
+                         sizes, row-cache hit rate, delta depth, audit
+                         outcomes (?recent=N adds per-cycle records);
+                         {"enabled": false} when rebuild-per-cycle
 
 The trace endpoints read the process-wide tracer (karmada_tpu.obs.TRACER,
 armed by `karmadactl serve --trace-buffer N`) unless an explicit recorder
@@ -75,6 +80,7 @@ class ObservabilityServer:
         return decisions.recorder()  # None while the explain plane is off
 
     def _state(self) -> dict:
+        from karmada_tpu import resident
         from karmada_tpu.ops import meshing
         from karmada_tpu.utils import deviceprobe
 
@@ -88,6 +94,10 @@ class ObservabilityServer:
                 # count, platform — {"enabled": false} on the
                 # single-device fallback; never initialises a backend
                 "mesh": meshing.mesh_info(),
+                # the resident-state plane (karmada_tpu/resident):
+                # generation, vocab sizes, row-cache hit rate, last audit
+                # — {"enabled": false} when running rebuild-per-cycle
+                "resident": resident.state_payload(),
                 "traces": rec.stats() if rec is not None else None,
                 "explain": dec.stats() if dec is not None else None}
 
@@ -180,6 +190,18 @@ class ObservabilityServer:
             from karmada_tpu.loadgen import driver as loadgen_driver
 
             return (json.dumps(loadgen_driver.load_state()).encode(),
+                    "application/json", 200)
+        if path == "/debug/resident":
+            from karmada_tpu import resident
+
+            recent = 0
+            for part in (query or "").split("&"):
+                if part.startswith("recent="):
+                    try:
+                        recent = max(0, int(part[len("recent="):]))
+                    except ValueError:
+                        pass
+            return (json.dumps(resident.state_payload(recent)).encode(),
                     "application/json", 200)
         if path == "/debug/explain":
             return (json.dumps(self._explain_payload()).encode(),

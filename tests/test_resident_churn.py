@@ -1,0 +1,287 @@
+"""Resident-state plane under churn (karmada_tpu/resident).
+
+Two layers of the same property — the delta path re-encodes EXACTLY the
+churned rows and the resident tensors stay bit-exact with a from-scratch
+encode:
+
+  * a direct unit property over ResidentState: per-cycle miss count ==
+    churned-binding count, hit count == unchanged count, closing audit
+    bit-exact (the tentpole's core contract);
+  * the REAL loadgen `churn` scenario (compressed virtual time) driven
+    through a device-backend ServeSlice with the resident plane armed: a
+    spy derives each encode call's expected miss count from the pre-call
+    cache state, so any spurious invalidation (re-encoding an unchanged
+    row) or stale reuse (serving a churned row from cache) fails loudly.
+    Kill/revive (structural membership churn) and capacity flaps ride
+    the same run; the parity audit runs every other cycle throughout.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import random
+
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.loadgen import (
+    LoadDriver,
+    ServeSlice,
+    ServiceModel,
+    VirtualClock,
+    get_scenario,
+)
+from karmada_tpu.loadgen.scenarios import ClusterEventSpec
+from karmada_tpu.models.cluster import (
+    Cluster,
+    ClusterSpec,
+    ClusterStatus,
+    ResourceSummary,
+)
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import Placement, ReplicaSchedulingStrategy
+from karmada_tpu.models.work import (
+    ObjectReference,
+    ReplicaRequirements,
+    ResourceBindingSpec,
+    ResourceBindingStatus,
+)
+from karmada_tpu.ops import tensors
+from karmada_tpu.resident import ResidentState, RowToken, compare_batches
+from karmada_tpu.utils.quantity import Quantity
+
+GVK = ("apps/v1", "Deployment")
+
+
+# -- unit-property builders (token-addressable: no affinity terms) -----------
+def mk_cluster(i: int) -> Cluster:
+    return Cluster(
+        metadata=ObjectMeta(name=f"rc-m{i:02d}", resource_version=1),
+        spec=ClusterSpec(region="us" if i % 2 else "eu"),
+        status=ClusterStatus(resource_summary=ResourceSummary(
+            allocatable={
+                "cpu": Quantity.from_milli(32000 + 1000 * i),
+                "memory": Quantity.from_units(64),
+                "pods": Quantity.from_units(110),
+            },
+            allocated={"cpu": Quantity.from_milli(100 * i)},
+        )),
+    )
+
+
+def mk_item(b: int, replicas: int = 2):
+    spec = ResourceBindingSpec(
+        resource=ObjectReference(
+            api_version=GVK[0], kind=GVK[1], namespace="default",
+            name=f"app-{b}", uid=f"uid-{b}",
+        ),
+        replicas=replicas,
+        replica_requirements=ReplicaRequirements(resource_request={
+            "cpu": Quantity.from_milli(250 if b % 3 else 500),
+        }),
+        placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type="Divided",
+            replica_division_preference="Weighted",
+        )),
+    )
+    return spec, ResourceBindingStatus()
+
+
+def test_resident_reencodes_exactly_the_churned_rows():
+    """Adopt a fleet, then churn random subsets for several cycles: every
+    cycle's miss count must equal the churned-binding count, hits the
+    rest, with capacity churn on clusters riding the scatter path (no
+    rebuild) — and the closing forced audit must be bit-exact."""
+    n, nc = 48, 12
+    rng = random.Random(7)
+    clusters = [mk_cluster(i) for i in range(nc)]
+    items = [mk_item(b) for b in range(n)]
+    rvs = [1] * n
+    state = ResidentState(estimator=GeneralEstimator(), audit_interval=0)
+
+    def tokens():
+        return [RowToken(f"rc/{b}", rvs[b]) for b in range(n)]
+
+    state.begin_cycle(clusters)
+    state.encode_cycle(items, tokens())  # adoption cycle: all misses
+    assert state.misses == n and state.hits == 0
+    assert len(state.rows) == n
+
+    for cycle in range(5):
+        k = rng.randint(1, n // 2)
+        churned = rng.sample(range(n), k)
+        for b in churned:
+            spec, status = items[b]
+            items[b] = (dataclasses.replace(spec, replicas=spec.replicas + 1),
+                        status)
+            rvs[b] += 1
+        # capacity churn on a couple of clusters: status-only => the rv
+        # sweep must scatter these lanes, never rebuild
+        for lane in rng.sample(range(nc), 2):
+            c = copy.deepcopy(clusters[lane])
+            c.metadata.resource_version += 1
+            rs = c.status.resource_summary
+            rs.allocated["cpu"] = Quantity.from_milli(
+                rs.allocated["cpu"].milli_value() + 50)
+            clusters[lane] = c
+        h0, m0 = state.hits, state.misses
+        state.begin_cycle(clusters)
+        batch = state.encode_cycle(items, tokens())
+        assert state.misses - m0 == k, f"cycle {cycle}: re-encoded " \
+            f"{state.misses - m0} rows for {k} churned bindings"
+        assert state.hits - h0 == n - k
+        assert batch.n_bindings == n
+
+    st = state.stats()
+    assert st["rebuilds"] == {"init": 1}, \
+        f"capacity churn must not rebuild: {st['rebuilds']}"
+
+    # closing audit: the resident batch vs a from-scratch encode, bit-exact
+    state.begin_cycle(clusters)
+    state.encode_cycle(items, tokens(), audit=True)
+    st = state.stats()
+    assert st["audits"] == {"ok": 1, "mismatch": 0}, st["last_audit"]
+
+    # direct bit-exact check too (independent of the audit plumbing)
+    state.begin_cycle(clusters)
+    resident_batch = state.encode_cycle(items, tokens())
+    fresh = tensors.encode_batch(items, tensors.ClusterIndex.build(clusters),
+                                 state.estimator)
+    assert compare_batches(resident_batch, fresh) == []
+
+    # binding deletion: forget() must drop the row so the next encounter
+    # is a miss, not a stale hit
+    state.forget("rc/0")
+    h0, m0 = state.hits, state.misses
+    state.begin_cycle(clusters)
+    state.encode_cycle(items, tokens())
+    assert state.misses - m0 == 1 and state.hits - h0 == n - 1
+
+
+def test_resident_structural_churn_falls_back_losslessly():
+    """Cluster membership churn (kill then revive) is structural: the
+    plane must rebuild, stay correct, and the next steady cycle must be
+    resident again (all hits)."""
+    n, nc = 24, 8
+    clusters = [mk_cluster(i) for i in range(nc)]
+    items = [mk_item(b) for b in range(n)]
+    toks = [RowToken(f"rs/{b}", 1) for b in range(n)]
+    state = ResidentState(estimator=GeneralEstimator(), audit_interval=0)
+
+    state.begin_cycle(clusters)
+    state.encode_cycle(items, toks)
+    killed = clusters.pop(3)  # membership change => structural
+    state.begin_cycle(clusters)
+    batch = state.encode_cycle(items, toks)
+    st = state.stats()
+    assert st["generation"] >= 1 and sum(st["rebuilds"].values()) >= 2
+    fresh = tensors.encode_batch(items, tensors.ClusterIndex.build(clusters),
+                                 state.estimator)
+    assert compare_batches(batch, fresh) == []
+
+    clusters.insert(3, killed)  # revive => structural again
+    state.begin_cycle(clusters)
+    state.encode_cycle(items, toks)
+    # steady state after the rebuilds: pure hits
+    h0, m0 = state.hits, state.misses
+    state.begin_cycle(clusters)
+    state.encode_cycle(items, toks, audit=True)
+    assert state.misses == m0 and state.hits - h0 == n
+    assert state.stats()["audits"]["mismatch"] == 0
+
+
+# -- loadgen churn scenario through the real device scheduler ----------------
+def attach_exactness_spy(state: ResidentState):
+    """Wrap state.encode_cycle: before each call, derive the expected
+    miss count from the pre-call cache (token absent/changed, or no token
+    at all => re-encode; resident row at the same rv => hit), then check
+    the plane's counters moved by exactly that much."""
+    mismatches = []
+    orig = state.encode_cycle
+
+    def spy(items, tokens=None, explain=False, audit=None):
+        if state.plane is None:
+            expected = len(items)  # rebuild fallback: one full encode
+        else:
+            expected = 0
+            for i in range(len(items)):
+                tok = tokens[i] if tokens is not None else None
+                row = state.rows.get(tok.key) if tok is not None else None
+                if row is None or tok is None or row.rv != tok.rv:
+                    expected += 1
+        before = state.misses
+        out = orig(items, tokens, explain=explain, audit=audit)
+        got = state.misses - before
+        if got != expected:
+            mismatches.append(
+                {"cycle": state.cycles, "items": len(items),
+                 "expected": expected, "reencoded": got})
+        return out
+
+    state.encode_cycle = spy
+    return mismatches
+
+
+def run_resident_scenario(scenario, seed: int = 1, audit_interval: int = 2):
+    clock = VirtualClock()
+    model = ServiceModel()
+    plane = ServeSlice(scenario, clock, model, backend="device",
+                       resident=True,
+                       resident_audit_interval=audit_interval)
+    state = plane.scheduler._resident  # noqa: SLF001 — the armed plane
+    assert state is not None, "resident plane must arm on the device backend"
+    mismatches = attach_exactness_spy(state)
+    driver = LoadDriver(plane, scenario, clock=clock, model=model, seed=seed)
+    report = driver.run()
+    return state, mismatches, report
+
+
+def test_churn_scenario_delta_path_reencodes_only_churn():
+    """The loadgen `churn` scenario (capacity flaps on a rotating cluster,
+    compressed mode) against the resident plane: per-cycle re-encode
+    exactness, hit rate in the SOAK payload, audit green every other
+    cycle, and NO structural rebuilds (flaps are status-only)."""
+    scenario = get_scenario("churn")
+    state, mismatches, report = run_resident_scenario(scenario)
+
+    assert mismatches == [], mismatches
+    assert report["scheduled"] == report["injected"] > 0
+
+    st = state.stats()
+    # the parity audit ran repeatedly across the flap events and stayed
+    # bit-exact (a mismatch would also force a generation bump)
+    assert st["audits"]["ok"] >= 3 and st["audits"]["mismatch"] == 0
+    # capacity flaps ride the scatter path: the only rebuild is adoption
+    assert st["rebuilds"] == {"init": 1}, st["rebuilds"]
+    assert st["resident"] is True
+
+    # the SOAK payload reports the resident plane (hit rate included)
+    res = report["resident"]
+    assert res is not None and res["enabled"]
+    assert res["row_misses"] > 0
+    assert res["hit_rate"] is None or 0.0 <= res["hit_rate"] <= 1.0
+    assert res["cycles"] == st["cycles"]
+
+
+def test_churn_scenario_with_kill_revive_keeps_audit_green():
+    """Kill/revive membership churn layered onto the flap scenario: the
+    structural events must force lossless rebuilds (generation bumps),
+    the exactness property must hold through them, and the bit-exact
+    audit must stay green for the whole run."""
+    base = get_scenario("churn")
+    scenario = dataclasses.replace(
+        base, name="churn-killrevive",
+        events=base.events + (
+            ClusterEventSpec(at_frac=0.30, kind="kill", count=1),
+            ClusterEventSpec(at_frac=0.75, kind="revive", count=1),
+        ))
+    state, mismatches, report = run_resident_scenario(scenario)
+
+    assert mismatches == [], mismatches
+    assert report["scheduled"] == report["injected"] > 0
+
+    st = state.stats()
+    assert st["audits"]["ok"] >= 3 and st["audits"]["mismatch"] == 0
+    # kill + revive are structural: at least two rebuilds beyond adoption
+    assert sum(st["rebuilds"].values()) >= 3, st["rebuilds"]
+    assert st["generation"] >= 2
+    assert report["resident"]["row_misses"] > 0

@@ -61,3 +61,44 @@ def test_vet_covers_known_surfaces():
               if sf.path.endswith(os.path.join("ops", "solver.py"))]
     mod = trace_safety._Module(solver[0])  # noqa: SLF001
     assert {"_schedule_core", "_schedule_compact_impl"} <= mod.roots()
+
+
+def test_vet_covers_resident_plane():
+    """The gate extends over karmada_tpu/resident/: the walk reaches the
+    subsystem's files, and the spec-coverage pass harvests ResidentPlane's
+    ndarray fields and judges every one against the meshing PartitionSpec
+    table (or the declared RESIDENT_HOST_ONLY set) — the same drift
+    detector that caught SolverBatch drift on day one.  A refactor that
+    renames the class or moves the files would silently drop the new
+    subsystem out of the gate; this pins it in."""
+    from karmada_tpu.analysis import spec_coverage
+    from karmada_tpu.analysis.core import collect_files
+
+    files = collect_files([PKG])
+    resident = {os.path.basename(sf.path) for sf in files
+                if (os.sep + "resident" + os.sep) in sf.path}
+    assert {"__init__.py", "state.py", "deltas.py"} <= resident
+
+    harvested = {}
+    host_only: set = set()
+    keys: set = set()
+    for sf in files:
+        line, k = spec_coverage._spec_table(sf.tree)  # noqa: SLF001
+        if k and not keys:
+            keys = k
+            host_only = spec_coverage._const_strings(  # noqa: SLF001
+                sf.tree, "HOST_ONLY_FIELDS")
+        for cls, exempt in spec_coverage.COVERED_CLASSES:
+            _line, f = spec_coverage._ndarray_fields(  # noqa: SLF001
+                sf.tree, cls)
+            if f and cls not in harvested:
+                harvested[cls] = (sf, f, spec_coverage._const_strings(  # noqa: SLF001
+                    sf.tree, exempt))
+    assert {"SolverBatch", "ResidentPlane"} <= set(harvested)
+    sf, fields, extra = harvested["ResidentPlane"]
+    assert sf.path.endswith(os.path.join("resident", "state.py"))
+    assert len(fields) >= 30  # the full plane, not a stub match
+    # the coverage property itself, asserted directly: every resident
+    # ndarray field is spec'd or declared host-only
+    assert fields <= keys | host_only | extra, \
+        sorted(fields - keys - host_only - extra)

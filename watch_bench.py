@@ -108,6 +108,24 @@ def main() -> int:
                               "explain_disarmed_delta_pct"),
                           "disarmed_new_compiles": detail.get(
                               "explain_disarmed_new_compiles")})
+                if "delta" in detail:
+                    # resident-plane steady-state summary as a structured
+                    # line (bench --delta payloads; the full record is in
+                    # detail.delta / the persisted delta_bench.json)
+                    dl = detail["delta"]
+                    head = (dl.get("churn") or [{}])[0]
+                    jlog({"event": "delta",
+                          "ts": round(time.time(), 3),
+                          "platform": dl.get("platform"),
+                          "bindings": dl.get("bindings"),
+                          "clusters": dl.get("clusters"),
+                          "full_bps": dl.get("full_bps"),
+                          "steady_bps": head.get("steady_bps"),
+                          "churn_frac": head.get("churn_frac"),
+                          "speedup_vs_full": head.get("speedup_vs_full"),
+                          "reencode_exact": dl.get("reencode_exact"),
+                          "audit_green": dl.get("audit_green"),
+                          "parity_ok": dl.get("parity_ok")})
                 if "soak" in detail:
                     # sustained-traffic SLO summary as a structured line
                     # (bench --soak SCENARIO payloads; the full record is
