@@ -1463,6 +1463,76 @@ def run_soak(args) -> int:
     return 0
 
 
+def run_chaos(args) -> int:
+    """bench --chaos SCENARIO: run a chaos-enabled loadgen scenario in
+    compressed virtual time against the FULL hardened shape — device
+    backend (XLA:CPU off-hardware), resident plane, mid-serve death
+    guard with cooldown recovery, the estimator fan-out harness — and
+    emit the CHAOS payload: the SOAK report plus the fault ledger and
+    the safety auditor's conservation/accountability/recovery proof
+    (ONE JSON line, detail.chaos; persisted to
+    <ckpt-dir>/chaos_<scenario>.json — the CHAOS_r*.json contract)."""
+    from karmada_tpu.loadgen import (
+        LoadDriver,
+        ServeSlice,
+        ServiceModel,
+        VirtualClock,
+        get_scenario,
+        warm_device_path,
+    )
+
+    try:
+        scenario = get_scenario(args.chaos)
+        if not scenario.chaotic:
+            raise ValueError(
+                f"scenario {scenario.name!r} schedules no chaos fault "
+                "events; use --soak for fault-free scenarios")
+    except ValueError as e:
+        print(json.dumps({"metric": "chaos soak failed (scenario)",
+                          "value": 0, "unit": "violations",
+                          "vs_baseline": 0, "detail": {"error": str(e)}}))
+        return 1
+    _hb(f"chaos {scenario.name}: fixed service model, backend=device "
+        "(XLA:CPU off-hardware), resident plane + death guard armed")
+    # a FIXED model (not calibrated): the chaos payload's value is the
+    # auditor verdict, not throughput, and fixing it keeps every fault's
+    # virtual timing — and therefore the whole run — reproducible
+    model = ServiceModel()
+    clock = VirtualClock()
+    plane = ServeSlice(scenario, clock, model, backend="device",
+                       resident=True, resident_audit_interval=0,
+                       device_cycle_timeout_s=2.0,
+                       device_recover_cycles=2)
+    # compile-warm OUTSIDE the death guard's window: the 2s guard must
+    # measure stuck cycles, not the first call's jit compile
+    warm_device_path(plane)
+    driver = LoadDriver(plane, scenario, clock=clock, model=model,
+                        seed=args.soak_seed)
+    payload = driver.run()
+    payload["backend"] = "device"
+    audit = payload.get("safety_audit") or {}
+    violations = audit.get("violations", [])
+    _hb(f"chaos done: injected={payload['injected']} "
+        f"scheduled={payload['scheduled']} "
+        f"faults={audit.get('fault_fires')} "
+        f"violations={len(violations)}")
+    os.makedirs(args.ckpt_dir, exist_ok=True)
+    out_path = os.path.join(args.ckpt_dir, f"chaos_{scenario.name}.json")
+    with open(out_path, "w") as f:
+        json.dump(payload, f, indent=2)
+    print(json.dumps({
+        "metric": f"chaos {scenario.name}: safety-audit violations "
+                  f"({payload['injected']} bindings, "
+                  f"{sum((audit.get('fault_fires') or {}).values())} "
+                  "faults fired)",
+        "value": len(violations),
+        "unit": "violations",
+        "vs_baseline": 0,
+        "detail": {"chaos": payload, "chaos_path": out_path},
+    }))
+    return 0 if not violations else 1
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--bindings", type=int, default=100_000)
@@ -1498,6 +1568,14 @@ def main() -> None:
                     default="serial",
                     help="scheduler backend the soak drives (and "
                          "calibrates against)")
+    ap.add_argument("--chaos", default=None, metavar="SCENARIO",
+                    help="chaos soak mode (karmada_tpu/chaos + loadgen): "
+                         "run a chaos-enabled scenario in compressed "
+                         "virtual time against the device backend with "
+                         "the resident plane, death guard, and estimator "
+                         "harness armed; emits the fault ledger + safety "
+                         "auditor payload (CHAOS_r*.json contract).  "
+                         "Exit 1 on any conservation violation.")
     ap.add_argument("--soak-seed", type=int, default=0,
                     help="deterministic arrival-process seed")
     ap.add_argument("--mesh", nargs="?", const="auto", default=None,
@@ -1573,6 +1651,14 @@ def main() -> None:
         # --mesh mode
         _HB_ON = True
         raise SystemExit(run_soak(args))
+    if args.chaos is not None:
+        # chaos mode is self-contained (virtual clock, fixed service
+        # model, whatever jax platform the environment provides —
+        # JAX_PLATFORMS=cpu in the tier-1 gate); the scheduler's own
+        # mid-serve death guard bounds a hung device cycle, so no probe
+        # and no watchdog parent
+        _HB_ON = True
+        raise SystemExit(run_chaos(args))
     if args.delta:
         # delta mode is host-only and self-contained: the resident plane's
         # device-path code runs byte-identical on XLA:CPU (forced before

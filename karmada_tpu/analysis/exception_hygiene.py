@@ -1,0 +1,81 @@
+"""Pass 6 — exception hygiene: no silently-swallowed blanket handlers.
+
+The chaos plane's first soak proved the failure mode this pass exists
+for: the estimator client's blanket ``except Exception`` arms flattened
+a dead estimator, a timeout, and a garbage reply into one silent
+sentinel — indistinguishable from a full cluster, invisible to every
+dashboard.  The rule: an ``except Exception`` (or bare ``except:`` /
+``except BaseException``) handler must do at least one of
+
+  * re-raise (any ``raise`` statement in the handler body — bare
+    re-raise, a wrapped exception, or a deferred ``box['err']`` pattern
+    still counts when a literal raise is present);
+  * record a metric (a ``.inc(...)`` / ``.observe(...)`` / ``.set(...)``
+    call anywhere in the handler body — the failure reaches /metrics);
+  * carry a ``# vet: ignore[exception-hygiene] <why>`` waiver whose
+    justification explains why swallowing is the correct handling
+    (e.g. "serialized back to the peer", "per-binding failure object").
+
+Anything else is a finding: the handler observes a failure the rest of
+the system can never see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from karmada_tpu.analysis.core import Finding, SourceFile, dotted
+
+#: handler types the rule covers (narrow handlers are presumed typed
+#: and intentional; the blanket forms are where failures vanish)
+_BLANKET = ("Exception", "BaseException")
+
+#: attribute calls that count as "records a metric"
+_METRIC_METHODS = ("inc", "observe", "set")
+
+
+def _is_blanket(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True  # bare except:
+    name = dotted(handler.type)
+    if name is not None and name.rsplit(".", 1)[-1] in _BLANKET:
+        return True
+    # except (A, Exception): — the tuple form is blanket if any member is
+    if isinstance(handler.type, ast.Tuple):
+        for elt in handler.type.elts:
+            n = dotted(elt)
+            if n is not None and n.rsplit(".", 1)[-1] in _BLANKET:
+                return True
+    return False
+
+
+def _handled(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_METHODS):
+            return True
+    return False
+
+
+def run(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if not _is_blanket(handler) or _handled(handler):
+                    continue
+                findings.append(Finding(
+                    rule="exception-hygiene", file=sf.path,
+                    line=handler.lineno,
+                    message="blanket `except Exception` neither "
+                            "re-raises nor records a metric — the "
+                            "failure is invisible to every dashboard; "
+                            "fix it or waive with a justification",
+                ))
+    return findings

@@ -1,4 +1,4 @@
-"""`karmadactl vet` — run the four static passes and assemble the report.
+"""`karmadactl vet` — run the static passes and assemble the report.
 
 JSON shape (stable; bench/watch tooling ingests it):
 
@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Sequence
 
 from karmada_tpu.analysis import (
     dtype_contract,
+    exception_hygiene,
     lock_discipline,
     metric_naming,
     spec_coverage,
@@ -46,6 +47,7 @@ PASSES = {
     "spec-coverage": (spec_coverage.run, ("spec-coverage",)),
     "lock-discipline": (lock_discipline.run, ("guarded-by",)),
     "metric-naming": (metric_naming.run, ("metric-naming",)),
+    "exception-hygiene": (exception_hygiene.run, ("exception-hygiene",)),
 }
 
 

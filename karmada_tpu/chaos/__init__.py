@@ -1,0 +1,47 @@
+"""Chaos plane: deterministic fault injection + post-soak safety audit.
+
+Public surface:
+
+  armed() / fire(site, **ctx) / raise_if(site, **ctx)
+      the seam API (one list read when disarmed — guard call sites with
+      ``if chaos.armed():``)
+  configure(spec, seed) / disarm() / active()
+      process-wide arming (`serve --chaos SPEC`, `Scheduler(chaos=)`,
+      loadgen scenario fault events)
+  parse_spec(spec) / SITES / SITE_*
+      the fault grammar and the injection-site catalog
+  state_payload()
+      /debug/chaos
+  capture_baseline() / audit_soak(driver, baseline)
+      the conservation/accountability/recovery auditor (chaos/audit.py)
+
+See docs/ROBUSTNESS.md for the site catalog, spec grammar, and the
+invariants the auditor proves.
+"""
+
+from karmada_tpu.chaos.audit import (  # noqa: F401 — public surface
+    audit_soak,
+    capture_baseline,
+)
+from karmada_tpu.chaos.plane import (  # noqa: F401 — public surface
+    SITE_DEVICE_CYCLE,
+    SITE_DEVICE_D2H,
+    SITE_DEVICE_DISPATCH,
+    SITE_ESTIMATOR_RPC,
+    SITE_LEASE_HEARTBEAT,
+    SITE_RESIDENT_MIRROR,
+    SITE_STORE_WATCH,
+    SITE_WORKER_RECONCILE,
+    SITES,
+    ChaosFault,
+    ChaosPlane,
+    Fault,
+    active,
+    armed,
+    configure,
+    disarm,
+    fire,
+    parse_spec,
+    raise_if,
+    state_payload,
+)

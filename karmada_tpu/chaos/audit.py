@@ -1,0 +1,218 @@
+"""Post-soak safety auditor: conservation invariants over a chaos run.
+
+A fault-injection soak is only evidence if something PROVES the plane
+stayed safe while faults were flying.  This module does that proof over
+one finished LoadDriver run:
+
+  conservation   every injected binding is exactly one of scheduled /
+                 still queued / shed-accounted — none lost, and no
+                 scheduled binding is double-placed (duplicate target
+                 clusters in spec.clusters);
+  accountability every fired fault has an observable consequence: an
+                 estimator fault is a typed error count or a broken-open
+                 circuit, a device fault is a contained cycle fault or a
+                 backend degrade, a resident corruption is an audit
+                 mismatch + forced rebuild, a single-shot rule that
+                 never reached its seam is itself reported;
+  recovery       a degrade that happened re-armed (when recovery is
+                 configured), an opened circuit is closed again by the
+                 end of the run (when the outage was cleared).
+
+`capture_baseline()` snapshots the relevant counters at soak install;
+`audit_soak(driver, baseline)` returns the payload embedded in the SOAK
+report (`safety_audit`) and CHAOS_r*.json — `violations` is the list the
+chaos tests assert empty.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from karmada_tpu.chaos import plane as chaos_plane
+
+
+def _readers() -> Dict[str, object]:
+    """name -> zero-arg reader.  Mostly cross-label Counter.total(); the
+    resident-audit reader is pinned to outcome="mismatch" — the forced
+    audit ALWAYS runs on a corruption fire, so counting its outcome="ok"
+    leg too would make the mismatch proof vacuous."""
+    from karmada_tpu.estimator import client as est_client
+    from karmada_tpu.resident import state as resident_state
+    from karmada_tpu.scheduler import metrics as sched_metrics
+    from karmada_tpu.store import worker as store_worker
+
+    return {
+        "estimator_errors": est_client.ESTIMATOR_ERRORS.total,
+        "circuit_transitions": est_client.CIRCUIT_TRANSITIONS.total,
+        "cycle_faults": sched_metrics.CYCLE_FAULTS.total,
+        "backend_degraded": sched_metrics.BACKEND_DEGRADED.total,
+        "backend_rearmed": sched_metrics.BACKEND_REARMED.total,
+        "resident_audits_mismatch": (
+            lambda: resident_state.RESIDENT_AUDITS.value(
+                outcome="mismatch")),
+        "resident_rebuilds": resident_state.RESIDENT_REBUILDS.total,
+        "worker_errors": store_worker.RECONCILE_ERRORS.total,
+        "chaos_injections": chaos_plane.INJECTIONS.total,
+    }
+
+
+def capture_baseline() -> Dict[str, float]:
+    """Counter readings at soak install time (the registry is
+    process-wide and cumulative; the audit reasons over this run's
+    deltas only)."""
+    return {name: read() for name, read in _readers().items()}
+
+
+def _deltas(baseline: Dict[str, float]) -> Dict[str, float]:
+    return {name: read() - baseline.get(name, 0.0)
+            for name, read in _readers().items()}
+
+
+def audit_soak(driver, baseline: Optional[Dict[str, float]] = None) -> dict:
+    """The safety-audit payload for one finished LoadDriver run.  Must be
+    called after `_drain` while the plane (store + queues) is intact and
+    the chaos plane is still armed."""
+    from karmada_tpu.models.work import ResourceBinding
+
+    baseline = baseline or {}
+    deltas = _deltas(baseline)
+    violations: List[dict] = []
+    plane = chaos_plane.active()
+    sched = driver.plane.scheduler
+    store = driver.plane.store
+
+    # -- conservation: injected == scheduled + queued + shed-accounted ------
+    scheduled = queued = missing = double_placed = 0
+    with driver._lock:  # noqa: SLF001 — the auditor is a driver-side report
+        flights = dict(driver._flight)  # noqa: SLF001
+    for key, rec in flights.items():
+        if rec.done:
+            scheduled += 1
+            rb = store.try_get(ResourceBinding.KIND, key[0], key[1])
+            if rb is not None:
+                names = [t.name for t in rb.spec.clusters]
+                if len(names) != len(set(names)):
+                    double_placed += 1
+                    violations.append({
+                        "kind": "double-placed", "binding": "/".join(key),
+                        "clusters": names})
+            continue
+        with sched._queue_lock:  # noqa: SLF001 — consistent queue membership
+            resident = sched.queue.has(key)
+        if resident:
+            queued += 1
+        else:
+            missing += 1
+    adm = driver.admission_delta()
+    shed_budget = adm.get("shed", 0) + adm.get("displaced", 0)
+    if missing > shed_budget:
+        violations.append({
+            "kind": "binding-lost",
+            "detail": f"{missing} binding(s) neither scheduled nor queued "
+                      f"but only {shed_budget} shed/displaced decisions "
+                      "account for terminally-dropped bindings"})
+    conservation = {
+        "injected": len(flights),
+        "scheduled": scheduled,
+        "queued_residual": queued,
+        "unaccounted": missing,
+        "shed_budget": shed_budget,
+        "double_placed": double_placed,
+    }
+
+    # -- fault accountability ------------------------------------------------
+    fires: Dict[str, int] = {}
+    unspent: List[dict] = []
+    if plane is not None:
+        fires = dict(plane.fired_by_site)
+        unspent = plane.unspent_rules()
+    for rule in unspent:
+        violations.append({
+            "kind": "fault-unfired",
+            "detail": "a budgeted fault never reached its seam "
+                      "(site dead or scenario mis-ordered)", "rule": rule})
+    # slow-mode fires delay but do not error; only the FAILING estimator
+    # modes must have been classified as typed errors (retries traverse
+    # the seam again, so errors can only exceed distinct logical calls).
+    # Per-mode totals come from the plane's persistent (site, mode)
+    # ledger — armed rules vanish on clear(), so a closed outage window
+    # must still account here.
+    est_fail_fires = 0
+    if plane is not None:
+        est_fail_fires = sum(
+            n for (site, mode), n in plane.fires_by_mode().items()
+            if site == chaos_plane.SITE_ESTIMATOR_RPC and mode != "slow")
+    if est_fail_fires and deltas["estimator_errors"] <= 0:
+        violations.append({
+            "kind": "fault-unaccounted", "site": chaos_plane.SITE_ESTIMATOR_RPC,
+            "detail": f"{est_fail_fires} failing estimator fault(s) fired "
+                      "but karmada_estimator_errors_total never moved"})
+    device_fires = (fires.get(chaos_plane.SITE_DEVICE_DISPATCH, 0)
+                    + fires.get(chaos_plane.SITE_DEVICE_D2H, 0))
+    if device_fires and deltas["cycle_faults"] <= 0:
+        violations.append({
+            "kind": "fault-unaccounted", "site": "device.dispatch/d2h",
+            "detail": f"{device_fires} device fault(s) fired but no cycle "
+                      "fault was contained "
+                      "(karmada_scheduler_cycle_faults_total)"})
+    hang_fires = fires.get(chaos_plane.SITE_DEVICE_CYCLE, 0)
+    if hang_fires and deltas["backend_degraded"] <= 0:
+        violations.append({
+            "kind": "fault-unaccounted", "site": chaos_plane.SITE_DEVICE_CYCLE,
+            "detail": f"{hang_fires} device-cycle hang(s) fired but the "
+                      "backend never degraded"})
+    corrupt_fires = fires.get(chaos_plane.SITE_RESIDENT_MIRROR, 0)
+    if corrupt_fires and deltas["resident_audits_mismatch"] <= 0:
+        violations.append({
+            "kind": "fault-unaccounted",
+            "site": chaos_plane.SITE_RESIDENT_MIRROR,
+            "detail": f"{corrupt_fires} resident corruption(s) fired but "
+                      "the parity audit never reported a mismatch"})
+
+    # -- recovery ------------------------------------------------------------
+    recovery: Dict[str, object] = {}
+    if deltas["backend_degraded"] > 0:
+        recovery["backend_degraded"] = deltas["backend_degraded"]
+        recovery["backend_rearmed"] = deltas["backend_rearmed"]
+        rearm_cfg = getattr(sched, "device_recover_cycles", None)
+        if rearm_cfg and deltas["backend_rearmed"] <= 0:
+            violations.append({
+                "kind": "recovery-missed",
+                "detail": "the backend degraded and recovery is configured "
+                          f"(device_recover_cycles={rearm_cfg}) but it "
+                          "never re-armed"})
+        if rearm_cfg and sched.backend != "device" and \
+                deltas["backend_rearmed"] > 0:
+            violations.append({
+                "kind": "recovery-missed",
+                "detail": f"backend ended the run on {sched.backend!r} "
+                          "despite a re-arm (degraded again without "
+                          "another hang?)"})
+    breaker = getattr(driver, "estimator_breaker", None)
+    if breaker is not None:
+        states = breaker.states()
+        recovery["circuit_states"] = states
+        stuck = [c for c, s in states.items() if s != "closed"]
+        if est_fail_fires and stuck and not _outage_still_armed(plane):
+            violations.append({
+                "kind": "recovery-missed",
+                "detail": "estimator outage ended but circuit(s) "
+                          f"{stuck} never closed again"})
+
+    return {
+        "violations": violations,
+        "conservation": conservation,
+        "fault_fires": fires,
+        "metric_deltas": {k: round(v, 6) for k, v in deltas.items()},
+        "recovery": recovery,
+    }
+
+
+def _outage_still_armed(plane) -> bool:
+    """True while an unlimited estimator fault rule is still armed (the
+    circuit legitimately stays open until the outage clears)."""
+    if plane is None:
+        return False
+    with plane._lock:  # noqa: SLF001 — read-only introspection
+        return any(r.site == chaos_plane.SITE_ESTIMATOR_RPC
+                   and not r.spent() for r in plane._rules)  # noqa: SLF001

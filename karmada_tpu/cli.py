@@ -42,7 +42,10 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                 batch_deadline: Optional[float] = None,
                 admission_limit: Optional[int] = None,
                 resident: bool = False,
-                resident_audit: int = 64):
+                resident_audit: int = 64,
+                device_recover_cycles: Optional[int] = None,
+                chaos: Optional[str] = None,
+                chaos_seed: int = 0):
     """controllers=None rehydrates the persisted --controllers spec; an
     explicit spec is also persisted so later invocations honor it.
 
@@ -74,7 +77,9 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                       batch_deadline_s=batch_deadline,
                       admission_limit=admission_limit,
                       resident=resident,
-                      resident_audit_interval=resident_audit)
+                      resident_audit_interval=resident_audit,
+                      device_recover_cycles=device_recover_cycles,
+                      chaos=chaos, chaos_seed=chaos_seed)
     if controllers is not None:
         cp.apply({"apiVersion": "v1", "kind": "ConfigMap",
                   "metadata": {"namespace": "karmada-system",
@@ -340,6 +345,7 @@ def cmd_edit(args) -> int:
 def _proxy_handle(cp, cluster: str):
     try:
         return cp.proxy(cluster)
+    # vet: ignore[exception-hygiene] proxy error printed to stderr, exit 1
     except Exception as e:  # noqa: BLE001 — ProxyDenied / unknown cluster
         print(f"cluster proxy error: {e}", file=sys.stderr)
         return None
@@ -357,6 +363,7 @@ def _stream_pod_logs(args, tail, header: str = "") -> int:
         return 1
     try:
         lines = handle.logs(args.namespace or "default", args.pod, tail=tail)
+    # vet: ignore[exception-hygiene] error printed to stderr, exit 1
     except Exception as e:  # noqa: BLE001 — pod not found
         print(_err_text(e), file=sys.stderr)
         return 1
@@ -383,6 +390,7 @@ def cmd_exec(args) -> int:
     try:
         rc, out = handle.exec(args.namespace or "default", args.pod,
                               args.cmd)
+    # vet: ignore[exception-hygiene] error printed to stderr, exit 1
     except Exception as e:  # noqa: BLE001 — pod not found
         print(_err_text(e), file=sys.stderr)
         return 1
@@ -548,6 +556,7 @@ def cmd_describe(args) -> int:
     if args.cluster:
         try:
             obj = cp.proxy(args.cluster).get(args.kind, args.namespace, args.name)
+        # vet: ignore[exception-hygiene] proxy error printed to stderr, exit 1
         except Exception as e:  # noqa: BLE001 — ProxyDenied / unknown cluster
             print(f"cluster proxy error: {e}", file=sys.stderr)
             return 1
@@ -735,6 +744,7 @@ def _explain_remote(args) -> int:
     except urllib.error.HTTPError as e:
         try:
             msg = json.loads(e.read().decode()).get("error", str(e))
+        # vet: ignore[exception-hygiene] fallback to the raw error text
         except Exception:  # noqa: BLE001 — non-JSON error body
             msg = str(e)
         print(f"server error ({e.code}): {msg}", file=sys.stderr)
@@ -797,6 +807,7 @@ def cmd_explain(args) -> int:
         seen = seen | {c}
         try:
             hints = typing.get_type_hints(c)
+        # vet: ignore[exception-hygiene] unresolvable hints degrade to declared field types
         except Exception:  # noqa: BLE001 — unresolvable forward refs
             hints = {}
         for f in dataclasses.fields(c):
@@ -1037,6 +1048,16 @@ def cmd_serve(args) -> int:
         except ValueError as e:
             print(str(e), file=sys.stderr)
             return 1
+    if args.chaos:
+        # validate the fault spec BEFORE the plane loads: a typo'd chaos
+        # spec must fail the command, never silently arm nothing
+        from karmada_tpu import chaos as chaos_mod
+
+        try:
+            chaos_mod.parse_spec(args.chaos, seed=args.chaos_seed)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 1
     try:
         cp = _load_plane(args.dir, backend=args.backend, waves=args.waves,
                          controllers=args.controllers,
@@ -1055,10 +1076,19 @@ def cmd_serve(args) -> int:
                                           if args.admission_limit > 0
                                           else None),
                          resident=args.resident,
-                         resident_audit=args.resident_audit)
+                         resident_audit=args.resident_audit,
+                         device_recover_cycles=(
+                             args.device_recover_cycles
+                             if args.device_recover_cycles > 0 else None),
+                         chaos=args.chaos or None,
+                         chaos_seed=args.chaos_seed)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 1
+    if args.chaos:
+        print(f"CHAOS PLANE ARMED (seed {args.chaos_seed}): {args.chaos} — "
+              "deterministic faults will fire at the named seams; state "
+              "at /debug/chaos")
     if args.resident:
         if cp.scheduler.backend == "device":
             print("resident-state plane armed: cluster tensors stay "
@@ -1777,7 +1807,31 @@ def build_parser() -> argparse.ArgumentParser:
                     help="mid-serve death guard: a device solve cycle "
                          "exceeding this many seconds is abandoned and the "
                          "scheduler degrades to the fastest host backend "
-                         "permanently (0 disables)")
+                         "(0 disables; see --device-recover-cycles for "
+                         "whether the degrade is permanent)")
+    sv.add_argument("--device-recover-cycles", type=int, default=64,
+                    metavar="N",
+                    help="recoverable degrade: after N scheduling cycles "
+                         "on the degraded backend, re-probe the device "
+                         "path (half-open: one cycle tries it; a hang "
+                         "degrades again with the cooldown doubled per "
+                         "consecutive failure).  0 = legacy one-way "
+                         "degrade")
+    sv.add_argument("--chaos", default="",
+                    metavar="SPEC",
+                    help="arm the deterministic fault-injection plane "
+                         "(karmada_tpu/chaos) with SPEC — "
+                         "SITE:MODE[:ARG][@PROB][#COUNT], ';'-separated; "
+                         "e.g. 'estimator.rpc:error@0.1;"
+                         "device.cycle:hang:30#1'.  Sites: estimator.rpc, "
+                         "device.dispatch, device.d2h, device.cycle, "
+                         "resident.mirror, store.watch, worker.reconcile, "
+                         "lease.heartbeat.  State at /debug/chaos; "
+                         "disarmed cost is one list read per seam")
+    sv.add_argument("--chaos-seed", type=int, default=0,
+                    help="deterministic seed for --chaos probability "
+                         "draws (same spec + seed + call sequence fires "
+                         "the same faults)")
     sv.add_argument("--check-invariants", action="store_true",
                     help="arm the runtime invariant guards "
                          "(karmada_tpu/analysis/guards): shape/dtype/NaN "
@@ -1852,6 +1906,7 @@ def main(argv: Optional[list] = None) -> int:
         # piped into head/less that exited — the unix-polite outcome
         try:
             sys.stdout.close()
+        # vet: ignore[exception-hygiene] double BrokenPipe on close; exiting anyway
         except Exception:  # noqa: BLE001
             pass
         return 0

@@ -157,6 +157,7 @@ class RateLimitedEvictionQueue:
             self._tokens -= 1.0
             try:
                 self.process(key)
+            # vet: ignore[exception-hygiene] traceback printed, eviction requeued for a paced retry
             except Exception:  # noqa: BLE001 — an eviction must not be lost
                 import traceback
 

@@ -168,6 +168,7 @@ class ExecutionController:
         for manifest in work.spec.workload:
             try:
                 self.watcher.create_or_update(member, _mark_managed(manifest), conflict)
+            # vet: ignore[exception-hygiene] surfaced in the Work's Applied=False condition message
             except Exception as e:  # noqa: BLE001
                 errors.append(str(e))
 

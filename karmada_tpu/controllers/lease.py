@@ -55,6 +55,16 @@ def renew_cluster_lease(
     clock: Callable[[], float] = time.time,
 ) -> None:
     """Create-or-renew the cluster's lease (the collector's heartbeat)."""
+    from karmada_tpu import chaos
+
+    if chaos.armed():
+        f = chaos.fire(chaos.SITE_LEASE_HEARTBEAT, cluster=cluster_name)
+        if f is not None and f.mode == "drop":
+            # a suppressed heartbeat is indistinguishable from a dead
+            # collector: the lease ages out, the monitor flips Ready to
+            # Unknown, and the taint/eviction chain takes over — exactly
+            # the failure path the chaos soak exists to exercise
+            return
     now = clock()
     try:
         def bump(lease: Lease) -> None:

@@ -98,6 +98,14 @@ class ControlPlane:
         # per-binding encoded-row cache; device backend only
         resident: bool = False,
         resident_audit_interval: int = 64,
+        # recoverable backend degrade (scheduler/service.py): after this
+        # many cycles on the degraded backend, re-probe the device path
+        # (None keeps the legacy one-way degrade)
+        device_recover_cycles: Optional[int] = None,
+        # chaos fault-injection plane (karmada_tpu/chaos, serve --chaos):
+        # spec string arming deterministic faults at the named seams
+        chaos: Optional[str] = None,
+        chaos_seed: int = 0,
     ) -> None:
         self.clock = clock if clock is not None else time.time
         from karmada_tpu.utils.events import EventRecorder
@@ -158,7 +166,10 @@ class ControlPlane:
                                    admission_limit=admission_limit,
                                    resident=resident,
                                    resident_audit_interval=(
-                                       resident_audit_interval))
+                                       resident_audit_interval),
+                                   device_recover_cycles=(
+                                       device_recover_cycles),
+                                   chaos=chaos, chaos_seed=chaos_seed)
         self.binding_controller = BindingController(
             self.store, self.runtime, self.interpreter
         )

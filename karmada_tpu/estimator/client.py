@@ -17,12 +17,14 @@ evaluate 100k bindings without 100k x clusters network calls.
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
-from karmada_tpu import obs
+from karmada_tpu import chaos, obs
 from karmada_tpu.utils.metrics import REGISTRY
 from karmada_tpu.estimator.wire import (
     CapacitySnapshotResponse,
@@ -44,6 +46,182 @@ RPC_SKIPPED = REGISTRY.counter(
     "since the previous cycle (the memoized answer served instead)",
     ("method",),
 )
+
+ESTIMATOR_ERRORS = REGISTRY.counter(
+    "karmada_estimator_errors_total",
+    "Estimator RPC failures by typed classification (unreachable / "
+    "timeout / malformed per attempt, circuit_open per short-circuited "
+    "call) — a dead estimator is no longer indistinguishable from a "
+    "full cluster",
+    ("kind",),
+)
+
+ESTIMATOR_RETRIES = REGISTRY.counter(
+    "karmada_estimator_retries_total",
+    "Estimator RPC retry attempts (bounded, full-jitter exponential "
+    "backoff) by method",
+    ("method",),
+)
+
+CIRCUIT_STATE = REGISTRY.gauge(
+    "karmada_estimator_circuit_state",
+    "Per-cluster estimator circuit-breaker state "
+    "(0 = closed, 1 = open, 2 = half-open)",
+    ("cluster",),
+)
+
+CIRCUIT_TRANSITIONS = REGISTRY.counter(
+    "karmada_estimator_circuit_transitions_total",
+    "Estimator circuit-breaker state transitions by target state",
+    ("to",),
+)
+
+
+# -- typed error classification ----------------------------------------------
+class EstimatorError(Exception):
+    """Base of the typed estimator failure taxonomy; `kind` is the
+    karmada_estimator_errors_total label."""
+
+    kind = "unreachable"
+
+
+class EstimatorUnreachable(EstimatorError):
+    kind = "unreachable"
+
+
+class EstimatorTimeout(EstimatorError):
+    kind = "timeout"
+
+
+class EstimatorMalformed(EstimatorError):
+    kind = "malformed"
+
+
+class EstimatorCircuitOpen(EstimatorError):
+    kind = "circuit_open"
+
+
+def classify_exception(exc: BaseException) -> EstimatorError:
+    """Map a raw transport/parse failure onto the typed taxonomy.
+    TimeoutError first: socket.timeout IS a TimeoutError which IS an
+    OSError, so the order of these checks is the classification."""
+    if isinstance(exc, EstimatorError):
+        return exc
+    if isinstance(exc, TimeoutError):
+        return EstimatorTimeout(str(exc))
+    if isinstance(exc, (ConnectionError, OSError)):
+        return EstimatorUnreachable(str(exc))
+    # ValueError/TypeError/KeyError/AttributeError from response parsing,
+    # json decode faults, and RuntimeError (a server-serialized error
+    # frame): the endpoint answered but the reply could not be used
+    return EstimatorMalformed(f"{type(exc).__name__}: {exc}")
+
+
+# -- per-cluster circuit breaker ----------------------------------------------
+CIRCUIT_CLOSED = "closed"
+CIRCUIT_OPEN = "open"
+CIRCUIT_HALF_OPEN = "half-open"
+_CIRCUIT_VALUE = {CIRCUIT_CLOSED: 0.0, CIRCUIT_OPEN: 1.0,
+                  CIRCUIT_HALF_OPEN: 2.0}
+
+
+class CircuitBreaker:
+    """Classic closed -> open -> half-open breaker, one circuit per
+    member cluster: `failure_threshold` consecutive failed CALLS (each
+    already retried) open the circuit; while open every call
+    short-circuits to the sentinel without touching the wire; after
+    `reset_timeout_s` ONE probe call is allowed through (half-open) —
+    success closes the circuit, failure re-opens it for another full
+    timeout.  `clock` is injectable so compressed-time soaks drive the
+    open-window on the loadgen virtual clock."""
+
+    def __init__(self, failure_threshold: int = 5,
+                 reset_timeout_s: float = 30.0,
+                 clock: Optional[Callable[[], float]] = None) -> None:
+        self.failure_threshold = max(1, failure_threshold)
+        self.reset_timeout_s = reset_timeout_s
+        self.clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        self._state: Dict[str, str] = {}  # guarded-by: _lock
+        self._failures: Dict[str, int] = {}  # guarded-by: _lock
+        self._opened_at: Dict[str, float] = {}  # guarded-by: _lock
+        self._probing: set = set()  # guarded-by: _lock
+        # guarded-by: _lock — bounded transition log (soak reporting)
+        self.transitions: deque = deque(maxlen=256)
+
+    def _set(self, cluster: str, state: str) -> None:
+        """Transition (call under _lock); metrics + log on real moves."""
+        prev = self._state.get(cluster, CIRCUIT_CLOSED)
+        if prev == state:
+            return
+        # vet: ignore[guarded-by] _set is a helper invoked only under _lock
+        self._state[cluster] = state
+        # vet: ignore[guarded-by] _set is a helper invoked only under _lock
+        self.transitions.append({"cluster": cluster, "from": prev,
+                                 "to": state, "ts": self.clock()})
+        CIRCUIT_STATE.set(_CIRCUIT_VALUE[state], cluster=cluster)
+        CIRCUIT_TRANSITIONS.inc(to=state)
+
+    def allow(self, cluster: str) -> bool:
+        """May a call to this cluster's estimator proceed?  Handles the
+        open->half-open transition; in half-open only one probe flies."""
+        with self._lock:
+            state = self._state.get(cluster, CIRCUIT_CLOSED)
+            if state == CIRCUIT_CLOSED:
+                return True
+            if state == CIRCUIT_OPEN:
+                if (self.clock() - self._opened_at.get(cluster, 0.0)
+                        >= self.reset_timeout_s):
+                    self._set(cluster, CIRCUIT_HALF_OPEN)
+                    self._probing.add(cluster)
+                    return True
+                return False
+            # half-open: exactly one in-flight probe
+            if cluster in self._probing:
+                return False
+            self._probing.add(cluster)
+            return True
+
+    def record_success(self, cluster: str) -> None:
+        with self._lock:
+            self._probing.discard(cluster)
+            self._failures[cluster] = 0
+            self._set(cluster, CIRCUIT_CLOSED)
+
+    def record_failure(self, cluster: str) -> None:
+        with self._lock:
+            self._probing.discard(cluster)
+            state = self._state.get(cluster, CIRCUIT_CLOSED)
+            if state in (CIRCUIT_HALF_OPEN, CIRCUIT_OPEN):
+                # a failed probe re-opens for another full timeout
+                self._opened_at[cluster] = self.clock()
+                self._set(cluster, CIRCUIT_OPEN)
+                return
+            n = self._failures.get(cluster, 0) + 1
+            self._failures[cluster] = n
+            if n >= self.failure_threshold:
+                self._opened_at[cluster] = self.clock()
+                self._set(cluster, CIRCUIT_OPEN)
+
+    def forget(self, cluster: str) -> None:
+        with self._lock:
+            self._state.pop(cluster, None)
+            self._failures.pop(cluster, None)
+            self._opened_at.pop(cluster, None)
+            self._probing.discard(cluster)
+        CIRCUIT_STATE.set(0.0, cluster=cluster)
+
+    def state(self, cluster: str) -> str:
+        with self._lock:
+            return self._state.get(cluster, CIRCUIT_CLOSED)
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._state)
+
+    def transition_log(self) -> List[dict]:
+        with self._lock:
+            return list(self.transitions)
 
 
 def _rpc_span(cluster: str, method: str):
@@ -80,12 +258,39 @@ def _traced_map(pool: ThreadPoolExecutor, fn, clusters: List[Cluster],
 
 
 class AccurateEstimatorClient:
-    """Per-cluster RPC fan-out (accurate.go): one transport per member."""
+    """Per-cluster RPC fan-out (accurate.go): one transport per member.
 
-    def __init__(self, max_workers: int = 16, timeout_replicas: int = UNAUTHENTIC_REPLICA) -> None:
+    Every wire call runs through the hardened path: the per-cluster
+    circuit breaker gates it (open circuits short-circuit to the
+    sentinel without touching the network), transient failures retry
+    with bounded full-jitter exponential backoff (`retry_attempts`
+    total tries; full jitter de-synchronizes the per-cluster pool
+    threads after a shared-dependency blip), and every failure is
+    CLASSIFIED — unreachable / timeout / malformed — into
+    karmada_estimator_errors_total before the UNAUTHENTIC sentinel
+    keeps the solver's answer total.  `sleep`/`clock` are injectable so
+    compressed-time soaks never wall-sleep and drive the breaker's
+    open-window on the loadgen virtual clock."""
+
+    def __init__(self, max_workers: int = 16,
+                 timeout_replicas: int = UNAUTHENTIC_REPLICA,
+                 breaker: Optional[CircuitBreaker] = None,
+                 retry_attempts: int = 3,
+                 retry_base_s: float = 0.02,
+                 retry_cap_s: float = 0.25,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Optional[Callable[[], float]] = None) -> None:
         self.transports: Dict[str, Transport] = {}
         self._pool = ThreadPoolExecutor(max_workers=max_workers)
         self._timeout_replicas = timeout_replicas
+        self.breaker = (breaker if breaker is not None
+                        else CircuitBreaker(clock=clock))
+        self.retry_attempts = max(1, retry_attempts)
+        self.retry_base_s = retry_base_s
+        self.retry_cap_s = retry_cap_s
+        self._sleep = sleep
+        # deterministic jitter stream (replayable soaks)
+        self._retry_rng = random.Random(0xC1A05)
         self._memo_lock = threading.Lock()
         # guarded-by: _memo_lock — per (method, cluster): the cluster
         # resourceVersion the memoized answers were observed at, and the
@@ -110,9 +315,64 @@ class AccurateEstimatorClient:
         t = self.transports.pop(cluster, None)
         if t is not None:
             t.close()
+        self.breaker.forget(cluster)
         with self._memo_lock:
             for key in [k for k in self._memo if k[1] == cluster]:
                 del self._memo[key]
+
+    # -- the hardened wire path ----------------------------------------------
+    def _transport_call(self, cluster: str, transport: Transport,
+                        method: str, payload: dict) -> dict:
+        """One raw attempt, with the chaos seam in front of the wire
+        (error/timeout raise the transport's own failure shapes; slow
+        delays; garbage substitutes an unparseable reply)."""
+        if chaos.armed():
+            f = chaos.fire(chaos.SITE_ESTIMATOR_RPC, cluster=cluster,
+                           method=method)
+            if f is not None:
+                if f.mode == "error":
+                    raise ConnectionError(
+                        "chaos: estimator connection refused")
+                if f.mode == "timeout":
+                    raise TimeoutError("chaos: estimator call timed out")
+                if f.mode == "slow":
+                    self._sleep(f.delay)
+                elif f.mode == "garbage":
+                    # structurally unusable on every method's parse path
+                    return {"maxReplicas": "garbage", "maxSets": "garbage",
+                            "unschedulableReplicas": "garbage",
+                            "nodeFree": 0, "nodeLabels": 0}
+        return transport.call(method, payload)
+
+    def _request(self, cluster: str, transport: Transport, method: str,
+                 payload: dict, parse: Callable[[dict], object]) -> object:
+        """One logical estimator call: breaker gate, bounded full-jitter
+        retry, typed classification.  Returns parse(reply) or raises an
+        EstimatorError whose kind is already counted."""
+        if not self.breaker.allow(cluster):
+            ESTIMATOR_ERRORS.inc(kind=EstimatorCircuitOpen.kind)
+            raise EstimatorCircuitOpen(
+                f"estimator circuit open for cluster {cluster!r}")
+        err: EstimatorError = EstimatorUnreachable("no attempt made")
+        for attempt in range(self.retry_attempts):
+            if attempt:
+                ESTIMATOR_RETRIES.inc(method=method)
+                # full jitter: uniform over [0, min(cap, base * 2^k)] —
+                # a deterministic stream, never a synchronized stampede
+                self._sleep(self._retry_rng.uniform(
+                    0.0, min(self.retry_cap_s,
+                             self.retry_base_s * (2 ** (attempt - 1)))))
+            try:
+                value = parse(self._transport_call(
+                    cluster, transport, method, payload))
+            except Exception as exc:  # noqa: BLE001 — classified + counted
+                err = classify_exception(exc)
+                ESTIMATOR_ERRORS.inc(kind=err.kind)
+                continue
+            self.breaker.record_success(cluster)
+            return value
+        self.breaker.record_failure(cluster)
+        raise err
 
     # -- rv-keyed RPC memo ---------------------------------------------------
     @staticmethod
@@ -169,14 +429,16 @@ class AccurateEstimatorClient:
             if cached is not None:
                 return TargetCluster(cluster.name, cached)
             try:
-                resp = MaxAvailableReplicasResponse.from_json(
-                    transport.call("MaxAvailableReplicas", payload)
-                )
-                self._memo_put("MaxAvailableReplicas", cluster, sig,
-                               resp.max_replicas)
-                return TargetCluster(cluster.name, resp.max_replicas)
-            except Exception:  # noqa: BLE001 -- unreachable estimator
+                value = self._request(
+                    cluster.name, transport, "MaxAvailableReplicas", payload,
+                    lambda raw: MaxAvailableReplicasResponse.from_json(
+                        raw).max_replicas)
+            except EstimatorError:
+                # typed + counted in _request; the sentinel keeps the
+                # solver's min-merge total
                 return TargetCluster(cluster.name, self._timeout_replicas)
+            self._memo_put("MaxAvailableReplicas", cluster, sig, value)
+            return TargetCluster(cluster.name, value)
 
         return _traced_map(self._pool, one, clusters,
                            "MaxAvailableReplicas")
@@ -210,14 +472,15 @@ class AccurateEstimatorClient:
             if cached is not None:
                 return TargetCluster(cluster.name, cached)
             try:
-                resp = MaxAvailableComponentSetsResponse.from_json(
-                    transport.call("MaxAvailableComponentSets", payload)
-                )
-                self._memo_put("MaxAvailableComponentSets", cluster, sig,
-                               resp.max_sets)
-                return TargetCluster(cluster.name, resp.max_sets)
-            except Exception:  # noqa: BLE001 -- unreachable estimator
+                value = self._request(
+                    cluster.name, transport, "MaxAvailableComponentSets",
+                    payload,
+                    lambda raw: MaxAvailableComponentSetsResponse.from_json(
+                        raw).max_sets)
+            except EstimatorError:
                 return TargetCluster(cluster.name, self._timeout_replicas)
+            self._memo_put("MaxAvailableComponentSets", cluster, sig, value)
+            return TargetCluster(cluster.name, value)
 
         return _traced_map(self._pool, one, clusters,
                            "MaxAvailableComponentSets")
@@ -234,11 +497,13 @@ class AccurateEstimatorClient:
         )
         try:
             with _rpc_span(cluster, "GetUnschedulableReplicas"):
-                resp = UnschedulableReplicasResponse.from_json(
-                    transport.call("GetUnschedulableReplicas", req.to_json())
-                )
-            return resp.unschedulable_replicas
-        except Exception:  # noqa: BLE001
+                return self._request(
+                    cluster, transport, "GetUnschedulableReplicas",
+                    req.to_json(),
+                    lambda raw: UnschedulableReplicasResponse.from_json(
+                        raw).unschedulable_replicas)
+        except EstimatorError:
+            # typed + counted in _request; UNAUTHENTIC keeps callers total
             return UNAUTHENTIC_REPLICA
 
 
@@ -268,10 +533,12 @@ class SnapshotEstimator:
                 return
         try:
             with _rpc_span(cluster, "CapacitySnapshot"):
-                snap = CapacitySnapshotResponse.from_json(
-                    transport.call("CapacitySnapshot", {})
-                )
-        except Exception:  # noqa: BLE001
+                snap = self.client._request(  # noqa: SLF001 — same tier
+                    cluster, transport, "CapacitySnapshot", {},
+                    CapacitySnapshotResponse.from_json)
+        except EstimatorError:
+            # typed + counted in _request; the stale-age gate answers
+            # UNAUTHENTIC for this cluster until a refresh succeeds
             return
         with self._lock:
             self._snapshots[cluster] = snap

@@ -392,6 +392,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 body = self.server.dispatch(  # type: ignore[attr-defined]
                     frame.get("method", ""), frame.get("body", {}))
                 _send_frame(self.request, {"body": body})
+            # vet: ignore[exception-hygiene] serialized back to the peer as an error frame
             except Exception as e:  # noqa: BLE001 -- serialize server errors
                 _send_frame(self.request, {"error": str(e)})
 

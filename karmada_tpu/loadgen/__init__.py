@@ -30,5 +30,6 @@ from karmada_tpu.loadgen.driver import (  # noqa: F401 — public surface
     ServiceModel,
     VirtualClock,
     load_state,
+    warm_device_path,
 )
 from karmada_tpu.loadgen.scenarios import SCENARIOS, get_scenario  # noqa: F401

@@ -163,6 +163,38 @@ def build_binding(name: str, priority: int = 0,
     return rb
 
 
+def warm_device_path(plane, sizes: Tuple[int, ...] = (2, 9, 17, 64)) -> None:
+    """Compile-warm a device-backend slice before a guarded soak: direct
+    schedule_batch calls pay the jit compile cost OUTSIDE the mid-serve
+    death guard's window, so a tight device_cycle_timeout_s measures
+    stuck cycles, not first-call compiles.  `sizes` spans the pow2
+    binding-axis buckets (8/16/32/64 for the default batch_window 64)
+    the soak's variable cuts will hit — an unseen shape mid-soak would
+    compile fresh and read as a hung cycle.  The warm bindings stay in
+    the store as ordinary residents (not flight-tracked, so reports and
+    audits ignore them)."""
+    from karmada_tpu.models.work import ResourceBinding as _RB
+
+    sched = plane.scheduler
+    prev = sched.device_cycle_timeout_s
+    sched.device_cycle_timeout_s = None
+    made = 0
+    try:
+        clusters = list(plane.store.list(Cluster.KIND))
+        for size in sizes:
+            names = []
+            for _ in range(size):
+                names.append(f"lg-warm{made:03d}")
+                made += 1
+                plane.store.create(build_binding(names[-1]))
+            rbs = [plane.store.try_get(_RB.KIND, LOADGEN_NS, name)
+                   for name in names]
+            sched.schedule_batch(
+                [rb for rb in rbs if rb is not None], clusters)
+    finally:
+        sched.device_cycle_timeout_s = prev
+
+
 class ServeSlice:
     """The scheduler-owning slice of a ControlPlane: store + runtime +
     batched scheduler over the same SchedulingQueue/worker machinery
@@ -174,7 +206,9 @@ class ServeSlice:
     def __init__(self, scenario: Scenario, clock, model: ServiceModel,
                  backend: str = "serial", explain: float = 0.0,
                  resident: bool = False,
-                 resident_audit_interval: int = 64) -> None:
+                 resident_audit_interval: int = 64,
+                 device_cycle_timeout_s: Optional[float] = None,
+                 device_recover_cycles: Optional[int] = None) -> None:
         self.store = ObjectStore()
         self.runtime = Runtime()
         self.scheduler = Scheduler(
@@ -186,6 +220,8 @@ class ServeSlice:
             explain=explain,
             resident=resident,
             resident_audit_interval=resident_audit_interval,
+            device_cycle_timeout_s=device_cycle_timeout_s,
+            device_recover_cycles=device_recover_cycles,
         )
         for i in range(scenario.n_clusters):
             self.store.create(build_cluster(f"lg-m{i}"))
@@ -297,14 +333,72 @@ class LoadDriver:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.residual: dict = {}
+        # chaos plumbing (scenario.chaotic): the driver arms the process-
+        # wide chaos plane, runs the per-cycle estimator fan-out harness
+        # (circuit-breaker dynamics on the virtual clock), and runs the
+        # safety auditor before uninstall (harness + audit: compressed
+        # mode only; a realtime chaotic scenario still arms the plane and
+        # applies its scheduled fault windows)
+        self._chaos = scenario.chaotic
+        self._audit_baseline: dict = {}
+        self.estimator_client = None
+        self.estimator_breaker = None
+        self.chaos_state: dict = {}
+        self.safety_audit: Optional[dict] = None
 
     # -- wiring --------------------------------------------------------------
+    def _setup_chaos(self) -> None:
+        """Arm the chaos plane (empty: the scenario's fault events add
+        rules at their scheduled times) and, in compressed mode, the
+        estimator fan-out harness: the production AccurateEstimatorClient
+        against one LocalTransport per loadgen cluster, retry sleeps
+        no-oped (virtual time must not wall-sleep) and the circuit
+        breaker's open-window on the soak's virtual clock.  One fan-out
+        per scheduling cycle gives the breaker its traffic."""
+        from karmada_tpu import chaos as chaos_mod
+
+        chaos_mod.configure("", seed=self.seed)
+        self._audit_baseline = chaos_mod.capture_baseline()
+        if self.realtime:
+            return
+        from karmada_tpu.estimator.client import (
+            AccurateEstimatorClient,
+            CircuitBreaker,
+        )
+        from karmada_tpu.estimator.wire import LocalTransport
+
+        breaker = CircuitBreaker(
+            failure_threshold=3,
+            reset_timeout_s=self.model.cost(self.scenario.batch_window),
+            clock=self.clock)
+        client = AccurateEstimatorClient(
+            breaker=breaker, sleep=lambda _s: None)
+        for c in self.plane.store.list(Cluster.KIND):
+            client.register(
+                c.metadata.name,
+                LocalTransport(lambda _m, _r: {"maxReplicas": 50,
+                                               "unschedulableReplicas": 0}))
+        self.estimator_client = client
+        self.estimator_breaker = breaker
+
+    def _estimator_probe(self) -> None:
+        """One per-cycle estimator fan-out across the live fleet (the
+        harness's stand-in for the scheduler's accurate-tier traffic).
+        Uses the unschedulable-replicas call — the one estimator method
+        with no rv-keyed memo, so every probe really crosses the wire
+        and the outage window's faults reach the breaker."""
+        for c in self.plane.store.list(Cluster.KIND):
+            self.estimator_client.unschedulable_replicas(
+                c.metadata.name, "Deployment", LOADGEN_NS, "probe")
+
     def _install(self) -> None:
         from karmada_tpu import obs
 
         assert not self._installed
         self._installed = True
         self._wall_t0 = _time.perf_counter()
+        if self._chaos:
+            self._setup_chaos()
         # arm the flight recorder (the report derives its latency/dwell
         # quantiles from cycle-span samples); restore on uninstall so a
         # soak inside a test suite leaves the global tracer untouched.
@@ -346,6 +440,10 @@ class LoadDriver:
                 t_end = self.clock.now() + self.model.cost(len(bindings))
                 self._inject_due(t_end)
                 self.clock.advance_to(t_end)
+                if self.estimator_client is not None:
+                    # chaos harness: one estimator fan-out per cycle keeps
+                    # the circuit breaker fed on the same virtual clock
+                    self._estimator_probe()
                 res = self._orig_schedule(bindings, clusters)
                 self._sample_queue()
                 return res
@@ -371,6 +469,12 @@ class LoadDriver:
             self._prev_queue_now = None
         self.plane.store.bus.unsubscribe(self._on_store_event)
         obs.TRACER.recorder = self._prev_recorder
+        if self._chaos:
+            # the chaos plane is process-wide: a finished soak must not
+            # leave faults armed for whatever runs next
+            from karmada_tpu import chaos as chaos_mod
+
+            chaos_mod.disarm()
         set_active(None)
 
     # -- traffic -------------------------------------------------------------
@@ -387,6 +491,18 @@ class LoadDriver:
             name, priority=prio, resource_name=self.resource_name))
 
     def _apply_cluster_event(self, spec) -> None:
+        if spec.kind in ("chaos", "chaos_clear"):
+            # scheduled fault window on the same virtual clock as the
+            # traffic: arm/clear rules on the process-wide chaos plane
+            from karmada_tpu import chaos as chaos_mod
+
+            plane = chaos_mod.active()
+            if plane is not None:
+                if spec.kind == "chaos":
+                    plane.add(spec.spec)
+                else:
+                    plane.clear(spec.spec or None)
+            return
         if spec.count <= 0:
             return  # a zero-count event is a no-op, NOT alive[-0:] == all
         store = self.plane.store
@@ -604,6 +720,20 @@ class LoadDriver:
                 self.plane.runtime.tick()
                 self._sample_queue()
             self._drain()
+            if self._chaos:
+                # chaos epilogue while the plane + rules are still armed:
+                # deliver any still-held watch events (a stalled event
+                # must not outlive the fault window), snapshot the fire
+                # log, and run the safety auditor over the intact queues
+                from karmada_tpu import chaos as chaos_mod
+
+                flushed = self.plane.store.bus.flush_held()
+                if flushed:
+                    self.plane.runtime.tick()
+                    self._drain()
+                self.chaos_state = chaos_mod.state_payload()
+                self.safety_audit = chaos_mod.audit_soak(
+                    self, self._audit_baseline)
         finally:
             self._uninstall()
         return report.build_soak_report(self)

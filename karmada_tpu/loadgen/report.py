@@ -172,6 +172,19 @@ def build_soak_report(driver) -> dict:
         **{k: fs[k] for k in ("injected", "scheduled", "failed_attempts",
                               "reschedules")},
     }
+    audit = getattr(driver, "safety_audit", None)
+    if audit is not None:
+        # chaos soak (karmada_tpu/chaos): the fault ledger and the
+        # conservation/accountability/recovery proof — CHAOS_r*.json is
+        # exactly this payload (bench.py --chaos)
+        payload["chaos"] = getattr(driver, "chaos_state", {})
+        payload["safety_audit"] = audit
+        breaker = getattr(driver, "estimator_breaker", None)
+        if breaker is not None:
+            payload["estimator_circuit"] = {
+                "states": breaker.states(),
+                "transitions": breaker.transition_log(),
+            }
     return payload
 
 

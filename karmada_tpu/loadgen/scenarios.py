@@ -32,17 +32,22 @@ from karmada_tpu.loadgen import arrival
 @dataclass(frozen=True)
 class ClusterEventSpec:
     """One scheduled fleet event.  kinds:
-    kill       delete `count` clusters and evict their placements (the
-               failover storm: every affected binding reschedules)
-    revive     recreate the most recently killed `count` clusters
-    flap_down  scale `count` clusters' allocatable by `scale` (< 1)
-    flap_up    restore flapped clusters to full capacity
+    kill        delete `count` clusters and evict their placements (the
+                failover storm: every affected binding reschedules)
+    revive      recreate the most recently killed `count` clusters
+    flap_down   scale `count` clusters' allocatable by `scale` (< 1)
+    flap_up     restore flapped clusters to full capacity
+    chaos       arm `spec` (karmada_tpu/chaos fault grammar) on the
+                process-wide chaos plane — fault windows open here
+    chaos_clear clear the chaos site named in `spec` (empty = all) —
+                fault windows close here
     """
 
     at_frac: float  # fraction of the scenario duration
-    kind: str       # kill | revive | flap_down | flap_up
+    kind: str       # kill | revive | flap_down | flap_up | chaos | chaos_clear
     count: int = 1
     scale: float = 0.5
+    spec: str = ""  # chaos fault spec / site (chaos kinds only)
 
 
 @dataclass(frozen=True)
@@ -64,6 +69,12 @@ class Scenario:
     admission_batches: float = 4.0      # admission bound, batch_windows
     events: Tuple[ClusterEventSpec, ...] = field(default_factory=tuple)
     slow: bool = False                  # heavy variant (excluded tier-1)
+
+    @property
+    def chaotic(self) -> bool:
+        """True when the schedule contains chaos fault events — the
+        driver arms the chaos plane and the safety auditor runs."""
+        return any(e.kind in ("chaos", "chaos_clear") for e in self.events)
 
     # -- derived quantities (given the service model's capacity) ------------
     def mean_rate(self, capacity_rate: float) -> float:
@@ -167,6 +178,41 @@ SCENARIOS: Dict[str, Scenario] = {s.name: s for s in (
                     "steady load",
         n_bindings=360, load_factor=0.6, deadline_cycles=6.0,
         events=_churn_events(flaps=6, count=1, scale=0.4),
+    ),
+    # the compressed chaos soak (ISSUE 8 acceptance shape): storm-grade
+    # arrivals + a cluster kill/revive, an estimator outage window (the
+    # circuit must open, then half-open-recover after the clear), one
+    # mid-cycle device fault of each flavor (a hang that degrades the
+    # backend — which must re-arm — and a dispatch raise that the cycle
+    # containment re-queues), and one resident-mirror corruption (the
+    # forced parity audit must rebuild bit-exact).  Event order matters:
+    # the hang lands while the estimator outage is still open (failures
+    # overlap), and the corruption waits until the backend has had its
+    # recovery cooldown.  Run it with ServeSlice(backend="device",
+    # resident=True, device_cycle_timeout_s=..., device_recover_cycles=..)
+    # — bench.py --chaos and tests/test_chaos.py both do.
+    Scenario(
+        name="chaos",
+        description="failure storm: 1.5x burst + kill/revive + estimator "
+                    "outage + device hang/raise + resident corruption",
+        n_bindings=420, load_factor=0.5,
+        deadline_cycles=1.0, admission_batches=3.0,
+        shape="burst", burst_factor=1.5,
+        burst_start_frac=0.3, burst_end_frac=0.55,
+        events=(
+            ClusterEventSpec(at_frac=0.2, kind="chaos",
+                             spec="estimator.rpc:error"),
+            ClusterEventSpec(at_frac=0.3, kind="kill", count=1),
+            ClusterEventSpec(at_frac=0.35, kind="chaos",
+                             spec="device.cycle:hang:3#1"),
+            ClusterEventSpec(at_frac=0.5, kind="chaos_clear",
+                             spec="estimator.rpc"),
+            ClusterEventSpec(at_frac=0.6, kind="revive", count=1),
+            ClusterEventSpec(at_frac=0.75, kind="chaos",
+                             spec="resident.mirror:corrupt#1"),
+            ClusterEventSpec(at_frac=0.85, kind="chaos",
+                             spec="device.dispatch:raise#1"),
+        ),
     ),
     # heavy variants: same shapes, production-shaped counts; marked slow
     # (bench --soak and the opt-in slow tests run them)

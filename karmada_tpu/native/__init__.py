@@ -96,6 +96,7 @@ def _build() -> Optional[str]:
             return f"g++ failed: {r.stderr[-800:]}"
         os.replace(_SO + ".tmp", _SO)
         return None
+    # vet: ignore[exception-hygiene] toolchain absence is a supported state; error kept in _build_error
     except Exception as e:  # noqa: BLE001 — toolchain absence is a supported state
         return f"native build unavailable: {e!r}"
 
@@ -164,6 +165,7 @@ def load_encode_fast():
             spec.loader.exec_module(mod)
             _enc_mod = mod
             return _enc_mod
+        # vet: ignore[exception-hygiene] optional acceleration; the build error is retained for report
         except Exception as e:  # noqa: BLE001 — optional acceleration only
             _enc_error = f"encode_fast unavailable: {e!r}"
             return None

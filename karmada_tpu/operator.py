@@ -97,6 +97,7 @@ class _Workflow:
         for condition, fn in self.tasks:
             try:
                 fn()
+            # vet: ignore[exception-hygiene] reported into the install-condition callback
             except Exception as e:  # noqa: BLE001 — reported, not raised
                 report(condition, False, repr(e))
                 return False
@@ -400,6 +401,7 @@ class KarmadaOperator:
         healthy = True
         try:
             plane.tick()
+        # vet: ignore[exception-hygiene] surfaced as status.api_ready=False
         except Exception:  # noqa: BLE001
             healthy = False
 

@@ -986,10 +986,12 @@ _NON_WORKLOAD_ARG = 28
 def _jit_cache_size():
     try:  # noqa: SLF001 — jax API
         n = schedule_compact._cache_size()
+    # vet: ignore[exception-hygiene] older jax: compile attribution degrades to None
     except Exception:  # noqa: BLE001 — older jax: attribution unavailable
         return None
     try:
         n += schedule_compact_donated._cache_size()  # noqa: SLF001
+    # vet: ignore[exception-hygiene] donated variant optional; the base count stands
     except Exception:  # noqa: BLE001 — donated variant is an optimization
         pass
     return n

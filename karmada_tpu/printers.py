@@ -88,6 +88,7 @@ def table_for(kind: str, objs) -> Tuple[List[str], List[Row]]:
     for o in objs:
         try:
             rows.append(fn(o))
+        # vet: ignore[exception-hygiene] a malformed object still renders a table row
         except Exception:  # noqa: BLE001 — a malformed object still prints
             rows.append(_default_row(o))
     return headers, rows

@@ -53,6 +53,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from karmada_tpu import chaos as chaos_mod
 from karmada_tpu import obs
 from karmada_tpu.estimator.general import GeneralEstimator
 from karmada_tpu.models.work import ResourceBindingStatus
@@ -301,6 +302,7 @@ class _DevicePlane:
                 tuple(self.np_refs[f] for f in CLUSTER_SIDE_FIELDS),
                 tuple(self.mirrors[f] for f in CLUSTER_SIDE_FIELDS),
                 gen)
+        # vet: ignore[exception-hygiene] logged + mirror path disabled (the broken flag IS the record)
         except Exception:  # noqa: BLE001 — mirrors are an optimization:
             # a failed device sync must degrade to plain dispatch-time
             # uploads, never take the scheduler down — but never silently:
@@ -644,6 +646,17 @@ class ResidentState:
         for this call (None = cadence)."""
         n = len(items)
         assert self.cindex is not None, "begin_cycle() before encode_cycle()"
+        if self.plane is not None and chaos_mod.armed():
+            # chaos seam (resident.mirror:corrupt): flip one value in a
+            # resident master and force THIS cycle's parity audit — the
+            # corrupted batch must be caught by the audit and replaced by
+            # the fresh encode before the solve reads it (auditable
+            # rebuild, never a wrong placement)
+            f = chaos_mod.fire(chaos_mod.SITE_RESIDENT_MIRROR,
+                               generation=self.generation)
+            if f is not None and f.mode == "corrupt":
+                self._chaos_corrupt()
+                audit = True
         if self.plane is None:
             # lossless fallback path: ONE full encode, adopted as masters
             batch = tensors.encode_batch(items, self.cindex, self.estimator,
@@ -692,6 +705,21 @@ class ResidentState:
                 return fresh
         self._sync_device()
         return batch
+
+    def _chaos_corrupt(self) -> None:
+        """Bit-flip one LIVE lane of a cluster-side master (the fault a
+        bad DMA / cosmic ray / buggy scatter kernel would produce),
+        through the same copy-on-write transaction real updates use, and
+        mark the mirror dirty so the corruption propagates exactly as
+        far as a real one would.  pods_allowed on a valid lane: a value
+        every solve reads and the parity audit compares unconditionally
+        — corruption in padded/retired vocabulary would be (correctly)
+        invisible to both."""
+        txn = _Txn(self.plane)
+        arr = txn.get("pods_allowed")
+        arr[max(self.nC - 1, 0) // 2] += 1
+        txn.commit()
+        self._mark_dirty("pods_allowed", None)
 
     def forget(self, key: str) -> None:
         """Drop one binding's cached row (binding deleted)."""

@@ -48,6 +48,24 @@ BACKEND_DEGRADED = REGISTRY.counter(
     ("to",),
 )
 
+BACKEND_REARMED = REGISTRY.counter(
+    "karmada_scheduler_backend_rearmed_total",
+    "Times a degraded scheduler re-armed the device backend after its "
+    "cooldown re-probe (device_recover_cycles) — degrade is no longer "
+    "one-way for transient faults",
+    ("backend",),
+)
+
+# cycle fault containment: a schedule_batch that RAISES must not lose its
+# popped bindings — they route to the backoff queue and the fault is
+# counted here by exception class (chaos device faults land here too)
+CYCLE_FAULTS = REGISTRY.counter(
+    "karmada_scheduler_cycle_faults_total",
+    "Scheduling cycles whose batch solve raised; the popped bindings "
+    "were re-queued to backoff instead of being lost, by exception class",
+    ("kind",),
+)
+
 QUEUE_INCOMING = REGISTRY.counter(
     "karmada_scheduler_queue_incoming_bindings_total",
     "Bindings added to scheduling queues by event type",

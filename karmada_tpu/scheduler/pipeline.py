@@ -62,6 +62,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from karmada_tpu import chaos as chaos_mod
 from karmada_tpu import obs
 from karmada_tpu.obs import decisions as obs_decisions
 from karmada_tpu.ops import tensors
@@ -302,6 +303,30 @@ def _record_decisions(recorder, batch, part, offset, keys, out_local,
                 key_of(i), r, nc, trace_id=tid, backend="device-big"))
 
 
+def _chaos_d2h(batch, idx, val, status, chunk_index: int) -> None:
+    """The device.d2h chaos seam, applied to the finalized COO planes.
+    `raise` fails the chunk outright; `poison` corrupts a COPY of the
+    index plane and runs it through the d2h invariant guard
+    (analysis/guards.check_d2h) — proving a poisoned result surfaces as
+    a loud InvariantViolation, never as a silently wrong placement."""
+    f = chaos_mod.fire(chaos_mod.SITE_DEVICE_D2H, chunk=chunk_index)
+    if f is None:
+        return
+    if f.mode == "poison":
+        poisoned = np.array(idx)
+        if poisoned.size:
+            from karmada_tpu.analysis import guards
+
+            dense_nnz = int(batch.B) * int(batch.C)
+            poisoned.flat[0] = dense_nnz + 7  # out of [-1, dense_nnz)
+            guards.check_d2h(poisoned, np.asarray(val),
+                             np.asarray(status), dense_nnz,
+                             where="chaos-d2h")
+            # check_d2h MUST have raised; reaching here means the guard
+            # stopped guarding — fail the chunk loudly either way
+    raise chaos_mod.ChaosFault(chaos_mod.SITE_DEVICE_D2H, f.mode)
+
+
 @dataclass
 class _InFlight:
     """A dispatched, not-yet-finalized chunk."""
@@ -529,6 +554,8 @@ def run_pipeline(
             else:
                 fin = finalize_compact(entry.handle)
             idx, val, status = fin[0], fin[1], fin[2]
+            if chaos_mod.armed():
+                _chaos_d2h(batch, idx, val, status, entry.index)
             if armed:
                 expl_planes = fin[-1]  # (verdict, score, avail, outcome)
             if live():
@@ -630,6 +657,12 @@ def run_pipeline(
             # chain stays contiguous (an all-invalid batch consumes nothing)
             handle = used0 = None
             if chain is not None or bool(np.any(batch.b_valid)):
+                if chaos_mod.armed():
+                    # chaos seam (device.dispatch:raise): a dispatch-time
+                    # device fault fails the whole cycle; the scheduler's
+                    # cycle-fault containment re-queues the batch
+                    chaos_mod.raise_if(chaos_mod.SITE_DEVICE_DISPATCH,
+                                       chunk=ci)
                 t_h2d = time.perf_counter()
                 d_span = (tracer.start_span(obs.SPAN_DISPATCH,
                                             parent=ch_span)

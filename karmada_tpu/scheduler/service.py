@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from karmada_tpu import chaos as chaos_mod
 from karmada_tpu import obs
 from karmada_tpu.obs import decisions as obs_decisions
 from karmada_tpu.estimator.general import GeneralEstimator
@@ -96,12 +97,27 @@ class Scheduler:
         mesh_shape=None,
         elector=None,  # utils.leaderelection.LeaderElector (None: always lead)
         # a device cycle exceeding this many seconds marks the backend dead
-        # and degrades ONE-WAY to the fastest working backend (the startup
+        # and degrades to the fastest working backend (the startup
         # probe cannot catch a tunnel that dies mid-serve, and a hung XLA
         # dispatch is uninterruptible in-process — the stuck cycle runs on
         # a discarded daemon thread).  None disables the guard (tests,
         # known-good hardware).
         device_cycle_timeout_s: Optional[float] = None,
+        # recoverable degrade: after this many scheduling cycles on the
+        # degraded backend the scheduler re-probes the device backend
+        # (half-open: ONE cycle tries device; the guard degrades again on
+        # failure, with the cooldown doubling per consecutive failed
+        # re-arm so a permanently dead tunnel converges to rare probes).
+        # None keeps the legacy one-way degrade.  Cycle-counted rather
+        # than wall-timed so compressed-virtual-clock soaks exercise the
+        # exact production path deterministically.
+        device_recover_cycles: Optional[int] = None,
+        # chaos plane (karmada_tpu/chaos, serve --chaos SPEC): arm the
+        # process-wide fault-injection plane with this spec string at
+        # construction.  None/"" leaves it disarmed (one list read per
+        # seam traversal).
+        chaos: Optional[str] = None,
+        chaos_seed: int = 0,
         # explain plane (obs/decisions, serve --explain[=RATE]): sample
         # rate in (0, 1] of scheduling cycles that run the solver's
         # explain jit variant and record per-binding placement Decision
@@ -165,6 +181,16 @@ class Scheduler:
         self.store = store
         self.backend = backend
         self.device_cycle_timeout_s = device_cycle_timeout_s
+        self.device_recover_cycles = device_recover_cycles
+        if chaos:
+            chaos_mod.configure(chaos, seed=chaos_seed)
+        # recoverable-degrade state (owned by the one cycle worker): the
+        # backend we degraded FROM (None = never degraded), cycles run
+        # since the degrade, and consecutive failed re-arms (cooldown
+        # escalation)
+        self._degraded_from: Optional[str] = None
+        self._cycles_since_degrade = 0
+        self._degrade_streak = 0
         # capacity-contention waves per solver chunk (ops/solver.py): the
         # chunk is priced in `waves` sequential waves, each seeing the
         # snapshot minus what earlier waves consumed; waves == batch size
@@ -221,18 +247,12 @@ class Scheduler:
         self._native_snap = None  # (clusters list, NativeSnapshot)
         self._resident = None
         self._delta_tracker = None
+        # remembered so a recovered backend re-arms the SAME resident
+        # configuration the operator chose (the degrade path detaches it)
+        self._resident_cfg = (bool(resident and backend == "device"),
+                              resident_audit_interval)
         if resident and backend == "device":
-            from karmada_tpu import resident as resident_mod
-            from karmada_tpu.resident import DeltaTracker, ResidentState
-
-            self._resident = ResidentState(
-                estimator=self._general,
-                audit_interval=resident_audit_interval)
-            self._delta_tracker = DeltaTracker()
-            # the tracker taps the same watch bus the scheduler does; its
-            # coalesced window drains at each device cycle's begin_cycle
-            store.bus.subscribe(self._delta_tracker.on_event)
-            resident_mod.set_active(self._resident)
+            self._arm_resident()
         if backend == "native":
             # warm the g++ build at startup so the first scheduling cycle
             # never blocks on a synchronous compile
@@ -242,6 +262,33 @@ class Scheduler:
         self.worker = runtime.register(AsyncWorker("scheduler", self._cycle))
         runtime.register_periodic(self._periodic_flush, name="scheduler")
         store.bus.subscribe(self._on_event)
+
+    def _arm_resident(self) -> None:
+        """Build + attach the resident-state plane (init and the
+        recovered-backend re-arm both land here)."""
+        from karmada_tpu import resident as resident_mod
+        from karmada_tpu.resident import DeltaTracker, ResidentState
+
+        self._resident = ResidentState(
+            estimator=self._general,
+            audit_interval=self._resident_cfg[1])
+        self._delta_tracker = DeltaTracker()
+        # the tracker taps the same watch bus the scheduler does; its
+        # coalesced window drains at each device cycle's begin_cycle
+        self.store.bus.subscribe(self._delta_tracker.on_event)
+        resident_mod.set_active(self._resident)
+
+    def _detach_resident(self) -> None:
+        """Tear the resident plane down (backend degrade: the host
+        backends never build SolverBatches, and the abandoned zombie may
+        still be mid-encode inside the plane)."""
+        from karmada_tpu import resident as resident_mod
+
+        if self._delta_tracker is not None:
+            self.store.bus.unsubscribe(self._delta_tracker.on_event)
+        self._resident = None
+        self._delta_tracker = None
+        resident_mod.set_active(None)
 
     # -- event wiring -------------------------------------------------------
     def _on_event(self, event: Event) -> None:
@@ -434,6 +481,11 @@ class Scheduler:
                               active_after=active_after_pop)
         if todo:
             sched_metrics.BATCH_SIZE.observe(len(todo))
+            # recoverable degrade: the cooldown ticks once per REAL
+            # scheduling cycle here — not per _solve call, which the
+            # affinity-failover loop invokes once per round and would
+            # expire the cooldown early on multi-term bindings
+            self._maybe_rearm_device()
             clusters = list(self.store.list(Cluster.KIND))
             # the batch's result-patch re-push echoes are gate-exempt for
             # the duration of this cycle (see _inflight_keys)
@@ -444,9 +496,29 @@ class Scheduler:
             # serial fallback, and estimator RPCs all nest under it
             with obs.TRACER.span(obs.SPAN_CYCLE, bindings=len(todo),
                                  backend=self.backend) as cspan:
+                outcomes = None
                 try:
                     outcomes = self.schedule_batch(
                         [rb for _, rb in todo], clusters)
+                except Exception as e:  # noqa: BLE001 — cycle fault
+                    # containment: a raising batch solve (device fault,
+                    # poisoned d2h, injected chaos) must not LOSE its
+                    # popped bindings — pop_ready already removed them, so
+                    # without this they would vanish until a cluster event
+                    # rescans the store.  Route every one to backoff and
+                    # count the fault; the worker keeps running.
+                    sched_metrics.CYCLE_FAULTS.inc(kind=type(e).__name__)
+                    import traceback
+
+                    traceback.print_exc()
+                    if cspan:
+                        cspan.set_attr(cycle_fault=type(e).__name__)
+                    with self._queue_lock:
+                        for info, _ in todo:
+                            self.queue.push_backoff_if_not_present(info)
+                    # the routing/metrics tail below runs over the empty
+                    # batch: nothing scheduled, nothing double-routed
+                    todo, outcomes = [], []
                 finally:
                     # the echoes fire inside schedule_batch (_apply_result
                     # patches); clear even on a raise, or the keys would
@@ -767,6 +839,19 @@ class Scheduler:
         cleared."""
         from karmada_tpu.scheduler import pipeline
 
+        if chaos_mod.armed():
+            # chaos seam (device.cycle:hang): a stalled accelerator tunnel
+            # looks exactly like this sleep — the mid-serve guard must
+            # abandon the cycle and degrade through its REAL path
+            f = chaos_mod.fire(chaos_mod.SITE_DEVICE_CYCLE,
+                               backend=self.backend)
+            if f is not None and f.mode == "hang":
+                time.sleep(f.delay)
+                if cancelled is not None and cancelled.is_set():
+                    # already abandoned by the guard: the zombie must not
+                    # go on to run a real solve the process may tear down
+                    # underneath it (XLA aborts on threads killed mid-op)
+                    return {}
         self._ensure_mesh()
         encode = None
         if self._resident is not None:
@@ -876,6 +961,7 @@ class Scheduler:
                                                     keys=keys,
                                                     explain=explain,
                                                     tokens=tokens)
+            # vet: ignore[exception-hygiene] boxed and re-raised on the caller thread
             except Exception as e:  # noqa: BLE001 — re-raised on the caller
                 box["err"] = e
 
@@ -892,46 +978,89 @@ class Scheduler:
                 trace_parent.set_attr(
                     cancelled=True, device_cycle_abandoned=True,
                     timeout_s=self.device_cycle_timeout_s)
-            from karmada_tpu import native as native_mod
-
-            self.backend = ("native" if native_mod.available() else "serial")
-            # the zombie thread still holds the old encoder cache: future
-            # cycles must never share it
-            self._enc_cache = None
-            self._enc_spec_sig = None
-            if self._resident is not None:
-                # the device backend is gone and the zombie may still be
-                # mid-encode inside the plane: detach it (the degraded
-                # backends never build SolverBatches) and stop reporting
-                # a resident plane at /debug/resident
-                from karmada_tpu import resident as resident_mod
-
-                if self._delta_tracker is not None:
-                    self.store.bus.unsubscribe(self._delta_tracker.on_event)
-                self._resident = None
-                self._delta_tracker = None
-                resident_mod.set_active(None)
-            if self.mesh_plan is not None:
-                # the device backend is gone: stop reporting an active
-                # solver mesh (/debug/state, karmada_mesh_* gauges)
-                from karmada_tpu.ops import meshing
-
-                meshing.deactivate()
-                self.mesh_plan = None
-            sched_metrics.BACKEND_DEGRADED.inc(to=self.backend)
-            import sys
-
-            print(
-                f"WARNING: device solve cycle exceeded "
-                f"{self.device_cycle_timeout_s:.0f}s (tunnel dead "
-                f"mid-serve?); abandoning it and degrading the scheduler "
-                f"to backend={self.backend} permanently",
-                file=sys.stderr, flush=True,
-            )
+            self._degrade_device()
             return {}
         if "err" in box:
             raise box["err"]  # type: ignore[misc]  # same surface as unguarded
+        # a clean device cycle while probing closes the half-open window
+        self._degrade_streak = 0
         return box.get("res", {})  # type: ignore[return-value]
+
+    def _degrade_device(self) -> None:
+        """Abandon the device backend after a hung cycle: fall to the
+        fastest working host backend and detach every device-coupled
+        plane (mesh, resident, encoder cache — the zombie thread may
+        still touch them).  With device_recover_cycles set this is a
+        COOLDOWN, not a death sentence: _maybe_rearm_device re-probes
+        after the cooldown, doubling it per consecutive failure."""
+        from karmada_tpu import native as native_mod
+
+        self.backend = ("native" if native_mod.available() else "serial")
+        self._degraded_from = "device"
+        self._cycles_since_degrade = 0
+        self._degrade_streak += 1
+        # the zombie thread still holds the old encoder cache: future
+        # cycles must never share it
+        self._enc_cache = None
+        self._enc_spec_sig = None
+        if self._resident is not None:
+            # the device backend is gone and the zombie may still be
+            # mid-encode inside the plane: detach it (the degraded
+            # backends never build SolverBatches) and stop reporting
+            # a resident plane at /debug/resident
+            self._detach_resident()
+        if self.mesh_plan is not None:
+            # the device backend is gone: stop reporting an active
+            # solver mesh (/debug/state, karmada_mesh_* gauges)
+            from karmada_tpu.ops import meshing
+
+            meshing.deactivate()
+            self.mesh_plan = None
+        sched_metrics.BACKEND_DEGRADED.inc(to=self.backend)
+        import sys
+
+        recover = self.device_recover_cycles
+        fate = ("permanently" if not recover else
+                f"for ~{recover * (2 ** (self._degrade_streak - 1))} "
+                "cycle(s) (cooldown re-probe armed)")
+        print(
+            f"WARNING: device solve cycle exceeded "
+            f"{self.device_cycle_timeout_s:g}s (tunnel dead "
+            f"mid-serve?); abandoning it and degrading the scheduler "
+            f"to backend={self.backend} {fate}",
+            file=sys.stderr, flush=True,
+        )
+
+    def _maybe_rearm_device(self) -> None:
+        """Half-open re-probe of a degraded device backend: after the
+        cooldown (device_recover_cycles scheduling cycles, doubled per
+        consecutive failed re-arm) the next cycle tries the device path
+        again.  A hang degrades it right back (the guard is still
+        armed); a clean cycle resets the escalation streak.  Runs on the
+        cycle worker only, once per non-empty cycle (_cycle)."""
+        if self._degraded_from != "device" or self.backend == "device":
+            return
+        if not self.device_recover_cycles:
+            return  # legacy one-way degrade
+        self._cycles_since_degrade += 1
+        need = self.device_recover_cycles * (
+            2 ** max(self._degrade_streak - 1, 0))
+        if self._cycles_since_degrade < need:
+            return
+        self.backend = "device"
+        self._cycles_since_degrade = 0
+        self._mesh_tried = False  # the mesh may reactivate with the device
+        self._native_snap = None
+        if self._resident_cfg[0] and self._resident is None:
+            self._arm_resident()
+        sched_metrics.BACKEND_REARMED.inc(backend="device")
+        import sys
+
+        print(
+            "scheduler re-arming the device backend after its degrade "
+            f"cooldown ({need} cycle(s)); the mid-serve guard stays armed",
+            file=sys.stderr, flush=True,
+        )
 
     def _solve(
         self,
@@ -968,6 +1097,7 @@ class Scheduler:
                             spec, status, clusters, cal,
                             enable_empty_workload_propagation=self.enable_empty_workload_propagation,
                         )
+                    # vet: ignore[exception-hygiene] failure returned as the binding's outcome object
                     except Exception as e:  # noqa: BLE001 — per-binding failure object
                         out[i] = e
                 if explain is not None:

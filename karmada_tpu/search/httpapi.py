@@ -245,6 +245,7 @@ class QueryPlaneServer:
                     return 200, _manifest_of(self.apply_fn(body))
                 except ConflictError as e:
                     last = e
+                # vet: ignore[exception-hygiene] admission denial answered as the HTTP error body
                 except Exception as e:  # noqa: BLE001 — admission denials
                     return 422, {"error": str(e)}
             return 409, {"error": f"conflict persisted across retries: {last}"}
@@ -258,6 +259,7 @@ class QueryPlaneServer:
                 self.store.delete(parts[1], ns, parts[-1])
             except KeyError:
                 return 404, {"error": "not found"}
+            # vet: ignore[exception-hygiene] answered as the HTTP error body
             except Exception as e:  # noqa: BLE001
                 return 422, {"error": str(e)}
             return 200, {"deleted": True}
@@ -377,6 +379,7 @@ class QueryPlaneServer:
             try:
                 lines = handle.logs(rest[1], rest[2],
                                     tail=int(tail[0]) if tail else None)
+            # vet: ignore[exception-hygiene] answered as the HTTP error body
             except Exception as e:  # noqa: BLE001 — pod not found
                 return 404, {"error": str(e)}
             return 200, {"lines": lines}
@@ -384,6 +387,7 @@ class QueryPlaneServer:
             command = (body or {}).get("command") or []
             try:
                 rc, out = handle.exec(rest[1], rest[2], command)
+            # vet: ignore[exception-hygiene] answered as the HTTP error body
             except Exception as e:  # noqa: BLE001
                 return 404, {"error": str(e)}
             return 200, {"rc": rc, "output": out}
@@ -480,6 +484,7 @@ class QueryPlaneServer:
                 try:
                     result = outer._handle(method, u.path, query, body,
                                            subject, self)
+                # vet: ignore[exception-hygiene] surfaced as a watch error frame to the client
                 except Exception as e:  # noqa: BLE001 — surface, don't die
                     self._send(500, {"error": repr(e)})
                     return

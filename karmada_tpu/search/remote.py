@@ -73,6 +73,7 @@ class RemoteTcpBackend(BackendStore):
                              ssl_context=ssl_context, timeout=timeout)
             try:
                 t.call("count", {})  # reachability probe
+            # vet: ignore[exception-hygiene] kept as the last error; the next address is tried
             except Exception as e:  # noqa: BLE001 — try the next address
                 last = e
                 continue

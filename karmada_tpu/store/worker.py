@@ -14,13 +14,31 @@ contract with two execution modes:
 from __future__ import annotations
 
 import contextlib
+import random
 import threading
 import time
 import traceback
+import zlib
 from collections import OrderedDict
 from typing import Callable, Dict, Hashable, List, Optional
 
-from karmada_tpu import obs
+from karmada_tpu import chaos, obs
+from karmada_tpu.utils.metrics import REGISTRY, exponential_buckets
+
+RECONCILE_ERRORS = REGISTRY.counter(
+    "karmada_worker_reconcile_errors_total",
+    "Reconcile (or periodic-hook) invocations that raised, by worker — "
+    "the retry/backoff machinery's input signal",
+    ("worker",),
+)
+
+WORKER_BACKOFF = REGISTRY.histogram(
+    "karmada_worker_backoff_seconds",
+    "Idle-poll backoff sleeps taken by serve-mode worker threads "
+    "(full-jitter exponential; soaks read this as retry pressure)",
+    ("worker",),
+    buckets=exponential_buckets(0.001, 2, 12),
+)
 
 
 class AsyncWorker:
@@ -101,6 +119,11 @@ class AsyncWorker:
         requeue = False
         tracer = obs.TRACER
         try:
+            if chaos.armed():
+                # chaos seam: an injected reconcile fault takes the SAME
+                # requeue/backoff path a real controller raise would
+                chaos.raise_if(chaos.SITE_WORKER_RECONCILE,
+                               worker=self.name, key=key)
             if tracer.enabled:
                 span = tracer.start_span(
                     obs.SPAN_RECONCILE_PREFIX + self.name,
@@ -114,6 +137,7 @@ class AsyncWorker:
                 result = self.reconcile(key)
             requeue = result is False
         except Exception:  # noqa: BLE001 — controller loops never die
+            RECONCILE_ERRORS.inc(worker=self.name)
             traceback.print_exc()
             requeue = True
         self._done(key, requeue)
@@ -286,16 +310,28 @@ class Runtime:
                 try:
                     fn()
                 except Exception:  # noqa: BLE001 — periodic hooks never die
+                    RECONCILE_ERRORS.inc(worker="periodic")
                     traceback.print_exc()
 
     def _run_worker(self, w: AsyncWorker) -> None:
-        backoff = 0.005
+        # full-jitter exponential backoff: the old fixed 0.005 -> 0.5s
+        # doubling put every idle worker on the SAME sleep schedule, so a
+        # shared-dependency blip (store stall, dead estimator) woke the
+        # whole fleet simultaneously and the retry storm re-synchronized
+        # each round.  Jitter draws uniform over [0, min(cap, base*2^k)];
+        # the stream is seeded per worker NAME (stable across runs —
+        # builtin hash() is process-randomized) so soaks replay.
+        rng = random.Random(zlib.crc32(w.name.encode("utf-8")))
+        base, cap = 0.005, 0.5
+        attempt = 0
         while not w._stopped:  # noqa: SLF001
             if w.process_one(block=True):
-                backoff = 0.005
+                attempt = 0
             else:
-                time.sleep(backoff)
-                backoff = min(backoff * 2, 0.5)
+                delay = rng.uniform(0.0, min(cap, base * (2 ** attempt)))
+                WORKER_BACKOFF.observe(delay, worker=w.name)
+                time.sleep(delay)
+                attempt = min(attempt + 1, 10)
 
     def stop(self) -> None:
         self._stop_event.set()

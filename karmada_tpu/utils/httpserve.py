@@ -28,6 +28,10 @@ ThreadingHTTPServer serves:
                          sizes, row-cache hit rate, delta depth, audit
                          outcomes (?recent=N adds per-cycle records);
                          {"enabled": false} when rebuild-per-cycle
+    /debug/chaos         chaos fault-injection plane (karmada_tpu/chaos,
+                         armed by `serve --chaos SPEC`): armed rules with
+                         fire counts, per-site totals, the recent fire
+                         log; {"enabled": false} when disarmed
 
 The trace endpoints read the process-wide tracer (karmada_tpu.obs.TRACER,
 armed by `karmadactl serve --trace-buffer N`) unless an explicit recorder
@@ -203,6 +207,11 @@ class ObservabilityServer:
                         pass
             return (json.dumps(resident.state_payload(recent)).encode(),
                     "application/json", 200)
+        if path == "/debug/chaos":
+            from karmada_tpu import chaos
+
+            return (json.dumps(chaos.state_payload()).encode(),
+                    "application/json", 200)
         if path == "/debug/explain":
             return (json.dumps(self._explain_payload()).encode(),
                     "application/json", 200)
@@ -225,6 +234,7 @@ class ObservabilityServer:
                 try:
                     body, ctype, code = outer._route(parsed.path,
                                                      parsed.query)
+                # vet: ignore[exception-hygiene] answered as a JSON 500 body
                 except Exception as e:  # noqa: BLE001 — JSON 500, never a
                     # closed connection with no body
                     body, ctype, code = outer._json_error(repr(e), 500)

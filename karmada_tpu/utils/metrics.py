@@ -72,6 +72,13 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
+    def total(self) -> float:
+        """Sum across every label combination (delta accounting for the
+        chaos safety auditor, which cannot enumerate label values that
+        only exist after faults fire)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def _render(self) -> List[str]:
         with self._lock:
             return [

@@ -1,0 +1,620 @@
+"""Chaos plane (karmada_tpu/chaos): deterministic fault injection,
+estimator circuit breaking, recoverable backend degrade, and the
+post-soak safety auditor.
+
+Covers the ISSUE-8 acceptance surface: the fault-spec grammar, per-seam
+injection semantics (estimator RPC, device dispatch/d2h, device cycle,
+resident mirrors, watch bus, worker reconcile, lease heartbeat), the
+typed estimator error taxonomy + bounded full-jitter retry + per-cluster
+circuit breaker, cycle fault containment (no binding lost), the
+degrade/cooldown/re-arm path, the /debug/chaos endpoint, the disarmed
+compile-cache check, and the compressed chaos soak with zero safety
+violations.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from karmada_tpu import chaos
+from karmada_tpu.estimator.client import (
+    CIRCUIT_CLOSED,
+    CIRCUIT_HALF_OPEN,
+    CIRCUIT_OPEN,
+    AccurateEstimatorClient,
+    CircuitBreaker,
+    ESTIMATOR_ERRORS,
+    ESTIMATOR_RETRIES,
+)
+from karmada_tpu.estimator.wire import (
+    LocalTransport,
+    Transport,
+    UNAUTHENTIC_REPLICA,
+)
+from karmada_tpu.loadgen import (
+    LoadDriver,
+    ServeSlice,
+    ServiceModel,
+    VirtualClock,
+    get_scenario,
+    warm_device_path,
+)
+from karmada_tpu.loadgen.driver import LOADGEN_NS, build_binding, build_cluster
+from karmada_tpu.models.cluster import Cluster
+from karmada_tpu.models.work import ResourceBinding
+from karmada_tpu.scheduler import metrics as sched_metrics
+from karmada_tpu.scheduler.queue import SchedulingQueue
+from karmada_tpu.scheduler.service import Scheduler
+from karmada_tpu.store.store import ADDED, Event, ObjectStore, WatchBus
+from karmada_tpu.store.worker import (
+    AsyncWorker,
+    RECONCILE_ERRORS,
+    Runtime,
+)
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    """No test may leak an armed chaos plane into the rest of the suite."""
+    yield
+    chaos.disarm()
+
+
+# ---------------------------------------------------------------------------
+# spec grammar + plane mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_spec_grammar_round_trip():
+    rules = chaos.parse_spec(
+        "estimator.rpc:error@0.25;device.cycle:hang:2.5#1,"
+        "store.watch:drop#3")
+    assert [(r.site, r.mode, r.arg, r.prob, r.count) for r in rules] == [
+        ("estimator.rpc", "error", None, 0.25, None),
+        ("device.cycle", "hang", 2.5, 1.0, 1),
+        ("store.watch", "drop", None, 1.0, 3),
+    ]
+
+
+@pytest.mark.parametrize("bad", [
+    "nope.site:raise",            # unknown site
+    "estimator.rpc:explode",      # unknown mode for the site
+    "estimator.rpc",              # no mode
+    "estimator.rpc:error@2.0",    # probability out of range
+    "estimator.rpc:error#x",      # bad count
+    "device.cycle:hang:abc",      # non-numeric arg
+])
+def test_spec_grammar_rejects(bad):
+    with pytest.raises(ValueError):
+        chaos.parse_spec(bad)
+
+
+def test_fire_budget_and_state_payload():
+    plane = chaos.configure("worker.reconcile:error#2", seed=7)
+    assert chaos.armed()
+    assert chaos.fire("worker.reconcile") is not None
+    assert chaos.fire("worker.reconcile") is not None
+    assert chaos.fire("worker.reconcile") is None  # budget spent
+    assert chaos.fire("estimator.rpc") is None     # no rule for the site
+    state = chaos.state_payload()
+    assert state["enabled"] and state["fired_total"] == 2
+    assert state["rules"][0]["fired"] == 2
+    assert plane.unspent_rules() == []
+    chaos.disarm()
+    assert not chaos.armed()
+    assert chaos.state_payload() == {"enabled": False}
+
+
+def test_probability_draws_are_seed_deterministic():
+    def draws(seed):
+        chaos.configure("store.watch:drop@0.5", seed=seed)
+        out = [chaos.fire("store.watch") is not None for _ in range(64)]
+        chaos.disarm()
+        return out
+
+    a, b, c = draws(3), draws(3), draws(4)
+    assert a == b, "same seed + call sequence must fire identically"
+    assert a != c, "a different seed must produce a different sequence"
+    assert 8 < sum(a) < 56  # the draw really is probabilistic
+
+
+def test_clear_closes_a_fault_window():
+    plane = chaos.configure("estimator.rpc:error;store.watch:drop")
+    assert plane.clear("estimator.rpc") == 1
+    assert chaos.fire("estimator.rpc") is None
+    assert chaos.fire("store.watch") is not None
+    assert plane.clear(None) == 1
+    assert chaos.fire("store.watch") is None
+
+
+# ---------------------------------------------------------------------------
+# estimator: typed classification, retry, circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class _FlakyTransport(Transport):
+    """Raises (or returns) a scripted sequence, then answers cleanly."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+
+    def call(self, method, request):
+        self.calls += 1
+        if self.script:
+            item = self.script.pop(0)
+            if isinstance(item, BaseException):
+                raise item
+            return item
+        return {"maxReplicas": 7, "unschedulableReplicas": 0}
+
+
+def _client(**kw):
+    kw.setdefault("sleep", lambda _s: None)
+    kw.setdefault("retry_attempts", 3)
+    return AccurateEstimatorClient(**kw)
+
+
+def _err(kind):
+    return ESTIMATOR_ERRORS.value(kind=kind)
+
+
+def test_typed_classification_and_retry():
+    client = _client()
+    t = _FlakyTransport([ConnectionError("boom"), TimeoutError("slow"),
+                         {"unschedulableReplicas": "garbage"}])
+    client.register("c1", t)
+    base = {k: _err(k) for k in ("unreachable", "timeout", "malformed")}
+    r0 = ESTIMATOR_RETRIES.value(method="GetUnschedulableReplicas")
+    # 3 attempts: unreachable, timeout, malformed -> call fails typed
+    assert client.unschedulable_replicas(
+        "c1", "Deployment", "ns", "x") == UNAUTHENTIC_REPLICA
+    assert _err("unreachable") == base["unreachable"] + 1
+    assert _err("timeout") == base["timeout"] + 1
+    assert _err("malformed") == base["malformed"] + 1
+    assert ESTIMATOR_RETRIES.value(
+        method="GetUnschedulableReplicas") == r0 + 2
+    # next call: clean answer, breaker stays closed
+    assert client.unschedulable_replicas("c1", "Deployment", "ns", "x") == 0
+    assert client.breaker.state("c1") == CIRCUIT_CLOSED
+
+
+def test_retry_recovers_transient_failure_within_one_call():
+    client = _client()
+    client.register("c1", _FlakyTransport([ConnectionError("blip")]))
+    assert client.unschedulable_replicas("c1", "Deployment", "ns", "x") == 0
+    assert client.breaker.state("c1") == CIRCUIT_CLOSED
+
+
+def test_full_jitter_backoff_is_bounded_and_deterministic():
+    slept = []
+    client = _client(sleep=slept.append, retry_attempts=4,
+                     retry_base_s=0.1, retry_cap_s=0.15)
+    client.register("c1", _FlakyTransport([ConnectionError()] * 4))
+    client.unschedulable_replicas("c1", "Deployment", "ns", "x")
+    assert len(slept) == 3
+    for k, s in enumerate(slept):
+        assert 0.0 <= s <= min(0.15, 0.1 * (2 ** k))
+
+
+def test_circuit_breaker_lifecycle_on_injected_clock():
+    clock = {"now": 0.0}
+    br = CircuitBreaker(failure_threshold=3, reset_timeout_s=10.0,
+                        clock=lambda: clock["now"])
+    for _ in range(3):
+        assert br.allow("c1")
+        br.record_failure("c1")
+    assert br.state("c1") == CIRCUIT_OPEN
+    assert not br.allow("c1")  # open: short-circuit
+    clock["now"] += 10.0
+    assert br.allow("c1")      # half-open probe
+    assert br.state("c1") == CIRCUIT_HALF_OPEN
+    assert not br.allow("c1")  # only ONE probe flies
+    br.record_failure("c1")    # failed probe re-opens for a full window
+    assert br.state("c1") == CIRCUIT_OPEN
+    clock["now"] += 9.9
+    assert not br.allow("c1")
+    clock["now"] += 0.2
+    assert br.allow("c1")
+    br.record_success("c1")
+    assert br.state("c1") == CIRCUIT_CLOSED
+    tos = [t["to"] for t in br.transition_log()]
+    assert tos == ["open", "half-open", "open", "half-open", "closed"]
+
+
+def test_open_circuit_short_circuits_the_wire():
+    clock = {"now": 0.0}
+    br = CircuitBreaker(failure_threshold=2, reset_timeout_s=100.0,
+                        clock=lambda: clock["now"])
+    client = _client(breaker=br, retry_attempts=1)
+    t = _FlakyTransport([ConnectionError(), ConnectionError()])
+    client.register("c1", t)
+    for _ in range(2):
+        client.unschedulable_replicas("c1", "Deployment", "ns", "x")
+    assert br.state("c1") == CIRCUIT_OPEN
+    calls_before = t.calls
+    base = _err("circuit_open")
+    assert client.unschedulable_replicas(
+        "c1", "Deployment", "ns", "x") == UNAUTHENTIC_REPLICA
+    assert t.calls == calls_before, "open circuit must not touch the wire"
+    assert _err("circuit_open") == base + 1
+
+
+def test_chaos_estimator_modes():
+    chaos.configure("estimator.rpc:garbage#1")
+    client = _client(retry_attempts=1)
+    client.register("c1", LocalTransport(
+        lambda m, r: {"unschedulableReplicas": 4}))
+    base = _err("malformed")
+    assert client.unschedulable_replicas(
+        "c1", "Deployment", "ns", "x") == UNAUTHENTIC_REPLICA
+    assert _err("malformed") == base + 1
+    # budget spent: the seam is transparent again
+    assert client.unschedulable_replicas("c1", "Deployment", "ns", "x") == 4
+    chaos.disarm()
+    chaos.configure("estimator.rpc:slow:0.0")
+    assert client.unschedulable_replicas("c1", "Deployment", "ns", "x") == 4
+
+
+# ---------------------------------------------------------------------------
+# watch bus, worker, lease seams
+# ---------------------------------------------------------------------------
+
+
+def _event(name="x"):
+    return Event(type=ADDED, obj=build_binding(name))
+
+
+def test_watch_bus_drop_dup_stall_reorder():
+    bus = WatchBus()
+    seen = []
+    bus.subscribe(lambda e: seen.append(e.obj.metadata.name))
+
+    chaos.configure("store.watch:drop#1")
+    bus.publish(_event("dropped"))
+    bus.publish(_event("a"))
+    assert seen == ["a"]
+
+    chaos.disarm()
+    chaos.configure("store.watch:dup#1")
+    bus.publish(_event("b"))
+    assert seen == ["a", "b", "b"]
+
+    chaos.disarm()
+    chaos.configure("store.watch:stall#1")
+    bus.publish(_event("held"))
+    assert seen == ["a", "b", "b"]  # held back
+    bus.publish(_event("c"))
+    # stall: delivered BEFORE the next event (delayed, order kept)
+    assert seen == ["a", "b", "b", "held", "c"]
+
+    chaos.disarm()
+    chaos.configure("store.watch:reorder#1")
+    bus.publish(_event("late"))
+    bus.publish(_event("d"))
+    # reorder: delivered AFTER the next event (order inverted)
+    assert seen[-2:] == ["d", "late"]
+
+
+def test_watch_bus_flush_held_delivers_stragglers():
+    bus = WatchBus()
+    seen = []
+    bus.subscribe(lambda e: seen.append(e.obj.metadata.name))
+    chaos.configure("store.watch:stall#1")
+    bus.publish(_event("straggler"))
+    assert seen == []
+    assert bus.flush_held() == 1
+    assert seen == ["straggler"]
+    assert bus.flush_held() == 0
+
+
+def test_worker_reconcile_fault_takes_the_retry_path():
+    done = []
+    w = AsyncWorker("chaos-test", lambda key: done.append(key))
+    chaos.configure("worker.reconcile:error#1")
+    base = RECONCILE_ERRORS.value(worker="chaos-test")
+    w.enqueue("k")
+    assert w.process_one()
+    assert done == [] and w.pending() == 1  # raised -> requeued
+    assert RECONCILE_ERRORS.value(worker="chaos-test") == base + 1
+    assert w.process_one()
+    assert done == ["k"]  # budget spent: the retry succeeds
+
+
+def test_lease_heartbeat_drop_ages_out_to_unknown():
+    from karmada_tpu.controllers.lease import (
+        LEASE_NAMESPACE,
+        ClusterLeaseMonitor,
+        Lease,
+        renew_cluster_lease,
+    )
+    from karmada_tpu.models.cluster import COND_CLUSTER_READY, ClusterSpec
+    from karmada_tpu.models.meta import ObjectMeta, get_condition
+
+    store = ObjectStore()
+    clock = {"now": 1000.0}
+    store.create(Cluster(metadata=ObjectMeta(name="m1"), spec=ClusterSpec()))
+    renew_cluster_lease(store, "m1", clock=lambda: clock["now"])
+    monitor = ClusterLeaseMonitor(store, Runtime(),
+                                  clock=lambda: clock["now"])
+    chaos.configure("lease.heartbeat:drop")
+    clock["now"] += 20.0
+    renew_cluster_lease(store, "m1", clock=lambda: clock["now"])  # dropped
+    lease = store.get(Lease.KIND, LEASE_NAMESPACE, "m1")
+    assert lease.renew_time == 1000.0, "the heartbeat must be suppressed"
+    clock["now"] += 100.0  # past 4 x 10s grace since the last REAL renewal
+    monitor.check_all()
+    cond = get_condition(store.get(Cluster.KIND, "", "m1").status.conditions,
+                         COND_CLUSTER_READY)
+    assert cond is not None and cond.status == "Unknown"
+
+
+# ---------------------------------------------------------------------------
+# scheduler: cycle fault containment, degrade + re-arm
+# ---------------------------------------------------------------------------
+
+
+def _slice(backend="device", **kw):
+    store = ObjectStore()
+    rt = Runtime()
+    sched = Scheduler(store, rt, backend=backend,
+                      queue=SchedulingQueue(initial_backoff_s=0.0), **kw)
+    for i in range(2):
+        store.create(build_cluster(f"cf-m{i}"))
+    return store, rt, sched
+
+
+def _all_scheduled(store):
+    rbs = list(store.list(ResourceBinding.KIND))
+    return rbs and all(rb.spec.clusters for rb in rbs)
+
+
+def test_cycle_fault_containment_no_binding_lost():
+    """A dispatch-time device fault fails the whole cycle; the popped
+    bindings must re-queue (backoff) and schedule on the retry instead
+    of vanishing until a cluster event rescans the store."""
+    store, rt, sched = _slice()
+    for i in range(3):
+        store.create(build_binding(f"cf-b{i}"))
+    chaos.configure("device.dispatch:raise#1")
+    base = sched_metrics.CYCLE_FAULTS.value(kind="ChaosFault")
+    rt.pump()          # the faulted cycle: contained, bindings -> backoff
+    rt.tick()          # flush_backoff (expiry 0) + the retry cycle
+    assert _all_scheduled(store)
+    assert sched_metrics.CYCLE_FAULTS.value(kind="ChaosFault") == base + 1
+
+
+def test_d2h_poison_surfaces_as_invariant_violation():
+    """A poisoned COO plane must fail LOUDLY through the d2h guard —
+    never decode into a wrong placement — and the cycle retries."""
+    store, rt, sched = _slice()
+    store.create(build_binding("poison-b0"))
+    chaos.configure("device.d2h:poison#1")
+    base = sched_metrics.CYCLE_FAULTS.value(kind="InvariantViolation")
+    rt.pump()
+    rt.tick()
+    assert _all_scheduled(store)
+    assert sched_metrics.CYCLE_FAULTS.value(
+        kind="InvariantViolation") == base + 1
+
+
+def test_degrade_then_cooldown_rearm():
+    store, rt, sched = _slice(device_cycle_timeout_s=None,
+                              device_recover_cycles=1)
+    store.create(build_binding("dg-warm"))
+    rt.pump()  # unguarded: pays the jit compile
+    sched.device_cycle_timeout_s = 0.5
+    chaos.configure("device.cycle:hang:1.5#1")
+    d0 = sched_metrics.BACKEND_DEGRADED.total()
+    r0 = sched_metrics.BACKEND_REARMED.value(backend="device")
+    store.create(build_binding("dg-b1"))
+    rt.pump()
+    rt.tick()
+    assert sched_metrics.BACKEND_DEGRADED.total() == d0 + 1
+    assert sched._degraded_from == "device"  # noqa: SLF001
+    # the abandoned batch re-entered through the degraded host backend
+    assert _all_scheduled(store)
+    # next cycle satisfies the 1-cycle cooldown: the plane re-arms device
+    store.create(build_binding("dg-b2"))
+    rt.pump()
+    rt.tick()
+    assert sched.backend == "device"
+    assert sched_metrics.BACKEND_REARMED.value(backend="device") == r0 + 1
+    assert _all_scheduled(store)
+    # give the abandoned zombie its sleep back before the test ends
+    time.sleep(1.2)
+
+
+def test_one_way_degrade_without_recover_cycles():
+    store, rt, sched = _slice(device_cycle_timeout_s=None,
+                              device_recover_cycles=None)
+    store.create(build_binding("ow-warm"))
+    rt.pump()
+    sched.device_cycle_timeout_s = 0.5
+    chaos.configure("device.cycle:hang:1.5#1")
+    store.create(build_binding("ow-b1"))
+    rt.pump()
+    rt.tick()
+    degraded_to = sched.backend
+    assert degraded_to != "device"
+    for i in range(3):
+        store.create(build_binding(f"ow-b{i + 2}"))
+        rt.pump()
+    assert sched.backend == degraded_to, "legacy degrade stays one-way"
+    time.sleep(1.2)
+
+
+# ---------------------------------------------------------------------------
+# resident corruption: auditable rebuild, never a wrong placement
+# ---------------------------------------------------------------------------
+
+
+def test_resident_corrupt_forces_bit_exact_rebuild():
+    from karmada_tpu.ops import tensors
+    from karmada_tpu.resident import ResidentState, RowToken
+    from karmada_tpu.resident.state import RESIDENT_AUDITS, compare_batches
+
+    clusters = [build_cluster(f"rc-m{i}") for i in range(3)]
+    bindings = [build_binding(f"rc-b{i}") for i in range(4)]
+    items = [(rb.spec, rb.status) for rb in bindings]
+    tokens = [RowToken(f"{LOADGEN_NS}/rc-b{i}", 1) for i in range(4)]
+    state = ResidentState(device_plane=False, audit_interval=0)
+    state.begin_cycle(clusters)
+    state.encode_cycle(items, tokens)  # adopt
+    state.begin_cycle(clusters)
+    chaos.configure("resident.mirror:corrupt#1")
+    m0 = RESIDENT_AUDITS.value(outcome="mismatch")
+    served = state.encode_cycle(items, tokens)
+    assert RESIDENT_AUDITS.value(outcome="mismatch") == m0 + 1
+    # the served batch is the FRESH encode, bit-exact — the corruption
+    # never reached a solve
+    fresh = tensors.encode_batch(items, state.cindex, state.estimator)
+    assert compare_batches(served, fresh) == []
+    stats = state.stats()
+    assert stats["audits"]["mismatch"] == 1
+    assert stats["rebuilds"].get("audit-mismatch") == 1
+    # the plane re-adopted and keeps serving
+    state.begin_cycle(clusters)
+    again = state.encode_cycle(items, tokens)
+    assert compare_batches(again, fresh) == []
+
+
+# ---------------------------------------------------------------------------
+# disarmed cost: no new jit compiles, seams inert
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_chaos_compiles_nothing_new():
+    """Compile-cache counter check (the explain plane's pattern): the
+    chaos seams are host-side only, so arming/disarming the plane must
+    never add a jit variant or recompile the disarmed signature."""
+    from karmada_tpu.ops import tensors
+    from karmada_tpu.ops.solver import _jit_cache_size, solve_compact
+
+    clusters = [build_cluster(f"cc-m{i}") for i in range(3)]
+    items = [(rb.spec, rb.status)
+             for rb in (build_binding(f"cc-b{i}") for i in range(2))]
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch(items, cindex)
+    solve_compact(batch, waves=1)
+    c0 = _jit_cache_size()
+    if c0 is None:
+        pytest.skip("jit cache size not exposed on this jax")
+    assert not chaos.armed()
+    solve_compact(batch, waves=1)
+    assert _jit_cache_size() == c0, "disarmed re-run must not recompile"
+    # even ARMED the seams are pure host work around the same programs
+    chaos.configure("device.d2h:poison#0")  # armed, zero budget
+    solve_compact(batch, waves=1)
+    assert _jit_cache_size() == c0, "the chaos plane must never touch jit"
+
+
+# ---------------------------------------------------------------------------
+# /debug/chaos
+# ---------------------------------------------------------------------------
+
+
+def test_debug_chaos_endpoint():
+    from karmada_tpu.utils.httpserve import ObservabilityServer
+
+    srv = ObservabilityServer()
+    url = srv.start()
+    try:
+        with urllib.request.urlopen(url + "/debug/chaos", timeout=5) as r:
+            assert json.loads(r.read()) == {"enabled": False}
+        chaos.configure("worker.reconcile:error#1", seed=3)
+        chaos.fire("worker.reconcile", worker="w")
+        with urllib.request.urlopen(url + "/debug/chaos", timeout=5) as r:
+            state = json.loads(r.read())
+        assert state["enabled"] and state["seed"] == 3
+        assert state["fired_by_site"] == {"worker.reconcile": 1}
+        assert state["recent"][0]["site"] == "worker.reconcile"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the compressed chaos soak (the ISSUE-8 acceptance run)
+# ---------------------------------------------------------------------------
+
+
+def _run_chaos_soak(seed=0):
+    scenario = get_scenario("chaos")
+    model = ServiceModel()
+    clock = VirtualClock()
+    plane = ServeSlice(scenario, clock, model, backend="device",
+                       resident=True, resident_audit_interval=0,
+                       device_cycle_timeout_s=2.0,
+                       device_recover_cycles=2)
+    warm_device_path(plane)
+    driver = LoadDriver(plane, scenario, clock=clock, model=model, seed=seed)
+    return plane, driver, driver.run()
+
+
+@pytest.mark.chaos
+@pytest.mark.soak
+def test_chaos_soak_zero_safety_violations():
+    """Storm arrivals + estimator outage + device hang/raise + resident
+    corruption, compressed: the circuit opens and half-open recovers,
+    the backend degrades and re-arms, the audit-forced rebuild stays
+    bit-exact, and the safety auditor reports ZERO conservation
+    violations."""
+    plane, driver, p = _run_chaos_soak()
+    audit = p["safety_audit"]
+    assert audit["violations"] == [], json.dumps(audit["violations"],
+                                                 indent=2)
+    fires = audit["fault_fires"]
+    # every scheduled single-shot fault reached its seam
+    assert fires.get("device.cycle") == 1
+    assert fires.get("device.dispatch") == 1
+    assert fires.get("resident.mirror") == 1
+    assert fires.get("estimator.rpc", 0) > 0
+    deltas = audit["metric_deltas"]
+    # estimator outage: typed errors counted, circuit opened AND closed
+    assert deltas["estimator_errors"] >= fires["estimator.rpc"]
+    tos = [t["to"] for t in p["estimator_circuit"]["transitions"]]
+    assert "open" in tos and "half-open" in tos and "closed" in tos
+    assert all(s == "closed"
+               for s in p["estimator_circuit"]["states"].values())
+    # the hang degraded the backend; the cooldown re-armed it
+    assert deltas["backend_degraded"] >= 1
+    assert deltas["backend_rearmed"] >= 1
+    assert plane.scheduler.backend == "device"
+    # the dispatch raise was contained (bindings re-queued, not lost)
+    assert deltas["cycle_faults"] >= 1
+    # the corruption was caught by the forced audit and rebuilt
+    assert deltas["resident_audits_mismatch"] == 1
+    # conservation: nothing lost, nothing double-placed, queues drained
+    cons = audit["conservation"]
+    assert cons["double_placed"] == 0
+    assert cons["unaccounted"] <= cons["shed_budget"]
+    assert cons["scheduled"] + cons["queued_residual"] \
+        + cons["unaccounted"] == cons["injected"]
+    assert p["residual_queue"] == {"active": 0, "backoff": 0,
+                                   "unschedulable": 0}
+    # the soak really stressed the plane
+    assert p["injected"] > 300 and p["scheduled"] > 300
+    # chaos is disarmed after the run (no leakage into the next test)
+    assert not chaos.armed()
+
+
+@pytest.mark.chaos
+@pytest.mark.soak
+def test_chaos_soak_traffic_is_seed_deterministic():
+    """Same seed -> identical arrival process and fault schedule (the
+    virtual-clock event times are derived, not wall-dependent)."""
+    s = get_scenario("chaos")
+    model = ServiceModel()
+
+    def arrivals(seed):
+        clock = VirtualClock()
+        plane = ServeSlice(s, clock, model)  # serial: arrivals only
+        d = LoadDriver(plane, s, clock=clock, model=model, seed=seed)
+        return list(d._arrivals), [t for t, _ in d._events]  # noqa: SLF001
+
+    assert arrivals(11) == arrivals(11)
+    assert arrivals(11)[0] != arrivals(12)[0]

@@ -1,0 +1,168 @@
+"""The failover chain end-to-end under a virtual-clock storm.
+
+Closes the e2e half of ROADMAP item 5 for controllers/{cluster,failover,
+lease}.py: two member clusters fail SIMULTANEOUSLY and the whole chain —
+Ready=False -> not-ready NoExecute taint -> toleration expiry -> graceful
+eviction whose task drains only after the replacement replicas report
+healthy -> the scheduler topping the lost replicas back up on the
+survivors — runs on an injected clock, so every deadline is exact and
+the storm replays deterministically.  A flapping cluster (recovered
+before its toleration expires) must come through the same storm
+untouched.
+"""
+
+from __future__ import annotations
+
+from karmada_tpu.controllers.binding import work_name
+from karmada_tpu.controllers.failover import TAINT_NOT_READY
+from karmada_tpu.e2e import ControlPlane
+from karmada_tpu.models.cluster import Cluster
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+    ClusterPreferences,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ReplicaSchedulingStrategy,
+    ResourceSelector,
+)
+from karmada_tpu.models.work import ResourceBinding, Work
+
+
+def _policy():
+    return PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(api_version="apps/v1",
+                                                 kind="Deployment")],
+            placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+            )),
+        ),
+    )
+
+
+def _deployment(replicas: int):
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "app", "namespace": "default"},
+        "spec": {"replicas": replicas, "template": {"spec": {
+            "containers": [{"name": "app", "image": "app:1",
+                            "resources": {"requests": {"cpu": "500m",
+                                                       "memory": "1Gi"}}}],
+        }}},
+    }
+
+
+def _rb(cp) -> ResourceBinding:
+    return cp.store.get(ResourceBinding.KIND, "default", "app-deployment")
+
+
+def test_failover_chain_under_virtual_clock_storm():
+    clock = {"now": 1000.0}
+    cp = ControlPlane(clock=lambda: clock["now"],
+                      eviction_grace_period_s=3600)
+    for m in ("m1", "m2", "m3", "m4"):
+        cp.add_member(m, cpu_milli=64_000)
+    cp.apply_policy(_policy())
+    cp.apply(_deployment(8))
+    cp.tick()
+    before = {t.name: t.replicas for t in _rb(cp).spec.clusters}
+    assert sum(before.values()) == 8 and len(before) == 4
+
+    # -- the storm: two clusters fail in the same instant -------------------
+    cp.member("m3").healthy = False
+    cp.member("m4").healthy = False
+    cp.tick()
+    for m in ("m3", "m4"):
+        cluster = cp.store.get(Cluster.KIND, "", m)
+        assert any(t.key == TAINT_NOT_READY for t in cluster.spec.taints), \
+            f"{m}: Ready=False must add the not-ready NoExecute taint"
+    # the defaulted 300s toleration holds the placements for now
+    rb = _rb(cp)
+    assert {t.name for t in rb.spec.clusters} >= {"m3", "m4"}
+    assert not rb.spec.graceful_eviction_tasks
+
+    # -- flap leg: m4 recovers before its toleration expires ----------------
+    clock["now"] += 120.0
+    cp.member("m4").healthy = True
+    cp.tick()
+    cluster = cp.store.get(Cluster.KIND, "", "m4")
+    assert not any(t.key == TAINT_NOT_READY for t in cluster.spec.taints)
+
+    # -- toleration expiry evicts the sustained failure ---------------------
+    clock["now"] += 301.0
+    cp.tick()
+    rb = _rb(cp)
+    names = {t.name: t.replicas for t in rb.spec.clusters}
+    assert "m3" not in names, "toleration expired: m3 must be evicted"
+    assert "m4" in names, "the flapped cluster must survive the storm"
+    # the scheduler topped the lost replicas back up on the survivors
+    assert sum(names.values()) == 8
+    # graceful eviction created the drain task, and the stale Work
+    # survives until the replacement reports healthy (grace period is 1h,
+    # so only replacement health can drain it)
+    task_seen = bool(rb.spec.graceful_eviction_tasks)
+    if task_seen:
+        assert rb.spec.graceful_eviction_tasks[0].from_cluster == "m3"
+        assert cp.store.try_get(Work.KIND, "karmada-es-m3",
+                                work_name(rb)) is not None
+
+    # -- replacement reports healthy: the eviction task drains --------------
+    cp.tick()
+    cp.tick()
+    rb = _rb(cp)
+    assert not rb.spec.graceful_eviction_tasks
+    assert cp.store.try_get(Work.KIND, "karmada-es-m3", work_name(rb)) is None
+
+    # -- recovery: m3 rejoins and is schedulable again ----------------------
+    cp.member("m3").healthy = True
+    cp.tick()
+    cluster = cp.store.get(Cluster.KIND, "", "m3")
+    assert not any(t.key == TAINT_NOT_READY for t in cluster.spec.taints)
+
+
+def test_storm_eviction_pacing_is_rate_limited():
+    """A zone-wide storm's evictions flow through the rate-limited queue
+    (cluster/eviction_worker.go semantics): with eviction_rate tiny, one
+    tick drains at most the accrued token allowance instead of
+    stampeding every binding through rescheduling at once."""
+    clock = {"now": 1000.0}
+    cp = ControlPlane(clock=lambda: clock["now"], eviction_rate=1.0,
+                      eviction_grace_period_s=0,
+                      default_toleration_seconds=None)
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.add_member("m2", cpu_milli=64_000)
+    cp.apply_policy(_policy())
+    # several workloads so the kill enqueues several evictions
+    for i in range(4):
+        d = _deployment(2)
+        d["metadata"]["name"] = f"app{i}"
+        cp.apply(d)
+    cp.tick()
+
+    cp.member("m2").healthy = False
+    cp.tick()
+    # untolerated taint: every binding targeting m2 is due immediately,
+    # but the paced queue drains them one token at a time
+    pending_after_first = cp.eviction_queue.pending()
+    total_evictions = 4
+    drained_first = total_evictions - pending_after_first
+    assert drained_first < total_evictions, \
+        "rate 1/s must not drain the whole storm in one tick"
+    # accrue tokens on the virtual clock until the queue empties
+    for _ in range(8):
+        clock["now"] += 1.0
+        cp.tick()
+    assert cp.eviction_queue.pending() == 0
+    for i in range(4):
+        rb = cp.store.get(ResourceBinding.KIND, "default",
+                          f"app{i}-deployment")
+        assert not any(t.name == "m2" for t in rb.spec.clusters)
+        assert sum(t.replicas for t in rb.spec.clusters) == 2

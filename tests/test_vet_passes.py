@@ -531,3 +531,69 @@ def test_guards_disarmed_is_noop():
     # disarmed: the drifted batch still dispatches (pre-vet behavior)
     res = solver.solve_compact(batch, waves=1)
     assert res[3] >= 0
+
+
+# -- pass 6: exception-hygiene ----------------------------------------------
+
+EXC_BAD = """
+    def swallow():
+        try:
+            risky()
+        except Exception:
+            pass
+
+    def swallow_bare():
+        try:
+            risky()
+        except:  # noqa: E722
+            return None
+"""
+
+EXC_FIXED = """
+    METRIC = object()
+
+    def reraises():
+        try:
+            risky()
+        except Exception as e:
+            raise RuntimeError("wrapped") from e
+
+    def counts():
+        try:
+            risky()
+        except Exception:
+            FAULTS.inc(kind="x")
+
+    def typed_is_fine():
+        try:
+            risky()
+        except ValueError:
+            pass
+"""
+
+
+def test_exception_hygiene_catches_seeded(tmp_path):
+    report = _vet(tmp_path, "mod.py", EXC_BAD)
+    lines = [f.line for f in report.findings
+             if f.rule == "exception-hygiene"]
+    assert len(lines) == 2  # the blanket AND the bare except
+
+
+def test_exception_hygiene_clean_on_fixed(tmp_path):
+    report = _vet(tmp_path, "mod.py", EXC_FIXED)
+    assert [f for f in report.findings
+            if f.rule == "exception-hygiene"] == []
+
+
+def test_exception_hygiene_waiver(tmp_path):
+    report = _vet(tmp_path, "mod.py", """
+        def swallow():
+            try:
+                risky()
+            # vet: ignore[exception-hygiene] error body answers the peer
+            except Exception:
+                return None
+    """)
+    assert [f for f in report.findings
+            if f.rule == "exception-hygiene"] == []
+    assert any(w.rule == "exception-hygiene" for w in report.waivers)
