@@ -24,10 +24,14 @@ from typing import Dict, List, Optional, Sequence
 from karmada_tpu.analysis.core import Finding, SourceFile, dotted
 
 _CTOR_DTYPE_POS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2,
-                   "asarray": 1, "array": 1}
+                   "asarray": 1, "array": 1, "ascontiguousarray": 1}
 
-#: table variable names the pass harvests from scanned files
-TABLE_NAMES = ("FIELD_DTYPES", "CARRY_DTYPES")
+#: table variable names the pass harvests from scanned files.
+#: NATIVE_ABI_DTYPES (ops/tensors.py) covers the native decode boundary —
+#: the int32 COO / verdict planes handed to native/decode_fast.c, whose C
+#: loop reads raw buffers and would decode garbage (not crash) on a
+#: drifted dtype, the same class of bug as NativeSnapshot.name_rank.
+TABLE_NAMES = ("FIELD_DTYPES", "CARRY_DTYPES", "NATIVE_ABI_DTYPES")
 
 _DTYPE_NORMALIZE = {
     "bool": "bool", "bool_": "bool",

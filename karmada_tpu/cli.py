@@ -45,7 +45,8 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                 resident_audit: int = 64,
                 device_recover_cycles: Optional[int] = None,
                 chaos: Optional[str] = None,
-                chaos_seed: int = 0):
+                chaos_seed: int = 0,
+                aot_cache: str = "off"):
     """controllers=None rehydrates the persisted --controllers spec; an
     explicit spec is also persisted so later invocations honor it.
 
@@ -57,6 +58,7 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
     from karmada_tpu.e2e import ControlPlane
     from karmada_tpu.models.cluster import Cluster
 
+    diag: dict = {}
     if probe_device and backend == "device":
         from karmada_tpu.utils.deviceprobe import resolve_backend
 
@@ -68,6 +70,21 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
         from karmada_tpu.ops.meshing import parse_shape
 
         mesh_shape = parse_shape(mesh)  # ValueError on malformed BxC
+    if aot_cache != "off" and backend == "device":
+        # arm the persistent compile cache BEFORE the plane loads (it must
+        # precede the first in-process jit — rehydration may already run
+        # solves); accelerator artifacts share one dir across hosts, CPU
+        # artifacts are host-feature keyed (ops/aotcache).  Device backend
+        # only — the host backends never jit, and a probe-degraded plane
+        # must not pay an in-process jax import it will never use
+        from karmada_tpu.ops import aotcache as aot_mod
+        from karmada_tpu.utils.deviceprobe import ACCELERATOR_PLATFORMS
+
+        plat = str(diag.get("platform") or "").lower()
+        hint = ("accel"
+                if any(p in plat for p in ACCELERATOR_PLATFORMS) else "cpu")
+        aot_mod.enable(None if aot_cache in ("", "on") else aot_cache,
+                       platform_hint=hint, mesh=mesh_shape)
     cp = ControlPlane(backend=backend, persist_dir=directory, waves=waves,
                       controllers=controllers, pipeline_chunk=pipeline_chunk,
                       mesh_shape=mesh_shape,
@@ -1081,10 +1098,42 @@ def cmd_serve(args) -> int:
                              args.device_recover_cycles
                              if args.device_recover_cycles > 0 else None),
                          chaos=args.chaos or None,
-                         chaos_seed=args.chaos_seed)
+                         chaos_seed=args.chaos_seed,
+                         aot_cache=args.aot_cache)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 1
+    if args.aot_cache != "off" and cp.scheduler.backend == "device":
+        # AOT warm-start (ops/aotcache): pre-compile every pow2 batch shape
+        # x jit variant this configuration can dispatch on a background
+        # thread, so the first real cycle of each shape deserializes from
+        # the persistent cache instead of paying the XLA compile.  Device
+        # backend only — the host backends never build solver executables.
+        from karmada_tpu.models.cluster import Cluster as _Cluster
+        from karmada_tpu.ops import aotcache as aot_mod
+
+        sched = cp.scheduler
+        warm_shapes = aot_mod.warm_shapes(sched.batch_window,
+                                          sched.pipeline_chunk)
+        warm_variants = aot_mod.variants_for(
+            sched.explain, sched.batch_window > sched.pipeline_chunk)
+        aot_mod.start_background_warmup(
+            lambda: list(cp.store.list(_Cluster.KIND)), sched._general,
+            shapes=warm_shapes, variants=warm_variants, waves=sched.waves,
+            keep_sel=sched.enable_empty_workload_propagation)
+        aot_state = aot_mod.state_payload()
+        if aot_state["armed"]:
+            print(f"AOT executable plane armed: persistent compile cache "
+                  f"at {aot_state['cache_dir']} (key {aot_state['key']}); "
+                  f"background warm-start over {len(warm_shapes)} pow2 "
+                  f"shape(s) x {len(warm_variants)} jit variant(s) — "
+                  "progress in /debug/state aot section")
+        else:
+            print("WARNING: persistent compile cache unavailable on this "
+                  "jax; background warm-start still pre-compiles "
+                  f"{len(warm_shapes)} shape(s) x {len(warm_variants)} "
+                  "variant(s) for THIS process, but restarts will re-pay "
+                  "the compiles", file=sys.stderr)
     if args.chaos:
         print(f"CHAOS PLANE ARMED (seed {args.chaos_seed}): {args.chaos} — "
               "deterministic faults will fire at the named seams; state "
@@ -1770,6 +1819,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "every compact solve over the mesh "
                          "(ops/meshing.py); a single-device environment "
                          "silently falls back to the unsharded dispatch")
+    sv.add_argument("--aot-cache", default="on", metavar="DIR|off",
+                    help="AOT executable plane (ops/aotcache, on by "
+                         "default): persist compiled solver executables "
+                         "across processes (cache dir keyed by platform, "
+                         "host CPU features, jax version and mesh "
+                         "topology; DIR overrides the keyed default) and "
+                         "AOT pre-compile every pow2 batch shape x jit "
+                         "variant this configuration can dispatch on a "
+                         "background thread at startup, so a fresh serve "
+                         "plane skips the ~100s first-cycle compile "
+                         "warmup.  'off' disables both (legacy cold "
+                         "start)")
     sv.add_argument("--metrics-port", type=int, default=-1,
                     help="serve /metrics,/healthz,/readyz,/debug/state on "
                          "127.0.0.1:PORT (0 = ephemeral, -1 = disabled)")

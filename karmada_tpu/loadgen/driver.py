@@ -163,7 +163,8 @@ def build_binding(name: str, priority: int = 0,
     return rb
 
 
-def warm_device_path(plane, sizes: Tuple[int, ...] = (2, 9, 17, 64)) -> None:
+def warm_device_path(plane, sizes: Tuple[int, ...] = (2, 9, 17, 64),
+                     aot_variants: bool = True) -> None:
     """Compile-warm a device-backend slice before a guarded soak: direct
     schedule_batch calls pay the jit compile cost OUTSIDE the mid-serve
     death guard's window, so a tight device_cycle_timeout_s measures
@@ -172,7 +173,15 @@ def warm_device_path(plane, sizes: Tuple[int, ...] = (2, 9, 17, 64)) -> None:
     the soak's variable cuts will hit — an unseen shape mid-soak would
     compile fresh and read as a hung cycle.  The warm bindings stay in
     the store as ordinary residents (not flight-tracked, so reports and
-    audits ignore them)."""
+    audits ignore them).
+
+    The store-driven cycles above only compile the PLAIN pow2 variants;
+    with `aot_variants` (default) the remaining jit variants this
+    scheduler can actually dispatch — explain-sampled cycles, the carry /
+    donated chain of multi-chunk cycles, mesh-placed when a solver mesh
+    is active — are AOT pre-compiled too (ops/aotcache), so the first
+    explain-sampled or donated cycle mid-soak doesn't eat a silent
+    mid-traffic compile that reads as a hung cycle."""
     from karmada_tpu.models.work import ResourceBinding as _RB
 
     sched = plane.scheduler
@@ -191,6 +200,19 @@ def warm_device_path(plane, sizes: Tuple[int, ...] = (2, 9, 17, 64)) -> None:
                    for name in names]
             sched.schedule_batch(
                 [rb for rb in rbs if rb is not None], clusters)
+        if aot_variants:
+            from karmada_tpu.ops import aotcache
+
+            variants = tuple(
+                v for v in aotcache.variants_for(
+                    sched.explain,
+                    sched.batch_window > sched.pipeline_chunk)
+                if v != aotcache.VARIANT_PLAIN)
+            if variants:
+                aotcache.warm_executables(
+                    clusters, sched._general,  # noqa: SLF001 — same package
+                    shapes=sizes, variants=variants, waves=sched.waves,
+                    keep_sel=sched.enable_empty_workload_propagation)
     finally:
         sched.device_cycle_timeout_s = prev
 

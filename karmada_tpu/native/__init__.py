@@ -73,10 +73,13 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "serial_solver.cc")
 _SO = os.path.join(_DIR, "_serial_solver.so")
 _ENC_SRC = os.path.join(_DIR, "encode_fast.c")
+_DEC_SRC = os.path.join(_DIR, "decode_fast.c")
 # ABI-tagged filename: a CPython-API extension must never be loaded into a
 # different interpreter version than the one that built it
 _ENC_SO = os.path.join(
     _DIR, f"_encode_fast.{__import__('sys').implementation.cache_tag}.so")
+_DEC_SO = os.path.join(
+    _DIR, f"_decode_fast.{__import__('sys').implementation.cache_tag}.so")
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -173,6 +176,57 @@ def load_encode_fast():
 
 def encode_fast_error() -> Optional[str]:
     return _enc_error
+
+
+# -- decode fast path (CPython extension) -------------------------------------
+
+_dec_mod = None
+_dec_error: Optional[str] = None
+
+
+def load_decode_fast():
+    """The _decode_fast extension module (native COO decode,
+    decode_fast.c), building it on demand; None when the toolchain or
+    headers are unavailable (ops/tensors.decode_compact falls back to
+    the Python builder, which stays the behavior-defining parity
+    control)."""
+    global _dec_mod, _dec_error
+    with _lib_lock:
+        if _dec_mod is not None:
+            return _dec_mod
+        if _dec_error is not None:
+            return None
+        try:
+            import sysconfig
+
+            if (not os.path.exists(_DEC_SO)
+                    or os.path.getmtime(_DEC_SO) < os.path.getmtime(_DEC_SRC)):
+                inc = sysconfig.get_path("include")
+                r = subprocess.run(
+                    ["gcc", "-O2", "-shared", "-fPIC", f"-I{inc}",
+                     "-o", _DEC_SO + ".tmp", _DEC_SRC],
+                    capture_output=True, text=True, timeout=180,
+                )
+                if r.returncode != 0:
+                    _dec_error = f"gcc failed: {r.stderr[-800:]}"
+                    return None
+                os.replace(_DEC_SO + ".tmp", _DEC_SO)
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "karmada_tpu.native._decode_fast", _DEC_SO)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _dec_mod = mod
+            return _dec_mod
+        # vet: ignore[exception-hygiene] optional acceleration; the build error is retained for report
+        except Exception as e:  # noqa: BLE001 — optional acceleration only
+            _dec_error = f"decode_fast unavailable: {e!r}"
+            return None
+
+
+def decode_fast_error() -> Optional[str]:
+    return _dec_error
 
 
 # ---------------------------------------------------------------------------

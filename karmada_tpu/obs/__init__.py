@@ -48,6 +48,8 @@ SPAN_BIG = "pipeline.big"                 # big-tier sub-solve (finalize)
 SPAN_WAIT = "pipeline.solve_wait"         # device execution wait
 SPAN_D2H = "pipeline.d2h"                 # sparse result copy (+ escalation)
 SPAN_DECODE = "pipeline.decode"           # COO decode to per-binding results
+# ops/aotcache.py (AOT executable plane)
+SPAN_WARMUP = "solver.warmup"             # AOT pre-compile of warm shapes
 # estimator/client.py
 SPAN_ESTIMATOR_RPC = "estimator.rpc"      # one per-cluster estimator call
 # karmada_tpu/resident (the device-resident state plane)
@@ -65,6 +67,7 @@ SPAN_NAMES = (
     SPAN_DISPATCH, SPAN_SPREAD, SPAN_BIG, SPAN_WAIT, SPAN_D2H, SPAN_DECODE,
     SPAN_ESTIMATOR_RPC, SPAN_RESIDENT_APPLY, SPAN_RESIDENT_ENCODE,
     SPAN_RESIDENT_AUDIT, SPAN_BINDING_RENDER, SPAN_DETECTOR_MATCH,
+    SPAN_WARMUP,
 )
 
 # every pipeline stage a healthy device chunk must traverse (the tier-1

@@ -1,0 +1,434 @@
+"""AOT executable plane: persistent compile cache + warm-start pre-compiles.
+
+A fresh serve plane used to pay the full jit compile warmup (~100s of
+`compile_warmup_s` in BENCH_r02) because the persistent compilation cache
+lived only in bench.py and nothing pre-compiled the solver executables
+before the first real cycle.  This module owns both halves of the fix:
+
+* ``enable()`` — the ONE place the jax persistent compilation cache is
+  armed (bench.py's three call sites and ``serve --aot-cache`` all land
+  here).  The cache directory is keyed by platform, host CPU features,
+  jax version and the configured mesh topology so an artifact compiled
+  on one host/layout is never loaded on an incompatible one (XLA:CPU
+  executables are host-feature-specific — observed SIGILL risk), while
+  accelerator executables (which target the chip, not the host) share
+  one dir across hosts.  Arming also registers a jax monitoring listener
+  that feeds ``karmada_solver_compile_cache_{hits,misses}_total`` — the
+  cold-start story is measured, not guessed.
+
+* ``warm_executables()`` — AOT pre-compile of the compact-solve
+  executables for every pow2 batch shape x jit variant the pipeline can
+  dispatch (plain / explain / carry / donated, mesh-placed when a solver
+  mesh is active) via the pjit ``.lower().compile()`` surface.  Nothing
+  executes: lowering runs from abstract ShapeDtypeStructs, so warming
+  never touches the device-transfer cache, never donates a real buffer,
+  and never produces a result to discard.  With the persistent cache
+  armed the compiles land on disk, so the FIRST real dispatch of a
+  warmed shape (and every later process) pays deserialization instead
+  of compilation.  ``start_background_warmup()`` runs it on a daemon
+  thread under a ``solver.warmup`` flight-recorder span — the serve
+  plane schedules its first cycle while the warm set compiles behind it.
+
+``state_payload()`` serves the ``aot`` section of ``/debug/state``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from karmada_tpu.utils.metrics import REGISTRY
+
+#: jit variants of the compact dispatch the pipeline can reach
+#: (scheduler/pipeline.py): plain single-chunk cycles, the explain jit
+#: variant of sampled cycles, the with_used carry chain of multi-chunk
+#: cycles, and its buffer-donated form.
+VARIANT_PLAIN = "plain"
+VARIANT_EXPLAIN = "explain"
+VARIANT_CARRY = "carry"
+VARIANT_DONATED = "donated"
+ALL_VARIANTS = (VARIANT_PLAIN, VARIANT_EXPLAIN, VARIANT_CARRY,
+                VARIANT_DONATED)
+
+COMPILE_CACHE_HITS = REGISTRY.counter(
+    "karmada_solver_compile_cache_hits_total",
+    "Solver executables served from the persistent compilation cache",
+)
+COMPILE_CACHE_MISSES = REGISTRY.counter(
+    "karmada_solver_compile_cache_misses_total",
+    "Solver compilations the persistent compilation cache could not serve",
+)
+
+# guarded-by: _LOCK; mutators: enable,disable_for_tests,_set_warm,_listener
+_STATE: Dict[str, object] = {
+    "armed": False,
+    "cache_dir": None,
+    "key": None,
+    # per-(shape, variant) warm ledger: "B{b}xC{c}:{variant}" ->
+    # {"state": pending|compiling|done|error|skipped, "seconds": float}
+    "warmup": {},
+    "warmup_thread": None,  # "running" | "done" | "error: ..." | None
+}
+_LOCK = threading.Lock()
+_LISTENER_ARMED = False
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def _listener(event: str, **_kw) -> None:
+    """jax monitoring tap: count persistent-cache hits/misses as they
+    happen (every jit compile in the process flows through here once
+    the cache is armed)."""
+    if event == _HIT_EVENT:
+        COMPILE_CACHE_HITS.inc()
+    elif event == _MISS_EVENT:
+        COMPILE_CACHE_MISSES.inc()
+
+
+def machine_tag() -> str:
+    """Short stable fingerprint of this host's CPU feature set.
+
+    XLA:CPU executables are compiled FOR the build host's CPU features;
+    loading one on a host with a different feature set risks SIGILL.
+    Unknown layouts (non-x86/arm, unreadable /proc) fall back to the full
+    uname PLUS a marker so those hosts at least never share a dir with a
+    feature-fingerprinted one."""
+    keys = ("flags", "Features", "model name", "vendor_id", "cpu family",
+            "CPU implementer", "CPU part")
+    ident: List[str] = []
+    try:
+        with open("/proc/cpuinfo") as f:
+            seen = set()
+            for ln in f:
+                k = ln.split(":", 1)[0].strip()
+                if k in keys and k not in seen:
+                    seen.add(k)
+                    ident.append(ln.strip())
+    except OSError:
+        pass
+    if not ident:
+        import platform
+
+        ident = ["nocpuinfo", *platform.uname()]
+    return hashlib.sha1("|".join(ident).encode()).hexdigest()[:12]
+
+
+def cache_key(platform_hint: str = "cpu", mesh=None) -> str:
+    """The cache-dir key: platform (accelerator executables target the
+    CHIP and share one dir across hosts; CPU artifacts are host-feature
+    bound), jax version (serialized executables are not stable across
+    jax/jaxlib upgrades), and the configured solver-mesh topology (a
+    sharded program is a different executable family — keeping them in
+    separate dirs keeps each dir's working set tight)."""
+    import jax
+
+    base = "accel-shared" if platform_hint == "accel" else machine_tag()
+    key = f"{base}-jax{jax.__version__}"
+    if mesh:
+        shape = mesh if isinstance(mesh, str) else "x".join(
+            str(int(d)) for d in mesh)
+        key += f"-mesh{shape}"
+    return key
+
+
+def default_cache_root() -> str:
+    """<repo root>/.jax_compile_cache — the same root bench.py always
+    used, shared by every entry point on this checkout."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        ".jax_compile_cache")
+
+
+def enable(cache_dir: Optional[str] = None, *, platform_hint: str = "cpu",
+           mesh=None, min_compile_time_s: float = 1.0) -> Dict[str, object]:
+    """Arm the persistent compilation cache (must precede the first jit).
+
+    cache_dir None uses ``default_cache_root()/<cache_key()>``; an
+    explicit dir is used verbatim (the two-process cold-start bench
+    points both children at one tmp dir).  min_compile_time_s below
+    jax's default of 1.0 persists even trivial compiles — what the
+    cold-start measurement needs to assert ZERO misses on a warm cache.
+    Returns the state payload.  Failure to arm (older jax) degrades to
+    the unarmed behavior: the cache is an optimization only."""
+    global _LISTENER_ARMED
+    import jax
+
+    key = cache_key(platform_hint, mesh)
+    if cache_dir is None:
+        cache_dir = os.path.join(default_cache_root(), key)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_s))
+    # vet: ignore[exception-hygiene] older jax: the persistent cache is an optimization only
+    except Exception:  # noqa: BLE001 — older jax: cache is optional
+        return state_payload()
+    try:
+        # jax memoizes the is-cache-used decision at the FIRST compile: a
+        # process that already jitted anything before enable() (tests, a
+        # plane that armed late) would otherwise silently never use the
+        # dir; reset_cache() makes it re-evaluate against the new config
+        from jax._src import compilation_cache as _cc  # noqa: SLF001
+
+        _cc.reset_cache()
+    # vet: ignore[exception-hygiene] private surface varies by jax version; fresh processes don't need the reset
+    except Exception:  # noqa: BLE001 — best-effort re-evaluation
+        pass
+    try:
+        if not _LISTENER_ARMED:
+            from jax._src import monitoring  # noqa: SLF001 — no public surface
+
+            monitoring.register_event_listener(_listener)
+            _LISTENER_ARMED = True
+    # vet: ignore[exception-hygiene] hit/miss attribution degrades to the warm ledger only
+    except Exception:  # noqa: BLE001 — attribution unavailable on this jax
+        pass
+    with _LOCK:
+        _STATE["armed"] = True
+        _STATE["cache_dir"] = cache_dir
+        _STATE["key"] = key
+    return state_payload()
+
+
+def disable_for_tests() -> None:
+    """Point jax back at no cache dir and clear the armed state (tests
+    that measure cold behavior)."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        from jax._src import compilation_cache as _cc  # noqa: SLF001
+
+        # drop the initialized cache object too (it holds the old dir)
+        _cc.reset_cache()
+    # vet: ignore[exception-hygiene] best-effort teardown in tests
+    except Exception:  # noqa: BLE001 — config shape differs on older jax
+        pass
+    with _LOCK:
+        _STATE["armed"] = False
+        _STATE["cache_dir"] = None
+        _STATE["key"] = None
+        _STATE["warmup"] = {}
+        _STATE["warmup_thread"] = None
+
+
+def counters() -> Tuple[int, int]:
+    """(hits, misses) of the persistent compilation cache so far."""
+    return int(COMPILE_CACHE_HITS.value()), int(COMPILE_CACHE_MISSES.value())
+
+
+def state_payload() -> Dict[str, object]:
+    """The ``aot`` section of /debug/state: cache dir + key, hit/miss
+    counters, and the per-shape warm ledger."""
+    hits, misses = counters()
+    with _LOCK:
+        return {
+            "armed": bool(_STATE["armed"]),
+            "cache_dir": _STATE["cache_dir"],
+            "key": _STATE["key"],
+            "hits": hits,
+            "misses": misses,
+            "warmup": dict(_STATE["warmup"]),  # shallow: values replaced whole
+            "warmup_thread": _STATE["warmup_thread"],
+        }
+
+
+def _set_warm(label: str, state: str, seconds: Optional[float] = None) -> None:
+    with _LOCK:
+        rec: Dict[str, object] = {"state": state}
+        if seconds is not None:
+            rec["seconds"] = round(seconds, 3)
+        _STATE["warmup"][label] = rec
+
+
+# -- synthetic warm workload --------------------------------------------------
+
+
+def synth_items(n: int):
+    """(spec, status) pairs for warm encodes: the loadgen shape —
+    Duplicated placement over every feasible cluster, one replica — so
+    the encoded batch routes ROUTE_DEVICE and exercises the same compact
+    executable real traffic does."""
+    from karmada_tpu.models.policy import (
+        REPLICA_SCHEDULING_DUPLICATED,
+        Placement,
+        ReplicaSchedulingStrategy,
+    )
+    from karmada_tpu.models.work import (
+        ObjectReference,
+        ResourceBindingSpec,
+        ResourceBindingStatus,
+    )
+
+    placement = Placement(replica_scheduling=ReplicaSchedulingStrategy(
+        replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED))
+    items = []
+    for i in range(n):
+        spec = ResourceBindingSpec(
+            resource=ObjectReference(
+                api_version="apps/v1", kind="Deployment",
+                namespace="karmada-warmup", name=f"aot-warm-{i}",
+                uid=f"aot-warm-uid-{i}"),
+            replicas=1,
+            placement=placement,
+        )
+        items.append((spec, ResourceBindingStatus()))
+    return items
+
+
+def warm_shapes(batch_window: int, pipeline_chunk: int) -> Tuple[int, ...]:
+    """Every pow2 binding-axis bucket a serve cycle can dispatch: the
+    pipelined executor cuts cycles into pipeline_chunk-sized chunks, and
+    encode_batch pads B UP to the next pow2 (min 8) — so the top bucket
+    is the pow2 ceiling of min(batch_window, pipeline_chunk), not its
+    floor (a 1000-binding chunk encodes as B=1024 and must be warmed)."""
+    cap = max(8, min(int(batch_window), int(pipeline_chunk)))
+    shapes = []
+    b = 8
+    while b < cap:
+        shapes.append(b)
+        b *= 2
+    shapes.append(b)  # the pow2 ceiling bucket full chunks pad into
+    return tuple(shapes)
+
+
+def variants_for(explain_rate: float, multi_chunk: bool) -> Tuple[str, ...]:
+    """The jit-variant set THIS scheduler configuration can actually
+    dispatch (warming more would spend background compile time on
+    programs that never run): plain always; explain only when the
+    explain plane samples; carry + donated only when cycles can span
+    multiple chunks (batch_window > pipeline_chunk)."""
+    variants = [VARIANT_PLAIN]
+    if explain_rate and explain_rate > 0:
+        variants.append(VARIANT_EXPLAIN)
+    if multi_chunk:
+        variants += [VARIANT_CARRY, VARIANT_DONATED]
+    return tuple(variants)
+
+
+def warm_executables(
+    clusters: Sequence,
+    estimator,
+    *,
+    shapes: Iterable[int] = (8, 16, 32, 64),
+    variants: Sequence[str] = ALL_VARIANTS,
+    waves: int = 8,
+    keep_sel: bool = False,
+    cancelled: Optional[threading.Event] = None,
+) -> Dict[str, object]:
+    """AOT pre-compile the compact dispatch for every (pow2 shape x jit
+    variant) against THIS cluster fleet via ``.lower().compile()``
+    (ops/solver.aot_warm_compile).  Synthetic bindings only feed the
+    ENCODER (host-side numpy) — nothing executes on device, and with the
+    persistent cache armed every compile lands on disk for later
+    processes.  Mesh-placed variants are compiled when a solver mesh is
+    active at call time.  Returns {label: seconds|error} plus totals;
+    the per-shape ledger also lands in state_payload()."""
+    from karmada_tpu import obs
+    from karmada_tpu.ops import solver, tensors
+
+    t_all = time.perf_counter()
+    results: Dict[str, object] = {}
+    compiled = 0
+    compile_s_total = 0.0
+    lower_s_total = 0.0
+    span = (obs.TRACER.start_span(obs.SPAN_WARMUP,
+                                  shapes=list(shapes),
+                                  variants=list(variants))
+            if obs.TRACER.enabled else None)
+    try:
+        cindex = tensors.ClusterIndex.build(list(clusters))
+        cache = tensors.EncoderCache()
+        for n in shapes:
+            if cancelled is not None and cancelled.is_set():
+                break
+            # one explain-encoded batch serves every variant: pl_fail_bits
+            # rides along unused by the disarmed signatures (the disarmed
+            # program is byte-identical with or without it — PR-5 gate)
+            cache.reset_for_cycle()
+            batch = tensors.encode_batch(synth_items(n), cindex, estimator,
+                                         cache=cache, explain=True)
+            for variant in variants:
+                label = f"B{batch.B}xC{batch.C}:{variant}"
+                with _LOCK:
+                    prior = _STATE["warmup"].get(label)
+                if prior is not None and prior.get("state") == "done":
+                    # distinct requested sizes can pad to one pow2 bucket;
+                    # one compile per (shape x variant) is enough
+                    results[label] = "already-warm"
+                    continue
+                if cancelled is not None and cancelled.is_set():
+                    _set_warm(label, "skipped")
+                    continue
+                _set_warm(label, "compiling")
+                t0 = time.perf_counter()
+                try:
+                    timings = solver.aot_warm_compile(batch, waves=waves,
+                                                      keep_sel=keep_sel,
+                                                      variant=variant)
+                    dt = time.perf_counter() - t0
+                    _set_warm(label, "done", dt)
+                    results[label] = {"seconds": round(dt, 3), **timings}
+                    compile_s_total += timings["compile_s"]
+                    lower_s_total += timings["lower_s"]
+                    compiled += 1
+                # vet: ignore[exception-hygiene] warm is best-effort; the error is kept in the ledger
+                except Exception as e:  # noqa: BLE001 — warm must never kill serve
+                    _set_warm(label, f"error: {e!r:.200}")
+                    results[label] = f"error: {e!r:.200}"
+    finally:
+        if span is not None:
+            span.end(compiled=compiled,
+                     seconds=round(time.perf_counter() - t_all, 3))
+    hits, misses = counters()
+    results["_totals"] = {"compiled": compiled,
+                          "seconds": round(time.perf_counter() - t_all, 3),
+                          # the XLA-compile share (what the persistent
+                          # cache serves) vs tracing (paid every process)
+                          "compile_s": round(compile_s_total, 3),
+                          "lower_s": round(lower_s_total, 3),
+                          "hits": hits, "misses": misses}
+    return results
+
+
+def start_background_warmup(
+    clusters_fn: Callable[[], Sequence],
+    estimator,
+    *,
+    shapes: Iterable[int],
+    variants: Sequence[str],
+    waves: int = 8,
+    keep_sel: bool = False,
+) -> threading.Thread:
+    """Run warm_executables on a daemon thread (serve: the plane takes
+    traffic immediately; warmed shapes stop paying compiles as they
+    land).  clusters_fn is called ON the thread so warmup sees the
+    store's state at warm time, not at arm time."""
+
+    def run() -> None:
+        with _LOCK:
+            _STATE["warmup_thread"] = "running"
+        try:
+            clusters = list(clusters_fn())
+            if not clusters:
+                with _LOCK:
+                    _STATE["warmup_thread"] = "done (no clusters)"
+                return
+            warm_executables(clusters, estimator, shapes=shapes,
+                             variants=variants, waves=waves,
+                             keep_sel=keep_sel)
+            with _LOCK:
+                _STATE["warmup_thread"] = "done"
+        # vet: ignore[exception-hygiene] background warm must never kill serve; state kept for /debug/state
+        except Exception as e:  # noqa: BLE001 — warm is best-effort
+            with _LOCK:
+                _STATE["warmup_thread"] = f"error: {e!r:.200}"
+
+    t = threading.Thread(target=run, daemon=True, name="solver-aot-warmup")
+    t.start()
+    return t

@@ -1303,6 +1303,82 @@ def dispatch_compact(batch, waves: int = 1, max_nnz: int = 0,
             with_used, tier, donated, shard_mesh, explain, pl_fb)
 
 
+def aot_warm_compile(batch, *, waves: int = 8, keep_sel: bool = False,
+                     variant: str = "plain", tier: str = "std") -> dict:
+    """AOT-compile the compact dispatch executable for this batch's shape
+    WITHOUT executing it: lowers from abstract ShapeDtypeStructs (never
+    touching the device-transfer cache or donating a live buffer) and
+    calls the pjit ``.lower().compile()`` surface, so with the persistent
+    compilation cache armed (ops/aotcache.enable) the executable lands on
+    disk and the first REAL dispatch of the shape — in this process or
+    any later one — pays cache deserialization instead of an XLA compile.
+
+    variant: "plain" (single-chunk cycle), "explain" (the explain jit
+    variant; requires a batch encoded with explain=True), "carry" (the
+    with_used chain of multi-chunk cycles), "donated" (its buffer-donated
+    form).  Statics (max_nnz, use_extra, shard_mesh) are derived exactly
+    the way dispatch_compact derives them, mesh placement included, so
+    the warmed signature IS the dispatched one."""
+    explain = variant == "explain"
+    with_used = variant in ("carry", "donated")
+    assert variant in ("plain", "explain", "carry", "donated"), variant
+    assert not explain or batch.explain, \
+        "explain warm needs a batch encoded with explain=True"
+    dense_nnz = batch.B * batch.C
+    max_nnz = dense_nnz if keep_sel else min(
+        max(batch.B * 16, 1 << 14), dense_nnz)
+    plan = _plan_for(batch, waves)
+
+    def aval(field, arr):
+        arr = _onp.asarray(arr)
+        if plan is None:
+            return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+        from karmada_tpu.ops import meshing
+
+        return jax.ShapeDtypeStruct(
+            arr.shape, arr.dtype,
+            sharding=meshing.sharding_for(plan.mesh, field, arr.shape))
+
+    fields = _CLUSTER_FIELDS + _BINDING_FIELDS
+    args = tuple(aval(f, getattr(batch, f)) for f in fields)
+    if with_used:
+        # the carry triple the chain's keyed store would render: zeros of
+        # the accumulator dtypes (tensors.CARRY_DTYPES), shaped like the
+        # capacity tensors they offset
+        used0_np = (_onp.zeros_like(batch.avail_milli),
+                    _onp.zeros_like(batch.pods_allowed),
+                    _onp.zeros_like(batch.est_override))
+        if plan is not None:
+            from karmada_tpu.ops import meshing
+
+            shards = meshing.used_shardings(
+                plan.mesh, tuple(u.shape for u in used0_np))
+            args = args + tuple(
+                jax.ShapeDtypeStruct(u.shape, u.dtype, sharding=s)
+                for u, s in zip(used0_np, shards))
+        else:
+            args = args + tuple(
+                jax.ShapeDtypeStruct(u.shape, u.dtype) for u in used0_np)
+    pl_fb = aval("pl_fail_bits", batch.pl_fail_bits) if explain else None
+    fn = schedule_compact_donated if variant == "donated" else schedule_compact
+    # lower (tracing — paid by every process, cache or not) timed apart
+    # from compile (the XLA step the persistent cache serves): the
+    # cold-start measurement compares compile_s across processes
+    import time as _time
+
+    t0 = _time.perf_counter()
+    lowered = fn.lower(*args, pl_fail_bits=pl_fb, waves=waves,
+                       max_nnz=max_nnz, keep_sel=keep_sel,
+                       use_extra=_use_extra(batch), with_used=with_used,
+                       tier=tier,
+                       shard_mesh=plan.mesh if plan is not None else None,
+                       explain=explain)
+    t1 = _time.perf_counter()
+    lowered.compile()
+    t2 = _time.perf_counter()
+    return {"lower_s": round(t1 - t0, 3), "compile_s": round(t2 - t1, 3)}
+
+
 def wait_compact(handle) -> None:
     """Block until a dispatch_compact handle's device work finishes WITHOUT
     copying anything to host: lets the scheduler service time the device
@@ -1333,6 +1409,36 @@ def dispatched_used(handle):
     stays consistent."""
     assert handle[7], "handle was not dispatched with_used=True"
     return handle[3][4:7]
+
+
+D2H_ZEROCOPY = REGISTRY.counter(
+    "karmada_solver_d2h_zerocopy_total",
+    "Device-to-host result planes handed over without a copy (dlpack)",
+)
+
+
+def _host_view(a):
+    """Hand a jit output to the host WITHOUT a copy when possible: a
+    single-device CPU jax array exports its buffer via dlpack and
+    np.from_dlpack wraps it as a READ-ONLY numpy view (the capsule keeps
+    the device buffer alive).  Anything else — a real accelerator
+    buffer, a multi-device sharded output, an already-numpy array —
+    falls back to np.asarray, exactly the old behavior.  Consumers
+    (decode_compact, the d2h guard, the native decoder) only read."""
+    import numpy as np
+
+    try:
+        devs = getattr(a, "devices", None)
+        if callable(devs):
+            ds = devs()
+            if len(ds) == 1 and next(iter(ds)).platform == "cpu":
+                out = np.from_dlpack(a)
+                D2H_ZEROCOPY.inc()
+                return out
+    # vet: ignore[exception-hygiene] dlpack support varies by jax/platform; the copy path is always correct
+    except Exception:  # noqa: BLE001 — zero-copy is an optimization only
+        pass
+    return np.asarray(a)
 
 
 def finalize_compact(handle):
@@ -1376,7 +1482,10 @@ def finalize_compact(handle):
                     compile_cache="miss" if after > before else "hit")
         nnz = res[3]
     idx, val, st = res[0], res[1], res[2]
-    out = (np.asarray(idx), np.asarray(val), np.asarray(st), int(nnz))
+    # zero-copy handoff where the platform allows it (CPU buffers export
+    # via dlpack): the COO triple — and the explain planes below — reach
+    # decode as read-only views instead of copies
+    out = (_host_view(idx), _host_view(val), _host_view(st), int(nnz))
     if _guards.armed():
         # the device->host boundary check: COO indices/values/status sanity
         _guards.check_d2h(out[0], out[1], out[2], dense_nnz,
@@ -1391,7 +1500,7 @@ def finalize_compact(handle):
             out = out + (tuple(np.asarray(u) for u in used),)
     if explain:
         off = 7 if with_used else 4
-        out = out + (tuple(np.asarray(a) for a in res[off:off + 4]),)
+        out = out + (tuple(_host_view(a) for a in res[off:off + 4]),)
     return out
 
 

@@ -57,6 +57,7 @@ from karmada_tpu.ops.webster import (
     fnv32a_batch_odd,
     tiebreak_descending_by_uid,
 )
+from karmada_tpu.utils.metrics import REGISTRY
 from karmada_tpu.utils.quantity import RESOURCE_CPU, RESOURCE_PODS
 
 MAX_INT32 = (1 << 31) - 1
@@ -170,6 +171,37 @@ FIELD_AXES = {
 CARRY_DTYPES = {
     "used_milli": "int64", "used_pods": "int64", "used_sets": "int64",
 }
+
+# the native decode ABI (native/decode_fast.c): dtypes of every buffer
+# crossing the d2h -> CPython-extension boundary.  The COO triple and the
+# explain outcome plane arrive from solver.finalize_compact as int32 jit
+# outputs (ideally zero-copy dlpack views); name_rank keeps the solver's
+# int64 contract.  Construction sites naming these fields are checked by
+# the dtype-contract vet pass exactly like SolverBatch fields — an s64
+# array handed to the int32-reading C loop would decode garbage, not
+# crash.
+NATIVE_ABI_DTYPES = {
+    "coo_idx": "int32", "coo_val": "int32", "coo_status": "int32",
+    "outcome_plane": "int32", "verdict_plane": "int32",
+    "decode_name_rank": "int64",
+}
+
+DECODE_NATIVE = REGISTRY.counter(
+    "karmada_solver_decode_native_total",
+    "Per-binding result rows decoded by the native COO decoder",
+)
+
+
+def tc_new_is_plain() -> bool:
+    """True while TargetCluster construction via cls.__new__(cls) +
+    setattr (what native/decode_fast.c does) is equivalent to calling the
+    dataclass __init__: plain object.__new__, no __slots__, no
+    __post_init__.  A subclass or monkeypatch that breaks the equivalence
+    silently re-routes decode to the Python builder instead of producing
+    divergent objects."""
+    return (TargetCluster.__new__ is object.__new__
+            and not hasattr(TargetCluster, "__post_init__")
+            and not hasattr(TargetCluster, "__slots__"))
 
 
 def _next_pow2(n: int, lo: int = 1) -> int:
@@ -452,6 +484,12 @@ class EncoderCache:
         self.placement_keys: Dict[int, Tuple[object, str]] = {}
         # cluster lane -> allowed pod count (snapshot-stable per cycle)
         self.pods_allowed: Optional[np.ndarray] = None
+        # cluster-axis bundle (cluster_valid, region_names, region_id,
+        # deleting, has_summary, name_rank): snapshot-stable per cycle,
+        # rebuilt once per cycle instead of once per chunk (the deleting/
+        # region Python loops are O(C) each — ~15k iterations per 5000-
+        # cluster chunk without this)
+        self.cluster_axis: Optional[tuple] = None
         # spread-by-label group axes, keyed by label key (cluster labels
         # are part of the owner's cache signature — scheduler/service.py
         # builds a fresh cache when any cluster label changes)
@@ -474,6 +512,7 @@ class EncoderCache:
         spec-derived rows (placement masks) and api-enablement rows survive
         — their owners invalidate them on their own signatures."""
         self.pods_allowed = None
+        self.cluster_axis = None
         self.override_rows = {}
         self.placement_keys = {}
         self.assembled_sig = None
@@ -520,31 +559,38 @@ def encode_batch(
     nB = len(items)
     B = _next_pow2(max(nB, 1), 8) if pad_bindings else max(nB, 1)
 
-    # ---- cluster axis -----------------------------------------------------
-    cluster_valid = np.zeros(C, bool)
-    cluster_valid[:nC] = True
-    # region vocabulary (device spread path routes on its size)
-    region_names: List[str] = []
-    region_ids: Dict[str, int] = {}
-    region_id = np.full(C, -1, np.int32)
-    for i, c in enumerate(clusters):
-        r = c.spec.region
-        if not r:
-            continue
-        if r not in region_ids:
-            region_ids[r] = len(region_names)
-            region_names.append(r)
-        region_id[i] = region_ids[r]
-    deleting = np.zeros(C, bool)
-    has_summary = np.zeros(C, bool)
-    name_rank = np.full(C, 0, np.int64)
-    name_rank[:nC] = cindex.name_rank
-    # padding lanes need distinct ranks above real ones
-    name_rank[nC:] = np.arange(nC, C)
-    for i, c in enumerate(clusters):
-        deleting[i] = c.metadata.deleting
-        if c.status.resource_summary is not None:
-            has_summary[i] = True
+    # ---- cluster axis (chunk-stable: built once per cycle) ----------------
+    if cache is not None and cache.cluster_axis is not None:
+        (cluster_valid, region_names, region_id, deleting, has_summary,
+         name_rank) = cache.cluster_axis
+    else:
+        cluster_valid = np.zeros(C, bool)
+        cluster_valid[:nC] = True
+        # region vocabulary (device spread path routes on its size)
+        region_names = []
+        region_ids: Dict[str, int] = {}
+        region_id = np.full(C, -1, np.int32)
+        for i, c in enumerate(clusters):
+            r = c.spec.region
+            if not r:
+                continue
+            if r not in region_ids:
+                region_ids[r] = len(region_names)
+                region_names.append(r)
+            region_id[i] = region_ids[r]
+        deleting = np.zeros(C, bool)
+        has_summary = np.zeros(C, bool)
+        name_rank = np.full(C, 0, np.int64)
+        name_rank[:nC] = cindex.name_rank
+        # padding lanes need distinct ranks above real ones
+        name_rank[nC:] = np.arange(nC, C)
+        for i, c in enumerate(clusters):
+            deleting[i] = c.metadata.deleting
+            if c.status.resource_summary is not None:
+                has_summary[i] = True
+        if cache is not None:
+            cache.cluster_axis = (cluster_valid, region_names, region_id,
+                                  deleting, has_summary, name_rank)
     if cache is not None and cache.pods_allowed is not None:
         pods_allowed = cache.pods_allowed
     else:
@@ -575,8 +621,11 @@ def encode_batch(
     nw_shortcut = np.zeros(B, bool)
     b_valid = np.zeros(B, bool)
     b_valid[:nB] = True
-    prev_entries: List[List[Tuple[int, int]]] = [[] for _ in range(B)]
-    evict_entries: List[List[int]] = [[] for _ in range(B)]
+    # sparse (most bindings carry no prev assignment / eviction tasks):
+    # dict-of-rows keeps the per-chunk cost proportional to the rows that
+    # HAVE entries instead of allocating B empty lists per chunk
+    prev_entries: Dict[int, List[Tuple[int, int]]] = {}
+    evict_entries: Dict[int, List[int]] = {}
 
     n_regions = len(region_names)
     # spread-by-label group axes, built lazily per label key (O(C) each,
@@ -730,7 +779,7 @@ def encode_batch(
             # count is a wide broadcast rather than a Webster target
             divides = (placement.replica_scheduling_type()
                        != REPLICA_SCHEDULING_DUPLICATED)
-            nprev = len(prev_entries[b])
+            nprev = len(prev_entries.get(b, ()))
             over1 = ((divides and nrep > COMPACT_DIVISION_CAP)
                      or nprev > COMPACT_PREV_CAP)
             over2 = ((divides and nrep > COMPACT_DIVISION_CAP_BIG)
@@ -750,7 +799,7 @@ def encode_batch(
             for task in spec.graceful_eviction_tasks:
                 ci = cindex_get(task.from_cluster)
                 if ci is not None:
-                    evict_entries[b].append(ci)
+                    evict_entries.setdefault(b, []).append(ci)
         route[b] = r
 
     fast = None
@@ -780,16 +829,18 @@ def encode_batch(
     # later waves against phantom usage)
     b_valid[:nB] = route == ROUTE_DEVICE
 
-    Kp = _next_pow2(max((len(e) for e in prev_entries), default=0) or 1, 4)
-    Ke = _next_pow2(max((len(e) for e in evict_entries), default=0) or 1, 4)
+    Kp = _next_pow2(
+        max((len(e) for e in prev_entries.values()), default=0) or 1, 4)
+    Ke = _next_pow2(
+        max((len(e) for e in evict_entries.values()), default=0) or 1, 4)
     prev_idx = np.full((B, Kp), -1, np.int32)
     prev_val = np.zeros((B, Kp), np.int32)
     evict_idx = np.full((B, Ke), -1, np.int32)
-    for b, entries in enumerate(prev_entries):
+    for b, entries in prev_entries.items():
         for j, (ci, r) in enumerate(entries):
             prev_idx[b, j] = ci
             prev_val[b, j] = min(r, MAX_INT32)
-    for b, entries in enumerate(evict_entries):
+    for b, entries in evict_entries.items():
         for j, ci in enumerate(entries):
             evict_idx[b, j] = ci
 
@@ -1366,6 +1417,7 @@ def decode_compact(
     *,
     enable_empty_workload_propagation: bool = False,
     items: Optional[Sequence[Tuple[ResourceBindingSpec, ResourceBindingStatus]]] = None,
+    outcome: Optional[np.ndarray] = None,
 ) -> List:
     """decode_result over the sparse COO form from solver.solve_compact.
 
@@ -1376,10 +1428,71 @@ def decode_compact(
     CONTRACT: idx must be ascending among its >=0 entries (row-major
     binding order) — solver._compact_of's jnp.nonzero guarantees this; any
     other producer must sort first (asserted below).
+
+    The hot loop is native (native/decode_fast.c) when the extension
+    builds: the raw int32 COO triple is row-split, rank-sorted and turned
+    into TargetCluster lists in C, fed zero-copy from the d2h views
+    finalize_compact hands over.  THIS Python implementation remains the
+    behavior-defining parity control and the fallback when the extension
+    is absent.  `outcome` (the explain plane's outcome vector, when the
+    cycle ran the explain jit variant) attaches the dominant rejection
+    reason to the error objects (`exc.reason`, obs/decisions layout).
     """
     names = batch.cluster_index.names
     C = batch.C
     nb = batch.n_bindings
+    coo_status = np.ascontiguousarray(np.asarray(status), np.int32)
+    non_workload = batch.non_workload
+    out: List = [None] * nb
+
+    # error slots are Python's (diagnosis construction); unknown nonzero
+    # statuses with no mapped error fall through to target construction
+    def _prefill_errors() -> None:
+        for b in np.nonzero(coo_status[:nb] != 0)[0]:
+            err = _status_error(batch, int(b), int(coo_status[b]), items)
+            if err is not None:
+                out[int(b)] = err
+
+    _prefill_errors()
+
+    from karmada_tpu import native as _native
+
+    outcome_plane = None
+    reason_names = None
+    if outcome is not None:
+        from karmada_tpu.obs.decisions import VERDICT_BIT_NAMES
+
+        outcome_plane = np.ascontiguousarray(np.asarray(outcome), np.int32)
+        reason_names = VERDICT_BIT_NAMES
+
+    # native full-COO path: row split + rank sort + TargetCluster
+    # construction in one C pass (wide Duplicated rows included)
+    dec = _native.load_decode_fast()
+    if dec is not None:
+        idx_np = np.asarray(idx)
+        val_np = np.asarray(val)
+        if (idx_np.dtype == np.int32 and val_np.dtype == np.int32
+                and tc_new_is_plain()):
+            coo_idx = np.ascontiguousarray(idx_np, np.int32)
+            coo_val = np.ascontiguousarray(val_np, np.int32)
+            decode_name_rank = np.ascontiguousarray(batch.name_rank, np.int64)
+            handled = dec.decode_coo(
+                coo_idx, coo_val, coo_status, int(C), int(batch.n_clusters),
+                decode_name_rank, names,
+                np.ascontiguousarray(non_workload[:nb], np.uint8),
+                bool(enable_empty_workload_propagation), TargetCluster, out,
+                *((outcome_plane, reason_names)
+                  if outcome_plane is not None else ()),
+            )
+            if handled >= 0:
+                DECODE_NATIVE.inc(int(handled))
+                return out
+            # ascending contract violated: the C pass may have filled
+            # slots before detecting it — rebuild and let the Python
+            # path's assert own the diagnostic
+            out = [None] * nb
+            _prefill_errors()
+
     # vectorized COO split: solver._compact_of emits row-major (b ascending)
     # order, so per-binding runs are contiguous and searchsorted finds them
     idx = np.asarray(idx)
@@ -1397,18 +1510,7 @@ def decode_compact(
         "decode_compact requires row-major (ascending) COO input"
     )
     bounds = np.searchsorted(b_arr, np.arange(nb + 1))
-    status_arr = np.ascontiguousarray(np.asarray(status), np.int32)
-    non_workload = batch.non_workload
-    out: List = [None] * nb
-
-    # error slots are Python's (diagnosis construction); unknown nonzero
-    # statuses with no mapped error fall through to target construction
-    for b in np.nonzero(status_arr[:nb] != 0)[0]:
-        err = _status_error(batch, int(b), int(status_arr[b]), items)
-        if err is not None:
-            out[int(b)] = err
-
-    from karmada_tpu import native as _native
+    status_arr = coo_status
 
     fast = _native.load_encode_fast()
     if fast is not None:
@@ -1445,4 +1547,12 @@ def decode_compact(
                 ]
         targets.sort(key=lambda t: t.name)
         out[b] = targets
+    if outcome_plane is not None:
+        # fallback parity with the native pass: dominant rejection reason
+        # onto the error objects (bits 8+ of the outcome code hold 1 +
+        # the dominant stage's bit index — obs/decisions.split_outcome)
+        for b in range(nb):
+            dom = int(outcome_plane[b]) >> 8
+            if 0 < dom <= len(reason_names) and isinstance(out[b], Exception):
+                out[b].reason = reason_names[dom - 1]
     return out

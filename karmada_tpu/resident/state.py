@@ -612,6 +612,10 @@ class ResidentState:
         c.override_rows = {}
         c.assembled = None
         c.assembled_sig = None
+        # the cluster-axis bundle caches has_summary/deleting per cycle; a
+        # capacity delta can flip has_summary (summary appearing), so the
+        # next miss encode rebuilds it
+        c.cluster_axis = None
         c.pods_allowed = self.plane.pods_allowed if self.plane is not None \
             else None
 

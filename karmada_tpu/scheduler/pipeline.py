@@ -567,6 +567,10 @@ def run_pipeline(
                 batch, idx, val, status,
                 enable_empty_workload_propagation=keep_sel,
                 items=part if diagnose else None,
+                # explain-armed cycles: the outcome verdict plane rides
+                # the decode pass, attaching dominant rejection reasons
+                # to the error objects (native or Python path alike)
+                outcome=expl_planes[3] if expl_planes is not None else None,
             )
             if dec_span is not None:
                 dec_span.end()

@@ -85,7 +85,7 @@ class ObservabilityServer:
 
     def _state(self) -> dict:
         from karmada_tpu import resident
-        from karmada_tpu.ops import meshing
+        from karmada_tpu.ops import aotcache, meshing
         from karmada_tpu.utils import deviceprobe
 
         counts = self.store.counts_by_kind() if self.store is not None else {}
@@ -94,6 +94,11 @@ class ObservabilityServer:
         return {"objects_by_kind": counts,
                 "total": sum(counts.values()),
                 "device_probe": deviceprobe.last_probe(),
+                # the AOT executable plane (ops/aotcache): persistent
+                # compile-cache dir + key, hit/miss counters, and the
+                # per-(shape x variant) warm-start ledger —
+                # {"armed": false} when serve ran --aot-cache off
+                "aot": aotcache.state_payload(),
                 # the active solver mesh (ops/meshing): shape, device
                 # count, platform — {"enabled": false} on the
                 # single-device fallback; never initialises a backend

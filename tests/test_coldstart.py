@@ -1,0 +1,201 @@
+"""AOT executable plane (ops/aotcache): cache keying, warm ledger,
+persistent-cache hit/miss accounting, and the cold-start contract.
+
+The compressed variant runs in tier-1: one process, a tmp cache dir, two
+AOT warms of the same executables — the second must be served entirely
+from the persistent cache (hits, zero new misses).  The honest
+two-process variant (fresh interpreter per run, the COLDSTART_r*.json
+contract) is marked slow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+import bench
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.ops import aotcache
+
+pytestmark = pytest.mark.coldstart
+
+
+@pytest.fixture
+def armed_cache(tmp_path):
+    """Persistent cache armed at a tmp dir; disarmed after the test so
+    later tests keep their compile behavior."""
+    info = aotcache.enable(str(tmp_path / "cache"), min_compile_time_s=0.0)
+    yield info
+    aotcache.disable_for_tests()
+
+
+def test_cache_key_components():
+    import jax
+
+    key = aotcache.cache_key("cpu")
+    assert aotcache.machine_tag() in key
+    assert f"jax{jax.__version__}" in key
+    assert "mesh" not in key
+    assert aotcache.cache_key("cpu", (2, 4)).endswith("-mesh2x4")
+    assert aotcache.cache_key("accel").startswith("accel-shared")
+    # accelerator executables target the chip: host features must NOT key
+    assert aotcache.machine_tag() not in aotcache.cache_key("accel")
+
+
+def test_variants_match_dispatchable_set():
+    assert aotcache.variants_for(0.0, False) == ("plain",)
+    assert aotcache.variants_for(0.5, False) == ("plain", "explain")
+    assert aotcache.variants_for(0.0, True) == ("plain", "carry", "donated")
+    assert set(aotcache.variants_for(1.0, True)) == set(aotcache.ALL_VARIANTS)
+
+
+def test_warm_shapes_pow2_buckets():
+    assert aotcache.warm_shapes(64, 1024) == (8, 16, 32, 64)
+    assert aotcache.warm_shapes(4096, 256) == (8, 16, 32, 64, 128, 256)
+    assert aotcache.warm_shapes(2, 2) == (8,)
+    # a non-pow2 chunk cap still warms the CEILING bucket full chunks
+    # pad into (B=1024 for pipeline_chunk=1000), not just the floor
+    assert aotcache.warm_shapes(4096, 1000)[-1] == 1024
+
+
+def test_warm_then_rewarm_zero_misses(armed_cache):
+    """Compressed cold-start: the SAME executables warmed twice against
+    one persistent cache — the second pass must be all hits, no misses
+    (what makes a second PROCESS's warmup cheap)."""
+    import jax
+
+    rng = random.Random(0)
+    clusters = bench.build_fleet(rng, 16)
+    est = GeneralEstimator()
+    # earlier tests in the suite may have compiled these very signatures
+    # into jax's in-memory caches (which are consulted BEFORE the
+    # persistent cache): drop them so the first warm genuinely compiles
+    jax.clear_caches()
+    h0, m0 = aotcache.counters()
+    res1 = aotcache.warm_executables(clusters, est, shapes=(8,),
+                                     variants=("plain", "carry"))
+    h1, m1 = aotcache.counters()
+    assert res1["_totals"]["compiled"] == 2
+    assert m1 - m0 >= 2, "first warm must actually compile (cache misses)"
+    ledger = aotcache.state_payload()["warmup"]
+    assert {k: v["state"] for k, v in ledger.items()} == {
+        "B8xC16:plain": "done", "B8xC16:carry": "done"}
+    # second warm AFTER dropping jax's in-memory caches (what a fresh
+    # process starts without): every XLA compile must be served from disk
+    import jax
+
+    jax.clear_caches()
+    aotcache._STATE["warmup"] = {}  # noqa: SLF001 — fresh ledger for the re-warm
+    res2 = aotcache.warm_executables(clusters, est, shapes=(8,),
+                                     variants=("plain", "carry"))
+    h2, m2 = aotcache.counters()
+    assert res2["_totals"]["compiled"] == 2
+    assert m2 - m1 == 0, "re-warm must not miss the persistent cache"
+    assert h2 - h1 >= 2, "re-warm must be served by the persistent cache"
+
+
+def test_warm_dedupes_pow2_aliases(armed_cache):
+    """Sizes that pad to one pow2 bucket compile once."""
+    rng = random.Random(1)
+    clusters = bench.build_fleet(rng, 12)
+    res = aotcache.warm_executables(clusters, GeneralEstimator(),
+                                    shapes=(2, 5), variants=("plain",))
+    assert res["_totals"]["compiled"] == 1
+    assert res["B8xC16:plain"] == "already-warm" or any(
+        v == "already-warm" for v in res.values())
+
+
+def test_state_payload_in_debug_state(armed_cache):
+    from karmada_tpu.utils.httpserve import ObservabilityServer
+
+    state = ObservabilityServer(store=None)._state()  # noqa: SLF001
+    assert state["aot"]["armed"] is True
+    assert state["aot"]["cache_dir"] == armed_cache["cache_dir"]
+    assert "hits" in state["aot"] and "misses" in state["aot"]
+
+
+def test_disarmed_payload():
+    aotcache.disable_for_tests()
+    p = aotcache.state_payload()
+    assert p["armed"] is False and p["cache_dir"] is None
+
+
+def test_warm_device_path_covers_dispatchable_variants(monkeypatch):
+    """Satellite fix: warm_device_path must warm the variant set the
+    pipeline can actually dispatch, not just the plain pow2 shapes."""
+    from karmada_tpu.loadgen import (
+        ServeSlice, ServiceModel, VirtualClock, get_scenario,
+        warm_device_path,
+    )
+
+    calls = []
+    monkeypatch.setattr(
+        aotcache, "warm_executables",
+        lambda clusters, est, **kw: calls.append(kw) or {"_totals": {}})
+    scenario = get_scenario("steady")
+    plane = ServeSlice(scenario, VirtualClock(), ServiceModel(),
+                       backend="serial", explain=0.25)
+    # explain armed + multi-chunk cycles possible -> explain/carry/donated
+    plane.scheduler.pipeline_chunk = scenario.batch_window // 2
+    warm_device_path(plane, sizes=(2, 9))
+    assert len(calls) == 1
+    assert set(calls[0]["variants"]) == {"explain", "carry", "donated"}
+    assert calls[0]["shapes"] == (2, 9)
+    # plain-only configuration: no AOT pass at all
+    calls.clear()
+    plane2 = ServeSlice(scenario, VirtualClock(), ServiceModel(),
+                        backend="serial")
+    warm_device_path(plane2, sizes=(2,))
+    assert not calls
+
+
+def test_zero_copy_d2h_view():
+    """finalize_compact's host views: on the CPU platform the COO planes
+    arrive as read-only dlpack views, not copies."""
+    import jax.numpy as jnp
+
+    from karmada_tpu.ops import solver
+
+    before = solver.D2H_ZEROCOPY.value()
+    arr = jnp.arange(16, dtype=jnp.int32) * 2
+    view = solver._host_view(arr)  # noqa: SLF001
+    assert view.dtype == "int32" and view[3] == 6
+    assert not view.flags.writeable, "dlpack view must be read-only"
+    assert solver.D2H_ZEROCOPY.value() == before + 1
+    import numpy as np
+
+    plain = np.arange(4)
+    assert solver._host_view(plain) is plain  # noqa: SLF001 — numpy passthrough
+
+
+@pytest.mark.slow
+def test_two_process_coldstart(tmp_path):
+    """The COLDSTART_r*.json contract end to end: two fresh processes
+    share one cache dir; the second must report ZERO compile-cache misses
+    for the warmed shapes and a much cheaper warmup."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--coldstart",
+         "--clusters", "64", "--coldstart-clusters", "24",
+         "--coldstart-shapes", "8", "--ckpt-dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=1500,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    line = [ln for ln in (r.stdout or "").splitlines()
+            if ln.startswith("{")][-1]
+    payload = json.loads(line)["detail"]["coldstart"]
+    assert payload["second_misses"] == 0
+    assert payload["second"]["hits"] >= 4
+    assert payload["warm_ratio"] < 1.0
+    assert payload["compile_warm_ratio"] < 0.5, (
+        "persistent cache did not shrink the compile share")
+    assert payload["decode"]["decode_parity_bit_exact"] is True
+    # bench's OWN gate (<10% compile share) needs real-scale compiles to
+    # dominate deserialization — COLDSTART_r01.json holds it at full
+    # scale; at this toy scale only the payload contract is asserted
+    assert r.returncode in (0, 1)

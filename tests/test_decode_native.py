@@ -1,0 +1,198 @@
+"""Native COO decode (native/decode_fast.c) parity + fallback gates.
+
+Behavior is DEFINED by the Python builder in tensors.decode_compact; the
+native pass must be bit-exact against it across mixed routes (device,
+spread, big tier), wide Duplicated rows, failure statuses, the explain
+outcome plane, and the empty-workload-propagation mode — and the
+extension being absent must degrade losslessly to today's behavior.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+import bench
+from karmada_tpu import native
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.models.work import TargetCluster
+from karmada_tpu.ops import tensors
+
+pytestmark = pytest.mark.skipif(
+    native.load_decode_fast() is None,
+    reason=f"decode_fast unavailable: {native.decode_fast_error()}",
+)
+
+
+@pytest.fixture
+def no_native_decode(monkeypatch):
+    """Force the Python parity control (extension 'absent')."""
+    monkeypatch.setattr(native, "_dec_mod", None)
+    monkeypatch.setattr(native, "_dec_error", "disabled for parity test")
+
+
+def _decode_pair(batch, idx, val, status, monkeypatch, **kw):
+    """(native result, python-control result) for one COO plane set."""
+    assert native.load_decode_fast() is not None
+    out_native = tensors.decode_compact(batch, idx, val, status, **kw)
+    with monkeypatch.context() as m:
+        m.setattr(native, "_dec_mod", None)
+        m.setattr(native, "_dec_error", "disabled for parity test")
+        out_py = tensors.decode_compact(batch, idx, val, status, **kw)
+    return out_native, out_py
+
+
+def _assert_bit_exact(a, b):
+    assert len(a) == len(b)
+    for i, (x, y) in enumerate(zip(a, b)):
+        if isinstance(x, Exception) or isinstance(y, Exception):
+            assert type(x) is type(y), f"slot {i}: {x!r} vs {y!r}"
+            assert getattr(x, "reason", None) == getattr(y, "reason", None)
+        else:
+            assert x == y, f"slot {i}: {x!r} vs {y!r}"
+            for tx, ty in zip(x, y):
+                assert type(tx) is type(ty) is TargetCluster
+                assert (tx.name, tx.replicas) == (ty.name, ty.replicas)
+
+
+def _mixed_batch(seed: int, n_clusters: int = 220, n_bindings: int = 512):
+    rng = random.Random(seed)
+    clusters = bench.build_fleet(rng, n_clusters)
+    placements = bench.build_placements(rng, [c.name for c in clusters])
+    items = bench.build_bindings(rng, n_bindings, placements)
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch(items, cindex, GeneralEstimator(),
+                                 cache=tensors.EncoderCache())
+    return batch, items
+
+
+def _fuzz_coo(batch, seed: int, wide_every: int = 11):
+    """Adversarial COO: every route's rows get entries (decode does not
+    route-filter), every wide_every-th row is FULL-FLEET wide (the shape
+    the old fast path punted to Python), statuses cycle through OK /
+    FIT_ERROR / UNSCHEDULABLE / NO_CLUSTER / unknown."""
+    rng = random.Random(seed)
+    nb, C, nC = batch.n_bindings, batch.C, batch.n_clusters
+    idx_l, val_l = [], []
+    status = np.zeros(batch.B, np.int32)
+    for b in range(nb):
+        status[b] = (0, 0, 0, tensors.STATUS_FIT_ERROR,
+                     tensors.STATUS_UNSCHEDULABLE, tensors.STATUS_NO_CLUSTER,
+                     9)[b % 7]
+        if b % wide_every == 0:
+            cs = range(nC)  # full-fleet wide row (forces the qsort branch)
+        else:
+            cs = sorted(rng.sample(range(nC), rng.randint(0, 6)))
+        for c in cs:
+            idx_l.append(b * C + c)
+            val_l.append(rng.choice((0, 0, 1, 2, 7)))
+    pad = 32
+    idx = np.full(len(idx_l) + pad, -1, np.int32)
+    val = np.zeros(len(idx_l) + pad, np.int32)
+    idx[:len(idx_l)] = idx_l
+    val[:len(val_l)] = val_l
+    return idx, val, status
+
+
+@pytest.mark.parametrize("seed", [7, 23, 101])
+def test_parity_fuzz_mixed_routes(seed, monkeypatch):
+    batch, items = _mixed_batch(seed)
+    idx, val, status = _fuzz_coo(batch, seed)
+    for empty_prop in (False, True):
+        a, b = _decode_pair(batch, idx, val, status, monkeypatch,
+                            items=items,
+                            enable_empty_workload_propagation=empty_prop)
+        _assert_bit_exact(a, b)
+
+
+def test_parity_small_fleet_compact_false(monkeypatch):
+    """C <= COMPACT_LANES fleets (compact=False) route wide Divided rows
+    to the device too — decode parity must hold there as well."""
+    batch, items = _mixed_batch(3, n_clusters=12, n_bindings=96)
+    idx, val, status = _fuzz_coo(batch, 3, wide_every=5)
+    a, b = _decode_pair(batch, idx, val, status, monkeypatch, items=items)
+    _assert_bit_exact(a, b)
+
+
+def test_parity_explain_outcome_plane(monkeypatch):
+    """The outcome verdict plane attaches `exc.reason` identically on the
+    native and Python paths."""
+    from karmada_tpu.obs.decisions import VERDICT_BIT_NAMES
+
+    batch, items = _mixed_batch(11, n_clusters=64, n_bindings=128)
+    idx, val, status = _fuzz_coo(batch, 11)
+    nb = batch.n_bindings
+    outcome = np.zeros(batch.B, np.int32)
+    for b in range(nb):
+        dom = b % (len(VERDICT_BIT_NAMES) + 1)  # 0 = no rejected clusters
+        outcome[b] = int(status[b]) | (dom << 8)
+    a, b = _decode_pair(batch, idx, val, status, monkeypatch,
+                        items=items, outcome=outcome)
+    _assert_bit_exact(a, b)
+    assert any(getattr(x, "reason", None) for x in a
+               if isinstance(x, Exception)), "fuzz produced no reasons"
+
+
+def test_absent_extension_falls_back_losslessly(no_native_decode):
+    batch, items = _mixed_batch(5, n_clusters=40, n_bindings=64)
+    idx, val, status = _fuzz_coo(batch, 5)
+    out = tensors.decode_compact(batch, idx, val, status, items=items)
+    assert len(out) == batch.n_bindings
+    assert all(r is not None for r in out)
+
+
+def test_ascending_violation_matches_python_assert():
+    """Out-of-order COO: the native pass hands back to Python, whose
+    assert owns the diagnostic — same failure mode as before."""
+    batch, _ = _mixed_batch(9, n_clusters=16, n_bindings=16)
+    C = batch.C
+    idx = np.array([3 * C + 1, 1 * C + 0, -1], np.int32)  # rows 3 then 1
+    val = np.array([1, 1, 0], np.int32)
+    status = np.zeros(batch.B, np.int32)
+    with pytest.raises(AssertionError, match="row-major"):
+        tensors.decode_compact(batch, idx, val, status)
+
+
+def test_tc_new_guard_reroutes_to_python(monkeypatch):
+    """A TargetCluster whose construction stopped being __new__-equivalent
+    must silently take the Python builder, never diverge."""
+    calls = []
+    real = native.load_decode_fast()
+    assert real is not None
+    monkeypatch.setattr(tensors, "tc_new_is_plain", lambda: False)
+
+    class Spy:
+        def decode_coo(self, *a, **k):
+            calls.append(1)
+            return real.decode_coo(*a, **k)
+
+    monkeypatch.setattr(native, "_dec_mod", Spy())
+    batch, items = _mixed_batch(13, n_clusters=16, n_bindings=32)
+    idx, val, status = _fuzz_coo(batch, 13)
+    out = tensors.decode_compact(batch, idx, val, status, items=items)
+    assert not calls, "native path ran despite the guard"
+    assert len(out) == batch.n_bindings
+
+
+def test_native_metric_counts_rows():
+    before = tensors.DECODE_NATIVE.value()
+    batch, items = _mixed_batch(17, n_clusters=24, n_bindings=48)
+    idx, val, status = _fuzz_coo(batch, 17)
+    out = tensors.decode_compact(batch, idx, val, status, items=items)
+    built = sum(1 for r in out if not isinstance(r, Exception))
+    assert tensors.DECODE_NATIVE.value() - before == built
+
+
+def test_end_to_end_solve_decode_parity(monkeypatch):
+    """Through the real jit: solve_compact's d2h views (zero-copy where
+    the platform allows) feed the native decode; parity against the
+    Python control on the same handle."""
+    from karmada_tpu.ops import solver
+
+    batch, items = _mixed_batch(21, n_clusters=10, n_bindings=12)
+    res = solver.solve_compact(batch, waves=2)
+    idx, val, status = res[0], res[1], res[2]
+    a, b = _decode_pair(batch, idx, val, status, monkeypatch, items=items)
+    _assert_bit_exact(a, b)

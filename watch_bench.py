@@ -126,6 +126,26 @@ def main() -> int:
                           "reencode_exact": dl.get("reencode_exact"),
                           "audit_green": dl.get("audit_green"),
                           "parity_ok": dl.get("parity_ok")})
+                if "coldstart" in detail:
+                    # AOT executable-plane summary as a structured line
+                    # (bench --coldstart payloads; the full record is in
+                    # detail.coldstart / the persisted coldstart.json)
+                    cs = detail["coldstart"]
+                    dec = cs.get("decode") or {}
+                    jlog({"event": "coldstart",
+                          "ts": round(time.time(), 3),
+                          "warm_ratio": cs.get("warm_ratio"),
+                          "compile_warm_ratio": cs.get("compile_warm_ratio"),
+                          "second_misses": cs.get("second_misses"),
+                          "first_warmup_s": (cs.get("first") or {}).get(
+                              "warmup_s"),
+                          "second_warmup_s": (cs.get("second") or {}).get(
+                              "warmup_s"),
+                          "decode_median_ms": (dec.get("decode_native")
+                                               or {}).get("median_ms"),
+                          "decode_parity": dec.get(
+                              "decode_parity_bit_exact"),
+                          "host_budget_bps": dec.get("host_budget_bps")})
                 if "soak" in detail:
                     # sustained-traffic SLO summary as a structured line
                     # (bench --soak SCENARIO payloads; the full record is
