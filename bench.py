@@ -1493,6 +1493,190 @@ def run_chaos(args) -> int:
     return 0 if not violations else 1
 
 
+def _rebalance_parity_items(rng: random.Random, n: int, names):
+    """A device-routed rebalance workload for the re-place parity leg:
+    Duplicated / dynamic-weight Divided / Aggregated placements (no
+    spread constraints or host rows — the carry chain's own territory),
+    each with a previous assignment so the re-solve exercises the
+    Steady/Fresh modes the descheduler reuses."""
+    placements = []
+    for _ in range(4):
+        placements.append(Placement(replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED)))
+    for _ in range(4):
+        placements.append(Placement(replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+            weight_preference=ClusterPreferences(
+                dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS))))
+    for _ in range(4):
+        placements.append(Placement(replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_AGGREGATED)))
+    items = build_bindings(rng, n, placements)
+    return build_rebalance_items(rng, items, names)
+
+
+def _serial_rebalance_control(items, clusters):
+    """The reference semantics the batched re-solve must reproduce
+    bit-exactly: one binding at a time, each seeing the previous ones'
+    consumption as the positive delta over its prior assignment (the
+    same rule the wave accumulator implements — tests/test_contention.py
+    pins the equivalence; this is its bench-side control)."""
+    import copy
+
+    clusters = copy.deepcopy(clusters)
+    cal = serial.make_cal_available([GeneralEstimator()])
+    by_name = {c.metadata.name: c for c in clusters}
+    results = []
+    for spec, st in items:
+        try:
+            want = serial.schedule(spec, st, clusters, cal)
+        except Exception as e:  # noqa: BLE001 — outcome object, like the queue
+            results.append(e)
+            continue
+        results.append(want)
+        prev = {tc.name: tc.replicas for tc in spec.clusters}
+        req = spec.replica_requirements.resource_request
+        for tc in want:
+            delta = max(tc.replicas - prev.get(tc.name, 0), 0)
+            if delta == 0:
+                continue
+            s = by_name[tc.name].status.resource_summary
+            alloc = s.allocated
+            alloc["cpu"] = Quantity.from_milli(
+                alloc.get("cpu", Quantity(0)).milli
+                + delta * req["cpu"].milli)
+            alloc["memory"] = Quantity.from_units(
+                alloc.get("memory", Quantity(0)).value()
+                + delta * req["memory"].value())
+            alloc["pods"] = Quantity.from_units(
+                alloc.get("pods", Quantity(0)).value() + delta)
+    return results
+
+
+def run_rebalance(args) -> int:
+    """bench --rebalance: the rebalance-plane acceptance payload
+    (REBALANCE_r*.json contract), two legs:
+
+    1. the compressed `hotspot` soak with the rebalance plane armed —
+       skewed arrivals pack the hot clusters, capacity churn overcommits
+       them, and the plane must drain them back inside the overcommit
+       threshold through paced graceful evictions with ZERO conservation
+       violations (safety auditor embedded in the payload);
+    2. re-place parity — the drained set re-solved through the pipelined
+       executor with the device-side carry chain (chunked, waves == chunk
+       so the accounting is fully sequential) against the serial
+       rebalance control, asserted bit-identical.
+
+    Exit 1 on any violation, non-convergence, or parity mismatch."""
+    from karmada_tpu.loadgen import (
+        LoadDriver, ServeSlice, ServiceModel, VirtualClock, get_scenario,
+        warm_device_path,
+    )
+    from karmada_tpu.scheduler import pipeline as sched_pipeline
+
+    scenario = get_scenario("hotspot")
+    _hb("rebalance soak (hotspot): fixed service model, backend=device "
+        "(XLA:CPU off-hardware), rebalance plane + graceful eviction armed")
+    model = ServiceModel()  # fixed, like --chaos: determinism over throughput
+    clock = VirtualClock()
+    plane = ServeSlice(scenario, clock, model, backend="device")
+    warm_device_path(plane)
+    driver = LoadDriver(plane, scenario, clock=clock, model=model,
+                        seed=args.soak_seed)
+    payload = driver.run()
+    payload["backend"] = "device"
+    reb = payload.get("rebalance") or {}
+    last = reb.get("last") or {}
+    audit = payload.get("safety_audit") or {}
+    violations = list(audit.get("violations", []))
+    thr = (reb.get("config") or {}).get("overcommit_threshold_milli", 1000)
+    over_after = {
+        name: row["over_milli"] for name, row in
+        (last.get("clusters") or {}).items()
+        if row["over_milli"] > thr and row["capacity"] > 0}
+    if over_after:
+        violations.append({"kind": "not-drained", "clusters": over_after})
+    if not last.get("converged"):
+        violations.append({"kind": "not-converged"})
+    if not reb.get("evictions"):
+        violations.append({"kind": "no-drains",
+                           "detail": "the hotspot never triggered a drain"})
+    _hb(f"soak done: evictions={reb.get('evictions')} "
+        f"peak_over={reb.get('peak_over_milli')} "
+        f"conservation_violations={reb.get('conservation_violations')}")
+
+    # -- leg 2: re-place parity vs the serial rebalance control -------------
+    rng = random.Random(0x5EB)
+    clusters = build_fleet(rng, 16)
+    names = [c.metadata.name for c in clusters]
+    reb_items = _rebalance_parity_items(rng, 256, names)
+    chunk = 64
+    _hb(f"re-place parity: {len(reb_items)} rebalance re-solves, "
+        f"pipelined chunk={chunk} carry=True vs serial control")
+    estimator = GeneralEstimator()
+    cindex = tensors.ClusterIndex.build(clusters)
+    t0 = time.perf_counter()
+    res = sched_pipeline.run_pipeline(
+        reb_items, cindex, estimator, chunk=chunk, waves=chunk,
+        cache=tensors.EncoderCache(), carry=True, carry_spread=True)
+    batched_s = time.perf_counter() - t0
+    batched = _targets_of(res.results)
+    control = _serial_rebalance_control(reb_items, clusters)
+    want = _targets_of(dict(enumerate(control)))
+    mismatches = [i for i in range(len(reb_items))
+                  if batched.get(i, want.get(i)) != want[i]]
+    if mismatches:
+        violations.append({
+            "kind": "replace-parity",
+            "detail": f"{len(mismatches)} re-solve(s) diverged from the "
+                      "serial rebalance control",
+            "first": mismatches[:8]})
+    parity = {
+        "bindings": len(reb_items),
+        "chunk": chunk,
+        "device_rows": len(res.results),
+        "mismatches": len(mismatches),
+        "bit_identical": not mismatches,
+        "batched_bindings_per_s": round(len(reb_items) / batched_s, 1),
+    }
+    _hb(f"parity done: {parity['device_rows']} device rows, "
+        f"{parity['mismatches']} mismatch(es)")
+
+    out = {
+        "version": 1,
+        "scenario": scenario.name,
+        "seed": args.soak_seed,
+        "drain": {
+            "threshold_milli": thr,
+            "peak_over_milli": reb.get("peak_over_milli"),
+            "final": last.get("clusters"),
+            "evictions": reb.get("evictions"),
+            "cycles": reb.get("cycles"),
+            "converged": bool(last.get("converged")),
+            "conservation_violations": reb.get("conservation_violations"),
+        },
+        "replace_parity": parity,
+        "violations": violations,
+        "soak": payload,
+    }
+    os.makedirs(args.ckpt_dir, exist_ok=True)
+    out_path = os.path.join(args.ckpt_dir, "rebalance_hotspot.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps({
+        "metric": "rebalance hotspot: violations "
+                  f"({reb.get('evictions')} drains, parity over "
+                  f"{len(reb_items)} re-solves)",
+        "value": len(violations),
+        "unit": "violations",
+        "vs_baseline": 0,
+        "detail": {"rebalance": out, "rebalance_path": out_path},
+    }))
+    return 0 if not violations else 1
+
+
 def _synth_coo(batch, err_every: int = 97):
     """A realistic decode workload without paying a 5000-cluster XLA:CPU
     solve: per ROUTE_DEVICE row, Duplicated placements emit one entry per
@@ -1783,6 +1967,17 @@ def main() -> None:
                          "Exit 1 on any conservation violation.")
     ap.add_argument("--soak-seed", type=int, default=0,
                     help="deterministic arrival-process seed")
+    ap.add_argument("--rebalance", action="store_true",
+                    help="rebalance acceptance mode (karmada_tpu/"
+                         "rebalance + loadgen): run the hotspot scenario "
+                         "in compressed virtual time with the rebalance "
+                         "plane armed (device backend on whatever jax "
+                         "platform the environment provides), then assert "
+                         "re-place parity of the carry-chain re-solve vs "
+                         "the serial rebalance control; emits the "
+                         "REBALANCE_r*.json payload.  Exit 1 on any "
+                         "conservation violation, non-convergence, or "
+                         "parity mismatch.")
     ap.add_argument("--mesh", nargs="?", const="auto", default=None,
                     help="mesh bench mode: run the SAME workload through "
                          "the pipelined executor single-device and sharded "
@@ -1891,6 +2086,12 @@ def main() -> None:
         # and no watchdog parent
         _HB_ON = True
         raise SystemExit(run_chaos(args))
+    if args.rebalance:
+        # rebalance mode is self-contained (virtual clock, fixed service
+        # model, XLA:CPU off-hardware like --chaos): the drain loop and
+        # the parity control never touch the device tunnel
+        _HB_ON = True
+        raise SystemExit(run_rebalance(args))
     if args.delta:
         # delta mode is host-only and self-contained: the resident plane's
         # device-path code runs byte-identical on XLA:CPU (forced before

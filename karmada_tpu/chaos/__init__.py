@@ -29,6 +29,7 @@ from karmada_tpu.chaos.plane import (  # noqa: F401 — public surface
     SITE_DEVICE_DISPATCH,
     SITE_ESTIMATOR_RPC,
     SITE_LEASE_HEARTBEAT,
+    SITE_REBALANCE_PLAN,
     SITE_RESIDENT_MIRROR,
     SITE_STORE_WATCH,
     SITE_WORKER_RECONCILE,

@@ -37,11 +37,15 @@ def _readers() -> Dict[str, object]:
     audit ALWAYS runs on a corruption fire, so counting its outcome="ok"
     leg too would make the mismatch proof vacuous."""
     from karmada_tpu.estimator import client as est_client
+    from karmada_tpu.rebalance import plane as rebalance_plane
     from karmada_tpu.resident import state as resident_state
     from karmada_tpu.scheduler import metrics as sched_metrics
     from karmada_tpu.store import worker as store_worker
 
     return {
+        "rebalance_conservation":
+            rebalance_plane.CONSERVATION_VIOLATIONS.total,
+        "rebalance_cycle_faults": rebalance_plane.CYCLE_FAULTS.total,
         "estimator_errors": est_client.ESTIMATOR_ERRORS.total,
         "circuit_transitions": est_client.CIRCUIT_TRANSITIONS.total,
         "cycle_faults": sched_metrics.CYCLE_FAULTS.total,
@@ -161,6 +165,24 @@ def audit_soak(driver, baseline: Optional[Dict[str, float]] = None) -> dict:
             "kind": "fault-unaccounted", "site": chaos_plane.SITE_DEVICE_CYCLE,
             "detail": f"{hang_fires} device-cycle hang(s) fired but the "
                       "backend never degraded"})
+    # rebalance plane: the conservation invariant holds across the whole
+    # soak (no binding with an in-flight rebalance drain ever served
+    # fewer than its desired replicas), and a fired rebalance.plan fault
+    # must be visible as a contained cycle fault
+    if deltas["rebalance_conservation"] > 0:
+        violations.append({
+            "kind": "rebalance-conservation",
+            "detail": f"{int(deltas['rebalance_conservation'])} binding(s) "
+                      "dropped below their desired replica count while a "
+                      "rebalance eviction was in flight"})
+    plan_fires = fires.get(chaos_plane.SITE_REBALANCE_PLAN, 0)
+    if plan_fires and deltas["rebalance_cycle_faults"] <= 0:
+        violations.append({
+            "kind": "fault-unaccounted",
+            "site": chaos_plane.SITE_REBALANCE_PLAN,
+            "detail": f"{plan_fires} rebalance.plan fault(s) fired but "
+                      "no rebalance cycle fault was contained "
+                      "(karmada_rebalance_cycle_faults_total)"})
     corrupt_fires = fires.get(chaos_plane.SITE_RESIDENT_MIRROR, 0)
     if corrupt_fires and deltas["resident_audits_mismatch"] <= 0:
         violations.append({

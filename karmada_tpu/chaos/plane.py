@@ -62,6 +62,7 @@ SITE_RESIDENT_MIRROR = "resident.mirror"
 SITE_STORE_WATCH = "store.watch"
 SITE_WORKER_RECONCILE = "worker.reconcile"
 SITE_LEASE_HEARTBEAT = "lease.heartbeat"
+SITE_REBALANCE_PLAN = "rebalance.plan"
 
 #: site -> modes it supports (parse_spec validates against this; a seam
 #: only ever interprets its own modes, so an unknown mode cannot arm)
@@ -74,6 +75,11 @@ SITES: Dict[str, Tuple[str, ...]] = {
     SITE_STORE_WATCH: ("drop", "dup", "stall", "reorder"),
     SITE_WORKER_RECONCILE: ("error",),
     SITE_LEASE_HEARTBEAT: ("drop",),
+    # rebalance plane (rebalance/plane.py run_cycle): "skip" drops the
+    # whole planned cycle (the next interval re-detects), "raise" aborts
+    # it mid-plan — both must be contained and counted, never lose a
+    # binding or leak a partial drain
+    SITE_REBALANCE_PLAN: ("skip", "raise"),
 }
 
 

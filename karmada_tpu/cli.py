@@ -46,7 +46,8 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                 device_recover_cycles: Optional[int] = None,
                 chaos: Optional[str] = None,
                 chaos_seed: int = 0,
-                aot_cache: str = "off"):
+                aot_cache: str = "off",
+                rebalance: Optional[float] = None):
     """controllers=None rehydrates the persisted --controllers spec; an
     explicit spec is also persisted so later invocations honor it.
 
@@ -96,7 +97,8 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                       resident=resident,
                       resident_audit_interval=resident_audit,
                       device_recover_cycles=device_recover_cycles,
-                      chaos=chaos, chaos_seed=chaos_seed)
+                      chaos=chaos, chaos_seed=chaos_seed,
+                      rebalance=rebalance)
     if controllers is not None:
         cp.apply({"apiVersion": "v1", "kind": "ConfigMap",
                   "metadata": {"namespace": "karmada-system",
@@ -1056,6 +1058,18 @@ def cmd_serve(args) -> int:
             print(f"--explain rate must be in (0, 1], got {explain_rate}",
                   file=sys.stderr)
             return 1
+    rebalance_interval = None
+    if args.rebalance is not None:
+        try:
+            rebalance_interval = float(args.rebalance)
+        except ValueError:
+            print(f"--rebalance interval must be a number of seconds, "
+                  f"got {args.rebalance!r}", file=sys.stderr)
+            return 1
+        if rebalance_interval <= 0:
+            print(f"--rebalance interval must be positive, got "
+                  f"{rebalance_interval}", file=sys.stderr)
+            return 1
     loadgen_scenario = None
     if args.loadgen:
         from karmada_tpu.loadgen import get_scenario
@@ -1099,7 +1113,8 @@ def cmd_serve(args) -> int:
                              if args.device_recover_cycles > 0 else None),
                          chaos=args.chaos or None,
                          chaos_seed=args.chaos_seed,
-                         aot_cache=args.aot_cache)
+                         aot_cache=args.aot_cache,
+                         rebalance=rebalance_interval)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 1
@@ -1138,6 +1153,12 @@ def cmd_serve(args) -> int:
         print(f"CHAOS PLANE ARMED (seed {args.chaos_seed}): {args.chaos} — "
               "deterministic faults will fire at the named seams; state "
               "at /debug/chaos")
+    if rebalance_interval is not None:
+        print(f"rebalance plane armed: drain-and-re-place cycle every "
+              f"{rebalance_interval:g}s (graceful evictions under the "
+              "shared pacing budget, re-placed with origin=rebalance); "
+              "state at /debug/rebalance, render with "
+              "`karmadactl rebalance --endpoint URL`")
     if args.resident:
         if cp.scheduler.backend == "device":
             print("resident-state plane armed: cluster tensors stay "
@@ -1322,6 +1343,32 @@ def cmd_loadgen(args) -> int:
                         seed=args.seed)
     payload = driver.run()
     print(json.dumps(payload, indent=2 if args.pretty else None))
+    return 0
+
+
+def cmd_rebalance(args) -> int:
+    """Render a live serve process's rebalance plane (/debug/rebalance):
+    last detect cycle's per-cluster overcommit/divergence scores,
+    eviction and conservation totals, and the shared pacing-budget
+    state — whether the drain loop is converged at a glance."""
+    import urllib.error
+    import urllib.request
+
+    from karmada_tpu.rebalance import render_state
+
+    base = args.endpoint.rstrip("/")
+    try:
+        with urllib.request.urlopen(base + "/debug/rebalance",
+                                    timeout=10) as r:
+            state = json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        print(f"server error ({e.code}): {e.read().decode()[:200]}",
+              file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"cannot reach {base}: {e.reason}", file=sys.stderr)
+        return 1
+    print(render_state(state))
     return 0
 
 
@@ -1946,6 +1993,21 @@ def build_parser() -> argparse.ArgumentParser:
                          "re-encodes from scratch and compares bit-exact "
                          "against the resident tensors (mismatch = "
                          "metric + forced rebuild; 0 disables)")
+    sv.add_argument("--rebalance", nargs="?", const="30", default=None,
+                    metavar="INTERVAL",
+                    help="arm the rebalance plane (karmada_tpu/rebalance): "
+                         "every INTERVAL seconds (default 30) detect "
+                         "per-cluster overcommit/spread divergence, "
+                         "gracefully evict victims under the shared "
+                         "pacing budget, and re-place them through the "
+                         "scheduler queue with origin=rebalance; state "
+                         "at /debug/rebalance (karmadactl rebalance "
+                         "--endpoint URL)")
+
+    rb = sub.add_parser("rebalance")
+    rb.add_argument("--endpoint", required=True,
+                    help="observability endpoint URL of a live serve "
+                         "process (serve --metrics-port PORT)")
 
     rs = sub.add_parser("resident")
     rs.add_argument("--endpoint", required=True,
@@ -2009,6 +2071,7 @@ COMMANDS = {
     "trace": cmd_trace,
     "vet": cmd_vet,
     "loadgen": cmd_loadgen,
+    "rebalance": cmd_rebalance,
     "resident": cmd_resident,
 }
 
@@ -2053,6 +2116,9 @@ def _dispatch(args) -> int:
     if args.command == "resident":
         # talks to a live serve process over HTTP; no plane is opened
         return cmd_resident(args)
+    if args.command == "rebalance":
+        # talks to a live serve process over HTTP; no plane is opened
+        return cmd_rebalance(args)
     if args.command == "explain":
         # kind mode reads only the model registry; binding mode talks to
         # a live serve process over HTTP — neither opens a plane

@@ -36,10 +36,17 @@ class Descheduler:
         runtime: Runtime,
         members: Dict[str, FakeMemberCluster],
         estimator=None,  # AccurateEstimatorClient (wire path) or None
+        # shared eviction-pacing ledger (rebalance/pacing.EvictionBudget):
+        # the stuck-replica mover and the rebalance plane's drains draw
+        # from the SAME per-cluster budget, so the two evictors cannot
+        # stampede one cluster in the same interval.  None = unpaced
+        # (the pre-budget behavior; unit-test harnesses).
+        budget=None,
     ) -> None:
         self.store = store
         self.members = members
         self.estimator = estimator
+        self.budget = budget
         runtime.register_periodic(self.run_once, name="descheduler")
 
     def _stuck_replicas(self, cluster: str, resource) -> int:
@@ -81,8 +88,18 @@ class Descheduler:
                 if member is None or not member.healthy:
                     continue
                 stuck = self._stuck_replicas(target.name, resource)
-                if stuck > 0:
-                    shrink[target.name] = min(stuck, target.replicas)
+                if stuck <= 0:
+                    continue
+                # shared pacing: one token per (binding, cluster) shrink,
+                # drawn from the same per-cluster ledger the rebalance
+                # plane drains against — a cluster that already absorbed
+                # its interval's evictions is skipped until the window
+                # rolls (the skipped shrink re-detects next round)
+                if (self.budget is not None
+                        and not self.budget.try_acquire(
+                            target.name, consumer="descheduler")):
+                    continue
+                shrink[target.name] = min(stuck, target.replicas)
             if not shrink:
                 continue
 

@@ -139,10 +139,18 @@ class FederatedHPAController:
         runtime: Runtime,
         metrics,  # search.MultiClusterMetricsProvider
         clock: Callable[[], float] = time.time,
+        # autoscale fast path (rebalance plane, ISSUE 10): called as
+        # fast_path(ns, scale_target_ref, desired) right after a scale
+        # mutate, so the control plane can refresh the binding and
+        # priority-push it into the scheduler queue in the SAME round
+        # instead of waiting for the next detector resolve.  None keeps
+        # the legacy detector-paced loop.
+        fast_path: Optional[Callable] = None,
     ) -> None:
         self.store = store
         self.metrics = metrics
         self.clock = clock
+        self.fast_path = fast_path
         self.calc = ReplicaCalculator()
         # per-HPA recommendation history for stabilization windows:
         # (ns, name) -> [(timestamp, recommendation)]
@@ -222,6 +230,11 @@ class FederatedHPAController:
             events.append((self.clock(), current, desired))
             horizon = 3600.0
             events[:] = [e for e in events if self.clock() - e[0] <= horizon]
+            if self.fast_path is not None:
+                # the detector will reconcile the template event too, but
+                # only on its own worker cadence; the fast path closes the
+                # autoscale -> re-place loop in one scheduling cycle
+                self.fast_path(ns, ref, desired)
 
         def set_status(obj: FederatedHPA) -> None:
             obj.status.current_replicas = current

@@ -106,6 +106,12 @@ class ControlPlane:
         # spec string arming deterministic faults at the named seams
         chaos: Optional[str] = None,
         chaos_seed: int = 0,
+        # rebalance plane (karmada_tpu/rebalance, serve --rebalance):
+        # interval in seconds of the periodic drain-and-re-place cycle;
+        # None leaves it disarmed.  When armed (or when the descheduler
+        # is), both evictors share ONE per-cluster pacing budget.
+        rebalance: Optional[float] = None,
+        rebalance_cfg=None,  # rebalance.RebalanceConfig override
     ) -> None:
         self.clock = clock if clock is not None else time.time
         from karmada_tpu.utils.events import EventRecorder
@@ -155,6 +161,19 @@ class ControlPlane:
         self.interpreter.attach_store(self.store)
         self.recorder = EventRecorder()
         self.detector = ResourceDetector(self.store, self.runtime, self.interpreter)
+        # shared eviction pacing (rebalance/pacing.py): the rebalance
+        # plane's drains and the descheduler's stuck-replica shrinks draw
+        # from ONE per-cluster token budget, so the two evictors cannot
+        # stampede a cluster in the same interval
+        self.eviction_budget_shared = None
+        if rebalance or enable_descheduler:
+            from karmada_tpu.rebalance import EvictionBudget, RebalanceConfig
+
+            bcfg = rebalance_cfg if rebalance_cfg is not None \
+                else RebalanceConfig()
+            self.eviction_budget_shared = EvictionBudget(
+                per_cluster=bcfg.budget_per_cluster,
+                interval_s=bcfg.budget_interval_s, clock=self.clock)
         self.scheduler = Scheduler(self.store, self.runtime, backend=backend,
                                    recorder=self.recorder, waves=waves,
                                    pipeline_chunk=pipeline_chunk,
@@ -169,7 +188,12 @@ class ControlPlane:
                                        resident_audit_interval),
                                    device_recover_cycles=(
                                        device_recover_cycles),
-                                   chaos=chaos, chaos_seed=chaos_seed)
+                                   chaos=chaos, chaos_seed=chaos_seed,
+                                   rebalance=rebalance,
+                                   rebalance_cfg=rebalance_cfg,
+                                   rebalance_budget=(
+                                       self.eviction_budget_shared),
+                                   rebalance_clock=self.clock)
         self.binding_controller = BindingController(
             self.store, self.runtime, self.interpreter
         )
@@ -229,7 +253,8 @@ class ControlPlane:
         self.descheduler_estimator = AccurateEstimatorClient()
         self.descheduler = (
             Descheduler(self.store, self.runtime, self.members,
-                        estimator=self.descheduler_estimator)
+                        estimator=self.descheduler_estimator,
+                        budget=self.eviction_budget_shared)
             if enable_descheduler
             else None
         )
@@ -256,7 +281,8 @@ class ControlPlane:
         )
 
         self.federated_hpa = FederatedHPAController(
-            self.store, self.runtime, self.metrics_provider, clock=self.clock
+            self.store, self.runtime, self.metrics_provider, clock=self.clock,
+            fast_path=self._hpa_fast_path,
         )
         self.cron_hpa = CronFederatedHPAController(
             self.store, self.runtime, clock=self.clock
@@ -301,6 +327,32 @@ class ControlPlane:
         from karmada_tpu.store.persistence import resync
 
         resync(self.store)
+
+    def _hpa_fast_path(self, ns: str, ref, desired: int) -> None:
+        """FederatedHPA scale fast path (rebalance plane, ISSUE 10):
+        refresh the binding's replica count NOW (the detector will later
+        reconcile the same value from the template — idempotent) and
+        priority-push it straight into the scheduler queue, so an
+        autoscale event re-places in one scheduling cycle instead of
+        waiting out the detector resolve."""
+        from karmada_tpu.controllers.detector import binding_name
+        from karmada_tpu.models.work import ResourceBinding as RB
+        from karmada_tpu.scheduler.service import FAST_PATH_PRIORITY
+        from karmada_tpu.store.store import NotFoundError
+
+        name = binding_name(ref.kind, ref.name)
+        if self.store.try_get(RB.KIND, ns, name) is None:
+            return  # no binding rendered yet: the detector path owns it
+
+        def bump(obj) -> None:
+            obj.spec.replicas = desired
+
+        try:
+            self.store.mutate(RB.KIND, ns, name, bump)
+        except NotFoundError:
+            return
+        self.scheduler.promote((ns, name), priority=FAST_PATH_PRIORITY,
+                               origin="hpa")
 
     def checkpoint(self) -> None:
         """Compact the WAL into a fresh snapshot (periodic maintenance)."""

@@ -26,7 +26,7 @@ import socketserver
 import struct
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from karmada_tpu.models.work import ReplicaRequirements
 from karmada_tpu.utils.quantity import Quantity
@@ -253,16 +253,10 @@ def replicas_on_node(
     return max(per_node, 0)
 
 
-def max_sets_from_free_table(free: List[Dict[str, int]], components) -> int:
-    """Whole component SETS that fit a free-capacity table (pool level).
-
-    The single implementation behind AccurateEstimatorServer and
-    SnapshotEstimator component-set answers.  The reference estimator server
-    leaves node-level set packing as a TODO (estimate.go:70-90 runs only
-    quota-style plugins); this pool-level bound is at least as tight.
-    Units follow the table convention: 'pods' is a raw count, cpu is milli,
-    everything else milli -> Value.
-    """
+def _pool_sets_bound(free: List[Dict[str, int]], components) -> int:
+    """Pool-level upper bound on whole component sets: summed free
+    capacity divided by one set's aggregate demand (the reference's
+    quota-style view)."""
     from karmada_tpu.estimator.general import per_set_requirement, pods_in_set
     from karmada_tpu.utils.quantity import RESOURCE_CPU, RESOURCE_PODS
 
@@ -286,6 +280,97 @@ def max_sets_from_free_table(free: List[Dict[str, int]], components) -> int:
             return 0
         total = min(total, avail // req)
     return min(total, MAX_INT32)
+
+
+def _per_replica_needs(components) -> List[Tuple[int, Dict[str, int]]]:
+    """(replicas, per-replica need in table units) per component: cpu in
+    milli, every other resource milli (request Value x 1000).  The 'pods'
+    axis is implicit — one pod per replica — so an explicit 'pods'
+    request is skipped here (it is already counted by pods_in_set)."""
+    from karmada_tpu.utils.quantity import (
+        RESOURCE_CPU,
+        RESOURCE_PODS,
+        resource_request_value,
+    )
+
+    needs: List[Tuple[int, Dict[str, int]]] = []
+    for c in components:
+        req: Dict[str, int] = {}
+        rr = c.replica_requirements
+        if rr is not None:
+            for rname, qty in rr.resource_request.items():
+                if rname == RESOURCE_PODS:
+                    continue
+                v = resource_request_value(rname, qty)
+                if v <= 0:
+                    continue
+                req[rname] = v if rname == RESOURCE_CPU else v * 1000
+        needs.append((max(int(c.replicas), 0), req))
+    return needs
+
+
+def max_sets_from_free_table(free: List[Dict[str, int]], components) -> int:
+    """Whole component SETS that fit a free-capacity table, packed NODE
+    BY NODE.
+
+    The single implementation behind AccurateEstimatorServer and
+    SnapshotEstimator component-set answers.  The reference estimator
+    server leaves node-level set packing as a TODO (estimate.go:70-90
+    runs only quota-style pool plugins); this resolves it: each component
+    replica of each candidate set is placed first-fit onto a node that
+    still fits its whole per-replica request, so a fragmented pool can no
+    longer overreport (two 1-cpu nodes pack ZERO sets of a 2-cpu pod,
+    where the pool bound said one).  First-fit in table order is greedy,
+    not optimal bin packing (that is NP-hard) — it can only UNDER-report
+    relative to a perfect packing, the safe direction for an estimator.
+    Workloads with no per-replica resource requests keep the exact pool
+    answer (pods spread freely, so pool == packing).  Node selectors are
+    out of scope here, as in the reference's pool plugins.
+
+    Units follow the table convention: 'pods' is a raw count, cpu is
+    milli, everything else milli -> Value.
+    """
+    upper = _pool_sets_bound(free, components)
+    if upper <= 0:
+        return 0
+    needs = _per_replica_needs(components)
+    if not any(req for _, req in needs):
+        return upper  # pods-only demand: the pool bound is exact
+    nodes = [dict(f) for f in free]
+    # per-component candidate lists in first-fit (table) order: node
+    # capacity only decreases, so a node that cannot fit component k's
+    # per-replica request NOW never can again — prune it permanently.
+    # That keeps the first-fit outcome bit-identical to a full rescan
+    # while making the whole pack amortized O(placements + components x
+    # nodes) instead of O(placements x nodes).
+    cand = [list(range(len(nodes))) for _ in needs]
+    sets = 0
+    while sets < upper:
+        placed_all = True
+        for k, (n_replicas, req) in enumerate(needs):
+            lst = cand[k]
+            for _ in range(n_replicas):
+                node = None
+                while lst:
+                    nd = nodes[lst[0]]
+                    if int(nd.get("pods", 0)) > 0 and all(
+                            int(nd.get(r, 0)) >= v
+                            for r, v in req.items()):
+                        node = nd
+                        break
+                    lst.pop(0)  # exhausted for this component forever
+                if node is None:
+                    placed_all = False
+                    break
+                node["pods"] = int(node.get("pods", 0)) - 1
+                for r, v in req.items():
+                    node[r] = int(node.get(r, 0)) - v
+            if not placed_all:
+                break
+        if not placed_all:
+            break
+        sets += 1
+    return sets
 
 
 _METHODS = {

@@ -42,9 +42,15 @@ from karmada_tpu.models.cluster import (
 from karmada_tpu.models.meta import ObjectMeta
 from karmada_tpu.models.policy import (
     Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    REPLICA_DIVISION_AGGREGATED,
+    REPLICA_SCHEDULING_DIVIDED,
     REPLICA_SCHEDULING_DUPLICATED,
     ReplicaSchedulingStrategy,
+    ResourceSelector,
 )
+from karmada_tpu.models.unstructured import Unstructured
 from karmada_tpu.models.work import (
     COND_SCHEDULED,
     ObjectReference,
@@ -139,12 +145,27 @@ def build_cluster(name: str, cpu_milli: int = 64_000, memory_gi: int = 256,
     )
 
 
+def _scheduling_strategy(divided: bool) -> ReplicaSchedulingStrategy:
+    if divided:
+        # Divided + Aggregated: pack the replicas into the fewest
+        # most-available clusters — the shape rebalance drains act on
+        return ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_AGGREGATED)
+    return ReplicaSchedulingStrategy(
+        replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED)
+
+
 def build_binding(name: str, priority: int = 0,
                   namespace: str = LOADGEN_NS,
-                  resource_name: Optional[str] = None) -> ResourceBinding:
+                  resource_name: Optional[str] = None,
+                  replicas: int = 1,
+                  divided: bool = False) -> ResourceBinding:
     """A synthetic binding: Duplicated placement over every feasible
     cluster (no affinity restriction), so cluster kills force real
-    rescheduling work.  `resource_name` points every binding at one
+    rescheduling work — or, with `divided`, Divided+Aggregated packing
+    of `replicas` into the fewest clusters (the rebalance plane's
+    drainable shape).  `resource_name` points every binding at one
     shared template (full-ControlPlane runs, where the binding
     controller renders real Works from it)."""
     rb = ResourceBinding()
@@ -155,12 +176,43 @@ def build_binding(name: str, priority: int = 0,
                                  namespace=namespace,
                                  name=resource_name or name,
                                  uid=f"uid-{name}"),
-        replicas=1,
-        placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
-            replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED)),
+        replicas=replicas,
+        placement=Placement(replica_scheduling=_scheduling_strategy(divided)),
         schedule_priority=priority or None,
     )
     return rb
+
+
+def build_workload_manifest(name: str, replicas: int,
+                            namespace: str = LOADGEN_NS) -> dict:
+    """A Deployment template for policy-path injection: the detector
+    matches it against the loadgen PropagationPolicy and renders the
+    ResourceBinding — the full template -> policy -> binding fan-out."""
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": {"loadgen.karmada.io/injected": "true"}},
+        "spec": {"replicas": replicas, "template": {"spec": {
+            "containers": [{"name": "app", "image": "app:1",
+                            "resources": {"requests": {"cpu": "100m"}}}],
+        }}},
+    }
+
+
+def build_loadgen_policy(divided: bool,
+                         namespace: str = LOADGEN_NS) -> PropagationPolicy:
+    """ONE PropagationPolicy claiming every policy-path workload in the
+    loadgen namespace (detector/policy fan-out under load)."""
+    return PropagationPolicy(
+        metadata=ObjectMeta(name="lg-policy", namespace=namespace),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(
+                api_version="apps/v1", kind="Deployment",
+                namespace=namespace)],
+            placement=Placement(
+                replica_scheduling=_scheduling_strategy(divided)),
+        ),
+    )
 
 
 def warm_device_path(plane, sizes: Tuple[int, ...] = (2, 9, 17, 64),
@@ -217,13 +269,57 @@ def warm_device_path(plane, sizes: Tuple[int, ...] = (2, 9, 17, 64),
         sched.device_cycle_timeout_s = prev
 
 
+class ReplacementStatusEcho:
+    """Stand-in for the member status-collection chain in the
+    scheduler-only slice: whenever a binding's schedule result changes,
+    report every target cluster applied + Healthy in aggregated_status.
+    The graceful-eviction controller then drains rebalance eviction
+    tasks on the PRODUCTION signal (replacement healthy), not only on
+    grace expiry.  Terminates trivially: once the echo matches the spec,
+    further events are no-ops (the store's drain loop is re-entrancy
+    safe for subscriber writes)."""
+
+    def __init__(self, store: ObjectStore) -> None:
+        self.store = store
+        store.bus.subscribe(self._on_event, kind=ResourceBinding.KIND)
+
+    def _on_event(self, event: Event) -> None:
+        if event.type == DELETED:
+            return
+        rb = event.obj
+        want = {t.name for t in rb.spec.clusters}
+        have = {i.cluster_name for i in rb.status.aggregated_status
+                if i.applied and i.health == "Healthy"}
+        if want == have:
+            return
+        from karmada_tpu.models.work import AggregatedStatusItem
+
+        def echo(obj: ResourceBinding) -> None:
+            obj.status.aggregated_status = [
+                AggregatedStatusItem(cluster_name=t.name, applied=True,
+                                     health="Healthy")
+                for t in obj.spec.clusters]
+
+        try:
+            self.store.mutate(ResourceBinding.KIND, rb.metadata.namespace,
+                              rb.metadata.name, echo)
+        except NotFoundError:
+            pass
+
+
 class ServeSlice:
     """The scheduler-owning slice of a ControlPlane: store + runtime +
     batched scheduler over the same SchedulingQueue/worker machinery
     serve mode runs.  The full ControlPlane wires ~30 controllers the
     soak does not exercise; the slice keeps tier-1 soaks inside budget.
     LoadDriver duck-types its plane — anything exposing .store /
-    .runtime / .scheduler (a ControlPlane included) drives the same."""
+    .runtime / .scheduler (a ControlPlane included) drives the same.
+
+    Scenario-driven extras: `policy_path` scenarios get the real
+    ResourceDetector (template -> policy -> binding fan-out), and
+    `rebalance_interval_cycles` scenarios arm the rebalance plane plus
+    the graceful-eviction chain it drains through (with the status echo
+    standing in for member health collection)."""
 
     def __init__(self, scenario: Scenario, clock, model: ServiceModel,
                  backend: str = "serial", explain: float = 0.0,
@@ -233,6 +329,18 @@ class ServeSlice:
                  device_recover_cycles: Optional[int] = None) -> None:
         self.store = ObjectStore()
         self.runtime = Runtime()
+        reb_interval = scenario.rebalance_interval_s(model)
+        reb_cfg = reb_budget = None
+        if reb_interval > 0:
+            from karmada_tpu.rebalance import EvictionBudget, RebalanceConfig
+
+            # per-cluster budget sized so a hotspot drain takes a couple
+            # of windows (pacing visible in the soak, convergence still
+            # bounded); the window is the rebalance interval itself
+            reb_budget = EvictionBudget(per_cluster=24,
+                                        interval_s=reb_interval,
+                                        clock=clock)
+            reb_cfg = RebalanceConfig(interval_s=reb_interval)
         self.scheduler = Scheduler(
             self.store, self.runtime, backend=backend,
             batch_window=scenario.batch_window,
@@ -244,7 +352,29 @@ class ServeSlice:
             resident_audit_interval=resident_audit_interval,
             device_cycle_timeout_s=device_cycle_timeout_s,
             device_recover_cycles=device_recover_cycles,
+            rebalance=(reb_interval or None),
+            rebalance_cfg=reb_cfg,
+            rebalance_budget=reb_budget,
         )
+        if scenario.policy_path:
+            from karmada_tpu.controllers.detector import ResourceDetector
+            from karmada_tpu.interpreter import ResourceInterpreter
+
+            self.interpreter = ResourceInterpreter()
+            self.interpreter.attach_store(self.store)
+            self.detector = ResourceDetector(self.store, self.runtime,
+                                             self.interpreter)
+        if reb_interval > 0:
+            from karmada_tpu.controllers.failover import (
+                GracefulEvictionController,
+            )
+
+            # grace period far beyond the soak horizon: ONLY replacement
+            # health may drain a task, so a conservation breach cannot
+            # hide behind a grace-expiry drain
+            self.graceful_eviction = GracefulEvictionController(
+                self.store, self.runtime, grace_period_s=1e9, clock=clock)
+            self.status_echo = ReplacementStatusEcho(self.store)
         for i in range(scenario.n_clusters):
             self.store.create(build_cluster(f"lg-m{i}"))
 
@@ -304,6 +434,11 @@ class LoadDriver:
         self.scenario = scenario
         self.realtime = realtime
         self.resource_name = resource_name
+        # policy-path mode: inject Deployment templates the detector
+        # renders into bindings (the plane must wire a detector —
+        # ServeSlice does for policy_path scenarios; a ControlPlane
+        # always has one)
+        self.policy_path = scenario.policy_path
         self.clock = clock if clock is not None else (
             RealClock() if realtime else VirtualClock())
         self.model = model if model is not None else ServiceModel()
@@ -419,6 +554,14 @@ class LoadDriver:
         assert not self._installed
         self._installed = True
         self._wall_t0 = _time.perf_counter()
+        if self.policy_path:
+            # one policy claims every injected template (detector fan-out)
+            policy = build_loadgen_policy(
+                self.scenario.binding_style == "divided")
+            if self.plane.store.try_get(
+                    PropagationPolicy.KIND, LOADGEN_NS,
+                    policy.name) is None:
+                self.plane.store.create(policy)
         if self._chaos:
             self._setup_chaos()
         # arm the flight recorder (the report derives its latency/dwell
@@ -502,6 +645,21 @@ class LoadDriver:
     # -- traffic -------------------------------------------------------------
     def _inject_binding(self, t: float) -> None:
         self._n_injected += 1
+        if self.policy_path:
+            # template in, binding out: the detector matches the loadgen
+            # policy and renders the ResourceBinding, so the soak load
+            # crosses the full controller fan-out.  The flight is keyed
+            # by the binding the detector WILL create.
+            from karmada_tpu.controllers.detector import binding_name
+
+            name = f"lg-{self._name_tag}w{self._n_injected:06d}"
+            key = (LOADGEN_NS, binding_name("Deployment", name))
+            with self._lock:
+                self._flight[key] = _Flight(t_inject=t, priority=0)
+            self.plane.store.create(Unstructured.from_manifest(
+                build_workload_manifest(
+                    name, self.scenario.binding_replicas)))
+            return
         name = f"lg-{self._name_tag}b{self._n_injected:06d}"
         prio = (PRIORITY_HIGH
                 if self.rng.random() < self.scenario.priority_high_frac
@@ -510,7 +668,9 @@ class LoadDriver:
             self._flight[(LOADGEN_NS, name)] = _Flight(t_inject=t,
                                                        priority=prio)
         self.plane.store.create(build_binding(
-            name, priority=prio, resource_name=self.resource_name))
+            name, priority=prio, resource_name=self.resource_name,
+            replicas=self.scenario.binding_replicas,
+            divided=self.scenario.binding_style == "divided"))
 
     def _apply_cluster_event(self, spec) -> None:
         if spec.kind in ("chaos", "chaos_clear"):
@@ -742,6 +902,20 @@ class LoadDriver:
                 self.plane.runtime.tick()
                 self._sample_queue()
             self._drain()
+            # rebalance convergence (hotspot -> drain -> re-place ->
+            # converge): the paced drains create NEW scheduling work
+            # after the arrival stream ends, so keep stepping rebalance
+            # intervals until the detector reports nothing left to drain
+            # and every eviction task has settled (or the round budget
+            # runs out — the residual then shows in the report)
+            reb = getattr(self.plane.scheduler, "rebalance_plane", None)
+            if reb is not None and not self.realtime:
+                for _ in range(64):
+                    if reb.converged() and reb.pending_drains() == 0:
+                        break
+                    self.clock.advance(reb.cfg.interval_s)
+                    self.plane.runtime.tick()
+                    self._drain()
             if self._chaos:
                 # chaos epilogue while the plane + rules are still armed:
                 # deliver any still-held watch events (a stalled event

@@ -168,6 +168,12 @@ def build_soak_report(driver) -> dict:
         "resident": (driver.plane.scheduler.resident_state()
                      if hasattr(driver.plane.scheduler, "resident_state")
                      else None),
+        # rebalance plane (karmada_tpu/rebalance): cycle/eviction totals,
+        # last detect scores per cluster, conservation-violation count;
+        # None when the plane is disarmed
+        "rebalance": (driver.plane.scheduler.rebalance_state()
+                      if hasattr(driver.plane.scheduler, "rebalance_state")
+                      else None),
         "residual_queue": getattr(driver, "residual", {}),
         **{k: fs[k] for k in ("injected", "scheduled", "failed_attempts",
                               "reschedules")},

@@ -69,6 +69,20 @@ class Scenario:
     admission_batches: float = 4.0      # admission bound, batch_windows
     events: Tuple[ClusterEventSpec, ...] = field(default_factory=tuple)
     slow: bool = False                  # heavy variant (excluded tier-1)
+    # workload shape: "duplicated" places every binding on all feasible
+    # clusters; "divided" (Divided + Aggregated) packs binding_replicas
+    # into the fewest most-available clusters — the shape rebalance
+    # drains act on (a duplicated re-solve would go right back)
+    binding_style: str = "duplicated"
+    binding_replicas: int = 1
+    # policy-path mode (ROADMAP item 2 leftover): inject workloads as
+    # Deployment templates matched by ONE PropagationPolicy, so the soak
+    # exercises the detector/policy fan-out (template -> policy match ->
+    # binding render) instead of creating ResourceBindings directly
+    policy_path: bool = False
+    # rebalance plane: cycle interval in full-batch service times
+    # (model.cost(batch_window)); 0 leaves the plane disarmed
+    rebalance_interval_cycles: float = 0.0
 
     @property
     def chaotic(self) -> bool:
@@ -109,6 +123,10 @@ class Scenario:
 
     def deadline_s(self, model) -> float:
         return self.deadline_cycles * model.cost(self.batch_window)
+
+    def rebalance_interval_s(self, model) -> float:
+        """Rebalance cycle interval on the virtual clock (0 = disarmed)."""
+        return self.rebalance_interval_cycles * model.cost(self.batch_window)
 
     def admission_limit(self) -> int:
         return max(self.batch_window,
@@ -212,6 +230,38 @@ SCENARIOS: Dict[str, Scenario] = {s.name: s for s in (
                              spec="resident.mirror:corrupt#1"),
             ClusterEventSpec(at_frac=0.85, kind="chaos",
                              spec="device.dispatch:raise#1"),
+        ),
+    ),
+    # hotspot (ISSUE 10 rebalance acceptance shape): 4 of 6 clusters
+    # start capacity-crushed, so the Divided+Aggregated workload packs
+    # onto the 2 "hot" survivors (skewed arrivals).  Then capacity
+    # churn: the cold 4 restore AND the hot 2 flap down — placements
+    # that were fine are now overcommitted, the exact situation the
+    # scheduler never revisits and the rebalance plane exists for.  The
+    # plane must drain the hot clusters to within the overcommit
+    # threshold (paced by the shared eviction budget), re-place victims
+    # through the normal queue with origin=rebalance, and converge with
+    # zero conservation violations.  Workloads flow through the
+    # detector/policy path (one PropagationPolicy matches every injected
+    # Deployment), and one chaos rebalance.plan:skip fault proves the
+    # seam + auditor accountability.
+    Scenario(
+        name="hotspot",
+        description="skewed arrivals pack 2 hot clusters, capacity churn "
+                    "overcommits them; rebalance drains + re-places",
+        n_bindings=160, load_factor=0.5, deadline_cycles=2.0,
+        n_clusters=6,
+        binding_style="divided", binding_replicas=3,
+        policy_path=True,
+        rebalance_interval_cycles=2.0,
+        events=(
+            ClusterEventSpec(at_frac=0.0, kind="flap_down", count=4,
+                             scale=0.05),
+            ClusterEventSpec(at_frac=0.55, kind="flap_up", count=4),
+            ClusterEventSpec(at_frac=0.6, kind="flap_down", count=2,
+                             scale=0.1),
+            ClusterEventSpec(at_frac=0.75, kind="chaos",
+                             spec="rebalance.plan:skip#1"),
         ),
     ),
     # heavy variants: same shapes, production-shaped counts; marked slow

@@ -56,6 +56,10 @@ SPAN_ESTIMATOR_RPC = "estimator.rpc"      # one per-cluster estimator call
 SPAN_RESIDENT_APPLY = "resident.apply"    # delta apply / structural rebuild
 SPAN_RESIDENT_ENCODE = "resident.encode"  # gather + miss-subset re-encode
 SPAN_RESIDENT_AUDIT = "resident.audit"    # bit-exact parity audit
+# karmada_tpu/rebalance (the drain-and-re-place plane)
+SPAN_REBALANCE_CYCLE = "rebalance.cycle"    # one detect->drain->audit pass
+SPAN_REBALANCE_DETECT = "rebalance.detect"  # tensor assembly + jit score
+SPAN_REBALANCE_DRAIN = "rebalance.drain"    # paced graceful evictions
 # controllers
 SPAN_BINDING_RENDER = "binding.ensure_works"
 SPAN_DETECTOR_MATCH = "detector.match_policy"
@@ -67,7 +71,8 @@ SPAN_NAMES = (
     SPAN_DISPATCH, SPAN_SPREAD, SPAN_BIG, SPAN_WAIT, SPAN_D2H, SPAN_DECODE,
     SPAN_ESTIMATOR_RPC, SPAN_RESIDENT_APPLY, SPAN_RESIDENT_ENCODE,
     SPAN_RESIDENT_AUDIT, SPAN_BINDING_RENDER, SPAN_DETECTOR_MATCH,
-    SPAN_WARMUP,
+    SPAN_WARMUP, SPAN_REBALANCE_CYCLE, SPAN_REBALANCE_DETECT,
+    SPAN_REBALANCE_DRAIN,
 )
 
 # every pipeline stage a healthy device chunk must traverse (the tier-1

@@ -1,0 +1,82 @@
+"""Rebalance detect kernel: per-cluster overcommit + spread divergence.
+
+The rebalance plane (karmada_tpu/rebalance) closes the control loop the
+reference runs in pkg/descheduler: every rebalance interval it scores the
+FLEET — how overcommitted is each cluster against its capacity, and how
+far does the committed-replica share diverge from the capacity share —
+and selects drain candidates.  The scoring is one small jitted kernel
+over [C] tensors (the same dense shape discipline as ops/solver.py): on
+an accelerator the resident cluster tensors are already device-side, so
+the per-interval detect costs one tiny dispatch, not a host scan.
+
+All math is int64 in milli units (ratios x1000) — no float anywhere, so
+the drain plan is bit-deterministic across backends and replays exactly
+in virtual-clock soaks.
+
+Outputs per cluster:
+  drain_need   replicas to shed to get back inside the thresholds
+               (max of the overcommit need and the gated spread need)
+  over_milli   committed/capacity ratio x1000 (capacity 0 with load
+               reports OVER_SATURATED)
+  div_milli    committed-share minus capacity-share, x1000 (positive =
+               this cluster carries more than its fair share)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+#: over_milli sentinel for "committed load on a cluster with zero
+#: usable capacity" — saturated beyond any finite ratio
+OVER_SATURATED = np.int64(1) << 30
+
+
+@partial(jax.jit, static_argnames=("threshold_milli", "spread_tol_milli"))
+def score_kernel(committed, capacity, valid,
+                 threshold_milli: int, spread_tol_milli: int):
+    """committed/capacity int64 [C], valid bool [C]; thresholds static
+    milli ints (they change only by operator reconfig, like `waves`)."""
+    cap = jnp.where(valid, jnp.maximum(capacity, 0), 0)
+    com = jnp.where(valid, jnp.maximum(committed, 0), 0)
+    sat = jnp.asarray(OVER_SATURATED, dtype=jnp.int64)
+    over_milli = jnp.where(
+        cap > 0, com * 1000 // jnp.maximum(cap, 1),
+        jnp.where(com > 0, sat, 0))
+    # overcommit: drain down to floor(threshold * capacity)
+    allowed = cap * threshold_milli // 1000
+    over_need = jnp.maximum(com - allowed, 0)
+    # spread divergence: committed share vs capacity share of the fleet
+    total_com = jnp.sum(com)
+    total_cap = jnp.sum(cap)
+    share_milli = jnp.where(total_com > 0,
+                            com * 1000 // jnp.maximum(total_com, 1), 0)
+    fair_milli = jnp.where(total_cap > 0,
+                           cap * 1000 // jnp.maximum(total_cap, 1), 0)
+    div_milli = share_milli - fair_milli
+    # spread need only gates in when divergence exceeds the tolerance:
+    # drain down to (fair share + tolerance) of the committed total
+    spread_allowed = (fair_milli + spread_tol_milli) * total_com // 1000
+    spread_need = jnp.where(div_milli > spread_tol_milli,
+                            jnp.maximum(com - spread_allowed, 0), 0)
+    drain_need = jnp.where(valid, jnp.maximum(over_need, spread_need), 0)
+    return drain_need, over_milli, div_milli
+
+
+def score(committed: np.ndarray, capacity: np.ndarray, valid: np.ndarray,
+          threshold_milli: int, spread_tol_milli: int):
+    """Host wrapper: int64/bool device round-trip of the detect kernel,
+    results back as numpy (the drain planner is host-side)."""
+    drain_need, over_milli, div_milli = score_kernel(
+        np.ascontiguousarray(committed, dtype=np.int64),
+        np.ascontiguousarray(capacity, dtype=np.int64),
+        np.ascontiguousarray(valid, dtype=bool),
+        threshold_milli=int(threshold_milli),
+        spread_tol_milli=int(spread_tol_milli))
+    return (np.asarray(drain_need), np.asarray(over_milli),
+            np.asarray(div_milli))

@@ -1,0 +1,90 @@
+"""Shared eviction pacing: ONE per-cluster token budget for every evictor.
+
+Two serve-path evictors act on the same fleet — the stuck-replica mover
+(controllers/descheduler.py) and the rebalance plane's drain step
+(rebalance/plane.py).  Each is individually rate-limited, but two
+individually-paced evictors can still stampede one cluster in the same
+interval.  This budget is the shared ledger both draw from: at most
+`per_cluster` eviction acquisitions per cluster per `interval_s` window,
+whoever asks first wins, and every denial is counted by consumer so a
+starved evictor is visible on a dashboard.
+
+The window is a fixed tumbling interval (not a continuous token bucket):
+tumbling windows replay exactly on the virtual clock the compressed
+soaks inject, which is what makes the pacing property testable.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict
+
+from karmada_tpu.utils.metrics import REGISTRY
+
+BUDGET_SPENT = REGISTRY.counter(
+    "karmada_rebalance_eviction_budget_spent_total",
+    "Eviction-pacing tokens granted from the shared per-cluster budget, "
+    "by consumer (descheduler / rebalance)",
+    ("consumer",),
+)
+
+BUDGET_DENIED = REGISTRY.counter(
+    "karmada_rebalance_eviction_budget_denied_total",
+    "Eviction attempts refused because the cluster's shared pacing "
+    "budget for the current interval was exhausted, by consumer",
+    ("consumer",),
+)
+
+
+class EvictionBudget:
+    """Per-cluster tumbling-window eviction allowance shared by every
+    serve-path evictor.  `try_acquire` is the only gate: a False return
+    means the cluster already absorbed its allowed evictions this
+    interval and the caller must wait for the next window."""
+
+    def __init__(self, per_cluster: int = 8, interval_s: float = 60.0,
+                 clock: Callable[[], float] = time.time) -> None:
+        self.per_cluster = max(1, int(per_cluster))
+        self.interval_s = float(interval_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        # guarded-by: _lock — current window start (rolled in place by
+        # each locked section when the interval elapses)
+        self._window_start = clock()
+        # guarded-by: _lock — per-cluster spend in the current window
+        self._spent: Dict[str, int] = {}
+
+    def try_acquire(self, cluster: str, consumer: str = "rebalance") -> bool:
+        """One eviction token for `cluster`, or False when the cluster's
+        budget for this window is spent (counted per consumer)."""
+        with self._lock:
+            now = self.clock()
+            if now - self._window_start >= self.interval_s:
+                self._window_start = now
+                self._spent = {}
+            spent = self._spent.get(cluster, 0)
+            if spent >= self.per_cluster:
+                BUDGET_DENIED.inc(consumer=consumer)
+                return False
+            self._spent[cluster] = spent + 1
+        BUDGET_SPENT.inc(consumer=consumer)
+        return True
+
+    def remaining(self, cluster: str) -> int:
+        with self._lock:
+            now = self.clock()
+            if now - self._window_start >= self.interval_s:
+                self._window_start = now
+                self._spent = {}
+            return self.per_cluster - self._spent.get(cluster, 0)
+
+    def state(self) -> dict:
+        with self._lock:
+            now = self.clock()
+            return {
+                "per_cluster": self.per_cluster,
+                "interval_s": self.interval_s,
+                "window_age_s": round(max(0.0, now - self._window_start), 6),
+                "spent": dict(self._spent),
+            }

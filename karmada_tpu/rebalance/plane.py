@@ -1,0 +1,444 @@
+"""RebalancePlane: the periodic drain-and-re-place cycle on the solver.
+
+Closes ROADMAP item 5's loop: the reference control plane rebalances
+placements with pkg/descheduler on the SAME solver the scheduler runs;
+here the serve path gets the equivalent — every `interval_s` (on the
+scheduler queue's clock, so compressed virtual-time soaks drive it
+deterministically) the plane
+
+  detect    scores per-cluster overcommit and spread divergence with the
+            jitted kernel (ops/rebalance_detect) over [C] tensors
+            assembled from the live fleet: committed replicas per
+            cluster from the store's schedule results, capacity from the
+            cluster ResourceSummaries;
+  drain     picks victims on each over-threshold cluster (lowest
+            schedule priority first, biggest per-cluster allotment
+            first) and evicts them through the EXISTING graceful-
+            eviction chain (controllers/failover.evict_cluster,
+            producer="rebalance") — the replica leaves spec.clusters but
+            its Work survives until the replacement reports healthy, so
+            serving capacity never dips.  Every eviction draws a token
+            from the shared pacing budget (rebalance/pacing.py), the
+            same ledger controllers/descheduler.py draws from, so the
+            two evictors cannot stampede a cluster in one interval;
+  re-place  the eviction's generation bump re-enters the binding through
+            the normal push path, and the plane additionally promotes it
+            with origin="rebalance" (scheduler.promote) so its queue
+            dwell is attributed to the rebalance plane and the next
+            cycle re-solves it through the pipelined executor (carry
+            chain pricing the remainder);
+  audit     asserts the conservation invariant: no binding with an
+            in-flight rebalance eviction may serve fewer than its
+            desired replicas (spec.clusters + pending eviction tasks >=
+            spec.replicas).  Violations are counted
+            (karmada_rebalance_conservation_violations_total) and the
+            chaos safety auditor (chaos/audit.py) fails a soak on them.
+
+Chaos seam `rebalance.plan` (skip / raise) fires at the top of the
+cycle; a raising cycle is contained (counted, never propagated into the
+runtime's periodic loop).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karmada_tpu import chaos as chaos_mod
+from karmada_tpu import obs
+from karmada_tpu.controllers.failover import evict_cluster
+from karmada_tpu.models.cluster import Cluster
+from karmada_tpu.models.policy import REPLICA_SCHEDULING_DIVIDED
+from karmada_tpu.models.work import ResourceBinding
+from karmada_tpu.ops import rebalance_detect
+from karmada_tpu.rebalance.pacing import EvictionBudget
+from karmada_tpu.store.store import NotFoundError
+from karmada_tpu.utils.metrics import REGISTRY
+
+PRODUCER = "rebalance"
+
+CYCLES = REGISTRY.counter(
+    "karmada_rebalance_cycles_total",
+    "Rebalance detect cycles run (drains or not)",
+)
+
+EVICTIONS = REGISTRY.counter(
+    "karmada_rebalance_evictions_total",
+    "Graceful evictions initiated by the rebalance plane, by cluster "
+    "drained from",
+    ("cluster",),
+)
+
+CYCLE_FAULTS = REGISTRY.counter(
+    "karmada_rebalance_cycle_faults_total",
+    "Rebalance cycles skipped or aborted by a fault (chaos rebalance.plan "
+    "seam included), by kind — the cycle is contained, the plane keeps "
+    "running",
+    ("kind",),
+)
+
+CONSERVATION_VIOLATIONS = REGISTRY.counter(
+    "karmada_rebalance_conservation_violations_total",
+    "Bindings observed serving fewer than their desired replicas while a "
+    "rebalance eviction was in flight (the invariant the drain chain "
+    "must never break)",
+)
+
+OVERCOMMIT = REGISTRY.gauge(
+    "karmada_rebalance_overcommit_milli",
+    "Last detect cycle's committed/capacity ratio x1000 per cluster",
+    ("cluster",),
+)
+
+DRAIN_NEED = REGISTRY.gauge(
+    "karmada_rebalance_drain_need",
+    "Replicas the last detect cycle wants shed per cluster to get back "
+    "inside the thresholds",
+    ("cluster",),
+)
+
+CONVERGED = REGISTRY.gauge(
+    "karmada_rebalance_converged",
+    "1 while the last detect cycle found no cluster needing a drain",
+)
+
+
+@dataclass(frozen=True)
+class RebalanceConfig:
+    """Thresholds + pacing of one rebalance plane.  All milli ratios are
+    ints so the jitted detect kernel stays float-free (bit-deterministic
+    drain plans)."""
+
+    interval_s: float = 30.0
+    # drain a cluster above committed > threshold x capacity
+    overcommit_threshold_milli: int = 1000
+    # drain a cluster whose committed share exceeds its capacity share
+    # by more than this (x1000).  0 (the default) keeps divergence
+    # REPORT-ONLY: spread draining can ping-pong when re-placement
+    # keeps favoring one most-available cluster, so an operator arms it
+    # deliberately, sized against the pacing budget
+    spread_tolerance_milli: int = 0
+    # pacing: hard cap per cycle across the fleet, and the shared
+    # per-cluster-per-window budget (rebalance/pacing.py)
+    max_evictions_per_cycle: int = 32
+    budget_per_cluster: int = 8
+    budget_interval_s: float = 60.0
+
+
+class RebalancePlane:
+    """One per-scheduler rebalance loop; registered as a runtime periodic
+    hook (maybe_run) and gated on the scheduler queue's clock."""
+
+    def __init__(self, store, scheduler, cfg: Optional[RebalanceConfig] = None,
+                 budget: Optional[EvictionBudget] = None,
+                 clock=None) -> None:
+        self.store = store
+        self.scheduler = scheduler
+        self.cfg = cfg if cfg is not None else RebalanceConfig()
+        self.clock = clock if clock is not None else scheduler.queue.now
+        self.budget = budget if budget is not None else EvictionBudget(
+            per_cluster=self.cfg.budget_per_cluster,
+            interval_s=self.cfg.budget_interval_s, clock=self.clock)
+        self._lock = threading.Lock()
+        # guarded-by: _lock — last-cycle snapshot + lifetime totals
+        # (readers: /debug/rebalance, the soak report; writer: the one
+        # periodic hook)
+        self._last: Dict[str, object] = {}
+        self._peak_over: Dict[str, int] = {}
+        self._cycles = 0
+        self._evictions = 0
+        self._violations = 0
+        self._violation_samples: List[dict] = []
+        self._last_run = float("-inf")
+
+    # -- periodic entry ------------------------------------------------------
+    def maybe_run(self) -> None:
+        """The runtime periodic hook: run a cycle when the interval (on
+        the scheduler's clock) has elapsed.  A raising cycle is contained
+        and counted — the plane must never take the periodic loop down."""
+        now = self.clock()
+        if now - self._last_run < self.cfg.interval_s:
+            return
+        self._last_run = now
+        try:
+            self.run_cycle()
+        # vet: ignore[exception-hygiene] contained + counted; the periodic loop must survive
+        except Exception as e:  # noqa: BLE001 — cycle fault containment
+            CYCLE_FAULTS.inc(kind=type(e).__name__)
+            import traceback
+
+            traceback.print_exc()
+
+    # -- one cycle -----------------------------------------------------------
+    def run_cycle(self) -> dict:
+        """detect -> drain -> audit; returns the cycle snapshot."""
+        if chaos_mod.armed():
+            f = chaos_mod.fire(chaos_mod.SITE_REBALANCE_PLAN)
+            if f is not None:
+                if f.mode == "skip":
+                    # the planned cycle is dropped whole; the NEXT interval
+                    # re-detects from fresh state, nothing is lost
+                    CYCLE_FAULTS.inc(kind="chaos_skip")
+                    return {"skipped": "chaos"}
+                raise RuntimeError("chaos: rebalance.plan raise")
+        with obs.TRACER.span(obs.SPAN_REBALANCE_CYCLE) as cspan:
+            clusters = list(self.store.list(Cluster.KIND))
+            bindings = list(self.store.list(ResourceBinding.KIND))
+            with obs.TRACER.span(obs.SPAN_REBALANCE_DETECT,
+                                 clusters=len(clusters),
+                                 bindings=len(bindings)):
+                names, committed, capacity, valid, by_cluster = (
+                    self._assemble(clusters, bindings))
+                if names:
+                    # tolerance 0 = spread is report-only: div_milli is
+                    # bounded by +/-1000, so a gate far above that can
+                    # never select a spread drain
+                    spread_tol = (self.cfg.spread_tolerance_milli
+                                  if self.cfg.spread_tolerance_milli > 0
+                                  else 1 << 20)
+                    drain_need, over_milli, div_milli = rebalance_detect.score(
+                        committed, capacity, valid,
+                        self.cfg.overcommit_threshold_milli,
+                        spread_tol)
+                else:
+                    drain_need = over_milli = div_milli = np.zeros(
+                        0, np.int64)
+            evicted = 0
+            with obs.TRACER.span(obs.SPAN_REBALANCE_DRAIN) as dspan:
+                evicted = self._drain(names, drain_need, by_cluster)
+                if dspan:
+                    dspan.set_attr(evicted=evicted)
+            violations = self._audit_conservation(bindings)
+            snapshot = self._publish(names, committed, capacity, drain_need,
+                                     over_milli, div_milli, evicted,
+                                     violations)
+            if cspan:
+                cspan.set_attr(evicted=evicted,
+                               converged=snapshot["converged"])
+        return snapshot
+
+    # -- detect assembly -----------------------------------------------------
+    def _assemble(self, clusters, bindings) -> Tuple:
+        """[C] committed/capacity/valid tensors + the per-cluster victim
+        candidates.  Committed counts the store's CURRENT schedule
+        results (spec.clusters); capacity is the allocatable pod count —
+        the denominator churn flaps move, which is exactly what makes a
+        previously-fine placement overcommitted."""
+        names = [c.metadata.name for c in clusters]
+        idx = {n: i for i, n in enumerate(names)}
+        committed = np.zeros(len(names), np.int64)
+        capacity = np.zeros(len(names), np.int64)
+        valid = np.zeros(len(names), dtype=bool)
+        for i, c in enumerate(clusters):
+            summary = c.status.resource_summary
+            pods = summary.allocatable.get("pods") if summary else None
+            capacity[i] = pods.value() if pods is not None else 0
+            valid[i] = (not c.metadata.deleting) and pods is not None
+        # cluster -> [(key, priority, replicas_here, rb)] victim candidates
+        by_cluster: Dict[str, List[Tuple]] = {}
+        for rb in bindings:
+            eligible = self._eligible(rb)
+            for t in rb.spec.clusters:
+                ci = idx.get(t.name)
+                if ci is None:
+                    continue
+                committed[ci] += t.replicas
+                if eligible:
+                    by_cluster.setdefault(t.name, []).append(
+                        ((rb.namespace, rb.name),
+                         rb.spec.schedule_priority or 0, t.replicas, rb))
+        return names, committed, capacity, valid, by_cluster
+
+    @staticmethod
+    def _eligible(rb: ResourceBinding) -> bool:
+        """Drain candidates: Divided bindings with no pending rebalance
+        eviction (an in-flight drain must settle before the same binding
+        is drained again) and scheduling not suspended.  Duplicated
+        placements are never drained — a re-solve would place them right
+        back on every feasible cluster."""
+        if rb.metadata.deleting:
+            return False
+        if rb.spec.suspension is not None and rb.spec.suspension.scheduling:
+            return False
+        if any(t.producer == PRODUCER
+               for t in rb.spec.graceful_eviction_tasks):
+            return False
+        placement = rb.spec.placement
+        if placement is None or placement.replica_scheduling is None:
+            return False
+        return (placement.replica_scheduling.replica_scheduling_type
+                == REPLICA_SCHEDULING_DIVIDED)
+
+    # -- drain ---------------------------------------------------------------
+    def _drain(self, names, drain_need, by_cluster) -> int:
+        """Evict victims on over-threshold clusters under the pacing
+        budget; returns evictions performed.  Victim order: lowest
+        schedule priority first, then largest per-cluster allotment
+        (fewest evictions to cover the need), then name — fully
+        deterministic, so virtual-clock soaks replay bit-exact."""
+        order = sorted(range(len(names)),
+                       key=lambda i: (-int(drain_need[i]), names[i]))
+        evicted = 0
+        capped = False
+        # keys drained THIS cycle: a binding spanning two over-threshold
+        # clusters must settle its first drain before the next — the
+        # same rule _eligible enforces between cycles via the pending
+        # task, which _assemble's snapshot cannot see mid-cycle
+        drained_keys: set = set()
+        for ci in order:
+            need = int(drain_need[ci])
+            if need <= 0 or capped:
+                break
+            cname = names[ci]
+            victims = sorted(by_cluster.get(cname, ()),
+                             key=lambda v: (v[1], -v[2], v[0]))
+            for key, prio, reps, _rb in victims:
+                if evicted >= self.cfg.max_evictions_per_cycle:
+                    capped = True
+                    break
+                if need <= 0:
+                    break
+                if key in drained_keys:
+                    continue
+                if not self.budget.try_acquire(cname, consumer=PRODUCER):
+                    break  # this cluster's window is spent; next interval
+                if self._evict(key, cname, prio):
+                    EVICTIONS.inc(cluster=cname)
+                    drained_keys.add(key)
+                    evicted += 1
+                    need -= reps
+        with self._lock:
+            self._evictions += evicted
+        return evicted
+
+    def _evict(self, key, cname: str, priority: int) -> bool:
+        """One graceful eviction + the re-place promotion.  The eviction
+        mutate bumps the binding's generation (spec changed), so it
+        re-enters scheduling through the normal push path; promote()
+        re-tags the queue entry with origin="rebalance" so its dwell and
+        admission are attributed to this plane."""
+        ns, name = key
+        changed = []
+
+        def do_evict(obj: ResourceBinding) -> None:
+            changed.clear()  # mutate may retry the closure
+            if evict_cluster(obj, cname, reason="Rebalance",
+                             producer=PRODUCER, now=self.clock()):
+                changed.append(True)
+
+        try:
+            self.store.mutate(ResourceBinding.KIND, ns, name, do_evict)
+        except NotFoundError:
+            return False
+        if changed:
+            self.scheduler.promote(key, priority=priority, origin=PRODUCER)
+        return bool(changed)
+
+    # -- conservation audit --------------------------------------------------
+    def _audit_conservation(self, bindings) -> List[dict]:
+        """No binding with an in-flight rebalance eviction may serve
+        fewer than its desired replicas: serving = spec.clusters replicas
+        + pending eviction-task replicas (those Works stay alive until
+        the task drains).  A shortfall means a task drained before the
+        replacement landed — the exact failure the graceful chain
+        exists to prevent."""
+        violations: List[dict] = []
+        for rb in bindings:
+            tasks = [t for t in rb.spec.graceful_eviction_tasks
+                     if t.producer == PRODUCER]
+            if not tasks:
+                continue
+            serving = (sum(t.replicas for t in rb.spec.clusters)
+                       + sum(t.replicas for t in tasks))
+            desired = rb.spec.replicas
+            if serving < desired:
+                violations.append({
+                    "binding": f"{rb.namespace}/{rb.name}",
+                    "serving": serving, "desired": desired})
+        if violations:
+            CONSERVATION_VIOLATIONS.inc(len(violations))
+            with self._lock:
+                self._violations += len(violations)
+                self._violation_samples = (
+                    self._violation_samples + violations)[-16:]
+        return violations
+
+    # -- state ---------------------------------------------------------------
+    def _publish(self, names, committed, capacity, drain_need, over_milli,
+                 div_milli, evicted: int, violations) -> dict:
+        CYCLES.inc()
+        per_cluster = {}
+        for i, n in enumerate(names):
+            per_cluster[n] = {
+                "committed": int(committed[i]),
+                "capacity": int(capacity[i]),
+                "over_milli": int(over_milli[i]),
+                "div_milli": int(div_milli[i]),
+                "drain_need": int(drain_need[i]),
+            }
+            OVERCOMMIT.set(float(over_milli[i]), cluster=n)
+            DRAIN_NEED.set(float(drain_need[i]), cluster=n)
+        converged = not any(int(d) > 0 for d in drain_need)
+        CONVERGED.set(1.0 if converged else 0.0)
+        snapshot = {
+            "t": round(self.clock(), 6),
+            "clusters": per_cluster,
+            "evicted": evicted,
+            "converged": converged,
+            "violations": len(violations),
+        }
+        with self._lock:
+            self._cycles += 1
+            self._last = snapshot
+            for n, row in per_cluster.items():
+                if row["over_milli"] > self._peak_over.get(n, 0):
+                    self._peak_over[n] = row["over_milli"]
+        return snapshot
+
+    def converged(self) -> bool:
+        """True when the last detect cycle found nothing to drain (and at
+        least one cycle ran)."""
+        with self._lock:
+            return bool(self._last) and bool(self._last.get("converged"))
+
+    def pending_drains(self) -> int:
+        """In-flight rebalance eviction tasks across the store (drained
+        tasks leave the list, so 0 means every drain settled)."""
+        n = 0
+        for rb in self.store.list(ResourceBinding.KIND):
+            n += sum(1 for t in rb.spec.graceful_eviction_tasks
+                     if t.producer == PRODUCER)
+        return n
+
+    def stats(self) -> dict:
+        """The /debug/rebalance payload (and the soak report's
+        `rebalance` section)."""
+        with self._lock:
+            last = dict(self._last)
+            peak = dict(self._peak_over)
+            cycles = self._cycles
+            evictions = self._evictions
+            violations = self._violations
+            samples = list(self._violation_samples)
+        return {
+            "enabled": True,
+            "config": {
+                "interval_s": self.cfg.interval_s,
+                "overcommit_threshold_milli":
+                    self.cfg.overcommit_threshold_milli,
+                "spread_tolerance_milli": self.cfg.spread_tolerance_milli,
+                "max_evictions_per_cycle": self.cfg.max_evictions_per_cycle,
+            },
+            "cycles": cycles,
+            "evictions": evictions,
+            "conservation_violations": violations,
+            "violation_samples": samples,
+            "budget": self.budget.state(),
+            # the drain story in two numbers per cluster: how overcommitted
+            # it ever got vs where the last cycle left it
+            "peak_over_milli": peak,
+            "last": last,
+        }

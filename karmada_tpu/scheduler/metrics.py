@@ -109,6 +109,17 @@ ADMISSION = REGISTRY.counter(
     ("decision",),
 )
 
+# priority pushes (Scheduler.promote): the rebalance plane re-placing a
+# drained binding and the FederatedHPA fast path pushing a refreshed
+# binding straight into the queue, bypassing no gate but jumping the
+# detector round-trip — autoscale/rebalance -> re-place is one cycle
+PRIORITY_PUSHES = REGISTRY.counter(
+    "karmada_scheduler_priority_pushes_total",
+    "Bindings pushed straight into the active queue by a control-loop "
+    "fast path, by origin (rebalance / hpa)",
+    ("origin",),
+)
+
 OVERLOAD_MODE = REGISTRY.gauge(
     "karmada_scheduler_overload_mode",
     "1 while the scheduler is in overload degradation (measured queue "

@@ -232,7 +232,7 @@ class SchedulingQueue:
 
     # -- producer side -------------------------------------------------------
     def push(self, key: Hashable, priority: int = 0,
-             gate_exempt: bool = False) -> str:
+             gate_exempt: bool = False, origin: str = "active") -> str:
         """Push (:276): external event -> activeQ, superseding any backoff /
         unschedulable residence.  Returns the admission decision:
         ADMIT_ADMITTED or ADMIT_SHED (the gate refused a NEW key; resident
@@ -244,7 +244,12 @@ class SchedulingQueue:
         was freed moments ago by its own pop in the CURRENT scheduling
         cycle (the scheduler's result-patch events re-push every
         scheduled binding): that bookkeeping echo must neither consume a
-        fresh slot nor displace a genuinely-waiting resident."""
+        fresh slot nor displace a genuinely-waiting resident.
+
+        `origin` names the plane that produced this push ("active" for a
+        plain external event; "rebalance"/"hpa" for the rebalance plane's
+        drains and the FederatedHPA fast path) — pop_ready buckets the
+        entry's queue dwell by it, so re-place latency is attributable."""
         prev = self._info.get(key)
         if (not gate_exempt
                 and self.max_resident is not None and key not in self._where
@@ -265,7 +270,7 @@ class SchedulingQueue:
                 prev.initial_attempt_timestamp if prev else None
             ),
         )
-        self._move_to_active(info)
+        self._move_to_active(info, origin=origin)
         sched_metrics.ADMISSION.inc(decision=ADMIT_ADMITTED)
         return ADMIT_ADMITTED
 

@@ -51,6 +51,12 @@ REASON_UNSCHEDULABLE = "Unschedulable"
 
 _CYCLE = "__cycle__"
 
+# priority of control-loop fast-path pushes (FederatedHPA scale events):
+# high enough to jump the steady backlog so autoscale -> re-place is one
+# cycle — the fast path exists to skip the detector round-trip, not the
+# admission gate (promote() still runs the gate)
+FAST_PATH_PRIORITY = 10
+
 # cap on the per-binding samples a cycle span carries (loadgen SLO
 # reporting): a 4096-binding cycle records every ~8th value instead of
 # an unbounded list; the stride rides along so aggregators can weight
@@ -154,6 +160,20 @@ class Scheduler:
         # metric + forced rebuild); 0 disables the cadence.
         resident: bool = False,
         resident_audit_interval: int = 64,
+        # rebalance plane (karmada_tpu/rebalance, serve --rebalance):
+        # interval in seconds of the periodic drain-and-re-place cycle on
+        # the scheduler queue's clock — detect overcommit/spread
+        # divergence, gracefully evict victims under the shared pacing
+        # budget, and re-enter them through the queue with a
+        # `rebalance` origin.  None/0 leaves the plane disarmed.
+        rebalance: Optional[float] = None,
+        rebalance_cfg=None,            # rebalance.RebalanceConfig override
+        rebalance_budget=None,         # shared pacing.EvictionBudget
+        # clock the rebalance plane paces on; None uses the scheduling
+        # queue's clock (wall time in production serve).  ControlPlane
+        # passes its injected clock so deterministic harnesses drive the
+        # interval gate like every other controller's
+        rebalance_clock=None,
     ) -> None:
         self.elector = elector
         if elector is not None:
@@ -261,6 +281,24 @@ class Scheduler:
             native_mod.load()
         self.worker = runtime.register(AsyncWorker("scheduler", self._cycle))
         runtime.register_periodic(self._periodic_flush, name="scheduler")
+        # rebalance plane (karmada_tpu/rebalance): a periodic hook on the
+        # queue's clock, like the flushes — NOT subject to --controllers
+        # (the plane belongs to the scheduler binary, not the controller
+        # manager; the reference descheduler is its own deployment)
+        self.rebalance_plane = None
+        if rebalance:
+            from karmada_tpu import rebalance as rebalance_mod
+            from karmada_tpu.rebalance import RebalanceConfig, RebalancePlane
+
+            cfg = (rebalance_cfg if rebalance_cfg is not None
+                   else RebalanceConfig(interval_s=float(rebalance)))
+            self.rebalance_plane = RebalancePlane(
+                store, self, cfg=cfg, budget=rebalance_budget,
+                clock=(rebalance_clock if rebalance_clock is not None
+                       else self.queue.now))
+            runtime.register_periodic(self.rebalance_plane.maybe_run,
+                                      name="scheduler-rebalance")
+            rebalance_mod.set_active(self.rebalance_plane)
         store.bus.subscribe(self._on_event)
 
     def _arm_resident(self) -> None:
@@ -602,6 +640,26 @@ class Scheduler:
         """The resident-state plane's stats snapshot, or None when the
         plane is not armed (serves /debug/state and the SOAK report)."""
         return self._resident.stats() if self._resident is not None else None
+
+    def rebalance_state(self) -> Optional[Dict[str, object]]:
+        """The rebalance plane's stats snapshot, or None when disarmed
+        (serves /debug/rebalance and the soak report)."""
+        return (self.rebalance_plane.stats()
+                if self.rebalance_plane is not None else None)
+
+    def promote(self, key, priority: int = 0, origin: str = "rebalance"):
+        """Priority push straight into the active queue: the rebalance
+        plane's re-place step and the FederatedHPA fast path both land
+        here so drain/autoscale -> re-solve latency is one cycle instead
+        of waiting for the next detector resolve or periodic flush.  The
+        push respects the admission gate like any external event (a fast
+        path must not become an admission bypass); `origin` buckets the
+        entry's queue dwell."""
+        with self._queue_lock:
+            decision = self.queue.push(key, priority, origin=origin)
+        sched_metrics.PRIORITY_PUSHES.inc(origin=origin)
+        self.worker.enqueue(_CYCLE)
+        return decision
 
     def queue_state(self) -> Dict[str, object]:
         """One consistent snapshot of the scheduling-queue state — depths,

@@ -28,6 +28,11 @@ ThreadingHTTPServer serves:
                          sizes, row-cache hit rate, delta depth, audit
                          outcomes (?recent=N adds per-cycle records);
                          {"enabled": false} when rebuild-per-cycle
+    /debug/rebalance     rebalance plane (karmada_tpu/rebalance, serve
+                         --rebalance): last detect scores per cluster,
+                         eviction/conservation totals, pacing budget;
+                         render with `karmadactl rebalance --endpoint`
+
     /debug/chaos         chaos fault-injection plane (karmada_tpu/chaos,
                          armed by `serve --chaos SPEC`): armed rules with
                          fire counts, per-site totals, the recent fire
@@ -216,6 +221,11 @@ class ObservabilityServer:
             from karmada_tpu import chaos
 
             return (json.dumps(chaos.state_payload()).encode(),
+                    "application/json", 200)
+        if path == "/debug/rebalance":
+            from karmada_tpu import rebalance
+
+            return (json.dumps(rebalance.state_payload()).encode(),
                     "application/json", 200)
         if path == "/debug/explain":
             return (json.dumps(self._explain_payload()).encode(),

@@ -243,3 +243,60 @@ def test_flink_style_e2e_via_component_hook():
     assert applied is not None
     assert applied.manifest["spec"]["components"]["taskmanager"]["replicas"] == 3
     assert cp.member("small").get("FlinkDeployment", "default", "wordcount") is None
+
+
+# -- node-level set packing (estimator/wire.py, reference estimate.go TODO) --
+
+
+def test_node_packing_fragmentation_caught():
+    """Two 1-cpu nodes cannot host a 2-cpu pod: the pool bound said one
+    set fits, node-level packing says zero — the overreport the
+    reference's pool-only plugins leave open."""
+    from karmada_tpu.estimator.wire import max_sets_from_free_table
+
+    comps = [Component(name="big", replicas=1,
+                       replica_requirements=ReplicaRequirements(
+                           resource_request={"cpu": Quantity.parse("2")}))]
+    free = [{"cpu": 1000, "pods": 10}, {"cpu": 1000, "pods": 10}]
+    assert max_sets_from_free_table(free, comps) == 0
+    # one node with the same pool total packs the set
+    assert max_sets_from_free_table([{"cpu": 2000, "pods": 10}], comps) == 1
+
+
+def test_node_packing_spreads_replicas_across_nodes():
+    """Replicas of one set place independently: 3x 1-cpu replicas fit
+    three 1-cpu nodes (set count limited by total, not per-node)."""
+    from karmada_tpu.estimator.wire import max_sets_from_free_table
+
+    comps = [Component(name="tm", replicas=3,
+                       replica_requirements=ReplicaRequirements(
+                           resource_request={"cpu": Quantity.parse("1")}))]
+    free = [{"cpu": 1000, "pods": 5}] * 3
+    assert max_sets_from_free_table(free, comps) == 1
+    free = [{"cpu": 2000, "pods": 5}] * 3
+    assert max_sets_from_free_table(free, comps) == 2
+
+
+def test_node_packing_pods_only_matches_pool():
+    """No per-replica resource requests: pods spread freely, the pool
+    bound is exact and the packer returns it unchanged."""
+    from karmada_tpu.estimator.wire import max_sets_from_free_table
+
+    comps = [Component(name="c", replicas=2)]
+    free = [{"pods": 3}, {"pods": 4}]
+    assert max_sets_from_free_table(free, comps) == 3  # 7 // 2
+
+
+def test_node_packing_memory_units():
+    """Non-cpu resources compare in milli (request Value x1000), the
+    same convention as the pool bound."""
+    from karmada_tpu.estimator.wire import max_sets_from_free_table
+
+    comps = [Component(name="m", replicas=1,
+                       replica_requirements=ReplicaRequirements(
+                           resource_request={"memory": Quantity.parse("2Gi")}))]
+    gib = 1 << 30
+    free = [{"memory": 3 * gib * 1000, "pods": 10},
+            {"memory": 3 * gib * 1000, "pods": 10}]
+    # pool: 6Gi -> 3 sets; nodes: each holds ONE 2Gi pod with 1Gi stranded
+    assert max_sets_from_free_table(free, comps) == 2

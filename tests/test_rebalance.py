@@ -1,0 +1,652 @@
+"""Rebalance plane: detect kernel, pacing, drains, and the closed loop.
+
+Covers ISSUE 10's acceptance surface:
+  * the jitted detect kernel's overcommit / spread-divergence math;
+  * the shared eviction-pacing budget (property test + the regression
+    with BOTH evictors — descheduler and rebalance plane — armed);
+  * drain mechanics: graceful eviction tasks with producer=rebalance,
+    origin-tagged re-place promotion, conservation audit;
+  * the chaos `rebalance.plan` seam (skip + raise containment);
+  * the FederatedHPA fast path (scale event -> priority push, one cycle);
+  * the compressed virtual-clock hotspot soak: skewed arrivals pack the
+    hot clusters, capacity churn overcommits them, the plane drains to
+    within threshold with zero conservation violations (policy-path
+    injection, so the detector fan-out is under load too);
+  * carry-chain parity: rebalance re-solves through the pipelined
+    executor (chunked, waves == chunk, carry) vs the serial rebalance
+    control, bit-identical;
+  * /debug/rebalance + `karmadactl rebalance` smoke.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import random
+import threading
+import urllib.request
+
+import pytest
+
+from karmada_tpu import chaos as chaos_mod
+from karmada_tpu import rebalance as rebalance_mod
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.loadgen import (
+    LoadDriver,
+    ServeSlice,
+    ServiceModel,
+    VirtualClock,
+    get_scenario,
+)
+from karmada_tpu.loadgen.driver import build_binding, build_cluster
+from karmada_tpu.models.cluster import Cluster
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.policy import (
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_DIVISION_AGGREGATED,
+    REPLICA_DIVISION_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+    REPLICA_SCHEDULING_DUPLICATED,
+    ClusterPreferences,
+    Placement,
+    ReplicaSchedulingStrategy,
+)
+from karmada_tpu.models.work import (
+    GracefulEvictionTask,
+    ObjectReference,
+    ReplicaRequirements,
+    ResourceBinding,
+    ResourceBindingSpec,
+    ResourceBindingStatus,
+    TargetCluster,
+)
+from karmada_tpu.ops import rebalance_detect, serial, tensors
+from karmada_tpu.rebalance import EvictionBudget, RebalanceConfig, RebalancePlane
+from karmada_tpu.rebalance import pacing as pacing_mod
+from karmada_tpu.rebalance import plane as plane_mod
+from karmada_tpu.store.store import ObjectStore
+from karmada_tpu.utils.quantity import Quantity
+
+pytestmark = pytest.mark.rebalance
+
+import numpy as np  # noqa: E402
+
+
+class FakeClock:
+    def __init__(self, t=1_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    yield
+    rebalance_mod.set_active(None)
+    chaos_mod.disarm()
+
+
+# -- detect kernel ------------------------------------------------------------
+
+SPREAD_OFF = 1 << 20  # the plane's report-only sentinel (plane.py)
+
+
+def test_detect_overcommit_and_saturated():
+    need, over, div = rebalance_detect.score(
+        np.array([480, 20, 10, 7]), np.array([300, 1000, 1000, 0]),
+        np.array([True, True, True, True]), 1000, SPREAD_OFF)
+    assert over[0] == 1600 and need[0] == 180
+    assert need[1] == 0 and need[2] == 0
+    # zero capacity with committed load: the saturated sentinel, and the
+    # whole committed count wants draining
+    assert over[3] == rebalance_detect.OVER_SATURATED
+    assert need[3] == 7
+
+
+def test_detect_invalid_clusters_never_selected():
+    need, over, div = rebalance_detect.score(
+        np.array([500]), np.array([100]), np.array([False]), 1000,
+        SPREAD_OFF)
+    assert need[0] == 0 and over[0] == 0
+
+
+def test_detect_threshold_scaling():
+    # threshold 1500 milli allows 1.5x capacity before draining
+    need, _, _ = rebalance_detect.score(
+        np.array([140, 160]), np.array([100, 100]),
+        np.array([True, True]), 1500, SPREAD_OFF)
+    assert need[0] == 0
+    assert need[1] == 10
+
+
+def test_detect_spread_divergence_gate():
+    committed = np.array([90, 10, 0, 0])
+    capacity = np.array([100, 100, 100, 100])
+    valid = np.ones(4, dtype=bool)
+    # gate off (tolerance above any possible divergence): report-only
+    need0, _, div = rebalance_detect.score(committed, capacity, valid,
+                                           2000, 1 << 20)
+    assert int(div[0]) == 900 - 250  # share 900m vs fair 250m
+    assert (need0 == 0).all()
+    # gate at 300 milli: cluster 0 diverges (650 > 300) and drains down
+    # to (fair + tol) of the committed total
+    need1, _, _ = rebalance_detect.score(committed, capacity, valid,
+                                         2000, 300)
+    assert int(need1[0]) == 90 - (250 + 300) * 100 // 1000
+    assert (need1[1:] == 0).all()
+
+
+# -- pacing budget ------------------------------------------------------------
+
+def test_budget_property_two_consumers_never_exceed():
+    """Random interleaving of two consumers: grants per cluster per
+    window never exceed per_cluster, regardless of who asks."""
+    clock = FakeClock()
+    budget = EvictionBudget(per_cluster=5, interval_s=10.0, clock=clock)
+    rng = random.Random(42)
+    grants = {"m1": 0, "m2": 0}
+    for _ in range(200):
+        cluster = rng.choice(["m1", "m2"])
+        consumer = rng.choice(["descheduler", "rebalance"])
+        if budget.try_acquire(cluster, consumer=consumer):
+            grants[cluster] += 1
+    assert grants["m1"] <= 5 and grants["m2"] <= 5
+    # window rolls: fresh allowance
+    clock.advance(10.0)
+    assert budget.try_acquire("m1")
+    assert budget.remaining("m1") == 4
+
+
+def test_budget_denials_counted_by_consumer():
+    clock = FakeClock()
+    budget = EvictionBudget(per_cluster=1, interval_s=10.0, clock=clock)
+    base = pacing_mod.BUDGET_DENIED.value(consumer="descheduler")
+    assert budget.try_acquire("m1", consumer="rebalance")
+    assert not budget.try_acquire("m1", consumer="descheduler")
+    assert pacing_mod.BUDGET_DENIED.value(consumer="descheduler") == base + 1
+
+
+# -- plane unit mechanics -----------------------------------------------------
+
+class _SchedStub:
+    """The slice of Scheduler the plane touches: a queue clock + promote."""
+
+    def __init__(self, clock):
+        self.queue = type("Q", (), {"now": staticmethod(clock)})()
+        self.promoted = []
+
+    def promote(self, key, priority=0, origin="rebalance"):
+        self.promoted.append((key, priority, origin))
+        return "admitted"
+
+
+def _divided_binding(name, targets, replicas=None, namespace="ns"):
+    rb = ResourceBinding()
+    rb.metadata.namespace = namespace
+    rb.metadata.name = name
+    total = sum(r for _, r in targets)
+    rb.spec = ResourceBindingSpec(
+        resource=ObjectReference(api_version="apps/v1", kind="Deployment",
+                                 namespace=namespace, name=name,
+                                 uid=f"uid-{name}"),
+        replicas=replicas if replicas is not None else total,
+        placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_AGGREGATED)),
+        clusters=[TargetCluster(name=c, replicas=r) for c, r in targets],
+    )
+    return rb
+
+
+def _plane_env(per_cluster=8, pods=100):
+    clock = FakeClock()
+    store = ObjectStore()
+    store.create(build_cluster("m1", pods=pods))
+    store.create(build_cluster("m2", pods=pods))
+    sched = _SchedStub(clock)
+    budget = EvictionBudget(per_cluster=per_cluster, interval_s=60.0,
+                            clock=clock)
+    plane = RebalancePlane(store, sched,
+                           cfg=RebalanceConfig(interval_s=5.0),
+                           budget=budget, clock=clock)
+    return clock, store, sched, plane
+
+
+def test_drain_evicts_gracefully_and_promotes():
+    _, store, sched, plane = _plane_env()
+    # 40 replicas committed on m1 (capacity 100): fine.  Crush m1's
+    # allocatable to 20 pods -> overcommitted, drain_need 20.
+    for i in range(4):
+        store.create(_divided_binding(f"b{i}", [("m1", 10)]))
+
+    def crush(c: Cluster) -> None:
+        c.status.resource_summary.allocatable["pods"] = Quantity.parse("20")
+
+    store.mutate(Cluster.KIND, "", "m1", crush)
+    snap = plane.run_cycle()
+    assert snap["clusters"]["m1"]["drain_need"] == 0 or snap["evicted"] > 0
+    assert snap["evicted"] == 2  # 2 x 10 replicas covers the need of 20
+    drained = [rb for rb in store.list(ResourceBinding.KIND)
+               if rb.spec.graceful_eviction_tasks]
+    assert len(drained) == 2
+    for rb in drained:
+        task = rb.spec.graceful_eviction_tasks[0]
+        assert task.producer == "rebalance"
+        assert task.from_cluster == "m1"
+        assert task.replicas == 10
+        assert not rb.spec.clusters  # the allotment left spec.clusters
+    assert len(sched.promoted) == 2
+    assert all(origin == "rebalance" for _, _, origin in sched.promoted)
+    # conservation holds mid-drain: clusters + tasks == desired
+    assert snap["violations"] == 0
+    # an in-flight drain is not drained again next cycle
+    snap2 = plane.run_cycle()
+    assert snap2["evicted"] <= 2  # remaining need only, never the same rbs
+    for rb in store.list(ResourceBinding.KIND):
+        assert len([t for t in rb.spec.graceful_eviction_tasks
+                    if t.producer == "rebalance"]) <= 1
+
+
+def test_drain_respects_budget_pacing():
+    _, store, sched, plane = _plane_env(per_cluster=3)
+    for i in range(20):
+        store.create(_divided_binding(f"b{i}", [("m1", 10)]))
+
+    def crush(c: Cluster) -> None:
+        c.status.resource_summary.allocatable["pods"] = Quantity.parse("10")
+
+    store.mutate(Cluster.KIND, "", "m1", crush)
+    snap = plane.run_cycle()
+    assert snap["evicted"] == 3, "the per-cluster window caps the drain"
+    # same window: nothing left to grant
+    snap2 = plane.run_cycle()
+    assert snap2["evicted"] == 0
+
+
+def test_conservation_violation_detected():
+    _, store, _, plane = _plane_env()
+    rb = _divided_binding("hurt", [("m1", 2)], replicas=5)
+    rb.spec.graceful_eviction_tasks.append(GracefulEvictionTask(
+        from_cluster="m2", replicas=1, producer="rebalance"))
+    store.create(rb)  # serving 3 < desired 5
+    base = plane_mod.CONSERVATION_VIOLATIONS.total()
+    snap = plane.run_cycle()
+    assert snap["violations"] == 1
+    assert plane_mod.CONSERVATION_VIOLATIONS.total() == base + 1
+    assert plane.stats()["violation_samples"][-1]["binding"] == "ns/hurt"
+
+
+def test_duplicated_bindings_never_drained():
+    _, store, sched, plane = _plane_env()
+    rb = ResourceBinding()
+    rb.metadata.namespace = "ns"
+    rb.metadata.name = "dup"
+    rb.spec = ResourceBindingSpec(
+        resource=ObjectReference(api_version="apps/v1", kind="Deployment",
+                                 namespace="ns", name="dup", uid="u"),
+        replicas=1,
+        placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED)),
+        clusters=[TargetCluster(name="m1", replicas=200)],
+    )
+    store.create(rb)
+
+    def crush(c: Cluster) -> None:
+        c.status.resource_summary.allocatable["pods"] = Quantity.parse("10")
+
+    store.mutate(Cluster.KIND, "", "m1", crush)
+    snap = plane.run_cycle()
+    assert snap["clusters"]["m1"]["drain_need"] > 0
+    assert snap["evicted"] == 0 and not sched.promoted
+
+
+def test_chaos_plan_seam_skip_and_raise():
+    clock, store, sched, plane = _plane_env()
+    chaos_mod.configure("rebalance.plan:skip#1")
+    base = plane_mod.CYCLE_FAULTS.total()
+    assert plane.run_cycle() == {"skipped": "chaos"}
+    assert plane_mod.CYCLE_FAULTS.value(kind="chaos_skip") >= 1
+    assert plane_mod.CYCLE_FAULTS.total() == base + 1
+    # raise mode: maybe_run contains it (counted, never propagated)
+    chaos_mod.configure("rebalance.plan:raise#1")
+    plane._last_run = float("-inf")
+    plane.maybe_run()  # must not raise
+    assert plane_mod.CYCLE_FAULTS.value(kind="RuntimeError") >= 1
+    chaos_mod.disarm()
+
+
+# -- shared budget with BOTH evictors armed ----------------------------------
+
+def test_descheduler_and_rebalance_share_one_budget():
+    """Regression for the stampede: with the descheduler and the
+    rebalance plane armed on one plane, combined evictions against a
+    cluster inside one pacing window never exceed the shared budget."""
+    from karmada_tpu.controllers.descheduler import Descheduler
+    from karmada_tpu.store.worker import Runtime
+
+    clock = FakeClock()
+    store = ObjectStore()
+    store.create(build_cluster("m1", pods=100))
+    store.create(build_cluster("m2", pods=100))
+    budget = EvictionBudget(per_cluster=4, interval_s=60.0, clock=clock)
+    sched = _SchedStub(clock)
+    plane = RebalancePlane(store, sched,
+                           cfg=RebalanceConfig(interval_s=5.0),
+                           budget=budget, clock=clock)
+
+    class _Member:
+        healthy = True
+
+        def unschedulable_replicas(self, *a):
+            return 1  # every binding always has one stuck replica
+
+    runtime = Runtime()
+    desched = Descheduler(store, runtime, {"m1": _Member(), "m2": _Member()},
+                          budget=budget)
+    for i in range(12):
+        rb = _divided_binding(f"b{i}", [("m1", 10)])
+        rb.spec.placement.replica_scheduling.replica_division_preference = (
+            REPLICA_DIVISION_AGGREGATED)
+        store.create(rb)
+
+    def crush(c: Cluster) -> None:
+        c.status.resource_summary.allocatable["pods"] = Quantity.parse("10")
+
+    store.mutate(Cluster.KIND, "", "m1", crush)
+    # descheduler round first: its per-binding m1 shrinks draw tokens
+    desched.run_once()
+    shrunk = sum(1 for rb in store.list(ResourceBinding.KIND)
+                 if sum(t.replicas for t in rb.spec.clusters) < 10
+                 and not rb.spec.graceful_eviction_tasks)
+    assert shrunk == 4, "descheduler capped by the shared budget"
+    # same window: the rebalance plane finds the budget spent
+    snap = plane.run_cycle()
+    assert snap["evicted"] == 0, \
+        "rebalance must not stampede m1 after the descheduler spent it"
+    # next window: the plane drains
+    clock.advance(60.0)
+    snap2 = plane.run_cycle()
+    assert 0 < snap2["evicted"] <= 4
+
+
+# -- FederatedHPA fast path ---------------------------------------------------
+
+def test_hpa_scale_event_fast_path_priority_push():
+    from karmada_tpu.e2e import ControlPlane
+    from karmada_tpu.scheduler import metrics as sched_metrics
+
+    clock = FakeClock(1_000_000.0)
+    cp = ControlPlane(backend="serial", clock=clock)
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.add_member("m2", cpu_milli=64_000)
+    cp.tick()
+    from karmada_tpu.models.autoscaling import (
+        CrossVersionObjectReference,
+        FederatedHPA,
+        FederatedHPASpec,
+        MetricSpec,
+        MetricTarget,
+        ResourceMetricSource,
+    )
+    from karmada_tpu.models.policy import PropagationPolicy, PropagationSpec
+    from karmada_tpu.models.policy import ResourceSelector
+
+    cp.store.create(PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(api_version="apps/v1",
+                                                 kind="Deployment")],
+            placement=Placement(replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS))),
+        )))
+    cp.apply({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"replicas": 4, "template": {"spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "100m"}}}]}}},
+    })
+    cp.tick()
+    rb = cp.store.get(ResourceBinding.KIND, "default", "web-deployment")
+    assert sum(t.replicas for t in rb.spec.clusters) == 4
+    for m in cp.members.values():
+        m.set_load("Deployment", "default", "web", {"cpu": 90})
+    cp.store.create(FederatedHPA(
+        metadata=ObjectMeta(name="web-hpa", namespace="default"),
+        spec=FederatedHPASpec(
+            scale_target_ref=CrossVersionObjectReference(
+                api_version="apps/v1", kind="Deployment", name="web"),
+            min_replicas=2, max_replicas=10,
+            metrics=[MetricSpec(resource=ResourceMetricSource(
+                name="cpu",
+                target=MetricTarget(type="Utilization",
+                                    average_utilization=50)))])))
+    base = sched_metrics.PRIORITY_PUSHES.value(origin="hpa")
+    cp.tick()
+    # the scale event took the fast path: priority push counted, the
+    # binding's replicas follow the scale, and the scheduler re-placed
+    assert sched_metrics.PRIORITY_PUSHES.value(origin="hpa") > base
+    rb = cp.store.get(ResourceBinding.KIND, "default", "web-deployment")
+    want = int(cp.store.get("Deployment", "default", "web")
+               .manifest["spec"]["replicas"])
+    assert want > 4
+    assert rb.spec.replicas == want
+    assert sum(t.replicas for t in rb.spec.clusters) == want
+
+
+# -- the compressed hotspot soak ---------------------------------------------
+
+def test_hotspot_soak_drains_to_threshold_conserving():
+    """hotspot -> drain -> re-place -> converge on the virtual clock:
+    skewed arrivals pack the hot clusters through the POLICY PATH (the
+    detector renders every binding), capacity churn overcommits them,
+    and the rebalance plane must drain back inside the threshold with
+    zero conservation violations and every binding scheduled."""
+    sc = get_scenario("hotspot")
+    assert sc.policy_path and sc.binding_style == "divided"
+    model = ServiceModel()
+    clock = VirtualClock()
+    plane = ServeSlice(sc, clock, model, backend="serial")
+    driver = LoadDriver(plane, sc, clock=clock, model=model, seed=3)
+    payload = driver.run()
+    assert payload["injected"] == payload["scheduled"]
+    reb = payload["rebalance"]
+    assert reb["enabled"] and reb["evictions"] > 0
+    assert reb["conservation_violations"] == 0
+    last = reb["last"]
+    assert last["converged"]
+    thr = reb["config"]["overcommit_threshold_milli"]
+    for name, row in last["clusters"].items():
+        if row["capacity"] > 0:
+            assert row["over_milli"] <= thr, (name, row)
+    # the peak proves there WAS an overcommit episode to drain
+    assert max(reb["peak_over_milli"].values()) > thr
+    # the chaos rebalance.plan:skip fault fired and the auditor is clean
+    audit = payload["safety_audit"]
+    assert audit["violations"] == []
+    assert payload["chaos"]["fired_by_site"].get("rebalance.plan") == 1
+    # every drain settled (graceful tasks gone) and nothing is parked
+    assert sum(payload["residual_queue"].values()) == 0
+    for rb in plane.store.list(ResourceBinding.KIND):
+        assert not rb.spec.graceful_eviction_tasks
+
+
+def test_hotspot_soak_deterministic():
+    sc = get_scenario("hotspot")
+    outs = []
+    for _ in range(2):
+        model = ServiceModel()
+        clock = VirtualClock()
+        plane = ServeSlice(sc, clock, model, backend="serial")
+        driver = LoadDriver(plane, sc, clock=clock, model=model, seed=7)
+        payload = driver.run()
+        outs.append((payload["rebalance"]["evictions"],
+                     payload["rebalance"]["last"]["clusters"],
+                     payload["injected"], payload["scheduled"]))
+    assert outs[0] == outs[1]
+
+
+# -- carry-chain parity of rebalance re-solves --------------------------------
+
+def _parity_clusters(n=8):
+    out = []
+    rng = random.Random(11)
+    for i in range(n):
+        c = build_cluster(f"member-{i:02d}",
+                          cpu_milli=rng.randint(16_000, 64_000),
+                          memory_gi=rng.choice([64, 128, 256]),
+                          pods=rng.randint(80, 200))
+        out.append(c)
+    return out
+
+
+def _parity_items(names, n=64):
+    rng = random.Random(5)
+    placements = [
+        Placement(replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED)),
+        Placement(replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+            weight_preference=ClusterPreferences(
+                dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS))),
+        Placement(replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_AGGREGATED)),
+    ]
+    items = []
+    for b in range(n):
+        # a rebalance re-solve: the binding HAD an assignment; part of it
+        # was drained, and the remainder seeds Steady/Fresh modes
+        prev_n = rng.randint(1, 3)
+        start = rng.randrange(len(names))
+        replicas = rng.choice([2, 4, 8, 16])
+        prev = [TargetCluster(name=names[(start + j) % len(names)],
+                              replicas=max(1, replicas // prev_n))
+                for j in range(prev_n)]
+        spec = ResourceBindingSpec(
+            resource=ObjectReference(api_version="apps/v1",
+                                     kind="Deployment",
+                                     namespace=f"ns-{b % 8}", name=f"app-{b}",
+                                     uid=f"uid-{b}"),
+            replicas=replicas,
+            replica_requirements=ReplicaRequirements(resource_request={
+                "cpu": Quantity.from_milli(rng.choice([100, 250, 500])),
+                "memory": Quantity.from_units(rng.choice([1, 2])),
+            }),
+            placement=placements[b % len(placements)],
+            clusters=prev,
+            reschedule_triggered_at=(100.0 if b % 3 == 0 else None),
+        )
+        items.append((spec, ResourceBindingStatus()))
+    return items
+
+
+def _serial_control(items, clusters):
+    """One binding at a time, consuming the positive delta over the
+    previous assignment (the wave accumulator's rule —
+    tests/test_contention.py pins the equivalence)."""
+    clusters = copy.deepcopy(clusters)
+    cal = serial.make_cal_available([GeneralEstimator()])
+    by_name = {c.metadata.name: c for c in clusters}
+    results = []
+    for spec, st in items:
+        try:
+            want = serial.schedule(spec, st, clusters, cal)
+        except Exception as e:  # noqa: BLE001 — outcome object
+            results.append(e)
+            continue
+        results.append(want)
+        prev = {tc.name: tc.replicas for tc in spec.clusters}
+        req = spec.replica_requirements.resource_request
+        for tc in want:
+            delta = max(tc.replicas - prev.get(tc.name, 0), 0)
+            if delta == 0:
+                continue
+            alloc = by_name[tc.name].status.resource_summary.allocated
+            alloc["cpu"] = Quantity.from_milli(
+                alloc.get("cpu", Quantity(0)).milli
+                + delta * req["cpu"].milli)
+            alloc["memory"] = Quantity.from_units(
+                alloc.get("memory", Quantity(0)).value()
+                + delta * req["memory"].value())
+            alloc["pods"] = Quantity.from_units(
+                alloc.get("pods", Quantity(0)).value() + delta)
+    return results
+
+
+def test_replace_parity_carry_chain_vs_serial_control():
+    from karmada_tpu.scheduler import pipeline as sched_pipeline
+
+    clusters = _parity_clusters()
+    names = [c.metadata.name for c in clusters]
+    items = _parity_items(names, n=64)
+    cindex = tensors.ClusterIndex.build(clusters)
+    chunk = 16
+    res = sched_pipeline.run_pipeline(
+        items, cindex, GeneralEstimator(), chunk=chunk, waves=chunk,
+        cache=tensors.EncoderCache(), carry=True, carry_spread=True)
+    control = _serial_control(items, clusters)
+    assert len(res.results) == len(items), "every row must route device"
+    for i, want in enumerate(control):
+        got = res.results[i]
+        if isinstance(want, Exception):
+            assert isinstance(got, type(want)), (i, want, got)
+            continue
+        wm = {tc.name: tc.replicas for tc in want}
+        gm = {tc.name: tc.replicas for tc in got}
+        assert gm == wm, (i, wm, gm)
+
+
+# -- exposure smoke -----------------------------------------------------------
+
+def test_debug_rebalance_http_and_cli(capsys):
+    from karmada_tpu.cli import main as cli_main
+    from karmada_tpu.utils.httpserve import ObservabilityServer
+
+    clock = FakeClock()
+    store = ObjectStore()
+    store.create(build_cluster("m1", pods=50))
+    store.create(_divided_binding("b0", [("m1", 80)]))
+    sched = _SchedStub(clock)
+    plane = RebalancePlane(store, sched,
+                           cfg=RebalanceConfig(interval_s=5.0), clock=clock)
+    rebalance_mod.set_active(plane)
+    plane.run_cycle()
+    srv = ObservabilityServer(store=store)
+    url = srv.start(port=0)
+    try:
+        with urllib.request.urlopen(url + "/debug/rebalance") as r:
+            state = json.loads(r.read().decode())
+        assert state["enabled"] and state["cycles"] == 1
+        assert state["last"]["clusters"]["m1"]["over_milli"] == 1600
+        rc = cli_main(["rebalance", "--endpoint", url])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "rebalance plane" in out and "m1" in out
+    finally:
+        srv.stop()
+    # disarmed payload for dashboards
+    rebalance_mod.set_active(None)
+    assert rebalance_mod.state_payload() == {"enabled": False}
+    assert "no rebalance plane" in rebalance_mod.render_state(
+        {"enabled": False})
+
+
+def test_scheduler_promote_tags_origin():
+    """promote() pushes through the admission gate with the caller's
+    origin; the queue buckets the dwell by it at pop."""
+    from karmada_tpu.scheduler.queue import SchedulingQueue
+
+    clock = FakeClock()
+    q = SchedulingQueue(now=clock)
+    q.push(("ns", "a"), 0, origin="rebalance")
+    clock.advance(1.0)
+    infos = q.pop_ready()
+    assert len(infos) == 1 and infos[0].origin == "rebalance"
