@@ -18,6 +18,24 @@ all-replicate (or a crash) on a mesh.  The pass AST-extracts:
 and reports both directions of drift: fields missing a spec entry, and
 spec entries naming no field (stale keys).  This is the same gate that
 caught SolverBatch drift on day one, now covering the resident plane.
+
+The fused gather path (ops/resident_gather + the resident device slot
+store) adds a third drift class this pass closes:
+
+  * every binding-row SLOT-STORE field (``BINDING_SLOT_FIELDS`` /
+    ``DEVICE_SLOT_FIELDS`` in resident/state.py) must appear in
+    ``shard_specs`` or a declared host-only set — its device mirror is
+    gathered straight into the dispatch, so an uncovered field would be
+    mesh-placed by accident exactly like an uncovered batch field;
+  * the gather kernel's field set (``GATHER_FIELDS`` in
+    ops/resident_gather.py) must equal the slot store's — a field added
+    to one tuple but not the other would silently ship stale/garbage
+    rows;
+  * every gather OUTPUT (``OUT_FIELDS``) must have a ``shard_specs``
+    entry: the kernel pins its out-shardings FROM that table, which is
+    also the solver's in-sharding table — one table, so the fused
+    chain's in/out shardings cannot drift apart; this check makes the
+    table-totality explicit.
 """
 
 from __future__ import annotations
@@ -98,8 +116,8 @@ def run(files: Sequence[SourceFile]) -> List[Finding]:
             if f and cls not in classes:
                 classes[cls] = (sf, line, f,
                                 _const_strings(sf.tree, exempt_name))
-    if specs_file is None or not classes:
-        return []  # scanned subtree lacks one side: nothing to compare
+    if specs_file is None:
+        return []  # scanned subtree lacks the spec table: nothing to compare
     findings: List[Finding] = []
     for cls, _exempt in COVERED_CLASSES:
         if cls not in classes:
@@ -121,5 +139,49 @@ def run(files: Sequence[SourceFile]) -> List[Finding]:
                 rule="spec-coverage", file=specs_file.path, line=specs_line,
                 message=f"shard_specs entry `{k}` names no SolverBatch "
                         "field — stale key",
+            ))
+    # -- fused gather path: slot store x gather kernel x spec table ----------
+    slot_fields: Set[str] = set()
+    slot_file = None
+    gather_fields: Set[str] = set()
+    out_fields: Set[str] = set()
+    gather_file = None
+    for sf in files:
+        s = _const_strings(sf.tree, "BINDING_SLOT_FIELDS") | \
+            _const_strings(sf.tree, "DEVICE_SLOT_FIELDS")
+        if s and slot_file is None:
+            slot_fields, slot_file = s, sf
+        g = _const_strings(sf.tree, "GATHER_FIELDS")
+        if g and gather_file is None:
+            gather_fields, gather_file = g, sf
+            out_fields = _const_strings(sf.tree, "OUT_FIELDS")
+    if slot_file is not None:
+        resident_exempt = (classes.get("ResidentPlane") or
+                           (None, 0, set(), set()))[3]
+        for f in sorted(slot_fields - keys - host_only - resident_exempt):
+            findings.append(Finding(
+                rule="spec-coverage", file=slot_file.path, line=1,
+                message=f"resident slot-store field `{f}` has no "
+                        "PartitionSpec entry in shard_specs (and is not "
+                        "host-only) — its device mirror feeds the fused "
+                        "gather and would be mesh-placed by accident",
+            ))
+    if slot_file is not None and gather_file is not None:
+        for f in sorted(slot_fields ^ gather_fields):
+            where = ("slot store but not the gather kernel"
+                     if f in slot_fields
+                     else "gather kernel but not the slot store")
+            findings.append(Finding(
+                rule="spec-coverage", file=gather_file.path, line=1,
+                message=f"fused-gather field `{f}` is in the {where} "
+                        "(DEVICE_SLOT_FIELDS vs GATHER_FIELDS drift)",
+            ))
+    if gather_file is not None and keys:
+        for f in sorted(out_fields - keys):
+            findings.append(Finding(
+                rule="spec-coverage", file=gather_file.path, line=1,
+                message=f"fused-gather output `{f}` has no shard_specs "
+                        "entry — its out-sharding cannot chain into the "
+                        "solver's in-sharding",
             ))
     return findings

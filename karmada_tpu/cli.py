@@ -43,6 +43,7 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                 admission_limit: Optional[int] = None,
                 resident: bool = False,
                 resident_audit: int = 64,
+                resident_fused: bool = False,
                 device_recover_cycles: Optional[int] = None,
                 chaos: Optional[str] = None,
                 chaos_seed: int = 0,
@@ -96,6 +97,7 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                       admission_limit=admission_limit,
                       resident=resident,
                       resident_audit_interval=resident_audit,
+                      resident_fused=resident_fused,
                       device_recover_cycles=device_recover_cycles,
                       chaos=chaos, chaos_seed=chaos_seed,
                       rebalance=rebalance)
@@ -1108,6 +1110,7 @@ def cmd_serve(args) -> int:
                                           else None),
                          resident=args.resident,
                          resident_audit=args.resident_audit,
+                         resident_fused=args.resident_fused,
                          device_recover_cycles=(
                              args.device_recover_cycles
                              if args.device_recover_cycles > 0 else None),
@@ -1131,11 +1134,26 @@ def cmd_serve(args) -> int:
         warm_shapes = aot_mod.warm_shapes(sched.batch_window,
                                           sched.pipeline_chunk)
         warm_variants = aot_mod.variants_for(
-            sched.explain, sched.batch_window > sched.pipeline_chunk)
+            sched.explain, sched.batch_window > sched.pipeline_chunk,
+            fused=getattr(sched, "resident_fused", False))
+        resident_cap = None
+        if getattr(sched, "resident_fused", False):
+            # the fused gather's jit signature includes the slot-store
+            # capacity, and at boot the resident plane has adopted
+            # nothing yet (_resident_slot_cap would fall to the 64
+            # floor): derive the adoption-time cap from the persisted
+            # store's binding count so the warmed executables match the
+            # geometry the first real cycles will gather at
+            from karmada_tpu.models.work import ResourceBinding as _RB
+            from karmada_tpu.ops.tensors import _next_pow2 as _np2
+
+            n_rb = len(cp.store.list(_RB.KIND))
+            resident_cap = _np2(max(n_rb, 64), 64)
         aot_mod.start_background_warmup(
             lambda: list(cp.store.list(_Cluster.KIND)), sched._general,
             shapes=warm_shapes, variants=warm_variants, waves=sched.waves,
-            keep_sel=sched.enable_empty_workload_propagation)
+            keep_sel=sched.enable_empty_workload_propagation,
+            resident_cap=resident_cap)
         aot_state = aot_mod.state_payload()
         if aot_state["armed"]:
             print(f"AOT executable plane armed: persistent compile cache "
@@ -1161,15 +1179,20 @@ def cmd_serve(args) -> int:
               "`karmadactl rebalance --endpoint URL`")
     if args.resident:
         if cp.scheduler.backend == "device":
+            fused_note = (" + FUSED device gather (binding rows never "
+                          "re-upload)" if args.resident_fused else "")
             print("resident-state plane armed: cluster tensors stay "
                   "device-resident between cycles, advanced by watch "
                   f"deltas (parity audit every {args.resident_audit} "
-                  "cycle(s)); state at /debug/resident, render with "
-                  "`karmadactl resident --endpoint URL`")
+                  f"cycle(s)){fused_note}; state at /debug/resident, "
+                  "render with `karmadactl resident --endpoint URL`")
         else:
             print(f"WARNING: --resident needs the device backend (running "
                   f"backend={cp.scheduler.backend}); the resident plane "
                   "is not armed", file=sys.stderr)
+    elif args.resident_fused:
+        print("WARNING: --resident-fused requires --resident; the fused "
+              "gather path is not armed", file=sys.stderr)
     if explain_rate > 0:
         if args.metrics_port >= 0:
             pct = f"{explain_rate:.0%}" if explain_rate < 1 else "every"
@@ -1987,6 +2010,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "cached so a steady-state cycle re-encodes only "
                          "churned bindings; state at /debug/resident "
                          "(karmadactl resident --endpoint URL)")
+    sv.add_argument("--resident-fused", action="store_true",
+                    help="fused whole-cycle-on-device steady state "
+                         "(requires --resident): the binding-row slot "
+                         "store mirrors on device and each cycle's batch "
+                         "GATHERS there (ops/resident_gather) — zero "
+                         "per-cycle h2d of binding-axis fields; host "
+                         "re-encode stays the parity control/fallback")
     sv.add_argument("--resident-audit", type=int, default=64,
                     metavar="N",
                     help="resident parity-audit cadence: every Nth cycle "

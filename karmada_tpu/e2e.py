@@ -98,6 +98,11 @@ class ControlPlane:
         # per-binding encoded-row cache; device backend only
         resident: bool = False,
         resident_audit_interval: int = 64,
+        # fused whole-cycle-on-device steady state (serve --resident
+        # --resident-fused, ops/resident_gather): device slot-store
+        # gather instead of host batch assembly; host path stays the
+        # parity control and fallback
+        resident_fused: bool = False,
         # recoverable backend degrade (scheduler/service.py): after this
         # many cycles on the degraded backend, re-probe the device path
         # (None keeps the legacy one-way degrade)
@@ -186,6 +191,7 @@ class ControlPlane:
                                    resident=resident,
                                    resident_audit_interval=(
                                        resident_audit_interval),
+                                   resident_fused=resident_fused,
                                    device_recover_cycles=(
                                        device_recover_cycles),
                                    chaos=chaos, chaos_seed=chaos_seed,

@@ -230,10 +230,11 @@ def warm_device_path(plane, sizes: Tuple[int, ...] = (2, 9, 17, 64),
     The store-driven cycles above only compile the PLAIN pow2 variants;
     with `aot_variants` (default) the remaining jit variants this
     scheduler can actually dispatch — explain-sampled cycles, the carry /
-    donated chain of multi-chunk cycles, mesh-placed when a solver mesh
-    is active — are AOT pre-compiled too (ops/aotcache), so the first
-    explain-sampled or donated cycle mid-soak doesn't eat a silent
-    mid-traffic compile that reads as a hung cycle."""
+    donated chain of multi-chunk cycles, the fused resident-gather
+    executable when --resident-fused armed it, mesh-placed when a solver
+    mesh is active — are AOT pre-compiled too (ops/aotcache), so the
+    first explain-sampled, donated, or fused cycle mid-soak doesn't eat
+    a silent mid-traffic compile that reads as a hung cycle."""
     from karmada_tpu.models.work import ResourceBinding as _RB
 
     sched = plane.scheduler
@@ -258,7 +259,8 @@ def warm_device_path(plane, sizes: Tuple[int, ...] = (2, 9, 17, 64),
             variants = tuple(
                 v for v in aotcache.variants_for(
                     sched.explain,
-                    sched.batch_window > sched.pipeline_chunk)
+                    sched.batch_window > sched.pipeline_chunk,
+                    fused=getattr(sched, "resident_fused", False))
                 if v != aotcache.VARIANT_PLAIN)
             if variants:
                 aotcache.warm_executables(

@@ -50,6 +50,12 @@ VARIANT_PLAIN = "plain"
 VARIANT_EXPLAIN = "explain"
 VARIANT_CARRY = "carry"
 VARIANT_DONATED = "donated"
+#: the fused resident-gather executable (ops/resident_gather) — not a
+#: solver variant (the solver jit signature is identical for fused
+#: dispatches: same avals, device-placed operands), but its OWN jit that
+#: must be warm per pow2 batch shape or the first fused cycle mid-soak
+#: eats a silent compile
+VARIANT_FUSED = "fused"
 ALL_VARIANTS = (VARIANT_PLAIN, VARIANT_EXPLAIN, VARIANT_CARRY,
                 VARIANT_DONATED)
 
@@ -297,18 +303,35 @@ def warm_shapes(batch_window: int, pipeline_chunk: int) -> Tuple[int, ...]:
     return tuple(shapes)
 
 
-def variants_for(explain_rate: float, multi_chunk: bool) -> Tuple[str, ...]:
+def variants_for(explain_rate: float, multi_chunk: bool,
+                 fused: bool = False) -> Tuple[str, ...]:
     """The jit-variant set THIS scheduler configuration can actually
     dispatch (warming more would spend background compile time on
     programs that never run): plain always; explain only when the
     explain plane samples; carry + donated only when cycles can span
-    multiple chunks (batch_window > pipeline_chunk)."""
+    multiple chunks (batch_window > pipeline_chunk); the fused
+    resident-gather executable only when the fused resident path is
+    armed (Scheduler resident_fused)."""
     variants = [VARIANT_PLAIN]
     if explain_rate and explain_rate > 0:
         variants.append(VARIANT_EXPLAIN)
     if multi_chunk:
         variants += [VARIANT_CARRY, VARIANT_DONATED]
+    if fused:
+        variants.append(VARIANT_FUSED)
     return tuple(variants)
+
+
+def _resident_slot_cap() -> int:
+    """The active resident plane's slot-store capacity (the fused gather
+    jit signature includes it), else the smallest geometry (64) — distinct
+    requested caps re-warm lazily as the store grows."""
+    from karmada_tpu import resident
+
+    state = resident.active()
+    if state is not None and state.plane is not None:
+        return int(state.plane.placement_id.shape[0])
+    return 64
 
 
 def warm_executables(
@@ -320,6 +343,7 @@ def warm_executables(
     waves: int = 8,
     keep_sel: bool = False,
     cancelled: Optional[threading.Event] = None,
+    resident_cap: Optional[int] = None,
 ) -> Dict[str, object]:
     """AOT pre-compile the compact dispatch for every (pow2 shape x jit
     variant) against THIS cluster fleet via ``.lower().compile()``
@@ -354,7 +378,15 @@ def warm_executables(
             batch = tensors.encode_batch(synth_items(n), cindex, estimator,
                                          cache=cache, explain=True)
             for variant in variants:
-                label = f"B{batch.B}xC{batch.C}:{variant}"
+                if variant == VARIANT_FUSED:
+                    # the fused gather's signature is (B, slot cap, sparse
+                    # widths), not (B, C): label it by its own geometry so
+                    # a grown slot store re-warms under a fresh key
+                    cap = (int(resident_cap) if resident_cap
+                           else _resident_slot_cap())
+                    label = f"B{batch.B}xS{cap}:{variant}"
+                else:
+                    label = f"B{batch.B}xC{batch.C}:{variant}"
                 with _LOCK:
                     prior = _STATE["warmup"].get(label)
                 if prior is not None and prior.get("state") == "done":
@@ -368,9 +400,18 @@ def warm_executables(
                 _set_warm(label, "compiling")
                 t0 = time.perf_counter()
                 try:
-                    timings = solver.aot_warm_compile(batch, waves=waves,
-                                                      keep_sel=keep_sel,
-                                                      variant=variant)
+                    if variant == VARIANT_FUSED:
+                        from karmada_tpu.ops import meshing, resident_gather
+
+                        timings = resident_gather.aot_warm(
+                            batch.B, cap=cap,
+                            Kp=batch.prev_idx.shape[1],
+                            Ke=batch.evict_idx.shape[1],
+                            plan=meshing.active())
+                    else:
+                        timings = solver.aot_warm_compile(
+                            batch, waves=waves, keep_sel=keep_sel,
+                            variant=variant)
                     dt = time.perf_counter() - t0
                     _set_warm(label, "done", dt)
                     results[label] = {"seconds": round(dt, 3), **timings}
@@ -404,6 +445,7 @@ def start_background_warmup(
     variants: Sequence[str],
     waves: int = 8,
     keep_sel: bool = False,
+    resident_cap: Optional[int] = None,
 ) -> threading.Thread:
     """Run warm_executables on a daemon thread (serve: the plane takes
     traffic immediately; warmed shapes stop paying compiles as they
@@ -421,7 +463,7 @@ def start_background_warmup(
                 return
             warm_executables(clusters, estimator, shapes=shapes,
                              variants=variants, waves=waves,
-                             keep_sel=keep_sel)
+                             keep_sel=keep_sel, resident_cap=resident_cap)
             with _LOCK:
                 _STATE["warmup_thread"] = "done"
         # vet: ignore[exception-hygiene] background warm must never kill serve; state kept for /debug/state

@@ -65,8 +65,10 @@ BATCH_FIELDS = (
 
 #: SolverBatch ndarray fields that by design never cross the host->device
 #: boundary (the spec-coverage vet pass exempts them from shard_specs):
-#: `route` is the host-side routing verdict the encoder leaves behind.
-HOST_ONLY_FIELDS = frozenset({"route"})
+#: `route` is the host-side routing verdict the encoder leaves behind;
+#: `non_workload_host` is the fused resident-gather path's host decode
+#: companion (the device plane of the same name is what dispatch ships).
+HOST_ONLY_FIELDS = frozenset({"route", "non_workload_host"})
 
 
 def parse_shape(text) -> Optional[object]:
@@ -262,6 +264,19 @@ def scan_result_shardings(mesh, B: int, Bw: int, C: int):
                         axis_sizes)
     return (NamedSharding(mesh, bc), NamedSharding(mesh, bc),
             NamedSharding(mesh, b))
+
+
+def resident_slot_sharding(mesh):
+    """NamedSharding for the resident binding-row slot store's device
+    mirrors (ops/resident_gather): fully REPLICATED.  The store's row
+    order is slot-allocation order, not batch order, so partitioning it
+    would turn every fused gather into an all-to-all; replicating keeps
+    the gather local per shard while the gather OUTPUTS pin to the
+    solver's binding-axis specs (shard_specs) — the repartition-free
+    chain into the dispatch."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
 
 
 def used_shardings(mesh, used_shapes: Sequence[Tuple[int, ...]]):

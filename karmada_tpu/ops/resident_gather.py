@@ -1,0 +1,209 @@
+"""Fused device-side gather over the resident binding-row slot store.
+
+The resident plane (karmada_tpu/resident) already keeps the CLUSTER-side
+solver tensors device-resident between cycles (ops/resident_update
+scatter kernels + ops/solver.prime_cluster_slot).  This module closes
+the other half of the steady-state loop: the BINDING-axis slot store
+stays device-resident too, and a cycle's batch rows are pulled out of it
+by one jitted gather instead of the host assembling numpy rows and
+re-uploading them every dispatch.  The steady-state chain becomes
+
+  scatter watch deltas into the device mirrors   (ops/resident_update)
+  -> gather the pending batch's rows ON DEVICE   (this module)
+  -> solve with operands already placed          (ops/solver.dispatch_compact)
+  -> d2h only the compact COO triple             (solver.finalize_compact)
+
+so the only per-cycle host->device traffic for a warm (all-hits) cycle
+is the [B] slot-index vector — zero binding-axis field uploads
+(karmada_solver_h2d_binding_fields_total stays flat; bench --delta
+asserts exactly that).
+
+Sharding chain: the gather's outputs are pinned to the SAME
+(bindings, clusters) PartitionSpecs the solver's dispatch places its
+binding-axis operands with (ops/meshing.shard_specs — derived here, not
+re-declared, so the two tables cannot drift; the spec-coverage vet pass
+checks the slot-store field set against the same table).  pjit inputs
+already partitioned to match in_axis_resources skip the repartition
+(SNIPPETS [1]/[2]), so under a mesh the gathered rows flow into the
+solve with no resharding step.  The slot-store mirrors themselves are
+REPLICATED over the mesh (ops/meshing.resident_slot_sharding): the
+store's row order is slot-allocation order, not batch order, so a
+sharded store would turn every gather into an all-to-all; replication
+keeps the gather local and only the OUTPUTS partition.
+
+Trace-safety: pure gathers + jnp.where masking — no Python control flow
+on traced values, no host syncs, no dtype-defaulted constructors (fill
+values are weak-typed scalars; dtypes ride in on the slot-store
+operands, built against ops/tensors.FIELD_DTYPES).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from karmada_tpu.ops.tensors import FIELD_DTYPES, ROUTE_DEVICE  # noqa: E402
+from karmada_tpu.utils.metrics import REGISTRY  # noqa: E402
+
+#: slot-store fields the kernel gathers, in the order the jit takes them
+#: (resident/state.py DEVICE_SLOT_FIELDS mirrors exactly this set; the
+#: spec-coverage vet pass checks both against meshing.shard_specs)
+GATHER_FIELDS = (
+    "placement_id", "gvk_id", "class_id", "replicas", "uid_desc",
+    "fresh", "non_workload", "nw_shortcut", "route",
+    "prev_idx", "prev_val", "evict_idx",
+)
+
+#: kernel outputs, in ops/solver._BINDING_FIELDS order — the dispatch
+#: operand contract.  b_valid is computed on device (route == DEVICE on
+#: real rows); route itself stays host-only (meshing.HOST_ONLY_FIELDS)
+#: and is not emitted.
+OUT_FIELDS = (
+    "b_valid", "placement_id", "gvk_id", "class_id", "replicas",
+    "uid_desc", "fresh", "non_workload", "nw_shortcut",
+    "prev_idx", "prev_val", "evict_idx",
+)
+
+#: pad fill per output field — MUST match the host control's zeros
+#: (resident/state.ResidentState._assemble) so a fused batch is
+#: bit-identical to the host-assembled one on every row, padding
+#: included (the parity fuzz in tests/test_resident_fused.py compares
+#: all B rows, not just the real ones)
+_FILL = {
+    "placement_id": 0, "gvk_id": 0, "class_id": -1, "replicas": 0,
+    "uid_desc": False, "fresh": False, "non_workload": False,
+    "nw_shortcut": False, "prev_idx": -1, "prev_val": 0, "evict_idx": -1,
+}
+
+GATHER_DISPATCHES = REGISTRY.counter(
+    "karmada_resident_gather_dispatches_total",
+    "Fused device-side binding-row gathers dispatched (one per chunk on "
+    "the fused resident path)",
+)
+GATHER_ROWS = REGISTRY.counter(
+    "karmada_resident_gather_rows_total",
+    "Binding rows pulled out of the device slot store by the fused gather",
+)
+GATHER_SCATTERS = REGISTRY.counter(
+    "karmada_resident_gather_row_scatters_total",
+    "Churned binding rows scattered into the device slot store (miss "
+    "re-encodes advancing the mirrors in place)",
+)
+
+
+def _gather_core(slots, placement_id, gvk_id, class_id, replicas, uid_desc,
+                 fresh, non_workload, nw_shortcut, route,
+                 prev_idx, prev_val, evict_idx, *, shard_mesh=None):
+    """slots int64[B] (-1 = padding) against the [cap]-leading slot store:
+    returns the solver's binding-axis operand tuple (OUT_FIELDS order),
+    padded rows rewritten to the host control's fill values."""
+    ok = slots >= 0
+    sl = jnp.where(ok, slots, 0)
+
+    def g1(a, fill):
+        return jnp.where(ok, a[sl], fill)
+
+    def g2(a, fill):
+        return jnp.where(ok[:, None], a[sl], fill)
+
+    route_g = route[sl]
+    b_valid = ok & (route_g == ROUTE_DEVICE)
+    F = _FILL
+    out = (
+        b_valid,
+        g1(placement_id, F["placement_id"]), g1(gvk_id, F["gvk_id"]),
+        g1(class_id, F["class_id"]), g1(replicas, F["replicas"]),
+        g1(uid_desc, F["uid_desc"]), g1(fresh, F["fresh"]),
+        g1(non_workload, F["non_workload"]),
+        g1(nw_shortcut, F["nw_shortcut"]),
+        g2(prev_idx, F["prev_idx"]), g2(prev_val, F["prev_val"]),
+        g2(evict_idx, F["evict_idx"]),
+    )
+    if shard_mesh is not None:
+        # chain the gather's out-shardings into the solver's in-shardings:
+        # ONE spec table (meshing.shard_specs) serves both, so a dispatch
+        # of these outputs repartitions nothing
+        from karmada_tpu.ops import meshing
+
+        out = tuple(
+            lax.with_sharding_constraint(
+                a, meshing.sharding_for(shard_mesh, f, a.shape))
+            for f, a in zip(OUT_FIELDS, out))
+    return out
+
+
+gather_batch = partial(
+    jax.jit, static_argnames=("shard_mesh",))(_gather_core)
+
+
+def place_slot(arr, plan=None):
+    """Place one slot-store master on device: replicated over the active
+    mesh (the gather is local per shard; only its outputs partition),
+    plain default placement single-device."""
+    if plan is None:
+        return jax.device_put(arr)
+    from karmada_tpu.ops import meshing
+
+    return jax.device_put(arr, meshing.resident_slot_sharding(plan.mesh))
+
+
+def dispatch_gather(slots, mirrors, plan=None):
+    """Run the fused gather over the device slot store: `slots` is the
+    int64[B] (-1 padded) slot vector — the ONLY per-cycle h2d on this
+    path — and `mirrors` maps GATHER_FIELDS to their device arrays.
+    Returns the solver binding-axis operand tuple (OUT_FIELDS order) as
+    live device values (async; nothing is forced here)."""
+    args = tuple(mirrors[f] for f in GATHER_FIELDS)
+    out = gather_batch(slots, *args,
+                       shard_mesh=plan.mesh if plan is not None else None)
+    GATHER_DISPATCHES.inc()
+    return out
+
+
+def aot_warm(B: int, cap: int, Kp: int = 4, Ke: int = 4, plan=None) -> dict:
+    """AOT-compile the fused gather executable for one (B, cap, Kp, Ke)
+    geometry from abstract ShapeDtypeStructs — nothing executes, no
+    device slot store need exist yet.  With the persistent compile cache
+    armed (ops/aotcache.enable) the executable lands on disk, so the
+    first fused cycle of the shape — mid-soak, or in a later process —
+    pays cache deserialization instead of an XLA compile (the same gap
+    aotcache closes for the solver variants).  Returns the lower/compile
+    timing split like solver.aot_warm_compile."""
+    import numpy as _onp
+
+    def aval(shape, dtype_name):
+        dt = _onp.bool_ if dtype_name == "bool" else _onp.dtype(dtype_name)
+        if plan is None:
+            return jax.ShapeDtypeStruct(shape, dt)
+        from karmada_tpu.ops import meshing
+
+        return jax.ShapeDtypeStruct(
+            shape, dt, sharding=meshing.resident_slot_sharding(plan.mesh))
+
+    def field_aval(f):
+        if f in ("prev_idx", "prev_val"):
+            shape = (cap, Kp)
+        elif f == "evict_idx":
+            shape = (cap, Ke)
+        else:
+            shape = (cap,)
+        return aval(shape, FIELD_DTYPES[f])
+
+    slots = jax.ShapeDtypeStruct((B,), _onp.int64)
+    args = (slots,) + tuple(field_aval(f) for f in GATHER_FIELDS)
+    import time as _time
+
+    t0 = _time.perf_counter()
+    lowered = gather_batch.lower(
+        *args, shard_mesh=plan.mesh if plan is not None else None)
+    t1 = _time.perf_counter()
+    lowered.compile()
+    t2 = _time.perf_counter()
+    return {"lower_s": round(t1 - t0, 3), "compile_s": round(t2 - t1, 3),
+            "slot_cap": int(cap)}

@@ -48,6 +48,19 @@ def scatter_cols(dst, lanes, cols):
     return dst.at[:, lanes].set(cols)
 
 
+@jax.jit
+def scatter_rows_cow(dst, lanes, rows):
+    """dst[lanes, ...] = rows WITHOUT donating dst (device-side
+    copy-on-write).  The fused gather path (ops/resident_gather) uses
+    this for the binding-row slot store: the previous chunk's async
+    gather may still hold the mirror as an in-flight input, and donating
+    a buffer with pending consumers stalls the dispatching host thread
+    until they drain — measured as ~60ms/chunk of encode-stage stall on
+    XLA:CPU.  The copy costs one allocation; the old buffer is dropped
+    by the caller's mirror-table swap as soon as its readers finish."""
+    return dst.at[lanes].set(rows)
+
+
 def _pad(lanes, data, lane_axis: int):
     """Pow2-bucket a (lanes, data) scatter so the jit signature set stays
     bounded (same bucketing as tensors._next_pow2, floor 8): the pad

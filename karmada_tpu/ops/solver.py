@@ -1070,7 +1070,16 @@ def _nnz_bound(batch) -> int:
     replica count to the device.  When the bound fits max_nnz the
     escalation re-solve can never trigger, which is exactly the
     precondition for buffer donation (a donated dispatch cannot re-run:
-    its inputs are gone)."""
+    its inputs are gone).
+
+    A fused resident-gather batch (ops/resident_gather) carries its
+    binding-axis fields as live device arrays; the resident plane
+    computes the identical bound host-side from the slot-store masters
+    at assemble time (nnz_bound_hint) so this function never forces a
+    device->host read of solver operands."""
+    hint = getattr(batch, "nnz_bound_hint", None)
+    if hint is not None:
+        return int(hint)
     strat = batch.pl_strategy[batch.placement_id]
     valid = batch.b_valid.astype(bool)
     wide = valid & ((strat == STRAT_DUPLICATED)
@@ -1173,15 +1182,30 @@ _BINDING_FIELDS = (
     "evict_idx",
 )
 
+H2D_BINDING_FIELDS = REGISTRY.counter(
+    "karmada_solver_h2d_binding_fields_total",
+    "Binding-axis SolverBatch operands shipped host->device at dispatch; "
+    "the fused resident-gather path (ops/resident_gather) hands live "
+    "device arrays instead, so its steady-state cycles add zero here "
+    "(bench --delta asserts exactly that)",
+)
+
 
 def _batch_args(batch, plan=None):
     cluster = _cluster_args(batch, plan)
+    rows = tuple(getattr(batch, f) for f in _BINDING_FIELDS)
+    # transfer accounting: every numpy operand here crosses the
+    # host->device boundary this dispatch (jit moves it, or _put does);
+    # live device arrays — the fused resident-gather outputs — do not
+    n_np = sum(1 for a in rows if isinstance(a, _onp.ndarray))
+    if n_np:
+        H2D_BINDING_FIELDS.inc(n_np)
     if plan is None:
         # binding-axis tensors change every chunk: no caching value, and
         # jit moves raw numpy for free on the single-device path
-        return cluster + tuple(getattr(batch, f) for f in _BINDING_FIELDS)
+        return cluster + rows
     return cluster + tuple(
-        _put(f, getattr(batch, f), plan) for f in _BINDING_FIELDS)
+        _put(f, a, plan) for f, a in zip(_BINDING_FIELDS, rows))
 
 
 def solve(batch, waves: int = 1, tier: str = "std"):

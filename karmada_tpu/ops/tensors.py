@@ -318,6 +318,19 @@ class SolverBatch:
     placements: List = field(default=None)  # P-axis order
     gvk_keys: List[Tuple[str, str]] = field(default=None)  # G-axis order
     class_reqs: List = field(default=None)  # Q-axis order (rr | _SetClass)
+    # fused resident-gather batches (ops/resident_gather via
+    # resident/state.py): binding-axis fields are LIVE DEVICE arrays
+    # gathered from the device slot store — never re-uploaded at
+    # dispatch.  nnz_bound_hint carries the host-computed donation-
+    # safety bound (solver._nnz_bound) so the solver derives it without
+    # forcing a device->host read of its own operands.
+    fused: bool = False
+    nnz_bound_hint: Optional[int] = None
+    # host copy of non_workload[:n] on fused batches (HOST_ONLY_FIELDS):
+    # decode reads it per binding, and converting the device-resident
+    # plane mid-pipeline can block behind the next chunk's solve on the
+    # runtime's transfer path (measured ~170ms stalls on XLA:CPU)
+    non_workload_host: np.ndarray = field(default=None)  # bool[n]
 
 
 def _effective_placement(
@@ -1442,7 +1455,14 @@ def decode_compact(
     C = batch.C
     nb = batch.n_bindings
     coo_status = np.ascontiguousarray(np.asarray(status), np.int32)
-    non_workload = batch.non_workload
+    # fused resident-gather batches carry non_workload as a DEVICE array
+    # plus a host companion: prefer the companion — reading the device
+    # plane here can block behind the next chunk's in-flight solve on
+    # the runtime's transfer path, and the Python fallback loop must
+    # not pay a sync per element either way
+    non_workload = np.asarray(
+        batch.non_workload_host if batch.non_workload_host is not None
+        else batch.non_workload)
     out: List = [None] * nb
 
     # error slots are Python's (diagnosis construction); unknown nonzero

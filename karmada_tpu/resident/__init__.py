@@ -82,6 +82,12 @@ def render_state(state: dict) -> str:
         f" (primed={state.get('device_primed')}); "
         f"last deltas {state.get('last_deltas')}",
     ]
+    fused = state.get("fused") or {}
+    if fused.get("armed"):
+        lines.append(
+            f"  fused gather {'on' if fused.get('available') else 'DEGRADED'}"
+            f": {fused.get('cycles')} fused / {fused.get('host_cycles')} "
+            f"host cycle(s), fallbacks {fused.get('fallbacks')}")
     for rec in state.get("recent_cycles") or ():
         lines.append(
             f"    cycle {rec['cycle']}: {rec['items']} item(s), "

@@ -106,6 +106,13 @@ RESIDENT_DELTAS = REGISTRY.counter(
     "Coalesced cluster deltas applied to the resident plane by class",
     ("kind",),
 )
+RESIDENT_GATHER_FALLBACKS = REGISTRY.counter(
+    "karmada_resident_gather_fallbacks_total",
+    "Cycles the fused device-gather path fell back to the host assemble "
+    "control by reason (explain = explain plane armed for the chunk, "
+    "device-rows = slot-store mirror sync failed/degraded)",
+    ("reason",),
+)
 
 #: Resident ndarray fields that never cross the host->device boundary
 #: beyond what meshing.HOST_ONLY_FIELDS already exempts.  The slot-store
@@ -199,6 +206,12 @@ ROW_SCATTER_FIELDS = frozenset({
 #: fields whose device mirror advances by a cluster-COLUMN scatter
 #: (trailing axis is C)
 COL_SCATTER_FIELDS = frozenset({"est_override", "api_ok"})
+#: the binding-axis slot store's device-mirror field set (fused gather
+#: path, ops/resident_gather) — MUST equal resident_gather.GATHER_FIELDS
+#: (asserted on first sync) and stay covered by meshing.shard_specs /
+#: HOST_ONLY_FIELDS (the spec-coverage vet pass checks this tuple)
+DEVICE_SLOT_FIELDS = BINDING_SLOT_FIELDS + (
+    "prev_idx", "prev_val", "evict_idx")
 
 
 class RowToken:
@@ -319,6 +332,77 @@ class _DevicePlane:
             return False
 
 
+class _DeviceRows:
+    """Device mirrors of the binding-axis slot store (the fused gather
+    path, ops/resident_gather).  Masters stay the host source of truth;
+    mirrors advance by ROW SCATTERS of exactly the churned slots
+    (ops/resident_update.scatter_rows — the [cap]-leading shapes make
+    every slot field a row scatter) and full re-places on geometry
+    changes (slot-capacity growth, sparse-width growth, rebuild, mesh
+    re-plan).  A failed sync degrades the plane to the host assemble
+    control — never takes the scheduler down."""
+
+    def __init__(self) -> None:
+        self.mirrors: Dict[str, object] = {}
+        self.plan_gen: Optional[int] = None
+        self.broken = False
+
+    def sync(self, plane: ResidentPlane, dirty) -> bool:
+        """Advance the mirrors: `dirty` is None (clean), "full" (re-place
+        every field), or an int64 lane array of churned slots (scatter).
+        Returns True when the mirrors match the masters."""
+        if self.broken:
+            return False
+        try:
+            from karmada_tpu.ops import meshing, resident_gather, \
+                resident_update
+
+            assert DEVICE_SLOT_FIELDS == resident_gather.GATHER_FIELDS, \
+                "slot-store field set drifted from the gather kernel's"
+            plan = meshing.active()
+            gen = plan.generation if plan is not None else 0
+            full = (isinstance(dirty, str)  # the "full" sentinel
+                    or gen != self.plan_gen or not self.mirrors)
+            if not full and dirty is None:
+                return True
+            scattered = 0
+            for f in DEVICE_SLOT_FIELDS:
+                master = getattr(plane, f)
+                mirror = self.mirrors.get(f)
+                if (not full and mirror is not None
+                        and getattr(mirror, "shape", None) == master.shape):
+                    # copy-on-write (no donation): the previous chunk's
+                    # async gather may still read this mirror, and
+                    # donating a buffer with in-flight consumers stalls
+                    # the dispatching thread until they drain
+                    lp, rows = resident_update.pad_lanes(
+                        dirty, master[dirty])
+                    mirror = resident_update.scatter_rows_cow(
+                        mirror, lp, rows)
+                    scattered = len(dirty)
+                else:
+                    mirror = resident_gather.place_slot(master, plan)
+                self.mirrors[f] = mirror
+            if scattered:
+                resident_gather.GATHER_SCATTERS.inc(scattered)
+            self.plan_gen = gen
+            return True
+        # vet: ignore[exception-hygiene] logged + fused path disabled (the broken flag IS the record)
+        except Exception:  # noqa: BLE001 — the device slot store is an
+            # optimization: a failed sync must degrade the fused path to
+            # the host assemble control, never take the scheduler down —
+            # but never silently: losing it re-adds the per-cycle host
+            # assembly + binding-field h2d for the process lifetime
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "resident device slot-store sync failed; disabling the "
+                "fused gather path (cycles fall back to host assembly)")
+            self.broken = True
+            self.mirrors = {}
+            return False
+
+
 class AuditMismatch(Exception):
     """Raised internally when the parity audit finds divergence."""
 
@@ -335,10 +419,21 @@ class ResidentState:
 
     def __init__(self, estimator: Optional[GeneralEstimator] = None,
                  audit_interval: int = 64, device_plane: bool = True,
-                 cycle_log_cap: int = 512) -> None:
+                 cycle_log_cap: int = 512, fused: bool = False) -> None:
         self.estimator = estimator or GeneralEstimator()
         self.audit_interval = max(0, int(audit_interval))
         self.device = _DevicePlane() if device_plane else None
+        # fused whole-cycle-on-device path (ops/resident_gather): the
+        # binding-axis slot store mirrors on device and per-cycle batch
+        # rows gather there instead of the host assembling + re-uploading
+        # them.  The host assemble stays the behavior-defining control:
+        # explain-armed chunks, rebuild cycles, and any mirror-sync
+        # failure fall back to it (RESIDENT_GATHER_FALLBACKS).
+        self.fused = bool(fused and device_plane)
+        self.device_rows = _DeviceRows() if self.fused else None
+        # guarded by the cycle thread: None = mirrors clean, "full" =
+        # re-place everything, int64 lanes = scatter exactly these slots
+        self._rows_dirty: object = "full"
 
         self.plane: Optional[ResidentPlane] = None
         self.cindex: Optional[tensors.ClusterIndex] = None
@@ -375,6 +470,15 @@ class ResidentState:
         self.generation = 0
         self.cycles = 0
         self._stats_lock = threading.Lock()
+        # guarded-by: _stats_lock
+        self.fused_cycles = 0
+        # guarded-by: _stats_lock
+        self.host_cycles = 0
+        # guarded-by: _stats_lock
+        self.gather_fallbacks: Dict[str, int] = {}
+        # guarded-by: _stats_lock — host seconds spent dispatching the
+        # fused gather (bench --delta's per-stage host-budget breakdown)
+        self.gather_seconds = 0.0
         # guarded-by: _stats_lock
         self.hits = 0
         # guarded-by: _stats_lock
@@ -499,6 +603,10 @@ class ResidentState:
         if self.device is not None:
             # mirrors of the retired generation must not be scatter-based
             self.device.np_refs = {}
+        if self.device_rows is not None:
+            # retired-generation slot mirrors must never serve a gather
+            self.device_rows.mirrors = {}
+        self._rows_dirty = "full"
         self.generation += 1
         RESIDENT_GENERATION.set(float(self.generation))
         RESIDENT_REBUILDS.inc(reason=reason)
@@ -687,13 +795,39 @@ class ResidentState:
                     continue
             miss_pos.append(i)
         with obs.TRACER.span(obs.SPAN_RESIDENT_ENCODE, items=n,
-                             hits=hits, misses=len(miss_pos)):
+                             hits=hits, misses=len(miss_pos),
+                             fused=self.fused):
             if miss_pos:
                 mini = tensors.encode_batch(
                     [items[i] for i in miss_pos], self.cindex,
                     self.estimator, cache=self.enc_cache)
                 self._merge(mini, miss_pos, tokens, slots)
-            batch = self._assemble(items, slots, n, explain)
+            batch = None
+            if self.fused:
+                # fused whole-cycle-on-device path: the churned slots
+                # just scattered into the device store; the batch rows
+                # now GATHER there.  Explain-armed chunks keep the host
+                # control (the explain planes decode host-side per row).
+                if explain:
+                    RESIDENT_GATHER_FALLBACKS.inc(reason="explain")
+                    with self._stats_lock:
+                        self.gather_fallbacks["explain"] = \
+                            self.gather_fallbacks.get("explain", 0) + 1
+                else:
+                    batch = self._assemble_fused(slots, n)
+                    if batch is None:
+                        RESIDENT_GATHER_FALLBACKS.inc(reason="device-rows")
+                        with self._stats_lock:
+                            self.gather_fallbacks["device-rows"] = \
+                                self.gather_fallbacks.get("device-rows",
+                                                          0) + 1
+            if batch is None:
+                batch = self._assemble(items, slots, n, explain)
+                with self._stats_lock:
+                    self.host_cycles += 1
+            else:
+                with self._stats_lock:
+                    self.fused_cycles += 1
         RESIDENT_LOOKUPS.inc(hits, result="hit")
         RESIDENT_LOOKUPS.inc(len(miss_pos), result="miss")
         with self._stats_lock:
@@ -815,6 +949,7 @@ class ResidentState:
         self._dirty = {}  # fresh masters: full re-place on next sync
         if self.device is not None:
             self.device.np_refs = {}
+        self._rows_dirty = "full"  # fresh slot masters likewise
         self._update_vocab_gauges()
 
     def _alloc_slots(self, k: int) -> np.ndarray:
@@ -834,8 +969,9 @@ class ResidentState:
 
     def _grow_rows(self, need: int) -> None:
         cap = tensors._next_pow2(need, 64)  # noqa: SLF001
+        self._rows_dirty = "full"  # slot geometry changes: re-place
         p = self.plane
-        for f in BINDING_SLOT_FIELDS + ("prev_idx", "prev_val", "evict_idx"):
+        for f in DEVICE_SLOT_FIELDS:
             old = getattr(p, f)
             shape = (cap,) + old.shape[1:]
             if f in ("prev_idx", "evict_idx"):
@@ -846,6 +982,7 @@ class ResidentState:
             setattr(p, f, new)
 
     def _widen_sparse(self, field: str, width: int) -> None:
+        self._rows_dirty = "full"  # sparse width changes: re-place
         p = self.plane
         old = getattr(p, field)
         fill = -1 if field in ("prev_idx", "evict_idx") else 0
@@ -928,8 +1065,20 @@ class ResidentState:
         p.evict_idx[mslots[:, None], np.arange(kem)[None, :]] = \
             mini.evict_idx[:nm]
         slots[miss_pos] = mslots
+        self._mark_rows_dirty(mslots)
         RESIDENT_ROWS.set(float(len(self.rows)))
         self._update_vocab_gauges()
+
+    def _mark_rows_dirty(self, slots: np.ndarray) -> None:
+        """Accumulate device slot-store dirtiness (fused gather path):
+        churned slot sets union; a pending full re-place absorbs them."""
+        if self.device_rows is None:
+            return
+        if isinstance(self._rows_dirty, str):
+            return  # full re-place already pending
+        lanes = np.unique(np.asarray(slots, np.int64))
+        self._rows_dirty = (lanes if self._rows_dirty is None
+                            else np.union1d(self._rows_dirty, lanes))
 
     def _res_index(self, name: str, mini: tensors.SolverBatch,
                    rm: int) -> int:
@@ -1096,6 +1245,69 @@ class ResidentState:
         batch.class_reqs = list(self.class_reqs)
         return batch
 
+    def _assemble_fused(self, slots: np.ndarray,
+                        n: int) -> Optional[tensors.SolverBatch]:
+        """The fused assemble: binding-axis fields gather from the device
+        slot store (ops/resident_gather) and ride into the dispatch as
+        live device arrays — the only per-cycle h2d is the [B] slot
+        vector.  Host keeps exactly what the host path needs: `route`
+        (routing/decode) and the donation-safety nnz bound, both O(n)
+        gathers off the masters.  Returns None when the device mirrors
+        cannot sync (caller falls back to the host control)."""
+        from karmada_tpu.ops import meshing, resident_gather
+
+        p = self.plane
+        if not self.device_rows.sync(p, self._rows_dirty):
+            return None
+        self._rows_dirty = None
+        sl = slots[:n]
+        B = tensors._next_pow2(max(n, 1), 8)  # noqa: SLF001
+        slots_b = np.full(B, -1, np.int64)
+        slots_b[:n] = sl
+        plan = meshing.active()
+        t0 = time.perf_counter()
+        out = resident_gather.dispatch_gather(
+            slots_b, self.device_rows.mirrors, plan)
+        with self._stats_lock:
+            # dispatch cost only — the gather executes async on device
+            self.gather_seconds += time.perf_counter() - t0
+        resident_gather.GATHER_ROWS.inc(n)
+        (b_valid, placement_id, gvk_id, class_id, replicas, uid_desc,
+         fresh, non_workload, nw_shortcut, prev_idx, prev_val,
+         evict_idx) = out
+        route = np.ascontiguousarray(p.route[sl], np.int32)
+        # host companions: decode reads non_workload per binding, and
+        # converting the device plane mid-pipeline can block behind the
+        # next chunk's in-flight solve on the runtime's transfer path
+        nw_host = np.ascontiguousarray(p.non_workload[sl])
+        # donation-safety bound (solver._nnz_bound semantics), computed
+        # from the host masters so the solver never reads device
+        # operands back: wide rows (Duplicated / non-workload) count the
+        # full cluster axis, the rest their own replica target + the
+        # sparse prev width
+        validh = route == _ROUTE_DEVICE
+        strat = p.pl_strategy[p.placement_id[sl]]
+        wide = validh & ((strat == tensors.STRAT_DUPLICATED)
+                         | nw_host)
+        per_row = np.minimum(p.replicas[sl], self.C) + self.Kp
+        bound = int(np.sum(wide)) * self.C + int(np.sum(per_row[validh
+                                                                & ~wide]))
+        shared = {f: getattr(p, f)
+                  for f in CLUSTER_SIDE_FIELDS + SHARED_EXTRA_FIELDS}
+        batch = tensors._build_solver_batch(  # noqa: SLF001
+            shared, B, self.C, n, self.nC, b_valid, placement_id, gvk_id,
+            class_id, replicas, uid_desc, fresh, non_workload, nw_shortcut,
+            prev_idx, prev_val, evict_idx, route, self.cindex,
+            list(self.region_names), list(self.res_names),
+            list(self.class_keys), dict(self.label_axes), False, None)
+        batch.placements = list(self.placements)
+        batch.gvk_keys = list(self.gvk_keys)
+        batch.class_reqs = list(self.class_reqs)
+        batch.fused = True
+        batch.nnz_bound_hint = bound
+        batch.non_workload_host = nw_host
+        return batch
+
     def _ensure_fail_plane(self) -> np.ndarray:
         """The [P, C] explain fail-bit plane over the resident placement
         vocabulary (obs/decisions layout), cached until placements or the
@@ -1213,6 +1425,18 @@ class ResidentState:
                 "device_plane": (self.device is not None
                                  and not self.device.broken),
                 "device_primed": self._device_primed,
+                "fused": {
+                    "armed": self.fused,
+                    "available": (self.device_rows is not None
+                                  and not self.device_rows.broken),
+                    "cycles": self.fused_cycles,
+                    "host_cycles": self.host_cycles,
+                    "fallbacks": dict(self.gather_fallbacks),
+                    "gather_s": round(self.gather_seconds, 6),
+                    "rows_synced": (self.device_rows is not None
+                                    and not self.device_rows.broken
+                                    and self._rows_dirty is None),
+                },
             }
         return out
 

@@ -658,9 +658,14 @@ def run_pipeline(
                 break
             # without carry an all-host chunk skips the device entirely (the
             # pre-pipeline behavior); with carry every chunk dispatches so the
-            # chain stays contiguous (an all-invalid batch consumes nothing)
+            # chain stays contiguous (an all-invalid batch consumes nothing).
+            # The check reads `route` (host-side by contract, fused batches
+            # included) — b_valid equals route == ROUTE_DEVICE on real rows
+            # by construction, but on a fused resident-gather batch it is a
+            # live device array and reading it here would force a sync.
             handle = used0 = None
-            if chain is not None or bool(np.any(batch.b_valid)):
+            if chain is not None or bool(
+                    np.any(np.asarray(batch.route) == tensors.ROUTE_DEVICE)):
                 if chaos_mod.armed():
                     # chaos seam (device.dispatch:raise): a dispatch-time
                     # device fault fails the whole cycle; the scheduler's

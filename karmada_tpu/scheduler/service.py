@@ -160,6 +160,15 @@ class Scheduler:
         # metric + forced rebuild); 0 disables the cadence.
         resident: bool = False,
         resident_audit_interval: int = 64,
+        # fused whole-cycle-on-device steady state (ops/resident_gather,
+        # serve --resident --resident-fused): the binding-axis slot
+        # store mirrors on device and each chunk's rows GATHER there —
+        # scatter watch deltas in, gather the pending batch, solve with
+        # operands already placed, d2h only the compact COO.  Host
+        # re-encode stays the behavior-defining parity control (explain
+        # chunks, rebuild cycles, and mirror-sync failures fall back).
+        # Requires resident=True; disarmed by default.
+        resident_fused: bool = False,
         # rebalance plane (karmada_tpu/rebalance, serve --rebalance):
         # interval in seconds of the periodic drain-and-re-place cycle on
         # the scheduler queue's clock — detect overcommit/spread
@@ -270,7 +279,10 @@ class Scheduler:
         # remembered so a recovered backend re-arms the SAME resident
         # configuration the operator chose (the degrade path detaches it)
         self._resident_cfg = (bool(resident and backend == "device"),
-                              resident_audit_interval)
+                              resident_audit_interval,
+                              bool(resident_fused))
+        self.resident_fused = bool(resident_fused and resident
+                                   and backend == "device")
         if resident and backend == "device":
             self._arm_resident()
         if backend == "native":
@@ -309,7 +321,8 @@ class Scheduler:
 
         self._resident = ResidentState(
             estimator=self._general,
-            audit_interval=self._resident_cfg[1])
+            audit_interval=self._resident_cfg[1],
+            fused=self._resident_cfg[2])
         self._delta_tracker = DeltaTracker()
         # the tracker taps the same watch bus the scheduler does; its
         # coalesced window drains at each device cycle's begin_cycle
