@@ -679,6 +679,13 @@ def run_batched(items, cindex, estimator, chunk: int, cache=None, waves: int = 8
                             failures=st.failures, lat=round(st.own_s, 4),
                             wall=round(st.wall_s, 4),
                             solve_s=round(st.solve_s, 4))
+        # telemetry plane: one ring sample per finalized chunk when the
+        # bench armed the sampler (disarmed cost is one global read) —
+        # the direct-pipeline bench has no scheduler cycle hook, so the
+        # chunk boundary is its cycle clock
+        from karmada_tpu.obs import timeseries as obs_ts
+
+        obs_ts.maybe_sample(time.perf_counter())
         _hb(f"chunk {st.index + 1} finalized ({st.n} bindings)")
 
     t0 = time.perf_counter()
@@ -740,6 +747,101 @@ def measure_explain_overhead(items, cindex, estimator, chunk: int,
         # None (jax exposes no cache counter) is reported as null — a
         # consumer must be able to tell "verified 0" from "unmeasurable"
         "explain_disarmed_new_compiles": new_compiles,
+    }
+
+
+def arm_telemetry(capacity: int = 4096, deadline_s: float = 1.0):
+    """Arm the telemetry plane (obs/timeseries + obs/slo) for a bench
+    leg: an unthrottled ring sampled on whatever clock the measured
+    path's cycles run on (the scheduler hook passes the queue clock —
+    the soak's VirtualClock in compressed mode), plus the stock SLO
+    objectives at the <1s-p99 north-star bound.  Returns the ring."""
+    from karmada_tpu.obs import slo as obs_slo
+    from karmada_tpu.obs import timeseries as obs_ts
+
+    ring = obs_ts.configure(capacity=capacity, min_interval_s=0.0)
+    # no regression watchdog here: bench legs run compressed virtual
+    # time on host backends, where bindings/s is the ServiceModel's
+    # axis, not the hardware's — the envelope comparison belongs to
+    # live serve (--telemetry)
+    obs_slo.configure(objectives=obs_slo.default_objectives(
+        schedule_deadline_s=deadline_s), arm_watchdog=False)
+    return ring
+
+
+def disarm_telemetry() -> None:
+    from karmada_tpu.obs import timeseries as obs_ts
+
+    obs_ts.disarm()  # also disarms the SLO evaluator
+
+
+def measure_sampler_overhead(reference_cycle_s, samples: int = 64) -> dict:
+    """The telemetry sampler's honest price: time `samples` forced ring
+    snapshots of the LIVE registry (post-run, so the families carry the
+    run's full label population) against a reference cycle cost, and
+    prove the sampler is pure host bookkeeping — zero new jit
+    compilations and zero new metric families (asserted, explain-plane
+    style: state is exact where wall time is noisy)."""
+    from karmada_tpu.obs import timeseries as obs_ts
+    from karmada_tpu.ops import solver
+    from karmada_tpu.utils.metrics import REGISTRY
+
+    ring = obs_ts.MetricRing(capacity=samples + 1)
+    c_before = solver._jit_cache_size()  # noqa: SLF001
+    fams_before = len(REGISTRY.snapshot())
+    ring.sample(0.0, force=True)  # warm (allocator, family iteration)
+    t0 = time.perf_counter()
+    for i in range(samples):
+        ring.sample(float(i + 1), force=True)
+    per_sample_s = (time.perf_counter() - t0) / samples
+    c_after = solver._jit_cache_size()  # noqa: SLF001
+    fams_after = len(REGISTRY.snapshot())
+    new_compiles = (None if c_before is None or c_after is None
+                    else c_after - c_before)
+    assert new_compiles in (0, None), (
+        f"the telemetry sampler triggered {new_compiles} jit "
+        "compilation(s) — sampling must be pure host bookkeeping")
+    # the sampler's own counters pre-exist; sampling must never mint
+    # metric families of its own (the zero-new-metric-cost contract)
+    assert fams_after == fams_before, (
+        f"sampling grew the registry {fams_before} -> {fams_after} "
+        "families")
+    overhead_pct = (round(per_sample_s / reference_cycle_s * 100, 3)
+                    if reference_cycle_s and reference_cycle_s > 0 else None)
+    return {
+        "sampler_per_sample_ms": round(per_sample_s * 1e3, 4),
+        "sampler_overhead_pct": overhead_pct,
+        "sampler_new_compiles": new_compiles,
+        "sampler_reference_cycle_ms": (
+            round(reference_cycle_s * 1e3, 4) if reference_cycle_s else None),
+        "registry_families": fams_after,
+    }
+
+
+def measure_disarmed_overhead(reference_cycle_s, iters: int = 20000) -> dict:
+    """The DISARMED telemetry hook's price — the acceptance gate: the
+    default serve cycle pays one module-global read at the sample site,
+    which must stay under 1% of a cycle and trigger zero jit compiles
+    (asserted by --slo, explain-plane style)."""
+    from karmada_tpu.obs import timeseries as obs_ts
+    from karmada_tpu.ops import solver
+
+    assert obs_ts.active() is None, \
+        "disarmed-cost measurement needs the sampler disarmed"
+    c_before = solver._jit_cache_size()  # noqa: SLF001
+    t0 = time.perf_counter()
+    for i in range(iters):
+        obs_ts.maybe_sample(float(i))
+    per_call_s = (time.perf_counter() - t0) / iters
+    c_after = solver._jit_cache_size()  # noqa: SLF001
+    new_compiles = (None if c_before is None or c_after is None
+                    else c_after - c_before)
+    return {
+        "disarmed_per_call_us": round(per_call_s * 1e6, 4),
+        "disarmed_overhead_pct": (
+            round(per_call_s / reference_cycle_s * 100, 5)
+            if reference_cycle_s and reference_cycle_s > 0 else None),
+        "disarmed_new_compiles": new_compiles,
     }
 
 
@@ -1606,11 +1708,52 @@ def run_soak(args) -> int:
     plane = ServeSlice(scenario, clock, model, backend=args.soak_backend)
     driver = LoadDriver(plane, scenario, clock=clock, model=model,
                         seed=args.soak_seed)
-    payload = driver.run()
+    # telemetry plane: the ring samples on the scheduler's cycle hook,
+    # which in compressed mode runs on the soak's VirtualClock — the
+    # series and the burn-rate windows are in virtual time.  The SOAK
+    # payload embeds the verdict (loadgen/report.py reads the armed
+    # evaluator), so every soak renders an SLO verdict.
+    ring = arm_telemetry()
+    try:
+        payload = driver.run()
+        # the sampler's price against the soak's own MEAN cycle cost
+        # (one sample lands per cycle, so per-cycle is the honest
+        # denominator; the raw per-sample ms rides along)
+        mean_batch = ((payload.get("cycles") or {}).get("batch_size")
+                      or {}).get("mean") or 1.0
+        ref_cycle_s = model.cost(max(1.0, mean_batch))
+        telemetry = measure_sampler_overhead(ref_cycle_s)
+        telemetry["ring_samples"] = len(ring)
+    finally:
+        disarm_telemetry()
+    telemetry.update(measure_disarmed_overhead(ref_cycle_s))
     payload["backend"] = args.soak_backend
+    payload["telemetry"] = telemetry
+    if args.slo:
+        # the acceptance gate (--slo): a real verdict from a real series,
+        # and a disarmed path the serve cycle can ignore — burn rates
+        # over >= 20 ring samples, the disarmed hook under 1% of a
+        # cycle, zero compiles either way (the armed sampler's absolute
+        # cost is reported above, not gated)
+        slo_payload = payload.get("slo") or {}
+        n_samples = (slo_payload.get("window") or {}).get("samples", 0)
+        assert n_samples >= 20, (
+            f"SLO verdict computed from only {n_samples} ring sample(s); "
+            "the burn-rate windows need a real series (>= 20)")
+        assert any(o.get("burn_rate", {}).get("long") is not None
+                   for o in slo_payload.get("objectives", [])), (
+            "no objective produced a burn-rate value over the soak window")
+        assert telemetry["disarmed_overhead_pct"] is not None and \
+            telemetry["disarmed_overhead_pct"] < 1.0, (
+            f"disarmed telemetry hook costs "
+            f"{telemetry['disarmed_overhead_pct']}% of a cycle — the "
+            "disarmed serve path must be free (< 1%)")
+        assert telemetry["disarmed_new_compiles"] in (0, None), (
+            "the disarmed telemetry hook triggered jit compilation")
     _hb(f"soak done: injected={payload['injected']} "
         f"scheduled={payload['scheduled']} "
-        f"admission={payload['admission']}")
+        f"admission={payload['admission']} "
+        f"slo_healthy={(payload.get('slo') or {}).get('healthy')}")
     os.makedirs(args.ckpt_dir, exist_ok=True)
     out_path = os.path.join(args.ckpt_dir, f"soak_{scenario.name}.json")
     with open(out_path, "w") as f:
@@ -1673,7 +1816,11 @@ def run_chaos(args) -> int:
     warm_device_path(plane)
     driver = LoadDriver(plane, scenario, clock=clock, model=model,
                         seed=args.soak_seed)
-    payload = driver.run()
+    arm_telemetry()
+    try:
+        payload = driver.run()
+    finally:
+        disarm_telemetry()
     payload["backend"] = "device"
     audit = payload.get("safety_audit") or {}
     violations = audit.get("violations", [])
@@ -1790,7 +1937,11 @@ def run_rebalance(args) -> int:
     warm_device_path(plane)
     driver = LoadDriver(plane, scenario, clock=clock, model=model,
                         seed=args.soak_seed)
-    payload = driver.run()
+    arm_telemetry()
+    try:
+        payload = driver.run()
+    finally:
+        disarm_telemetry()
     payload["backend"] = "device"
     reb = payload.get("rebalance") or {}
     last = reb.get("last") or {}
@@ -1864,6 +2015,7 @@ def run_rebalance(args) -> int:
         },
         "replace_parity": parity,
         "violations": violations,
+        "slo": payload.get("slo"),
         "soak": payload,
     }
     os.makedirs(args.ckpt_dir, exist_ok=True)
@@ -2170,6 +2322,13 @@ def main() -> None:
                          "harness armed; emits the fault ledger + safety "
                          "auditor payload (CHAOS_r*.json contract).  "
                          "Exit 1 on any conservation violation.")
+    ap.add_argument("--slo", action="store_true",
+                    help="with --soak: assert the telemetry acceptance "
+                         "gate — the SLO verdict must be computed from "
+                         ">= 20 ring samples, the sampler must cost "
+                         "< 1%% of a cycle, and sampling must trigger "
+                         "zero jit compiles (the verdict itself is "
+                         "always embedded, flag or not)")
     ap.add_argument("--soak-seed", type=int, default=0,
                     help="deterministic arrival-process seed")
     ap.add_argument("--rebalance", action="store_true",
@@ -2477,6 +2636,11 @@ def main() -> None:
         from karmada_tpu.obs.export import latest_pipeline_timeline
 
         obs.TRACER.configure(capacity=4, slow_keep=2)
+        # telemetry plane: ring sampled once per finalized chunk, SLO
+        # verdict + sampler overhead embedded in the payload (so the
+        # BENCH_r* contract carries the same verdict shape the soak and
+        # serve paths render)
+        telemetry_ring = arm_telemetry()
         (elapsed, solve_s, scheduled, chunk_lat, chunk_wall,
          failures) = run_batched(
             items, cindex, estimator, args.chunk, cache, waves=args.waves,
@@ -2569,6 +2733,23 @@ def main() -> None:
         explain_probe = measure_explain_overhead(
             items, cindex, estimator, min(args.chunk, 256), args.waves)
         _hb(f"explain overhead probe done: {explain_probe}")
+
+        # telemetry verdict + sampler cost (obs/timeseries, obs/slo):
+        # the SLO evaluator judges the chunk-sampled series, and the
+        # overhead probe proves the sampler costs <1% of a mean chunk
+        # with zero compiles / zero new metric families
+        from karmada_tpu.obs import slo as obs_slo
+
+        slo_verdict = None
+        if len(telemetry_ring) >= 2:
+            ev = obs_slo.active()
+            if ev is not None:
+                slo_verdict = ev.evaluate(telemetry_ring)
+        telemetry_probe = measure_sampler_overhead(
+            float(np.mean(chunk_lat)) if chunk_lat else None)
+        telemetry_probe["ring_samples"] = len(telemetry_ring)
+        disarm_telemetry()
+        _hb(f"telemetry probe done: {telemetry_probe}")
     except Exception as e:  # noqa: BLE001 — leave a diagnostic trail, not a traceback
         import traceback
 
@@ -2638,6 +2819,11 @@ def main() -> None:
             # this workload, plus proof the disarmed path stayed intact
             # (zero new jit compilations after an armed run)
             **explain_probe,
+            # telemetry plane (serve --telemetry): SLO verdict over the
+            # chunk-sampled ring + the sampler's measured price (the
+            # BENCH_r08 contract)
+            "slo": slo_verdict,
+            **telemetry_probe,
             "serial_bindings_per_s": round(serial_throughput, 2),
             "serial_python_bindings_per_s": round(sc["py_serial_bps"], 2),
             "serial_sample": sc["native_sample"],

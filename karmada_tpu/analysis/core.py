@@ -29,6 +29,8 @@ RULES = (
     "guarded-by",        # annotated state mutated outside its lock
     "metric-naming",     # registry metric not karmada_-prefixed snake_case
                          # with help text
+    "metric-docs",       # registered metric missing from
+                         # docs/OBSERVABILITY.md (or a doc row gone stale)
     "exception-hygiene",  # blanket except that neither re-raises nor
                           # records a metric (nor carries a waiver)
     "waiver-syntax",     # vet: ignore[...] without a justification

@@ -1,0 +1,157 @@
+"""Pass 7 — metric-name <-> documentation parity (docs/OBSERVABILITY.md).
+
+Every metric the package registers (the same ``*REGISTRY`` literal-name
+registrations pass 5 vets) must appear in ``docs/OBSERVABILITY.md`` —
+the scrape surface's catalogue is the only place an operator can learn
+what a series means, and an undocumented metric rots into cargo-cult
+the moment its author forgets it.  The reverse direction is checked
+too: a metric name the doc catalogues but nothing registers is stale
+documentation (a rename that forgot the doc) — waivable, because the
+doc legitimately references externally-produced series.
+
+Doc-side conventions the extractor understands:
+
+  * label braces after a complete name are stripped:
+    ``karmada_foo_total{kind}`` documents ``karmada_foo_total``;
+  * a brace group directly after a trailing underscore is NAME
+    expansion: ``karmada_slo_{healthy,burn_rate_milli}`` documents
+    ``karmada_slo_healthy`` and ``karmada_slo_burn_rate_milli``;
+  * a doc line containing ``metric-docs: ok`` (e.g. inside an HTML
+    comment with a reason) waives that LINE's doc-side names — the
+    doc-side analogue of ``# vet: ignore[metric-docs] why`` on a
+    registration site.
+
+Both directions only run on whole-package scans (the scanned set must
+include ``utils/metrics.py``, the registry home) — vetting one file
+must not report the rest of the tree's doc as stale.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karmada_tpu.analysis.core import Finding, SourceFile
+from karmada_tpu.analysis.metric_naming import _arg, _registration
+
+DOC_RELPATH = os.path.join("docs", "OBSERVABILITY.md")
+
+#: a metric-shaped token: karmada_ + at least one more underscore
+#: segment ("karmada_tpu" alone is the package name, never a metric)
+_NAME_RE = re.compile(r"karmada_[a-z0-9]+(?:_[a-z0-9]+)+")
+_EXPAND_RE = re.compile(r"(karmada_[a-z0-9_]*_)\{([a-z0-9_,]+)\}([a-z0-9_]*)")
+_LABEL_BRACE_RE = re.compile(r"\{[^}]*\}")
+_DOC_WAIVER = "metric-docs: ok"
+_NOT_METRICS = {"karmada_tpu"}
+
+
+def _find_doc(files: Sequence[SourceFile]) -> Optional[str]:
+    """docs/OBSERVABILITY.md, located by walking up from the scanned
+    files' directories (the doc lives at the repo root, one level above
+    the package)."""
+    seen = set()
+    for sf in files:
+        d = os.path.dirname(os.path.abspath(sf.path))
+        for _ in range(6):
+            if d in seen:
+                break
+            seen.add(d)
+            cand = os.path.join(d, DOC_RELPATH)
+            if os.path.isfile(cand):
+                return cand
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    return None
+
+
+def doc_metric_names(text: str) -> Dict[str, Tuple[int, bool]]:
+    """{name: (first line number, waived)} for every metric-shaped token
+    in the doc, after name-expansion and label-brace stripping."""
+    out: Dict[str, Tuple[int, bool]] = {}
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        waived = _DOC_WAIVER in line
+        expanded = _EXPAND_RE.sub(
+            lambda m: " ".join(m.group(1) + alt + m.group(3)
+                               for alt in m.group(2).split(",")),
+            line)
+        stripped = _LABEL_BRACE_RE.sub(" ", expanded)
+        for name in _NAME_RE.findall(stripped):
+            if name in _NOT_METRICS:
+                continue
+            prev = out.get(name)
+            if prev is None:
+                out[name] = (lineno, waived)
+            elif waived and not prev[1]:
+                out[name] = (prev[0], True)
+    return out
+
+
+def registered_names(
+        files: Sequence[SourceFile]) -> List[Tuple[str, SourceFile, int]]:
+    """(name, file, line) of every literal-name registry registration in
+    the scanned set (computed names are pass 5's finding, not ours)."""
+    out: List[Tuple[str, SourceFile, int]] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _registration(node) is None:
+                continue
+            name_node = _arg(node, 0, "name")
+            if (isinstance(name_node, ast.Constant)
+                    and isinstance(name_node.value, str)):
+                out.append((name_node.value, sf, node.lineno))
+    return out
+
+
+def run(files: Sequence[SourceFile]) -> List[Finding]:
+    whole_package = any(
+        sf.path.endswith(os.path.join("utils", "metrics.py"))
+        for sf in files)
+    if not whole_package:
+        return []
+    regs = registered_names(files)
+    if not regs:
+        return []
+    doc_path = _find_doc(files)
+    if doc_path is None:
+        sf = regs[0][1]
+        return [Finding(
+            rule="metric-docs", file=sf.path, line=regs[0][2],
+            message=f"{DOC_RELPATH} not found above the scanned tree — "
+                    "the metric catalogue gate cannot run (metrics are "
+                    "registered but nothing documents them)",
+        )]
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            doc_text = f.read()
+    except OSError as e:
+        sf = regs[0][1]
+        return [Finding(rule="metric-docs", file=sf.path, line=regs[0][2],
+                        message=f"cannot read {doc_path}: {e}")]
+    doc_names = doc_metric_names(doc_text)
+    findings: List[Finding] = []
+    seen_code = set()
+    for name, sf, line in regs:
+        seen_code.add(name)
+        if name not in doc_names:
+            findings.append(Finding(
+                rule="metric-docs", file=sf.path, line=line,
+                message=f"metric `{name}` is registered but not "
+                        f"catalogued in {DOC_RELPATH} — every scrape "
+                        "series needs its operator-facing row",
+            ))
+    for name, (lineno, waived) in sorted(doc_names.items()):
+        if name in seen_code or waived:
+            continue
+        findings.append(Finding(
+            rule="metric-docs", file=doc_path, line=lineno,
+            message=f"{DOC_RELPATH} catalogues `{name}` but nothing "
+                    "registers it — stale documentation (rename the doc "
+                    f"row, or waive the line with `{_DOC_WAIVER} <why>`)",
+        ))
+    return findings

@@ -26,6 +26,7 @@ from karmada_tpu.analysis import (
     dtype_contract,
     exception_hygiene,
     lock_discipline,
+    metric_docs,
     metric_naming,
     spec_coverage,
     trace_safety,
@@ -47,6 +48,7 @@ PASSES = {
     "spec-coverage": (spec_coverage.run, ("spec-coverage",)),
     "lock-discipline": (lock_discipline.run, ("guarded-by",)),
     "metric-naming": (metric_naming.run, ("metric-naming",)),
+    "metric-docs": (metric_docs.run, ("metric-docs",)),
     "exception-hygiene": (exception_hygiene.run, ("exception-hygiene",)),
 }
 

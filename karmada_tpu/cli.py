@@ -482,6 +482,35 @@ def _node_rows(node_metrics) -> list:
 
 
 def cmd_top(args) -> int:
+    if getattr(args, "endpoint", ""):
+        # live telemetry dashboard over /debug/timeseries + /debug/slo
+        # (obs/timeseries.render_top): queue depths, the cycle budget
+        # breakdown, h2d counter, shed/eviction rates, SLO burn — the
+        # plane-level `top`, no --dir needed
+        import urllib.error
+        import urllib.request
+
+        from karmada_tpu.obs import timeseries as ts_mod
+
+        base = args.endpoint.rstrip("/")
+        try:
+            # aggregate mode (?points=0): the dashboard needs window
+            # deltas and last values, not the whole ring's point lists
+            with urllib.request.urlopen(base + "/debug/timeseries?points=0",
+                                        timeout=10) as r:
+                ts = json.loads(r.read().decode())
+            with urllib.request.urlopen(base + "/debug/slo",
+                                        timeout=10) as r:
+                slo = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            print(f"server error ({e.code}): {e.read().decode()[:200]}",
+                  file=sys.stderr)
+            return 1
+        except urllib.error.URLError as e:
+            print(f"cannot reach {base}: {e.reason}", file=sys.stderr)
+            return 1
+        print(ts_mod.render_top(ts, slo))
+        return 0
     from karmada_tpu.models.cluster import Cluster
 
     cp = _load_plane(args.dir)
@@ -1039,6 +1068,7 @@ def cmd_serve(args) -> int:
     """Run the control plane long-lived: every controller on its own
     thread, periodic hooks on a timer (the karmada-controller-manager /
     scheduler / webhook processes rolled into one, Runtime.serve)."""
+    import os
     import time as _time
 
     if args.check_invariants:
@@ -1205,6 +1235,37 @@ def cmd_serve(args) -> int:
                   "disabled, so /debug/explain is unreachable; add "
                   "--metrics-port PORT to read the decisions",
                   file=sys.stderr)
+    if args.telemetry:
+        try:
+            ring_cap = int(args.telemetry)
+        except ValueError:
+            print(f"--telemetry ring capacity must be an integer, got "
+                  f"{args.telemetry!r}", file=sys.stderr)
+            return 1
+        from karmada_tpu.obs import slo as slo_mod
+        from karmada_tpu.obs import timeseries as ts_mod
+
+        ts_mod.configure(capacity=ring_cap,
+                         min_interval_s=max(args.telemetry_interval, 0.0))
+        ev = slo_mod.configure(objectives=slo_mod.default_objectives(
+            schedule_deadline_s=args.slo_deadline))
+        watchdog_note = (
+            f"regression watchdog armed (baseline "
+            f"{ev.watchdog.baseline_bps:g} bindings/s, floor "
+            f"{ev.watchdog.floor_bps:g})" if ev.watchdog is not None
+            else "regression watchdog off (no committed baseline "
+                 "envelope found)")
+        print(f"telemetry plane armed: {ring_cap}-sample metric ring on "
+              f"the scheduler cycle clock (min interval "
+              f"{args.telemetry_interval:g}s), SLO burn rates at "
+              f"/debug/slo (schedule/dwell p99 bound "
+              f"{args.slo_deadline:g}s); {watchdog_note}; render with "
+              "`karmadactl top --endpoint URL`")
+        if args.metrics_port < 0:
+            print("WARNING: --telemetry is armed but --metrics-port is "
+                  "disabled, so /debug/timeseries and /debug/slo are "
+                  "unreachable (the karmada_slo_* gauges still update)",
+                  file=sys.stderr)
     if args.feature_gates:
         cp.gates.set_from_string(args.feature_gates)
     cp.runtime._periodic_interval_s = args.sync_period  # noqa: SLF001
@@ -1229,7 +1290,11 @@ def cmd_serve(args) -> int:
     if args.metrics_port >= 0:
         from karmada_tpu.utils.httpserve import ObservabilityServer
 
-        obs = ObservabilityServer(store=cp.store)
+        obs = ObservabilityServer(
+            store=cp.store,
+            # /debug/profile artifacts land under the plane dir so a
+            # capture survives the process (the profileflag contract)
+            profile_dir=os.path.join(args.dir, "profiles"))
         url = obs.start(port=args.metrics_port)
         print(f"observability endpoint at {url} "
               "(/metrics /healthz /readyz /debug/state /debug/traces)")
@@ -1420,6 +1485,46 @@ def cmd_resident(args) -> int:
         print(f"cannot reach {base}: {e.reason}", file=sys.stderr)
         return 1
     print(render_state(state))
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Open one on-demand jax.profiler capture window on a live serve
+    process (/debug/profile, obs/devprof) and report the artifacts it
+    wrote under the plane's profile dir — the TPU-native profileflag:
+
+      karmadactl profile --endpoint http://127.0.0.1:8080 --seconds 2
+    """
+    import urllib.error
+    import urllib.request
+
+    base = args.endpoint.rstrip("/")
+    url = f"{base}/debug/profile?seconds={args.seconds:g}"
+    # the server holds the window open for the full capture, and
+    # jax.profiler.start_trace itself costs seconds-to-tens-of-seconds
+    # in a process with a large executable population: the client
+    # budget is the window plus generous grace, never less
+    timeout = max(30.0, args.seconds + 120.0)
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            rec = json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            rec = json.loads(e.read().decode())
+        except json.JSONDecodeError:
+            rec = {"ok": False, "error": str(e)}
+    except urllib.error.URLError as e:
+        print(f"cannot reach {base}: {e.reason}", file=sys.stderr)
+        return 1
+    if not rec.get("ok"):
+        print(f"capture failed: {rec.get('error')}", file=sys.stderr)
+        return 1
+    print(f"captured {rec.get('seconds')}s profiler window "
+          f"({rec.get('wall_s')}s wall) -> {rec.get('dir')}")
+    for f in rec.get("files", []):
+        print(f"  {f['path']}  {f['bytes']} bytes")
+    print(f"total {rec.get('total_bytes')} bytes; load with "
+          "`tensorboard --logdir` on the directory above")
     return 0
 
 
@@ -1739,9 +1844,17 @@ def build_parser() -> argparse.ArgumentParser:
         c.add_argument("name")
 
     t = sub.add_parser("top")
-    t.add_argument("what", choices=["clusters", "pods", "nodes"])
+    t.add_argument("what", nargs="?", default="clusters",
+                   choices=["clusters", "pods", "nodes"])
     t.add_argument("name", nargs="?", help="workload name (pods)")
     t.add_argument("-n", "--namespace", default="")
+    t.add_argument("--endpoint", default="",
+                   help="observability endpoint URL of a serve process "
+                        "armed with --telemetry: render the live plane "
+                        "dashboard (queue depths, cycle budget breakdown, "
+                        "h2d counter, shed/eviction rates, SLO burn) from "
+                        "/debug/timeseries + /debug/slo instead of the "
+                        "cluster table")
 
     i = sub.add_parser("interpret")
     i.add_argument("-f", "--filename", required=True)
@@ -1917,6 +2030,31 @@ def build_parser() -> argparse.ArgumentParser:
                          "cycles (bare --explain = every cycle); the "
                          "disarmed path compiles byte-identical to "
                          "--explain off")
+    sv.add_argument("--telemetry", nargs="?", const="512", default="",
+                    metavar="RING",
+                    help="arm the telemetry plane (obs/timeseries and "
+                         "obs/slo): retain a bounded ring of RING metric "
+                         "snapshots (default 512) sampled on the "
+                         "scheduler's cycle clock, evaluate the SLO "
+                         "error budgets with multi-window burn rates, "
+                         "refresh per-device memory attribution every "
+                         "guarded cycle, and arm the regression "
+                         "watchdog against the committed baseline "
+                         "envelope; read at /debug/timeseries + "
+                         "/debug/slo, render with `karmadactl top "
+                         "--endpoint URL`")
+    sv.add_argument("--telemetry-interval", type=float, default=1.0,
+                    metavar="SECONDS",
+                    help="minimum spacing between telemetry ring "
+                         "samples on the sampling clock (busy planes "
+                         "cycle faster than the ring needs; 0 samples "
+                         "every cycle)")
+    sv.add_argument("--slo-deadline", type=float, default=1.0,
+                    metavar="SECONDS",
+                    help="the schedule_p99 objective's latency bound "
+                         "(the <1s p99 north star); dwell_p99 uses "
+                         "twice this bound — deadline-formed batches "
+                         "dwell at the batch deadline by design")
     sv.add_argument("--trace-buffer", type=int, default=0,
                     help="arm the flight recorder: retain the last N "
                          "cross-component traces (scheduler cycles, "
@@ -2045,6 +2183,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "process (serve --metrics-port PORT)")
     rs.add_argument("--recent", type=int, default=0, metavar="N",
                     help="also list the last N per-cycle hit/miss records")
+
+    pf = sub.add_parser("profile")
+    pf.add_argument("--endpoint", required=True,
+                    help="observability endpoint URL of a live serve "
+                         "process (serve --metrics-port PORT)")
+    pf.add_argument("--seconds", type=float, default=2.0,
+                    help="capture-window length (server-capped at 60s); "
+                         "artifacts land under the plane's profiles/ dir")
     return p
 
 
@@ -2103,6 +2249,7 @@ COMMANDS = {
     "loadgen": cmd_loadgen,
     "rebalance": cmd_rebalance,
     "resident": cmd_resident,
+    "profile": cmd_profile,
 }
 
 
@@ -2146,6 +2293,12 @@ def _dispatch(args) -> int:
     if args.command == "resident":
         # talks to a live serve process over HTTP; no plane is opened
         return cmd_resident(args)
+    if args.command == "profile":
+        # talks to a live serve process over HTTP; no plane is opened
+        return cmd_profile(args)
+    if args.command == "top" and getattr(args, "endpoint", ""):
+        # live telemetry dashboard over HTTP; no plane is opened
+        return cmd_top(args)
     if args.command == "rebalance":
         # talks to a live serve process over HTTP; no plane is opened
         return cmd_rebalance(args)

@@ -178,6 +178,15 @@ def build_soak_report(driver) -> dict:
         **{k: fs[k] for k in ("injected", "scheduled", "failed_attempts",
                               "reschedules")},
     }
+    # telemetry plane (obs/slo): when the SLO evaluator is armed for
+    # this soak (bench --soak / --chaos / --rebalance, serve
+    # --telemetry), the payload carries the multi-window burn-rate
+    # verdict computed over the soak's own virtual-clock series — every
+    # bench mode renders an SLO verdict, not only /debug/slo
+    from karmada_tpu.obs import slo as obs_slo
+
+    payload["slo"] = (obs_slo.state_payload()
+                      if obs_slo.active() is not None else None)
     audit = getattr(driver, "safety_audit", None)
     if audit is not None:
         # chaos soak (karmada_tpu/chaos): the fault ledger and the

@@ -1,0 +1,275 @@
+"""Device cost/memory attribution + on-demand profiler capture.
+
+The fused resident path's whole point (PR 11) is that the win lives on
+the DEVICE and the link — host wall-time barely moves on the CPU
+fallback — yet every cost surface so far was host-side.  This module is
+the TPU-native answer to the reference's pprof profileflag
+(pkg/sharedcli/profileflag, already name-checked in utils/httpserve):
+
+  * **Executable cost ledger** — ``record_cost()`` keeps the
+    ``compiled.cost_analysis()`` harvest (flops / bytes accessed) of
+    every AOT-warmed executable (ops/aotcache feeds it per
+    shape x variant label), so "what does one solver dispatch cost the
+    chip" is a table, not a guess.
+  * **Memory gauges** — ``refresh_memory_gauges()`` exports per-device
+    ``memory_stats()`` (HBM in-use / limit / peak, where the backend
+    reports them; XLA:CPU reports none) plus the process RSS fallback so
+    the attribution surface is never empty off-hardware.  Refreshed per
+    guarded scheduler cycle via the telemetry sampler
+    (obs/timeseries.maybe_sample), so the series land in the ring.
+  * **Profiler capture** — ``capture_profile(seconds, out_dir)`` wraps
+    ``jax.profiler`` start/stop around a bounded window (one capture at
+    a time; a marker op guarantees a non-empty artifact on an idle
+    plane), writing TensorBoard-loadable artifacts under the serve dir.
+    Served as ``/debug/profile?seconds=N`` and ``karmadactl profile``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from karmada_tpu.utils.metrics import REGISTRY
+
+DEVICE_MEMORY = REGISTRY.gauge(
+    "karmada_device_memory_bytes",
+    "Per-device memory_stats() attribution (bytes), by device and kind "
+    "(in_use / peak / limit); absent on backends that report no stats",
+    ("device", "kind"),
+)
+PROCESS_MEMORY = REGISTRY.gauge(
+    "karmada_process_memory_bytes",
+    "Host process memory (bytes) by kind (rss) — the attribution floor "
+    "on backends whose devices report no memory_stats",
+    ("kind",),
+)
+CAPTURES = REGISTRY.counter(
+    "karmada_devprof_captures_total",
+    "On-demand jax.profiler capture windows completed, by outcome",
+    ("outcome",),
+)
+
+#: memory_stats keys exported when present -> gauge kind label
+_MEM_KEYS = (("bytes_in_use", "in_use"),
+             ("peak_bytes_in_use", "peak"),
+             ("bytes_limit", "limit"))
+
+#: /debug/profile bound: a capture window is a debugging act, not a
+#: background service — long windows belong to offline tooling
+MAX_CAPTURE_S = 60.0
+
+_LOCK = threading.Lock()
+# guarded-by: _LOCK; mutators: record_cost,_note_capture,reset_for_tests
+_STATE: Dict[str, object] = {
+    "costs": {},          # label -> {"flops": f, "bytes_accessed": b}
+    "last_memory": None,  # last refresh summary
+    "last_capture": None, # last capture_profile outcome
+}
+_CAPTURE_GATE = threading.Lock()  # one profiler window at a time
+
+
+def harvest_cost(compiled) -> Optional[dict]:
+    """flops / bytes-accessed totals from a jax Compiled's
+    cost_analysis(), or None when the backend exposes none.  Accepts
+    both the list-of-dicts (older jax) and plain-dict shapes."""
+    try:
+        ca = compiled.cost_analysis()
+    # vet: ignore[exception-hygiene] cost analysis is best-effort attribution; absence is a valid outcome
+    except Exception:  # noqa: BLE001 — backend exposes no analysis
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out = {}
+    if "flops" in ca:
+        out["flops"] = float(ca["flops"])
+    if "bytes accessed" in ca:
+        out["bytes_accessed"] = float(ca["bytes accessed"])
+    return out or None
+
+
+def record_cost(label: str, cost: Optional[dict]) -> None:
+    """File one AOT-warmed executable's cost harvest under its
+    shape x variant label (ops/aotcache)."""
+    if not cost:
+        return
+    with _LOCK:
+        _STATE["costs"][label] = dict(cost)
+
+
+def cost_ledger() -> Dict[str, dict]:
+    with _LOCK:
+        return {k: dict(v) for k, v in _STATE["costs"].items()}
+
+
+def _rss_bytes() -> Optional[int]:
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def refresh_memory_gauges(devices: Optional[Sequence] = None) -> int:
+    """Refresh the per-device memory gauges (+ process RSS).  Returns
+    how many per-device series were updated.  `devices` is injectable
+    for tests; None enumerates jax.devices() — only call on paths where
+    a backend is already initialised (the telemetry sampler runs inside
+    the scheduler's guarded device cycle cadence, after init)."""
+    if devices is None:
+        try:
+            import jax
+
+            devices = jax.devices()
+        # vet: ignore[exception-hygiene] no backend / dead tunnel: attribution degrades to RSS only
+        except Exception:  # noqa: BLE001 — backend unavailable
+            devices = []
+    updated = 0
+    summary: List[dict] = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        # vet: ignore[exception-hygiene] a device without stats is a valid outcome, not a fault
+        except Exception:  # noqa: BLE001 — backend exposes no stats
+            stats = None
+        if not stats:
+            continue
+        name = f"{getattr(d, 'platform', 'dev')}:{getattr(d, 'id', 0)}"
+        rec = {"device": name}
+        for key, kind in _MEM_KEYS:
+            if key in stats:
+                DEVICE_MEMORY.set(float(stats[key]), device=name, kind=kind)
+                rec[kind] = int(stats[key])
+                updated += 1
+        summary.append(rec)
+    rss = _rss_bytes()
+    if rss is not None:
+        PROCESS_MEMORY.set(float(rss), kind="rss")
+    with _LOCK:
+        _STATE["last_memory"] = {"at_unix": round(time.time(), 3),
+                                 "devices": summary,
+                                 "rss_bytes": rss}
+    return updated
+
+
+def memory_stats_payload(devices: Optional[Sequence] = None) -> List[dict]:
+    """Raw per-device memory_stats() as JSON-able records (the device
+    probe's HBM-visibility line in watch_bench rides on the same
+    shape)."""
+    if devices is None:
+        try:
+            import jax
+
+            devices = jax.devices()
+        # vet: ignore[exception-hygiene] no backend: an empty attribution list is the honest answer
+        except Exception:  # noqa: BLE001 — backend unavailable
+            devices = []
+    out: List[dict] = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        # vet: ignore[exception-hygiene] a device without stats is a valid outcome
+        except Exception:  # noqa: BLE001 — backend exposes no stats
+            stats = None
+        out.append({
+            "device": f"{getattr(d, 'platform', 'dev')}:{getattr(d, 'id', 0)}",
+            "memory_stats": ({k: int(v) for k, v in stats.items()}
+                             if stats else None),
+        })
+    return out
+
+
+def _artifacts_under(root: str) -> List[dict]:
+    files = []
+    for r, _dirs, fns in os.walk(root):
+        for fn in fns:
+            p = os.path.join(r, fn)
+            try:
+                files.append({"path": os.path.relpath(p, root),
+                              "bytes": os.path.getsize(p)})
+            except OSError:
+                continue
+    return sorted(files, key=lambda f: f["path"])
+
+
+def _note_capture(rec: dict) -> dict:
+    with _LOCK:
+        _STATE["last_capture"] = rec
+    return rec
+
+
+def capture_profile(seconds: float, out_dir: str) -> dict:
+    """One bounded jax.profiler capture window: start the trace, keep
+    the window open `seconds` (capped at MAX_CAPTURE_S), run one tiny
+    marker op so an idle plane still yields a non-empty artifact, stop,
+    and inventory what landed on disk.  One capture at a time — a
+    second concurrent request answers busy instead of corrupting the
+    first window's artifact."""
+    seconds = min(max(float(seconds), 0.0), MAX_CAPTURE_S)
+    if not _CAPTURE_GATE.acquire(blocking=False):
+        CAPTURES.inc(outcome="busy")
+        # `busy` is the structured flag the HTTP layer maps to 409 —
+        # never couple on the human-readable message
+        return {"ok": False, "busy": True,
+                "error": "a profiler capture is already running; one "
+                         "window at a time"}
+    t0 = time.perf_counter()
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        dest = os.path.join(out_dir, f"profile-{stamp}")
+        os.makedirs(dest, exist_ok=True)
+        jax.profiler.start_trace(dest)
+        try:
+            deadline = time.perf_counter() + seconds
+            # the marker op: guarantees the capture is never empty and
+            # stamps a recognizable kernel into an otherwise idle window
+            jax.jit(lambda a: a * 2 + 1)(
+                jnp.arange(128)).block_until_ready()
+            remaining = deadline - time.perf_counter()
+            if remaining > 0:
+                time.sleep(remaining)
+        finally:
+            jax.profiler.stop_trace()
+        files = _artifacts_under(dest)
+        CAPTURES.inc(outcome="ok")
+        return _note_capture({
+            "ok": True,
+            "dir": dest,
+            "seconds": seconds,
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "files": files,
+            "total_bytes": sum(f["bytes"] for f in files),
+        })
+    # vet: ignore[exception-hygiene] counted + returned as the capture outcome; the debug surface must answer, not raise
+    except Exception as e:  # noqa: BLE001 — answered as the JSON outcome
+        CAPTURES.inc(outcome="error")
+        return _note_capture({"ok": False, "error": repr(e)[:400],
+                              "seconds": seconds})
+    finally:
+        _CAPTURE_GATE.release()
+
+
+def state_payload() -> dict:
+    """The devprof block (inside /debug/slo-adjacent surfaces and
+    /debug/state consumers that want attribution): the executable cost
+    ledger, the last memory refresh, and the last capture outcome."""
+    with _LOCK:
+        return {
+            "costs": {k: dict(v) for k, v in _STATE["costs"].items()},
+            "last_memory": _STATE["last_memory"],
+            "last_capture": _STATE["last_capture"],
+        }
+
+
+def reset_for_tests() -> None:
+    with _LOCK:
+        _STATE["costs"] = {}
+        _STATE["last_memory"] = None
+        _STATE["last_capture"] = None

@@ -244,11 +244,18 @@ def state_payload() -> Dict[str, object]:
         }
 
 
-def _set_warm(label: str, state: str, seconds: Optional[float] = None) -> None:
+def _set_warm(label: str, state: str, seconds: Optional[float] = None,
+              cost: Optional[dict] = None) -> None:
     with _LOCK:
         rec: Dict[str, object] = {"state": state}
         if seconds is not None:
             rec["seconds"] = round(seconds, 3)
+        if cost:
+            # device cost attribution (obs/devprof): the executable's
+            # cost_analysis() harvest rides in the ledger so
+            # /debug/state's aot section shows flops/bytes per
+            # shape x variant
+            rec["cost"] = dict(cost)
         _STATE["warmup"][label] = rec
 
 
@@ -413,7 +420,11 @@ def warm_executables(
                             batch, waves=waves, keep_sel=keep_sel,
                             variant=variant)
                     dt = time.perf_counter() - t0
-                    _set_warm(label, "done", dt)
+                    cost = timings.get("cost")
+                    _set_warm(label, "done", dt, cost=cost)
+                    from karmada_tpu.obs import devprof
+
+                    devprof.record_cost(label, cost)
                     results[label] = {"seconds": round(dt, 3), **timings}
                     compile_s_total += timings["compile_s"]
                     lower_s_total += timings["lower_s"]

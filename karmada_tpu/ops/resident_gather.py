@@ -203,7 +203,10 @@ def aot_warm(B: int, cap: int, Kp: int = 4, Ke: int = 4, plan=None) -> dict:
     lowered = gather_batch.lower(
         *args, shard_mesh=plan.mesh if plan is not None else None)
     t1 = _time.perf_counter()
-    lowered.compile()
+    compiled = lowered.compile()
     t2 = _time.perf_counter()
+    from karmada_tpu.obs import devprof
+
     return {"lower_s": round(t1 - t0, 3), "compile_s": round(t2 - t1, 3),
-            "slot_cap": int(cap)}
+            "slot_cap": int(cap),
+            "cost": devprof.harvest_cost(compiled)}

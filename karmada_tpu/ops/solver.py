@@ -1398,9 +1398,15 @@ def aot_warm_compile(batch, *, waves: int = 8, keep_sel: bool = False,
                        shard_mesh=plan.mesh if plan is not None else None,
                        explain=explain)
     t1 = _time.perf_counter()
-    lowered.compile()
+    compiled = lowered.compile()
     t2 = _time.perf_counter()
-    return {"lower_s": round(t1 - t0, 3), "compile_s": round(t2 - t1, 3)}
+    from karmada_tpu.obs import devprof
+
+    # device cost attribution: flops / bytes-accessed of the executable
+    # (telemetry plane, obs/devprof) — the chip-side price of one
+    # dispatch, harvested once at warm time, zero cost on dispatch
+    return {"lower_s": round(t1 - t0, 3), "compile_s": round(t2 - t1, 3),
+            "cost": devprof.harvest_cost(compiled)}
 
 
 def wait_compact(handle) -> None:

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from karmada_tpu import chaos as chaos_mod
 from karmada_tpu import obs
 from karmada_tpu.obs import decisions as obs_decisions
+from karmada_tpu.obs import timeseries as obs_timeseries
 from karmada_tpu.estimator.general import GeneralEstimator
 from karmada_tpu.models.cluster import Cluster
 from karmada_tpu.models.meta import Condition, set_condition
@@ -395,6 +396,11 @@ class Scheduler:
         # visible precisely when cycles stop happening
         for qname, age in oldest.items():
             sched_metrics.QUEUE_OLDEST_AGE.set(age, queue=qname)
+        # idle planes keep producing series too (rate-limited by the
+        # ring's min_interval on the same queue clock): a wedged queue's
+        # depth trajectory must be in the ring precisely when cycles
+        # stop happening
+        obs_timeseries.maybe_sample(self.queue.now())
         if moved or ready:
             self.worker.enqueue(_CYCLE)
 
@@ -646,6 +652,11 @@ class Scheduler:
         for qname, depth in depths.items():
             sched_metrics.QUEUE_DEPTH.set(depth, queue=qname)
             sched_metrics.QUEUE_OLDEST_AGE.set(oldest[qname], queue=qname)
+        # telemetry plane (obs/timeseries, serve --telemetry): one ring
+        # sample per scheduling cycle on the QUEUE's clock — the loadgen
+        # VirtualClock in compressed soaks, so synthetic hours produce
+        # real series.  Disarmed cost is one module-global read.
+        obs_timeseries.maybe_sample(self.queue.now())
         if more:
             self.worker.enqueue(_CYCLE)
 

@@ -89,14 +89,28 @@ def last_probe() -> dict:
 # compiles + executes (a half-dead tunnel can pass init and hang dispatch).
 # NDEV makes the probe topology-aware: the mesh-sharded solve path
 # (ops/meshing) and the watcher/bench payloads report how many chips
-# actually answered, not just that one did.
+# actually answered, not just that one did.  MEMSTATS carries each
+# device's memory_stats() (post-jit, so HBM in-use reflects a live
+# executable) — device-memory visibility across chip windows for the
+# telemetry plane; null per device on backends that report none
+# (XLA:CPU).
 _PROBE_SNIPPET = (
-    "import jax, jax.numpy as jnp;"
+    "import jax, jax.numpy as jnp, json;"
     "d = jax.devices();"
     "jax.jit(lambda a: a @ a)(jnp.ones((128, 128), jnp.bfloat16))"
     ".block_until_ready();"
     "print('PLATFORM=' + d[0].platform);"
-    "print('NDEV=' + str(len(d)))"
+    "print('NDEV=' + str(len(d)));"
+    "ms = [];\n"
+    "for dev in d:\n"
+    "    try:\n"
+    "        s = dev.memory_stats()\n"
+    "    except Exception:\n"
+    "        s = None\n"
+    "    ms.append({'device': f'{dev.platform}:{dev.id}',\n"
+    "               'memory_stats': ({k: int(v) for k, v in s.items()}\n"
+    "                                if s else None)})\n"
+    "print('MEMSTATS=' + json.dumps(ms))"
 )
 
 # platforms worth running the batched XLA program on; XLA:CPU executes it
@@ -116,7 +130,7 @@ def probe_backend(timeout_s: float = 330.0) -> dict:
     solve's scale axis).
     """
     diag = {"ok": False, "platform": None, "device_count": None,
-            "attempts": []}
+            "memory_stats": None, "attempts": []}
     t0 = time.perf_counter()
     try:
         r = subprocess.run(
@@ -128,6 +142,13 @@ def probe_backend(timeout_s: float = 330.0) -> dict:
             if line.startswith("NDEV="):
                 try:
                     diag["device_count"] = int(line.split("=", 1)[1])
+                except ValueError:
+                    pass
+            elif line.startswith("MEMSTATS="):
+                import json as _json
+
+                try:
+                    diag["memory_stats"] = _json.loads(line.split("=", 1)[1])
                 except ValueError:
                     pass
         for line in r.stdout.splitlines():

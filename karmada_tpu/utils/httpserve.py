@@ -37,6 +37,24 @@ ThreadingHTTPServer serves:
                          armed by `serve --chaos SPEC`): armed rules with
                          fire counts, per-site totals, the recent fire
                          log; {"enabled": false} when disarmed
+    /debug/timeseries    telemetry plane ring (obs/timeseries, armed by
+                         `serve --telemetry`): per-series point lists
+                         over the retained window, counters with
+                         reset-aware window deltas; ?n=N limits samples,
+                         ?prefix=karmada_scheduler filters families,
+                         ?points=0 keeps only the window aggregates
+                         (delta/last — what karmadactl top polls);
+                         {"enabled": false} when disarmed
+    /debug/slo           SLO error budgets (obs/slo): per-objective
+                         multi-window burn rates, budget remaining, the
+                         regression-watchdog verdict; {"enabled": false}
+                         when disarmed
+    /debug/profile?seconds=N
+                         on-demand jax.profiler capture (obs/devprof):
+                         opens a bounded trace window, writes
+                         TensorBoard-loadable artifacts under the serve
+                         dir, answers the artifact inventory; one
+                         capture at a time (HTTP 409 while busy)
 
 The trace endpoints read the process-wide tracer (karmada_tpu.obs.TRACER,
 armed by `karmadactl serve --trace-buffer N`) unless an explicit recorder
@@ -63,6 +81,9 @@ class ObservabilityServer:
         ready_probe: Optional[Callable[[], bool]] = None,
         recorder=None,
         decisions=None,
+        # /debug/profile artifact root (serve passes <plane dir>/profiles);
+        # None lazily falls back to a tmp dir on the first capture
+        profile_dir: Optional[str] = None,
     ) -> None:
         from karmada_tpu.utils.metrics import REGISTRY
 
@@ -71,6 +92,7 @@ class ObservabilityServer:
         self.ready_probe = ready_probe
         self._recorder = recorder
         self._decisions = decisions
+        self.profile_dir = profile_dir
         self._httpd = None
         self._thread: Optional[threading.Thread] = None
 
@@ -128,6 +150,17 @@ class ObservabilityServer:
             "summaries": [export.summarize(t) for t in traces],
             "traces": traces,
         }
+
+    @staticmethod
+    def _query_params(query: str) -> dict:
+        """k=v pairs of a raw query string (no repeats expected on the
+        debug surface; the last value wins)."""
+        out = {}
+        for part in (query or "").split("&"):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                out[k] = v
+        return out
 
     @staticmethod
     def _json_error(message: str, code: int):
@@ -227,6 +260,45 @@ class ObservabilityServer:
 
             return (json.dumps(rebalance.state_payload()).encode(),
                     "application/json", 200)
+        if path == "/debug/timeseries":
+            from karmada_tpu.obs import timeseries
+
+            params = self._query_params(query)
+            n = None
+            try:
+                if params.get("n"):
+                    n = max(0, int(params["n"]))
+            except ValueError:
+                pass
+            return (json.dumps(timeseries.state_payload(
+                        n=n, prefix=params.get("prefix") or None,
+                        include_points=params.get("points") != "0")).encode(),
+                    "application/json", 200)
+        if path == "/debug/slo":
+            from karmada_tpu.obs import slo
+
+            return (json.dumps(slo.state_payload()).encode(),
+                    "application/json", 200)
+        if path == "/debug/profile":
+            from karmada_tpu.obs import devprof
+
+            params = self._query_params(query)
+            try:
+                seconds = float(params.get("seconds", "1"))
+            except ValueError:
+                return self._json_error(
+                    f"seconds must be a number, got "
+                    f"{params.get('seconds')!r}", 400)
+            out_dir = self.profile_dir
+            if out_dir is None:
+                import tempfile
+
+                out_dir = self.profile_dir = tempfile.mkdtemp(
+                    prefix="karmada-profile-")
+            rec = devprof.capture_profile(seconds, out_dir)
+            code = 200 if rec.get("ok") else (
+                409 if rec.get("busy") else 500)
+            return json.dumps(rec).encode(), "application/json", code
         if path == "/debug/explain":
             return (json.dumps(self._explain_payload()).encode(),
                     "application/json", 200)

@@ -21,6 +21,23 @@ def exponential_buckets(start: float, factor: float, count: int) -> List[float]:
     return [start * (factor ** i) for i in range(count)]
 
 
+def quantile_from_buckets(bounds: Sequence[float], cum_counts: Sequence[int],
+                          total: int, q: float) -> float:
+    """Bucket-resolution quantile estimate from CUMULATIVE bucket counts
+    (the shape Histogram keeps internally and Registry.snapshot()
+    exposes).  Shared by Histogram.quantile, the SLO evaluator's
+    windowed bucket-delta math (obs/slo), and the `karmadactl top`
+    dashboard — one estimator, one bias (the returned value is the upper
+    bound of the bucket the rank lands in; +inf past the last bound)."""
+    if total <= 0:
+        return math.nan
+    rank = q * total
+    for bound, c in zip(bounds, cum_counts):
+        if c >= rank:
+            return bound
+    return math.inf
+
+
 def _escape_label(value: str) -> str:
     """Prometheus text-format label-value escaping: backslash, double
     quote, and newline would otherwise break the exposition line."""
@@ -56,28 +73,17 @@ class _Metric:
         return "{" + ",".join(pairs) + "}" if pairs else ""
 
 
-class Counter(_Metric):
-    TYPE = "counter"
+class _ScalarMetric(_Metric):
+    """Shared one-value-per-label-set storage (Counter / Gauge): the
+    render and snapshot shapes must never drift between the two."""
 
     def __init__(self, name, help_, label_names=()):
         super().__init__(name, help_, label_names)
         self._values: Dict[Tuple[str, ...], float] = {}  # guarded-by: _lock
 
-    def inc(self, amount: float = 1.0, **labels: str) -> None:
-        key = self._key(labels)
-        with self._lock:
-            self._values[key] = self._values.get(key, 0.0) + amount
-
     def value(self, **labels: str) -> float:
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
-
-    def total(self) -> float:
-        """Sum across every label combination (delta accounting for the
-        chaos safety auditor, which cannot enumerate label values that
-        only exist after faults fire)."""
-        with self._lock:
-            return sum(self._values.values())
 
     def _render(self) -> List[str]:
         with self._lock:
@@ -86,13 +92,30 @@ class Counter(_Metric):
                 for k, v in sorted(self._values.items())
             ]
 
+    def _snap(self) -> List[dict]:
+        with self._lock:
+            return [{"labels": list(k), "value": v}
+                    for k, v in sorted(self._values.items())]
 
-class Gauge(_Metric):
+
+class Counter(_ScalarMetric):
+    TYPE = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def total(self) -> float:
+        """Sum across every label combination (delta accounting for the
+        chaos safety auditor, which cannot enumerate label values that
+        only exist after faults fire)."""
+        with self._lock:
+            return sum(self._values.values())
+
+
+class Gauge(_ScalarMetric):
     TYPE = "gauge"
-
-    def __init__(self, name, help_, label_names=()):
-        super().__init__(name, help_, label_names)
-        self._values: Dict[Tuple[str, ...], float] = {}  # guarded-by: _lock
 
     def set(self, value: float, **labels: str) -> None:
         with self._lock:
@@ -102,17 +125,6 @@ class Gauge(_Metric):
         key = self._key(labels)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
-
-    def value(self, **labels: str) -> float:
-        with self._lock:
-            return self._values.get(self._key(labels), 0.0)
-
-    def _render(self) -> List[str]:
-        with self._lock:
-            return [
-                f"{self.name}{self._fmt_labels(self.label_names, k)} {v}"
-                for k, v in sorted(self._values.items())
-            ]
 
 
 class Histogram(_Metric):
@@ -157,14 +169,16 @@ class Histogram(_Metric):
         key = self._key(labels)
         with self._lock:
             total = self._totals.get(key, 0)
-            counts = self._counts.get(key, [])
-        if total == 0:
-            return math.nan
-        rank = q * total
-        for i, c in enumerate(counts):
-            if c >= rank:
-                return self.buckets[i]
-        return math.inf
+            counts = list(self._counts.get(key, []))
+        return quantile_from_buckets(self.buckets, counts, total, q)
+
+    def _snap(self) -> List[dict]:
+        with self._lock:
+            return [{"labels": list(k),
+                     "count": self._totals[k],
+                     "sum": self._sums[k],
+                     "buckets": list(self._counts.get(k, []))}
+                    for k in sorted(self._totals)]
 
     def _render(self) -> List[str]:
         out: List[str] = []
@@ -224,6 +238,42 @@ class Registry:
             lines.append(f"# TYPE {m.name} {m.TYPE}")
             lines.extend(m._render())  # noqa: SLF001
         return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Structured point-in-time view of every family — the telemetry
+        plane's sampling surface (obs/timeseries), read under the same
+        locks `dump()` renders under, with NO text-format round trip:
+
+            {name: {"type": counter|gauge|histogram,
+                    "help": str,
+                    "labels": [label names...],
+                    # counters/gauges:
+                    "samples": [{"labels": [values...], "value": float}],
+                    # histograms instead:
+                    "bounds": [finite upper bounds...],
+                    "samples": [{"labels": [...], "count": int,
+                                 "sum": float,
+                                 "buckets": [cumulative counts...]}]}}
+
+        Histogram bucket counts are CUMULATIVE (the internal shape), so
+        windowed deltas between two snapshots stay valid bucket arrays
+        and feed `quantile_from_buckets` directly.  `dump()` stays the
+        only text exposition; the two are regression-tested for
+        consistency (tests/test_telemetry.py)."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        out: Dict[str, dict] = {}
+        for m in metrics:
+            fam: Dict[str, object] = {
+                "type": m.TYPE,
+                "help": m.help,
+                "labels": list(m.label_names),
+                "samples": m._snap(),  # noqa: SLF001 — registry owner
+            }
+            if isinstance(m, Histogram):
+                fam["bounds"] = list(m.buckets)
+            out[m.name] = fam
+        return out
 
 
 # the default registry every component instruments into (the reference's

@@ -1,0 +1,607 @@
+"""Telemetry plane (obs/timeseries + obs/slo + obs/devprof): ring
+bounds, snapshot/dump consistency, counter-delta reset semantics,
+virtual-clock sampling inside a compressed soak, burn-rate math on
+synthetic series, the regression watchdog, the disarmed
+zero-compile/zero-cost contract, the /debug/timeseries + /debug/slo +
+/debug/profile HTTP surface, and the `karmadactl top`/`profile` render
+smoke."""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from karmada_tpu.obs import devprof
+from karmada_tpu.obs import slo as obs_slo
+from karmada_tpu.obs import timeseries as obs_ts
+from karmada_tpu.utils.metrics import (
+    Registry,
+    exponential_buckets,
+    quantile_from_buckets,
+)
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test leaves the process-wide sampler/evaluator disarmed."""
+    yield
+    obs_ts.disarm()
+    obs_slo.disarm()
+
+
+def make_registry():
+    r = Registry()
+    c = r.counter("karmada_test_events_total", "events", ("kind",))
+    g = r.gauge("karmada_test_depth", "depth")
+    h = r.histogram("karmada_test_latency_seconds", "latency",
+                    buckets=exponential_buckets(0.001, 2, 10))
+    return r, c, g, h
+
+
+# -- Registry.snapshot() ------------------------------------------------------
+
+
+def test_snapshot_structure_and_dump_consistency():
+    """The structured snapshot and the text exposition must agree on
+    every value — dump() stays the only text surface, snapshot() the
+    only structured one, and they may never drift."""
+    r, c, g, h = make_registry()
+    c.inc(3, kind="a")
+    c.inc(kind="b")
+    g.set(7.5)
+    for v in (0.002, 0.004, 0.1):
+        h.observe(v)
+    snap = r.snapshot()
+    assert set(snap) == {"karmada_test_events_total", "karmada_test_depth",
+                        "karmada_test_latency_seconds"}
+    fam = snap["karmada_test_events_total"]
+    assert fam["type"] == "counter" and fam["labels"] == ["kind"]
+    values = {tuple(s["labels"]): s["value"] for s in fam["samples"]}
+    assert values == {("a",): 3.0, ("b",): 1.0}
+    hs = snap["karmada_test_latency_seconds"]["samples"][0]
+    assert hs["count"] == 3 and hs["sum"] == pytest.approx(0.106)
+    # cumulative buckets: monotone, last == count at +Inf only if all fit
+    assert hs["buckets"] == sorted(hs["buckets"])
+    # cross-check every dump line against the snapshot
+    dump = r.dump()
+    for line in dump.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        m = re.match(r"([a-z0-9_]+)(\{[^}]*\})? ([-+0-9.e]+|inf)$", line)
+        assert m, line
+        name, labels, val = m.group(1), m.group(2) or "", float(m.group(3))
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        if base not in snap:
+            base = name  # unsuffixed family
+        assert base in snap, line
+        fam = snap[base]
+        if fam["type"] != "histogram":
+            lv = tuple(re.findall(r'="([^"]*)"', labels))
+            got = {tuple(s["labels"]): s["value"] for s in fam["samples"]}
+            assert got[lv] == val, line
+        elif name.endswith("_count"):
+            assert fam["samples"][0]["count"] == val
+        elif name.endswith("_sum"):
+            assert fam["samples"][0]["sum"] == pytest.approx(val)
+
+
+def test_quantile_helper_shared_by_histogram():
+    r, _c, _g, h = make_registry()
+    for v in [0.001] * 90 + [0.3] * 10:
+        h.observe(v)
+    snap = r.snapshot()["karmada_test_latency_seconds"]
+    s = snap["samples"][0]
+    q = quantile_from_buckets(snap["bounds"], s["buckets"], s["count"], 0.5)
+    assert q == h.quantile(0.5)
+    assert q <= 0.002
+    assert quantile_from_buckets(snap["bounds"], s["buckets"],
+                                 s["count"], 0.99) >= 0.3
+    assert quantile_from_buckets([], [], 0, 0.5) != \
+        quantile_from_buckets([], [], 0, 0.5)  # NaN on empty
+
+
+# -- ring bounds / eviction ---------------------------------------------------
+
+
+def test_ring_bounds_and_eviction():
+    r, c, _g, _h = make_registry()
+    ring = obs_ts.MetricRing(capacity=4, registry=r)
+    for i in range(10):
+        c.inc(kind="a")
+        assert ring.sample(float(i), force=True)
+    assert len(ring) == 4
+    assert ring.dropped == 6
+    ts = [t for t, _ in ring.samples()]
+    assert ts == [6.0, 7.0, 8.0, 9.0]  # oldest evicted first
+    t0, t1, n = ring.window()
+    assert (t0, t1, n) == (6.0, 9.0, 4)
+    # n=0 really means zero samples, never the whole-ring [-0:] slice
+    assert ring.samples(0) == []
+    assert len(ring.samples(2)) == 2
+    # a late out-of-order arrival (concurrent cycle + periodic threads
+    # finishing snapshots in the wrong order) is dropped, keeping the
+    # ring time-monotone — counter_delta must never read it as a reset
+    assert not ring.sample(5.0, force=True)
+    assert ring.out_of_order == 1
+    assert [t for t, _ in ring.samples()] == [6.0, 7.0, 8.0, 9.0]
+    # min_interval throttling on the SAMPLING clock; the per-sample
+    # prepare hook (memory-gauge refresh) is only paid on ADMITTED
+    # samples — a plane cycling every few ms must not poll devices
+    # per cycle
+    ring2 = obs_ts.MetricRing(capacity=8, registry=r, min_interval_s=1.0)
+    calls = []
+    assert ring2.sample(0.0, prepare=lambda: calls.append(1))
+    assert not ring2.sample(0.5, prepare=lambda: calls.append(1))
+    assert ring2.sample(1.5, prepare=lambda: calls.append(1))
+    assert len(calls) == 2
+
+
+def test_counter_delta_reset_aware():
+    """A restarted process re-registers counters at 0: the windowed
+    delta must count the post-reset value as increase and keep the
+    pre-reset growth."""
+    pts = [(0.0, 100.0), (1.0, 150.0), (2.0, 10.0), (3.0, 30.0)]
+    assert obs_ts.counter_delta(pts) == pytest.approx(50 + 10 + 20)
+    assert obs_ts.counter_delta([(0.0, 5.0)]) == 0.0
+    assert obs_ts.counter_delta([]) == 0.0
+    # end-to-end: series_window carries the reset-aware delta
+    r, c, _g, _h = make_registry()
+    ring = obs_ts.MetricRing(capacity=8, registry=r)
+    c.inc(100, kind="a")
+    ring.sample(0.0, force=True)
+    c.inc(50, kind="a")
+    ring.sample(1.0, force=True)
+    series = obs_ts.series_window(ring.samples())
+    key = 'karmada_test_events_total{kind="a"}'
+    assert series[key]["delta"] == 50.0
+    assert series[key]["points"] == [[0.0, 100.0], [1.0, 150.0]]
+
+
+# -- burn-rate math on synthetic series --------------------------------------
+
+
+def _counter_snap(value_bad: float, value_total: float) -> dict:
+    return {
+        "karmada_test_bad_total": {
+            "type": "counter", "help": "", "labels": [],
+            "samples": [{"labels": [], "value": value_bad}]},
+        "karmada_test_all_total": {
+            "type": "counter", "help": "", "labels": [],
+            "samples": [{"labels": [], "value": value_total}]},
+    }
+
+
+class _FakeRing:
+    def __init__(self, samples):
+        self._s = samples
+
+    def samples(self, n=None):
+        return self._s if n is None else self._s[-n:]
+
+
+def test_burn_rate_math_ratio_objective():
+    obj = obs_slo.Objective(
+        "errs", "ratio", target=0.99,
+        bad=("karmada_test_bad_total", None),
+        total=("karmada_test_all_total", None))
+    ev = obs_slo.SloEvaluator(objectives=[obj], short_frac=0.25)
+    # 8 samples; bad grows 5 over the long window (total 100), but all
+    # of it in the FIRST half — the short window (last 2) is clean
+    samples = []
+    for i in range(8):
+        bad = 5.0 if i >= 4 else i * (5.0 / 4)
+        samples.append((float(i), _counter_snap(bad, i * (100.0 / 7))))
+    payload = ev.evaluate(_FakeRing(samples))
+    rec = payload["objectives"][0]
+    # err long = 5/100 = 0.05; budget = 0.01 -> burn 5.0
+    assert rec["burn_rate"]["long"] == pytest.approx(5.0, rel=1e-3)
+    assert rec["burn_rate"]["short"] == pytest.approx(0.0)
+    # multi-window rule: short is clean -> healthy despite long burn
+    assert rec["healthy"] is True
+    assert rec["budget_remaining"] == 0.0  # 0.05/0.01 clamps to 0
+    # now both windows burn: bad grows steadily
+    samples = [(float(i), _counter_snap(i * 2.0, i * 100.0))
+               for i in range(8)]
+    payload = ev.evaluate(_FakeRing(samples))
+    rec = payload["objectives"][0]
+    assert rec["burn_rate"]["long"] == pytest.approx(2.0, rel=1e-3)
+    assert rec["burn_rate"]["short"] == pytest.approx(2.0, rel=1e-3)
+    assert rec["healthy"] is False
+    assert payload["healthy"] is False
+    # gauges exported
+    assert obs_slo.SLO_HEALTHY.value(slo="errs") == 0.0
+    assert obs_slo.SLO_BURN_MILLI.value(slo="errs", window="long") == 2000.0
+
+
+def test_burn_rate_latency_and_zero_objectives():
+    r = Registry()
+    h = r.histogram("karmada_test_lat_seconds", "x",
+                    buckets=[0.1, 1.0, 10.0])
+    viol = r.counter("karmada_test_viol_total", "x")
+    lat = obs_slo.Objective("lat", "latency", target=0.9,
+                            metric="karmada_test_lat_seconds",
+                            threshold_s=1.0)
+    zero = obs_slo.Objective("cons", "zero",
+                             bad=("karmada_test_viol_total", None))
+    ev = obs_slo.SloEvaluator(objectives=[lat, zero], short_frac=0.5)
+    ring = obs_ts.MetricRing(capacity=16, registry=r)
+    ring.sample(0.0, force=True)
+    for v in [0.05] * 8 + [5.0] * 2:  # 20% of observations over 1s
+        h.observe(v)
+    ring.sample(1.0, force=True)
+    payload = ev.evaluate(ring)
+    lat_rec, zero_rec = payload["objectives"]
+    # err 0.2 over budget 0.1 -> burn 2.0 in both windows -> unhealthy
+    assert lat_rec["burn_rate"]["long"] == pytest.approx(2.0)
+    assert lat_rec["healthy"] is False
+    assert lat_rec["estimated_p"] == pytest.approx(10.0)  # bucket bound
+    assert zero_rec["healthy"] is True and zero_rec["events"]["long"] == 0
+    viol.inc()
+    ring.sample(2.0, force=True)
+    payload = ev.evaluate(ring)
+    zero_rec = payload["objectives"][1]
+    assert zero_rec["healthy"] is False
+    assert zero_rec["events"]["long"] == 1.0
+    # an off-bucket threshold rounds the error fraction UP: 1.0s
+    # observations against a 0.7s deadline (between the 0.1 and 1.0
+    # bounds) must count as misses, never as provably-good
+    lat07 = obs_slo.Objective("lat07", "latency", target=0.9,
+                              metric="karmada_test_lat_seconds",
+                              threshold_s=0.7)
+    ev07 = obs_slo.SloEvaluator(objectives=[lat07], short_frac=0.5)
+    r07 = Registry()
+    h07 = r07.histogram("karmada_test_lat_seconds", "x",
+                        buckets=[0.1, 1.0, 10.0])
+    ring07 = obs_ts.MetricRing(capacity=4, registry=r07)
+    ring07.sample(0.0, force=True)
+    for _ in range(10):
+        h07.observe(1.0)  # every request missed the 0.7s deadline
+    ring07.sample(1.0, force=True)
+    rec07 = ev07.evaluate(ring07)["objectives"][0]
+    assert rec07["error_fraction"]["long"] == 1.0
+    assert rec07["healthy"] is False
+    # no-data tri-state: a fresh ring with no observations judges None
+    ev2 = obs_slo.SloEvaluator(objectives=[lat])
+    r2 = Registry()
+    r2.histogram("karmada_test_lat_seconds", "x", buckets=[0.1, 1.0])
+    ring2 = obs_ts.MetricRing(capacity=4, registry=r2)
+    ring2.sample(0.0, force=True)
+    ring2.sample(1.0, force=True)
+    rec = ev2.evaluate(ring2)["objectives"][0]
+    assert rec["healthy"] is None
+    assert rec["burn_rate"]["long"] is None
+
+
+# -- regression watchdog ------------------------------------------------------
+
+
+def _watchdog_samples(bps: float, span: float = 10.0, n: int = 6,
+                      busy: bool = True):
+    out = []
+    for i in range(n):
+        t = span * i / (n - 1)
+        out.append((t, {
+            "karmada_scheduler_schedule_attempts_total": {
+                "type": "counter", "help": "",
+                "labels": ["result", "schedule_type"],
+                "samples": [{"labels": ["scheduled", "reconcile"],
+                             "value": bps * t}]},
+            "karmada_scheduler_queue_depth": {
+                "type": "gauge", "help": "", "labels": ["queue"],
+                "samples": [{"labels": ["active"],
+                             "value": 5.0 if busy else 0.0}]},
+        }))
+    return out
+
+
+def test_regression_watchdog_trip_and_clear():
+    wd = obs_slo.RegressionWatchdog(baseline_bps=1000.0, floor_frac=0.5,
+                                    min_window_bindings=100)
+    # saturated window scheduling at 200 bps < floor 500 -> trip
+    rec = wd.check(_watchdog_samples(200.0))
+    assert rec["tripped"] is True
+    assert rec["live_bps"] == pytest.approx(200.0, rel=0.01)
+    assert obs_slo.REGRESSION_TRIPPED.value() == 1.0
+    # recovered throughput clears it
+    rec = wd.check(_watchdog_samples(800.0))
+    assert rec["tripped"] is False
+    assert obs_slo.REGRESSION_TRIPPED.value() == 0.0
+    # light load (idle queue) never evaluates: verdict keeps last state
+    rec = wd.check(_watchdog_samples(1.0, busy=False))
+    assert rec["tripped"] is False and rec["live_bps"] is None
+    assert rec["busy_frac"] == 0.0
+    # too little traffic: same
+    wd2 = obs_slo.RegressionWatchdog(baseline_bps=1000.0, floor_frac=0.5,
+                                     min_window_bindings=10_000)
+    rec = wd2.check(_watchdog_samples(200.0))
+    assert rec["tripped"] is False and rec["live_bps"] is None
+
+
+def test_baseline_envelope_loads_committed_bench():
+    env = obs_slo.load_baseline_envelope()
+    assert env is not None and env["bps"] > 0
+    assert obs_slo.load_baseline_envelope("/nonexistent.json") is None
+
+
+# -- virtual-clock sampling inside a compressed soak -------------------------
+
+
+def test_virtual_clock_sampling_in_compressed_soak():
+    """The scheduler's cycle hook stamps ring samples on the QUEUE
+    clock — the soak's VirtualClock — so a compressed scenario yields a
+    real virtual-time series with enough samples for burn-rate math
+    (the bench --slo acceptance shape)."""
+    import dataclasses
+
+    from karmada_tpu.loadgen import (
+        LoadDriver, ServeSlice, ServiceModel, VirtualClock, get_scenario,
+    )
+
+    scenario = dataclasses.replace(get_scenario("steady"), n_bindings=80)
+    clock = VirtualClock()
+    model = ServiceModel()
+    plane = ServeSlice(scenario, clock, model)
+    driver = LoadDriver(plane, scenario, clock=clock, model=model, seed=3)
+    ring = obs_ts.configure(capacity=2048, min_interval_s=0.0)
+    obs_slo.configure(arm_watchdog=False)
+    payload = driver.run()
+    assert len(ring) >= 20
+    t0, t1, _n = ring.window()
+    # stamped on the virtual timeline, not wall time
+    assert t0 >= 1_000_000.0 and t1 > t0
+    slo_payload = payload["slo"]
+    assert slo_payload["enabled"] and slo_payload["window"]["samples"] >= 20
+    by_name = {o["name"]: o for o in slo_payload["objectives"]}
+    assert by_name["schedule_p99"]["burn_rate"]["long"] is not None
+    assert payload["scheduled"] > 0
+
+
+# -- disarmed contract --------------------------------------------------------
+
+
+def test_disarmed_zero_compile_and_zero_metric_cost():
+    from karmada_tpu.ops import solver
+    from karmada_tpu.utils.metrics import REGISTRY
+
+    assert obs_ts.active() is None
+    before_fams = set(REGISTRY.snapshot())
+    c_before = solver._jit_cache_size()  # noqa: SLF001
+    for i in range(1000):
+        assert obs_ts.maybe_sample(float(i)) is False
+    c_after = solver._jit_cache_size()  # noqa: SLF001
+    assert c_before == c_after  # zero jit compiles (both None on old jax)
+    assert set(REGISTRY.snapshot()) == before_fams  # zero new families
+    # and the sampler's own counters did not move while disarmed
+    assert obs_ts.SAMPLES_TOTAL.value() == obs_ts.SAMPLES_TOTAL.value()
+
+
+# -- devprof ------------------------------------------------------------------
+
+
+class _FakeDev:
+    platform, id = "tpu", 0
+
+    def memory_stats(self):
+        return {"bytes_in_use": 1024, "peak_bytes_in_use": 2048,
+                "bytes_limit": 4096}
+
+
+def test_devprof_memory_gauges_and_cost_ledger():
+    devprof.reset_for_tests()
+    n = devprof.refresh_memory_gauges(devices=[_FakeDev()])
+    assert n == 3
+    assert devprof.DEVICE_MEMORY.value(device="tpu:0", kind="in_use") == 1024
+    assert devprof.DEVICE_MEMORY.value(device="tpu:0", kind="peak") == 2048
+    payload = devprof.state_payload()
+    assert payload["last_memory"]["devices"][0]["in_use"] == 1024
+    assert payload["last_memory"]["rss_bytes"] > 0  # the host floor
+    devprof.record_cost("B8xC2:plain", {"flops": 10.0,
+                                        "bytes_accessed": 20.0})
+    devprof.record_cost("nope", None)  # absent analysis: not filed
+    assert devprof.cost_ledger() == {"B8xC2:plain": {"flops": 10.0,
+                                                     "bytes_accessed": 20.0}}
+    stats = devprof.memory_stats_payload(devices=[_FakeDev()])
+    assert stats[0]["memory_stats"]["bytes_limit"] == 4096
+
+
+def test_aot_warm_harvests_cost_analysis():
+    """ops/solver.aot_warm_compile returns the compiled executable's
+    cost_analysis harvest (flops/bytes) — the aotcache ledger's cost
+    column."""
+    from karmada_tpu.estimator.general import GeneralEstimator
+    from karmada_tpu.loadgen.driver import build_cluster
+    from karmada_tpu.ops import solver, tensors
+    from karmada_tpu.ops.aotcache import synth_items
+
+    clusters = [build_cluster(f"m{i}") for i in range(2)]
+    cindex = tensors.ClusterIndex.build(clusters)
+    batch = tensors.encode_batch(synth_items(8), cindex, GeneralEstimator())
+    timings = solver.aot_warm_compile(batch, waves=4)
+    assert timings["compile_s"] >= 0
+    cost = timings["cost"]
+    assert cost is not None and cost["flops"] > 0
+    assert cost["bytes_accessed"] > 0
+
+
+# -- HTTP + CLI smoke ---------------------------------------------------------
+
+
+def fetch(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, r.read().decode()
+
+
+@pytest.fixture
+def telemetry_plane(tmp_path):
+    """A 2-cycle device-backend serve slice with the telemetry plane
+    armed, served over the observability endpoint."""
+    from karmada_tpu.e2e import ControlPlane
+    from karmada_tpu.utils.httpserve import ObservabilityServer
+
+    obs_ts.configure(capacity=256, min_interval_s=0.0)
+    obs_slo.configure(arm_watchdog=False)
+    cp = ControlPlane(backend="device")
+    cp.add_member("m1", cpu_milli=64_000)
+    cp.add_member("m2", cpu_milli=64_000)
+    cp.tick()
+    from karmada_tpu.models.meta import ObjectMeta
+    from karmada_tpu.models.policy import (
+        Placement, PropagationPolicy, PropagationSpec, ResourceSelector,
+    )
+
+    cp.apply_policy(PropagationPolicy(
+        metadata=ObjectMeta(name="pp", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(api_version="apps/v1",
+                                                 kind="Deployment")],
+            placement=Placement())))
+    for cycle in range(2):  # the "2-cycle serve"
+        for i in range(3):
+            cp.apply({"apiVersion": "apps/v1", "kind": "Deployment",
+                      "metadata": {"name": f"app-{cycle}-{i}",
+                                   "namespace": "default"},
+                      "spec": {"replicas": 1, "template": {"spec": {
+                          "containers": [{"name": "a", "resources": {
+                              "requests": {"cpu": "100m"}}}]}}}})
+        cp.tick()
+    srv = ObservabilityServer(store=cp.store,
+                              profile_dir=str(tmp_path / "profiles"))
+    url = srv.start()
+    try:
+        yield cp, url
+    finally:
+        srv.stop()
+
+
+def test_debug_timeseries_serves_15_series_over_2_cycles(telemetry_plane):
+    _cp, url = telemetry_plane
+    code, body = fetch(url + "/debug/timeseries")
+    assert code == 200
+    payload = json.loads(body)
+    assert payload["enabled"] and payload["samples"] >= 2
+    series = payload["series"]
+    assert len(series) >= 15, f"only {len(series)} series"
+    # counters carry window deltas, gauges carry last
+    kinds = {rec["type"] for rec in series.values()}
+    assert "counter" in kinds and "gauge" in kinds
+    assert all(("delta" in rec) == (rec["type"] == "counter")
+               for rec in series.values())
+    # filters work
+    code, body = fetch(url + "/debug/timeseries?n=2&prefix=karmada_scheduler")
+    sub = json.loads(body)
+    assert sub["returned_samples"] <= 2
+    assert sub["series"] and all(k.startswith("karmada_scheduler")
+                                 for k in sub["series"])
+    # aggregate mode (?points=0, the karmadactl top poll): window
+    # deltas/last values only — no per-series point lists serialized
+    code, body = fetch(url + "/debug/timeseries?points=0")
+    agg = json.loads(body)
+    assert agg["series"] and all("points" not in rec
+                                 for rec in agg["series"].values())
+    assert len(body) < len(fetch(url + "/debug/timeseries")[1])
+
+
+def test_debug_slo_and_top_render(telemetry_plane, capsys):
+    _cp, url = telemetry_plane
+    code, body = fetch(url + "/debug/slo")
+    assert code == 200
+    payload = json.loads(body)
+    assert payload["enabled"]
+    assert {o["name"] for o in payload["objectives"]} >= {
+        "schedule_p99", "dwell_p99", "shed_ratio", "conservation",
+        "estimator_errors"}
+    # karmadactl top --endpoint renders the dashboard from the live plane
+    from karmada_tpu.cli import main as cli_main
+
+    rc = cli_main(["top", "--endpoint", url])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "telemetry window" in out
+    assert "queue depth" in out and "cycle budget" in out
+    assert "slo [" in out
+    # disarmed plane: the dashboard says so instead of crashing
+    obs_ts.disarm()
+    rc = cli_main(["top", "--endpoint", url])
+    assert rc == 0
+    assert "disabled" in capsys.readouterr().out
+
+
+def test_debug_profile_rejects_bad_input_as_json_400(telemetry_plane):
+    """Input validation answers JSON, never a stack trace (no capture
+    is started, so this stays cheap in-process)."""
+    _cp, url = telemetry_plane
+    import urllib.error
+
+    try:
+        code, body = fetch(url + "/debug/profile?seconds=abc")
+    except urllib.error.HTTPError as e:
+        code, body = e.code, e.read().decode()
+    assert code == 400 and "error" in json.loads(body)
+
+
+def test_debug_profile_writes_nonempty_artifact(tmp_path, capsys):
+    """The acceptance shape: /debug/profile?seconds=1 on a live serve
+    plane (CPU backend) yields a non-empty TensorBoard-loadable
+    artifact.  Runs against a FRESH serve subprocess — in a long test
+    session jax.profiler.start_trace scales with the process's
+    executable population (tens of seconds), which measures the suite,
+    not the endpoint."""
+    import os
+    import re as _re
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    plane = str(tmp_path / "plane")
+    subprocess.run(
+        [sys.executable, "-m", "karmada_tpu.cli", "--dir", plane, "init"],
+        check=True, env=env, cwd=repo, capture_output=True)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "karmada_tpu.cli", "--dir", plane, "serve",
+         "--backend", "serial", "--metrics-port", "0", "--telemetry"],
+        env=env, cwd=repo, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    url = None
+    try:
+        for line in proc.stdout:  # serve prints the bound ephemeral port
+            m = _re.search(r"observability endpoint at (http://\S+)", line)
+            if m:
+                url = m.group(1)
+                break
+        assert url, "serve never printed its observability endpoint"
+        with urllib.request.urlopen(url + "/debug/profile?seconds=1",
+                                    timeout=180) as r:
+            rec = json.loads(r.read().decode())
+        assert rec["ok"], rec
+        assert rec["files"], "capture produced no artifacts"
+        assert rec["total_bytes"] > 0
+        assert any(f["bytes"] > 0 for f in rec["files"])
+        # artifacts land under the plane dir (the profileflag contract)
+        assert rec["dir"].startswith(os.path.join(plane, "profiles"))
+        # karmadactl profile renders a second capture's inventory
+        from karmada_tpu.cli import main as cli_main
+
+        rc = cli_main(["profile", "--endpoint", url, "--seconds", "0"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "captured" in out and "bytes" in out
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_serve_cycles_refresh_memory_attribution(telemetry_plane):
+    """The per-guarded-cycle contract: after a 2-cycle serve with the
+    plane armed, the memory attribution refreshed (RSS floor on CPU,
+    per-device series where the backend reports stats)."""
+    payload = devprof.state_payload()
+    assert payload["last_memory"] is not None
+    assert payload["last_memory"]["rss_bytes"] > 0
+    assert devprof.PROCESS_MEMORY.value(kind="rss") > 0

@@ -597,3 +597,102 @@ def test_exception_hygiene_waiver(tmp_path):
     assert [f for f in report.findings
             if f.rule == "exception-hygiene"] == []
     assert any(w.rule == "exception-hygiene" for w in report.waivers)
+
+
+# -- metric-docs (pass 7) -----------------------------------------------------
+
+METRIC_SRC = """
+    from karmada_tpu.utils.metrics import REGISTRY
+    DOCUMENTED = REGISTRY.counter(
+        "karmada_fixture_documented_total", "help text")
+    UNDOCUMENTED = REGISTRY.counter(
+        "karmada_fixture_ghost_total", "help text")
+"""
+
+
+def _docs_tree(tmp_path, doc_text, src=METRIC_SRC):
+    """A fixture tree shaped like the package: the registry home
+    (utils/metrics.py — the whole-package marker), a registration
+    module, and docs/OBSERVABILITY.md one level above."""
+    import textwrap as _tw
+
+    pkg = tmp_path / "pkg"
+    (pkg / "utils").mkdir(parents=True)
+    (pkg / "utils" / "metrics.py").write_text("REGISTRY = None\n")
+    (pkg / "mod.py").write_text(_tw.dedent(src))
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "OBSERVABILITY.md").write_text(_tw.dedent(doc_text))
+    return run_vet([str(pkg)])
+
+
+def test_metric_docs_catches_undocumented_and_stale(tmp_path):
+    report = _docs_tree(tmp_path, """
+        # Metrics
+        * `karmada_fixture_documented_total{kind}` — documented
+        * `karmada_fixture_stale_total` — registered by nothing
+    """)
+    msgs = {f.message for f in report.findings if f.rule == "metric-docs"}
+    assert any("karmada_fixture_ghost_total" in m
+               and "not catalogued" in m for m in msgs)
+    assert any("karmada_fixture_stale_total" in m
+               and "stale" in m for m in msgs)
+    assert not any("karmada_fixture_documented_total" in m for m in msgs)
+    # the stale finding anchors at the DOC file/line
+    stale = [f for f in report.findings
+             if f.rule == "metric-docs" and "stale" in f.message]
+    assert stale[0].file.endswith("OBSERVABILITY.md")
+
+
+def test_metric_docs_clean_on_fixed_and_brace_forms(tmp_path):
+    # name expansion + label braces both resolve; a doc-side waiver
+    # covers the deliberately-external row
+    report = _docs_tree(tmp_path, """
+        * `karmada_fixture_{documented,ghost}_total{kind=a|b}` — both
+        * `karmada_fixture_external_total` <!-- metric-docs: ok scraped from the agent -->
+    """)
+    assert [f for f in report.findings if f.rule == "metric-docs"] == []
+
+
+def test_metric_docs_code_side_waiver_and_missing_doc(tmp_path):
+    import textwrap as _tw
+
+    report = _docs_tree(tmp_path, """
+        * `karmada_fixture_documented_total`
+    """, src="""
+        from karmada_tpu.utils.metrics import REGISTRY
+        DOCUMENTED = REGISTRY.counter(
+            "karmada_fixture_documented_total", "help text")
+        # vet: ignore[metric-docs] internal-only debugging series
+        UNDOC = REGISTRY.counter(
+            "karmada_fixture_ghost_total", "help text")
+    """)
+    assert [f for f in report.findings if f.rule == "metric-docs"] == []
+    assert any(w.rule == "metric-docs" for w in report.waivers)
+
+
+def test_metric_docs_missing_doc_is_a_finding(tmp_path):
+    """No docs/OBSERVABILITY.md anywhere above the scanned tree: one
+    actionable finding, never a silently-vacuous gate.  (Its own
+    tmp_path — a sibling doc from another fixture tree must not be
+    found by the walk-up.)"""
+    import textwrap as _tw
+
+    pkg = tmp_path / "pkg"
+    (pkg / "utils").mkdir(parents=True)
+    (pkg / "utils" / "metrics.py").write_text("REGISTRY = None\n")
+    (pkg / "mod.py").write_text(_tw.dedent(METRIC_SRC))
+    report = run_vet([str(pkg)])
+    assert any(f.rule == "metric-docs" and "not found" in f.message
+               for f in report.findings)
+
+
+def test_metric_docs_skips_partial_scans(tmp_path):
+    """Vetting a single module (no utils/metrics.py in the scanned set)
+    must not judge doc parity — partial scans would report the whole
+    doc as stale."""
+    import textwrap as _tw
+
+    (tmp_path / "mod.py").write_text(_tw.dedent(METRIC_SRC))
+    report = run_vet([str(tmp_path / "mod.py")])
+    assert [f for f in report.findings if f.rule == "metric-docs"] == []

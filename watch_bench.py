@@ -52,6 +52,10 @@ def probe_record(probe: dict, attempt: int) -> dict:
         # scale axis) — MULTICHIP payloads become self-describing instead
         # of a stderr tail
         "devices": probe.get("device_count"),
+        # per-device memory_stats() from the successful probe subprocess
+        # (telemetry plane, obs/devprof): HBM visibility across chip
+        # windows — null per device on backends that report none
+        "memory_stats": probe.get("memory_stats"),
         "elapsed_s": last.get("s"),
         "rc": last.get("rc"),
         "err": (str(last.get("err"))[:200]
@@ -163,6 +167,25 @@ def main() -> int:
                           "admission": soak.get("admission"),
                           "overload": soak.get(
                               "starvation", {}).get("overload_entered")})
+                slo_v = (detail.get("slo")
+                         or (detail.get("soak") or {}).get("slo")
+                         or ((detail.get("chaos") or {}).get("slo"))
+                         or ((detail.get("rebalance") or {}).get("slo")))
+                if slo_v:
+                    # SLO verdict pass-through (telemetry plane): the
+                    # burn-rate summary as a structured line, same
+                    # contract as the soak/delta/coldstart events
+                    jlog({"event": "slo",
+                          "ts": round(time.time(), 3),
+                          "healthy": slo_v.get("healthy"),
+                          "window": slo_v.get("window"),
+                          "objectives": {
+                              o["name"]: {"healthy": o.get("healthy"),
+                                          "burn": o.get("burn_rate"),
+                                          "budget": o.get(
+                                              "budget_remaining")}
+                              for o in slo_v.get("objectives", [])},
+                          "regression": slo_v.get("regression")})
                 live_tpu = ("tpu" in str(detail.get("platform", "")).lower()
                             and not detail.get("cached"))
                 if live_tpu and payload.get("value", 0) > 0:
