@@ -845,6 +845,63 @@ def measure_disarmed_overhead(reference_cycle_s, iters: int = 20000) -> dict:
     }
 
 
+def measure_ledger_overhead(reference_cycle_s, iters: int = 20000) -> dict:
+    """The lifecycle ledger's honest price — the --slo acceptance gate:
+    the ARMED per-event record (timed on a private ledger in its two
+    shapes: the coalescing tail bump a steady stream of identical events
+    takes, and the fresh-event path rotating refs take) and the DISARMED
+    module-emitter no-op (one global list read), each against a mean
+    scheduling cycle.  Pure host bookkeeping — zero jit compiles
+    (asserted, explain-plane style)."""
+    from karmada_tpu.obs import events as obs_events
+    from karmada_tpu.ops import solver
+
+    c_before = solver._jit_cache_size()  # noqa: SLF001
+    led = obs_events.EventLedger(capacity=4096)
+    ref = obs_events.ObjectRef("ResourceBinding", "bench", "ledger")
+    led.record(ref, obs_events.TYPE_NORMAL,
+               obs_events.REASON_BINDING_ENQUEUED, "enqueued")  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        led.record(ref, obs_events.TYPE_NORMAL,
+                   obs_events.REASON_BINDING_ENQUEUED, "enqueued")
+    coalesce_s = (time.perf_counter() - t0) / iters
+    refs = [obs_events.ObjectRef("ResourceBinding", "bench", f"l{i}")
+            for i in range(1024)]
+    t0 = time.perf_counter()
+    for i in range(iters):
+        led.record(refs[i & 1023], obs_events.TYPE_NORMAL,
+                   obs_events.REASON_SCHEDULE_BINDING_SUCCEED,
+                   f"scheduled round {i >> 10}")
+    fresh_s = (time.perf_counter() - t0) / iters
+    was_armed = obs_events.armed()
+    obs_events.disarm()
+    try:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            obs_events.emit_key(("bench", "ledger"), obs_events.TYPE_NORMAL,
+                                obs_events.REASON_BINDING_ENQUEUED,
+                                "enqueued")
+        disarmed_s = (time.perf_counter() - t0) / iters
+    finally:
+        if was_armed:
+            obs_events.arm()
+    c_after = solver._jit_cache_size()  # noqa: SLF001
+    new_compiles = (None if c_before is None or c_after is None
+                    else c_after - c_before)
+    armed_s = max(coalesce_s, fresh_s)
+    pct = lambda s: (round(s / reference_cycle_s * 100, 5)
+                     if reference_cycle_s and reference_cycle_s > 0 else None)
+    return {
+        "ledger_armed_per_event_us": round(armed_s * 1e6, 4),
+        "ledger_coalesce_per_event_us": round(coalesce_s * 1e6, 4),
+        "ledger_armed_overhead_pct": pct(armed_s),
+        "ledger_disarmed_per_call_us": round(disarmed_s * 1e6, 4),
+        "ledger_disarmed_overhead_pct": pct(disarmed_s),
+        "ledger_new_compiles": new_compiles,
+    }
+
+
 def build_rebalance_items(rng: random.Random, items, names):
     """BASELINE config 5's second half: bindings that WERE scheduled now
     need re-assignment (descheduler marks clusters lossy / triggers
@@ -1727,6 +1784,7 @@ def run_soak(args) -> int:
     finally:
         disarm_telemetry()
     telemetry.update(measure_disarmed_overhead(ref_cycle_s))
+    telemetry.update(measure_ledger_overhead(ref_cycle_s))
     payload["backend"] = args.soak_backend
     payload["telemetry"] = telemetry
     if args.slo:
@@ -1750,6 +1808,25 @@ def run_soak(args) -> int:
             "disarmed serve path must be free (< 1%)")
         assert telemetry["disarmed_new_compiles"] in (0, None), (
             "the disarmed telemetry hook triggered jit compilation")
+        # the lifecycle ledger's acceptance leg: recording an event (the
+        # worst of the coalescing and fresh-event shapes) and the
+        # disarmed emitter must each stay under 1% of a mean cycle, and
+        # neither may touch the jit cache
+        assert telemetry["ledger_armed_overhead_pct"] is not None and \
+            telemetry["ledger_armed_overhead_pct"] < 1.0, (
+            f"armed ledger record costs "
+            f"{telemetry['ledger_armed_overhead_pct']}% of a cycle — the "
+            "event journal must be noise (< 1%)")
+        assert telemetry["ledger_disarmed_overhead_pct"] is not None and \
+            telemetry["ledger_disarmed_overhead_pct"] < 1.0, (
+            f"disarmed ledger emitter costs "
+            f"{telemetry['ledger_disarmed_overhead_pct']}% of a cycle")
+        assert telemetry["ledger_new_compiles"] in (0, None), (
+            "the lifecycle ledger triggered jit compilation")
+        ledger_stats = payload.get("events") or {}
+        assert ledger_stats.get("recorded", 0) > 0, (
+            "the soak recorded zero lifecycle events — the ledger was "
+            "disarmed or the emitters are dead")
     _hb(f"soak done: injected={payload['injected']} "
         f"scheduled={payload['scheduled']} "
         f"admission={payload['admission']} "

@@ -31,6 +31,9 @@ RULES = (
                          # with help text
     "metric-docs",       # registered metric missing from
                          # docs/OBSERVABILITY.md (or a doc row gone stale)
+    "event-reasons",     # ledger emission without a declared REASON_*
+                         # constant, or a reason missing from the
+                         # docs/OBSERVABILITY.md catalog
     "exception-hygiene",  # blanket except that neither re-raises nor
                           # records a metric (nor carries a waiver)
     "waiver-syntax",     # vet: ignore[...] without a justification

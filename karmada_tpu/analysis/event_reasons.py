@@ -1,0 +1,155 @@
+"""Pass 8 — event-reason taxonomy (docs/OBSERVABILITY.md catalog).
+
+Two legs, mirroring the metric-docs pass:
+
+  * **call sites** — every lifecycle-ledger emission
+    (``recorder.event(obj, type_, REASON, msg)``, ``emit(ref, type_,
+    REASON, msg)``, ``emit_key(key, type_, REASON, msg)``) must pass a
+    declared ``REASON_*`` constant, never a string literal or computed
+    value: the reason vocabulary is the timeline's query key (the
+    auditor's terminal-state walk, the per-reason metrics, the doc
+    catalog), and an ad-hoc string silently forks it;
+  * **catalog** — every ``REASON_* = "..."`` constant declared in the
+    taxonomy home (``obs/events.py``) must appear in the
+    docs/OBSERVABILITY.md reason catalog, so an operator reading a
+    timeline can look up what each reason means.  Only runs on
+    whole-package scans (the scanned set must include ``obs/events.py``)
+    — vetting one file must not report the rest of the tree's doc.
+
+Waivers: ``# vet: ignore[event-reasons] <why>`` on the call site, and
+the doc side needs no waiver channel (declare the constant where the
+pass harvests or don't declare it at all).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from karmada_tpu.analysis.core import Finding, SourceFile, dotted
+from karmada_tpu.analysis.metric_docs import DOC_RELPATH, _find_doc
+
+#: module-level emitter names (obs/events): calls to these are ledger
+#: emissions wherever they appear (bare or attribute-qualified)
+EMIT_FUNCS = ("emit", "emit_key")
+
+#: the taxonomy home — REASON_* assignments are harvested only here
+TAXONOMY_SUFFIX = os.path.join("obs", "events.py")
+
+
+def _reason_arg(node: ast.Call) -> Optional[ast.AST]:
+    """The reason argument of an emission call: positional index 2
+    (after obj/ref and type_) or the ``reason=`` keyword."""
+    for kw in node.keywords:
+        if kw.arg == "reason":
+            return kw.value
+    if len(node.args) > 2:
+        return node.args[2]
+    return None
+
+
+def _is_emission(node: ast.Call) -> Optional[str]:
+    """\"recorder.event\" / \"emit\" / \"emit_key\" when the call is a
+    ledger emission, else None."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "event":
+            chain = dotted(f.value) or ""
+            if chain == "recorder" or chain.endswith(".recorder"):
+                return "recorder.event"
+            return None
+        if f.attr in EMIT_FUNCS:
+            return f.attr
+        return None
+    if isinstance(f, ast.Name) and f.id in EMIT_FUNCS:
+        return f.id
+    return None
+
+
+def _reason_const_name(node: ast.AST) -> Optional[str]:
+    """The terminal identifier of a Name/Attribute reason argument."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def declared_reasons(
+        files: Sequence[SourceFile]) -> List[Tuple[str, str, SourceFile, int]]:
+    """(constant name, reason value, file, line) for every module-level
+    ``REASON_* = "literal"`` assignment in the taxonomy home."""
+    out: List[Tuple[str, str, SourceFile, int]] = []
+    for sf in files:
+        if not sf.path.endswith(TAXONOMY_SUFFIX):
+            continue
+        for node in sf.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                if (isinstance(target, ast.Name)
+                        and target.id.startswith("REASON_")
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    out.append((target.id, node.value.value, sf, node.lineno))
+    return out
+
+
+def run(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    # -- leg 1: every emission call site names a REASON_* constant ----------
+    for sf in files:
+        if sf.path.endswith(TAXONOMY_SUFFIX):
+            continue  # the ledger's own internals forward parameters
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            shape = _is_emission(node)
+            if shape is None:
+                continue
+            arg = _reason_arg(node)
+            if arg is None:
+                continue  # too few args: not the emission signature
+            name = _reason_const_name(arg)
+            if name is None or not name.startswith("REASON_"):
+                what = ("string literal"
+                        if isinstance(arg, ast.Constant) else "expression")
+                findings.append(Finding(
+                    rule="event-reasons", file=sf.path, line=node.lineno,
+                    message=f"{shape}(...) passes a {what} as the event "
+                            "reason — every emission must name a declared "
+                            "REASON_* constant (obs/events.py taxonomy; "
+                            "ad-hoc reasons fork the timeline vocabulary)",
+                ))
+    # -- leg 2: every declared reason is catalogued in the doc --------------
+    declared = declared_reasons(files)
+    if not declared:
+        return findings  # partial scan: the taxonomy home is not in view
+    doc_path = _find_doc(files)
+    if doc_path is None:
+        _, _, sf, line = declared[0]
+        findings.append(Finding(
+            rule="event-reasons", file=sf.path, line=line,
+            message=f"{DOC_RELPATH} not found above the scanned tree — "
+                    "the event-reason catalog gate cannot run",
+        ))
+        return findings
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            doc_text = f.read()
+    except OSError as e:
+        _, _, sf, line = declared[0]
+        findings.append(Finding(
+            rule="event-reasons", file=sf.path, line=line,
+            message=f"cannot read {doc_path}: {e}"))
+        return findings
+    for cname, value, sf, line in declared:
+        if value not in doc_text:
+            findings.append(Finding(
+                rule="event-reasons", file=sf.path, line=line,
+                message=f"event reason `{value}` ({cname}) is not "
+                        f"catalogued in {DOC_RELPATH} — every reason an "
+                        "operator can meet on a timeline needs its row",
+            ))
+    return findings

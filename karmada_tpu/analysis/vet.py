@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Sequence
 
 from karmada_tpu.analysis import (
     dtype_contract,
+    event_reasons,
     exception_hygiene,
     lock_discipline,
     metric_docs,
@@ -49,6 +50,7 @@ PASSES = {
     "lock-discipline": (lock_discipline.run, ("guarded-by",)),
     "metric-naming": (metric_naming.run, ("metric-naming",)),
     "metric-docs": (metric_docs.run, ("metric-docs",)),
+    "event-reasons": (event_reasons.run, ("event-reasons",)),
     "exception-hygiene": (exception_hygiene.run, ("exception-hygiene",)),
 }
 

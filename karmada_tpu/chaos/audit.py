@@ -29,6 +29,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from karmada_tpu.chaos import plane as chaos_plane
+from karmada_tpu.obs import events as obs_events
 
 
 def _readers() -> Dict[str, object]:
@@ -123,6 +124,15 @@ def audit_soak(driver, baseline: Optional[Dict[str, float]] = None) -> dict:
         "shed_budget": shed_budget,
         "double_placed": double_placed,
     }
+
+    # -- ledger-derived conservation (the lifecycle-ledger variant) ----------
+    # the same invariant proved a SECOND way, from the event timelines
+    # alone: every injected binding has a non-empty timeline whose
+    # terminal event is consistent with the store/queue state the
+    # recompute above read.  A disagreement means one of the two
+    # accountings lies — both land in `violations`.
+    ledger_conservation = _ledger_conservation(flights, sched, driver,
+                                               violations)
 
     # -- fault accountability ------------------------------------------------
     fires: Dict[str, int] = {}
@@ -224,9 +234,124 @@ def audit_soak(driver, baseline: Optional[Dict[str, float]] = None) -> dict:
     return {
         "violations": violations,
         "conservation": conservation,
+        "ledger_conservation": ledger_conservation,
         "fault_fires": fires,
         "metric_deltas": {k: round(v, 6) for k, v in deltas.items()},
         "recovery": recovery,
+    }
+
+
+#: lifecycle-ledger reason -> terminal-state class for the ledger-derived
+#: conservation walk (newest matching event wins)
+_TERMINAL_STATES = {
+    obs_events.REASON_SCHEDULE_BINDING_SUCCEED: "scheduled",
+    obs_events.REASON_BINDING_SHED: "shed",
+    obs_events.REASON_BINDING_DISPLACED: "shed",
+    obs_events.REASON_SCHEDULE_BINDING_FAILED: "queued",
+    obs_events.REASON_BINDING_ENQUEUED: "queued",
+    obs_events.REASON_EVICT_WORKLOAD_FROM_CLUSTER: "evicted",
+    obs_events.REASON_REBALANCE_EVICTED: "evicted",
+}
+
+
+def _ledger_terminal(timeline) -> tuple:
+    """(terminal state, reasons seen) of one timeline: the newest event
+    whose reason names a terminal class decides."""
+    seen = set()
+    terminal = "missing"
+    for evd in timeline:
+        seen.add(evd["reason"])
+    for evd in reversed(timeline):
+        state = _TERMINAL_STATES.get(evd["reason"])
+        if state is not None:
+            terminal = state
+            break
+    return terminal, seen
+
+
+def _ledger_conservation(flights, sched, driver, violations) -> dict:
+    """The ledger-derived conservation verdict: classify every injected
+    binding from its event timeline and cross-check against the live
+    store/queue state (the legacy recompute's inputs).
+
+    Consistency rules per binding:
+      * still resident in a queue  -> terminal `queued` or `evicted`
+        (an eviction's re-push lands an enqueued event next, so a
+        resident binding's tail is one of exactly these);
+      * observed scheduled (flight.done) and not resident -> terminal
+        `scheduled`, or `shed` only when a ScheduleBindingSucceed event
+        precedes it (a once-scheduled binding re-offered by a cluster
+        kill may legitimately be shed while re-waiting);
+      * neither -> terminal `shed` (the only legitimate way to drop);
+      * an empty timeline is always a gap (the ledger missed a life).
+    """
+    if not obs_events.armed():
+        return {"enabled": False}
+    led = obs_events.ledger()
+    # capacity eviction during THIS run: an early binding's whole
+    # timeline may have been pruned oldest-first — that is the bounded
+    # journal doing its job, not a missed life, so pruned timelines are
+    # REPORTED (the gap_free flag still drops) but never violations.
+    # With zero evictions, a missing timeline can only be a real gap.
+    base = getattr(driver, "_events_base", None) or {}
+    evicted_delta = led.counters()["evicted"] - base.get("evicted", 0)
+    # run scoping: the process ledger outlives drivers (a pytest process
+    # runs many soaks), and deterministic binding names recur across
+    # runs — only events whose ACTIVITY postdates this run's install
+    # baseline count, or a prior run's stale terminal would mask a real
+    # gap in this one
+    seq_base = base.get("seq", 0)
+    counts: Dict[str, int] = {}
+    disagreements: List[dict] = []
+    pruned = 0
+    for key, rec in flights.items():
+        ns, name = key
+        timeline = [e for e in led.timeline("ResourceBinding", ns, name)
+                    if e["last_seq"] > seq_base]
+        terminal, seen = _ledger_terminal(timeline)
+        if terminal == "missing" and evicted_delta > 0:
+            pruned += 1
+            counts["pruned"] = counts.get("pruned", 0) + 1
+            continue
+        counts[terminal] = counts.get(terminal, 0) + 1
+        with sched._queue_lock:  # noqa: SLF001 — consistent membership
+            resident = sched.queue.has(key)
+        if resident:
+            ok = terminal in ("queued", "evicted")
+            expect = "queued|evicted (still resident)"
+        elif rec.done:
+            ok = terminal == "scheduled" or (
+                terminal == "shed"
+                and obs_events.REASON_SCHEDULE_BINDING_SUCCEED in seen)
+            expect = "scheduled (observed done)"
+        else:
+            ok = terminal == "shed"
+            expect = "shed (terminally dropped)"
+        if not ok:
+            disagreements.append({
+                "binding": f"{ns}/{name}", "terminal": terminal,
+                "expected": expect, "events": len(timeline)})
+    for d in disagreements[:8]:
+        violations.append({
+            "kind": ("timeline-gap" if d["terminal"] == "missing"
+                     else "ledger-disagreement"),
+            "detail": f"binding {d['binding']} timeline terminal "
+                      f"{d['terminal']!r} but store state expects "
+                      f"{d['expected']}", **d})
+    if len(disagreements) > 8:
+        violations.append({
+            "kind": "ledger-disagreement",
+            "detail": f"{len(disagreements) - 8} further timeline "
+                      "disagreement(s) truncated"})
+    return {
+        "enabled": True,
+        "checked": len(flights),
+        "terminal": counts,
+        "gap_free": counts.get("missing", 0) == 0 and pruned == 0,
+        "pruned_by_eviction": pruned,
+        "evicted_events": int(evicted_delta),
+        "disagreements": len(disagreements),
+        "agrees": not disagreements,
     }
 
 

@@ -263,6 +263,16 @@ class ChaosPlane:
                 return None
         INJECTIONS.inc(site=site, mode=fault.mode)
         self._annotate_span(fault)
+        # lifecycle ledger: every fired fault is a timeline fact (keyed
+        # by site so one outage window coalesces into a counted entry);
+        # the safety auditor's accountability leg can then point at the
+        # exact virtual time a seam was hit
+        from karmada_tpu.obs import events as obs_events
+
+        obs_events.emit(
+            obs_events.ObjectRef(kind="ChaosPlane", name=site),
+            obs_events.TYPE_WARNING, obs_events.REASON_CHAOS_FAULT_INJECTED,
+            f"fault injected at {site} (mode={fault.mode})", origin="chaos")
         return fault
 
     @staticmethod

@@ -599,9 +599,194 @@ def cmd_interpret(args) -> int:
     return 0
 
 
+def _fetch_json(base: str, path: str):
+    """GET one JSON payload from an observability endpoint; raises
+    SystemExit-style (None, errcode) tuples are avoided — returns the
+    payload or prints the error and returns None."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(base.rstrip("/") + path, timeout=10) as r:
+            return json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            msg = json.loads(e.read().decode()).get("error", str(e))
+        # vet: ignore[exception-hygiene] fallback to the raw error text
+        except Exception:  # noqa: BLE001 — non-JSON error body
+            msg = str(e)
+        print(f"server error ({e.code}): {msg}", file=sys.stderr)
+        return None
+    except urllib.error.URLError as e:
+        print(f"cannot reach {base}: {e.reason}", file=sys.stderr)
+        return None
+
+
+def _event_age(evd: dict, now: float) -> str:
+    age = max(0.0, now - float(evd.get("last_timestamp") or 0.0))
+    if age < 120:
+        return f"{age:.0f}s"
+    if age < 7200:
+        return f"{age / 60:.0f}m"
+    return f"{age / 3600:.1f}h"
+
+
+def _event_rows(events, now: float, with_object: bool = True):
+    rows = []
+    for evd in events:
+        obj = (f"{evd.get('kind')}/"
+               + ("/".join(p for p in (evd.get("namespace"),
+                                       evd.get("name")) if p)))
+        link = []
+        if evd.get("cycle_id") is not None:
+            link.append(f"cycle={evd['cycle_id']}")
+        if evd.get("trace_id"):
+            link.append(f"trace={evd['trace_id']}")
+        if evd.get("decision_id") is not None:
+            link.append(f"decision={evd['decision_id']}")
+        row = [
+            _event_age(evd, now),
+            evd.get("type", ""),
+            evd.get("reason", ""),
+        ]
+        if with_object:
+            row.append(obj)
+        row += [
+            str(evd.get("count", 1)),
+            (evd.get("message") or "")[:72],
+            ",".join(link) or "-",
+        ]
+        rows.append(row)
+    return rows
+
+
+def _render_describe(payload: dict) -> None:
+    """Kube-style `karmadactl describe ns/binding --endpoint` rendering:
+    status summary + the event timeline + the last explain verdict."""
+    import time as _time
+
+    print(f"NAME: {payload.get('key')}  ({payload.get('kind')})")
+    binding = payload.get("binding")
+    if binding:
+        cond = binding.get("scheduled_condition") or {}
+        where = ", ".join(f"{c['name']}({c['replicas']})"
+                          for c in binding.get("clusters", []))
+        print(f"STATUS: Scheduled={cond.get('status', 'Unknown')}"
+              + (f" ({cond.get('reason')})" if cond.get("reason") else "")
+              + (f" — {cond.get('message')}" if cond.get("message") else ""))
+        print(f"REPLICAS: {binding.get('replicas')}  "
+              f"CLUSTERS: {where or '-'}  "
+              f"GENERATION: {binding.get('observed_generation')}"
+              f"/{binding.get('generation')}")
+        for t in binding.get("eviction_tasks", []):
+            print(f"EVICTING: {t['from_cluster']} "
+                  f"(reason={t['reason']}, producer={t['producer']})")
+    else:
+        print("STATUS: binding not present in the live store")
+    decision = payload.get("decision")
+    if decision:
+        print(f"LAST VERDICT: {decision.get('outcome')}"
+              + (f" ({decision.get('reason')})"
+                 if decision.get("reason") else "")
+              + f" — {decision.get('message')}")
+        print("  (full verdict table: `karmadactl explain "
+              f"{payload.get('key')} --endpoint URL`)")
+    events = payload.get("events") or []
+    print(f"\nEvents ({len(events)}):")
+    rows = _event_rows(events, _time.time(), with_object=False)
+    _print_table(rows or [["-"] * 6],
+                 ["AGE", "TYPE", "REASON", "COUNT", "MESSAGE", "LINKS"])
+
+
+def cmd_events(args) -> int:
+    """The lifecycle ledger's front door (obs/events, /debug/events):
+
+      karmadactl events --endpoint URL            recent-event table
+      karmadactl events ns/name --endpoint URL    one binding's timeline
+      karmadactl events --endpoint URL --watch    follow new events
+    """
+    import time as _time
+
+    base = args.endpoint
+    if args.target:
+        if "/" not in args.target:
+            print("expected namespace/name (e.g. default/app-deployment)",
+                  file=sys.stderr)
+            return 1
+        payload = _fetch_json(base, f"/debug/events/{args.target}")
+        if payload is None:
+            return 1
+        _render_describe(payload)
+        return 0
+    since = None
+    first = True
+    while True:
+        # ctrl-c must exit cleanly wherever it lands — mid-fetch (the
+        # 10s urlopen is most of each cycle against a slow endpoint) as
+        # much as mid-sleep
+        try:
+            path = f"/debug/events?n={args.limit}"
+            if since is not None:
+                path += f"&since={since}"
+            payload = _fetch_json(base, path)
+            if payload is None:
+                return 1
+            events = payload.get("recent") or []
+            if first:
+                stats = payload.get("stats") or {}
+                print(f"ledger: {stats.get('recorded')} recorded, "
+                      f"{stats.get('coalesced')} coalesced, "
+                      f"{stats.get('evicted')} evicted, "
+                      f"{stats.get('retained')} retained over "
+                      f"{stats.get('objects')} object(s)")
+            rows = _event_rows(events, _time.time())
+            if rows or first:
+                _print_table(rows or [["-"] * 7],
+                             ["AGE", "TYPE", "REASON", "OBJECT", "COUNT",
+                              "MESSAGE", "LINKS"])
+            first = False
+            for evd in events:
+                # the ACTIVITY cursor (not the event id): a coalesced
+                # repeat bumps last_seq, so a shed storm collapsing onto
+                # one tail entry keeps surfacing; the server pages
+                # OLDEST-first past the cursor, so a burst wider than
+                # --limit drains over successive polls instead of being
+                # skipped
+                since = max(since or 0, int(evd.get("last_seq") or 0))
+            if not args.watch:
+                return 0
+            if len(events) < args.limit:
+                _time.sleep(max(args.interval, 0.2))
+        except KeyboardInterrupt:
+            return 0
+
+
 def cmd_describe(args) -> int:
     """Detailed single-object view incl. recorded events
-    (pkg/karmadactl/describe)."""
+    (pkg/karmadactl/describe).  With --endpoint, `karmadactl describe
+    ns/binding --endpoint URL` renders a live serve plane's kube-style
+    view instead: status + the lifecycle-ledger timeline
+    (/debug/events/{ns}/{name}) + the last explain verdict."""
+    if getattr(args, "endpoint", ""):
+        target = args.kind if "/" in (args.kind or "") else (
+            f"{args.namespace}/{args.name}"
+            if args.name and args.namespace else "")
+        ns, _, nm = target.partition("/")
+        if not ns or not nm:
+            print("describe --endpoint expects namespace/name "
+                  "(e.g. karmadactl describe default/app-deployment "
+                  "--endpoint URL)", file=sys.stderr)
+            return 1
+        payload = _fetch_json(args.endpoint, f"/debug/events/{target}")
+        if payload is None:
+            return 1
+        _render_describe(payload)
+        return 0
+    if not args.name:
+        print("usage: karmadactl describe KIND NAME [-n NS] | "
+              "karmadactl describe NS/NAME --endpoint URL",
+              file=sys.stderr)
+        return 1
     cp = _load_plane(args.dir)
     if args.cluster:
         try:
@@ -1863,10 +2048,32 @@ def build_parser() -> argparse.ArgumentParser:
     i.add_argument("--replicas", type=int, default=1)
 
     d = sub.add_parser("describe")
-    d.add_argument("kind")
-    d.add_argument("name")
+    d.add_argument("kind",
+                   help="an API Kind (local mode), or namespace/binding "
+                        "with --endpoint (live timeline view)")
+    d.add_argument("name", nargs="?", default="")
     d.add_argument("-n", "--namespace", default="")
     d.add_argument("--cluster", default="")
+    d.add_argument("--endpoint", default="",
+                   help="observability endpoint URL of a serve process: "
+                        "render the kube-style live view (status + "
+                        "lifecycle-ledger event timeline + last explain "
+                        "verdict) from /debug/events/{ns}/{name}")
+
+    evs = sub.add_parser("events")
+    evs.add_argument("target", nargs="?", default="",
+                     help="namespace/name: render that binding's event "
+                          "timeline (omit to list recent events)")
+    evs.add_argument("--endpoint", required=True,
+                     help="observability endpoint URL of a live serve "
+                          "process (serve --metrics-port PORT)")
+    evs.add_argument("--watch", action="store_true",
+                     help="follow: poll /debug/events?since=ID and print "
+                          "new events until interrupted")
+    evs.add_argument("--interval", type=float, default=2.0,
+                     help="--watch poll interval seconds")
+    evs.add_argument("--limit", type=int, default=64, metavar="N",
+                     help="events per fetch (the recent-ring slice)")
 
     dl = sub.add_parser("delete")
     dl.add_argument("kind")
@@ -2245,6 +2452,7 @@ COMMANDS = {
     "tick": cmd_tick,
     "serve": cmd_serve,
     "trace": cmd_trace,
+    "events": cmd_events,
     "vet": cmd_vet,
     "loadgen": cmd_loadgen,
     "rebalance": cmd_rebalance,
@@ -2293,6 +2501,12 @@ def _dispatch(args) -> int:
     if args.command == "resident":
         # talks to a live serve process over HTTP; no plane is opened
         return cmd_resident(args)
+    if args.command == "events":
+        # talks to a live serve process over HTTP; no plane is opened
+        return cmd_events(args)
+    if args.command == "describe" and getattr(args, "endpoint", ""):
+        # live timeline view over HTTP; no plane is opened
+        return cmd_describe(args)
     if args.command == "profile":
         # talks to a live serve process over HTTP; no plane is opened
         return cmd_profile(args)

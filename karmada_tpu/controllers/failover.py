@@ -43,6 +43,7 @@ from karmada_tpu.models.work import (
 )
 from karmada_tpu.store.store import Event, NotFoundError, ObjectStore
 from karmada_tpu.store.worker import AsyncWorker, Runtime
+from karmada_tpu.utils import events as ev
 
 TAINT_NOT_READY = "cluster.karmada.io/not-ready"
 DEFAULT_GRACE_PERIOD_S = 600
@@ -162,6 +163,10 @@ class ClusterTaintController:
             def rm(c: Cluster) -> None:
                 c.spec.taints = [t for t in c.spec.taints if t.key != TAINT_NOT_READY]
             self.store.mutate(Cluster.KIND, "", name, rm)
+            ev.emit(ev.ObjectRef(kind=Cluster.KIND, name=name),
+                    ev.TYPE_NORMAL, ev.REASON_UNTAINT_CLUSTER_SUCCEED,
+                    "cluster recovered Ready: not-ready NoExecute taint "
+                    "removed", origin="cluster-taint")
         elif not ready and not has:
             def add(c: Cluster) -> None:
                 c.spec.taints.append(Taint(
@@ -169,6 +174,10 @@ class ClusterTaintController:
                     time_added=self.clock(),
                 ))
             self.store.mutate(Cluster.KIND, "", name, add)
+            ev.emit(ev.ObjectRef(kind=Cluster.KIND, name=name),
+                    ev.TYPE_WARNING, ev.REASON_TAINT_CLUSTER_SUCCEED,
+                    "cluster Ready=False: not-ready NoExecute taint added",
+                    origin="cluster-taint")
 
 
 class NoExecuteTaintManager:
@@ -255,7 +264,18 @@ class NoExecuteTaintManager:
                     self._pending.pop(key, None)
             elif due > now:
                 with self._pending_lock:
+                    newly = key not in self._pending
                     self._pending[key] = due
+                if newly:
+                    # toleration countdown visible on the timeline: the
+                    # eviction is armed but waiting out tolerationSeconds
+                    # (a taint cleared before expiry cancels it)
+                    ev.emit_key((rb.namespace, rb.name), ev.TYPE_WARNING,
+                                ev.REASON_EVICTION_PENDING,
+                                f"eviction from {cluster_name} pending "
+                                "toleration expiry (NoExecute taint "
+                                "tolerated for a bounded window)",
+                                origin="taint-manager")
             else:
                 with self._pending_lock:
                     self._pending.pop(key, None)
@@ -295,17 +315,27 @@ class NoExecuteTaintManager:
         if due is None or due > self.clock():
             return  # toleration re-verified: cancelled or not yet expired
 
+        changed = []
+
         def do_evict(obj: ResourceBinding) -> None:
-            evict_cluster(
+            changed.clear()  # mutate may retry the closure
+            if evict_cluster(
                 obj, cluster_name,
                 reason="TaintUntolerated", producer="taint-manager",
                 now=self.clock(),
-            )
+            ):
+                changed.append(True)
 
         try:
             self.store.mutate(ResourceBinding.KIND, ns, name, do_evict)
         except NotFoundError:
-            pass
+            return
+        if changed:
+            ev.emit_key((ns, name), ev.TYPE_WARNING,
+                        ev.REASON_EVICT_WORKLOAD_FROM_CLUSTER,
+                        f"gracefully evicted from {cluster_name}: "
+                        "untolerated NoExecute taint (toleration expired)",
+                        origin="taint-manager")
 
 
 class GracefulEvictionController:
@@ -364,15 +394,26 @@ class GracefulEvictionController:
                 continue  # drop the task; binding controller prunes the Work
             keep.append(task)
         if len(keep) != len(rb.spec.graceful_eviction_tasks):
+            drained = {t.from_cluster for t in rb.spec.graceful_eviction_tasks} - {
+                t.from_cluster for t in keep
+            }
+
             def update(obj: ResourceBinding) -> None:
-                drained = {t.from_cluster for t in rb.spec.graceful_eviction_tasks} - {
-                    t.from_cluster for t in keep
-                }
                 obj.spec.graceful_eviction_tasks = [
                     t for t in obj.spec.graceful_eviction_tasks
                     if t.from_cluster not in drained
                 ]
             self.store.mutate(ResourceBinding.KIND, ns, name, update)
+            # replacement-health progression on the timeline: the stale
+            # Work finally vacates only now — "replacement healthy" is
+            # the production signal, "grace expired" the bounded escape
+            why = ("replacement healthy on every scheduled cluster"
+                   if ready else "grace period expired")
+            for cluster in sorted(drained):
+                ev.emit_key((ns, name), ev.TYPE_NORMAL,
+                            ev.REASON_EVICTION_TASK_DRAINED,
+                            f"eviction task for {cluster} drained ({why})",
+                            origin="graceful-eviction")
 
 
 class ApplicationFailoverController:
@@ -438,7 +479,9 @@ class ApplicationFailoverController:
         msg = (f"application failover of cluster {cluster!r} deferred: "
                f"{why}")
         if self.recorder is not None:
-            self.recorder.event(rb, "Warning", "EvictionDeferred", msg)
+            self.recorder.event(rb, ev.TYPE_WARNING,
+                                ev.REASON_EVICTION_DEFERRED, msg,
+                                origin="app-failover")
         key = (rb.namespace, rb.name, cluster)
         if key not in self._deferral_logged:
             self._deferral_logged.add(key)
@@ -534,6 +577,12 @@ class ApplicationFailoverController:
             # tops the lost replicas back up without disrupting survivors
 
         self.store.mutate(ResourceBinding.KIND, ns, name, update)
+        for cluster in evicted:
+            ev.emit_key((ns, name), ev.TYPE_WARNING,
+                        ev.REASON_EVICT_WORKLOAD_FROM_CLUSTER,
+                        f"application unhealthy past toleration on "
+                        f"{cluster}: evicted (purge={purge})",
+                        origin="app-failover")
         # deferred evictions (payload not collectable yet) keep their
         # tracking state so they fire as soon as the status arrives
         for cluster in evicted:

@@ -137,6 +137,7 @@ class ClusterLeaseMonitor:
             except NotFoundError:
                 continue
             self.recorder.event(
-                stored, ev.TYPE_WARNING, "ClusterStatusUnknown",
+                stored, ev.TYPE_WARNING, ev.REASON_CLUSTER_STATUS_UNKNOWN,
                 f"lease for cluster {name} not renewed within grace period",
+                origin="cluster-lease",
             )

@@ -287,12 +287,16 @@ class ClusterStatusController:
             ready = member.healthy
             if self._last_ready.get(name) != ready:
                 self._last_ready[name] = ready
-                self.recorder.event(
-                    stored,
-                    ev.TYPE_NORMAL if ready else ev.TYPE_WARNING,
-                    ev.REASON_CLUSTER_READY if ready else ev.REASON_CLUSTER_NOT_READY,
-                    f"cluster {name} readiness is now {ready}",
-                )
+                if ready:
+                    self.recorder.event(
+                        stored, ev.TYPE_NORMAL, ev.REASON_CLUSTER_READY,
+                        f"cluster {name} readiness is now True",
+                        origin="cluster-status")
+                else:
+                    self.recorder.event(
+                        stored, ev.TYPE_WARNING, ev.REASON_CLUSTER_NOT_READY,
+                        f"cluster {name} readiness is now False",
+                        origin="cluster-status")
 
     @staticmethod
     def _export_gauges(cluster: Cluster) -> None:

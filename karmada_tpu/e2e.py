@@ -357,6 +357,12 @@ class ControlPlane:
             self.store.mutate(RB.KIND, ns, name, bump)
         except NotFoundError:
             return
+        from karmada_tpu.utils import events as ev
+
+        ev.emit_key((ns, name), ev.TYPE_NORMAL, ev.REASON_HPA_FAST_PATH,
+                    f"FederatedHPA scale to {desired} replicas: "
+                    "priority-pushed past the detector round-trip",
+                    origin="hpa")
         self.scheduler.promote((ns, name), priority=FAST_PATH_PRIORITY,
                                origin="hpa")
 

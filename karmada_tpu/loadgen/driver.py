@@ -60,6 +60,7 @@ from karmada_tpu.models.work import (
 from karmada_tpu.scheduler import metrics as sched_metrics
 from karmada_tpu.scheduler.queue import SchedulingQueue
 from karmada_tpu.scheduler.service import Scheduler
+from karmada_tpu.obs import events as obs_events
 from karmada_tpu.store.store import DELETED, Event, NotFoundError, ObjectStore
 from karmada_tpu.store.worker import Runtime
 from karmada_tpu.utils.quantity import Quantity
@@ -580,8 +581,17 @@ class LoadDriver:
             d: sched_metrics.ADMISSION.value(decision=d)
             for d in ("admitted", "shed", "displaced")}
         self.plane.store.bus.subscribe(self._on_store_event)
+        # lifecycle-ledger baseline: the SOAK report embeds this run's
+        # event deltas (events/s, coalesce ratio, per-reason counts)
+        self._events_base = obs_events.ledger().counters()
         self._prev_queue_now = None
+        self._prev_events_clock = None
         if not self.realtime:
+            # the ledger stamps on the SAME virtual clock the queue runs
+            # on (the obs_timeseries.maybe_sample discipline): compressed
+            # soak events must order against the virtual timeline, not
+            # interleave wall time with it
+            self._prev_events_clock = obs_events.set_clock(self.clock)
             sched = self.plane.scheduler
             # compressed time only works when the scheduler's queue stamps
             # on the SAME clock the driver advances — a duck-typed plane
@@ -634,6 +644,9 @@ class LoadDriver:
         if self._prev_queue_now is not None:
             self.plane.scheduler.queue.now = self._prev_queue_now
             self._prev_queue_now = None
+        if self._prev_events_clock is not None:
+            obs_events.set_clock(self._prev_events_clock)
+            self._prev_events_clock = None
         self.plane.store.bus.unsubscribe(self._on_store_event)
         obs.TRACER.recorder = self._prev_recorder
         if self._chaos:
@@ -730,6 +743,13 @@ class LoadDriver:
 
                 store.mutate(ResourceBinding.KIND, rb.metadata.namespace,
                              rb.metadata.name, evict)
+                obs_events.emit_key(
+                    (rb.metadata.namespace, rb.metadata.name),
+                    obs_events.TYPE_WARNING,
+                    obs_events.REASON_EVICT_WORKLOAD_FROM_CLUSTER,
+                    "evicted from killed cluster(s): placements referenced "
+                    "a dead cluster (failover re-schedule)",
+                    origin="loadgen")
                 with self._lock:
                     rec = self._flight.get(
                         (rb.metadata.namespace, rb.metadata.name))

@@ -106,6 +106,31 @@ def span_samples(recorder, attr: str, stride_attr: str) -> List:
     return pairs
 
 
+def _ledger_summary(driver) -> dict:
+    """Lifecycle-ledger deltas over one driver run (None baseline = the
+    ledger section degrades to lifetime counters)."""
+    from karmada_tpu.obs import events as obs_events
+
+    cur = obs_events.ledger().counters()
+    base = getattr(driver, "_events_base", None) or {}
+    recorded = cur["recorded"] - base.get("recorded", 0)
+    coalesced = cur["coalesced"] - base.get("coalesced", 0)
+    base_rsn = base.get("by_reason", {})
+    by_reason = {r: n - base_rsn.get(r, 0)
+                 for r, n in cur["by_reason"].items()
+                 if n - base_rsn.get(r, 0) > 0}
+    duration = max(float(getattr(driver, "duration_s", 0.0)), 1e-9)
+    return {
+        "armed": obs_events.armed(),
+        "recorded": recorded,
+        "coalesced": coalesced,
+        "coalesce_ratio": round(coalesced / recorded, 4) if recorded else 0.0,
+        "events_per_s": round(recorded / duration, 3),
+        "evicted": cur["evicted"] - base.get("evicted", 0),
+        "by_reason": by_reason,
+    }
+
+
 def build_soak_report(driver) -> dict:
     """The SOAK payload for one finished LoadDriver run."""
     recorder = getattr(driver, "recorder", None)
@@ -187,6 +212,11 @@ def build_soak_report(driver) -> dict:
 
     payload["slo"] = (obs_slo.state_payload()
                       if obs_slo.active() is not None else None)
+    # lifecycle ledger (obs/events): this run's event deltas against the
+    # driver's install-time baseline — events/s on the soak's own clock,
+    # the coalesce ratio (how much the tail-bump saved the ring), and
+    # the per-reason tally the timeline summaries key on
+    payload["events"] = _ledger_summary(driver)
     audit = getattr(driver, "safety_audit", None)
     if audit is not None:
         # chaos soak (karmada_tpu/chaos): the fault ledger and the

@@ -56,6 +56,7 @@ from karmada_tpu.models.work import ResourceBinding
 from karmada_tpu.ops import rebalance_detect
 from karmada_tpu.rebalance.pacing import EvictionBudget
 from karmada_tpu.store.store import NotFoundError
+from karmada_tpu.utils import events as ev
 from karmada_tpu.utils.metrics import REGISTRY
 
 PRODUCER = "rebalance"
@@ -304,6 +305,13 @@ class RebalancePlane:
                 if key in drained_keys:
                     continue
                 if not self.budget.try_acquire(cname, consumer=PRODUCER):
+                    # the denial is a lifecycle fact on the CLUSTER's
+                    # timeline: the drain wanted to act and pacing said no
+                    ev.emit(ev.ObjectRef(kind="Cluster", name=cname),
+                            ev.TYPE_WARNING, ev.REASON_EVICTION_BUDGET_DENIED,
+                            "rebalance drain deferred: per-cluster eviction "
+                            "pacing budget exhausted for this window",
+                            origin=PRODUCER)
                     break  # this cluster's window is spent; next interval
                 if self._evict(key, cname, prio):
                     EVICTIONS.inc(cluster=cname)
@@ -334,6 +342,10 @@ class RebalancePlane:
         except NotFoundError:
             return False
         if changed:
+            ev.emit_key(key, ev.TYPE_NORMAL, ev.REASON_REBALANCE_EVICTED,
+                        f"gracefully evicted from {cname} by the rebalance "
+                        "drain (re-placed with a priority push)",
+                        origin=PRODUCER)
             self.scheduler.promote(key, priority=priority, origin=PRODUCER)
         return bool(changed)
 

@@ -45,6 +45,7 @@ import time as _time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Hashable, List, Optional, Tuple
 
+from karmada_tpu.obs import events as obs_events
 from karmada_tpu.scheduler import metrics as sched_metrics
 
 DEFAULT_INITIAL_BACKOFF_S = 1.0
@@ -260,9 +261,19 @@ class SchedulingQueue:
                 # the weakest resident is the one shed (equal priority
                 # keeps the resident — no displacement thrash)
                 sched_metrics.ADMISSION.inc(decision=ADMIT_SHED)
+                obs_events.emit_key(
+                    key, obs_events.TYPE_WARNING,
+                    obs_events.REASON_BINDING_SHED,
+                    f"admission gate full ({self.max_resident} resident): "
+                    "shed without a queue slot", origin=origin)
                 return ADMIT_SHED
             self.forget(victim)
             sched_metrics.ADMISSION.inc(decision=ADMIT_DISPLACED)
+            obs_events.emit_key(
+                victim, obs_events.TYPE_WARNING,
+                obs_events.REASON_BINDING_DISPLACED,
+                "displaced from the admission gate by a higher-priority "
+                "arrival", origin=origin)
         info = QueuedBindingInfo(
             key=key, priority=priority, timestamp=self.now(),
             attempts=prev.attempts if prev else 0,
@@ -272,6 +283,16 @@ class SchedulingQueue:
         )
         self._move_to_active(info, origin=origin)
         sched_metrics.ADMISSION.inc(decision=ADMIT_ADMITTED)
+        if not gate_exempt:
+            # the lifecycle ledger's admission record: every EXTERNAL
+            # push lands one (coalescing) timeline entry — the
+            # scheduler's own result-patch echoes are bookkeeping, not
+            # lifecycle, and stay silent
+            obs_events.emit_key(
+                key, obs_events.TYPE_NORMAL,
+                obs_events.REASON_BINDING_ENQUEUED,
+                f"enqueued to the active queue (origin={origin})",
+                origin=origin)
         return ADMIT_ADMITTED
 
     def push_unschedulable_if_not_present(self, info: QueuedBindingInfo,

@@ -233,6 +233,12 @@ class Scheduler:
                           if self.explain > 0 else None)
         # deterministic per-scheduler sampling stream (tests, replayable)
         self._explain_rng = random.Random(0x5EED)
+        # the recorder of the CURRENT cycle's explain sample (None on
+        # unsampled cycles) — the decision<->event cross-link must only
+        # bind outcomes to decisions THIS cycle produced, never to a
+        # stale verdict from an earlier sampled cycle (owned by the one
+        # cycle worker via schedule_batch)
+        self._cycle_explain = None
         self.mesh_plan = None
         self._mesh_tried = False
         self.estimators = list(estimators) if estimators else [GeneralEstimator()]
@@ -254,6 +260,11 @@ class Scheduler:
         # empty — must stay 0 (the never-cut-an-empty-cycle invariant);
         # counted here because an empty cut leaves no span to count
         self._empty_cuts = 0
+        # monotone id of the scheduling cycle in flight, stamped onto
+        # every lifecycle-ledger event the cycle's outcomes emit so a
+        # timeline entry names the exact batch that produced it (owned
+        # by the one cycle worker; readers take the instantaneous value)
+        self._cycle_id = 0
         # guarded-by: _queue_lock — keys of the batch the CURRENT cycle
         # is scheduling: their result-patch events re-push through
         # _on_event, and those echoes are gate-exempt (the slot they
@@ -487,9 +498,20 @@ class Scheduler:
             if dwells_sorted and \
                     p95 > self.batch_deadline_s * self.overload_enter_factor:
                 self._overload = True
+                ev.emit(ev.SCHEDULER_REF, ev.TYPE_WARNING,
+                        ev.REASON_OVERLOAD_ENTERED,
+                        "overload mode entered: p95 batch dwell exceeded "
+                        f"{self.overload_enter_factor:g}x the batch "
+                        "deadline (explain sampling suppressed, deadline "
+                        "widened)", origin="scheduler",
+                        cycle_id=self._cycle_id)
         elif popped > 0 and (popped < self.batch_window or active_after == 0
                              or p95 <= self.batch_deadline_s):
             self._overload = False
+            ev.emit(ev.SCHEDULER_REF, ev.TYPE_NORMAL,
+                    ev.REASON_OVERLOAD_EXITED,
+                    "overload mode exited: batch dwell back under the "
+                    "deadline", origin="scheduler", cycle_id=self._cycle_id)
         sched_metrics.OVERLOAD_MODE.set(1.0 if self._overload else 0.0)
 
     # -- the batched cycle --------------------------------------------------
@@ -538,6 +560,18 @@ class Scheduler:
                               active_after=active_after_pop)
         if todo:
             sched_metrics.BATCH_SIZE.observe(len(todo))
+            self._cycle_id += 1
+            # batch-formation lifecycle event on the scheduler's own
+            # timeline: the THREE stable cut shapes (window-full,
+            # deadline-hit, immediate drain) coalesce, so a steady plane
+            # keeps one bumping entry while mode flips stay visible
+            ev.emit(ev.SCHEDULER_REF, ev.TYPE_NORMAL, ev.REASON_BATCH_FORMED,
+                    ("batch cut at the batch window"
+                     if len(infos) >= self.batch_window else
+                     "batch cut at the formation deadline"
+                     if self.batch_deadline_s is not None else
+                     "batch drained immediately"),
+                    origin="scheduler", cycle_id=self._cycle_id)
             # recoverable degrade: the cooldown ticks once per REAL
             # scheduling cycle here — not per _solve call, which the
             # affinity-failover loop invokes once per round and would
@@ -565,6 +599,11 @@ class Scheduler:
                     # rescans the store.  Route every one to backoff and
                     # count the fault; the worker keeps running.
                     sched_metrics.CYCLE_FAULTS.inc(kind=type(e).__name__)
+                    ev.emit(ev.SCHEDULER_REF, ev.TYPE_WARNING,
+                            ev.REASON_CYCLE_FAULT,
+                            f"cycle fault contained ({type(e).__name__}); "
+                            "popped bindings routed to backoff",
+                            origin="scheduler", cycle_id=self._cycle_id)
                     import traceback
 
                     traceback.print_exc()
@@ -717,6 +756,7 @@ class Scheduler:
         # explain plane: one sampling decision per cycle (every affinity
         # round of a sampled cycle records, so a failover story is whole)
         explain_rec = self._explain_sample()
+        self._cycle_explain = explain_rec
         keys_all = [f"{rb.namespace}/{rb.name}" for rb in bindings]
         tokens_all = None
         if self._resident is not None:
@@ -1099,6 +1139,10 @@ class Scheduler:
             meshing.deactivate()
             self.mesh_plan = None
         sched_metrics.BACKEND_DEGRADED.inc(to=self.backend)
+        ev.emit(ev.SCHEDULER_REF, ev.TYPE_WARNING, ev.REASON_BACKEND_DEGRADED,
+                f"device backend degraded to {self.backend} after a hung "
+                "cycle (mid-serve death guard)", origin="scheduler",
+                cycle_id=self._cycle_id)
         import sys
 
         recover = self.device_recover_cycles
@@ -1136,6 +1180,10 @@ class Scheduler:
         if self._resident_cfg[0] and self._resident is None:
             self._arm_resident()
         sched_metrics.BACKEND_REARMED.inc(backend="device")
+        ev.emit(ev.SCHEDULER_REF, ev.TYPE_NORMAL, ev.REASON_BACKEND_REARMED,
+                "device backend re-armed after its degrade cooldown "
+                "(half-open re-probe)", origin="scheduler",
+                cycle_id=self._cycle_id)
         import sys
 
         print(
@@ -1200,6 +1248,22 @@ class Scheduler:
             )
         return out
 
+    def _link_decision(self, rb: ResourceBinding,
+                       event_id: Optional[int]) -> None:
+        """Cross-reference the outcome event with the explain plane's
+        Decision record for the same binding: the Decision gets the
+        event id, the event gets the decision id, so
+        /debug/explain/{ns}/{name} and the timeline point at each
+        other.  Only fires on an EXPLAIN-SAMPLED cycle (_cycle_explain):
+        an unsampled cycle's outcome must never adopt a stale verdict an
+        earlier sampled cycle recorded for the same binding."""
+        if self._cycle_explain is None or event_id is None:
+            return
+        did = self._cycle_explain.link_event(f"{rb.namespace}/{rb.name}",
+                                             event_id)
+        if did is not None:
+            self.recorder.link_decision(event_id, did)
+
     # -- result patch-back (patchScheduleResultForResourceBinding :664) -----
     def _apply_result(self, rb: ResourceBinding, res, affinity_name: str):
         """Patch the schedule outcome back; returns the EFFECTIVE outcome
@@ -1221,8 +1285,17 @@ class Scheduler:
                     obj.status.scheduler_observed_affinity_name = affinity_name
 
             self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, mark_failed)
-            self.recorder.event(rb, ev.TYPE_WARNING,
-                                ev.REASON_SCHEDULE_BINDING_FAILED, str(res))
+            # the timeline's unschedulable entry carries the dominant
+            # reason from the explain classifier (exc.reason when an
+            # explain-armed decode attached the solver's verdict, the
+            # message-shape classifier otherwise)
+            dom = obs_decisions.classify_unschedulable(res) \
+                if isinstance(res, serial.UnschedulableError) else None
+            eid = self.recorder.event(
+                rb, ev.TYPE_WARNING, ev.REASON_SCHEDULE_BINDING_FAILED,
+                (f"{res} (dominant reason: {dom})" if dom else str(res)),
+                origin="scheduler", cycle_id=self._cycle_id)
+            self._link_decision(rb, eid)
             return res
 
         # success: patch spec.clusters, then record the *stored* generation in
@@ -1259,10 +1332,13 @@ class Scheduler:
             ))
 
         self.store.mutate(ResourceBinding.KIND, rb.namespace, rb.name, patch_status)
-        self.recorder.event(
+        where = ", ".join(f"{t.name}({t.replicas})" for t in targets)
+        eid = self.recorder.event(
             rb, ev.TYPE_NORMAL, ev.REASON_SCHEDULE_BINDING_SUCCEED,
-            "Binding has been scheduled successfully.",
-        )
+            "Binding has been scheduled successfully"
+            + (f" to {where}." if where else "."),
+            origin="scheduler", cycle_id=self._cycle_id)
+        self._link_decision(rb, eid)
         return res
 
 

@@ -1,92 +1,68 @@
 """Event recorder: the framework's record.EventRecorder equivalent.
 
-The reference emits Kubernetes Events with reasons enumerated in
-pkg/events/events.go; controllers here record structured events into a
-bounded in-memory journal (duplicate (object, reason, message) events
-coalesce with a count, like the apiserver does), queryable by object.
+Grown into the lifecycle ledger (karmada_tpu/obs/events.py): a bounded,
+coalescing, thread-safe journal with a per-object timeline index, where
+every event carries {type, reason, message, origin, cycle_id, trace_id,
+decision_id}.  This module re-exports the whole surface so the classic
+``from karmada_tpu.utils import events as ev`` import sites keep
+working; see obs/events for the ledger itself, /debug/events for the
+HTTP surface, and docs/OBSERVABILITY.md for the reason catalog.
+
+A bare ``EventRecorder()`` binds the PROCESS ledger — every controller
+shares one unified per-binding timeline; explicit capacity/now yields a
+private ledger (test isolation, the pre-ledger semantics).
 """
 
-from __future__ import annotations
+from karmada_tpu.obs.events import (  # noqa: F401 — the public surface
+    EVENTS_DROPPED,
+    EVENTS_TOTAL,
+    REASON_APPLY_POLICY_SUCCEED,
+    REASON_BACKEND_DEGRADED,
+    REASON_BACKEND_REARMED,
+    REASON_BATCH_FORMED,
+    REASON_BINDING_DISPLACED,
+    REASON_BINDING_ENQUEUED,
+    REASON_BINDING_SHED,
+    REASON_CHAOS_FAULT_INJECTED,
+    REASON_CLUSTER_NOT_READY,
+    REASON_CLUSTER_READY,
+    REASON_CLUSTER_STATUS_UNKNOWN,
+    REASON_CYCLE_FAULT,
+    REASON_EVICT_WORKLOAD_FROM_CLUSTER,
+    REASON_EVICTION_BUDGET_DENIED,
+    REASON_EVICTION_DEFERRED,
+    REASON_EVICTION_PENDING,
+    REASON_EVICTION_TASK_DRAINED,
+    REASON_HPA_FAST_PATH,
+    REASON_OVERLOAD_ENTERED,
+    REASON_OVERLOAD_EXITED,
+    REASON_REBALANCE_EVICTED,
+    REASON_REFLECT_STATUS_FAILED,
+    REASON_SCHEDULE_BINDING_FAILED,
+    REASON_SCHEDULE_BINDING_SUCCEED,
+    REASON_SYNC_WORKLOAD_FAILED,
+    REASON_SYNC_WORKLOAD_SUCCEED,
+    REASON_TAINT_CLUSTER_SUCCEED,
+    REASON_UNTAINT_CLUSTER_SUCCEED,
+    REASON_WORK_DISPATCHING,
+    SCHEDULER_REF,
+    TYPE_NORMAL,
+    TYPE_WARNING,
+    EventLedger,
+    EventRecorder,
+    LedgerEvent,
+    ObjectRef,
+    arm,
+    armed,
+    configure,
+    disarm,
+    emit,
+    emit_key,
+    ledger,
+    set_clock,
+    state_payload,
+    timeline_payload,
+)
 
-import threading
-import time
-from collections import OrderedDict
-from dataclasses import dataclass, field
-from typing import Callable, List, Optional
-
-TYPE_NORMAL = "Normal"
-TYPE_WARNING = "Warning"
-
-# pkg/events/events.go reasons used by this framework's controllers
-REASON_SCHEDULE_BINDING_SUCCEED = "ScheduleBindingSucceed"
-REASON_SCHEDULE_BINDING_FAILED = "ScheduleBindingFailed"
-REASON_SYNC_WORKLOAD_SUCCEED = "SyncSucceed"
-REASON_SYNC_WORKLOAD_FAILED = "SyncFailed"
-REASON_WORK_DISPATCHING = "WorkDispatching"
-REASON_TAINT_CLUSTER_SUCCEED = "TaintClusterSucceed"
-REASON_EVICT_WORKLOAD_FROM_CLUSTER = "EvictWorkloadFromCluster"
-REASON_APPLY_POLICY_SUCCEED = "ApplyPolicySucceed"
-REASON_REFLECT_STATUS_FAILED = "ReflectStatusFailed"
-REASON_CLUSTER_NOT_READY = "ClusterNotReady"
-REASON_CLUSTER_READY = "ClusterReady"
-
-
-@dataclass
-class ObjectRef:
-    kind: str = ""
-    namespace: str = ""
-    name: str = ""
-
-
-@dataclass
-class RecordedEvent:
-    ref: ObjectRef
-    type: str = TYPE_NORMAL
-    reason: str = ""
-    message: str = ""
-    count: int = 1
-    first_timestamp: float = 0.0
-    last_timestamp: float = 0.0
-
-
-class EventRecorder:
-    """Bounded, coalescing event journal."""
-
-    def __init__(self, capacity: int = 4096,
-                 now: Callable[[], float] = time.time) -> None:
-        self.capacity = capacity
-        self.now = now
-        self._events: "OrderedDict[tuple, RecordedEvent]" = OrderedDict()
-        self._lock = threading.Lock()
-
-    def event(self, obj, type_: str, reason: str, message: str) -> None:
-        """Record one event for a typed store object (or an ObjectRef)."""
-        if isinstance(obj, ObjectRef):
-            ref = obj
-        else:
-            ref = ObjectRef(kind=obj.KIND, namespace=obj.namespace, name=obj.name)
-        key = (ref.kind, ref.namespace, ref.name, type_, reason, message)
-        ts = self.now()
-        with self._lock:
-            ev = self._events.get(key)
-            if ev is not None:
-                ev.count += 1
-                ev.last_timestamp = ts
-                self._events.move_to_end(key)
-                return
-            self._events[key] = RecordedEvent(
-                ref=ref, type=type_, reason=reason, message=message,
-                first_timestamp=ts, last_timestamp=ts,
-            )
-            while len(self._events) > self.capacity:
-                self._events.popitem(last=False)
-
-    def list(self, kind: Optional[str] = None, namespace: Optional[str] = None,
-             name: Optional[str] = None) -> List[RecordedEvent]:
-        with self._lock:
-            return [
-                ev for ev in self._events.values()
-                if (kind is None or ev.ref.kind == kind)
-                and (namespace is None or ev.ref.namespace == namespace)
-                and (name is None or ev.ref.name == name)
-            ]
+#: compat alias — callers that type-annotated the old dataclass
+RecordedEvent = LedgerEvent

@@ -55,6 +55,19 @@ ThreadingHTTPServer serves:
                          TensorBoard-loadable artifacts under the serve
                          dir, answers the artifact inventory; one
                          capture at a time (HTTP 409 while busy)
+    /debug/events        lifecycle ledger (obs/events, armed by
+                         default): counters, per-reason tallies, the
+                         recent event ring; ?n=N bounds the ring slice,
+                         ?since=SEQ returns only events with activity
+                         after the cursor (last_seq — coalesced repeats
+                         included; the `karmadactl events --watch`
+                         cursor)
+    /debug/events/{ns}/{name}
+                         one binding's gap-free event timeline plus a
+                         status summary from the live store (clusters,
+                         Scheduled condition, eviction tasks) — what
+                         `karmadactl describe ns/name --endpoint`
+                         renders kube-style
 
 The trace endpoints read the process-wide tracer (karmada_tpu.obs.TRACER,
 armed by `karmadactl serve --trace-buffer N`) unless an explicit recorder
@@ -115,10 +128,15 @@ class ObservabilityServer:
         from karmada_tpu.ops import aotcache, meshing
         from karmada_tpu.utils import deviceprobe
 
+        from karmada_tpu.obs import events as obs_events
+
         counts = self.store.counts_by_kind() if self.store is not None else {}
         rec = self._trace_recorder()
         dec = self._decision_recorder()
         return {"objects_by_kind": counts,
+                # the lifecycle ledger's counters (obs/events): recorded/
+                # coalesced/evicted totals + retained window size
+                "events": obs_events.ledger().counters(),
                 "total": sum(counts.values()),
                 "device_probe": deviceprobe.last_probe(),
                 # the AOT executable plane (ops/aotcache): persistent
@@ -187,7 +205,7 @@ class ObservabilityServer:
         return {"key": d["key"], "outcome": d["outcome"],
                 "reason": d.get("reason"), "message": d.get("message"),
                 "trace_id": d.get("trace_id"), "ts": d.get("ts"),
-                "backend": d.get("backend")}
+                "backend": d.get("backend"), "event_id": d.get("event_id")}
 
     def _explain_payload(self) -> dict:
         rec = self._decision_recorder()
@@ -200,6 +218,54 @@ class ObservabilityServer:
             "decisions": [self._decision_summary(d) for d in rec.recent()],
             "unschedulable": [self._decision_summary(d)
                               for d in rec.unschedulable()],
+        }
+
+    def _one_timeline(self, key: str):
+        """(body, ctype, code) for /debug/events/{namespace}/{name}: the
+        binding's ordered event timeline + a live status summary (the
+        `karmadactl describe --endpoint` payload)."""
+        from karmada_tpu.obs import events as obs_events
+
+        if "/" not in key:
+            return self._json_error(
+                f"expected namespace/name, got {key!r}", 404)
+        ns, name = key.split("/", 1)
+        payload = obs_events.timeline_payload(ns, name)
+        payload["binding"] = self._binding_summary(ns, name)
+        # the explain cross-reference: the latest Decision's identity so
+        # the describe renderer can show the verdict one fetch away
+        dec = self._decision_recorder()
+        d = dec.get(f"{ns}/{name}") if dec is not None else None
+        if d is not None:
+            payload["decision"] = {
+                "id": d.get("id"), "outcome": d.get("outcome"),
+                "reason": d.get("reason"), "message": d.get("message"),
+                "event_id": d.get("event_id")}
+        return json.dumps(payload).encode(), "application/json", 200
+
+    def _binding_summary(self, ns: str, name: str):
+        """A kube-describe-style status block from the live store (None
+        when the server carries no store or the binding is gone)."""
+        if self.store is None:
+            return None
+        rb = self.store.try_get("ResourceBinding", ns, name)
+        if rb is None:
+            return None
+        cond = next((c for c in rb.status.conditions
+                     if c.type == "Scheduled"), None)
+        return {
+            "exists": True,
+            "generation": rb.metadata.generation,
+            "observed_generation": rb.status.scheduler_observed_generation,
+            "replicas": rb.spec.replicas,
+            "clusters": [{"name": t.name, "replicas": t.replicas}
+                         for t in rb.spec.clusters],
+            "eviction_tasks": [{"from_cluster": t.from_cluster,
+                                "reason": t.reason, "producer": t.producer}
+                               for t in rb.spec.graceful_eviction_tasks],
+            "scheduled_condition": (None if cond is None else {
+                "status": cond.status, "reason": cond.reason,
+                "message": cond.message}),
         }
 
     def _one_decision(self, key: str):
@@ -299,6 +365,23 @@ class ObservabilityServer:
             code = 200 if rec.get("ok") else (
                 409 if rec.get("busy") else 500)
             return json.dumps(rec).encode(), "application/json", code
+        if path == "/debug/events":
+            from karmada_tpu.obs import events as obs_events
+
+            params = self._query_params(query)
+            n, since = 64, None
+            try:
+                if params.get("n"):
+                    n = max(0, int(params["n"]))
+                if params.get("since"):
+                    since = int(params["since"])
+            except ValueError:
+                pass
+            return (json.dumps(obs_events.state_payload(
+                        n=n, since=since)).encode(),
+                    "application/json", 200)
+        if path.startswith("/debug/events/"):
+            return self._one_timeline(path[len("/debug/events/"):])
         if path == "/debug/explain":
             return (json.dumps(self._explain_payload()).encode(),
                     "application/json", 200)

@@ -696,3 +696,100 @@ def test_metric_docs_skips_partial_scans(tmp_path):
     (tmp_path / "mod.py").write_text(_tw.dedent(METRIC_SRC))
     report = run_vet([str(tmp_path / "mod.py")])
     assert [f for f in report.findings if f.rule == "metric-docs"] == []
+
+
+# -- event-reasons (pass 8) ---------------------------------------------------
+
+EVENTS_TAXONOMY = """
+    REASON_FIXTURE_GOOD = "FixtureGood"
+    REASON_FIXTURE_GHOST = "FixtureGhost"
+"""
+
+
+def _events_tree(tmp_path, doc_text, src, taxonomy=EVENTS_TAXONOMY):
+    """A fixture tree shaped like the package: the taxonomy home
+    (obs/events.py — the whole-package marker), an emitting module, and
+    docs/OBSERVABILITY.md one level above."""
+    import textwrap as _tw
+
+    pkg = tmp_path / "pkg"
+    (pkg / "obs").mkdir(parents=True)
+    (pkg / "obs" / "events.py").write_text(_tw.dedent(taxonomy))
+    (pkg / "mod.py").write_text(_tw.dedent(src))
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "OBSERVABILITY.md").write_text(_tw.dedent(doc_text))
+    return run_vet([str(pkg)])
+
+
+def test_event_reasons_catches_literal_and_computed_reasons(tmp_path):
+    report = _events_tree(tmp_path, "| `FixtureGood` | `FixtureGhost` |", """
+        from karmada_tpu.utils import events as ev
+
+        def go(recorder, rb, ready):
+            recorder.event(rb, ev.TYPE_WARNING, "AdHocReason", "msg")
+            ev.emit_key(("ns", "n"), ev.TYPE_NORMAL,
+                        ev.REASON_FIXTURE_GOOD if ready else "Other", "msg")
+    """)
+    msgs = [f.message for f in report.findings if f.rule == "event-reasons"]
+    assert len(msgs) == 2
+    assert any("string literal" in m for m in msgs)
+    assert any("expression" in m for m in msgs)
+
+
+def test_event_reasons_clean_on_constants_and_catalogued_doc(tmp_path):
+    report = _events_tree(tmp_path, """
+        ## Reason catalog
+        | `FixtureGood` | fine |
+        | `FixtureGhost` | also catalogued |
+    """, """
+        from karmada_tpu.utils import events as ev
+
+        def go(recorder, rb):
+            recorder.event(rb, ev.TYPE_NORMAL, ev.REASON_FIXTURE_GOOD, "m")
+            ev.emit(ev.SCHEDULER_REF, ev.TYPE_NORMAL,
+                    ev.REASON_FIXTURE_GHOST, "m", origin="x")
+            ev.emit_key(("a", "b"), ev.TYPE_NORMAL,
+                        reason=ev.REASON_FIXTURE_GOOD, message="kw form")
+    """)
+    assert [f for f in report.findings if f.rule == "event-reasons"] == []
+
+
+def test_event_reasons_catches_uncatalogued_constant(tmp_path):
+    # FixtureGhost is declared in the taxonomy home but missing from the
+    # doc catalog: the doc-parity leg reports it at the declaration
+    report = _events_tree(tmp_path, "only `FixtureGood` is here", """
+        from karmada_tpu.utils import events as ev
+
+        def go(recorder, rb):
+            recorder.event(rb, ev.TYPE_NORMAL, ev.REASON_FIXTURE_GOOD, "m")
+    """)
+    bad = [f for f in report.findings if f.rule == "event-reasons"]
+    assert len(bad) == 1
+    assert "FixtureGhost" in bad[0].message
+    assert bad[0].file.endswith("events.py")
+
+
+def test_event_reasons_waiver_and_partial_scan(tmp_path):
+    import textwrap as _tw
+
+    # a waived literal call site is a waiver, not a finding
+    report = _events_tree(tmp_path, "| `FixtureGood` | `FixtureGhost` |", """
+        from karmada_tpu.utils import events as ev
+
+        def go(recorder, rb):
+            # vet: ignore[event-reasons] fixture exercising the waiver channel
+            recorder.event(rb, ev.TYPE_NORMAL, "Literal", "m")
+    """)
+    assert [f for f in report.findings if f.rule == "event-reasons"] == []
+    assert any(w.rule == "event-reasons" for w in report.waivers)
+    # partial scan (no obs/events.py in view): the doc-parity leg must
+    # not run, only call-site findings
+    (tmp_path / "solo.py").write_text(_tw.dedent("""
+        def go(recorder, rb):
+            recorder.event(rb, "Normal", "Literal", "m")
+    """))
+    solo = run_vet([str(tmp_path / "solo.py")])
+    assert all("catalogued" not in f.message for f in solo.findings
+               if f.rule == "event-reasons")
+    assert any(f.rule == "event-reasons" for f in solo.findings)

@@ -167,6 +167,20 @@ def main() -> int:
                           "admission": soak.get("admission"),
                           "overload": soak.get(
                               "starvation", {}).get("overload_entered")})
+                led = ((detail.get("soak") or {}).get("events")
+                       or (detail.get("chaos") or {}).get("events")
+                       or (detail.get("rebalance") or {}).get("events"))
+                if led:
+                    # lifecycle-ledger pass-through (obs/events): the
+                    # run's event-rate / coalesce summary as a
+                    # structured line, same contract as soak/slo
+                    jlog({"event": "ledger",
+                          "ts": round(time.time(), 3),
+                          "recorded": led.get("recorded"),
+                          "events_per_s": led.get("events_per_s"),
+                          "coalesce_ratio": led.get("coalesce_ratio"),
+                          "evicted": led.get("evicted"),
+                          "by_reason": led.get("by_reason")})
                 slo_v = (detail.get("slo")
                          or (detail.get("soak") or {}).get("slo")
                          or ((detail.get("chaos") or {}).get("slo"))
