@@ -24,7 +24,7 @@ import subprocess
 import sys
 import time
 
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -2111,6 +2111,301 @@ def run_rebalance(args) -> int:
     return 0 if not violations else 1
 
 
+def build_megafleet(rng: random.Random, n_clusters: int, n_regions: int):
+    """The million-user fleet shape: `n_clusters` clusters round-robined
+    into `n_regions` regions, and ONE shared Divided+DynamicWeight
+    placement per region whose cluster affinity names exactly that
+    region's clusters — per-tenant eligible sets a shortlist k covers
+    (each tenant's traffic stays inside its region, the reference's own
+    hierarchy: group selection before per-cluster division)."""
+    clusters = build_fleet(rng, n_clusters)
+    for i, c in enumerate(clusters):
+        c.spec.region = f"r{i % n_regions}"
+    by_region: Dict[str, List[str]] = {}
+    for c in clusters:
+        by_region.setdefault(c.spec.region, []).append(c.metadata.name)
+    placements = []
+    for r in sorted(by_region, key=lambda s: int(s[1:])):
+        placements.append(Placement(
+            cluster_affinity=ClusterAffinity(cluster_names=by_region[r]),
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS)),
+        ))
+    return clusters, placements
+
+
+def build_mega_bindings(rng: random.Random, n: int, placements,
+                        block: int) -> list:
+    """`n` bindings whose placement group advances every `block`
+    bindings (tenant-clustered arrival order: a chunk's bindings mostly
+    share a region, which is what keeps the candidate union narrow —
+    real queues batch per tenant burst, not round-robin across every
+    tenant)."""
+    # shared requirement objects (9 classes): a million specs must not
+    # carry a million Quantity dicts, and the encoder's request-class
+    # dedup hits the same Q rows either way
+    reqs = [
+        ReplicaRequirements(resource_request={
+            "cpu": Quantity.from_milli(cpu),
+            "memory": Quantity.from_units(mem),
+        })
+        for cpu in (100, 250, 500) for mem in (1, 2, 4)
+    ]
+    status = ResourceBindingStatus()
+    items = []
+    for b in range(n):
+        pl = placements[(b // max(block, 1)) % len(placements)]
+        spec = ResourceBindingSpec(
+            resource=ObjectReference(
+                api_version=GVK[0], kind=GVK[1], namespace=f"ns-{b % 64}",
+                name=f"mega-{b}", uid=f"uid-mega-{b}"),
+            # ~2 replicas/binding keeps a 1M-binding fleet inside the
+            # 10k clusters' ~1.8M free-pod envelope (demand ~ capacity;
+            # the tail that does not fit prices as real contention)
+            replicas=rng.choice([1, 2, 3]),
+            replica_requirements=reqs[rng.randrange(len(reqs))],
+            placement=pl,
+        )
+        items.append((spec, status))
+    return items
+
+
+def _targets_of(res) -> list:
+    if isinstance(res, Exception):
+        return []
+    return [(t.name, t.replicas) for t in res]
+
+
+def run_megafleet(args) -> int:
+    """bench --megafleet: the hierarchical two-tier solve acceptance
+    payload (ops/shortlist).  Runs the device-path code on XLA:CPU
+    (forced before backend init — never blocks on the tunnel, like
+    --delta).  Four legs:
+
+      real      N bindings x C clusters through the pipelined executor
+                with the shortlist armed — throughput, per-chunk cell
+                work (B*C' solved vs B*C dense-equivalent), fallback and
+                widen counters, peak device/host memory (obs/devprof).
+      recall    a sampled slice solved BOTH ways: shortlisted placements
+                asserted bit-exact against the dense control, and
+                shortlist recall (dense-chosen clusters present in the
+                candidate set) reported.
+      soak      the loadgen `megafleet` scenario compressed on the
+                virtual clock (device backend, shortlist armed end to
+                end through serve's real queue/batch machinery) — zero
+                fallbacks asserted.
+      project   the 1M x 10k virtual-clock extrapolation from the real
+                leg's measured per-binding cost.
+
+    Exit 1 on parity mismatch, recall < 0.999, cell-work reduction
+    < 50x, or any shortlist fallback in the soak."""
+    import resource
+
+    force_cpu_fallback()
+    from karmada_tpu.obs import devprof
+    from karmada_tpu.ops import shortlist as sl_mod
+
+    rng = random.Random(20260804)
+    n_clusters = args.megafleet_clusters
+    n_regions = args.megafleet_regions
+    n_bindings = args.megafleet_bindings
+    k = args.megafleet_k
+    chunk = args.chunk
+    _hb(f"megafleet: building {n_clusters} clusters in {n_regions} "
+        f"regions, {n_bindings} bindings")
+    clusters, placements = build_megafleet(rng, n_clusters, n_regions)
+    items = build_mega_bindings(rng, n_bindings, placements, block=chunk)
+    cindex = tensors.ClusterIndex.build(clusters)
+    estimator = GeneralEstimator()
+    cfg = sl_mod.ShortlistConfig(k=k, min_cells=0)
+
+    # -- recall + parity leg (sampled dense comparison slice) ---------------
+    sample_n = min(args.megafleet_sample, n_bindings)
+    sample = items[:sample_n]
+    _hb(f"megafleet: dense-vs-shortlist parity over {sample_n} sampled "
+        "bindings")
+    from karmada_tpu.scheduler import pipeline as sched_pipeline
+
+    def run_slice(shortlist_cfg):
+        cache = tensors.EncoderCache()
+        return sched_pipeline.run_pipeline(
+            sample, cindex, estimator, chunk=chunk, waves=args.waves,
+            cache=cache, carry=True, carry_spread=True,
+            shortlist=shortlist_cfg, diagnose=False)
+
+    dense_res = run_slice(None)
+    sl_res = run_slice(cfg)
+    mismatches = sum(
+        1 for i in dense_res.results
+        if _targets_of(dense_res.results[i]) != _targets_of(
+            sl_res.results.get(i))
+        or isinstance(dense_res.results[i], Exception)
+        != isinstance(sl_res.results.get(i), Exception))
+    # recall: dense-chosen clusters present in the tier-1 candidate set
+    batch = tensors.encode_batch(sample, cindex, estimator)
+    cand_sets = sl_mod.binding_candidates(batch, k)
+    names_idx = {n: i for i, n in enumerate(cindex.names)}
+    chosen = hit = 0
+    for i, res in dense_res.results.items():
+        cset = cand_sets[i]
+        for name, _rep in _targets_of(res):
+            chosen += 1
+            hit += 1 if names_idx[name] in cset else 0
+    recall = (hit / chosen) if chosen else 1.0
+
+    # -- real throughput leg ------------------------------------------------
+    cells0 = {t: 0.0 for t in ("solve", "dense_equiv")}
+    for t in cells0:
+        cells0[t] = sl_mod.SHORTLIST_CELLS.value(tier=t)
+    disp0 = sl_mod.SHORTLIST_DISPATCHES.value()
+    fb0 = sl_mod.SHORTLIST_FALLBACKS.total()
+    w0 = sl_mod.SHORTLIST_WIDENINGS.value()
+    _hb(f"megafleet: real leg ({n_bindings} bindings x {n_clusters} "
+        f"clusters, chunk {chunk}, k={k})")
+    elapsed, solve_s, scheduled, chunk_lat, chunk_wall, failures = (
+        run_megafleet_pipeline(items, cindex, estimator, chunk,
+                               args.waves, cfg))
+    devprof.refresh_memory_gauges()
+    cells_solve = sl_mod.SHORTLIST_CELLS.value(tier="solve") - cells0["solve"]
+    cells_dense = (sl_mod.SHORTLIST_CELLS.value(tier="dense_equiv")
+                   - cells0["dense_equiv"])
+    reduction = (cells_dense / cells_solve) if cells_solve else 0.0
+    # processed = every binding the two-tier solve priced (unschedulable
+    # rows pay the full pipeline too); scheduled is the success subset
+    bps = n_bindings / elapsed if elapsed > 0 else 0.0
+    real = {
+        "bindings": n_bindings, "clusters": n_clusters,
+        "regions": n_regions, "k": k, "chunk": chunk,
+        "scheduled": scheduled, "failures": failures,
+        "wall_s": round(elapsed, 3),
+        "processed_per_s": round(bps, 1),
+        "scheduled_per_s": round(scheduled / elapsed, 1)
+        if elapsed > 0 else 0.0,
+        "chunks": len(chunk_lat),
+        "chunk_own_mean_s": round(float(np.mean(chunk_lat)), 4)
+        if chunk_lat else None,
+        "cells_solved": int(cells_solve),
+        "cells_dense_equiv": int(cells_dense),
+        "cell_reduction_x": round(reduction, 1),
+        "shortlist_dispatches": int(
+            sl_mod.SHORTLIST_DISPATCHES.value() - disp0),
+        "shortlist_fallbacks": int(sl_mod.SHORTLIST_FALLBACKS.total() - fb0),
+        "widenings": int(sl_mod.SHORTLIST_WIDENINGS.value() - w0),
+    }
+
+    # -- compressed virtual-clock soak (serve path end to end) --------------
+    from karmada_tpu.loadgen import (
+        LoadDriver, ServeSlice, VirtualClock, get_scenario,
+    )
+
+    scenario = get_scenario("megafleet")
+    _hb(f"megafleet: compressed {scenario.name} soak (device backend, "
+        f"shortlist k={scenario.shortlist_k})")
+    model = ServiceModel_for_soak()
+    clock = VirtualClock()
+    plane = ServeSlice(scenario, clock, model, backend="device")
+    fb_soak0 = sl_mod.SHORTLIST_FALLBACKS.total()
+    disp_soak0 = sl_mod.SHORTLIST_DISPATCHES.value()
+    driver = LoadDriver(plane, scenario, clock=clock, model=model,
+                        seed=args.soak_seed)
+    soak_payload = driver.run()
+    soak = {
+        "injected": soak_payload.get("injected"),
+        "scheduled": soak_payload.get("scheduled"),
+        "shortlist_dispatches": int(
+            sl_mod.SHORTLIST_DISPATCHES.value() - disp_soak0),
+        "shortlist_fallbacks": int(
+            sl_mod.SHORTLIST_FALLBACKS.total() - fb_soak0),
+        "virtual_duration_s": soak_payload.get("duration_s"),
+    }
+
+    # -- 1M x 10k virtual-clock projection ----------------------------------
+    target_b, target_c = 1_000_000, max(n_clusters, 10_000)
+    per_binding_s = (elapsed / n_bindings) if n_bindings else float("inf")
+    projected_s = target_b * per_binding_s
+    project = {
+        "target_bindings": target_b, "target_clusters": target_c,
+        "per_binding_ms": round(per_binding_s * 1e3, 4),
+        "projected_wall_s": round(projected_s, 1),
+        "within_one_hour": bool(projected_s < 3600),
+        "dense_cells": target_b * target_c,
+        "two_tier_cells": target_b * k,
+        "cell_reduction_x": round(target_c / k, 1),
+    }
+
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    payload_detail = {
+        "real": real,
+        "recall": {"sample": sample_n, "parity_mismatches": mismatches,
+                   "recall": round(recall, 6), "chosen": chosen},
+        "soak": soak,
+        "project": project,
+        "memory": {
+            "devices": devprof.memory_stats_payload(),
+            "peak_rss_bytes": int(ru.ru_maxrss) * 1024,
+        },
+        "shortlist_state": sl_mod.state_payload(),
+    }
+    ok = (mismatches == 0 and recall >= 0.999 and reduction >= 50.0
+          and soak["shortlist_fallbacks"] == 0
+          and soak["shortlist_dispatches"] > 0)
+    os.makedirs(args.ckpt_dir, exist_ok=True)
+    out_path = os.path.join(args.ckpt_dir, "megafleet.json")
+    with open(out_path, "w") as f:
+        json.dump(payload_detail, f, indent=2)
+    print(json.dumps({
+        "metric": f"megafleet two-tier solve ({n_bindings}x{n_clusters}, "
+                  f"k={k}): cell work vs dense",
+        "value": round(reduction, 1),
+        "unit": "x reduction",
+        "vs_baseline": round(reduction, 1),
+        "detail": {**payload_detail, "megafleet_path": out_path,
+                   "ok": ok},
+    }))
+    return 0 if ok else 1
+
+
+def ServiceModel_for_soak():
+    """Fixed service model for the megafleet soak — determinism over
+    calibrated throughput, exactly like --chaos / --rebalance."""
+    from karmada_tpu.loadgen import ServiceModel
+
+    return ServiceModel()
+
+
+def run_megafleet_pipeline(items, cindex, estimator, chunk, waves, cfg):
+    """run_batched's aggregates with the shortlist armed (collect off —
+    a megafleet run must not hold a million result lists)."""
+    from karmada_tpu.scheduler import pipeline as sched_pipeline
+
+    scheduled = 0
+    failures: Dict[str, int] = {}
+    solve_s = 0.0
+    chunk_lat, chunk_wall = [], []
+
+    def on_chunk(st) -> None:
+        nonlocal scheduled, solve_s
+        scheduled += st.n_ok
+        for kk, v in st.failures.items():
+            failures[kk] = failures.get(kk, 0) + v
+        chunk_lat.append(st.own_s)
+        chunk_wall.append(st.wall_s)
+        solve_s += st.solve_s
+        _hb(f"megafleet chunk {st.index + 1} finalized ({st.n} bindings)")
+
+    cache = tensors.EncoderCache()
+    t0 = time.perf_counter()
+    sched_pipeline.run_pipeline(
+        items, cindex, estimator, chunk=chunk, waves=waves, cache=cache,
+        carry=True, carry_spread=True, on_chunk=on_chunk,
+        collect=False, diagnose=False, shortlist=cfg)
+    return (time.perf_counter() - t0, solve_s, scheduled, chunk_lat,
+            chunk_wall, failures)
+
+
 def _synth_coo(batch, err_every: int = 97):
     """A realistic decode workload without paying a 5000-cluster XLA:CPU
     solve: per ROUTE_DEVICE row, Duplicated placements emit one entry per
@@ -2419,6 +2714,26 @@ def main() -> None:
                          "REBALANCE_r*.json payload.  Exit 1 on any "
                          "conservation violation, non-convergence, or "
                          "parity mismatch.")
+    ap.add_argument("--megafleet", action="store_true",
+                    help="megafleet acceptance mode (ops/shortlist): the "
+                         "hierarchical two-tier solve at fleet scale — "
+                         "real throughput + cell-work reduction with the "
+                         "shortlist armed, sampled dense-parity + recall, "
+                         "the compressed loadgen megafleet scenario on "
+                         "the virtual clock (device backend end to end), "
+                         "and the 1Mx10k projection; emits "
+                         "MEGAFLEET_r*.json.  XLA:CPU, never blocks on "
+                         "the tunnel.  Exit 1 on parity/recall/"
+                         "reduction/fallback gate misses")
+    ap.add_argument("--megafleet-bindings", type=int, default=16384,
+                    help="real-leg binding count (the 1M claim rides the "
+                         "virtual-clock projection from this measured leg)")
+    ap.add_argument("--megafleet-clusters", type=int, default=10000)
+    ap.add_argument("--megafleet-regions", type=int, default=200)
+    ap.add_argument("--megafleet-k", type=int, default=64,
+                    help="tier-1 candidate lanes per binding")
+    ap.add_argument("--megafleet-sample", type=int, default=2048,
+                    help="dense-comparison slice for parity + recall")
     ap.add_argument("--mesh", nargs="?", const="auto", default=None,
                     help="mesh bench mode: run the SAME workload through "
                          "the pipelined executor single-device and sharded "
@@ -2539,6 +2854,11 @@ def main() -> None:
         # the parity control never touch the device tunnel
         _HB_ON = True
         raise SystemExit(run_rebalance(args))
+    if args.megafleet:
+        # megafleet mode is self-contained: XLA:CPU forced before backend
+        # init (the mode validates the two-tier solve, never the tunnel)
+        _HB_ON = True
+        raise SystemExit(run_megafleet(args))
     if args.delta:
         # delta mode is host-only and self-contained: the resident plane's
         # device-path code runs byte-identical on XLA:CPU (forced before

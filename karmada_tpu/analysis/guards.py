@@ -43,6 +43,15 @@ def _dims_of(batch) -> dict:
     return {"B": batch.B, "C": batch.C}
 
 
+#: FIELD_DTYPES entries that may legitimately be absent/None on a batch:
+#: the shortlist kernel's OUTPUT planes (ops/shortlist — typed in the
+#: table for the dtype-contract pass, never SolverBatch attributes) and
+#: the sub-vocabulary lane map (dense batches carry none; when present
+#: on a shortlisted sub-batch it is checked like any other field)
+_OPTIONAL_FIELDS = frozenset(
+    {"shortlist_idx", "shortlist_fcount", "sub_lanes"})
+
+
 def check_batch(batch, where: str = "solver-entry") -> None:
     """Validate a SolverBatch against the canonical per-field dtype table
     (tensors.FIELD_DTYPES) and axis table (tensors.FIELD_AXES): dtype
@@ -54,6 +63,8 @@ def check_batch(batch, where: str = "solver-entry") -> None:
     for field_name, want in FIELD_DTYPES.items():
         arr = getattr(batch, field_name, None)
         if arr is None:
+            if field_name in _OPTIONAL_FIELDS:
+                continue
             raise InvariantViolation(
                 f"[{where}] SolverBatch.{field_name} is None")
         arr = np.asarray(arr)

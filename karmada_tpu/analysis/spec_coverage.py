@@ -36,6 +36,15 @@ store) adds a third drift class this pass closes:
     also the solver's in-sharding table — one table, so the fused
     chain's in/out shardings cannot drift apart; this check makes the
     table-totality explicit.
+
+The shortlist plane (ops/shortlist) adds a fourth drift class, the same
+shape as the gather's: every tier-1 kernel output
+(``SHORTLIST_OUT_FIELDS``) must have BOTH a ``shard_specs`` entry (the
+kernel pins its out-shardings from the table the tier-2 dispatch places
+its in-shardings with) and an ``ops/tensors.FIELD_DTYPES`` entry (the
+armed runtime guards and the dtype-contract pass read the same table) —
+a field added to the kernel without either would be placed or typed by
+accident.
 """
 
 from __future__ import annotations
@@ -131,15 +140,45 @@ def run(files: Sequence[SourceFile]) -> List[Finding]:
                         "RESIDENT_HOST_ONLY) — a mesh dispatch would "
                         "place it by accident",
             ))
+    # shortlist kernel outputs (ops/shortlist.SHORTLIST_OUT_FIELDS):
+    # legitimate spec keys that are not SolverBatch fields — collected
+    # before the stale-key sweep so they are exempt from it, then
+    # checked for their own two-table coverage below
+    shortlist_fields: Set[str] = set()
+    shortlist_file = None
+    field_dtypes: Set[str] = set()
+    for sf in files:
+        s = _const_strings(sf.tree, "SHORTLIST_OUT_FIELDS")
+        if s and shortlist_file is None:
+            shortlist_fields, shortlist_file = s, sf
+        d = _const_strings(sf.tree, "FIELD_DTYPES")
+        if d and not field_dtypes:
+            field_dtypes = d
     if "SolverBatch" in classes:
         # stale-key drift is judged against SolverBatch only: the resident
         # plane's fields are a subset of the batch vocabulary by design
-        for k in sorted(keys - classes["SolverBatch"][2]):
+        for k in sorted(keys - classes["SolverBatch"][2] - shortlist_fields):
             findings.append(Finding(
                 rule="spec-coverage", file=specs_file.path, line=specs_line,
                 message=f"shard_specs entry `{k}` names no SolverBatch "
                         "field — stale key",
             ))
+    if shortlist_file is not None:
+        for f in sorted(shortlist_fields - keys):
+            findings.append(Finding(
+                rule="spec-coverage", file=shortlist_file.path, line=1,
+                message=f"shortlist kernel output `{f}` has no "
+                        "shard_specs entry — its out-sharding cannot "
+                        "chain into the tier-2 solver's in-sharding",
+            ))
+        if field_dtypes:
+            for f in sorted(shortlist_fields - field_dtypes):
+                findings.append(Finding(
+                    rule="spec-coverage", file=shortlist_file.path, line=1,
+                    message=f"shortlist kernel output `{f}` has no "
+                            "ops/tensors.FIELD_DTYPES entry — the dtype "
+                            "contract would not cover it",
+                ))
     # -- fused gather path: slot store x gather kernel x spec table ----------
     slot_fields: Set[str] = set()
     slot_file = None

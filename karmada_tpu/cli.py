@@ -48,7 +48,8 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                 chaos: Optional[str] = None,
                 chaos_seed: int = 0,
                 aot_cache: str = "off",
-                rebalance: Optional[float] = None):
+                rebalance: Optional[float] = None,
+                shortlist_k: Optional[int] = None):
     """controllers=None rehydrates the persisted --controllers spec; an
     explicit spec is also persisted so later invocations honor it.
 
@@ -100,7 +101,8 @@ def _load_plane(directory: str, backend: str = "serial", waves: int = 8,
                       resident_fused=resident_fused,
                       device_recover_cycles=device_recover_cycles,
                       chaos=chaos, chaos_seed=chaos_seed,
-                      rebalance=rebalance)
+                      rebalance=rebalance,
+                      shortlist_k=shortlist_k)
     if controllers is not None:
         cp.apply({"apiVersion": "v1", "kind": "ConfigMap",
                   "metadata": {"namespace": "karmada-system",
@@ -1275,6 +1277,18 @@ def cmd_serve(args) -> int:
             print(f"--explain rate must be in (0, 1], got {explain_rate}",
                   file=sys.stderr)
             return 1
+    shortlist_k = None
+    if args.shortlist:
+        try:
+            shortlist_k = int(args.shortlist)
+        except ValueError:
+            print(f"--shortlist k must be an integer, got "
+                  f"{args.shortlist!r}", file=sys.stderr)
+            return 1
+        if shortlist_k <= 0:
+            print(f"--shortlist k must be positive, got {shortlist_k}",
+                  file=sys.stderr)
+            return 1
     rebalance_interval = None
     if args.rebalance is not None:
         try:
@@ -1332,7 +1346,8 @@ def cmd_serve(args) -> int:
                          chaos=args.chaos or None,
                          chaos_seed=args.chaos_seed,
                          aot_cache=args.aot_cache,
-                         rebalance=rebalance_interval)
+                         rebalance=rebalance_interval,
+                         shortlist_k=shortlist_k)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 1
@@ -1350,7 +1365,8 @@ def cmd_serve(args) -> int:
                                           sched.pipeline_chunk)
         warm_variants = aot_mod.variants_for(
             sched.explain, sched.batch_window > sched.pipeline_chunk,
-            fused=getattr(sched, "resident_fused", False))
+            fused=getattr(sched, "resident_fused", False),
+            shortlist=bool(getattr(sched, "shortlist_k", None)))
         resident_cap = None
         if getattr(sched, "resident_fused", False):
             # the fused gather's jit signature includes the slot-store
@@ -1368,7 +1384,8 @@ def cmd_serve(args) -> int:
             lambda: list(cp.store.list(_Cluster.KIND)), sched._general,
             shapes=warm_shapes, variants=warm_variants, waves=sched.waves,
             keep_sel=sched.enable_empty_workload_propagation,
-            resident_cap=resident_cap)
+            resident_cap=resident_cap,
+            shortlist_k=getattr(sched, "shortlist_k", None))
         aot_state = aot_mod.state_payload()
         if aot_state["armed"]:
             print(f"AOT executable plane armed: persistent compile cache "
@@ -1408,6 +1425,23 @@ def cmd_serve(args) -> int:
     elif args.resident_fused:
         print("WARNING: --resident-fused requires --resident; the fused "
               "gather path is not armed", file=sys.stderr)
+    if shortlist_k is not None:
+        if cp.scheduler.shortlist_k:
+            print(f"shortlist plane armed (k={shortlist_k}): chunks at/"
+                  f"above {cp.scheduler.shortlist_min_cells} dense cells "
+                  "run the two-tier solve (tier-1 candidate kernel -> "
+                  "dense solver over the candidate union); fallbacks are "
+                  "counted in karmada_shortlist_fallbacks_total; state "
+                  "in /debug/state shortlist section")
+        elif args.resident_fused and args.resident:
+            print("WARNING: --shortlist is incompatible with "
+                  "--resident-fused (the device slot store owns the "
+                  "binding rows); the shortlist plane is not armed",
+                  file=sys.stderr)
+        else:
+            print(f"WARNING: --shortlist needs the device backend "
+                  f"(running backend={cp.scheduler.backend}); the "
+                  "shortlist plane is not armed", file=sys.stderr)
     if explain_rate > 0:
         if args.metrics_port >= 0:
             pct = f"{explain_rate:.0%}" if explain_rate < 1 else "every"
@@ -2368,6 +2402,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "re-encodes from scratch and compares bit-exact "
                          "against the resident tensors (mismatch = "
                          "metric + forced rebuild; 0 disables)")
+    sv.add_argument("--shortlist", nargs="?", const="64", default="",
+                    metavar="K",
+                    help="arm the hierarchical two-tier solve "
+                         "(ops/shortlist): chunks above the cell "
+                         "threshold run a cheap device-side candidate "
+                         "kernel (top-K cluster lanes per binding, "
+                         "default K=64) and dispatch the dense solver "
+                         "over the candidate union — B*K cells instead "
+                         "of B*C, bit-exact when every binding's "
+                         "eligible set fits K, loud dense fallback "
+                         "otherwise (karmada_shortlist_fallbacks_total)")
     sv.add_argument("--rebalance", nargs="?", const="30", default=None,
                     metavar="INTERVAL",
                     help="arm the rebalance plane (karmada_tpu/rebalance): "

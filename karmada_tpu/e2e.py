@@ -117,6 +117,11 @@ class ControlPlane:
         # is), both evictors share ONE per-cluster pacing budget.
         rebalance: Optional[float] = None,
         rebalance_cfg=None,  # rebalance.RebalanceConfig override
+        # hierarchical two-tier solve (ops/shortlist, serve --shortlist):
+        # top-k candidate lanes per binding; None/0 keeps every chunk
+        # on the full dense dispatch
+        shortlist_k: Optional[int] = None,
+        shortlist_min_cells: int = 1 << 21,
     ) -> None:
         self.clock = clock if clock is not None else time.time
         from karmada_tpu.utils.events import EventRecorder
@@ -195,6 +200,8 @@ class ControlPlane:
                                    device_recover_cycles=(
                                        device_recover_cycles),
                                    chaos=chaos, chaos_seed=chaos_seed,
+                                   shortlist_k=shortlist_k,
+                                   shortlist_min_cells=shortlist_min_cells,
                                    rebalance=rebalance,
                                    rebalance_cfg=rebalance_cfg,
                                    rebalance_budget=(

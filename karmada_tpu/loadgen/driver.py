@@ -41,6 +41,7 @@ from karmada_tpu.models.cluster import (
 )
 from karmada_tpu.models.meta import ObjectMeta
 from karmada_tpu.models.policy import (
+    ClusterAffinity,
     Placement,
     PropagationPolicy,
     PropagationSpec,
@@ -131,10 +132,10 @@ class ServiceModel:
 
 
 def build_cluster(name: str, cpu_milli: int = 64_000, memory_gi: int = 256,
-                  pods: int = 1000) -> Cluster:
+                  pods: int = 1000, region: str = "") -> Cluster:
     return Cluster(
         metadata=ObjectMeta(name=name),
-        spec=ClusterSpec(),
+        spec=ClusterSpec(region=region or None),
         status=ClusterStatus(
             api_enablements=[APIEnablement("apps/v1", ["Deployment"])],
             resource_summary=ResourceSummary(
@@ -161,14 +162,17 @@ def build_binding(name: str, priority: int = 0,
                   namespace: str = LOADGEN_NS,
                   resource_name: Optional[str] = None,
                   replicas: int = 1,
-                  divided: bool = False) -> ResourceBinding:
+                  divided: bool = False,
+                  affinity: Optional[List[str]] = None) -> ResourceBinding:
     """A synthetic binding: Duplicated placement over every feasible
     cluster (no affinity restriction), so cluster kills force real
     rescheduling work — or, with `divided`, Divided+Aggregated packing
     of `replicas` into the fewest clusters (the rebalance plane's
     drainable shape).  `resource_name` points every binding at one
     shared template (full-ControlPlane runs, where the binding
-    controller renders real Works from it)."""
+    controller renders real Works from it).  `affinity` restricts the
+    placement to the named clusters (the megafleet shape: per-tenant
+    eligible sets a shortlist k covers)."""
     rb = ResourceBinding()
     rb.metadata.namespace = namespace
     rb.metadata.name = name
@@ -178,7 +182,10 @@ def build_binding(name: str, priority: int = 0,
                                  name=resource_name or name,
                                  uid=f"uid-{name}"),
         replicas=replicas,
-        placement=Placement(replica_scheduling=_scheduling_strategy(divided)),
+        placement=Placement(
+            cluster_affinity=(ClusterAffinity(cluster_names=list(affinity))
+                              if affinity else None),
+            replica_scheduling=_scheduling_strategy(divided)),
         schedule_priority=priority or None,
     )
     return rb
@@ -261,13 +268,15 @@ def warm_device_path(plane, sizes: Tuple[int, ...] = (2, 9, 17, 64),
                 v for v in aotcache.variants_for(
                     sched.explain,
                     sched.batch_window > sched.pipeline_chunk,
-                    fused=getattr(sched, "resident_fused", False))
+                    fused=getattr(sched, "resident_fused", False),
+                    shortlist=bool(getattr(sched, "shortlist_k", None)))
                 if v != aotcache.VARIANT_PLAIN)
             if variants:
                 aotcache.warm_executables(
                     clusters, sched._general,  # noqa: SLF001 — same package
                     shapes=sizes, variants=variants, waves=sched.waves,
-                    keep_sel=sched.enable_empty_workload_propagation)
+                    keep_sel=sched.enable_empty_workload_propagation,
+                    shortlist_k=getattr(sched, "shortlist_k", None))
     finally:
         sched.device_cycle_timeout_s = prev
 
@@ -358,6 +367,11 @@ class ServeSlice:
             rebalance=(reb_interval or None),
             rebalance_cfg=reb_cfg,
             rebalance_budget=reb_budget,
+            # scenario-driven shortlist tier (ops/shortlist): compressed
+            # scales must still arm, so the cell threshold drops to 0 —
+            # the scenario IS the operator's explicit opt-in
+            shortlist_k=(scenario.shortlist_k or None),
+            shortlist_min_cells=0,
         )
         if scenario.policy_path:
             from karmada_tpu.controllers.detector import ResourceDetector
@@ -379,7 +393,12 @@ class ServeSlice:
                 self.store, self.runtime, grace_period_s=1e9, clock=clock)
             self.status_echo = ReplacementStatusEcho(self.store)
         for i in range(scenario.n_clusters):
-            self.store.create(build_cluster(f"lg-m{i}"))
+            # group-affine fleets (scenario.n_regions > 0): clusters
+            # round-robin into regions; megafleet bindings target one
+            # region each via cluster affinity
+            region = (f"lg-r{i % scenario.n_regions}"
+                      if scenario.n_regions > 0 else "")
+            self.store.create(build_cluster(f"lg-m{i}", region=region))
 
 
 @dataclass
@@ -679,13 +698,38 @@ class LoadDriver:
         prio = (PRIORITY_HIGH
                 if self.rng.random() < self.scenario.priority_high_frac
                 else 0)
+        affinity = None
+        if self.scenario.n_regions > 0:
+            # tenant-clustered arrival: the targeted region advances per
+            # batch_window block, not per binding — real traffic arrives
+            # in per-tenant bursts, and it is exactly this locality that
+            # keeps a chunk's candidate union narrow under the shortlist
+            affinity = self._region_names(
+                (self._n_injected // max(self.scenario.batch_window, 1))
+                % self.scenario.n_regions)
         with self._lock:
             self._flight[(LOADGEN_NS, name)] = _Flight(t_inject=t,
                                                        priority=prio)
         self.plane.store.create(build_binding(
             name, priority=prio, resource_name=self.resource_name,
             replicas=self.scenario.binding_replicas,
-            divided=self.scenario.binding_style == "divided"))
+            divided=self.scenario.binding_style == "divided",
+            affinity=affinity))
+
+    def _region_names(self, group: int) -> List[str]:
+        """Cluster names of one region group (group-affine scenarios),
+        derived once from the live store so any plane shape works."""
+        cached = getattr(self, "_region_name_cache", None)
+        if cached is None:
+            cached = {}
+            for c in self.plane.store.list(Cluster.KIND):
+                r = c.spec.region
+                if r:
+                    cached.setdefault(r, []).append(c.metadata.name)
+            self._region_name_cache = cached
+        key = f"lg-r{group}"
+        return cached.get(key) or sorted(
+            n for names in cached.values() for n in names) or None
 
     def _apply_cluster_event(self, spec) -> None:
         if spec.kind in ("chaos", "chaos_clear"):

@@ -83,6 +83,16 @@ class Scenario:
     # rebalance plane: cycle interval in full-batch service times
     # (model.cost(batch_window)); 0 leaves the plane disarmed
     rebalance_interval_cycles: float = 0.0
+    # shortlist tier (ops/shortlist): top-k candidate lanes per binding
+    # for the hierarchical two-tier solve; 0 keeps every chunk dense.
+    # Device-backend slices only (the host backends never build
+    # SolverBatches); the slice arms it with min_cells=0 so compressed
+    # scales exercise the exact production tier-selection path
+    shortlist_k: int = 0
+    # group-affine fleet: clusters carry a region in `n_regions` groups
+    # and each binding's placement targets ONE group — the million-user
+    # shape (per-tenant affinity) whose eligible sets fit k
+    n_regions: int = 0
 
     @property
     def chaotic(self) -> bool:
@@ -263,6 +273,31 @@ SCENARIOS: Dict[str, Scenario] = {s.name: s for s in (
             ClusterEventSpec(at_frac=0.75, kind="chaos",
                              spec="rebalance.plan:skip#1"),
         ),
+    ),
+    # million-binding shape at compressed scale: a group-affine fleet
+    # (each binding's affinity targets one region, so eligible sets fit
+    # the shortlist k) under the hierarchical two-tier solve — the
+    # production tier-selection path end-to-end on the virtual clock.
+    # Device-backend slices only (bench --megafleet and the shortlist
+    # soak test drive it with backend="device").
+    Scenario(
+        name="megafleet",
+        description="group-affine fleet under the two-tier shortlist "
+                    "solve: per-region affinity bindings, steady Poisson",
+        n_bindings=320, load_factor=0.5, deadline_cycles=6.0,
+        n_clusters=48, n_regions=8, shortlist_k=8,
+        binding_style="divided", binding_replicas=3,
+        batch_window=64,
+    ),
+    Scenario(
+        name="megafleet-heavy",
+        description="group-affine two-tier solve at production-shaped "
+                    "counts",
+        n_bindings=20000, load_factor=0.6, deadline_cycles=4.0,
+        n_clusters=512, n_regions=32, shortlist_k=32,
+        binding_style="divided", binding_replicas=5,
+        batch_window=512,
+        slow=True,
     ),
     # heavy variants: same shapes, production-shaped counts; marked slow
     # (bench --soak and the opt-in slow tests run them)

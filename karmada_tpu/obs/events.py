@@ -93,6 +93,8 @@ REASON_HPA_FAST_PATH = "HpaFastPathPush"
 # chaos plane (karmada_tpu/chaos)
 REASON_CHAOS_FAULT_INJECTED = "ChaosFaultInjected"
 
+REASON_SHORTLIST_FALLBACK = "ShortlistFallback"
+
 EVENTS_TOTAL = REGISTRY.counter(
     "karmada_events_total",
     "Lifecycle-ledger events recorded (coalesced repeats count each "

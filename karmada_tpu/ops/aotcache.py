@@ -56,6 +56,12 @@ VARIANT_DONATED = "donated"
 #: must be warm per pow2 batch shape or the first fused cycle mid-soak
 #: eats a silent compile
 VARIANT_FUSED = "fused"
+#: the shortlist tier (ops/shortlist): TWO executables per shape — the
+#: tier-1 candidate kernel at (B, C, k), and the tier-2 [B, C'] solver
+#: over the sub-vocabulary width the first shortlisted chunks will
+#: dispatch.  Without both the first shortlisted cycle mid-soak eats a
+#: silent compile exactly like the fused/explain variants used to.
+VARIANT_SHORTLIST = "shortlist"
 ALL_VARIANTS = (VARIANT_PLAIN, VARIANT_EXPLAIN, VARIANT_CARRY,
                 VARIANT_DONATED)
 
@@ -311,14 +317,16 @@ def warm_shapes(batch_window: int, pipeline_chunk: int) -> Tuple[int, ...]:
 
 
 def variants_for(explain_rate: float, multi_chunk: bool,
-                 fused: bool = False) -> Tuple[str, ...]:
+                 fused: bool = False,
+                 shortlist: bool = False) -> Tuple[str, ...]:
     """The jit-variant set THIS scheduler configuration can actually
     dispatch (warming more would spend background compile time on
     programs that never run): plain always; explain only when the
     explain plane samples; carry + donated only when cycles can span
     multiple chunks (batch_window > pipeline_chunk); the fused
     resident-gather executable only when the fused resident path is
-    armed (Scheduler resident_fused)."""
+    armed (Scheduler resident_fused); the shortlist tier pair only when
+    the two-tier solve is armed (Scheduler shortlist_k)."""
     variants = [VARIANT_PLAIN]
     if explain_rate and explain_rate > 0:
         variants.append(VARIANT_EXPLAIN)
@@ -326,6 +334,8 @@ def variants_for(explain_rate: float, multi_chunk: bool,
         variants += [VARIANT_CARRY, VARIANT_DONATED]
     if fused:
         variants.append(VARIANT_FUSED)
+    if shortlist:
+        variants.append(VARIANT_SHORTLIST)
     return tuple(variants)
 
 
@@ -351,6 +361,7 @@ def warm_executables(
     keep_sel: bool = False,
     cancelled: Optional[threading.Event] = None,
     resident_cap: Optional[int] = None,
+    shortlist_k: Optional[int] = None,
 ) -> Dict[str, object]:
     """AOT pre-compile the compact dispatch for every (pow2 shape x jit
     variant) against THIS cluster fleet via ``.lower().compile()``
@@ -392,6 +403,9 @@ def warm_executables(
                     cap = (int(resident_cap) if resident_cap
                            else _resident_slot_cap())
                     label = f"B{batch.B}xS{cap}:{variant}"
+                elif variant == VARIANT_SHORTLIST:
+                    sk = int(shortlist_k or 64)
+                    label = f"B{batch.B}xC{batch.C}:k{sk}:{variant}"
                 else:
                     label = f"B{batch.B}xC{batch.C}:{variant}"
                 with _LOCK:
@@ -415,6 +429,36 @@ def warm_executables(
                             Kp=batch.prev_idx.shape[1],
                             Ke=batch.evict_idx.shape[1],
                             plan=meshing.active())
+                    elif variant == VARIANT_SHORTLIST:
+                        from karmada_tpu.ops import meshing, shortlist
+                        from karmada_tpu.ops import tensors as _T
+
+                        timings = shortlist.aot_warm(
+                            batch, k=min(sk, batch.C),
+                            plan=meshing.active())
+                        # the tier-2 [B, C'] solver over the most likely
+                        # sub-vocabulary bucket (pow2 ceiling of 2k —
+                        # wider unions re-warm lazily at dispatch):
+                        # encode the synth items against a truncated
+                        # fleet so the warmed aval set IS a sub-shape
+                        sub_n = min(len(cindex.clusters),
+                                    _T._next_pow2(2 * sk, 8))  # noqa: SLF001
+                        sub_cindex = _T.ClusterIndex.build(
+                            cindex.clusters[:sub_n])
+                        sub_batch = _T.encode_batch(
+                            synth_items(n), sub_cindex, estimator,
+                            explain=True)
+                        t2 = solver.aot_warm_compile(
+                            sub_batch, waves=waves, keep_sel=keep_sel,
+                            variant=VARIANT_PLAIN)
+                        timings = dict(timings)
+                        timings["tier2"] = {
+                            "shape": f"B{sub_batch.B}xC{sub_batch.C}",
+                            **t2}
+                        timings["compile_s"] = (timings["compile_s"]
+                                                + t2["compile_s"])
+                        timings["lower_s"] = (timings["lower_s"]
+                                              + t2["lower_s"])
                     else:
                         timings = solver.aot_warm_compile(
                             batch, waves=waves, keep_sel=keep_sel,
@@ -457,6 +501,7 @@ def start_background_warmup(
     waves: int = 8,
     keep_sel: bool = False,
     resident_cap: Optional[int] = None,
+    shortlist_k: Optional[int] = None,
 ) -> threading.Thread:
     """Run warm_executables on a daemon thread (serve: the plane takes
     traffic immediately; warmed shapes stop paying compiles as they
@@ -474,7 +519,8 @@ def start_background_warmup(
                 return
             warm_executables(clusters, estimator, shapes=shapes,
                              variants=variants, waves=waves,
-                             keep_sel=keep_sel, resident_cap=resident_cap)
+                             keep_sel=keep_sel, resident_cap=resident_cap,
+                             shortlist_k=shortlist_k)
             with _LOCK:
                 _STATE["warmup_thread"] = "done"
         # vet: ignore[exception-hygiene] background warm must never kill serve; state kept for /debug/state

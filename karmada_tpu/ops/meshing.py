@@ -67,8 +67,11 @@ BATCH_FIELDS = (
 #: boundary (the spec-coverage vet pass exempts them from shard_specs):
 #: `route` is the host-side routing verdict the encoder leaves behind;
 #: `non_workload_host` is the fused resident-gather path's host decode
-#: companion (the device plane of the same name is what dispatch ships).
-HOST_ONLY_FIELDS = frozenset({"route", "non_workload_host"})
+#: companion (the device plane of the same name is what dispatch ships);
+#: `sub_lanes` is the shortlist plane's host-side sub-vocabulary lane
+#: map (ops/shortlist) — the dispatch ships the GATHERED planes, the
+#: map itself only drives the host-side carry/decode remap.
+HOST_ONLY_FIELDS = frozenset({"route", "non_workload_host", "sub_lanes"})
 
 
 def parse_shape(text) -> Optional[object]:
@@ -154,6 +157,11 @@ def shard_specs() -> Dict[str, object]:
         # explain plane (obs/decisions bit layout): placement-static
         # failure bits shard with the other [P, C] placement rows
         "pl_fail_bits": P(None, AXIS_CLUSTERS),
+        # shortlist plane (ops/shortlist): the tier-1 kernel's outputs
+        # pin to these — candidate lanes ride the binding axis (the
+        # per-binding top-k column axis is tiny, like prev_idx's Kp)
+        "shortlist_idx": P(AXIS_BINDINGS, None),
+        "shortlist_fcount": P(AXIS_BINDINGS),
         # binding axis: data parallel
         "b_valid": P(AXIS_BINDINGS), "placement_id": P(AXIS_BINDINGS),
         "gvk_id": P(AXIS_BINDINGS), "class_id": P(AXIS_BINDINGS),

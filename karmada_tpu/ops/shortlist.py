@@ -1,0 +1,647 @@
+"""Tier-1 candidate shortlist: device-side top-k cluster lanes per binding.
+
+The dense solve is O(B*C): every binding prices every cluster.  At the
+north star's scale (1M+ bindings, 10k+ clusters) that is 10^10 cells —
+out of reach on any hardware in one tier.  The reference control plane
+itself solves hierarchically (PAPER.md §L4: SpreadConstraint group
+selection runs BEFORE per-cluster replica division); this module is that
+hierarchy for the batched path:
+
+  tier 1 (this kernel)   one cheap jitted pass scores every (profile,
+                         cluster) cell with a packed integer key —
+                         feasibility bit, capacity estimate, a COARSE
+                         per-group aggregate rank (built once per cycle
+                         from the resident cluster planes), name order —
+                         and emits the top-k candidate lanes (k ~ 32-64,
+                         -1 padded).  Profiles are the encoder's own
+                         dedup axes: bindings sharing (placement, gvk,
+                         request class) have identical static rows, so
+                         the kernel runs over the chunk's few DISTINCT
+                         profiles — O(P'*C) per chunk, not O(B*C) — and
+                         per-binding deltas (prev assignments) rejoin
+                         the candidate union host-side.
+  tier 2 (ops/solver)    the EXISTING dense solver runs over the chunk's
+                         union-of-candidates sub-vocabulary — a [B, C']
+                         problem with C' ~ O(k) instead of C — via the
+                         per-chunk vocabulary remap below.  The solver's
+                         lane math is lane-count agnostic (ops/solver
+                         _assign_lanes), so the sub-solve is bit-exact.
+
+Exactness contract (the parity fuzz in tests/test_shortlist.py): a
+binding is COVERED when its whole eligible lane set — feasible lanes
+plus every previous-assignment lane, which the solver's scale-down and
+selection math read even when infeasible — fits in k.  A covered
+binding's sub-solve result is bit-identical to the full dense solve:
+absent lanes are exactly the lanes that contribute nothing (infeasible,
+non-prev), and every packed sort key in the solver compares name_rank /
+rank_eff only by ORDER, which the sub-vocabulary preserves.  A chunk
+with any uncovered binding (or any row the device tier does not own)
+widens k and retries, then falls back to the full dense dispatch —
+loudly (karmada_shortlist_fallbacks_total{reason} + a ledger event),
+never with a wrong placement.
+
+Sharding chain: the kernel's outputs pin to the shard_specs entries for
+SHORTLIST_OUT_FIELDS (ops/meshing — the SAME table the solver's dispatch
+places its in-shardings with, the ops/resident_gather pattern), so under
+a mesh the candidate plane flows toward the tier-2 dispatch without a
+repartition step.  The coarse per-group aggregates are built once per
+cycle from the cluster planes the resident plane keeps between cycles
+(memoized on the frozen arrays' identities — the same identity
+discipline as the solver's device-transfer cache).
+
+Trace-safety: pure elementwise + top_k — no Python control flow on
+traced values, no host syncs, dtypes ride in on the operands (built
+against ops/tensors.FIELD_DTYPES).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
+
+from karmada_tpu.obs import events as ev  # noqa: E402
+from karmada_tpu.ops import tensors as T  # noqa: E402
+from karmada_tpu.utils.metrics import REGISTRY  # noqa: E402
+
+# packed score-key geometry: prev-assignment bonus bit above a 34-bit
+# capacity field above a 5-bit coarse group-rank field above the 21-bit
+# lane field (1+34+5+21 = 61 bits — fits int64 with sign headroom)
+_AVAIL_BITS = 34
+_AVAIL_CAP = (1 << _AVAIL_BITS) - 1
+_LANE_BITS = 21
+_LANE_MASK = (1 << _LANE_BITS) - 1
+_GROUP_BITS = 5
+_GROUP_MASK = (1 << _GROUP_BITS) - 1
+
+#: kernel outputs, in the order the jit returns them — the spec-coverage
+#: vet pass checks every entry against meshing.shard_specs exactly like
+#: the fused gather's OUT_FIELDS (one table, so the shortlist's
+#: out-shardings cannot drift from the solver's in-shardings)
+SHORTLIST_OUT_FIELDS = ("shortlist_idx", "shortlist_fcount")
+
+SHORTLIST_DISPATCHES = REGISTRY.counter(
+    "karmada_shortlist_dispatches_total",
+    "Tier-1 shortlist kernel dispatches (one per shortlisted chunk, "
+    "plus one per widen retry)",
+)
+SHORTLIST_ROWS = REGISTRY.counter(
+    "karmada_shortlist_rows_total",
+    "Binding rows whose tier-2 solve ran over the shortlisted "
+    "sub-vocabulary instead of the full cluster axis",
+)
+SHORTLIST_FALLBACKS = REGISTRY.counter(
+    "karmada_shortlist_fallbacks_total",
+    "Chunks that fell back to the full dense dispatch, by reason "
+    "(uncovered: a binding's eligible set outgrew k even after "
+    "widening; mixed_routes: the chunk holds rows the device tier "
+    "does not own; union_wide: the candidate union approached the "
+    "dense width; fused: the fused resident-gather path owns the "
+    "chunk's binding rows)",
+    ("reason",),
+)
+SHORTLIST_WIDENINGS = REGISTRY.counter(
+    "karmada_shortlist_widenings_total",
+    "Widen-and-retry rounds (k doubled because a binding's eligible "
+    "lane set did not fit)",
+)
+SHORTLIST_CELLS = REGISTRY.counter(
+    "karmada_shortlist_cells_total",
+    "Tier-2 solver cell work, by tier: solve = B*C' actually "
+    "dispatched over the sub-vocabulary, dense_equiv = B*C the full "
+    "dense dispatch would have priced (their ratio is the measured "
+    "cell-work reduction)",
+    ("tier",),
+)
+SHORTLIST_UNION_LANES = REGISTRY.gauge(
+    "karmada_shortlist_union_lanes",
+    "Cluster lanes in the most recent shortlisted chunk's candidate "
+    "union (the tier-2 sub-vocabulary width before pow2 padding)",
+)
+
+
+@dataclass(frozen=True)
+class ShortlistConfig:
+    """Tier selection knobs (Scheduler(shortlist_k=) / serve --shortlist).
+
+    k: candidate lanes per binding (tier-1 top-k width).
+    min_cells: a chunk shortlists only when its dense B*C cell count is
+      at least this (the two-tier overhead only pays above a scale);
+      <= 0 arms every chunk (tests, megafleet).
+    k_max: widen-and-retry ceiling — k doubles toward this while any
+      binding's eligible set does not fit, then the chunk falls back.
+    union_frac: dense fallback when the candidate union exceeds this
+      fraction of the real cluster count (a sub-solve near dense width
+      costs more than dense: extra gather + remap for no cell savings).
+    """
+
+    k: int = 64
+    min_cells: int = 1 << 21
+    k_max: int = 256
+    union_frac: float = 0.5
+
+
+def _shortlist_core(
+    cluster_valid, deleting, name_rank, pods_allowed, has_summary,
+    avail_milli, has_alloc, api_ok,
+    req_milli, req_is_cpu, req_pods, est_override,
+    pl_mask, pl_tol_bypass, group_pref,
+    b_valid, placement_id, gvk_id, class_id, replicas,
+    prev_idx, prev_val, evict_idx,
+    *, k: int, shard_mesh=None,
+):
+    """One chunk's candidate plane: (shortlist_idx int32[B, k] — full-
+    vocabulary cluster lanes, -1 padded, best first — and
+    shortlist_fcount int32[B], the eligible-lane count whose comparison
+    against k decides coverage).  Feasibility is the solver's own
+    formula (ops/solver._schedule_core wave_step) so no feasible lane is
+    ever dropped while fewer than k survive; previous-assignment lanes
+    are eligible even when infeasible (the solver's scale-down and
+    selection math read them)."""
+    from karmada_tpu.ops.solver import MAX_INT32, _capacity_estimates
+
+    B = b_valid.shape[0]
+    C = cluster_valid.shape[0]
+    Q = req_milli.shape[0]
+    bidx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    pmask = prev_idx >= 0
+    pic = jnp.where(pmask, prev_idx, 0)
+    prev_present = (
+        jnp.zeros((B, C), jnp.int32).at[bidx, pic]
+        .add(pmask.astype(jnp.int32)) > 0
+    )
+    emask = evict_idx >= 0
+    eic = jnp.where(emask, evict_idx, 0)
+    evict = (
+        jnp.zeros((B, C), jnp.int32).at[bidx, eic]
+        .add(emask.astype(jnp.int32)) > 0
+    )
+    lanes_ok = cluster_valid[None, :] & ~deleting[None, :]
+    feasible = (
+        lanes_ok
+        & pl_mask[placement_id]
+        & (pl_tol_bypass[placement_id] | prev_present)
+        & (api_ok[gvk_id] | prev_present)
+        & ~evict
+    )
+    est_q = _capacity_estimates(
+        req_milli, req_is_cpu, req_pods, avail_milli, has_alloc,
+        pods_allowed, has_summary,
+    )
+    ovr = jnp.maximum(est_override, 0)
+    est_q = est_q.at[:Q].set(jnp.where(est_override >= 0, ovr, est_q[:Q]))
+    cid = jnp.where(class_id >= 0, class_id, Q)
+    est_b = est_q[cid]  # [B, C]
+    avail = jnp.clip(
+        jnp.where(est_b == MAX_INT32, replicas[:, None], est_b),
+        0, _AVAIL_CAP)
+    eligible = (feasible | prev_present) & b_valid[:, None]
+    key = (
+        (prev_present.astype(jnp.int64)
+         << (_AVAIL_BITS + _GROUP_BITS + _LANE_BITS))
+        | (avail << (_GROUP_BITS + _LANE_BITS))
+        | (jnp.asarray(group_pref, jnp.int64)[None, :] << _LANE_BITS)
+        | (_LANE_MASK - jnp.asarray(name_rank, jnp.int64))[None, :]
+    )
+    key = jnp.where(eligible, key, jnp.int64(-1))
+    vals, idx = lax.top_k(key, k)
+    cand = jnp.where(vals >= 0, idx, -1).astype(jnp.int32)
+    fcount = jnp.sum(eligible, axis=1).astype(jnp.int32)
+    out = (cand, fcount)
+    if shard_mesh is not None:
+        # pin the candidate plane's out-shardings FROM the solver's spec
+        # table (meshing.shard_specs) — the resident_gather pattern: one
+        # table serves both tiers, so the chain cannot drift apart
+        from karmada_tpu.ops import meshing
+
+        out = tuple(
+            lax.with_sharding_constraint(
+                a, meshing.sharding_for(shard_mesh, f, a.shape))
+            for f, a in zip(SHORTLIST_OUT_FIELDS, out))
+    return out
+
+
+shortlist_topk = partial(
+    jax.jit, static_argnames=("k", "shard_mesh"))(_shortlist_core)
+
+
+@partial(jax.jit, static_argnames=("n_groups",))
+def _group_sums(group_id, cap_proxy, n_groups: int):
+    """Coarse per-group aggregate: sum of the capacity proxy per group
+    (groupless clusters land in the trailing bucket)."""
+    gid = jnp.where(group_id >= 0, group_id, n_groups)
+    return jax.ops.segment_sum(cap_proxy, gid, num_segments=n_groups + 1)
+
+
+# one-slot per-cycle memo for the coarse aggregates: the encoder hands
+# back the SAME frozen numpy cluster planes across every chunk of a cycle
+# (EncoderCache.assembled / the resident plane's masters), so identity
+# keying re-aggregates exactly once per cycle.  The memo PINS the source
+# arrays it keyed on — a GC'd id must never alias a fresh cycle's plane
+# (the solver's device-transfer cache discipline).
+# guarded-by: _AGG_LOCK; mutators: cycle_aggregates,reset_for_tests
+_AGG_MEMO: List[Optional[dict]] = [None]
+_AGG_LOCK = threading.Lock()
+
+#: the per-cluster capacity aggregate the rebalance detect reuses —
+#: implemented in ops/tensors (jax-free: host-backend planes import it
+#: without paying a jax init) and re-exported here as part of the
+#: shortlist plane's coarse-aggregate surface
+fleet_capacity = T.fleet_capacity
+
+
+def reset_for_tests() -> None:
+    with _AGG_LOCK:
+        _AGG_MEMO[0] = None
+
+
+def cycle_aggregates(batch) -> dict:
+    """The cycle's coarse per-group aggregate tensors, built once from
+    the (resident) cluster planes: group_cap int64[G+1] (free-pod proxy
+    summed per region; trailing bucket = groupless), group_pref
+    int64[C] (the 5-bit capacity-rank preference the score key packs —
+    richer groups rank higher), cap_proxy int64[C], and the cluster
+    names the arrays are aligned to (the rebalance plane's reuse key)."""
+    src = (batch.avail_milli, batch.pods_allowed, batch.region_id)
+    with _AGG_LOCK:
+        memo = _AGG_MEMO[0]
+        if (memo is not None and memo["c"] == batch.C
+                and all(a is b for a, b in zip(memo["src"], src))):
+            return memo
+    region_id = (batch.region_id if batch.region_id is not None
+                 else np.full(batch.C, -1, np.int32))
+    n_groups = len(batch.region_names or [])
+    valid = np.asarray(batch.cluster_valid) & ~np.asarray(batch.deleting)
+    cap_proxy = np.where(valid, np.asarray(batch.pods_allowed), 0)
+    group_cap = np.asarray(_group_sums(
+        np.ascontiguousarray(region_id, np.int32),
+        np.ascontiguousarray(cap_proxy, np.int64),
+        n_groups=n_groups))
+    # rank groups by aggregate capacity (desc); the key packs 5 bits
+    order = np.argsort(-group_cap, kind="stable")
+    rank = np.zeros(n_groups + 1, np.int64)
+    rank[order] = np.arange(n_groups + 1)
+    pref = _GROUP_MASK - np.minimum(rank, _GROUP_MASK)
+    gid = np.where(region_id >= 0, region_id, n_groups)
+    group_pref = pref[gid]
+    memo = {
+        # pinned sources: the identity check above is only sound while
+        # these keep the keyed arrays alive
+        "src": src,
+        "c": batch.C,
+        "group_cap": group_cap,
+        "group_pref": np.ascontiguousarray(group_pref, np.int64),
+        "cap_proxy": np.ascontiguousarray(cap_proxy, np.int64),
+        "names": tuple(batch.cluster_index.names)
+        if batch.cluster_index is not None else (),
+        "n_groups": n_groups,
+    }
+    with _AGG_LOCK:
+        _AGG_MEMO[0] = memo
+    return memo
+
+
+# /debug/state shortlist block: last-chunk snapshot + lifetime counters
+# guarded-by: _AGG_LOCK; mutators: _note,reset_for_tests
+_LAST: Dict[str, object] = {}
+
+
+def _note(**kw) -> None:
+    with _AGG_LOCK:
+        _LAST.update(kw)
+
+
+def state_payload() -> dict:
+    """The `shortlist` section of /debug/state."""
+    with _AGG_LOCK:
+        last = dict(_LAST)
+    return {
+        "dispatches": int(SHORTLIST_DISPATCHES.value()),
+        "rows": int(SHORTLIST_ROWS.value()),
+        "widenings": int(SHORTLIST_WIDENINGS.value()),
+        "fallbacks": int(SHORTLIST_FALLBACKS.total()),
+        "last": last,
+    }
+
+
+def _fallback(batch, reason: str, detail: str) -> Tuple[None, dict]:
+    """The loud dense-fallback path: metric + lifecycle-ledger event —
+    a shortlisted chunk must never silently change width."""
+    SHORTLIST_FALLBACKS.inc(reason=reason)
+    ev.emit(ev.ObjectRef(kind="Scheduler", namespace="", name="shortlist"),
+            ev.TYPE_WARNING, ev.REASON_SHORTLIST_FALLBACK,
+            f"chunk fell back to the dense solve ({reason}): {detail}",
+            origin="shortlist")
+    _note(fallback_reason=reason)
+    return None, {"fallback": reason, "detail": detail}
+
+
+def _profiles(batch):
+    """Profile dedup: bindings sharing (placement, gvk, request class)
+    have IDENTICAL static feasibility and capacity rows — the encoder's
+    own P/Q dedup axes — so the tier-1 kernel scores one row per
+    DISTINCT profile (a handful per chunk) instead of one per binding:
+    tier-1 cost is O(P'*C) per chunk, not O(B*C).  Per-binding deltas
+    (prev assignments, evictions) rejoin host-side: prev lanes append to
+    the candidate union, evict lanes only ever REMOVE feasibility (a
+    superset union never changes the sub-solve's result).
+
+    Returns (prof_keys int32[nprof, 3], prof_of int64[B], replicas_max
+    int64[nprof])."""
+    keys = np.stack([
+        np.asarray(batch.placement_id, np.int32),
+        np.asarray(batch.gvk_id, np.int32),
+        np.asarray(batch.class_id, np.int32),
+    ], axis=1)
+    prof_keys, prof_of = np.unique(keys, axis=0, return_inverse=True)
+    prof_of = prof_of.reshape(-1)
+    rep_max = np.zeros(prof_keys.shape[0], np.int64)
+    np.maximum.at(rep_max, prof_of, np.asarray(batch.replicas, np.int64))
+    return prof_keys, prof_of, rep_max
+
+
+def _dispatch_profiles(batch, prof_keys, rep_max, k: int, plan=None):
+    """Run the tier-1 kernel over the chunk's profile rows: returns
+    (cand int32[nprof, k], fcount int32[nprof]) as numpy."""
+    agg = cycle_aggregates(batch)
+    nprof = prof_keys.shape[0]
+    Bp = T._next_pow2(max(nprof, 1), 8)  # noqa: SLF001 — same package
+
+    def pad1(a, fill, dtype):
+        out = np.full(Bp, fill, dtype)
+        out[:nprof] = a
+        return out
+
+    b_valid = np.zeros(Bp, bool)
+    b_valid[:nprof] = True
+    none_idx = np.full((Bp, 1), -1, np.int32)
+    none_val = np.zeros((Bp, 1), np.int32)
+    cand, fcount = shortlist_topk(
+        batch.cluster_valid, batch.deleting, batch.name_rank,
+        batch.pods_allowed, batch.has_summary, batch.avail_milli,
+        batch.has_alloc, batch.api_ok, batch.req_milli, batch.req_is_cpu,
+        batch.req_pods, batch.est_override, batch.pl_mask,
+        batch.pl_tol_bypass, agg["group_pref"],
+        b_valid,
+        pad1(prof_keys[:, 0], 0, np.int32),
+        pad1(prof_keys[:, 1], 0, np.int32),
+        pad1(prof_keys[:, 2], -1, np.int32),
+        pad1(rep_max, 0, np.int64),
+        none_idx, none_val, none_idx,
+        k=k, shard_mesh=plan.mesh if plan is not None else None)
+    SHORTLIST_DISPATCHES.inc()
+    return np.asarray(cand)[:nprof], np.asarray(fcount)[:nprof]
+
+
+def binding_candidates(batch, k: int, plan=None):
+    """Per-binding candidate lane sets (profile candidates plus the
+    binding's own prev lanes) — the recall measurement's view of tier 1
+    (bench --megafleet, tests).  Host-side; small slices only."""
+    prof_keys, prof_of, rep_max = _profiles(batch)
+    cand, _fcount = _dispatch_profiles(batch, prof_keys, rep_max,
+                                       min(k, batch.C), plan=plan)
+    prev = np.asarray(batch.prev_idx)
+    out = []
+    for b in range(batch.n_bindings):
+        s = set(int(c) for c in cand[prof_of[b]] if c >= 0)
+        s.update(int(c) for c in prev[b] if c >= 0)
+        out.append(s)
+    return out
+
+
+def shrink_chunk(batch, cfg: ShortlistConfig, plan=None):
+    """Tier selection for one encoded chunk: returns (sub_batch, info).
+
+    sub_batch is a SolverBatch over the chunk's candidate-union
+    sub-vocabulary (C' lanes instead of C) whose tier-2 solve is
+    bit-exact against the full dense dispatch, or None when the chunk
+    must stay dense (info["fallback"] says why — every fallback is
+    counted and ledgered; `below_threshold` chunks are silent: staying
+    dense below the arming scale is the configuration, not a failure).
+    """
+    if cfg.min_cells > 0 and batch.B * batch.C < cfg.min_cells:
+        return None, {"fallback": "below_threshold"}
+    if batch.C <= cfg.k:
+        return None, {"fallback": "below_threshold"}
+    if getattr(batch, "fused", False):
+        return _fallback(batch, "fused",
+                         "fused resident-gather batches keep the dense path")
+    route = np.asarray(batch.route)
+    if route.size and not bool(np.all(route == T.ROUTE_DEVICE)):
+        n_other = int(np.sum(route != T.ROUTE_DEVICE))
+        return _fallback(batch, "mixed_routes",
+                         f"{n_other} row(s) owned by spread/big/host tiers")
+    prof_keys, prof_of, rep_max = _profiles(batch)
+    valid = np.asarray(batch.b_valid)
+    # per-binding prev-lane counts (host: the sparse plane is tiny);
+    # coverage is judged conservatively as profile-feasible + prev —
+    # prev lanes can add bypass feasibility beyond the profile row
+    prev_count = np.sum(np.asarray(batch.prev_idx) >= 0, axis=1)
+    k = min(cfg.k, batch.C)
+    k_cap = min(cfg.k_max, batch.C)
+    widened = 0
+    while True:
+        cand, fcount = _dispatch_profiles(batch, prof_keys, rep_max, k,
+                                          plan=plan)
+        need = fcount[prof_of] + prev_count
+        worst = int(need[valid].max()) if bool(valid.any()) else 0
+        if worst <= k:
+            break
+        if worst > k_cap:
+            # the eligible count is k-independent: a set beyond k_max
+            # can never be covered, so fall back WITHOUT burning another
+            # kernel dispatch on a doomed widen
+            return _fallback(
+                batch, "uncovered",
+                f"eligible set of {worst} lane(s) exceeds k_max={cfg.k_max}")
+        k = min(max(k * 2, worst), k_cap)
+        widened += 1
+        SHORTLIST_WIDENINGS.inc()
+    prev_np = np.asarray(batch.prev_idx)
+    lanes = np.unique(np.concatenate([
+        cand[cand >= 0].astype(np.int64).reshape(-1),
+        prev_np[prev_np >= 0].astype(np.int64).reshape(-1),
+    ]))
+    max_union = max(cfg.k, int(cfg.union_frac * max(batch.n_clusters, 1)))
+    if lanes.size > max_union:
+        return _fallback(
+            batch, "union_wide",
+            f"candidate union of {lanes.size} lane(s) exceeds "
+            f"{max_union} ({cfg.union_frac:.0%} of {batch.n_clusters})")
+    sub = _sub_batch(batch, lanes)
+    if sub is None:
+        # a covered binding's prev lane missing from the union would be a
+        # kernel bug; refuse the shortlist rather than mis-solve
+        return _fallback(batch, "uncovered",
+                         "prev-assignment lane absent from the union")
+    SHORTLIST_ROWS.inc(int(batch.n_bindings))
+    SHORTLIST_CELLS.inc(float(batch.B) * float(sub.C), tier="solve")
+    SHORTLIST_CELLS.inc(float(batch.B) * float(batch.C), tier="dense_equiv")
+    SHORTLIST_UNION_LANES.set(float(lanes.size))
+    info = {"k": k, "widened": widened, "union": int(lanes.size),
+            "sub_c": sub.C, "profiles": int(prof_keys.shape[0]),
+            "cells_solve": batch.B * sub.C,
+            "cells_dense": batch.B * batch.C}
+    _note(k=k, widened=widened, union=int(lanes.size), sub_c=sub.C,
+          b=batch.B, c=batch.C, profiles=int(prof_keys.shape[0]),
+          fallback_reason=None)
+    return sub, info
+
+
+def _sub_batch(batch, lanes: np.ndarray):
+    """The per-chunk vocabulary remap: the full batch's planes gathered
+    to the candidate union (cluster axis only — placements, request
+    classes and the binding axis keep their vocabularies), name_rank
+    re-densified order-preserving, sparse prev/evict lane indices
+    remapped.  The result is an ordinary SolverBatch the existing
+    dispatch/decode/carry machinery runs unchanged; `sub_lanes` /
+    `sub_full_c` / `sub_sig` tag it for the keyed carry transport
+    (tensors.CarryState renders accumulators across the lane remap)."""
+    n2 = int(lanes.size)
+    C2 = T._next_pow2(max(n2, 1), 8)  # noqa: SLF001 — same package
+    inv = np.full(batch.C, -1, np.int32)
+    inv[lanes] = np.arange(n2, dtype=np.int32)
+
+    def g1(a, fill):
+        out = np.full(C2, fill, a.dtype)
+        out[:n2] = a[lanes]
+        return out
+
+    def g_rows(a, fill):  # [C, R] -> [C2, R]
+        out = np.full((C2,) + a.shape[1:], fill, a.dtype)
+        out[:n2] = a[lanes]
+        return out
+
+    def g_cols(a, fill):  # [.., C] -> [.., C2]
+        out = np.full(a.shape[:-1] + (C2,), fill, a.dtype)
+        out[..., :n2] = a[..., lanes]
+        return out
+
+    sub_clusters = [batch.cluster_index.clusters[int(i)] for i in lanes]
+    cindex2 = T.ClusterIndex.build(sub_clusters)
+    name_rank = np.zeros(C2, np.int64)
+    name_rank[:n2] = cindex2.name_rank
+    name_rank[n2:] = np.arange(n2, C2)
+
+    def remap_sparse(idx, val=None):
+        m = idx >= 0
+        out_idx = np.where(m, inv[np.where(m, idx, 0)], -1).astype(np.int32)
+        dropped = m & (out_idx < 0)
+        if val is None:
+            return out_idx, dropped
+        out_val = np.where(out_idx >= 0, val, 0).astype(np.int32)
+        return out_idx, out_val, dropped
+
+    prev_idx, prev_val, prev_dropped = remap_sparse(batch.prev_idx,
+                                                    batch.prev_val)
+    if bool(prev_dropped[np.asarray(batch.b_valid)].any()):
+        return None  # prev lane outside the union: coverage bug, refuse
+    evict_idx, _ = remap_sparse(batch.evict_idx)
+    label_axes = {
+        key: (g1(gid, -1), values)
+        for key, (gid, values) in (batch.label_axes or {}).items()
+    }
+    sub = T.SolverBatch(
+        B=batch.B, C=C2, n_bindings=batch.n_bindings, n_clusters=n2,
+        cluster_valid=g1(batch.cluster_valid, False),
+        deleting=g1(batch.deleting, False),
+        name_rank=name_rank,
+        pods_allowed=g1(batch.pods_allowed, 0),
+        has_summary=g1(batch.has_summary, False),
+        avail_milli=g_rows(batch.avail_milli, 0),
+        has_alloc=g_rows(batch.has_alloc, False),
+        api_ok=g_cols(batch.api_ok, False),
+        req_milli=batch.req_milli, req_is_cpu=batch.req_is_cpu,
+        req_pods=batch.req_pods,
+        est_override=g_cols(batch.est_override, -1),
+        pl_mask=g_cols(batch.pl_mask, False),
+        pl_tol_bypass=g_cols(batch.pl_tol_bypass, False),
+        pl_strategy=batch.pl_strategy,
+        pl_static_w=g_cols(batch.pl_static_w, 0),
+        pl_has_cluster_sc=batch.pl_has_cluster_sc,
+        pl_sc_min=batch.pl_sc_min, pl_sc_max=batch.pl_sc_max,
+        pl_ignore_avail=batch.pl_ignore_avail,
+        b_valid=batch.b_valid, placement_id=batch.placement_id,
+        gvk_id=batch.gvk_id, class_id=batch.class_id,
+        replicas=batch.replicas, uid_desc=batch.uid_desc,
+        fresh=batch.fresh, non_workload=batch.non_workload,
+        nw_shortcut=batch.nw_shortcut,
+        prev_idx=prev_idx, prev_val=prev_val, evict_idx=evict_idx,
+        route=batch.route, cluster_index=cindex2,
+        region_id=g1(batch.region_id, -1)
+        if batch.region_id is not None else None,
+        region_names=batch.region_names,
+        label_axes=label_axes,
+        pl_has_region_sc=batch.pl_has_region_sc,
+        pl_region_min=batch.pl_region_min,
+        pl_region_max=batch.pl_region_max,
+        pl_extra_score=g_cols(batch.pl_extra_score, 0),
+        res_names=batch.res_names, class_keys=batch.class_keys,
+        pl_fail_bits=g_cols(batch.pl_fail_bits, 0),
+        explain=batch.explain,
+        placements=batch.placements, gvk_keys=batch.gvk_keys,
+        class_reqs=batch.class_reqs,
+        non_workload_host=batch.non_workload_host,
+        sub_lanes=np.concatenate(
+            [lanes, np.full(C2 - n2, -1, np.int64)]),
+        sub_full_c=batch.C,
+        sub_sig=hash((batch.C, C2, lanes.tobytes())),
+    )
+    return sub
+
+
+def aot_warm(batch, k: int, plan=None, profiles: int = 8) -> dict:
+    """AOT-compile the shortlist kernel executable for this batch's
+    cluster/placement geometry from abstract ShapeDtypeStructs (nothing
+    executes) — with the persistent compile cache armed
+    (ops/aotcache.enable) the first shortlisted chunk of the shape,
+    mid-soak or in a later process, pays cache deserialization instead
+    of an XLA compile.  The row axis is the PROFILE axis (pow2 floor 8
+    — _dispatch_profiles' geometry), not the binding axis.  Returns the
+    lower/compile timing split like solver.aot_warm_compile."""
+    import time as _time
+
+    fields = (
+        "cluster_valid", "deleting", "name_rank", "pods_allowed",
+        "has_summary", "avail_milli", "has_alloc", "api_ok",
+        "req_milli", "req_is_cpu", "req_pods", "est_override",
+        "pl_mask", "pl_tol_bypass",
+    )
+
+    def aval(arr):
+        arr = np.asarray(arr)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    Bp = T._next_pow2(max(profiles, 1), 8)  # noqa: SLF001 — same package
+    args = tuple(aval(getattr(batch, f)) for f in fields)
+    args = args + (jax.ShapeDtypeStruct((batch.C,), np.int64),)  # group_pref
+    args = args + (
+        jax.ShapeDtypeStruct((Bp,), np.bool_),    # b_valid
+        jax.ShapeDtypeStruct((Bp,), np.int32),    # placement_id
+        jax.ShapeDtypeStruct((Bp,), np.int32),    # gvk_id
+        jax.ShapeDtypeStruct((Bp,), np.int32),    # class_id
+        jax.ShapeDtypeStruct((Bp,), np.int64),    # replicas
+        jax.ShapeDtypeStruct((Bp, 1), np.int32),  # prev_idx
+        jax.ShapeDtypeStruct((Bp, 1), np.int32),  # prev_val
+        jax.ShapeDtypeStruct((Bp, 1), np.int32),  # evict_idx
+    )
+    t0 = _time.perf_counter()
+    lowered = shortlist_topk.lower(
+        *args, k=int(k),
+        shard_mesh=plan.mesh if plan is not None else None)
+    t1 = _time.perf_counter()
+    compiled = lowered.compile()
+    t2 = _time.perf_counter()
+    from karmada_tpu.obs import devprof
+
+    return {"lower_s": round(t1 - t0, 3), "compile_s": round(t2 - t1, 3),
+            "k": int(k), "cost": devprof.harvest_cost(compiled)}

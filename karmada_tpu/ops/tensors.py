@@ -140,6 +140,10 @@ FIELD_DTYPES = {
     "pl_has_region_sc": "bool", "pl_region_min": "int32",
     "pl_region_max": "int32",
     "pl_fail_bits": "int32",
+    # shortlist plane (ops/shortlist): the tier-1 kernel's candidate
+    # outputs and the sub-vocabulary lane map the tier-2 remap carries
+    "shortlist_idx": "int32", "shortlist_fcount": "int32",
+    "sub_lanes": "int64",
 }
 
 # axis names per field (B/C extents are checked against the batch by the
@@ -164,6 +168,10 @@ FIELD_AXES = {
     "pl_has_region_sc": ("P",), "pl_region_min": ("P",),
     "pl_region_max": ("P",),
     "pl_fail_bits": ("P", "C"),
+    # shortlist plane: candidate lanes per binding [B, k], eligible-lane
+    # counts [B], and the sub-vocabulary's full-vocab lane per sub lane
+    "shortlist_idx": ("B", "k"), "shortlist_fcount": ("B",),
+    "sub_lanes": ("sC",),
 }
 
 # the consumed-capacity carry triple (solver with_used / CarryState):
@@ -326,6 +334,18 @@ class SolverBatch:
     # forcing a device->host read of its own operands.
     fused: bool = False
     nnz_bound_hint: Optional[int] = None
+    # shortlist plane (ops/shortlist): a tier-2 sub-vocabulary batch —
+    # the chunk's cluster planes gathered to the candidate union.
+    # sub_lanes maps each sub lane to its FULL-vocabulary lane (-1 on
+    # pow2 padding), sub_full_c is the full batch's padded C, and
+    # sub_sig is the lane set's identity (the carry chain keys its
+    # segments on it: two sub-batches with equal shapes but different
+    # lane sets must never chain device arrays).  All three are
+    # host-side bookkeeping — the dispatch ships the gathered planes,
+    # never the map itself (meshing.HOST_ONLY_FIELDS).
+    sub_lanes: np.ndarray = field(default=None)
+    sub_full_c: Optional[int] = None
+    sub_sig: Optional[int] = None
     # host copy of non_workload[:n] on fused batches (HOST_ONLY_FIELDS):
     # decode reads it per binding, and converting the device-resident
     # plane mid-pipeline can block behind the next chunk's solve on the
@@ -1269,12 +1289,31 @@ class CarryState:
     Per batch: `used0_for(batch)` renders the carry into the batch's
     vocabulary; after the solve, `absorb(batch, used_out, used0)` adds the
     batch's OWN consumption (carry-out minus carry-in) back into the
-    stable store."""
+    stable store.
+
+    Shortlisted sub-vocabulary batches (ops/shortlist: `sub_lanes` maps
+    sub lane -> full-vocabulary lane) render and absorb through the lane
+    map: the store's arrays stay in the FULL cluster vocabulary
+    (`sub_full_c` lanes), used0_for gathers the sub-batch's rows out of
+    them, and absorb scatter-adds the sub-batch's own consumption back —
+    so consumption crosses per-chunk cluster vocabularies losslessly,
+    exactly like the resource/class keying already crosses per-chunk
+    resource vocabularies."""
 
     def __init__(self) -> None:
         self.milli: Dict[str, np.ndarray] = {}  # name -> int64[C]
         self.pods: Optional[np.ndarray] = None  # int64[C]
         self.sets: Dict = {}  # class key -> int64[C]
+
+    @staticmethod
+    def _lanes_of(batch):
+        """(full_C, lanes, ok_mask) for a sub-vocabulary batch, else
+        (batch.C, None, None) — the identity rendering."""
+        lanes = getattr(batch, "sub_lanes", None)
+        if lanes is None:
+            return batch.C, None, None
+        ok = lanes >= 0
+        return int(batch.sub_full_c), np.where(ok, lanes, 0), ok
 
     def empty(self) -> bool:
         """True when no consumption has been absorbed yet (used0_for would
@@ -1295,35 +1334,94 @@ class CarryState:
                               else arr.copy())
 
     def used0_for(self, batch: SolverBatch):
+        _full_c, lanes, ok = self._lanes_of(batch)
+
+        def render(full_row):
+            if lanes is None:
+                return full_row.copy()
+            return np.where(ok, full_row[lanes], 0)
+
         um = np.zeros_like(batch.avail_milli)
         for r, name in enumerate(batch.res_names):
             if name in self.milli:
-                um[:, r] = self.milli[name]
-        up = (self.pods.copy() if self.pods is not None
+                um[:, r] = render(self.milli[name])
+        up = (render(self.pods) if self.pods is not None
               else np.zeros_like(batch.pods_allowed))
         us = np.zeros_like(batch.est_override)
         for q, key in enumerate(batch.class_keys):
             if key in self.sets:
-                us[q] = self.sets[key]
+                us[q] = render(self.sets[key])
         return um, up, us
 
     def absorb(self, batch: SolverBatch, used_out, used0) -> None:
+        full_c, lanes, ok = self._lanes_of(batch)
+
+        def widen(own):
+            """A sub-batch's own consumption scattered back to the full
+            vocabulary (additive; padding lanes carry zero by the
+            solver's cluster_valid masking)."""
+            if lanes is None:
+                return own
+            full = np.zeros(full_c, own.dtype)
+            np.add.at(full, lanes[ok], own[ok])
+            return full
+
         um_out, up_out, us_out = used_out
-        um0, up0, us0 = used0
         for r, name in enumerate(batch.res_names):
-            own = np.asarray(um_out)[:, r] - um0[:, r]
+            own = widen(np.asarray(um_out)[:, r] - used0[0][:, r])
             if name in self.milli:
                 self.milli[name] = self.milli[name] + own
             else:
                 self.milli[name] = own.copy()
-        own_p = np.asarray(up_out) - up0
+        own_p = widen(np.asarray(up_out) - used0[1])
         self.pods = own_p.copy() if self.pods is None else self.pods + own_p
         for q, key in enumerate(batch.class_keys):
-            own_s = np.asarray(us_out)[q] - us0[q]
+            own_s = widen(np.asarray(us_out)[q] - used0[2][q])
             if key in self.sets:
                 self.sets[key] = self.sets[key] + own_s
             else:
                 self.sets[key] = own_s.copy()
+
+
+# -- fleet capacity memo (the shortlist plane's coarse per-cluster
+# aggregate, reused by the rebalance detect; jax-free on purpose — the
+# rebalance plane runs on host backends too) ---------------------------------
+import threading as _threading  # noqa: E402 — local to this memo
+
+# guarded-by: _FLEET_CAP_LOCK; mutators: fleet_capacity
+_FLEET_CAP_MEMO: Dict[str, Tuple[int, int]] = {}  # name -> (rv, pods)
+_FLEET_CAP_LOCK = _threading.Lock()
+
+
+def fleet_capacity(clusters) -> np.ndarray:
+    """Per-cluster allocatable-pod capacity int64[C], memoized by
+    (name, resourceVersion): the store's list() hands back deep COPIES
+    every call, so an identity memo could never hit — the rv key
+    survives copies, and only clusters whose status actually moved
+    re-parse their Quantity dicts.  Names absent from this call are
+    pruned (the memo never outgrows the live fleet)."""
+    out = np.zeros(len(clusters), np.int64)
+    with _FLEET_CAP_LOCK:
+        live: Dict[str, Tuple[int, int]] = {}
+        for i, c in enumerate(clusters):
+            name = c.metadata.name
+            rv = int(c.metadata.resource_version or 0)
+            ent = _FLEET_CAP_MEMO.get(name)
+            if ent is not None and ent[0] == rv:
+                out[i] = ent[1]
+            else:
+                cap = 0
+                s = c.status.resource_summary
+                if s is not None:
+                    pods = s.allocatable.get("pods")
+                    if pods is not None:
+                        cap = int(pods.value())
+                out[i] = cap
+                ent = (rv, cap)
+            live[name] = ent
+        _FLEET_CAP_MEMO.clear()
+        _FLEET_CAP_MEMO.update(live)
+    return out
 
 
 def _spec_with(placement: Placement) -> ResourceBindingSpec:

@@ -231,12 +231,19 @@ class RebalancePlane:
         names = [c.metadata.name for c in clusters]
         idx = {n: i for i, n in enumerate(names)}
         committed = np.zeros(len(names), np.int64)
-        capacity = np.zeros(len(names), np.int64)
         valid = np.zeros(len(names), dtype=bool)
+        # capacity reuses the shortlist plane's coarse per-cluster
+        # aggregate (fleet_capacity, implemented jax-free in ops/tensors
+        # and re-exported by ops/shortlist): memoized by (name, rv), so
+        # only clusters whose status actually moved re-parse their
+        # Quantity dicts — detect assembly stays O(C) dict lookups per
+        # cycle at 10k clusters instead of O(C) Quantity parses
+        from karmada_tpu.ops.tensors import fleet_capacity
+
+        capacity = fleet_capacity(clusters)
         for i, c in enumerate(clusters):
             summary = c.status.resource_summary
             pods = summary.allocatable.get("pods") if summary else None
-            capacity[i] = pods.value() if pods is not None else 0
             valid[i] = (not c.metadata.deleting) and pods is not None
         # cluster -> [(key, priority, replicas_here, rb)] victim candidates
         by_cluster: Dict[str, List[Tuple]] = {}

@@ -126,15 +126,24 @@ class _CarryChain:
 
     @staticmethod
     def _sig(batch) -> tuple:
+        # sub_sig joins the signature: two shortlisted sub-vocabulary
+        # batches (ops/shortlist) can share every shape while holding
+        # DIFFERENT cluster lane sets — chaining their live device
+        # accumulators would misalign lanes silently
         return (batch.C, tuple(batch.res_names), tuple(batch.class_keys),
-                batch.est_override.shape[0], batch.avail_milli.shape[1])
+                batch.est_override.shape[0], batch.avail_milli.shape[1],
+                getattr(batch, "sub_sig", None))
 
     @staticmethod
     def _subset(from_batch, to_batch) -> bool:
         """True when a device-side remap from_batch -> to_batch is
         lossless: every accumulator key of the source vocabulary exists
-        in the target's (nothing to drop)."""
+        in the target's (nothing to drop).  Sub-vocabulary batches only
+        remap on-device within ONE lane set; crossing lane sets goes
+        through the keyed store (CarryState renders across the remap)."""
         return (from_batch.C == to_batch.C
+                and (getattr(from_batch, "sub_sig", None)
+                     == getattr(to_batch, "sub_sig", None))
                 and set(from_batch.res_names) <= set(to_batch.res_names)
                 and set(from_batch.class_keys) <= set(to_batch.class_keys))
 
@@ -361,6 +370,7 @@ def run_pipeline(
     explain: Optional["obs_decisions.DecisionRecorder"] = None,
     keys: Optional[Sequence[str]] = None,
     encode: Optional[Callable[[Sequence, int, bool], object]] = None,
+    shortlist=None,
 ) -> PipelineResult:
     """Schedule `items` (a cycle of (spec, status) pairs) through the
     pipelined chunk executor.  Returns a PipelineResult whose `results`
@@ -402,6 +412,11 @@ def run_pipeline(
       plain tensors.encode_batch against `cindex`/`cache`.  The returned
       batch must be semantically identical to a fresh full encode (the
       resident plane's parity audit enforces exactly that contract).
+    shortlist: an ops/shortlist.ShortlistConfig arming the hierarchical
+      two-tier solve — chunks at/above its cell threshold run the tier-1
+      candidate kernel and dispatch the existing solver over the
+      candidate-union sub-vocabulary (bit-exact when covered; loud dense
+      fallback otherwise).  None (default) keeps every chunk dense.
     """
     from karmada_tpu.ops.solver import (
         dispatch_compact,
@@ -648,6 +663,24 @@ def run_pipeline(
             batch = (encode(part, lo, armed) if encode is not None
                      else tensors.encode_batch(part, cindex, estimator,
                                                cache=cache, explain=armed))
+            if shortlist is not None:
+                # tier selection (ops/shortlist): dispatch the cheap
+                # candidate kernel and, when the chunk is covered, swap
+                # in the sub-vocabulary batch — the dispatch/decode/
+                # carry machinery below runs it unchanged.  Fallbacks
+                # keep the dense batch (counted + ledgered in the
+                # shortlist module; bit-exactness is never traded).
+                from karmada_tpu.ops import shortlist as sl_mod
+
+                sub, sl_info = sl_mod.shrink_chunk(batch, shortlist,
+                                                   plan=mesh_plan)
+                if ch_span is not None:
+                    ch_span.set_attr(shortlist=(
+                        f"union={sl_info['union']} k={sl_info['k']}"
+                        if sub is not None
+                        else sl_info.get("fallback", "off")))
+                if sub is not None:
+                    batch = sub
             t1 = time.perf_counter()
             if enc_span is not None:
                 enc_span.end()

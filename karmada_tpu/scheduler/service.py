@@ -170,6 +170,17 @@ class Scheduler:
         # chunks, rebuild cycles, and mirror-sync failures fall back).
         # Requires resident=True; disarmed by default.
         resident_fused: bool = False,
+        # hierarchical two-tier solve (ops/shortlist, serve --shortlist):
+        # chunks whose dense B*C cell count reaches shortlist_min_cells
+        # run the tier-1 candidate kernel and solve over the candidate-
+        # union sub-vocabulary (B*k cells instead of B*C) — bit-exact
+        # when every binding's eligible set fits k, loud dense fallback
+        # otherwise.  None/0 keeps every chunk dense (the default);
+        # shortlist_min_cells <= 0 arms every chunk (tests, megafleet).
+        # Device backend only; the fused resident-gather path keeps the
+        # dense dispatch (the slot store owns its binding rows).
+        shortlist_k: Optional[int] = None,
+        shortlist_min_cells: int = 1 << 21,
         # rebalance plane (karmada_tpu/rebalance, serve --rebalance):
         # interval in seconds of the periodic drain-and-re-place cycle on
         # the scheduler queue's clock — detect overcommit/spread
@@ -295,6 +306,17 @@ class Scheduler:
                               bool(resident_fused))
         self.resident_fused = bool(resident_fused and resident
                                    and backend == "device")
+        # shortlist tier selection: built lazily (ops/shortlist imports
+        # jax) the first device cycle that can use it.  The fused
+        # resident path keeps the dense dispatch — its binding rows live
+        # in the device slot store, which the host-side sub-vocabulary
+        # remap cannot gather; arming both would only ledger a fallback
+        # per chunk, so the combination disarms shortlisting up front.
+        self.shortlist_k = (int(shortlist_k) if shortlist_k
+                            and backend == "device"
+                            and not self.resident_fused else None)
+        self.shortlist_min_cells = int(shortlist_min_cells)
+        self._shortlist_cfg = None
         if resident and backend == "device":
             self._arm_resident()
         if backend == "native":
@@ -998,6 +1020,15 @@ class Scheduler:
         else:
             cindex = tensors.ClusterIndex.build(clusters)
             cache = self._encoder_cache(clusters)
+        shortlist_cfg = None
+        if self.shortlist_k:
+            if self._shortlist_cfg is None:
+                from karmada_tpu.ops.shortlist import ShortlistConfig
+
+                self._shortlist_cfg = ShortlistConfig(
+                    k=self.shortlist_k,
+                    min_cells=self.shortlist_min_cells)
+            shortlist_cfg = self._shortlist_cfg
         carry = len(items) > self.pipeline_chunk
         res = pipeline.run_pipeline(
             items, cindex, self._general,
@@ -1017,6 +1048,7 @@ class Scheduler:
                 self.enable_empty_workload_propagation),
             cancelled=cancelled,
             explain=explain, keys=keys, encode=encode,
+            shortlist=shortlist_cfg,
         )
         return res.results
 

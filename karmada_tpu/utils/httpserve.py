@@ -152,8 +152,27 @@ class ObservabilityServer:
                 # generation, vocab sizes, row-cache hit rate, last audit
                 # — {"enabled": false} when running rebuild-per-cycle
                 "resident": resident.state_payload(),
+                # the shortlist plane (ops/shortlist): dispatch/fallback
+                # counters + the last shortlisted chunk's geometry.
+                # Read through sys.modules so a host-backend plane that
+                # never armed the two-tier solve pays no jax import
+                "shortlist": self._shortlist_state(),
                 "traces": rec.stats() if rec is not None else None,
                 "explain": dec.stats() if dec is not None else None}
+
+    @staticmethod
+    def _shortlist_state() -> dict:
+        import sys as _sys
+
+        mod = _sys.modules.get("karmada_tpu.ops.shortlist")
+        if mod is None:
+            return {"active": False}
+        payload = mod.state_payload()
+        # "active" means the tier actually ran, not merely that some
+        # other plane imported the module — an operator debugging why
+        # shortlisting isn't firing must not read an armed-looking block
+        # with zero dispatches
+        return {"active": payload["dispatches"] > 0, **payload}
 
     def _traces_payload(self, which: str) -> dict:
         from karmada_tpu.obs import export

@@ -1,0 +1,638 @@
+"""Hierarchical two-tier solve (ops/shortlist): candidate shortlisting
+plus the dense solver over the per-chunk candidate-union sub-vocabulary.
+
+The golden contract under test: whenever every binding's eligible lane
+set (feasible clusters plus previous-assignment lanes) fits k, the
+shortlisted chunk's placements are BIT-EXACT against the full dense
+dispatch — and when it does not fit, the chunk widens k, then falls
+back to the dense dispatch loudly (metric + ledger event), never with a
+wrong placement.  Covered here:
+
+  * parity fuzz across affinity/static/dynamic/aggregated strategies,
+    prev assignments, and multi-chunk carry (consumption crosses the
+    per-chunk cluster-lane remap through the keyed CarryState);
+  * widen-and-retry, and every fallback reason
+    (uncovered / mixed_routes / union_wide / fused / below_threshold);
+  * explain-plane verdicts through the vocabulary remap;
+  * the loadgen `megafleet` scenario compressed on the virtual clock
+    (device backend end to end, zero fallbacks);
+  * AOT warm coverage (aotcache VARIANT_SHORTLIST: tier-1 kernel +
+    tier-2 sub-shape solver), Scheduler/ControlPlane plumbing, the
+    /debug/state shortlist block, and seeded spec-coverage fixtures for
+    the new drift class;
+  * 2-device mesh parity (8-device marked slow).
+"""
+
+import random
+import textwrap
+
+import numpy as np
+import pytest
+
+import bench
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.models.policy import (
+    ClusterAffinity,
+    Placement,
+    ReplicaSchedulingStrategy,
+    REPLICA_SCHEDULING_DIVIDED,
+    REPLICA_DIVISION_WEIGHTED,
+    ClusterPreferences,
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    SpreadConstraint,
+    SPREAD_BY_FIELD_REGION,
+    SPREAD_BY_FIELD_CLUSTER,
+)
+from karmada_tpu.models.work import TargetCluster
+from karmada_tpu.ops import meshing, shortlist as sl, tensors
+from karmada_tpu.scheduler import pipeline
+
+pytestmark = pytest.mark.shortlist
+
+
+@pytest.fixture(autouse=True)
+def _no_mesh_leak():
+    yield
+    meshing.deactivate()
+
+
+def _fleet(n, seed=0):
+    rng = random.Random(seed)
+    clusters = bench.build_fleet(rng, n)
+    return clusters, tensors.ClusterIndex.build(clusters)
+
+
+def _affinity_placements(rng, names, n=12, lo=3, hi=16):
+    """Device-routed strategy mix over affinity subsets (the shape whose
+    eligible sets a small k covers): Duplicated, StaticWeight, and
+    DynamicWeight-Divided, all restricted to [lo, hi] clusters."""
+    out = []
+    for j in range(n):
+        k = rng.randint(lo, min(hi, len(names)))
+        start = rng.randrange(len(names))
+        picked = [names[(start + i) % len(names)] for i in range(k)]
+        aff = ClusterAffinity(cluster_names=picked)
+        if j % 3 == 0:
+            rs = ReplicaSchedulingStrategy(
+                replica_scheduling_type="Duplicated")
+        elif j % 3 == 1:
+            rs = ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED)
+        else:
+            rs = ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS))
+        out.append(Placement(cluster_affinity=aff, replica_scheduling=rs))
+    return out
+
+
+def _items(rng, n, placements, prev_of=None):
+    items = bench.build_bindings(rng, n, placements)
+    if prev_of:
+        for b, targets in prev_of.items():
+            items[b][0].clusters = [
+                TargetCluster(name=nm, replicas=rep) for nm, rep in targets]
+    return items
+
+
+def _run(items, cindex, est, cfg, **kw):
+    kw.setdefault("chunk", 64)
+    kw.setdefault("waves", 4)
+    kw.setdefault("carry", True)
+    kw.setdefault("carry_spread", True)
+    return pipeline.run_pipeline(items, cindex, est, shortlist=cfg, **kw)
+
+
+def _assert_parity(dense, shortlisted):
+    assert dense.results.keys() == shortlisted.results.keys()
+    for i, want in dense.results.items():
+        got = shortlisted.results[i]
+        if isinstance(want, Exception):
+            assert isinstance(got, type(want)), (i, want, got)
+        else:
+            assert not isinstance(got, Exception), (i, got)
+            assert ({t.name: t.replicas for t in got}
+                    == {t.name: t.replicas for t in want}), i
+
+
+def _fallback_delta(fn, reason):
+    before = sl.SHORTLIST_FALLBACKS.value(reason=reason)
+    out = fn()
+    return out, sl.SHORTLIST_FALLBACKS.value(reason=reason) - before
+
+
+# -- parity ------------------------------------------------------------------
+
+
+def test_parity_fuzz_covered_bit_exact():
+    """Shortlist-vs-dense placements bit-exact whenever every eligible
+    set fits k (fuzz over seeds / strategies / chunk boundaries)."""
+    for seed in (3, 17):
+        rng = random.Random(seed)
+        clusters, cindex = _fleet(96, seed=seed)
+        names = [c.metadata.name for c in clusters]
+        pls = _affinity_placements(rng, names)
+        items = _items(rng, 150, pls)
+        est = GeneralEstimator()
+        dense = _run(items, cindex, est, None)
+        cfg = sl.ShortlistConfig(k=24, min_cells=0, union_frac=1.0)
+        fb0 = sl.SHORTLIST_FALLBACKS.total()
+        shortlisted = _run(items, cindex, est, cfg)
+        assert sl.SHORTLIST_FALLBACKS.total() == fb0, "unexpected fallback"
+        _assert_parity(dense, shortlisted)
+
+
+def test_prev_assignment_lanes_ride_the_union():
+    """Previous-assignment lanes are eligible even beyond the affinity
+    row (scale-up/down read them): parity holds and the union contains
+    every prev lane."""
+    rng = random.Random(5)
+    clusters, cindex = _fleet(64, seed=5)
+    names = [c.metadata.name for c in clusters]
+    pls = _affinity_placements(rng, names, n=6)
+    # prev targets deliberately outside each binding's affinity subset
+    prev_of = {b: [(names[(b * 7 + 3) % len(names)], 2),
+                   (names[(b * 11 + 9) % len(names)], 1)]
+               for b in range(0, 40, 5)}
+    items = _items(rng, 40, pls, prev_of=prev_of)
+    est = GeneralEstimator()
+    dense = _run(items, cindex, est, None)
+    cfg = sl.ShortlistConfig(k=24, min_cells=0, union_frac=1.0)
+    shortlisted = _run(items, cindex, est, cfg)
+    _assert_parity(dense, shortlisted)
+    # the sub-vocabulary covered the prev lanes (direct shrink check)
+    batch = tensors.encode_batch(items, cindex, est)
+    sub, info = sl.shrink_chunk(batch, cfg)
+    assert sub is not None, info
+    lanes = set(sub.sub_lanes[sub.sub_lanes >= 0].tolist())
+    prev_np = np.asarray(batch.prev_idx)
+    assert set(prev_np[prev_np >= 0].tolist()) <= lanes
+
+
+def test_carry_across_shortlisted_chunks():
+    """Multi-chunk contention: chunk k+1 prices against what chunks <=k
+    consumed, ACROSS different per-chunk sub-vocabularies (the keyed
+    CarryState renders accumulators through the lane remap).  A tight
+    fleet makes the carry observable — dropping it would change
+    placements."""
+    rng = random.Random(29)
+    clusters = bench.build_fleet(rng, 48)
+    # shrink capacity so contention bites across chunks
+    for c in clusters:
+        c.status.resource_summary.allocatable["pods"] = (
+            type(c.status.resource_summary.allocatable["pods"])
+            .from_units(24))
+    cindex = tensors.ClusterIndex.build(clusters)
+    names = [c.metadata.name for c in clusters]
+    pls = _affinity_placements(rng, names, n=8, lo=4, hi=10)
+    items = _items(rng, 180, pls)
+    est = GeneralEstimator()
+    dense = _run(items, cindex, est, None, chunk=48)
+    cfg = sl.ShortlistConfig(k=16, min_cells=0, union_frac=1.0)
+    shortlisted = _run(items, cindex, est, cfg, chunk=48)
+    _assert_parity(dense, shortlisted)
+    # the run really was multi-chunk and really was shortlisted
+    assert shortlisted.chunks >= 3
+    assert sl.state_payload()["last"]["fallback_reason"] is None
+
+
+# -- widen + fallbacks -------------------------------------------------------
+
+
+def test_widen_and_retry_then_exact():
+    rng = random.Random(41)
+    clusters, cindex = _fleet(64, seed=41)
+    names = [c.metadata.name for c in clusters]
+    pls = _affinity_placements(rng, names, n=4, lo=12, hi=20)
+    items = _items(rng, 30, pls)
+    est = GeneralEstimator()
+    batch = tensors.encode_batch(items, cindex, est)
+    w0 = sl.SHORTLIST_WIDENINGS.value()
+    cfg = sl.ShortlistConfig(k=4, k_max=64, min_cells=0, union_frac=1.0)
+    sub, info = sl.shrink_chunk(batch, cfg)
+    assert sub is not None, info
+    assert info["widened"] >= 1 and info["k"] > 4
+    assert sl.SHORTLIST_WIDENINGS.value() > w0
+    dense = _run(items, cindex, est, None)
+    shortlisted = _run(items, cindex, est, cfg)
+    _assert_parity(dense, shortlisted)
+
+
+def test_uncovered_fallback_is_loud_and_correct():
+    from karmada_tpu.obs import events as ev
+
+    rng = random.Random(43)
+    clusters, cindex = _fleet(64, seed=43)
+    names = [c.metadata.name for c in clusters]
+    pls = _affinity_placements(rng, names, n=4, lo=20, hi=24)
+    items = _items(rng, 24, pls)
+    est = GeneralEstimator()
+    cfg = sl.ShortlistConfig(k=4, k_max=8, min_cells=0, union_frac=1.0)
+    batch = tensors.encode_batch(items, cindex, est)
+    (sub, info), delta = _fallback_delta(
+        lambda: sl.shrink_chunk(batch, cfg), "uncovered")
+    assert sub is None and info["fallback"] == "uncovered"
+    assert delta == 1
+    recent = ev.state_payload(n=16)["recent"]
+    assert any(e.get("reason") == ev.REASON_SHORTLIST_FALLBACK
+               for e in recent), recent
+    # the pipeline still schedules correctly (dense fallback per chunk)
+    dense = _run(items, cindex, est, None)
+    shortlisted = _run(items, cindex, est, cfg)
+    _assert_parity(dense, shortlisted)
+
+
+def test_mixed_routes_fallback():
+    rng = random.Random(47)
+    clusters, cindex = _fleet(64, seed=47)
+    names = [c.metadata.name for c in clusters]
+    pls = _affinity_placements(rng, names, n=3)
+    spread = Placement(
+        spread_constraints=[
+            SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_REGION,
+                             min_groups=1, max_groups=2),
+            SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+                             min_groups=1, max_groups=4),
+        ],
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=REPLICA_DIVISION_WEIGHTED,
+            weight_preference=ClusterPreferences(
+                dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS)))
+    items = _items(rng, 20, pls + [spread])
+    est = GeneralEstimator()
+    batch = tensors.encode_batch(items, cindex, est)
+    cfg = sl.ShortlistConfig(k=24, min_cells=0)
+    (sub, info), delta = _fallback_delta(
+        lambda: sl.shrink_chunk(batch, cfg), "mixed_routes")
+    assert sub is None and info["fallback"] == "mixed_routes"
+    assert delta == 1
+    dense = _run(items, cindex, est, None)
+    shortlisted = _run(items, cindex, est, cfg)
+    _assert_parity(dense, shortlisted)
+
+
+def test_union_wide_fallback():
+    rng = random.Random(53)
+    clusters, cindex = _fleet(64, seed=53)
+    names = [c.metadata.name for c in clusters]
+    # many groups jointly spanning most of the fleet
+    pls = _affinity_placements(rng, names, n=16, lo=10, hi=16)
+    items = _items(rng, 64, pls)
+    est = GeneralEstimator()
+    batch = tensors.encode_batch(items, cindex, est)
+    cfg = sl.ShortlistConfig(k=16, min_cells=0, union_frac=0.2)
+    (sub, info), delta = _fallback_delta(
+        lambda: sl.shrink_chunk(batch, cfg), "union_wide")
+    assert sub is None and info["fallback"] == "union_wide"
+    assert delta == 1
+
+
+def test_below_threshold_is_silent():
+    rng = random.Random(59)
+    clusters, cindex = _fleet(32, seed=59)
+    items = _items(rng, 16, _affinity_placements(
+        rng, [c.metadata.name for c in clusters], n=3))
+    batch = tensors.encode_batch(items, cindex, GeneralEstimator())
+    fb0 = sl.SHORTLIST_FALLBACKS.total()
+    sub, info = sl.shrink_chunk(
+        batch, sl.ShortlistConfig(k=8, min_cells=1 << 30))
+    assert sub is None and info["fallback"] == "below_threshold"
+    assert sl.SHORTLIST_FALLBACKS.total() == fb0
+
+
+def test_fused_batch_falls_back():
+    rng = random.Random(61)
+    clusters, cindex = _fleet(32, seed=61)
+    items = _items(rng, 16, _affinity_placements(
+        rng, [c.metadata.name for c in clusters], n=3))
+    batch = tensors.encode_batch(items, cindex, GeneralEstimator())
+    batch.fused = True
+    (sub, info), delta = _fallback_delta(
+        lambda: sl.shrink_chunk(batch, sl.ShortlistConfig(
+            k=8, min_cells=0)), "fused")
+    assert sub is None and info["fallback"] == "fused"
+    assert delta == 1
+
+
+# -- explain through the remap ------------------------------------------------
+
+
+def test_explain_verdicts_through_the_remap():
+    from karmada_tpu.obs import decisions as obs_decisions
+
+    rng = random.Random(67)
+    clusters, cindex = _fleet(64, seed=67)
+    names = [c.metadata.name for c in clusters]
+    pls = _affinity_placements(rng, names, n=6)
+    items = _items(rng, 40, pls)
+    est = GeneralEstimator()
+    rec = obs_decisions.DecisionRecorder()
+    cfg = sl.ShortlistConfig(k=24, min_cells=0, union_frac=1.0)
+    res = _run(items, cindex, est, cfg, explain=rec)
+    assert res.scheduled > 0
+    recent = rec.recent()
+    assert recent, "explain-armed shortlisted cycle recorded no decisions"
+    union = set()
+    batch = tensors.encode_batch(items, cindex, est)
+    sub, _info = sl.shrink_chunk(batch, cfg)
+    assert sub is not None
+    union = set(sub.cluster_index.names)
+    for d in recent:
+        table = d.get("clusters") or []
+        for row in table:
+            assert row["name"] in union
+    # parity against the dense explain run: same outcomes
+    rec2 = obs_decisions.DecisionRecorder()
+    dense = _run(items, cindex, est, None, explain=rec2)
+    _assert_parity(dense, res)
+
+
+# -- serve-path integration ---------------------------------------------------
+
+
+@pytest.mark.soak
+def test_megafleet_compressed_soak_zero_fallbacks():
+    """The loadgen megafleet scenario end to end on the virtual clock:
+    device backend, shortlist armed through the Scheduler, every chunk
+    covered (zero fallbacks), everything scheduled."""
+    from karmada_tpu.loadgen import (
+        LoadDriver, ServeSlice, ServiceModel, VirtualClock, get_scenario,
+    )
+
+    scenario = get_scenario("megafleet")
+    assert scenario.shortlist_k > 0 and scenario.n_regions > 0
+    clock = VirtualClock()
+    model = ServiceModel()
+    plane = ServeSlice(scenario, clock, model, backend="device")
+    assert plane.scheduler.shortlist_k == scenario.shortlist_k
+    disp0 = sl.SHORTLIST_DISPATCHES.value()
+    fb0 = sl.SHORTLIST_FALLBACKS.total()
+    driver = LoadDriver(plane, scenario, clock=clock, model=model, seed=7)
+    payload = driver.run()
+    assert payload["injected"] > 0
+    assert payload["scheduled"] == payload["injected"]
+    assert sl.SHORTLIST_DISPATCHES.value() > disp0
+    assert sl.SHORTLIST_FALLBACKS.total() == fb0
+
+
+def test_scheduler_and_controlplane_plumbing():
+    from karmada_tpu.e2e import ControlPlane
+    from karmada_tpu.scheduler.service import Scheduler
+    from karmada_tpu.store.store import ObjectStore
+    from karmada_tpu.store.worker import Runtime
+
+    sched = Scheduler(ObjectStore(), Runtime(), backend="device",
+                      shortlist_k=32, shortlist_min_cells=123)
+    assert sched.shortlist_k == 32
+    assert sched.shortlist_min_cells == 123
+    # host backends never arm the tier (they build no SolverBatches)
+    assert Scheduler(ObjectStore(), Runtime(), backend="serial",
+                     shortlist_k=32).shortlist_k is None
+    # the fused slot store owns its binding rows: combination disarms
+    assert Scheduler(ObjectStore(), Runtime(), backend="device",
+                     resident=True, resident_fused=True,
+                     shortlist_k=32).shortlist_k is None
+    cp = ControlPlane(backend="device", shortlist_k=16)
+    assert cp.scheduler.shortlist_k == 16
+
+
+def test_debug_state_shortlist_block():
+    from karmada_tpu.utils.httpserve import ObservabilityServer
+
+    block = ObservabilityServer._shortlist_state()
+    # this suite imported ops.shortlist, so the live payload shows —
+    # and "active" tracks real dispatches, not module presence
+    assert "dispatches" in block and "fallbacks" in block
+    assert block["active"] == (block["dispatches"] > 0)
+
+
+def test_armed_guards_accept_sub_batches():
+    """serve --check-invariants must pass a shortlisted sub-batch at the
+    solver entry (sub_lanes checked when present, the kernel's output
+    fields skipped — they are never batch attributes)."""
+    from karmada_tpu.analysis import guards
+
+    rng = random.Random(89)
+    clusters, cindex = _fleet(64, seed=89)
+    items = _items(rng, 24, _affinity_placements(
+        rng, [c.metadata.name for c in clusters], n=4))
+    est = GeneralEstimator()
+    batch = tensors.encode_batch(items, cindex, est)
+    sub, info = sl.shrink_chunk(
+        batch, sl.ShortlistConfig(k=24, min_cells=0, union_frac=1.0))
+    assert sub is not None, info
+    guards.check_batch(batch)  # dense: sub_lanes absent -> skipped
+    guards.check_batch(sub)    # sub: lane map + gathered planes checked
+    guards.arm()
+    try:
+        from karmada_tpu.ops.solver import solve_compact
+
+        solve_compact(sub, waves=2)
+    finally:
+        guards.arm(False)
+
+
+def test_state_payload_shape():
+    p = sl.state_payload()
+    for key in ("dispatches", "rows", "widenings", "fallbacks", "last"):
+        assert key in p
+
+
+# -- AOT warm coverage --------------------------------------------------------
+
+
+def test_variants_for_shortlist():
+    from karmada_tpu.ops import aotcache
+
+    assert aotcache.variants_for(0.0, False) == ("plain",)
+    assert aotcache.variants_for(0.0, False, shortlist=True) == \
+        ("plain", "shortlist")
+    assert "shortlist" in aotcache.variants_for(0.5, True, fused=True,
+                                                shortlist=True)
+
+
+def test_warm_executables_compiles_shortlist_pair():
+    from karmada_tpu.ops import aotcache
+
+    rng = random.Random(71)
+    clusters = bench.build_fleet(rng, 24)
+    label = "B8xC32:k8:shortlist"
+    try:
+        res = aotcache.warm_executables(
+            clusters, GeneralEstimator(), shapes=(8,),
+            variants=(aotcache.VARIANT_SHORTLIST,), shortlist_k=8)
+        assert res["_totals"]["compiled"] == 1
+        entry = res[label]
+        assert entry["k"] == 8 and entry["compile_s"] >= 0
+        # the tier-2 sub-shape solver warmed alongside the kernel
+        assert "tier2" in entry and entry["tier2"]["compile_s"] >= 0
+        ledger = aotcache.state_payload()["warmup"]
+        assert ledger.get(label, {}).get("state") == "done"
+    finally:
+        aotcache._STATE["warmup"].pop(label, None)  # noqa: SLF001
+
+
+# -- coarse aggregates + rebalance reuse -------------------------------------
+
+
+def test_cycle_aggregates_memoized_per_cycle():
+    rng = random.Random(73)
+    clusters, cindex = _fleet(32, seed=73)
+    items = _items(rng, 16, _affinity_placements(
+        rng, [c.metadata.name for c in clusters], n=3))
+    est = GeneralEstimator()
+    cache = tensors.EncoderCache()
+    b1 = tensors.encode_batch(items, cindex, est, cache=cache)
+    b2 = tensors.encode_batch(items, cindex, est, cache=cache)
+    a1 = sl.cycle_aggregates(b1)
+    a2 = sl.cycle_aggregates(b2)
+    assert a1 is a2  # same frozen cluster planes -> one aggregation
+    # the memo pins its keyed sources: identity can never falsely hit
+    assert a1["src"][0] is b1.avail_milli
+
+
+def test_fleet_capacity_memo_and_rebalance_reuse():
+    import copy
+
+    rng = random.Random(79)
+    clusters = bench.build_fleet(rng, 16)
+    cap1 = sl.fleet_capacity(clusters)
+    # the memo keys on (name, rv) — it must hit across DEEP COPIES (the
+    # store's list() hands back fresh objects every call)
+    cap2 = sl.fleet_capacity([copy.deepcopy(c) for c in clusters])
+    assert np.array_equal(cap1, cap2)
+    want = np.array(
+        [int(c.status.resource_summary.allocatable["pods"].value())
+         for c in clusters], np.int64)
+    assert np.array_equal(cap1, want)
+    # a churned cluster (rv bumped with a new summary) re-parses
+    moved = copy.deepcopy(clusters[3])
+    moved.metadata.resource_version += 1
+    moved.status.resource_summary.allocatable["pods"] = (
+        type(moved.status.resource_summary.allocatable["pods"])
+        .from_units(7))
+    cap3 = sl.fleet_capacity(clusters[:3] + [moved] + clusters[4:])
+    assert cap3[3] == 7 and np.array_equal(cap3[:3], want[:3])
+    # the rebalance plane's detect assembles through the same memo
+    from karmada_tpu.rebalance.plane import RebalancePlane
+    from karmada_tpu.store.store import ObjectStore
+
+    plane = RebalancePlane(ObjectStore(), scheduler=None,
+                           clock=lambda: 0.0)
+    names, committed, capacity, valid, _by = plane._assemble(clusters, [])
+    assert np.array_equal(capacity, want)
+
+
+# -- vet drift fixtures (spec-coverage shortlist class) -----------------------
+
+
+def _vet(tmp_path, files):
+    from karmada_tpu.analysis.vet import run_vet
+
+    for fname, src in files.items():
+        (tmp_path / fname).write_text(textwrap.dedent(src))
+    return run_vet([str(tmp_path)], rules=["spec-coverage"])
+
+
+_MESHING_FIXTURE = """
+    HOST_ONLY_FIELDS = frozenset({"route"})
+
+    def shard_specs():
+        return {"shortlist_idx": 1, "b_valid": 2}
+"""
+
+
+def test_vet_catches_unchained_shortlist_output(tmp_path):
+    report = _vet(tmp_path, {
+        "meshing.py": _MESHING_FIXTURE,
+        "shortlist.py": """
+            SHORTLIST_OUT_FIELDS = ("shortlist_idx", "mystery_plane")
+            FIELD_DTYPES = {"shortlist_idx": "int32",
+                            "mystery_plane": "int32"}
+        """,
+    })
+    msgs = [f.message for f in report.findings]
+    assert any("shortlist kernel output `mystery_plane`" in m
+               and "shard_specs" in m for m in msgs), msgs
+
+
+def test_vet_catches_untyped_shortlist_output(tmp_path):
+    report = _vet(tmp_path, {
+        "meshing.py": """
+            HOST_ONLY_FIELDS = frozenset({"route"})
+
+            def shard_specs():
+                return {"shortlist_idx": 1, "shortlist_fcount": 2,
+                        "b_valid": 3}
+        """,
+        "shortlist.py": """
+            SHORTLIST_OUT_FIELDS = ("shortlist_idx", "shortlist_fcount")
+            FIELD_DTYPES = {"shortlist_idx": "int32"}
+        """,
+    })
+    msgs = [f.message for f in report.findings]
+    assert any("shortlist kernel output `shortlist_fcount`" in m
+               and "FIELD_DTYPES" in m for m in msgs), msgs
+
+
+def test_vet_clean_on_real_tree_tables():
+    keys = set(meshing.shard_specs())
+    assert set(sl.SHORTLIST_OUT_FIELDS) <= keys
+    assert set(sl.SHORTLIST_OUT_FIELDS) <= set(tensors.FIELD_DTYPES)
+    assert set(sl.SHORTLIST_OUT_FIELDS) <= set(tensors.FIELD_AXES)
+    assert "sub_lanes" in meshing.HOST_ONLY_FIELDS
+    assert "sub_lanes" in tensors.FIELD_DTYPES
+
+
+# -- mesh parity --------------------------------------------------------------
+
+
+def _mesh_parity(shape, n_clusters=64, n_items=60):
+    import jax
+
+    rng = random.Random(83)
+    clusters, cindex = _fleet(n_clusters, seed=83)
+    names = [c.metadata.name for c in clusters]
+    pls = _affinity_placements(rng, names, n=6)
+    items = _items(rng, n_items, pls)
+    est = GeneralEstimator()
+    cfg = sl.ShortlistConfig(k=24, min_cells=0, union_frac=1.0)
+    dense = _run(items, cindex, est, None, chunk=32)
+    n_dev = shape[0] * shape[1]
+    plan = meshing.activate(shape, devices=jax.devices()[:n_dev])
+    assert plan is not None
+    try:
+        shortlisted = _run(items, cindex, est, cfg, chunk=32)
+    finally:
+        meshing.deactivate()
+    _assert_parity(dense, shortlisted)
+
+
+def test_mesh_2dev_parity():
+    _mesh_parity((1, 2))
+
+
+@pytest.mark.slow
+def test_mesh_8dev_parity():
+    _mesh_parity((2, 4))
+
+
+@pytest.mark.slow
+def test_parity_fuzz_heavy():
+    for seed in range(8):
+        rng = random.Random(100 + seed)
+        clusters, cindex = _fleet(160, seed=seed)
+        names = [c.metadata.name for c in clusters]
+        pls = _affinity_placements(rng, names, n=16, lo=3, hi=28)
+        prev_of = {b: [(names[(b * 13 + 1) % len(names)], 1 + b % 4)]
+                   for b in range(0, 200, 7)}
+        items = _items(rng, 200, pls, prev_of=prev_of)
+        est = GeneralEstimator()
+        dense = _run(items, cindex, est, None, chunk=48)
+        shortlisted = _run(items, cindex, est,
+                           sl.ShortlistConfig(k=32, min_cells=0, union_frac=1.0), chunk=48)
+        _assert_parity(dense, shortlisted)
