@@ -1310,6 +1310,17 @@ def cmd_serve(args) -> int:
         except ValueError as e:
             print(str(e), file=sys.stderr)
             return 1
+    facade_addr = None
+    if args.facade:
+        # validate BEFORE the plane loads: a typo'd address must fail
+        # the command, not die after controllers are already running
+        host, _, port_s = args.facade.rpartition(":")
+        try:
+            facade_addr = (host or "127.0.0.1", int(port_s))
+        except ValueError:
+            print(f"--facade ADDR must be HOST:PORT (or :PORT), got "
+                  f"{args.facade!r}", file=sys.stderr)
+            return 1
     if args.chaos:
         # validate the fault spec BEFORE the plane loads: a typo'd chaos
         # spec must fail the command, never silently arm nothing
@@ -1530,6 +1541,31 @@ def cmd_serve(args) -> int:
         print(f"query plane at {api_url} "
               "(cluster proxy, search cache, metrics adapter; "
               f"karmadactl --server {api_url})")
+    facade_service = None
+    if facade_addr is not None:
+        # the facade plane (karmada_tpu/facade): scheduler-as-a-service
+        # over the wire tier, coalescing concurrent callers into one
+        # detached solve per batch — bound before controller threads so
+        # a port clash fails fast
+        from karmada_tpu import facade as facade_mod
+
+        facade_service = facade_mod.FacadeService(cp.scheduler, cp.store)
+        try:
+            fh, fp = facade_service.serve(host=facade_addr[0],
+                                          port=facade_addr[1])
+        except OSError as e:
+            print(f"--facade cannot bind {facade_addr[0]}:"
+                  f"{facade_addr[1]}: {e}", file=sys.stderr)
+            facade_service.close()
+            return 1
+        facade_mod.set_active(facade_service)
+        print(f"facade plane armed at {fh}:{fp} "
+              f"(SelectClusters/AssignReplicas/WhatIf, batch window "
+              f"{facade_service.batch_window}, deadline "
+              f"{facade_service.batch_deadline_s:g}s); counters at "
+              "/debug/facade, capacity queries at /whatif "
+              "(`karmadactl whatif --endpoint URL`, `karmadactl "
+              f"estimate --facade-addr {fh}:{fp}`)")
     cp.runtime.serve()
     loadgen_driver = None
     if loadgen_scenario is not None:
@@ -1561,6 +1597,11 @@ def cmd_serve(args) -> int:
     finally:
         if loadgen_driver is not None:
             loadgen_driver.stop()
+        if facade_service is not None:
+            from karmada_tpu import facade as facade_mod
+
+            facade_mod.set_active(None)
+            facade_service.close()
         if obs is not None:
             obs.stop()
         if api is not None:
@@ -1677,6 +1718,136 @@ def cmd_rebalance(args) -> int:
         return 1
     print(render_state(state))
     return 0
+
+
+def cmd_whatif(args) -> int:
+    """Ask a live serve process's facade plane a capacity-planning
+    question (/whatif, karmada_tpu/facade): a hypothetical solve on a
+    copy-on-write fork of live state — placements never move.
+
+      karmadactl whatif --endpoint URL --query placement --replicas 500
+      karmadactl whatif --endpoint URL --query cluster-loss
+      karmadactl whatif --endpoint URL --query headroom --cpu 1000m
+    """
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    params = {"query": args.query, "replicas": str(args.replicas),
+              "limit": str(args.limit)}
+    if args.cpu:
+        params["cpu"] = args.cpu
+    if args.memory:
+        params["memory"] = args.memory
+    if args.cluster:
+        params["cluster"] = args.cluster
+    if args.duplicated:
+        params["divided"] = "false"
+    base = args.endpoint.rstrip("/")
+    url = base + "/whatif?" + urllib.parse.urlencode(params)
+    try:
+        with urllib.request.urlopen(url, timeout=120) as r:
+            payload = json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read().decode())
+        except json.JSONDecodeError:
+            payload = {"error": str(e)}
+    except urllib.error.URLError as e:
+        print(f"cannot reach {base}: {e.reason}", file=sys.stderr)
+        return 1
+    if payload.get("error"):
+        print(payload["error"], file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(json.dumps(payload, indent=2))
+        return 0
+    print(_render_whatif(payload))
+    return 0
+
+
+def _render_whatif(payload: dict) -> str:
+    """Human rendering of one /whatif answer (whatif.py documents the
+    per-query result shapes)."""
+    res = payload.get("result", {})
+    lines = [f"what-if {payload.get('query')} "
+             f"(forked from {payload.get('source')} state)"]
+    query = payload.get("query")
+    if query == "placement":
+        lines.append(f"  replicas requested: {res.get('replicas')}")
+        lines.append(f"  outcome: {res.get('outcome')}"
+                     + (f" — {res['message']}" if res.get("message") else ""))
+        for a in res.get("assignments", []):
+            lines.append(f"    {a['cluster']:<24} {a['replicas']} replicas")
+    elif query == "cluster-loss":
+        lines.append(f"  worst single loss: {res.get('worst') or '(none)'}")
+        lines.append(f"  {'CLUSTER':<24} {'HOSTED':>8} {'REPLICAS':>9} "
+                     f"{'STRANDED':>9} {'REPLICAS':>9}")
+        for row in res.get("ranking", []):
+            trunc = (f"  (+{row['truncated']} unprobed)"
+                     if row.get("truncated") else "")
+            lines.append(
+                f"  {row['cluster']:<24} {row['bindings']:>8} "
+                f"{row['replicas']:>9} {row['stranded_bindings']:>9} "
+                f"{row['stranded_replicas']:>9}{trunc}")
+    elif query == "headroom":
+        lines.append(f"  max replicas that still fully schedule: "
+                     f"{res.get('max_replicas')} "
+                     f"({res.get('probes')} probe solves)")
+        for a in res.get("assignments", []):
+            lines.append(f"    {a['cluster']:<24} {a['replicas']} replicas")
+    else:
+        lines.append(f"  {json.dumps(res)}")
+    return "\n".join(lines)
+
+
+def cmd_estimate(args) -> int:
+    """One AssignReplicas call against a served facade plane over the
+    wire tier (serve --facade prints the bound address) — the
+    external-scheduler integration path, typed errors and all:
+
+      karmadactl estimate --facade-addr 127.0.0.1:PORT --replicas 50 \\
+          --cpu 500m --memory 1Gi
+    """
+    from karmada_tpu.estimator import wire
+    from karmada_tpu.estimator.client import EstimatorError
+    from karmada_tpu.facade import FacadeClient
+
+    host, _, port_s = args.facade_addr.rpartition(":")
+    try:
+        addr = (host or "127.0.0.1", int(port_s))
+    except ValueError:
+        print(f"--facade-addr must be HOST:PORT, got "
+              f"{args.facade_addr!r}", file=sys.stderr)
+        return 1
+    resource_request = {}
+    if args.cpu:
+        resource_request["cpu"] = args.cpu
+    if args.memory:
+        resource_request["memory"] = args.memory
+    req = wire.AssignReplicasRequest(
+        namespace=args.namespace, name=args.name,
+        replicas=args.replicas, resource_request=resource_request,
+        divided=not args.duplicated,
+        cluster_names=[c for c in args.clusters.split(",") if c])
+    client = FacadeClient(wire.TcpTransport(addr[0], addr[1], timeout=120))
+    try:
+        resp = client.assign_replicas(req)
+    except EstimatorError as e:
+        print(f"estimate failed ({e.kind}): {e}", file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+    if args.format == "json":
+        print(json.dumps(resp.to_json(), indent=2))
+        return 0
+    print(f"outcome: {resp.outcome}"
+          + (f" — {resp.message}" if resp.message else ""))
+    for a in resp.assignments:
+        print(f"  {a['cluster']:<24} {a['replicas']} replicas")
+    print(f"(coalesced batch {resp.batch_id}, {resp.batch_size} caller(s)"
+          + (f", trace {resp.trace_id}" if resp.trace_id else "") + ")")
+    return 0 if resp.outcome == "scheduled" else 1
 
 
 def cmd_resident(args) -> int:
@@ -2423,11 +2594,67 @@ def build_parser() -> argparse.ArgumentParser:
                          "scheduler queue with origin=rebalance; state "
                          "at /debug/rebalance (karmadactl rebalance "
                          "--endpoint URL)")
+    sv.add_argument("--facade", nargs="?", const="127.0.0.1:0", default="",
+                    metavar="ADDR",
+                    help="arm the facade plane (karmada_tpu/facade): "
+                         "serve SelectClusters/AssignReplicas/WhatIf "
+                         "over the estimator wire tier at ADDR (default "
+                         "127.0.0.1:0 = ephemeral port), coalescing "
+                         "concurrent callers into one detached solve "
+                         "per batch; what-if capacity queries at "
+                         "/whatif, counters at /debug/facade "
+                         "(karmadactl whatif / karmadactl estimate)")
 
     rb = sub.add_parser("rebalance")
     rb.add_argument("--endpoint", required=True,
                     help="observability endpoint URL of a live serve "
                          "process (serve --metrics-port PORT)")
+
+    wi = sub.add_parser("whatif")
+    wi.add_argument("--endpoint", required=True,
+                    help="observability endpoint URL of a live serve "
+                         "process with the facade plane armed "
+                         "(serve --metrics-port PORT --facade)")
+    wi.add_argument("--query", default="placement",
+                    choices=["placement", "cluster-loss", "headroom"],
+                    help="placement: where would N new replicas land; "
+                         "cluster-loss: which single cluster loss "
+                         "strands the most replicas; headroom: largest "
+                         "replica count that still fully schedules")
+    wi.add_argument("--replicas", type=int, default=1,
+                    help="replica count (placement) / search seed "
+                         "(headroom)")
+    wi.add_argument("--cpu", default="",
+                    help="per-replica cpu request, e.g. 500m")
+    wi.add_argument("--memory", default="",
+                    help="per-replica memory request, e.g. 1Gi")
+    wi.add_argument("--cluster", default="",
+                    help="cluster-loss: restrict to one named candidate")
+    wi.add_argument("--duplicated", action="store_true",
+                    help="Duplicated scheduling (full replica count on "
+                         "every eligible cluster) instead of Divided")
+    wi.add_argument("--limit", type=int, default=512,
+                    help="cluster-loss: per-cluster re-solve cap")
+    wi.add_argument("--format", choices=["text", "json"], default="text")
+
+    es = sub.add_parser("estimate")
+    es.add_argument("--facade-addr", required=True, metavar="HOST:PORT",
+                    help="wire address of a served facade plane "
+                         "(serve --facade prints it)")
+    es.add_argument("--replicas", type=int, default=1)
+    es.add_argument("--cpu", default="",
+                    help="per-replica cpu request, e.g. 500m")
+    es.add_argument("--memory", default="",
+                    help="per-replica memory request, e.g. 1Gi")
+    es.add_argument("--namespace", default="default")
+    es.add_argument("--name", default="estimate",
+                    help="binding name stamped on the facade ledger "
+                         "events for this call")
+    es.add_argument("--clusters", default="",
+                    help="comma-separated cluster-affinity restriction")
+    es.add_argument("--duplicated", action="store_true",
+                    help="Duplicated scheduling instead of Divided")
+    es.add_argument("--format", choices=["text", "json"], default="text")
 
     rs = sub.add_parser("resident")
     rs.add_argument("--endpoint", required=True,
@@ -2501,6 +2728,8 @@ COMMANDS = {
     "vet": cmd_vet,
     "loadgen": cmd_loadgen,
     "rebalance": cmd_rebalance,
+    "whatif": cmd_whatif,
+    "estimate": cmd_estimate,
     "resident": cmd_resident,
     "profile": cmd_profile,
 }
@@ -2561,6 +2790,13 @@ def _dispatch(args) -> int:
     if args.command == "rebalance":
         # talks to a live serve process over HTTP; no plane is opened
         return cmd_rebalance(args)
+    if args.command == "whatif":
+        # talks to a live serve process over HTTP; no plane is opened
+        return cmd_whatif(args)
+    if args.command == "estimate":
+        # talks to a served facade plane over the wire tier; no plane
+        # is opened
+        return cmd_estimate(args)
     if args.command == "explain":
         # kind mode reads only the model registry; binding mode talks to
         # a live serve process over HTTP — neither opens a plane

@@ -16,6 +16,12 @@ message schemas:
 
 Messages are dataclasses with explicit to/from_json so the wire format is
 stable and transport-independent.
+
+The same frame transport also carries the facade tier (karmada_tpu/facade):
+`SelectClusters`/`AssignReplicas` are the scheduler-as-a-service contract —
+a caller submits one small binding's requirements and gets a placement
+back, the shape a Go scheduler running with
+`--replica-scheduling-backend=tpu` would speak to this process.
 """
 
 from __future__ import annotations
@@ -32,6 +38,18 @@ from karmada_tpu.models.work import ReplicaRequirements
 from karmada_tpu.utils.quantity import Quantity
 
 UNAUTHENTIC_REPLICA = -1
+
+#: hard bound on one frame's payload: a corrupt/hostile length prefix must
+#: not become a multi-GiB allocation before the first payload byte arrives
+MAX_FRAME_BYTES = 64 * 1024 * 1024
+
+
+class FrameTooLarge(ValueError):
+    """Length prefix exceeds MAX_FRAME_BYTES.  A ValueError on purpose:
+    estimator.client.classify_exception maps ValueError to
+    EstimatorMalformed (a protocol fault), where a ConnectionError would
+    misreport it as EstimatorUnreachable and make the breaker retry a
+    peer that is speaking garbage."""
 
 
 # -- messages (pb/generated.proto equivalents) ------------------------------
@@ -212,6 +230,115 @@ class CapacitySnapshotResponse:
         return CapacitySnapshotResponse(
             cluster=d.get("cluster", ""), node_free=list(d.get("nodeFree", [])),
             node_labels=list(d.get("nodeLabels", [])))
+
+
+# -- facade messages (karmada_tpu/facade's scheduler-as-a-service tier) -----
+
+
+@dataclass
+class SelectClustersRequest:
+    """Feasibility query (the reference's SelectClusters phase: group +
+    filter): which member clusters can host this request class at all."""
+
+    namespace: str = "default"
+    name: str = ""
+    resource_request: Dict[str, str] = field(default_factory=dict)
+    cluster_names: List[str] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {"namespace": self.namespace, "name": self.name,
+                "resourceRequest": self.resource_request,
+                "clusterNames": self.cluster_names}
+
+    @staticmethod
+    def from_json(d: dict) -> "SelectClustersRequest":
+        return SelectClustersRequest(
+            namespace=d.get("namespace", "default"),
+            name=d.get("name", ""),
+            resource_request=dict(d.get("resourceRequest", {})),
+            cluster_names=list(d.get("clusterNames", [])),
+        )
+
+
+@dataclass
+class SelectClustersResponse:
+    clusters: List[str] = field(default_factory=list)
+    # per filtered-out cluster: the filter diagnosis (FitError shape)
+    excluded: Dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {"clusters": self.clusters, "excluded": self.excluded}
+
+    @staticmethod
+    def from_json(d: dict) -> "SelectClustersResponse":
+        return SelectClustersResponse(
+            clusters=list(d.get("clusters", [])),
+            excluded=dict(d.get("excluded", {})),
+        )
+
+
+@dataclass
+class AssignReplicasRequest:
+    """One small binding in, a placement out — the facade's core verb
+    (the reference's core.AssignReplicas seam served over the wire).
+    `divided` selects Divided+Aggregated packing; default is Duplicated
+    across every feasible cluster.  `cluster_names` restricts the
+    candidate set (a ClusterAffinity allowlist)."""
+
+    namespace: str = "default"
+    name: str = ""
+    replicas: int = 1
+    resource_request: Dict[str, str] = field(default_factory=dict)
+    divided: bool = False
+    cluster_names: List[str] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {"namespace": self.namespace, "name": self.name,
+                "replicas": self.replicas,
+                "resourceRequest": self.resource_request,
+                "divided": self.divided,
+                "clusterNames": self.cluster_names}
+
+    @staticmethod
+    def from_json(d: dict) -> "AssignReplicasRequest":
+        return AssignReplicasRequest(
+            namespace=d.get("namespace", "default"),
+            name=d.get("name", ""),
+            replicas=int(d.get("replicas", 1)),
+            resource_request=dict(d.get("resourceRequest", {})),
+            divided=bool(d.get("divided", False)),
+            cluster_names=list(d.get("clusterNames", [])),
+        )
+
+
+@dataclass
+class AssignReplicasResponse:
+    """`assignments` is the TargetCluster list ([{cluster, replicas}]);
+    `batch_id`/`batch_size` name the coalesced facade cycle this call
+    shared, so a caller can see how many peers rode its device dispatch."""
+
+    assignments: List[Dict] = field(default_factory=list)
+    outcome: str = "scheduled"  # scheduled | unschedulable | error
+    message: str = ""
+    trace_id: str = ""
+    batch_id: int = 0
+    batch_size: int = 0
+
+    def to_json(self) -> dict:
+        return {"assignments": self.assignments, "outcome": self.outcome,
+                "message": self.message, "traceId": self.trace_id,
+                "batchId": self.batch_id, "batchSize": self.batch_size}
+
+    @staticmethod
+    def from_json(d: dict) -> "AssignReplicasResponse":
+        return AssignReplicasResponse(
+            assignments=list(d.get("assignments", [])),
+            outcome=d.get("outcome", "scheduled"),
+            message=d.get("message", ""),
+            trace_id=d.get("traceId", ""),
+            batch_id=int(d.get("batchId", 0)),
+            batch_size=int(d.get("batchSize", 0)),
+        )
 
 
 def replicas_on_node(
@@ -410,8 +537,9 @@ def _send_frame(sock: socket.socket, payload: dict) -> None:
 def _recv_frame(sock: socket.socket) -> dict:
     header = _recv_exact(sock, 4)
     (length,) = struct.unpack(">I", header)
-    if length > 64 * 1024 * 1024:
-        raise ConnectionError("frame too large")
+    if length > MAX_FRAME_BYTES:
+        raise FrameTooLarge(
+            f"frame length {length} exceeds {MAX_FRAME_BYTES} bytes")
     return json.loads(_recv_exact(sock, length).decode("utf-8"))
 
 
@@ -440,6 +568,11 @@ class TcpTransport(Transport):
         sock = socket.create_connection(self.addr, timeout=self.timeout)
         if self.ssl_context is not None:
             sock = self.ssl_context.wrap_socket(sock, server_hostname=self.addr[0])
+        # create_connection's timeout bounds only the CONNECT; re-arm it on
+        # the (possibly TLS-wrapped) socket so every recv is bounded too —
+        # a stalled peer surfaces as socket.timeout (a TimeoutError, i.e.
+        # EstimatorTimeout through classify_exception), not a hang
+        sock.settimeout(self.timeout)
         return sock
 
     def call(self, method: str, request: dict) -> dict:
@@ -449,6 +582,14 @@ class TcpTransport(Transport):
             try:
                 _send_frame(self._sock, {"method": method, "body": request})
                 resp = _recv_frame(self._sock)
+            except (FrameTooLarge, socket.timeout):
+                # protocol desync / stalled peer: the stream cannot be
+                # trusted (a partial frame may still be in flight), and a
+                # blind resend could double-execute the call — drop the
+                # connection and surface the typed fault to the breaker
+                self._sock.close()
+                self._sock = None
+                raise
             except (ConnectionError, OSError):
                 # one reconnect attempt (sidecar restarts are routine)
                 self._sock.close()
@@ -471,7 +612,10 @@ class _Handler(socketserver.StreamRequestHandler):
         while True:
             try:
                 frame = _recv_frame(self.request)
-            except (ConnectionError, OSError):
+            except (FrameTooLarge, ConnectionError, OSError):
+                # an oversize prefix means the peer is desynced or hostile:
+                # there is no way to resync a length-prefixed stream, so
+                # the only safe response is dropping the connection
                 return
             try:
                 body = self.server.dispatch(  # type: ignore[attr-defined]

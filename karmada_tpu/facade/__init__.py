@@ -1,0 +1,90 @@
+"""Wire-compatible ReplicaEstimator facade: scheduler-as-a-service.
+
+BASELINE.json's north star is the batched TPU solver exposed as a
+`ReplicaEstimator`-style service a Go scheduler would call with
+`--replica-scheduling-backend=tpu`.  This package is that seam served
+over the repo's wire tier (estimator/wire.py's length-prefixed frames —
+the gRPC analogue, grpcio being absent by design):
+
+  * **Protocol** — `SelectClusters`/`AssignReplicas` request/response
+    messages (estimator/wire.py) plus the facade-only `WhatIf` query
+    (messages.py): many independent callers each submit ONE small
+    binding and get back a placement.
+  * **Coalescing service** — `FacadeService` (service.py) admits
+    concurrent in-flight calls through a deadline-vs-size batch former
+    (the scheduler's own admission shape), runs ONE detached solve
+    through the unchanged pipelined solver, and demuxes per-call
+    responses with trace ids + ledger events stamped per caller.  Many
+    small RPCs become one device dispatch — the economic argument for
+    the TPU sidecar.
+  * **What-if plane** — capacity-planning queries (whatif.py) answered
+    by hypothetical solves against a copy-on-write fork of the resident
+    masters' cluster view, never mutating live state; surfaced at
+    `/whatif`, `/debug/facade`, `serve --facade[=ADDR]`, and the
+    `karmadactl whatif` / `karmadactl estimate` verbs.
+
+Process-wide registry below follows the loadgen/chaos idiom: `serve
+--facade` arms one service, /debug endpoints read it lazily, and a
+disarmed plane reports ``{"enabled": False}``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from karmada_tpu.facade.client import FacadeClient
+from karmada_tpu.facade.messages import (
+    FACADE_METHODS,
+    WhatIfRequest,
+    WhatIfResponse,
+)
+from karmada_tpu.facade.service import FacadeService
+
+__all__ = [
+    "FACADE_METHODS",
+    "FacadeClient",
+    "FacadeService",
+    "WhatIfRequest",
+    "WhatIfResponse",
+    "active",
+    "set_active",
+    "state_payload",
+    "whatif_payload",
+]
+
+_LOCK = threading.Lock()
+_ACTIVE: list = [None]
+
+
+def set_active(service: Optional[FacadeService]) -> None:
+    with _LOCK:
+        _ACTIVE[0] = service
+
+
+def active() -> Optional[FacadeService]:
+    with _LOCK:
+        return _ACTIVE[0]
+
+
+def state_payload() -> dict:
+    """/debug/facade: the armed service's coalescing/what-if counters,
+    or the disarmed sentinel."""
+    svc = active()
+    if svc is None:
+        return {"enabled": False}
+    return svc.state_payload()
+
+
+def whatif_payload(params: dict) -> dict:
+    """/whatif: run one capacity-planning query against the armed
+    service (query params -> WhatIfRequest -> hypothetical solve)."""
+    svc = active()
+    if svc is None:
+        return {"enabled": False,
+                "error": "facade plane not armed (serve --facade)"}
+    try:
+        req = WhatIfRequest.from_params(params)
+        return svc.whatif(req).to_json()
+    except ValueError as e:  # unknown query / unparseable number -> 400
+        return {"enabled": True, "error": str(e)}

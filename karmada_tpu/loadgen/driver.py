@@ -503,6 +503,9 @@ class LoadDriver:
         self._killed: List[Cluster] = []   # kill/revive LIFO (driver thread)
         self._flapped: Dict[str, dict] = {}  # name -> original allocatable
         self._flap_rr = 0  # rotating flap_down victim cursor (driver thread)
+        # "whatif" event answers (facade capacity queries fired mid-soak;
+        # the whatif scenario asserts they leave placements bit-identical)
+        self.whatif_results: List[dict] = []
         self._installed = False
         self._orig_schedule = None
         self._prev_recorder = None
@@ -732,6 +735,23 @@ class LoadDriver:
             n for names in cached.values() for n in names) or None
 
     def _apply_cluster_event(self, spec) -> None:
+        if spec.kind == "whatif":
+            # a facade capacity query riding the soak (karmada_tpu/facade):
+            # a hypothetical solve on a copy-on-write fork of live state —
+            # the whatif scenario's control run proves it never moves a
+            # placement.  `spec` names the query (default placement),
+            # `count` carries the replica count.
+            from karmada_tpu.facade import messages as facade_messages
+            from karmada_tpu.facade import whatif as facade_whatif
+
+            req = facade_messages.WhatIfRequest(
+                query=spec.spec or facade_messages.QUERY_PLACEMENT,
+                replicas=max(spec.count, 1),
+                resource_request={"cpu": "500m", "memory": "512Mi"})
+            resp = facade_whatif.run_query(self.plane.scheduler,
+                                           self.plane.store, req)
+            self.whatif_results.append(resp.to_json())
+            return
         if spec.kind in ("chaos", "chaos_clear"):
             # scheduled fault window on the same virtual clock as the
             # traffic: arm/clear rules on the process-wide chaos plane

@@ -41,13 +41,19 @@ class ClusterEventSpec:
                 process-wide chaos plane — fault windows open here
     chaos_clear clear the chaos site named in `spec` (empty = all) —
                 fault windows close here
+    whatif      fire one facade capacity query (karmada_tpu/facade)
+                against the live plane: `spec` names the query
+                (placement | cluster-loss | headroom, default
+                placement), `count` carries the replica count; answers
+                accumulate on the driver's whatif_results and MUST
+                leave live placements bit-identical
     """
 
     at_frac: float  # fraction of the scenario duration
-    kind: str       # kill | revive | flap_down | flap_up | chaos | chaos_clear
+    kind: str       # kill|revive|flap_down|flap_up|chaos|chaos_clear|whatif
     count: int = 1
     scale: float = 0.5
-    spec: str = ""  # chaos fault spec / site (chaos kinds only)
+    spec: str = ""  # chaos fault spec / site / whatif query name
 
 
 @dataclass(frozen=True)
@@ -240,6 +246,31 @@ SCENARIOS: Dict[str, Scenario] = {s.name: s for s in (
                              spec="resident.mirror:corrupt#1"),
             ClusterEventSpec(at_frac=0.85, kind="chaos",
                              spec="device.dispatch:raise#1"),
+        ),
+    ),
+    # what-if isolation proof: steady traffic with facade capacity
+    # queries fired mid-soak (one of each kind, twice over).  Every
+    # query runs a DETACHED solve on a copy-on-write fork of live
+    # state, so the acceptance check is brutal and simple: the final
+    # placement map must be bit-identical to a control run with the
+    # whatif events stripped (tests/test_facade.py proves it).
+    Scenario(
+        name="whatif",
+        description="steady 0.5x load with facade what-if capacity "
+                    "queries riding the soak; placements must not move",
+        n_bindings=320, load_factor=0.5, deadline_cycles=6.0,
+        binding_style="divided", binding_replicas=2,
+        events=(
+            ClusterEventSpec(at_frac=0.3, kind="whatif", count=50,
+                             spec="placement"),
+            ClusterEventSpec(at_frac=0.4, kind="whatif", count=8,
+                             spec="headroom"),
+            ClusterEventSpec(at_frac=0.5, kind="whatif", count=16,
+                             spec="cluster-loss"),
+            ClusterEventSpec(at_frac=0.7, kind="whatif", count=200,
+                             spec="placement"),
+            ClusterEventSpec(at_frac=0.8, kind="whatif", count=4,
+                             spec="headroom"),
         ),
     ),
     # hotspot (ISSUE 10 rebalance acceptance shape): 4 of 6 clusters

@@ -60,6 +60,9 @@ SPAN_RESIDENT_AUDIT = "resident.audit"    # bit-exact parity audit
 SPAN_REBALANCE_CYCLE = "rebalance.cycle"    # one detect->drain->audit pass
 SPAN_REBALANCE_DETECT = "rebalance.detect"  # tensor assembly + jit score
 SPAN_REBALANCE_DRAIN = "rebalance.drain"    # paced graceful evictions
+# karmada_tpu/facade (scheduler-as-a-service)
+SPAN_FACADE_CYCLE = "facade.cycle"          # one coalesced facade dispatch
+SPAN_FACADE_WHATIF = "facade.whatif"        # one what-if hypothetical solve
 # controllers
 SPAN_BINDING_RENDER = "binding.ensure_works"
 SPAN_DETECTOR_MATCH = "detector.match_policy"
@@ -72,7 +75,7 @@ SPAN_NAMES = (
     SPAN_ESTIMATOR_RPC, SPAN_RESIDENT_APPLY, SPAN_RESIDENT_ENCODE,
     SPAN_RESIDENT_AUDIT, SPAN_BINDING_RENDER, SPAN_DETECTOR_MATCH,
     SPAN_WARMUP, SPAN_REBALANCE_CYCLE, SPAN_REBALANCE_DETECT,
-    SPAN_REBALANCE_DRAIN,
+    SPAN_REBALANCE_DRAIN, SPAN_FACADE_CYCLE, SPAN_FACADE_WHATIF,
 )
 
 # every pipeline stage a healthy device chunk must traverse (the tier-1

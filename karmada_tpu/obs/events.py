@@ -95,6 +95,12 @@ REASON_CHAOS_FAULT_INJECTED = "ChaosFaultInjected"
 
 REASON_SHORTLIST_FALLBACK = "ShortlistFallback"
 
+# facade plane (karmada_tpu/facade): per-caller outcome events, stamped
+# with the coalesced batch id so a caller's timeline names the shared
+# device dispatch it rode
+REASON_FACADE_ASSIGNED = "FacadeAssigned"
+REASON_FACADE_REJECTED = "FacadeRejected"
+
 EVENTS_TOTAL = REGISTRY.counter(
     "karmada_events_total",
     "Lifecycle-ledger events recorded (coalesced repeats count each "

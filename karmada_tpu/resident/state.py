@@ -497,6 +497,19 @@ class ResidentState:
         self.cycle_log: deque = deque(maxlen=cycle_log_cap)
 
     # -- lifecycle -----------------------------------------------------------
+    def fork_clusters(self) -> List:
+        """A deep-copied fork of the plane's member-cluster view for
+        hypothetical (what-if) solves: the masters themselves are frozen
+        device arrays shared by reference (copy-on-write by
+        construction), and the host-side Cluster objects are the only
+        mutable tier — so the fork copies exactly those.  A what-if solve
+        may decorate, drain, or delete the copies freely; the live plane
+        never observes it.  Returns [] before the first begin_cycle
+        (the caller falls back to a store snapshot)."""
+        import copy
+
+        return [copy.deepcopy(c) for c in self.clusters]
+
     def begin_cycle(self, clusters: Sequence,
                     deltas: Optional[CycleDeltas] = None) -> None:
         """Advance the plane to this cycle's cluster snapshot: apply the

@@ -770,6 +770,32 @@ class Scheduler:
     def schedule_batch(
         self, bindings: List[ResourceBinding], clusters: List[Cluster]
     ) -> List[object]:
+        results, affinity_name = self.solve_batch(bindings, clusters)
+        outcomes: List[object] = []
+        for i, rb in enumerate(bindings):
+            res = results.get(i)
+            # _apply_result may downgrade a success to unschedulable (e.g.
+            # the quota-enforcement admission denies the patch) — the queue
+            # must route on the EFFECTIVE outcome
+            outcomes.append(self._apply_result(rb, res, affinity_name.get(i, "")))
+        return outcomes
+
+    def solve_batch(
+        self, bindings: List[ResourceBinding], clusters: List[Cluster],
+        *, detached: bool = False,
+    ) -> Tuple[Dict[int, object], Dict[int, str]]:
+        """The affinity-failover solve loop WITHOUT the store patch-back:
+        returns ({index: List[TargetCluster] | Exception}, {index:
+        affinity term name}).  The live path (schedule_batch) applies the
+        results to the store; the facade/what-if plane (karmada_tpu/
+        facade) consumes them directly.
+
+        ``detached=True`` is the hypothetical-solve contract: no explain
+        sampling, no resident-plane advance, no encoder-cache reuse, no
+        mid-serve degradation — the solve reads the cluster snapshot it
+        was handed and touches NOTHING owned by the live cycle worker, so
+        it is safe to run from a facade thread concurrently with live
+        cycles (detached callers serialize among themselves)."""
         # affinity failover loop: term index per binding
         term_idx: Dict[int, int] = {}
         active: List[Tuple[int, ResourceBinding]] = list(enumerate(bindings))
@@ -777,11 +803,12 @@ class Scheduler:
         affinity_name: Dict[int, str] = {}
         # explain plane: one sampling decision per cycle (every affinity
         # round of a sampled cycle records, so a failover story is whole)
-        explain_rec = self._explain_sample()
-        self._cycle_explain = explain_rec
+        explain_rec = None if detached else self._explain_sample()
+        if not detached:
+            self._cycle_explain = explain_rec
         keys_all = [f"{rb.namespace}/{rb.name}" for rb in bindings]
         tokens_all = None
-        if self._resident is not None:
+        if self._resident is not None and not detached:
             from karmada_tpu.resident import RowToken
 
             tokens_all = []
@@ -814,7 +841,8 @@ class Scheduler:
                                   explain=explain_rec,
                                   tokens=([tokens_all[i] for i, _ in active]
                                           if tokens_all is not None
-                                          else None))
+                                          else None),
+                                  detached=detached)
 
             next_active: List[Tuple[int, ResourceBinding]] = []
             for (i, rb), res in zip(active, outcome):
@@ -827,14 +855,7 @@ class Scheduler:
                 results[i] = res
             active = next_active
 
-        outcomes: List[object] = []
-        for i, rb in enumerate(bindings):
-            res = results.get(i)
-            # _apply_result may downgrade a success to unschedulable (e.g.
-            # the quota-enforcement admission denies the patch) — the queue
-            # must route on the EFFECTIVE outcome
-            outcomes.append(self._apply_result(rb, res, affinity_name.get(i, "")))
-        return outcomes
+        return results, affinity_name
 
     def _explain_sample(self) -> Optional["obs_decisions.DecisionRecorder"]:
         """The decision recorder for THIS cycle, or None: the explain
@@ -894,6 +915,7 @@ class Scheduler:
         items: List[Tuple[ResourceBindingSpec, ResourceBindingStatus]],
         clusters: List[Cluster],
         out: List[object],
+        detached: bool = False,
     ) -> List[int]:
         """backend="native": the compiled C++ pipeline (karmada_tpu/native)
         schedules the whole batch on host; bindings in its documented
@@ -913,14 +935,18 @@ class Scheduler:
             return []
         t0 = time.perf_counter()
         # one snapshot per cluster list: the affinity-failover loop re-solves
-        # against the same snapshot object each round (EncoderCache analog)
-        cached = self._native_snap
+        # against the same snapshot object each round (EncoderCache analog).
+        # A detached solve builds its own snapshot and leaves the cache
+        # alone — it runs off the cycle worker, and clobbering the live
+        # worker's cached snapshot from a facade thread would race it.
+        cached = None if detached else self._native_snap
         if cached is not None and cached[0] is clusters:
             snap = cached[1]
         else:
             snap = native_mod.NativeSnapshot(
                 clusters, native_mod.collect_res_names(items))
-            self._native_snap = (clusters, snap)
+            if not detached:
+                self._native_snap = (clusters, snap)
         nb = native_mod.marshal_batch(items, snap)
         t1 = time.perf_counter()
         sched_metrics.STEP_LATENCY.observe(
@@ -958,6 +984,7 @@ class Scheduler:
         keys: Optional[List[str]] = None,
         explain=None,
         tokens=None,
+        detached: bool = False,
     ) -> Dict[int, object]:
         """backend="device": one batched cycle through the pipelined chunk
         executor (scheduler/pipeline.py — the same loop bench.py measures).
@@ -998,7 +1025,17 @@ class Scheduler:
                     return {}
         self._ensure_mesh()
         encode = None
-        if self._resident is not None:
+        if detached:
+            # detached (facade/what-if) cycle: per-call encoder state only.
+            # The resident plane's begin_cycle would DRAIN the live delta
+            # tracker and the shared EncoderCache belongs to the cycle
+            # worker — a hypothetical solve must touch neither.  Shortlist
+            # and carry still compose below: this is the same pipelined
+            # executor the live path runs, minus the live-state hooks.
+            cindex = tensors.ClusterIndex.build(clusters)
+            cache = tensors.EncoderCache()
+            cache.reset_for_cycle()
+        elif self._resident is not None:
             # resident-state plane: advance the persistent tensors by this
             # window's coalesced watch deltas (or rebuild losslessly on a
             # structural change), then hand the pipeline an encoder that
@@ -1231,22 +1268,32 @@ class Scheduler:
         keys: Optional[List[str]] = None,
         explain=None,
         tokens=None,
+        detached: bool = False,
     ) -> List[object]:
         """Returns per item either List[TargetCluster] or an Exception."""
         cal = serial.make_cal_available(self.estimators)
         out: List[object] = [None] * len(items)
         device_idx: List[int] = []
         if self.backend == "device" and items:
-            solved = self._solve_device_guarded(items, clusters,
-                                                keys=keys, explain=explain,
-                                                tokens=tokens)
+            if detached:
+                # no mid-serve death guard: a detached (facade/what-if)
+                # solve must never degrade the LIVE backend as a side
+                # effect — its caller bounds it with transport timeouts
+                solved = self._solve_device(items, clusters, keys=keys,
+                                            detached=True)
+            else:
+                solved = self._solve_device_guarded(items, clusters,
+                                                    keys=keys,
+                                                    explain=explain,
+                                                    tokens=tokens)
             for i, res in solved.items():
                 out[i] = res
             device_idx = list(solved.keys())
         # not elif: the guard may have just degraded device -> native, and
         # the CURRENT batch deserves the fast path too
         if self.backend == "native" and items and not device_idx:
-            device_idx = self._solve_native(items, clusters, out)
+            device_idx = self._solve_native(items, clusters, out,
+                                            detached=detached)
         device_set = set(device_idx)
         host_idx = [i for i in range(len(items)) if i not in device_set]
         if host_idx:

@@ -33,6 +33,18 @@ ThreadingHTTPServer serves:
                          eviction/conservation totals, pacing budget;
                          render with `karmadactl rebalance --endpoint`
 
+    /debug/facade        facade plane (karmada_tpu/facade, armed by
+                         `serve --facade[=ADDR]`): call/batch totals,
+                         the coalesce ratio, in-flight depth, what-if
+                         query tallies, the bound wire address;
+                         {"enabled": false} when disarmed
+    /whatif              capacity-planning queries against the armed
+                         facade plane (?query=placement|cluster-loss|
+                         headroom&replicas=N&cpu=Q&memory=Q&divided=
+                         &cluster=&limit=): a hypothetical solve on a
+                         copy-on-write fork of live state — never
+                         mutates a placement; what `karmadactl whatif`
+                         polls
     /debug/chaos         chaos fault-injection plane (karmada_tpu/chaos,
                          armed by `serve --chaos SPEC`): armed rules with
                          fire counts, per-site totals, the recent fire
@@ -345,6 +357,18 @@ class ObservabilityServer:
 
             return (json.dumps(rebalance.state_payload()).encode(),
                     "application/json", 200)
+        if path == "/debug/facade":
+            from karmada_tpu import facade
+
+            return (json.dumps(facade.state_payload()).encode(),
+                    "application/json", 200)
+        if path == "/whatif":
+            from karmada_tpu import facade
+
+            payload = facade.whatif_payload(self._query_params(query))
+            code = 200 if "error" not in payload else (
+                503 if not payload.get("enabled", True) else 400)
+            return json.dumps(payload).encode(), "application/json", code
         if path == "/debug/timeseries":
             from karmada_tpu.obs import timeseries
 

@@ -102,3 +102,18 @@ def test_vet_covers_resident_plane():
     # ndarray field is spec'd or declared host-only
     assert fields <= keys | host_only | extra, \
         sorted(fields - keys - host_only - extra)
+
+
+def test_vet_covers_facade_plane():
+    """The gate extends over karmada_tpu/facade/: the analyzer walk must
+    reach every module of the subsystem, so its metric names stay inside
+    the metric-docs pass and its code inside every other vet rule.  A
+    rename or package move would silently drop the facade out of the
+    gate; this pins it in (the resident-plane test's shape)."""
+    from karmada_tpu.analysis.core import collect_files
+
+    files = collect_files([PKG])
+    facade = {os.path.basename(sf.path) for sf in files
+              if (os.sep + "facade" + os.sep) in sf.path}
+    assert {"__init__.py", "client.py", "messages.py", "metrics.py",
+            "service.py", "whatif.py"} <= facade

@@ -167,6 +167,18 @@ def main() -> int:
                           "admission": soak.get("admission"),
                           "overload": soak.get(
                               "starvation", {}).get("overload_entered")})
+                if "facade" in detail:
+                    # facade coalescing summary as a structured line
+                    # (bench --facade payloads; the full record is in
+                    # detail.facade / the persisted facade.json)
+                    fc = detail["facade"]
+                    jlog({"event": "facade",
+                          "ts": round(time.time(), 3),
+                          "callers": fc.get("callers"),
+                          "batches": fc.get("batches"),
+                          "coalesce_ratio": fc.get("coalesce_ratio"),
+                          "speedup_x": fc.get("speedup_x"),
+                          "whatif_isolated": fc.get("whatif_isolated")})
                 led = ((detail.get("soak") or {}).get("events")
                        or (detail.get("chaos") or {}).get("events")
                        or (detail.get("rebalance") or {}).get("events"))
