@@ -490,6 +490,7 @@ from karmada_tpu.models.policy import (
 from karmada_tpu.models.work import (
     ObjectReference,
     ReplicaRequirements,
+    ResourceBinding,
     ResourceBindingSpec,
     ResourceBindingStatus,
 )
@@ -2613,6 +2614,231 @@ def run_megafleet_pipeline(items, cindex, estimator, chunk, waves, cfg):
             chunk_wall, failures)
 
 
+def run_incremental(args) -> int:
+    """bench --incremental: the dirty-set steady state at megafleet
+    scale (ops/dirty + scheduler/incremental).  Same 1M x 10k fleet
+    shape as --megafleet, but RESIDENT: adopt once (full solve), then
+    watch-driven cycles that re-solve only the dirty sub-batch against
+    the carried capacity ledger.  Legs:
+
+      adopt     full solve + write-back + self-churn settle + cluster
+                status catch-up (the whole ledger retires) — untimed.
+      steady    `--incremental-cycles` timed cycles at
+                `--incremental-churn` fraction (replica bumps + rv, the
+                coalesced-deltas contract); p50/p99 wall, dirty rows,
+                dispatch groups.
+      capacity  a cluster status flap mid-stream (ledger lane retire on
+                the hot path).
+      audit     one final forced bit-exact dense-control audit — parity
+                asserted in-run against the SAME pre-cycle ledger.
+
+    Exit 1 on audit mismatch, any shortlist fallback (silent dense
+    work), any chunk-dragged fallback row, or a steady-state speedup
+    below 20x vs the committed MEGAFLEET_r01 full-cycle wall."""
+    import resource
+
+    force_cpu_fallback()
+    from karmada_tpu.obs import devprof
+    from karmada_tpu.ops import shortlist as sl_mod
+    from karmada_tpu.resident import ResidentState
+    from karmada_tpu.resident.deltas import CycleDeltas
+    from karmada_tpu.scheduler.incremental import IncrementalSolver
+
+    rng = random.Random(20260807)
+    n_clusters = args.incremental_clusters
+    n_regions = args.incremental_regions
+    n_bindings = args.incremental_bindings
+    chunk = args.chunk
+    _hb(f"incremental: building {n_clusters} clusters in {n_regions} "
+        f"regions, {n_bindings} bindings")
+    clusters, placements = build_megafleet(rng, n_clusters, n_regions)
+    # steady-fit fleet: triple the pod envelope so every dynamic row
+    # converges (assigned == replicas).  The steady-state claim is about
+    # CHURN cost — rows the fleet cannot fit are permanently capacity-
+    # sensitive and re-price every cycle by design (they are the
+    # contention story, measured in --megafleet)
+    for c in clusters:
+        q = c.status.resource_summary.allocatable["pods"]
+        c.status.resource_summary.allocatable["pods"] = (
+            Quantity.from_units(int(q.value()) * 3))
+    specs = build_mega_bindings(rng, n_bindings, placements, block=chunk)
+    bindings = [
+        ResourceBinding(
+            metadata=ObjectMeta(namespace=spec.resource.namespace,
+                                name=spec.resource.name,
+                                resource_version=1),
+            spec=spec, status=status)
+        for spec, status in specs
+    ]
+    del specs
+
+    state = ResidentState(audit_interval=0)
+    cfg = sl_mod.ShortlistConfig(k=args.incremental_k, min_cells=0)
+    solver = IncrementalSolver(state, GeneralEstimator(), chunk=chunk,
+                               audit_every=args.audit_every,
+                               shortlist=cfg)
+    fb0 = sl_mod.SHORTLIST_FALLBACKS.total()
+    drag0 = sl_mod.SHORTLIST_FALLBACK_ROWS.value(kind="chunk_drag")
+    needed0 = sl_mod.SHORTLIST_FALLBACK_ROWS.value(kind="needed")
+
+    _hb("incremental: adopt (full solve)")
+    rep = solver.adopt(clusters, bindings)
+    adopt_s = rep.seconds
+    _hb(f"incremental: adopt done in {adopt_s:.1f}s "
+        f"({len(solver.results)} results); write-back + settle")
+    written = solver.write_back()
+    t0 = time.perf_counter()
+    settle = solver.cycle(clusters, bindings, CycleDeltas())
+    settle_s = time.perf_counter() - t0
+    solver.write_back()
+    _hb(f"incremental: settle cycle {settle_s:.1f}s "
+        f"(dirty {settle.dirty} of {settle.total})")
+    # cluster status catch-up: every member reports fresh capacity, so
+    # the entire adopt-era ledger retires (reported availability now
+    # embeds it)
+    for c in clusters:
+        c.metadata.resource_version += 1
+    catchup = solver.cycle(clusters, bindings, CycleDeltas())
+    solver.write_back()
+    ledger_live = sum(int(np.count_nonzero(a))
+                      for a in solver.ledger.milli.values())
+    _hb(f"incremental: status catch-up (dirty {catchup.dirty}, "
+        f"{ledger_live} live ledger lanes)")
+
+    # -- steady-state churn cycles -----------------------------------------
+    churned = max(1, int(n_bindings * args.incremental_churn))
+    walls, dirties, group_counts = [], [], []
+    for cyc in range(args.incremental_cycles):
+        touched = []
+        for pos in rng.sample(range(n_bindings), churned):
+            rb = bindings[pos]
+            rb.spec.replicas = max(
+                1, rb.spec.replicas + rng.choice((-1, 1)))
+            rb.metadata.resource_version += 1
+            touched.append((rb.namespace, rb.name))
+        deltas = CycleDeltas(bindings_touched=touched)
+        t0 = time.perf_counter()
+        rep = solver.cycle(clusters, bindings, deltas)
+        wall = time.perf_counter() - t0
+        solver.write_back()
+        assert rep.mode == "incremental", rep
+        walls.append(wall)
+        dirties.append(rep.dirty)
+        group_counts.append(len(rep.groups))
+        _hb(f"incremental: steady cycle {cyc + 1}/"
+            f"{args.incremental_cycles}: {wall:.3f}s, dirty {rep.dirty}, "
+            f"{len(rep.groups)} dispatch group(s)")
+
+    # -- capacity churn leg -------------------------------------------------
+    flapped = rng.sample(clusters, 2)
+    for c in flapped:
+        q = c.status.resource_summary.allocatable["pods"]
+        c.status.resource_summary.allocatable["pods"] = (
+            Quantity.from_units(max(8, int(q.value()) - 16)))
+        c.metadata.resource_version += 1
+    t0 = time.perf_counter()
+    cap_rep = solver.cycle(clusters, bindings, CycleDeltas())
+    cap_wall = time.perf_counter() - t0
+    solver.write_back()
+    _hb(f"incremental: capacity flap cycle {cap_wall:.3f}s "
+        f"(dirty {cap_rep.dirty})")
+
+    # -- final forced audit (the bit-exact gate, in-run) --------------------
+    _hb("incremental: forced dense-control audit")
+    t0 = time.perf_counter()
+    audit_rep = solver.cycle(clusters, bindings, CycleDeltas(),
+                             force_audit=True)
+    audit_wall = time.perf_counter() - t0
+    devprof.refresh_memory_gauges()
+
+    p50 = float(np.percentile(walls, 50))
+    p99 = float(np.percentile(walls, 99))
+    baseline_s = 140.59  # MEGAFLEET_r01 real-leg full-cycle wall
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "MEGAFLEET_r01.json")) as f:
+            baseline_s = float(
+                json.load(f)["detail"]["real"]["wall_s"])
+    except (OSError, KeyError, ValueError):
+        pass
+    speedup = baseline_s / p50 if p50 > 0 else 0.0
+    fallbacks = int(sl_mod.SHORTLIST_FALLBACKS.total() - fb0)
+    chunk_drag = int(
+        sl_mod.SHORTLIST_FALLBACK_ROWS.value(kind="chunk_drag") - drag0)
+    needed_rows = int(
+        sl_mod.SHORTLIST_FALLBACK_ROWS.value(kind="needed") - needed0)
+
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    payload = {
+        "fleet": {"bindings": n_bindings, "clusters": n_clusters,
+                  "regions": n_regions, "k": args.incremental_k,
+                  "chunk": chunk},
+        "adopt": {"wall_s": round(adopt_s, 3), "written_back": written,
+                  "settle_wall_s": round(settle_s, 3),
+                  "settle_dirty": settle.dirty},
+        "catchup": {"dirty": catchup.dirty,
+                    "ledger_live_lanes": ledger_live},
+        "steady": {
+            "churn_frac": args.incremental_churn,
+            "churned_per_cycle": churned,
+            "cycles": args.incremental_cycles,
+            "wall_p50_s": round(p50, 4),
+            "wall_p99_s": round(p99, 4),
+            "walls_s": [round(w, 4) for w in walls],
+            "dirty_rows": dirties,
+            "dirty_rows_mean": round(float(np.mean(dirties)), 1),
+            "dispatch_groups": group_counts,
+        },
+        "capacity_churn": {"flapped": len(flapped),
+                           "wall_s": round(cap_wall, 4),
+                           "dirty": cap_rep.dirty},
+        "audit": {"outcome": audit_rep.audit_outcome,
+                  "wall_s": round(audit_wall, 3),
+                  "rows": audit_rep.total,
+                  "audit_every": args.audit_every},
+        "fallbacks": {"shortlist_chunks": fallbacks,
+                      "rows_needed": needed_rows,
+                      "rows_chunk_drag": chunk_drag},
+        "speedup": {"baseline_full_cycle_s": baseline_s,
+                    "steady_p50_s": round(p50, 4),
+                    "speedup_x": round(speedup, 1)},
+        "memory": {
+            "devices": devprof.memory_stats_payload(),
+            "peak_rss_bytes": int(ru.ru_maxrss) * 1024,
+        },
+    }
+    ok = (audit_rep.audit_outcome == "ok" and fallbacks == 0
+          and chunk_drag == 0 and speedup >= 20.0)
+    root = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(root, "MEGAFLEET_r02.json")
+    summary = {
+        "metric": f"incremental steady-state cycle ({n_bindings}x"
+                  f"{n_clusters}, {args.incremental_churn:.2%} churn) "
+                  "vs full re-solve",
+        "value": round(speedup, 1),
+        "unit": "x speedup",
+        "vs_baseline": round(speedup, 1),
+        "detail": {**payload, "incremental": {
+            "adopt_s": round(adopt_s, 3),
+            "steady_p50_s": round(p50, 4),
+            "steady_p99_s": round(p99, 4),
+            "dirty_rows_mean": round(float(np.mean(dirties)), 1),
+            "speedup_x": round(speedup, 1),
+            "audit_outcome": audit_rep.audit_outcome,
+            "fallbacks": fallbacks,
+            "chunk_drag_rows": chunk_drag,
+        }, "megafleet_r02_path": out_path, "ok": ok},
+    }
+    with open(out_path, "w") as f:
+        json.dump(summary, f, indent=2)
+    os.makedirs(args.ckpt_dir, exist_ok=True)
+    with open(os.path.join(args.ckpt_dir, "megafleet_incremental.json"),
+              "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary))
+    return 0 if ok else 1
+
+
 def _synth_coo(batch, err_every: int = 97):
     """A realistic decode workload without paying a 5000-cluster XLA:CPU
     solve: per ROUTE_DEVICE row, Duplicated placements emit one entry per
@@ -2964,6 +3190,35 @@ def main() -> None:
                     help="tier-1 candidate lanes per binding")
     ap.add_argument("--megafleet-sample", type=int, default=2048,
                     help="dense-comparison slice for parity + recall")
+    ap.add_argument("--incremental", action="store_true",
+                    help="incremental acceptance mode (ops/dirty + "
+                         "scheduler/incremental): the dirty-set steady "
+                         "state at megafleet scale — adopt once, then "
+                         "watch-driven cycles re-solving only the dirty "
+                         "sub-batch against the carried capacity ledger; "
+                         "steady p50/p99 at the configured churn, a "
+                         "cluster-status capacity flap, and a final "
+                         "forced bit-exact dense-control audit; emits "
+                         "MEGAFLEET_r02.json.  XLA:CPU, never blocks on "
+                         "the tunnel.  Exit 1 on audit mismatch, any "
+                         "shortlist fallback, any chunk-dragged fallback "
+                         "row, or steady speedup < 20x vs MEGAFLEET_r01")
+    ap.add_argument("--incremental-bindings", type=int, default=1_000_000)
+    ap.add_argument("--incremental-clusters", type=int, default=10_000)
+    ap.add_argument("--incremental-regions", type=int, default=200)
+    ap.add_argument("--incremental-k", type=int, default=64,
+                    help="tier-1 candidate lanes per binding (the "
+                         "incremental cycles keep the two-tier shortlist "
+                         "armed end to end)")
+    ap.add_argument("--incremental-cycles", type=int, default=8,
+                    help="timed steady-state churn cycles")
+    ap.add_argument("--incremental-churn", type=float, default=0.001,
+                    help="per-cycle churned-binding fraction (replica "
+                         "bumps + rv, the coalesced-deltas contract)")
+    ap.add_argument("--audit-every", type=int, default=16,
+                    help="incremental audit cadence (every Nth cycle "
+                         "runs the full dense control bit-exact; 0 "
+                         "disables — the final audit is always forced)")
     ap.add_argument("--mesh", nargs="?", const="auto", default=None,
                     help="mesh bench mode: run the SAME workload through "
                          "the pipelined executor single-device and sharded "
@@ -3096,6 +3351,11 @@ def main() -> None:
         # init (the mode validates the two-tier solve, never the tunnel)
         _HB_ON = True
         raise SystemExit(run_megafleet(args))
+    if args.incremental:
+        # incremental mode is self-contained like --megafleet: XLA:CPU
+        # forced before backend init, no probe, no watchdog parent
+        _HB_ON = True
+        raise SystemExit(run_incremental(args))
     if args.delta:
         # delta mode is host-only and self-contained: the resident plane's
         # device-path code runs byte-identical on XLA:CPU (forced before

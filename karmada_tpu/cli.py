@@ -1438,17 +1438,18 @@ def cmd_serve(args) -> int:
               "gather path is not armed", file=sys.stderr)
     if shortlist_k is not None:
         if cp.scheduler.shortlist_k:
+            fused_sl = (" via the device slot store (sub-batch gathers "
+                        "straight into the candidate union)"
+                        if args.resident_fused and args.resident else "")
             print(f"shortlist plane armed (k={shortlist_k}): chunks at/"
                   f"above {cp.scheduler.shortlist_min_cells} dense cells "
                   "run the two-tier solve (tier-1 candidate kernel -> "
-                  "dense solver over the candidate union); fallbacks are "
-                  "counted in karmada_shortlist_fallbacks_total; state "
-                  "in /debug/state shortlist section")
-        elif args.resident_fused and args.resident:
-            print("WARNING: --shortlist is incompatible with "
-                  "--resident-fused (the device slot store owns the "
-                  "binding rows); the shortlist plane is not armed",
-                  file=sys.stderr)
+                  f"dense solver over the candidate union){fused_sl}; "
+                  "super-k_max rows are truncated out and re-solved "
+                  "per-binding at full width; fallbacks are counted in "
+                  "karmada_shortlist_fallbacks_total (row-granular in "
+                  "karmada_shortlist_fallback_rows_total); state in "
+                  "/debug/state shortlist section")
         else:
             print(f"WARNING: --shortlist needs the device backend "
                   f"(running backend={cp.scheduler.backend}); the "
@@ -2582,8 +2583,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "default K=64) and dispatch the dense solver "
                          "over the candidate union — B*K cells instead "
                          "of B*C, bit-exact when every binding's "
-                         "eligible set fits K, loud dense fallback "
-                         "otherwise (karmada_shortlist_fallbacks_total)")
+                         "eligible set fits K; rows whose eligible set "
+                         "exceeds the widen ceiling are truncated out "
+                         "and re-solved per-binding at full width "
+                         "(truncation-with-recall), so one huge row no "
+                         "longer drags its whole chunk dense; remaining "
+                         "fallbacks stay loud "
+                         "(karmada_shortlist_fallbacks_total, row-level "
+                         "karmada_shortlist_fallback_rows_total); "
+                         "composes with --resident-fused via a device "
+                         "slot-store sub-gather")
     sv.add_argument("--rebalance", nargs="?", const="30", default=None,
                     metavar="INTERVAL",
                     help="arm the rebalance plane (karmada_tpu/rebalance): "

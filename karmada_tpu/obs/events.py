@@ -94,6 +94,11 @@ REASON_HPA_FAST_PATH = "HpaFastPathPush"
 REASON_CHAOS_FAULT_INJECTED = "ChaosFaultInjected"
 
 REASON_SHORTLIST_FALLBACK = "ShortlistFallback"
+REASON_SHORTLIST_TRUNCATE = "ShortlistTruncate"
+
+# incremental steady-state solve (scheduler/incremental.py)
+REASON_INCREMENTAL_FULL_SOLVE = "IncrementalFullSolve"
+REASON_INCREMENTAL_AUDIT_MISMATCH = "IncrementalAuditMismatch"
 
 # facade plane (karmada_tpu/facade): per-caller outcome events, stamped
 # with the coalesced batch id so a caller's timeline names the shared

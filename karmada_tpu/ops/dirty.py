@@ -1,0 +1,188 @@
+"""Device-side dirty-row detection for the incremental steady-state solve.
+
+The reference control plane is watch-driven: between cycles almost
+nothing changes, and karmada's reconcile loop only touches what the
+watch stream dirtied.  The batched solver's equivalent is this kernel:
+one jitted pass over the binding-row SLOT STORE (the resident plane's
+[cap]-leading masters / device mirrors, karmada_tpu/resident/state.py)
+classifies every row as clean or dirty for the cycle, and the
+incremental solver (karmada_tpu/scheduler/incremental.py) re-solves ONLY
+the dirty sub-batch.  Nothing here materializes an [n, C] plane — the
+pass is O(cap * (Kp + Ke + F)) with F the cycle's handful of
+feasibility-flip lanes.
+
+Derivation rules (docs/PERF_NOTES.md "Incremental solve" is the prose
+version; the solver math referenced is ops/solver._assign_lanes /
+wave_step):
+
+  rv-churn     the binding itself was written this window (resident
+               deltas.bindings_touched + the incremental solver's own
+               write-backs) — its encoded row is stale, re-solve.
+  route        rows the compact device tier does not own (spread / big /
+               host routes) re-solve every cycle: their sub-solves price
+               against the cycle's carry and are cheap at steady-state
+               counts.
+  sensitive    capacity-sensitive rows — Dynamic/Aggregated rows that
+               are fresh or whose previous assignment no longer covers
+               the replica target under CURRENT feasibility
+               (assigned != replicas), and spread-constrained rows.
+               Their placement depends on the capacity environment, so
+               any cycle's capacity churn (or carried consumption) can
+               move them: always dirty.  Steady rows
+               (assigned == replicas, not fresh) reproduce their
+               previous assignment exactly and consume nothing — the
+               solver's stickiness contract — so they are clean no
+               matter how capacity moved.
+  flip         a lane's feasibility actually changed this window
+               (resident last_flip_lanes: `deleting` flips and api_ok
+               column changes — the only feasibility inputs a
+               non-structural delta can move).  Every row whose
+               placement mask covers a flipped lane is dirty: its
+               eligible set changed.  Structural changes (membership,
+               spec, labels) rebuild the whole plane and force a full
+               solve upstream — they never reach this kernel.
+
+The kernel also grades each dirty row for the solver's visibility-exact
+grouping (scheduler/incremental.py):
+
+  sensitive    bit — the row's RESULT depends on consumed capacity seen
+               at solve time (ordering matters for it).
+  consumer     bit — the row's re-solve may CONSUME capacity (its new
+               result can exceed its previous assignment), so later
+               sensitive rows must either see its consumption (chained
+               groups) or provably not care (disjoint placement masks).
+
+Trace-safety: pure gathers/compares + one scatter-max for the rv mask —
+no Python control flow on traced values, no host syncs; dtypes ride in
+on the slot-store operands (ops/tensors.FIELD_DTYPES).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from karmada_tpu.ops import tensors as T  # noqa: E402
+from karmada_tpu.utils.metrics import REGISTRY  # noqa: E402
+
+#: code bits in the kernel's uint8 output (per slot)
+DIRTY = 1        # re-solve this row this cycle
+SENSITIVE = 2    # result depends on the consumed-capacity environment
+CONSUMER = 4     # re-solve may consume capacity beyond the previous rep
+
+DIRTY_DISPATCHES = REGISTRY.counter(
+    "karmada_incremental_dirty_kernel_dispatches_total",
+    "Dirty-set kernel dispatches (one per incremental cycle)",
+)
+DIRTY_ROWS = REGISTRY.counter(
+    "karmada_incremental_dirty_rows_total",
+    "Binding rows classified dirty by the incremental dirty-set kernel "
+    "(re-solved as the cycle's compact sub-batch instead of the full "
+    "roster)",
+)
+DIRTY_FRACTION = REGISTRY.gauge(
+    "karmada_incremental_dirty_fraction",
+    "Dirty rows / live roster rows in the most recent incremental cycle "
+    "(the steady-state win is 1 minus this, roughly)",
+)
+
+
+def _dirty_core(placement_id, replicas, fresh, non_workload, route,
+                prev_idx, prev_val, evict_idx,
+                cluster_valid, deleting, pl_mask, pl_strategy,
+                pl_has_cluster_sc, pl_has_region_sc,
+                flip_lanes, rv_slots):
+    """uint8[cap] dirty codes over the slot store — see module docstring.
+
+    flip_lanes int64[F] / rv_slots int64[S]: -1 padded (static pow2
+    buckets so the jit signature stays stable across cycles)."""
+    cap = placement_id.shape[0]
+    lanes_ok = cluster_valid & ~deleting  # [C]
+
+    # previous-assignment feasibility under CURRENT planes — exactly the
+    # solver's prev-lane formula (lanes_ok & pl_mask & ~evict; tolerance
+    # and api gates auto-pass on prev-present lanes)
+    okp = prev_idx >= 0                                    # [cap, Kp]
+    pl = jnp.where(okp, prev_idx, 0)
+    in_mask = pl_mask[placement_id[:, None], pl]           # [cap, Kp]
+    ev = jnp.where(evict_idx >= 0, evict_idx, -2)          # [cap, Ke]
+    evicted = jnp.any(pl[:, :, None] == ev[:, None, :], axis=2)
+    feas = okp & lanes_ok[pl] & in_mask & ~evicted
+    assigned = jnp.sum(
+        jnp.where(feas, prev_val, 0), axis=1).astype(replicas.dtype)
+
+    strat = pl_strategy[placement_id]
+    dyn = ((strat == T.STRAT_DYNAMIC) | (strat == T.STRAT_AGGREGATED))
+    has_sc = (pl_has_cluster_sc[placement_id]
+              | pl_has_region_sc[placement_id])
+    sensitive = (~non_workload) & (
+        (dyn & (fresh | (assigned != replicas))) | has_sc)
+
+    # a feasibility flip reaches every row whose placement mask covers
+    # the flipped lane (lanes outside the mask are infeasible regardless)
+    fl_ok = flip_lanes >= 0                                # [F]
+    fl = jnp.where(fl_ok, flip_lanes, 0)
+    flip_hit = jnp.any(
+        pl_mask[placement_id[:, None], fl[None, :]] & fl_ok[None, :],
+        axis=1)
+
+    rv_ok = rv_slots >= 0
+    rv_hit = (jnp.zeros(cap, bool)
+              .at[jnp.where(rv_ok, rv_slots, 0)].max(rv_ok))
+
+    route_hit = route != T.ROUTE_DEVICE
+    # rv-churned rows grade conservatively sensitive+consumer: the kernel
+    # reads the PRE-re-encode slot row, so their steadiness is unknown
+    sens_out = sensitive | rv_hit | route_hit
+    dirty = sens_out | flip_hit
+    # Static/Duplicated rows are capacity-INsensitive but their re-solve
+    # can still move replicas onto new lanes (consume); steady dynamic
+    # rows hit only by an off-prev-lane flip reproduce prev exactly
+    consumer = sens_out | (dirty & ~dyn & ~non_workload)
+    return (dirty.astype(jnp.uint8)
+            | (sens_out.astype(jnp.uint8) << 1)
+            | (consumer.astype(jnp.uint8) << 2))
+
+
+dirty_kernel = jax.jit(_dirty_core)
+
+
+def _pad_lanes(arr: np.ndarray, lo: int = 8) -> np.ndarray:
+    """-1-pad to the next pow2 bucket (stable jit signatures)."""
+    arr = np.asarray(arr, np.int64).reshape(-1)
+    n = T._next_pow2(max(arr.size, 1), lo)  # noqa: SLF001 — same package
+    out = np.full(n, -1, np.int64)
+    out[:arr.size] = arr
+    return out
+
+
+def dirty_codes(state, rv_slots: np.ndarray,
+                mirrors: Optional[dict] = None) -> np.ndarray:
+    """Run the dirty kernel against a ResidentState's slot store: returns
+    the uint8[cap] code plane as numpy (DIRTY/SENSITIVE/CONSUMER bits).
+
+    `rv_slots`: slot indices of rows the watch window (or the solver's
+    own write-backs) touched.  `mirrors`: pass the fused device slot
+    mirrors to run against live device arrays (zero binding-axis h2d);
+    None gathers from the frozen host masters (XLA transfers them — free
+    on CPU, the fused path is the headline elsewhere)."""
+    p = state.plane
+    src = mirrors if mirrors else p
+
+    def f(name):
+        return (src[name] if isinstance(src, dict) else getattr(src, name))
+
+    codes = dirty_kernel(
+        f("placement_id"), f("replicas"), f("fresh"), f("non_workload"),
+        f("route"), f("prev_idx"), f("prev_val"), f("evict_idx"),
+        p.cluster_valid, p.deleting, p.pl_mask, p.pl_strategy,
+        p.pl_has_cluster_sc, p.pl_has_region_sc,
+        _pad_lanes(state.last_flip_lanes), _pad_lanes(rv_slots))
+    DIRTY_DISPATCHES.inc()
+    return np.asarray(codes)

@@ -142,6 +142,76 @@ gather_batch = partial(
     jax.jit, static_argnames=("shard_mesh",))(_gather_core)
 
 
+def _sub_gather_core(slots, lane_inv, drop, placement_id, gvk_id, class_id,
+                     replicas, uid_desc, fresh, non_workload, nw_shortcut,
+                     route, prev_idx, prev_val, evict_idx, *,
+                     shard_mesh=None):
+    """The fused gather, emitting rows directly in a shortlist
+    SUB-vocabulary: `lane_inv` int32[C] maps full-vocabulary cluster
+    lanes to union lanes (-1 = outside the union), `drop` bool[B] marks
+    rows the shortlist routed out of the compact solve (residual /
+    non-device rows) — their b_valid is cleared on device instead of a
+    host round-trip.  prev/evict lane indices are remapped in-kernel
+    (out-of-union prev lanes -> -1 with value zeroed; the shortlist
+    union always contains every row's prev lanes, so a -1 here only
+    appears on rows already dropped)."""
+    ok = slots >= 0
+    sl = jnp.where(ok, slots, 0)
+
+    def g1(a, fill):
+        return jnp.where(ok, a[sl], fill)
+
+    def g2(a, fill):
+        return jnp.where(ok[:, None], a[sl], fill)
+
+    def remap(lanes):
+        m = jnp.where(lanes >= 0, lane_inv[jnp.where(lanes >= 0, lanes, 0)],
+                      -1)
+        return m.astype(lanes.dtype)
+
+    route_g = route[sl]
+    b_valid = ok & (route_g == ROUTE_DEVICE) & ~drop
+    F = _FILL
+    pidx = remap(g2(prev_idx, F["prev_idx"]))
+    pval = jnp.where(pidx >= 0, g2(prev_val, F["prev_val"]), 0)
+    eidx = remap(g2(evict_idx, F["evict_idx"]))
+    out = (
+        b_valid,
+        g1(placement_id, F["placement_id"]), g1(gvk_id, F["gvk_id"]),
+        g1(class_id, F["class_id"]), g1(replicas, F["replicas"]),
+        g1(uid_desc, F["uid_desc"]), g1(fresh, F["fresh"]),
+        g1(non_workload, F["non_workload"]),
+        g1(nw_shortcut, F["nw_shortcut"]),
+        pidx, pval, eidx,
+    )
+    if shard_mesh is not None:
+        from karmada_tpu.ops import meshing
+
+        out = tuple(
+            lax.with_sharding_constraint(
+                a, meshing.sharding_for(shard_mesh, f, a.shape))
+            for f, a in zip(OUT_FIELDS, out))
+    return out
+
+
+sub_gather_batch = partial(
+    jax.jit, static_argnames=("shard_mesh",))(_sub_gather_core)
+
+
+def dispatch_sub_gather(slots, mirrors, lane_inv, drop, plan=None):
+    """Run the sub-vocabulary gather (see _sub_gather_core): the per-call
+    h2d is the [B] slot vector plus the [C] lane map and [B] drop mask —
+    still zero binding-axis FIELD uploads.  Returns the solver
+    binding-axis operand tuple (OUT_FIELDS order) with prev/evict lanes
+    already living in the union vocabulary."""
+    args = tuple(mirrors[f] for f in GATHER_FIELDS)
+    out = sub_gather_batch(
+        slots, lane_inv, drop, *args,
+        shard_mesh=plan.mesh if plan is not None else None)
+    GATHER_DISPATCHES.inc()
+    return out
+
+
 def place_slot(arr, plan=None):
     """Place one slot-store master on device: replicated over the active
     mesh (the gather is local per shard; only its outputs partition),

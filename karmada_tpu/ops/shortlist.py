@@ -103,11 +103,21 @@ SHORTLIST_FALLBACKS = REGISTRY.counter(
     "karmada_shortlist_fallbacks_total",
     "Chunks that fell back to the full dense dispatch, by reason "
     "(uncovered: a binding's eligible set outgrew k even after "
-    "widening; mixed_routes: the chunk holds rows the device tier "
-    "does not own; union_wide: the candidate union approached the "
-    "dense width; fused: the fused resident-gather path owns the "
-    "chunk's binding rows)",
+    "widening, with truncation off or unavailable; mixed_routes: the "
+    "chunk holds rows the device tier does not own; union_wide: the "
+    "candidate union approached the dense width; fused: a fused "
+    "resident-gather batch arrived without its fused_src handle, so "
+    "the shortlist cannot read binding fields host-side)",
     ("reason",),
+)
+SHORTLIST_FALLBACK_ROWS = REGISTRY.counter(
+    "karmada_shortlist_fallback_rows_total",
+    "Binding rows priced at full dense width, by kind: `needed` rows "
+    "individually required it (eligible set beyond k_max, or a "
+    "non-device route), `chunk_drag` rows were dragged along by a "
+    "per-chunk fallback their own eligible set did not ask for — the "
+    "per-binding routing win is this kind going to zero",
+    ("kind",),
 )
 SHORTLIST_WIDENINGS = REGISTRY.counter(
     "karmada_shortlist_widenings_total",
@@ -138,16 +148,25 @@ class ShortlistConfig:
       at least this (the two-tier overhead only pays above a scale);
       <= 0 arms every chunk (tests, megafleet).
     k_max: widen-and-retry ceiling — k doubles toward this while any
-      binding's eligible set does not fit, then the chunk falls back.
+      binding's eligible set does not fit, then the offending rows are
+      truncated out (below) or the chunk falls back.
     union_frac: dense fallback when the candidate union exceeds this
       fraction of the real cluster count (a sub-solve near dense width
       costs more than dense: extra gather + remap for no cell savings).
+    truncate: truncation-with-recall — a binding whose eligible set
+      exceeds k_max is routed OUT of the shortlisted sub-solve as a
+      per-binding dense residual (the pipeline solves it at full width
+      against the chunk's own starting capacity) instead of dragging
+      the whole chunk dense.  Exact at waves=1 (rows of one chunk never
+      see each other's consumption there — docs/PERF_NOTES.md); the
+      pipeline disables it at waves>1 or under keep_sel.
     """
 
     k: int = 64
     min_cells: int = 1 << 21
     k_max: int = 256
     union_frac: float = 0.5
+    truncate: bool = True
 
 
 def _shortlist_core(
@@ -252,6 +271,13 @@ def _group_sums(group_id, cap_proxy, n_groups: int):
 _AGG_MEMO: List[Optional[dict]] = [None]
 _AGG_LOCK = threading.Lock()
 
+# per-profile tier-1 memo (see _dispatch_profiles): one master-set slot,
+# {(placement, gvk, class, k) -> (cand_row, fcount)} under it.
+# Same pinning discipline as _AGG_MEMO.
+# guarded-by: _T1_LOCK; mutators: _dispatch_profiles,reset_for_tests
+_T1_MEMO: List[Optional[dict]] = [None]
+_T1_LOCK = threading.Lock()
+
 #: the per-cluster capacity aggregate the rebalance detect reuses —
 #: implemented in ops/tensors (jax-free: host-backend planes import it
 #: without paying a jax init) and re-exported here as part of the
@@ -262,6 +288,8 @@ fleet_capacity = T.fleet_capacity
 def reset_for_tests() -> None:
     with _AGG_LOCK:
         _AGG_MEMO[0] = None
+    with _T1_LOCK:
+        _T1_MEMO[0] = None
 
 
 def cycle_aggregates(batch) -> dict:
@@ -369,10 +397,9 @@ def _profiles(batch):
     return prof_keys, prof_of, rep_max
 
 
-def _dispatch_profiles(batch, prof_keys, rep_max, k: int, plan=None):
-    """Run the tier-1 kernel over the chunk's profile rows: returns
-    (cand int32[nprof, k], fcount int32[nprof]) as numpy."""
-    agg = cycle_aggregates(batch)
+def _t1_rows(batch, prof_keys, rep_max, k: int, agg, mesh):
+    """Run the tier-1 kernel over the given profile rows (uncached):
+    returns (cand int32[nprof, k], fcount int32[nprof]) as numpy."""
     nprof = prof_keys.shape[0]
     Bp = T._next_pow2(max(nprof, 1), 8)  # noqa: SLF001 — same package
 
@@ -397,9 +424,62 @@ def _dispatch_profiles(batch, prof_keys, rep_max, k: int, plan=None):
         pad1(prof_keys[:, 2], -1, np.int32),
         pad1(rep_max, 0, np.int64),
         none_idx, none_val, none_idx,
-        k=k, shard_mesh=plan.mesh if plan is not None else None)
+        k=k, shard_mesh=mesh)
     SHORTLIST_DISPATCHES.inc()
     return np.asarray(cand)[:nprof], np.asarray(fcount)[:nprof]
+
+
+def _dispatch_profiles(batch, prof_keys, rep_max, k: int, plan=None):
+    """Tier-1 candidates for the chunk's profile rows: returns
+    (cand int32[nprof, k], fcount int32[nprof]) as numpy.
+
+    Cached PER PROFILE across calls: the kernel reads only the frozen
+    lane/class masters (never the carried capacity ledger — tier 2 owns
+    pricing), so for an unchanged master set the output is a pure
+    function of (profile key, k).  rep_max is deliberately NOT part of
+    the key: profile rows carry no prev/evict lanes, so the kernel's
+    `eligible` mask (and fcount) is replica-independent — replicas only
+    rank the packed score, and for every covered profile the widen loop
+    guarantees k >= fcount, making cand the FULL eligible set whatever
+    the order; an uncovered profile's truncated cand only adds superset
+    lanes to the union, which never changes the sub-solve's result.
+    Identity-keyed on the masters like _AGG_MEMO, pinning the keyed
+    arrays (copy-on-write plane updates swap in fresh arrays, so a
+    content change always changes identity).  The steady-state
+    dirty-set cycle re-dispatches the same profiles every cycle — warm
+    cycles skip tier-1 entirely."""
+    agg = cycle_aggregates(batch)
+    mesh = plan.mesh if plan is not None else None
+    masters = (batch.cluster_valid, batch.deleting, batch.name_rank,
+               batch.pods_allowed, batch.has_summary, batch.avail_milli,
+               batch.has_alloc, batch.api_ok, batch.req_milli,
+               batch.req_is_cpu, batch.req_pods, batch.est_override,
+               batch.pl_mask, batch.pl_tol_bypass, agg["group_pref"])
+    nprof = prof_keys.shape[0]
+    pkeys = [(int(prof_keys[i, 0]), int(prof_keys[i, 1]),
+              int(prof_keys[i, 2]), k)
+             for i in range(nprof)]
+    with _T1_LOCK:
+        memo = _T1_MEMO[0]
+        if (memo is None or memo["mesh"] is not mesh
+                or len(memo["src"]) != len(masters)
+                or not all(a is b for a, b in zip(memo["src"], masters))):
+            memo = {"src": masters, "mesh": mesh, "rows": {}}
+            _T1_MEMO[0] = memo
+        have = {key: memo["rows"].get(key) for key in pkeys}
+    miss = [i for i, key in enumerate(pkeys) if have[key] is None]
+    if miss:
+        cand_m, fcount_m = _t1_rows(
+            batch, prof_keys[miss], rep_max[np.asarray(miss)], k, agg, mesh)
+        fresh = {pkeys[i]: (cand_m[j], fcount_m[j])
+                 for j, i in enumerate(miss)}
+        have.update(fresh)
+        with _T1_LOCK:
+            memo["rows"].update(fresh)
+    cand = np.stack([have[key][0] for key in pkeys]) if nprof else \
+        np.zeros((0, k), np.int32)
+    fcount = np.asarray([have[key][1] for key in pkeys], np.int32)
+    return cand, fcount
 
 
 def binding_candidates(batch, k: int, plan=None):
@@ -418,7 +498,58 @@ def binding_candidates(batch, k: int, plan=None):
     return out
 
 
-def shrink_chunk(batch, cfg: ShortlistConfig, plan=None):
+def _host_rows(batch):
+    """Host view of the binding-axis fields the shrink logic reads
+    (tier-1 profiles and coverage are host math).  Plain batches ARE the
+    host view; fused resident-gather batches carry those fields as live
+    device arrays, so the view is gathered lazily off the frozen
+    slot-store masters in the batch's fused_src handle — cheap O(n)
+    fancy-indexing of copy-on-write arrays, bit-identical to the device
+    mirrors by the resident plane's sync contract."""
+    if not getattr(batch, "fused", False):
+        return batch
+    from types import SimpleNamespace
+
+    src = batch.fused_src
+    p, sl = src["plane"], src["slots"]
+    n = int(sl.shape[0])
+    B = batch.B
+
+    def pad(a, fill):
+        out = np.full((B,) + a.shape[1:], fill, a.dtype)
+        out[:n] = a[sl]
+        return out
+
+    b_valid = np.zeros(B, bool)
+    b_valid[:n] = np.asarray(batch.route) == T.ROUTE_DEVICE
+    return SimpleNamespace(
+        b_valid=b_valid,
+        placement_id=pad(p.placement_id, 0), gvk_id=pad(p.gvk_id, 0),
+        class_id=pad(p.class_id, -1), replicas=pad(p.replicas, 0),
+        non_workload=pad(p.non_workload, False),
+        prev_idx=pad(p.prev_idx, -1), prev_val=pad(p.prev_val, 0),
+        evict_idx=pad(p.evict_idx, -1))
+
+
+def _row_names(part, rows, limit: int = 5) -> str:
+    """Name offending binding rows for fallback/truncation messages —
+    operators chase bindings by key, not by chunk-local row index."""
+    from karmada_tpu.obs import decisions as obs_decisions
+
+    rows = list(rows)
+    if part is None:
+        return f"{len(rows)} row(s)"
+    names = [
+        (obs_decisions.default_key(part[i][0])
+         if i < len(part) else f"row {i}")
+        for i in rows[:limit]
+    ]
+    extra = f" (+{len(rows) - limit} more)" if len(rows) > limit else ""
+    return ", ".join(names) + extra
+
+
+def shrink_chunk(batch, cfg: ShortlistConfig, plan=None, part=None,
+                 allow_truncate: bool = True):
     """Tier selection for one encoded chunk: returns (sub_batch, info).
 
     sub_batch is a SolverBatch over the chunk's candidate-union
@@ -427,68 +558,123 @@ def shrink_chunk(batch, cfg: ShortlistConfig, plan=None):
     must stay dense (info["fallback"] says why — every fallback is
     counted and ledgered; `below_threshold` chunks are silent: staying
     dense below the arming scale is the configuration, not a failure).
+
+    Fused resident-gather batches shortlist too: profile/coverage math
+    reads the host slot-store masters (batch.fused_src) and the
+    sub-batch's binding rows are gathered straight into the union
+    vocabulary on device (ops/resident_gather.dispatch_sub_gather) —
+    zero binding-axis field uploads, same as the dense fused path.
+
+    Per-binding routing (cfg.truncate + allow_truncate): rows whose
+    eligible set exceeds k_max leave the chunk as info["residual"]
+    (chunk-local row indices) for the pipeline's per-binding dense
+    mini-solve instead of dragging all B rows dense; `part` (the
+    chunk's items) names the offenders in events.
     """
     if cfg.min_cells > 0 and batch.B * batch.C < cfg.min_cells:
         return None, {"fallback": "below_threshold"}
     if batch.C <= cfg.k:
         return None, {"fallback": "below_threshold"}
-    if getattr(batch, "fused", False):
+    if getattr(batch, "fused", False) and batch.fused_src is None:
         return _fallback(batch, "fused",
-                         "fused resident-gather batches keep the dense path")
+                         "fused batch without a fused_src handle "
+                         "(explain/legacy assemble) keeps the dense path")
+    hv = _host_rows(batch)
+    valid = np.asarray(hv.b_valid)
     route = np.asarray(batch.route)
     if route.size and not bool(np.all(route == T.ROUTE_DEVICE)):
         n_other = int(np.sum(route != T.ROUTE_DEVICE))
+        # non-device rows individually need the dense/spread machinery;
+        # the chunk's device rows are dragged along — count both kinds
+        # so the per-binding routing win is measurable
+        SHORTLIST_FALLBACK_ROWS.inc(n_other, kind="needed")
+        SHORTLIST_FALLBACK_ROWS.inc(int(valid.sum()), kind="chunk_drag")
         return _fallback(batch, "mixed_routes",
                          f"{n_other} row(s) owned by spread/big/host tiers")
-    prof_keys, prof_of, rep_max = _profiles(batch)
-    valid = np.asarray(batch.b_valid)
+    prof_keys, prof_of, rep_max = _profiles(hv)
     # per-binding prev-lane counts (host: the sparse plane is tiny);
     # coverage is judged conservatively as profile-feasible + prev —
     # prev lanes can add bypass feasibility beyond the profile row
-    prev_count = np.sum(np.asarray(batch.prev_idx) >= 0, axis=1)
+    prev_count = np.sum(np.asarray(hv.prev_idx) >= 0, axis=1)
     k = min(cfg.k, batch.C)
     k_cap = min(cfg.k_max, batch.C)
     widened = 0
+    drop = np.zeros(batch.B, bool)
+    residual: List[int] = []
     while True:
         cand, fcount = _dispatch_profiles(batch, prof_keys, rep_max, k,
                                           plan=plan)
         need = fcount[prof_of] + prev_count
-        worst = int(need[valid].max()) if bool(valid.any()) else 0
+        active = valid & ~drop
+        worst = int(need[active].max()) if bool(active.any()) else 0
+        if worst > k_cap:
+            # the eligible count is k-independent: rows beyond k_max can
+            # never be covered, however far k widens
+            offenders = np.flatnonzero(active & (need > k_cap))
+            if cfg.truncate and allow_truncate:
+                # truncation-with-recall: route ONLY the offenders to a
+                # per-binding dense residual solve; everything else
+                # keeps the shortlist.  Their recall is the full lane
+                # axis (the residual prices every cluster), so nothing
+                # is silently narrowed.
+                drop[offenders] = True
+                residual = [int(i) for i in offenders]
+                SHORTLIST_FALLBACK_ROWS.inc(len(residual), kind="needed")
+                ev.emit(ev.ObjectRef(kind="Scheduler", namespace="",
+                                     name="shortlist"),
+                        ev.TYPE_NORMAL, ev.REASON_SHORTLIST_TRUNCATE,
+                        f"{len(residual)} binding(s) exceed "
+                        f"k_max={cfg.k_max} (worst {worst} lane(s)): "
+                        "routed to the per-binding dense residual: "
+                        + _row_names(part, residual),
+                        origin="shortlist")
+                _note(residual=len(residual))
+                active = valid & ~drop
+                worst = int(need[active].max()) if bool(active.any()) else 0
+            else:
+                SHORTLIST_FALLBACK_ROWS.inc(len(offenders), kind="needed")
+                SHORTLIST_FALLBACK_ROWS.inc(
+                    int(active.sum()) - len(offenders), kind="chunk_drag")
+                return _fallback(
+                    batch, "uncovered",
+                    f"eligible set of {worst} lane(s) exceeds "
+                    f"k_max={cfg.k_max} for "
+                    + _row_names(part, offenders))
         if worst <= k:
             break
-        if worst > k_cap:
-            # the eligible count is k-independent: a set beyond k_max
-            # can never be covered, so fall back WITHOUT burning another
-            # kernel dispatch on a doomed widen
-            return _fallback(
-                batch, "uncovered",
-                f"eligible set of {worst} lane(s) exceeds k_max={cfg.k_max}")
         k = min(max(k * 2, worst), k_cap)
         widened += 1
         SHORTLIST_WIDENINGS.inc()
-    prev_np = np.asarray(batch.prev_idx)
+    prev_np = np.asarray(hv.prev_idx)
+    # recall guarantee: EVERY kept row's prev lanes join the union
+    # (residual rows' lanes are priced at full width — excluded here)
+    prev_keep = prev_np[valid & ~drop]
     lanes = np.unique(np.concatenate([
         cand[cand >= 0].astype(np.int64).reshape(-1),
-        prev_np[prev_np >= 0].astype(np.int64).reshape(-1),
+        prev_keep[prev_keep >= 0].astype(np.int64).reshape(-1),
     ]))
     max_union = max(cfg.k, int(cfg.union_frac * max(batch.n_clusters, 1)))
     if lanes.size > max_union:
+        SHORTLIST_FALLBACK_ROWS.inc(int(valid.sum()), kind="chunk_drag")
         return _fallback(
             batch, "union_wide",
             f"candidate union of {lanes.size} lane(s) exceeds "
             f"{max_union} ({cfg.union_frac:.0%} of {batch.n_clusters})")
-    sub = _sub_batch(batch, lanes)
+    sub = _sub_batch(batch, lanes, hv=hv,
+                     drop=drop if residual else None)
     if sub is None:
         # a covered binding's prev lane missing from the union would be a
         # kernel bug; refuse the shortlist rather than mis-solve
+        SHORTLIST_FALLBACK_ROWS.inc(int(valid.sum()), kind="chunk_drag")
         return _fallback(batch, "uncovered",
                          "prev-assignment lane absent from the union")
-    SHORTLIST_ROWS.inc(int(batch.n_bindings))
+    SHORTLIST_ROWS.inc(int(batch.n_bindings) - len(residual))
     SHORTLIST_CELLS.inc(float(batch.B) * float(sub.C), tier="solve")
     SHORTLIST_CELLS.inc(float(batch.B) * float(batch.C), tier="dense_equiv")
     SHORTLIST_UNION_LANES.set(float(lanes.size))
     info = {"k": k, "widened": widened, "union": int(lanes.size),
             "sub_c": sub.C, "profiles": int(prof_keys.shape[0]),
+            "residual": residual,
             "cells_solve": batch.B * sub.C,
             "cells_dense": batch.B * batch.C}
     _note(k=k, widened=widened, union=int(lanes.size), sub_c=sub.C,
@@ -497,7 +683,7 @@ def shrink_chunk(batch, cfg: ShortlistConfig, plan=None):
     return sub, info
 
 
-def _sub_batch(batch, lanes: np.ndarray):
+def _sub_batch(batch, lanes: np.ndarray, hv=None, drop=None):
     """The per-chunk vocabulary remap: the full batch's planes gathered
     to the candidate union (cluster axis only — placements, request
     classes and the binding axis keep their vocabularies), name_rank
@@ -505,7 +691,15 @@ def _sub_batch(batch, lanes: np.ndarray):
     remapped.  The result is an ordinary SolverBatch the existing
     dispatch/decode/carry machinery runs unchanged; `sub_lanes` /
     `sub_full_c` / `sub_sig` tag it for the keyed carry transport
-    (tensors.CarryState renders accumulators across the lane remap)."""
+    (tensors.CarryState renders accumulators across the lane remap).
+
+    `drop` bool[B] marks rows routed OUT of the sub-solve (the
+    truncation residual): their b_valid clears here.  On a fused batch
+    (`hv` = its host view) the binding axis never touches the host —
+    ops/resident_gather.dispatch_sub_gather emits the rows directly in
+    the union vocabulary from the device slot store."""
+    if hv is None:
+        hv = _host_rows(batch)
     n2 = int(lanes.size)
     C2 = T._next_pow2(max(n2, 1), 8)  # noqa: SLF001 — same package
     inv = np.full(batch.C, -1, np.int32)
@@ -541,11 +735,47 @@ def _sub_batch(batch, lanes: np.ndarray):
         out_val = np.where(out_idx >= 0, val, 0).astype(np.int32)
         return out_idx, out_val, dropped
 
-    prev_idx, prev_val, prev_dropped = remap_sparse(batch.prev_idx,
-                                                    batch.prev_val)
-    if bool(prev_dropped[np.asarray(batch.b_valid)].any()):
+    kept = np.asarray(hv.b_valid)
+    if drop is not None:
+        kept = kept & ~drop
+    prev_idx, prev_val, prev_dropped = remap_sparse(
+        np.asarray(hv.prev_idx), np.asarray(hv.prev_val))
+    if bool(prev_dropped[kept].any()):
         return None  # prev lane outside the union: coverage bug, refuse
-    evict_idx, _ = remap_sparse(batch.evict_idx)
+    evict_idx, _ = remap_sparse(np.asarray(hv.evict_idx))
+    fused = bool(getattr(batch, "fused", False))
+    if fused:
+        # binding axis stays on device: gather the rows straight into
+        # the union vocabulary from the slot mirrors (the -1 lane map
+        # kills out-of-union prev/evict lanes in-kernel; `drop` clears
+        # residual rows' b_valid without a host round-trip)
+        from karmada_tpu.ops import resident_gather as rg
+
+        src = batch.fused_src
+        drop_b = (np.ascontiguousarray(drop) if drop is not None
+                  else np.zeros(batch.B, bool))
+        (b_valid_a, placement_a, gvk_a, class_a, replicas_a, uid_a,
+         fresh_a, nw_a, nws_a, prev_idx_a, prev_val_a, evict_idx_a) = (
+            rg.dispatch_sub_gather(src["slots_b"], src["mirrors"], inv,
+                                   drop_b, src["plan"]))
+        # donation-safety bound over the SUB width (solver._nnz_bound
+        # semantics, recomputed like resident/state._assemble_fused)
+        strat = np.asarray(batch.pl_strategy)[np.asarray(hv.placement_id)]
+        wide = kept & ((strat == T.STRAT_DUPLICATED)
+                       | np.asarray(hv.non_workload))
+        Kp = np.asarray(hv.prev_idx).shape[1]
+        per_row = np.minimum(np.asarray(hv.replicas, np.int64), C2) + Kp
+        nnz_bound = (int(np.sum(wide)) * C2
+                     + int(np.sum(per_row[kept & ~wide])))
+    else:
+        b_valid_a = kept if drop is not None else batch.b_valid
+        placement_a, gvk_a, class_a = (batch.placement_id, batch.gvk_id,
+                                       batch.class_id)
+        replicas_a, uid_a, fresh_a = (batch.replicas, batch.uid_desc,
+                                      batch.fresh)
+        nw_a, nws_a = batch.non_workload, batch.nw_shortcut
+        prev_idx_a, prev_val_a, evict_idx_a = prev_idx, prev_val, evict_idx
+        nnz_bound = None
     label_axes = {
         key: (g1(gid, -1), values)
         for key, (gid, values) in (batch.label_axes or {}).items()
@@ -570,12 +800,12 @@ def _sub_batch(batch, lanes: np.ndarray):
         pl_has_cluster_sc=batch.pl_has_cluster_sc,
         pl_sc_min=batch.pl_sc_min, pl_sc_max=batch.pl_sc_max,
         pl_ignore_avail=batch.pl_ignore_avail,
-        b_valid=batch.b_valid, placement_id=batch.placement_id,
-        gvk_id=batch.gvk_id, class_id=batch.class_id,
-        replicas=batch.replicas, uid_desc=batch.uid_desc,
-        fresh=batch.fresh, non_workload=batch.non_workload,
-        nw_shortcut=batch.nw_shortcut,
-        prev_idx=prev_idx, prev_val=prev_val, evict_idx=evict_idx,
+        b_valid=b_valid_a, placement_id=placement_a,
+        gvk_id=gvk_a, class_id=class_a,
+        replicas=replicas_a, uid_desc=uid_a,
+        fresh=fresh_a, non_workload=nw_a,
+        nw_shortcut=nws_a,
+        prev_idx=prev_idx_a, prev_val=prev_val_a, evict_idx=evict_idx_a,
         route=batch.route, cluster_index=cindex2,
         region_id=g1(batch.region_id, -1)
         if batch.region_id is not None else None,
@@ -595,6 +825,8 @@ def _sub_batch(batch, lanes: np.ndarray):
             [lanes, np.full(C2 - n2, -1, np.int64)]),
         sub_full_c=batch.C,
         sub_sig=hash((batch.C, C2, lanes.tobytes())),
+        fused=fused,
+        nnz_bound_hint=nnz_bound,
     )
     return sub
 

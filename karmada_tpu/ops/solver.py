@@ -1534,34 +1534,42 @@ def finalize_compact(handle):
     return out
 
 
-def solve_big(items, idx_list, cindex, estimator, cache, waves: int = 1,
-              enable_empty_workload_propagation: bool = False,
-              collect_used: bool = False, used0=None, from_batch=None):
-    """Solve one chunk's ROUTE_DEVICE_BIG bindings (beyond the tier-1
-    compact caps) as their own sub-batch on the big lane tier, the same
-    sub-batch pattern as ops/spread.solve_spread.  Returns
+def solve_rows(items, idx_list, cindex, estimator, cache, *,
+               route, tier: str = "std", waves: int = 1,
+               enable_empty_workload_propagation: bool = False,
+               collect_used: bool = False, used0=None, from_batch=None):
+    """Solve an arbitrary subset of a chunk's bindings as their own
+    sub-batch — the sub-batch pattern of ops/spread.solve_spread,
+    parameterized on the route the rows carry (`route`) and the lane
+    tier the compact solve runs on (`tier`).  Returns
     {original_index: List[TargetCluster] | Exception}.
 
     Carry (the pipelined executor's chunk accounting): `used0` carries a
-    previous batch's consumption in, given in `from_batch`'s vocabulary
-    and remapped here into the sub-batch's own (tensors.remap_used);
+    previous batch's consumption in — either an accumulator tuple in
+    `from_batch`'s vocabulary (remapped here via tensors.remap_used) or
+    a tensors.CarryState, whose keyed store renders into the sub-batch's
+    vocabulary directly (the only lossless transport OUT of a
+    shortlisted sub-vocabulary — remap_used cannot cross lane sets);
     with collect_used the return becomes (out, (sub_batch, used_out,
     used0_sub)) — the triple a caller feeds CarryState.absorb to fold
-    the big bindings' OWN consumption back into its keyed store."""
+    the sub-batch's OWN consumption back into its keyed store."""
     from karmada_tpu.ops import tensors as T
 
     if not idx_list:
         return ({}, None) if collect_used else {}
     sub = [items[i] for i in idx_list]
     batch2 = T.encode_batch(sub, cindex, estimator, cache=cache)
-    # in a parent batch big rows are host-invalid; in THIS sub-batch they
-    # are the payload (binding-axis arrays are fresh per encode: writable)
-    batch2.b_valid[:len(sub)] = batch2.route == T.ROUTE_DEVICE_BIG
+    # in a parent batch these rows may be host-invalid; in THIS sub-batch
+    # they are the payload (binding-axis arrays are fresh per encode:
+    # writable)
+    batch2.b_valid[:len(sub)] = batch2.route == route
     used0_sub = None
-    if used0 is not None and from_batch is not None:
+    if isinstance(used0, T.CarryState):
+        used0_sub = used0.used0_for(batch2)
+    elif used0 is not None and from_batch is not None:
         used0_sub = T.remap_used(used0, from_batch, batch2)
     res = solve_compact(
-        batch2, waves=waves, tier="big",
+        batch2, waves=waves, tier=tier,
         keep_sel=enable_empty_workload_propagation,
         with_used=collect_used, used0=used0_sub)
     idx, val, st = res[0], res[1], res[2]
@@ -1570,7 +1578,7 @@ def solve_big(items, idx_list, cindex, estimator, cache, waves: int = 1,
         enable_empty_workload_propagation=enable_empty_workload_propagation,
         items=sub)
     out = {idx_list[j]: decoded[j] for j in range(len(sub))
-           if batch2.route[j] == T.ROUTE_DEVICE_BIG}
+           if batch2.route[j] == route}
     if collect_used:
         if used0_sub is None:
             used0_sub = tuple(
@@ -1579,6 +1587,21 @@ def solve_big(items, idx_list, cindex, estimator, cache, waves: int = 1,
                  batch2.est_override))
         return out, (batch2, res[4], used0_sub)
     return out
+
+
+def solve_big(items, idx_list, cindex, estimator, cache, waves: int = 1,
+              enable_empty_workload_propagation: bool = False,
+              collect_used: bool = False, used0=None, from_batch=None):
+    """Solve one chunk's ROUTE_DEVICE_BIG bindings (beyond the tier-1
+    compact caps) as their own sub-batch on the big lane tier — the
+    solve_rows pattern pinned to the big route/tier."""
+    from karmada_tpu.ops import tensors as T
+
+    return solve_rows(
+        items, idx_list, cindex, estimator, cache,
+        route=T.ROUTE_DEVICE_BIG, tier="big", waves=waves,
+        enable_empty_workload_propagation=enable_empty_workload_propagation,
+        collect_used=collect_used, used0=used0, from_batch=from_batch)
 
 
 def solve_compact(batch, waves: int = 1, max_nnz: int = 0,

@@ -351,6 +351,13 @@ class SolverBatch:
     # plane mid-pipeline can block behind the next chunk's solve on the
     # runtime's transfer path (measured ~170ms stalls on XLA:CPU)
     non_workload_host: np.ndarray = field(default=None)  # bool[n]
+    # fused-source handle (resident/state._assemble_fused): the frozen
+    # host slot-store masters, this chunk's slot vector, and the live
+    # device slot mirrors — the shortlist's fused arming reads binding
+    # fields host-side from the masters and gathers the device rows
+    # straight into its sub-vocabulary (ops/resident_gather sub-gather).
+    # Host bookkeeping only, never shipped.
+    fused_src: Optional[Dict] = field(default=None)
 
 
 def _effective_placement(
@@ -1319,6 +1326,36 @@ class CarryState:
         """True when no consumption has been absorbed yet (used0_for would
         render all-zero accumulators)."""
         return not self.milli and not self.sets and self.pods is None
+
+    def copy(self) -> "CarryState":
+        """Deep copy (independent arrays) — the incremental plane seeds
+        each cycle's pipeline chain from its carried ledger, and the chain
+        mutates its seed in place (merge/absorb are additive)."""
+        out = CarryState()
+        out.milli = {k: v.copy() for k, v in self.milli.items()}
+        out.pods = self.pods.copy() if self.pods is not None else None
+        out.sets = {k: v.copy() for k, v in self.sets.items()}
+        return out
+
+    def retire_lanes(self, lanes: np.ndarray) -> None:
+        """Zero the accumulators at these full-vocabulary cluster lanes.
+
+        The incremental plane's carried-consumption invariant: a lane's
+        carried consumption stands in for allocations the cluster's
+        status has not reported yet, so a status write for that cluster
+        (resident last_cap_lanes) RETIRES the lane — the fresh
+        allocatable/allocated numbers now embed whatever the carried
+        placements actually landed.  Lanes beyond an accumulator's length
+        (vocabulary padding drift) are ignored."""
+        lanes = np.asarray(lanes, np.int64)
+        if lanes.size == 0:
+            return
+        for arr in self.milli.values():
+            arr[lanes[lanes < arr.shape[0]]] = 0
+        if self.pods is not None:
+            self.pods[lanes[lanes < self.pods.shape[0]]] = 0
+        for arr in self.sets.values():
+            arr[lanes[lanes < arr.shape[0]]] = 0
 
     def merge(self, other: "CarryState") -> None:
         """Fold another keyed store into this one (additive; the pipelined

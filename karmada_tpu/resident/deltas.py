@@ -54,10 +54,14 @@ class CycleDeltas:
     clusters: Dict[str, str] = field(default_factory=dict)
     binding_events: int = 0
     bindings_deleted: List[Tuple[str, str]] = field(default_factory=list)
+    # ADDED/MODIFIED binding keys seen this window — the incremental
+    # dirty-set plane (scheduler/incremental.py) seeds its rv-churn mask
+    # from these instead of sweeping a million row tokens per cycle
+    bindings_touched: List[Tuple[str, str]] = field(default_factory=list)
 
     def empty(self) -> bool:
         return (not self.structural and not self.clusters
-                and not self.bindings_deleted)
+                and not self.bindings_deleted and not self.bindings_touched)
 
 
 def classify_change(old: Cluster, new: Cluster) -> Tuple[str, str]:
@@ -99,6 +103,8 @@ class DeltaTracker:
         self._binding_events = 0
         # guarded-by: _lock
         self._bindings_deleted: List[Tuple[str, str]] = []
+        # guarded-by: _lock
+        self._bindings_touched: List[Tuple[str, str]] = []
 
     def on_event(self, event: Event) -> None:
         kind = event.kind
@@ -116,9 +122,11 @@ class DeltaTracker:
         elif kind == ResourceBinding.KIND:
             with self._lock:
                 self._binding_events += 1
+                m = event.obj.metadata
                 if event.type == DELETED:
-                    m = event.obj.metadata
                     self._bindings_deleted.append((m.namespace, m.name))
+                else:
+                    self._bindings_touched.append((m.namespace, m.name))
 
     def drain(self) -> CycleDeltas:
         """The coalesced window since the previous drain (resets it)."""
@@ -129,9 +137,11 @@ class DeltaTracker:
                 clusters=self._clusters,
                 binding_events=self._binding_events,
                 bindings_deleted=self._bindings_deleted,
+                bindings_touched=self._bindings_touched,
             )
             self._clusters = {}
             self._structural = None
             self._binding_events = 0
             self._bindings_deleted = []
+            self._bindings_touched = []
         return out

@@ -466,6 +466,19 @@ class ResidentState:
         # device-mirror dirtiness accumulated since the last sync
         self._dirty: Dict[str, object] = {}
         self._device_primed = False
+        # feasibility-flip lanes of the LAST begin_cycle window: lanes
+        # whose `deleting` value or api_ok column actually CHANGED (not
+        # merely re-wrote).  Among the delta-applied fields these are the
+        # only feasibility inputs (solver feasibility = lanes_ok & pl_mask
+        # & (tol_bypass | prev) & (api_ok | prev) & ~evict; the rest of
+        # _apply_capacity's fields are capacity-only).  The incremental
+        # dirty-set plane (ops/dirty.py) expands these into the rows whose
+        # placements cover them.  Owned by the cycle thread.
+        self.last_flip_lanes: np.ndarray = np.zeros(0, np.int64)
+        # capacity-updated lanes of the LAST begin_cycle window (status
+        # writes): the incremental plane retires its carried-consumption
+        # ledger per lane on these
+        self.last_cap_lanes: np.ndarray = np.zeros(0, np.int64)
 
         self.generation = 0
         self.cycles = 0
@@ -531,6 +544,8 @@ class ResidentState:
 
         clusters = list(clusters)
         self.cycles += 1
+        self.last_flip_lanes = np.zeros(0, np.int64)
+        self.last_cap_lanes = np.zeros(0, np.int64)
         reason = None
         changed: Dict[str, str] = dict(deltas.clusters) if deltas else {}
         if self.plane is None:
@@ -647,6 +662,8 @@ class ResidentState:
                 api_lanes.append(lane)
                 cap_lanes.append(lane)
         if cap_lanes:
+            self.last_cap_lanes = np.asarray(sorted(set(cap_lanes)),
+                                             np.int64)
             self._apply_capacity(sorted(set(cap_lanes)), by_lane)
         if api_lanes:
             self._apply_api(sorted(set(api_lanes)), by_lane)
@@ -670,9 +687,13 @@ class ResidentState:
         has_alloc = txn.get("has_alloc")
         est_override = txn.get("est_override") if self.class_keys else None
         modeling = self.estimator.enable_resource_modeling
+        flips: List[int] = []
         for lane in lanes:
             c = by_lane[lane]
             s = c.status.resource_summary
+            if bool(deleting[lane]) != bool(c.metadata.deleting):
+                # the ONE feasibility input a status write can move
+                flips.append(lane)
             deleting[lane] = c.metadata.deleting
             has_summary[lane] = s is not None
             pods_allowed[lane] = tensors._allowed_pods(s) if s is not None \
@@ -706,6 +727,9 @@ class ResidentState:
         lanes_arr = np.asarray(lanes, np.int64)
         for f in changed:
             self._mark_dirty(f, lanes_arr)
+        if flips:
+            self.last_flip_lanes = np.union1d(
+                self.last_flip_lanes, np.asarray(flips, np.int64))
         self._invalidate_enc_cache()
 
     def _apply_api(self, lanes: List[int],
@@ -714,11 +738,18 @@ class ResidentState:
             return
         txn = _Txn(self.plane)
         api_ok = txn.get("api_ok")
+        flips: List[int] = []
         for lane in lanes:
             c = by_lane[lane]
+            old_col = api_ok[:, lane].copy()
             for g, (api_version, kind) in enumerate(self.gvk_keys):
                 api_ok[g, lane] = (
                     c.api_enablement(api_version, kind) == serial.API_ENABLED)
+            if not np.array_equal(old_col, api_ok[:, lane]):
+                flips.append(lane)  # an api_ok flip is a feasibility flip
+        if flips:
+            self.last_flip_lanes = np.union1d(
+                self.last_flip_lanes, np.asarray(flips, np.int64))
         for f in txn.commit():
             self._mark_dirty(f, np.asarray(lanes, np.int64))
         # gvk rows cached in the encoder are stale for these clusters
@@ -1319,6 +1350,19 @@ class ResidentState:
         batch.fused = True
         batch.nnz_bound_hint = bound
         batch.non_workload_host = nw_host
+        # fused-source handle (ops/shortlist under --resident-fused): the
+        # frozen host masters + this chunk's slot vector + the live slot
+        # mirrors let the shortlist read binding fields LAZILY host-side
+        # (tier-1 profiles are host math) and sub-gather the binding rows
+        # straight into its sub-vocabulary on device — all without the
+        # dense path's per-chunk h2d.  Masters are copy-on-write frozen,
+        # so holding references across the chunk's lifetime is safe; the
+        # mirrors dict is current until the NEXT encode_cycle's sync, and
+        # the shortlist consumes it at shrink time (same thread, before
+        # that sync).
+        batch.fused_src = {"plane": p, "slots": sl, "slots_b": slots_b,
+                           "mirrors": self.device_rows.mirrors,
+                           "plan": plan}
         return batch
 
     def _ensure_fail_plane(self) -> np.ndarray:

@@ -1,0 +1,584 @@
+"""Dirty-set incremental solving: the watch-driven steady state.
+
+The reference control plane never rescans the world — its reconcile
+loop touches exactly what the watch stream dirtied.  The batched
+solver's full-cycle equivalent (re-encode + re-solve every binding,
+every cycle) is what makes a million-binding steady state expensive:
+at 0.1% churn, 99.9% of that work reproduces last cycle's answer
+bit-for-bit.  This module is the solver-side reconcile loop:
+
+  1. ``ops/dirty.dirty_codes`` classifies every slot-store row
+     clean/dirty in one jitted pass (rv churn from the coalesced watch
+     deltas + our own write-backs, feasibility-flip lanes from the
+     resident plane, capacity-sensitive rows, non-device routes).
+  2. Dirty rows gather from the resident slot store into compact
+     sub-batches — grouped by their ORIGINAL chunk so each group is one
+     single-chunk ``run_pipeline`` call, chained through a carried
+     consumed-capacity ledger.
+  3. Everything else keeps last cycle's placement untouched.
+
+Sequential equivalence (the bit-exact contract, waves=1 only)
+-------------------------------------------------------------
+The control is ``run_pipeline(all items, chunk=K, waves=1, carry=True,
+carry_state=ledger)``: a row in chunk c prices against the ledger plus
+the consumption of chunks < c, and never sees same-chunk rows.  The
+incremental cycle reproduces that visibility exactly:
+
+* CLEAN rows reproduce their previous placement and consume zero —
+  the solver's stickiness contract (steady rows take rep = prev and
+  charge nothing; a clean Static/Duplicated row's eligible set did not
+  change, so its re-solve would be its prev).  Skipping them removes
+  no consumption any dirty row would have seen.  This leans on the
+  WRITE-BACK PROTOCOL: ``write_back()`` must run between cycles so a
+  row's stored prev advances to its last result (the write bumps the
+  rv, the row re-solves once, reproduces, and goes quiet).  A caller
+  that solves without writing back leaves moved rows re-charging their
+  prev-delta in every dense control pass while the incremental leg
+  skips them — the audit catches exactly this drift and recovers.
+* Dirty rows grouped by original chunk (pos // chunk) solve as ONE
+  chunk each, seeded with ledger + consumption of earlier groups —
+  exactly the chunks-before-this-one environment of the control.
+* Consecutive chunk-groups COALESCE into one dispatch only when
+  provably order-free: the incoming group's capacity-SENSITIVE rows'
+  placement masks must be disjoint from the union of the already-
+  grouped CONSUMER rows' masks (ops/dirty grades both bits).  Rows
+  whose result cannot observe the skipped consumption are safe to
+  solve a chunk early.
+
+The carried ledger
+------------------
+``tensors.CarryState`` keyed by resource name / scoreclass key in the
+full cluster vocabulary.  Invariants:
+
+* ledger_0 = empty; every cycle's rows (control and incremental alike)
+  price against the PRE-cycle ledger.
+* ledger_{t+1} = ledger_t, retired on the cycle's capacity-updated
+  lanes (``state.last_cap_lanes`` — a cluster status write means the
+  reported availability now embeds previously-charged consumption),
+  plus this cycle's own consumption (the final group's carry-out).
+* A structural plane rebuild resets the ledger (the lane/resource
+  vocabulary it indexes is gone) and forces a full solve.
+
+Audit cadence
+-------------
+Every ``audit_every``-th cycle (knob; 0 disables) the full dense solve
+runs as a bit-exact control against the SAME pre-cycle ledger and the
+merged incremental results are compared row-by-row (and the ledgers
+store-by-store).  A mismatch is loud: metric, lifecycle-ledger event,
+and the full solve's results + ledger are adopted wholesale — the
+incremental plane recovers by construction, never schedules from a
+diverged state twice.
+
+Driven single-threaded from one scheduler/bench cycle loop, like the
+ResidentState it wraps.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from karmada_tpu.obs import events as ev
+from karmada_tpu.ops import dirty as dirty_mod
+from karmada_tpu.ops import tensors as T
+from karmada_tpu.scheduler import pipeline
+from karmada_tpu.utils.metrics import REGISTRY
+
+INC_CYCLES = REGISTRY.counter(
+    "karmada_incremental_cycles_total",
+    "Incremental-plane scheduling cycles by mode (incremental: dirty "
+    "sub-batches only; full: dense solve forced by adopt/rebuild/"
+    "roster-change/audit-mismatch)",
+    ("mode",),
+)
+INC_AUDITS = REGISTRY.counter(
+    "karmada_incremental_audits_total",
+    "Bit-exact parity audits of the incremental solve against the full "
+    "dense control (outcome=ok|mismatch; mismatch also forces adoption "
+    "of the control's results and ledger)",
+    ("outcome",),
+)
+
+#: conservative dirty grade for rows with no slot-store row yet
+#: (appended bindings, affinity-failover rows that bypass the cache)
+_ALL_BITS = dirty_mod.DIRTY | dirty_mod.SENSITIVE | dirty_mod.CONSUMER
+
+#: slot-store fields the dirty kernel gathers row-wise — the device
+#: mirrors are used only when they cover all of these
+_KERNEL_ROW_FIELDS = ("placement_id", "replicas", "fresh", "non_workload",
+                      "route", "prev_idx", "prev_val", "evict_idx")
+
+
+def _norm(res) -> tuple:
+    """Order-free comparable form of one scheduling outcome."""
+    if isinstance(res, Exception):
+        return ("exc", type(res).__name__)
+    return tuple(sorted((t.name, int(t.replicas)) for t in res))
+
+
+def _ledger_equal(a: T.CarryState, b: T.CarryState) -> bool:
+    """Store equality treating missing keys as zeros (a group's sub-
+    vocabulary may simply never have priced a resource)."""
+    def eq(da, db):
+        for k in set(da) | set(db):
+            x, y = da.get(k), db.get(k)
+            if x is None:
+                x = np.zeros_like(y)
+            if y is None:
+                y = np.zeros_like(x)
+            if x.shape != y.shape or not np.array_equal(x, y):
+                return False
+        return True
+
+    pa = a.pods if a.pods is not None else None
+    pb = b.pods if b.pods is not None else None
+    if (pa is None) != (pb is None):
+        pa = np.zeros(0, np.int64) if pa is None else pa
+        pb = np.zeros(pa.shape, np.int64) if pb is None else pb
+    return (eq(a.milli, b.milli) and eq(a.sets, b.sets)
+            and (pa is None or np.array_equal(pa, pb)))
+
+
+@dataclass
+class CycleReport:
+    """One incremental cycle's outcome (the bench payload's raw rows)."""
+
+    mode: str = "incremental"        # or "full"
+    reason: str = ""                 # full-solve trigger ("" incremental)
+    total: int = 0                   # roster size
+    dirty: int = 0                   # rows re-solved this cycle
+    chunk_groups: int = 0            # original-chunk groups before coalesce
+    groups: List[int] = field(default_factory=list)  # dispatch sizes
+    host_rows: int = 0               # rows the device tiers stopped owning
+    audited: bool = False
+    audit_outcome: Optional[str] = None   # "ok" | "mismatch"
+    seconds: float = 0.0
+
+
+class IncrementalSolver:
+    """Steady-state scheduling driver over a ResidentState plane.
+
+    ``adopt()`` once (full solve, roster + ledger established), then
+    ``cycle()`` per scheduling round with the window's coalesced
+    deltas; ``write_back()`` patches changed placements into the
+    binding objects (rv bump ⇒ next cycle re-solves exactly those rows
+    once more, reproduces them, and goes quiet — self-churn
+    terminates).
+
+    The roster is append-only between full solves: the bindings
+    sequence must keep its order, with new bindings appended (they are
+    force-dirtied).  Any shrink/reorder falls back to a full solve —
+    loud, never wrong.
+    """
+
+    def __init__(self, state, estimator, *, chunk: int = 4096,
+                 waves: int = 1, audit_every: int = 16,
+                 shortlist=None, diagnose: bool = False) -> None:
+        assert waves == 1, \
+            "incremental solving is bit-exact only at waves=1 (a chunk's " \
+            "rows must never see same-chunk consumption)"
+        self.state = state
+        self.estimator = estimator
+        self.chunk = int(chunk)
+        self.audit_every = max(0, int(audit_every))
+        self.shortlist = shortlist
+        self.diagnose = bool(diagnose)
+        # lane budget for taint-coalescing when the shortlist is armed:
+        # merging chunk-groups from disjoint placement scopes is order-
+        # free, but an unbounded merge unions their candidate lanes —
+        # random churn over a region-sharded fleet would coalesce into
+        # one near-dense-width dispatch (union_wide fallback + a dense
+        # solve, the exact work this plane exists to avoid).  Bounding
+        # the merged groups' mask-union keeps every dispatch inside the
+        # shortlist's narrow sub-vocabulary; more (sequential) groups
+        # never break exactness, they only add barriers.
+        self._lane_budget = (8 * shortlist.k) if shortlist else None
+
+        self.ledger: T.CarryState = T.CarryState()
+        self.keys: List[str] = []
+        self.key_pos: Dict[str, int] = {}
+        self.bindings: List = []
+        self.results: Dict[int, object] = {}
+        # pos -> slot-store slot (-1: no cached row); refreshed for rows
+        # that re-encode, so the next dirty pass reads live slots
+        self._slots: np.ndarray = np.zeros(0, np.int64)
+        # keys our own write_back() touched since the last cycle — the
+        # watch stream the bench/tests drive may not carry them
+        self._pending: Set[str] = set()
+        # pos -> last normalized outcome write_back applied (changed-only
+        # patching; repeated identical results never bump an rv)
+        self._applied: Dict[int, tuple] = {}
+        # positions whose result changed since the last write_back — at a
+        # million-row roster write_back must not re-normalize the whole
+        # results map to find the ~0.1% that moved
+        self._since_wb: Set[int] = set()
+        # the caller's roster object, for the identity fast path in
+        # cycle(): same list + same length skips the O(n) key rebuild.
+        # Assumes the roster is append-only (replacing an element in
+        # place must come as a new list — a store snapshot does).
+        self._roster_src: Optional[object] = None
+        self.cycles = 0
+        self._plm_cache: Optional[Tuple[int, np.ndarray]] = None
+        self._pid_cache: Optional[Tuple[int, np.ndarray]] = None
+
+    # -- roster ---------------------------------------------------------------
+    def _token(self, rb, key: str):
+        from karmada_tpu.resident import RowToken
+
+        terms = (rb.spec.placement.cluster_affinities
+                 if rb.spec.placement else [])
+        # affinity-failover rows encode against synthesized status and
+        # bypass the row cache (see scheduler/service) — no stable token
+        return None if terms else RowToken(key, rb.metadata.resource_version)
+
+    def _set_roster(self, bindings: Sequence, keys: List[str]) -> List[int]:
+        """Adopt the cycle's roster; returns appended positions (the
+        caller has already verified prefix stability)."""
+        n0 = len(self.keys)
+        appended = list(range(n0, len(keys)))
+        for i in appended:
+            self.key_pos[keys[i]] = i
+        if appended:
+            self._slots = np.concatenate(
+                [self._slots, np.full(len(appended), -1, np.int64)])
+        self.keys = keys
+        self.bindings = list(bindings)
+        self._roster_src = bindings
+        return appended
+
+    def _rebuild_roster(self, bindings: Sequence, keys: List[str]) -> None:
+        self.keys = keys
+        self.key_pos = {k: i for i, k in enumerate(keys)}
+        self.bindings = list(bindings)
+        self._slots = np.full(len(keys), -1, np.int64)
+        self.results = {}
+        self._applied = {}
+        self._since_wb = set()
+        self._roster_src = bindings
+
+    def _refresh_slots(self, positions) -> None:
+        rows = self.state.rows
+        sl = self._slots
+        keys = self.keys
+        for p in positions:
+            row = rows.get(keys[p])
+            sl[p] = row.slot if row is not None else -1
+
+    # -- plane views (cached on the frozen masters' identity) -----------------
+    def _plm(self) -> np.ndarray:
+        m = self.state.plane.pl_mask
+        if self._plm_cache is None or self._plm_cache[0] != id(m):
+            self._plm_cache = (id(m), np.asarray(m).astype(bool))
+        return self._plm_cache[1]
+
+    def _pid(self) -> np.ndarray:
+        a = self.state.plane.placement_id
+        if self._pid_cache is None or self._pid_cache[0] != id(a):
+            self._pid_cache = (id(a), np.asarray(a))
+        return self._pid_cache[1]
+
+    # -- the two solve legs ---------------------------------------------------
+    def _run_all(self, seed: T.CarryState) -> "pipeline.PipelineResult":
+        """Full dense control: every roster row, seeded from `seed`."""
+        state = self.state
+        toks = [self._token(rb, k) for rb, k in zip(self.bindings, self.keys)]
+        items = [(rb.spec, rb.status) for rb in self.bindings]
+
+        def encode(part, offset, armed):
+            return state.encode_cycle(
+                part, toks[offset:offset + len(part)], explain=armed)
+
+        res = pipeline.run_pipeline(
+            items, state.cindex, self.estimator,
+            chunk=self.chunk, waves=1, cache=state.enc_cache,
+            carry=True, collect=True, diagnose=self.diagnose,
+            encode=encode, keys=self.keys, shortlist=self.shortlist,
+            carry_state=seed, collect_carry=True)
+        if res.cancelled or res.carry is None:
+            raise RuntimeError("incremental full solve did not complete")
+        return res
+
+    def _full(self, reason: str, rep: CycleReport) -> CycleReport:
+        res = self._run_all(self.ledger)
+        self.results = dict(res.results)
+        self._since_wb = set(self.results)
+        self.ledger = res.carry
+        self._refresh_slots(range(len(self.keys)))
+        INC_CYCLES.inc(mode="full")
+        rep.mode = "full"
+        rep.reason = reason
+        rep.dirty = len(self.keys)
+        rep.host_rows = len(self.keys) - len(self.results)
+        return rep
+
+    def _solve_group(self, grp: List[int],
+                     seed: T.CarryState) -> "pipeline.PipelineResult":
+        state = self.state
+        g_bind = [self.bindings[p] for p in grp]
+        g_keys = [self.keys[p] for p in grp]
+        g_items = [(b.spec, b.status) for b in g_bind]
+        g_toks = [self._token(b, k) for b, k in zip(g_bind, g_keys)]
+
+        def encode(part, offset, armed, _t=g_toks):
+            return state.encode_cycle(
+                part, _t[offset:offset + len(part)], explain=armed)
+
+        res = pipeline.run_pipeline(
+            g_items, state.cindex, self.estimator,
+            chunk=self.chunk, waves=1, cache=state.enc_cache,
+            carry=True, collect=True, diagnose=self.diagnose,
+            encode=encode, keys=g_keys, shortlist=self.shortlist,
+            carry_state=seed, collect_carry=True)
+        if res.cancelled or res.carry is None:
+            raise RuntimeError("incremental group solve did not complete")
+        return res
+
+    # -- lifecycle ------------------------------------------------------------
+    def adopt(self, clusters: Sequence, bindings: Sequence) -> CycleReport:
+        """First cycle: full solve, roster + ledger + slot store built."""
+        t0 = time.perf_counter()
+        self.cycles += 1
+        self._rebuild_roster(
+            bindings, [f"{rb.namespace}/{rb.name}" for rb in bindings])
+        self.state.begin_cycle(clusters, None)
+        self.ledger = T.CarryState()
+        rep = self._full("adopt", CycleReport(total=len(self.keys)))
+        rep.seconds = time.perf_counter() - t0
+        return rep
+
+    def cycle(self, clusters: Sequence, bindings: Sequence,
+              deltas=None, force_audit: Optional[bool] = None) -> CycleReport:
+        """One watch-driven cycle: apply `deltas` to the plane, re-solve
+        the dirty set, audit on cadence.  `bindings` is the full roster
+        (append-only vs the previous cycle, or a full solve triggers)."""
+        t0 = time.perf_counter()
+        self.cycles += 1
+        state = self.state
+        gen0 = state.generation
+        state.begin_cycle(clusters, deltas)
+        rep = CycleReport(total=len(bindings))
+
+        n0 = len(self.keys)
+        if bindings is self._roster_src and len(bindings) == n0:
+            keys = self.keys  # identity fast path: no O(n) key rebuild
+        else:
+            keys = [f"{rb.namespace}/{rb.name}" for rb in bindings]
+        full_reason = None
+        if state.generation != gen0 or state.plane is None:
+            # structural rebuild: the lane/resource vocabulary the ledger
+            # indexes is gone — reset it, price from reported capacity
+            full_reason = "plane-rebuild"
+            self.ledger = T.CarryState()
+        elif len(keys) < n0 or keys[:n0] != self.keys:
+            full_reason = "roster-change"
+        if full_reason:
+            self._rebuild_roster(bindings, keys)
+            self.ledger.retire_lanes(state.last_cap_lanes)
+            ev.emit(ev.SCHEDULER_REF, ev.TYPE_NORMAL,
+                    ev.REASON_INCREMENTAL_FULL_SOLVE,
+                    f"incremental plane forced a full dense solve: "
+                    f"{full_reason}", origin="incremental")
+            rep = self._full(full_reason, rep)
+            self._pending.clear()
+            rep.seconds = time.perf_counter() - t0
+            return rep
+
+        appended = self._set_roster(bindings, keys)
+        # capacity catch-up: status writes mean the snapshot's reported
+        # availability now embeds previously-charged consumption
+        self.ledger.retire_lanes(state.last_cap_lanes)
+
+        # rv churn: the coalesced watch window + our own write-backs
+        touched = set(self._pending)
+        self._pending.clear()
+        if deltas is not None:
+            touched.update(f"{ns}/{nm}"
+                           for ns, nm in deltas.bindings_touched)
+        rv_slots: List[int] = []
+        forced_pos: List[int] = list(appended)
+        for key in touched:
+            p = self.key_pos.get(key)
+            if p is None:
+                continue
+            s = int(self._slots[p])
+            if s >= 0:
+                rv_slots.append(s)
+            else:
+                forced_pos.append(p)
+
+        mirrors = None
+        dr = getattr(state, "device_rows", None)
+        if (dr is not None and not dr.broken
+                and all(f in dr.mirrors for f in _KERNEL_ROW_FIELDS)):
+            mirrors = dr.mirrors
+        codes = dirty_mod.dirty_codes(
+            state, np.asarray(rv_slots, np.int64), mirrors=mirrors)
+
+        n = len(keys)
+        pos_codes = np.zeros(n, np.uint8)
+        has_slot = self._slots >= 0
+        pos_codes[has_slot] = codes[self._slots[has_slot]]
+        # no cached row = no slot to read: conservatively dirty
+        pos_codes[~has_slot] = _ALL_BITS
+        if forced_pos:
+            pos_codes[forced_pos] = _ALL_BITS
+        dirty_pos = np.flatnonzero(pos_codes & dirty_mod.DIRTY)
+        rep.dirty = int(dirty_pos.size)
+        dirty_mod.DIRTY_ROWS.inc(rep.dirty)
+        dirty_mod.DIRTY_FRACTION.set(rep.dirty / max(n, 1))
+        INC_CYCLES.inc(mode="incremental")
+
+        groups = self._group(dirty_pos, pos_codes)
+        rep.chunk_groups = len(np.unique(dirty_pos // self.chunk))
+        rep.groups = [len(g) for g in groups]
+
+        pre = self.ledger.copy()  # the audit's seed: PRE-cycle ledger
+        seed = self.ledger
+        new_results: Dict[int, object] = {}
+        for grp in groups:
+            res = self._solve_group(grp, seed)
+            seed = res.carry
+            for j, r in res.results.items():
+                new_results[grp[j]] = r
+        self.ledger = seed
+        for p in dirty_pos.tolist():
+            if p not in new_results:
+                # the row left the device tiers (route change): the
+                # caller's serial fallback owns it now
+                if self.results.pop(p, None) is not None:
+                    rep.host_rows += 1
+        self.results.update(new_results)
+        self._since_wb.update(new_results)
+        self._refresh_slots(dirty_pos.tolist())
+
+        rep.audited = (force_audit if force_audit is not None
+                       else (self.audit_every > 0
+                             and self.cycles % self.audit_every == 0))
+        if rep.audited:
+            rep.audit_outcome = self._audit(pre)
+        rep.seconds = time.perf_counter() - t0
+        return rep
+
+    # -- grouping -------------------------------------------------------------
+    def _group(self, dirty_pos: np.ndarray,
+               pos_codes: np.ndarray) -> List[List[int]]:
+        """Original-chunk groups with the taint-coalescing rule (see
+        module docstring): merge chunk-group B into the running dispatch
+        only when B's sensitive rows' placement masks are disjoint from
+        the consumer-mask union accumulated so far (and the merged size
+        stays within one chunk).  With the shortlist armed a third gate
+        applies: the merged dispatch's candidate-lane union must stay
+        within ``_lane_budget`` — splitting into more sequential groups
+        is always exact (pieces stay chunk-atomic; extra ordering only
+        affects rows that share lanes, and those never merged anyway),
+        while over-merging disjoint regions widens the sub-vocabulary
+        until the shortlist falls back to a dense solve."""
+        if dirty_pos.size == 0:
+            return []
+        plm = self._plm()
+        pid = self._pid()
+        C = plm.shape[1]
+        # measured on the 1M x 10k megafleet: tier-2 sub-solve cost grows
+        # superlinearly with the dispatch shape ([512, 2048] costs ~4x a
+        # [128, 512] solve), so many narrow shape-stable dispatches beat
+        # few wide ones — 8*k keeps each group at one pow2 width
+        budget = self._lane_budget if self._lane_budget else C
+
+        def mask_union(rows: np.ndarray, bit: int) -> np.ndarray:
+            sel = rows[(pos_codes[rows] & bit) != 0]
+            if sel.size == 0:
+                return np.zeros(C, bool)
+            slots = self._slots[sel]
+            if np.any(slots < 0):
+                return np.ones(C, bool)  # unknown row: taints everything
+            return plm[pid[slots]].any(axis=0)
+
+        chunk_ids = dirty_pos // self.chunk
+        bounds = np.flatnonzero(np.diff(chunk_ids)) + 1
+        pieces = np.split(dirty_pos, bounds)
+
+        groups: List[List[int]] = []
+        cur: List[int] = []
+        cur_cons = np.zeros(C, bool)
+        cur_all = np.zeros(C, bool)
+        for g in pieces:
+            inc_sens = mask_union(g, dirty_mod.SENSITIVE)
+            g_all = mask_union(g, dirty_mod.DIRTY)  # every row is DIRTY
+            if (cur and len(cur) + len(g) <= self.chunk
+                    and not np.any(cur_cons & inc_sens)
+                    and int(np.count_nonzero(cur_all | g_all)) <= budget):
+                cur.extend(g.tolist())
+            else:
+                if cur:
+                    groups.append(cur)
+                cur = g.tolist()
+                cur_cons = np.zeros(C, bool)
+                cur_all = np.zeros(C, bool)
+            cur_cons |= mask_union(g, dirty_mod.CONSUMER)
+            cur_all |= g_all
+        if cur:
+            groups.append(cur)
+        return groups
+
+    # -- audit ----------------------------------------------------------------
+    def _audit(self, pre: T.CarryState) -> str:
+        """Full dense control against the same pre-cycle ledger; adopt
+        its results + ledger on any divergence."""
+        res = self._run_all(pre)
+        bad: List[int] = []
+        for p in set(res.results) | set(self.results):
+            a = self.results.get(p)
+            b = res.results.get(p)
+            if (a is None) != (b is None) or \
+                    (a is not None and _norm(a) != _norm(b)):
+                bad.append(p)
+        ledger_ok = _ledger_equal(self.ledger, res.carry)
+        if not bad and ledger_ok:
+            INC_AUDITS.inc(outcome="ok")
+            return "ok"
+        INC_AUDITS.inc(outcome="mismatch")
+        what = (f"{len(bad)} row(s) diverged"
+                + ("" if ledger_ok else " and the capacity ledger drifted"))
+        names = ", ".join(self.keys[p] for p in sorted(bad)[:5])
+        ev.emit(ev.SCHEDULER_REF, ev.TYPE_WARNING,
+                ev.REASON_INCREMENTAL_AUDIT_MISMATCH,
+                f"incremental solve diverged from the dense control: {what}"
+                + (f" ({names})" if names else "")
+                + "; adopting the control's results and ledger",
+                origin="incremental")
+        self.results = dict(res.results)
+        self._since_wb = set(self.results)
+        self.ledger = res.carry
+        self._refresh_slots(range(len(self.keys)))
+        return "mismatch"
+
+    # -- write-back -----------------------------------------------------------
+    def write_back(self) -> int:
+        """Patch changed placements into the roster's binding objects
+        (spec.clusters + rv bump), changed-only: a result identical to
+        the last applied one writes nothing, so re-solve -> identical
+        answer -> no rv bump terminates the self-churn loop.  Returns
+        the number of bindings written.  Visits only positions whose
+        result changed since the last write_back (``_since_wb``) — the
+        steady-state contract is O(dirty) here too, not O(roster)."""
+        changed = 0
+        for pos in self._since_wb:
+            res = self.results.get(pos)
+            if res is None:
+                continue  # row left the device tiers since
+            norm = _norm(res)
+            if self._applied.get(pos) == norm:
+                continue
+            self._applied[pos] = norm
+            if isinstance(res, Exception):
+                continue  # no placement to record; outcome tracked only
+            rb = self.bindings[pos]
+            rb.spec.clusters = list(res)
+            rb.metadata.resource_version += 1
+            self._pending.add(self.keys[pos])
+            changed += 1
+        self._since_wb.clear()
+        return changed

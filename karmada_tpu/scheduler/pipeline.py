@@ -106,6 +106,11 @@ class PipelineResult:
     solve_s: float = 0.0
     chunks: int = 0          # finalized (skipped chunks excluded)
     cancelled: bool = False  # the guard fired mid-cycle; results are partial
+    # the run's cumulative consumed-capacity store (collect_carry=True,
+    # carry on, not cancelled): seed carry_state + every chunk's own
+    # consumption, keyed by resource name / class key in the FULL
+    # cluster vocabulary — the incremental plane's ledger transport
+    carry: Optional["tensors.CarryState"] = None
 
 
 class _CarryChain:
@@ -245,6 +250,23 @@ class _CarryChain:
             raise AssertionError("dispatched() without a carry_in() segment")
         self._seg[3] = handle
 
+    def snapshot(self) -> "tensors.CarryState":
+        """The cumulative consumption of every chunk dispatched so far, as
+        a fresh keyed store in the FULL vocabulary — WITHOUT closing the
+        open segment (the chain keeps pipelining).  Forces a host sync on
+        the segment's last dispatched solve; callers use it sparingly
+        (the shortlist truncation residual, the collect_carry epilogue)."""
+        out = self.total.copy()
+        if not self.extras.empty():
+            out.merge(self.extras)
+        if self._seg is not None and self._seg[3] is not None:
+            from karmada_tpu.ops.solver import dispatched_used
+
+            _sig, batch, base, handle = self._seg
+            used = tuple(np.asarray(u) for u in dispatched_used(handle))
+            out.absorb(batch, used, base)
+        return out
+
 
 def _record_decisions(recorder, batch, part, offset, keys, out_local,
                       expl_planes, sp_expl, cyc, live: bool) -> None:
@@ -349,6 +371,13 @@ class _InFlight:
     t_submit: float
     encode_s: float
     span: object = None      # the chunk's wall span (None: tracing off)
+    # shortlist truncation residual (ops/shortlist): chunk-local row
+    # indices solved per-binding at full dense width in finalize, plus
+    # the full-vocabulary carry snapshot they price against (the chunk's
+    # own used0 lives in the SUB vocabulary — lossy for lanes outside
+    # the union, which a full-width residual row does consult)
+    residual: List[int] = field(default_factory=list)
+    resid_used0: object = None
 
 
 def run_pipeline(
@@ -371,6 +400,8 @@ def run_pipeline(
     keys: Optional[Sequence[str]] = None,
     encode: Optional[Callable[[Sequence, int, bool], object]] = None,
     shortlist=None,
+    carry_state: Optional["tensors.CarryState"] = None,
+    collect_carry: bool = False,
 ) -> PipelineResult:
     """Schedule `items` (a cycle of (spec, status) pairs) through the
     pipelined chunk executor.  Returns a PipelineResult whose `results`
@@ -417,11 +448,22 @@ def run_pipeline(
       candidate kernel and dispatch the existing solver over the
       candidate-union sub-vocabulary (bit-exact when covered; loud dense
       fallback otherwise).  None (default) keeps every chunk dense.
+      Rows the shortlist truncates out (eligible set beyond k_max) come
+      back as per-binding dense residual solves in the chunk's finalize
+      — exact at waves=1, so truncation only arms there.
+    carry_state: seed the carry chain with consumption carried in from a
+      PREVIOUS run (requires carry=True) — the incremental plane's
+      ledger: every chunk prices against snapshot minus this seed minus
+      in-run consumption.  The seed object is not mutated.
+    collect_carry: return the run's cumulative consumption (seed + every
+      chunk's own) as PipelineResult.carry — costs one host sync on the
+      last dispatched solve at the end of the run.
     """
     from karmada_tpu.ops.solver import (
         dispatch_compact,
         finalize_compact,
         solve_big,
+        solve_rows,
         wait_compact,
     )
     from karmada_tpu.ops.spread import solve_spread
@@ -436,6 +478,12 @@ def run_pipeline(
     cache = cache if cache is not None else tensors.EncoderCache()
     keep_sel = enable_empty_workload_propagation
     chain = _CarryChain() if carry else None
+    assert carry_state is None or chain is not None, \
+        "carry_state seeding requires carry=True"
+    if chain is not None and carry_state is not None:
+        # merge copies every array on first insert: the caller's seed
+        # object stays untouched however the chain mutates its store
+        chain.total.merge(carry_state)
     carry_label = "on" if carry else "off"
     from karmada_tpu.ops import meshing
 
@@ -540,6 +588,34 @@ def run_pipeline(
             if live():
                 sm.STEP_LATENCY.observe(
                     time.perf_counter() - t_big, schedule_step=sm.STEP_SOLVE)
+        if entry.residual:
+            # shortlist truncation residual: the rows whose eligible set
+            # outgrew k_max, solved per-binding at FULL dense width
+            # against the chunk's starting consumption (exact at
+            # waves=1 — within a chunk, rows never see each other).
+            # Their results override the sub-solve's invalidated rows
+            # via the out_local.update(sub) below.
+            t_rs = time.perf_counter()
+            if chain is not None and entry.resid_used0 is not None:
+                r_out, r_used = solve_rows(
+                    part, entry.residual, cindex, estimator, cache,
+                    route=tensors.ROUTE_DEVICE, waves=waves,
+                    enable_empty_workload_propagation=keep_sel,
+                    collect_used=True, used0=entry.resid_used0,
+                )
+                if r_used is not None:
+                    r_batch, r_used_out, r_used0 = r_used
+                    chain.extras.absorb(r_batch, r_used_out, r_used0)
+            else:
+                r_out = solve_rows(
+                    part, entry.residual, cindex, estimator, cache,
+                    route=tensors.ROUTE_DEVICE, waves=waves,
+                    enable_empty_workload_propagation=keep_sel,
+                )
+            sub.update(r_out)
+            if live():
+                sm.STEP_LATENCY.observe(
+                    time.perf_counter() - t_rs, schedule_step=sm.STEP_SOLVE)
         decode_s = 0.0
         out_local: Dict[int, object] = {}
         expl_planes = None
@@ -663,6 +739,8 @@ def run_pipeline(
             batch = (encode(part, lo, armed) if encode is not None
                      else tensors.encode_batch(part, cindex, estimator,
                                                cache=cache, explain=armed))
+            residual: List[int] = []
+            resid_used0 = None
             if shortlist is not None:
                 # tier selection (ops/shortlist): dispatch the cheap
                 # candidate kernel and, when the chunk is covered, swap
@@ -672,8 +750,12 @@ def run_pipeline(
                 # shortlist module; bit-exactness is never traded).
                 from karmada_tpu.ops import shortlist as sl_mod
 
-                sub, sl_info = sl_mod.shrink_chunk(batch, shortlist,
-                                                   plan=mesh_plan)
+                sub, sl_info = sl_mod.shrink_chunk(
+                    batch, shortlist, plan=mesh_plan, part=part,
+                    # the per-binding residual is exact only at waves=1
+                    # (one chunk's rows never see each other there) and
+                    # keep_sel needs the full selection plane
+                    allow_truncate=(waves == 1 and not keep_sel))
                 if ch_span is not None:
                     ch_span.set_attr(shortlist=(
                         f"union={sl_info['union']} k={sl_info['k']}"
@@ -681,6 +763,15 @@ def run_pipeline(
                         else sl_info.get("fallback", "off")))
                 if sub is not None:
                     batch = sub
+                    residual = sl_info.get("residual") or []
+                    if residual and chain is not None:
+                        # full-vocabulary carry-in for the residual rows:
+                        # the chunk's own used0 lives in the union
+                        # vocabulary, blind to consumption on lanes
+                        # outside it.  Snapshot BEFORE this chunk's
+                        # dispatch = exactly the chunks-before-this-one
+                        # consumption (rare path: super-k_max rows)
+                        resid_used0 = chain.snapshot()
             t1 = time.perf_counter()
             if enc_span is not None:
                 enc_span.end()
@@ -718,7 +809,7 @@ def run_pipeline(
                 # rows keep their used0 alive.  The solver additionally
                 # refuses donation whenever the nnz-escalation re-solve is
                 # not provably impossible.
-                donate = (chain is not None
+                donate = (chain is not None and not residual
                           and not (carry_spread and bool(np.isin(
                               batch.route,
                               (tensors.ROUTE_DEVICE_SPREAD,
@@ -748,12 +839,18 @@ def run_pipeline(
                         schedule_step=sm.STEP_H2D)
             entry = _InFlight(index=ci, offset=lo, part=part, batch=batch,
                               handle=handle, used0=used0, t_submit=tc,
-                              encode_s=t1 - tc, span=ch_span)
+                              encode_s=t1 - tc, span=ch_span,
+                              residual=residual, resid_used0=resid_used0)
             if pending is not None:
                 finalize(pending)
             pending = entry
         if pending is not None and live():
             finalize(pending)
+        if chain is not None and collect_carry and live():
+            # the incremental plane's ledger hand-off: seed + every
+            # chunk's own consumption, keyed in the full vocabulary
+            # (one host sync on the final dispatched solve)
+            res.carry = chain.snapshot()
     finally:
         res.cancelled = not live()
         if cyc is not None:

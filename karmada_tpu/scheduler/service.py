@@ -307,14 +307,14 @@ class Scheduler:
         self.resident_fused = bool(resident_fused and resident
                                    and backend == "device")
         # shortlist tier selection: built lazily (ops/shortlist imports
-        # jax) the first device cycle that can use it.  The fused
-        # resident path keeps the dense dispatch — its binding rows live
-        # in the device slot store, which the host-side sub-vocabulary
-        # remap cannot gather; arming both would only ledger a fallback
-        # per chunk, so the combination disarms shortlisting up front.
+        # jax) the first device cycle that can use it.  Composes with the
+        # fused resident path: shrink logic reads the host slot-store
+        # masters through the batch's fused_src handle and the sub-batch
+        # gathers straight into the union vocabulary on device
+        # (ops/resident_gather.dispatch_sub_gather), so binding rows
+        # still never re-upload.
         self.shortlist_k = (int(shortlist_k) if shortlist_k
-                            and backend == "device"
-                            and not self.resident_fused else None)
+                            and backend == "device" else None)
         self.shortlist_min_cells = int(shortlist_min_cells)
         self._shortlist_cfg = None
         if resident and backend == "device":

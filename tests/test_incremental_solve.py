@@ -1,0 +1,435 @@
+"""Dirty-set incremental solving (ops/dirty + scheduler/incremental).
+
+The golden contract: every incremental cycle's merged placements are
+BIT-EXACT against the full dense solve seeded from the same pre-cycle
+capacity ledger — asserted here by forcing the built-in parity audit
+every cycle (the audit IS the dense control) across random delta
+streams at 0.01% / 0.1% / 5% churn, through vocabulary growth (roster
+appends with new placements), cluster removal (structural rebuild ⇒
+forced full solve + ledger reset), and a forced audit mismatch
+(corrupted results ⇒ loud recovery by adopting the control's answer).
+
+Also covered: the carried-ledger seeding really flows into pricing
+(run_pipeline carry_state), write-back self-churn terminates, the
+dirty kernel's clean/steady classification empties the dirty set on
+quiet cycles, and the fused slot store composes with the shortlist
+plane (PR-15 gap: --shortlist now arms under --resident-fused) with
+parity on the 2-device mesh.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import bench
+from karmada_tpu.estimator.general import GeneralEstimator
+from karmada_tpu.models.meta import ObjectMeta
+from karmada_tpu.models.work import ResourceBinding
+from karmada_tpu.ops import dirty as dirty_mod
+from karmada_tpu.ops import meshing, shortlist as sl, tensors
+from karmada_tpu.resident import ResidentState
+from karmada_tpu.resident.deltas import CycleDeltas
+from karmada_tpu.scheduler import pipeline
+from karmada_tpu.scheduler.incremental import (
+    INC_AUDITS,
+    INC_CYCLES,
+    CycleReport,
+    IncrementalSolver,
+)
+
+pytestmark = pytest.mark.incremental
+
+
+@pytest.fixture(autouse=True)
+def _no_mesh_leak():
+    yield
+    meshing.deactivate()
+
+
+def _fleet(n, seed=0, pods=None):
+    rng = random.Random(seed)
+    clusters = bench.build_fleet(rng, n)
+    if pods is not None:
+        for c in clusters:
+            q = c.status.resource_summary.allocatable["pods"]
+            c.status.resource_summary.allocatable["pods"] = (
+                type(q).from_units(pods))
+    return clusters
+
+
+def _bindings(rng, n, placements, seed_tag=""):
+    """ResourceBinding objects (the incremental roster is binding-
+    addressed: keys + rvs + in-place write-back)."""
+    out = []
+    for i, (spec, status) in enumerate(
+            bench.build_bindings(rng, n, placements)):
+        out.append(ResourceBinding(
+            metadata=ObjectMeta(namespace=spec.resource.namespace,
+                                name=f"{seed_tag}{spec.resource.name}",
+                                resource_version=1),
+            spec=spec, status=status))
+    return out
+
+
+def _placements(rng, names, n=6, lo=4, hi=10):
+    import tests.test_shortlist as ts
+
+    return ts._affinity_placements(rng, names, n=n, lo=lo, hi=hi)
+
+
+def _world(n_clusters=48, n_bindings=256, seed=11, pods=None, n_pl=6):
+    rng = random.Random(seed)
+    clusters = _fleet(n_clusters, seed=seed, pods=pods)
+    names = [c.metadata.name for c in clusters]
+    pls = _placements(rng, names, n=n_pl)
+    bindings = _bindings(rng, n_bindings, pls)
+    return rng, clusters, names, pls, bindings
+
+
+def _churn(rng, clusters, bindings, n_rows, n_caps=0):
+    """One watch window: bump n_rows bindings' replica targets and
+    n_caps clusters' reported pod capacity.  Returns the CycleDeltas a
+    DeltaTracker would have coalesced (cluster churn rides the resident
+    plane's own rv sweep instead)."""
+    touched = []
+    for pos in rng.sample(range(len(bindings)), n_rows):
+        rb = bindings[pos]
+        rb.spec.replicas = max(1, rb.spec.replicas + rng.choice((-1, 1)))
+        rb.metadata.resource_version += 1
+        touched.append((rb.namespace, rb.name))
+    for c in rng.sample(clusters, n_caps):
+        q = c.status.resource_summary.allocatable["pods"]
+        c.status.resource_summary.allocatable["pods"] = (
+            type(q).from_units(max(8, int(q.value()) + rng.choice(
+                (-4, 4)))))
+        c.metadata.resource_version += 1
+    return CycleDeltas(bindings_touched=touched)
+
+
+def _static_world(seed=23, n_clusters=32, n_bindings=128):
+    """Duplicated/StaticWeight-only placements over an ample fleet: no
+    dynamic-divergence, so quiet cycles classify every row clean."""
+    from karmada_tpu.models.policy import (
+        ClusterAffinity, Placement, ReplicaSchedulingStrategy,
+        REPLICA_SCHEDULING_DIVIDED, REPLICA_DIVISION_WEIGHTED)
+
+    rng = random.Random(seed)
+    clusters = _fleet(n_clusters, seed=seed)
+    names = [c.metadata.name for c in clusters]
+    pls = []
+    for j in range(6):
+        picked = rng.sample(names, rng.randint(4, 10))
+        rs = (ReplicaSchedulingStrategy(
+                  replica_scheduling_type="Duplicated") if j % 2 else
+              ReplicaSchedulingStrategy(
+                  replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                  replica_division_preference=REPLICA_DIVISION_WEIGHTED))
+        pls.append(Placement(
+            cluster_affinity=ClusterAffinity(cluster_names=picked),
+            replica_scheduling=rs))
+    return rng, clusters, _bindings(rng, n_bindings, pls)
+
+
+def _settle(solver, clusters, bindings):
+    """adopt + write-back + drain the self-churn (written-back rows
+    re-solve once, reproduce, and go quiet)."""
+    rep = solver.adopt(clusters, bindings)
+    assert rep.mode == "full" and rep.reason == "adopt"
+    assert solver.write_back() > 0
+    rep = solver.cycle(clusters, bindings, CycleDeltas(),
+                       force_audit=True)
+    assert rep.mode == "incremental" and rep.audit_outcome == "ok"
+    assert solver.write_back() == 0  # identical answers: no rv bumps
+    return rep
+
+
+# -- the churn property ------------------------------------------------------
+
+
+@pytest.mark.parametrize("churn_frac", [0.0001, 0.001, 0.05])
+def test_churn_stream_bit_exact_every_cycle(churn_frac):
+    rng, clusters, names, pls, bindings = _world(
+        n_clusters=48, n_bindings=256, seed=17, pods=64)
+    state = ResidentState(audit_interval=0)
+    solver = IncrementalSolver(state, GeneralEstimator(), chunk=64,
+                               audit_every=0)
+    _settle(solver, clusters, bindings)
+
+    n_rows = max(1, int(len(bindings) * churn_frac))
+    for cyc in range(5):
+        deltas = _churn(rng, clusters, bindings, n_rows,
+                        n_caps=(1 if cyc % 2 else 0))
+        rep = solver.cycle(clusters, bindings, deltas, force_audit=True)
+        assert rep.mode == "incremental"
+        assert rep.audit_outcome == "ok", (churn_frac, cyc, rep)
+        assert rep.dirty >= n_rows
+        # dirty-ONLY: the compact sub-batch never balloons to the roster
+        if churn_frac < 0.01:
+            assert rep.dirty < len(bindings) // 2, rep
+        assert sum(rep.groups) == rep.dirty
+        solver.write_back()
+
+
+def test_quiet_cycle_empty_dirty_set():
+    """Steady state: no churn => the kernel classifies every row clean
+    and the cycle dispatches zero groups.  Static-only fixture: dynamic
+    rows that stay divergent (assigned != replicas) are ALWAYS sensitive
+    by design — that case is covered by the fixed-point test below."""
+    rng, clusters, bindings = _static_world(seed=23)
+    state = ResidentState(audit_interval=0)
+    solver = IncrementalSolver(state, GeneralEstimator(), chunk=64,
+                               audit_every=0)
+    _settle(solver, clusters, bindings)
+    d0 = dirty_mod.DIRTY_ROWS.value()
+    rep = solver.cycle(clusters, bindings, CycleDeltas(),
+                       force_audit=True)
+    assert rep.dirty == 0 and rep.groups == []
+    assert rep.audit_outcome == "ok"
+    assert dirty_mod.DIRTY_ROWS.value() == d0
+    assert dirty_mod.DIRTY_FRACTION.value() == 0.0
+
+
+def test_quiet_cycles_reach_fixed_point():
+    """Mixed fixture (includes dynamic-weight rows): the persistent
+    dirty set — divergent/unplaceable rows that must retry each cycle —
+    stabilizes at a small fixed point across quiet cycles."""
+    _rng, clusters, _names, _pls, bindings = _world(
+        n_clusters=32, n_bindings=128, seed=23)
+    state = ResidentState(audit_interval=0)
+    solver = IncrementalSolver(state, GeneralEstimator(), chunk=64,
+                               audit_every=0)
+    _settle(solver, clusters, bindings)
+    reps = [solver.cycle(clusters, bindings, CycleDeltas(),
+                         force_audit=True) for _ in range(3)]
+    assert all(r.audit_outcome == "ok" for r in reps)
+    counts = {r.dirty for r in reps}
+    assert len(counts) == 1, reps  # fixed point
+    assert reps[0].dirty < len(bindings) // 4, reps[0]
+
+
+def test_vocabulary_growth_roster_append():
+    """Appended bindings with a NEW placement (placement-vocabulary
+    growth) force-dirty only themselves; parity holds."""
+    rng, clusters, names, pls, bindings = _world(
+        n_clusters=48, n_bindings=192, seed=29)
+    state = ResidentState(audit_interval=0)
+    solver = IncrementalSolver(state, GeneralEstimator(), chunk=64,
+                               audit_every=0)
+    _settle(solver, clusters, bindings)
+    grown = bindings + _bindings(
+        rng, 24, _placements(rng, names, n=2, lo=3, hi=8),
+        seed_tag="grown-")
+    rep = solver.cycle(clusters, grown, CycleDeltas(), force_audit=True)
+    assert rep.mode == "incremental"  # append is NOT a full solve
+    assert rep.audit_outcome == "ok"
+    assert rep.dirty >= 24
+    assert rep.dirty < len(grown) // 2
+    solver.write_back()
+    rep = solver.cycle(clusters, grown, CycleDeltas(), force_audit=True)
+    assert rep.audit_outcome == "ok"
+
+
+def test_cluster_removal_forces_full_solve_and_recovers():
+    rng, clusters, names, pls, bindings = _world(
+        n_clusters=48, n_bindings=192, seed=31)
+    state = ResidentState(audit_interval=0)
+    solver = IncrementalSolver(state, GeneralEstimator(), chunk=64,
+                               audit_every=0)
+    _settle(solver, clusters, bindings)
+    solver.write_back()
+    f0 = INC_CYCLES.value(mode="full")
+    shrunk = clusters[:-4]  # membership change: structural rebuild
+    rep = solver.cycle(shrunk, bindings, CycleDeltas())
+    assert rep.mode == "full" and rep.reason == "plane-rebuild"
+    assert INC_CYCLES.value(mode="full") == f0 + 1
+    solver.write_back()
+    # ...and the plane settles back into incremental operation
+    rep = solver.cycle(shrunk, bindings, CycleDeltas(), force_audit=True)
+    assert rep.mode == "incremental" and rep.audit_outcome == "ok"
+    # the persistent dirty set (rows the shrink left divergent) reaches
+    # its fixed point; parity keeps holding
+    r1 = solver.cycle(shrunk, bindings, CycleDeltas(), force_audit=True)
+    r2 = solver.cycle(shrunk, bindings, CycleDeltas(), force_audit=True)
+    assert r1.audit_outcome == "ok" and r2.audit_outcome == "ok"
+    assert r1.dirty == r2.dirty < len(bindings)
+
+
+def test_forced_audit_mismatch_recovery():
+    """Corrupted incremental results are caught by the audit, recovered
+    from the control, and announced on the lifecycle ledger."""
+    from karmada_tpu.obs import events as ev
+
+    _rng, clusters, _names, _pls, bindings = _world(
+        n_clusters=32, n_bindings=128, seed=37)
+    state = ResidentState(audit_interval=0)
+    solver = IncrementalSolver(state, GeneralEstimator(), chunk=64,
+                               audit_every=0)
+    _settle(solver, clusters, bindings)
+    pos = next(p for p, r in solver.results.items()
+               if not isinstance(r, Exception))
+    good = solver.results[pos]
+    solver.results[pos] = []  # diverged state (placements dropped)
+    m0 = INC_AUDITS.value(outcome="mismatch")
+    rep = solver.cycle(clusters, bindings, CycleDeltas(),
+                       force_audit=True)
+    assert rep.audit_outcome == "mismatch"
+    assert INC_AUDITS.value(outcome="mismatch") == m0 + 1
+    # recovery adopted the control's answer
+    assert ({t.name: t.replicas for t in solver.results[pos]}
+            == {t.name: t.replicas for t in good})
+    recent = ev.state_payload(n=16)["recent"]
+    assert any(e.get("reason") == ev.REASON_INCREMENTAL_AUDIT_MISMATCH
+               for e in recent), recent
+    rep = solver.cycle(clusters, bindings, CycleDeltas(),
+                       force_audit=True)
+    assert rep.audit_outcome == "ok"
+
+
+# -- ledger mechanics ---------------------------------------------------------
+
+
+def test_carry_state_seed_changes_pricing():
+    """run_pipeline(carry_state=...) must actually flow into the solve:
+    seeding a previous run's consumption (scaled up) moves placements on
+    a tight fleet, and the seed object itself is never mutated."""
+    rng = random.Random(41)
+    clusters = _fleet(24, seed=41, pods=24)
+    cindex = tensors.ClusterIndex.build(clusters)
+    names = [c.metadata.name for c in clusters]
+    items = bench.build_bindings(rng, 96, _placements(
+        rng, names, n=4, lo=4, hi=8))
+    est = GeneralEstimator()
+    base = pipeline.run_pipeline(items, cindex, est, chunk=32, waves=1,
+                                 carry=True, collect_carry=True)
+    assert base.carry is not None and not base.carry.empty()
+    seed = base.carry.copy()
+    for arr in seed.milli.values():
+        arr *= 40
+    if seed.pods is not None:
+        seed.pods *= 40
+    before = {k: v.copy() for k, v in seed.milli.items()}
+    seeded = pipeline.run_pipeline(items, cindex, est, chunk=32, waves=1,
+                                   carry=True, carry_state=seed,
+                                   collect_carry=True)
+    for k, v in before.items():
+        assert np.array_equal(seed.milli[k], v), "seed object mutated"
+    moved = sum(
+        1 for i, want in base.results.items()
+        if not isinstance(want, Exception)
+        and ({t.name: t.replicas for t in want}
+             != ({t.name: t.replicas for t in seeded.results[i]}
+                 if not isinstance(seeded.results[i], Exception)
+                 else None)))
+    assert moved > 0, "a 40x consumption seed moved no placement"
+
+
+def test_capacity_churn_retires_ledger_lanes():
+    """A cluster status write retires the carried consumption on its
+    lane (reported availability now embeds it) — and parity still holds
+    through the retire.  Static ample-capacity fixture: with a quiet
+    dirty set the cycle adds no consumption of its own, so the full
+    retire leaves the ledger exactly empty."""
+    rng, clusters, bindings = _static_world(seed=43)
+    state = ResidentState(audit_interval=0)
+    solver = IncrementalSolver(state, GeneralEstimator(), chunk=64,
+                               audit_every=0)
+    _settle(solver, clusters, bindings)
+    assert not solver.ledger.empty()
+    # every cluster reports fresh capacity: the whole ledger retires
+    for c in clusters:
+        q = c.status.resource_summary.allocatable["pods"]
+        c.status.resource_summary.allocatable["pods"] = (
+            type(q).from_units(int(q.value())))
+        c.metadata.resource_version += 1
+    rep = solver.cycle(clusters, bindings, CycleDeltas(),
+                       force_audit=True)
+    assert rep.audit_outcome == "ok"
+    for arr in solver.ledger.milli.values():
+        assert not arr.any()
+
+
+# -- fused slot store x shortlist (PR-15 arming gap) -------------------------
+
+
+def _fused_shortlist_world(seed=47):
+    rng, clusters, names, pls, bindings = _world(
+        n_clusters=64, n_bindings=192, seed=seed)
+    state = ResidentState(audit_interval=0, fused=True)
+    cfg = sl.ShortlistConfig(k=16, min_cells=0, union_frac=1.0)
+    solver = IncrementalSolver(state, GeneralEstimator(), chunk=64,
+                               audit_every=0, shortlist=cfg)
+    return rng, clusters, bindings, state, solver
+
+
+def test_fused_shortlist_armed_and_bit_exact():
+    rng, clusters, bindings, state, solver = _fused_shortlist_world()
+    disp0 = sl.SHORTLIST_DISPATCHES.value()
+    fb0 = sl.SHORTLIST_FALLBACKS.total()
+    rep = solver.adopt(clusters, bindings)
+    assert rep.mode == "full"
+    # the fused gather really ran, and the shortlist really dispatched
+    assert state.fused_cycles > 0
+    assert sl.SHORTLIST_DISPATCHES.value() > disp0
+    assert sl.SHORTLIST_FALLBACKS.total() == fb0, "silent fallback"
+    # independent control: fresh host encode, dense solve, same seed
+    items = [(rb.spec, rb.status) for rb in bindings]
+    dense = pipeline.run_pipeline(
+        items, tensors.ClusterIndex.build(clusters), GeneralEstimator(),
+        chunk=64, waves=1, carry=True)
+    assert dense.results.keys() == solver.results.keys()
+    for i, want in dense.results.items():
+        got = solver.results[i]
+        if isinstance(want, Exception):
+            assert isinstance(got, type(want)), (i, want, got)
+        else:
+            assert ({t.name: t.replicas for t in got}
+                    == {t.name: t.replicas for t in want}), i
+    # steady churned cycles stay fused + shortlisted + bit-exact
+    solver.write_back()
+    solver.cycle(clusters, bindings, CycleDeltas())
+    for _ in range(3):
+        deltas = _churn(rng, clusters, bindings, 4, n_caps=1)
+        rep = solver.cycle(clusters, bindings, deltas, force_audit=True)
+        assert rep.audit_outcome == "ok"
+        solver.write_back()
+    assert sl.SHORTLIST_FALLBACKS.total() == fb0, "silent fallback"
+
+
+def test_fused_shortlist_mesh_2dev_parity():
+    import jax
+
+    rng, clusters, bindings, state, solver = _fused_shortlist_world(
+        seed=53)
+    items = [(rb.spec, rb.status) for rb in bindings]
+    dense = pipeline.run_pipeline(
+        items, tensors.ClusterIndex.build(clusters), GeneralEstimator(),
+        chunk=64, waves=1, carry=True)
+    plan = meshing.activate((1, 2), devices=jax.devices()[:2])
+    assert plan is not None
+    try:
+        _settle(solver, clusters, bindings)
+        rep = solver.cycle(clusters, bindings, CycleDeltas(),
+                           force_audit=True)
+        assert rep.mode == "incremental" and rep.audit_outcome == "ok"
+    finally:
+        meshing.deactivate()
+    assert dense.results.keys() == solver.results.keys()
+    for i, want in dense.results.items():
+        got = solver.results[i]
+        if isinstance(want, Exception):
+            assert isinstance(got, type(want)), (i, want, got)
+        else:
+            assert ({t.name: t.replicas for t in got}
+                    == {t.name: t.replicas for t in want}), i
+
+
+# -- report / plumbing --------------------------------------------------------
+
+
+def test_report_shape_and_waves_guard():
+    state = ResidentState(audit_interval=0)
+    with pytest.raises(AssertionError):
+        IncrementalSolver(state, GeneralEstimator(), waves=2)
+    rep = CycleReport()
+    assert rep.mode == "incremental" and rep.groups == []

@@ -222,26 +222,114 @@ def test_widen_and_retry_then_exact():
 
 
 def test_uncovered_fallback_is_loud_and_correct():
+    """With truncation off, super-k_max rows still drag the chunk dense
+    — loudly, with the offending BINDING KEYS named in the event."""
     from karmada_tpu.obs import events as ev
 
     rng = random.Random(43)
     clusters, cindex = _fleet(64, seed=43)
     names = [c.metadata.name for c in clusters]
-    pls = _affinity_placements(rng, names, n=4, lo=20, hi=24)
+    # 3 coverable placements + 1 whale: the whale rows NEED the
+    # fallback, the coverable rows are merely dragged along
+    pls = _affinity_placements(rng, names, n=3, lo=4, hi=6)
+    pls += _affinity_placements(rng, names, n=1, lo=20, hi=24)
     items = _items(rng, 24, pls)
     est = GeneralEstimator()
-    cfg = sl.ShortlistConfig(k=4, k_max=8, min_cells=0, union_frac=1.0)
+    cfg = sl.ShortlistConfig(k=4, k_max=8, min_cells=0, union_frac=1.0,
+                             truncate=False)
     batch = tensors.encode_batch(items, cindex, est)
+    need0 = sl.SHORTLIST_FALLBACK_ROWS.value(kind="needed")
+    drag0 = sl.SHORTLIST_FALLBACK_ROWS.value(kind="chunk_drag")
     (sub, info), delta = _fallback_delta(
-        lambda: sl.shrink_chunk(batch, cfg), "uncovered")
+        lambda: sl.shrink_chunk(batch, cfg, part=items), "uncovered")
     assert sub is None and info["fallback"] == "uncovered"
     assert delta == 1
+    # row-granular accounting: the offenders NEEDED the fallback, every
+    # other valid row was merely dragged along by the chunk
+    needed = sl.SHORTLIST_FALLBACK_ROWS.value(kind="needed") - need0
+    dragged = sl.SHORTLIST_FALLBACK_ROWS.value(kind="chunk_drag") - drag0
+    assert needed >= 1 and dragged >= 1
+    assert needed + dragged == 24
     recent = ev.state_payload(n=16)["recent"]
-    assert any(e.get("reason") == ev.REASON_SHORTLIST_FALLBACK
-               for e in recent), recent
+    fallback_msgs = [e.get("message", "") for e in recent
+                     if e.get("reason") == ev.REASON_SHORTLIST_FALLBACK]
+    assert fallback_msgs, recent
+    # the widen-exhaustion message names the offending binding keys
+    from karmada_tpu.obs import decisions as obs_decisions
+
+    keys = {obs_decisions.default_key(spec) for spec, _st in items}
+    assert any(any(k in m for k in keys) for m in fallback_msgs), \
+        fallback_msgs
     # the pipeline still schedules correctly (dense fallback per chunk)
     dense = _run(items, cindex, est, None)
     shortlisted = _run(items, cindex, est, cfg)
+    _assert_parity(dense, shortlisted)
+
+
+def test_truncation_with_recall_bit_exact():
+    """Truncation-with-recall (seeded): rows whose eligible set outgrows
+    k_max leave the chunk as residual and re-solve per-binding at full
+    width — one huge row no longer drags 24 rows dense, and placements
+    stay bit-exact against the dense control (waves=1)."""
+    from karmada_tpu.obs import events as ev
+
+    rng = random.Random(43)
+    clusters, cindex = _fleet(64, seed=43)
+    names = [c.metadata.name for c in clusters]
+    # 3 coverable rows + 1 seeded whale spanning most of the fleet
+    pls = _affinity_placements(rng, names, n=3, lo=4, hi=6)
+    pls += _affinity_placements(rng, names, n=1, lo=40, hi=48)
+    items = _items(rng, 24, pls)
+    est = GeneralEstimator()
+    cfg = sl.ShortlistConfig(k=8, k_max=16, min_cells=0, union_frac=1.0)
+    batch = tensors.encode_batch(items, cindex, est)
+    need0 = sl.SHORTLIST_FALLBACK_ROWS.value(kind="needed")
+    drag0 = sl.SHORTLIST_FALLBACK_ROWS.value(kind="chunk_drag")
+    fb0 = sl.SHORTLIST_FALLBACKS.total()
+    sub, info = sl.shrink_chunk(batch, cfg, part=items)
+    assert sub is not None, info
+    residual = info["residual"]
+    assert residual, "seeded whale row did not go residual"
+    # the whale rows are placements index 3 mod 4
+    assert all(i % 4 == 3 for i in residual), residual
+    assert sl.SHORTLIST_FALLBACKS.total() == fb0, "no chunk fallback"
+    assert (sl.SHORTLIST_FALLBACK_ROWS.value(kind="needed") - need0
+            == len(residual))
+    assert sl.SHORTLIST_FALLBACK_ROWS.value(kind="chunk_drag") == drag0
+    # residual rows' b_valid cleared in the sub-batch; others kept
+    assert not any(bool(sub.b_valid[i]) for i in residual)
+    # the truncation event names the offending binding keys
+    recent = ev.state_payload(n=16)["recent"]
+    trunc = [e for e in recent
+             if e.get("reason") == ev.REASON_SHORTLIST_TRUNCATE]
+    assert trunc, recent
+    from karmada_tpu.obs import decisions as obs_decisions
+
+    keys = {obs_decisions.default_key(items[i][0]) for i in residual}
+    assert any(k in trunc[-1].get("message", "") for k in keys), trunc
+    # end to end: bit-exact vs dense, through the pipeline's per-binding
+    # residual finalize (exact only at waves=1)
+    dense = _run(items, cindex, est, None, waves=1)
+    shortlisted = _run(items, cindex, est, cfg, waves=1)
+    _assert_parity(dense, shortlisted)
+
+
+def test_truncation_disabled_at_waves_gt1():
+    """waves>1 chunks may not truncate (rows see same-chunk consumption
+    there): the pipeline passes allow_truncate=False and the chunk falls
+    back dense instead — still correct."""
+    rng = random.Random(43)
+    clusters, cindex = _fleet(64, seed=43)
+    names = [c.metadata.name for c in clusters]
+    pls = _affinity_placements(rng, names, n=3, lo=4, hi=6)
+    pls += _affinity_placements(rng, names, n=1, lo=40, hi=48)
+    items = _items(rng, 24, pls)
+    est = GeneralEstimator()
+    cfg = sl.ShortlistConfig(k=8, k_max=16, min_cells=0, union_frac=1.0)
+    (dense, shortlisted), delta = _fallback_delta(
+        lambda: (_run(items, cindex, est, None, waves=4),
+                 _run(items, cindex, est, cfg, waves=4)), "uncovered")
+    assert delta >= 1  # the whale forced the dense fallback, loudly
     _assert_parity(dense, shortlisted)
 
 
@@ -392,10 +480,11 @@ def test_scheduler_and_controlplane_plumbing():
     # host backends never arm the tier (they build no SolverBatches)
     assert Scheduler(ObjectStore(), Runtime(), backend="serial",
                      shortlist_k=32).shortlist_k is None
-    # the fused slot store owns its binding rows: combination disarms
+    # the fused slot store composes: shrink reads the host masters via
+    # the batch's fused_src handle and sub-gathers on device
     assert Scheduler(ObjectStore(), Runtime(), backend="device",
                      resident=True, resident_fused=True,
-                     shortlist_k=32).shortlist_k is None
+                     shortlist_k=32).shortlist_k == 32
     cp = ControlPlane(backend="device", shortlist_k=16)
     assert cp.scheduler.shortlist_k == 16
 

@@ -104,6 +104,27 @@ def test_vet_covers_resident_plane():
         sorted(fields - keys - host_only - extra)
 
 
+def test_vet_covers_incremental_plane():
+    """The gate extends over the dirty-set incremental-solve modules:
+    the walk must reach ops/dirty.py (the classification kernel) and
+    scheduler/incremental.py (the solver), so their metric names stay
+    inside the metric-docs pass, the kernel inside trace-safety, and
+    both inside every other vet rule.  A rename or move would silently
+    drop the subsystem out of the gate; this pins it in."""
+    from karmada_tpu.analysis import trace_safety
+    from karmada_tpu.analysis.core import collect_files
+
+    files = collect_files([PKG])
+    by_tail = {os.path.join(*sf.path.split(os.sep)[-2:]): sf
+               for sf in files}
+    assert os.path.join("ops", "dirty.py") in by_tail
+    assert os.path.join("scheduler", "incremental.py") in by_tail
+    # the jitted classification kernel is discovered as a trace root
+    mod = trace_safety._Module(  # noqa: SLF001
+        by_tail[os.path.join("ops", "dirty.py")])
+    assert "_dirty_core" in mod.roots()
+
+
 def test_vet_covers_facade_plane():
     """The gate extends over karmada_tpu/facade/: the analyzer walk must
     reach every module of the subsystem, so its metric names stay inside

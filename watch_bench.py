@@ -179,6 +179,21 @@ def main() -> int:
                           "coalesce_ratio": fc.get("coalesce_ratio"),
                           "speedup_x": fc.get("speedup_x"),
                           "whatif_isolated": fc.get("whatif_isolated")})
+                if "incremental" in detail:
+                    # dirty-set steady-state summary as a structured line
+                    # (bench --incremental payloads; the full record is
+                    # in detail / the persisted MEGAFLEET_r02.json)
+                    inc = detail["incremental"]
+                    jlog({"event": "incremental",
+                          "ts": round(time.time(), 3),
+                          "adopt_s": inc.get("adopt_s"),
+                          "steady_p50_s": inc.get("steady_p50_s"),
+                          "steady_p99_s": inc.get("steady_p99_s"),
+                          "dirty_rows_mean": inc.get("dirty_rows_mean"),
+                          "speedup_x": inc.get("speedup_x"),
+                          "audit_outcome": inc.get("audit_outcome"),
+                          "fallbacks": inc.get("fallbacks"),
+                          "chunk_drag_rows": inc.get("chunk_drag_rows")})
                 led = ((detail.get("soak") or {}).get("events")
                        or (detail.get("chaos") or {}).get("events")
                        or (detail.get("rebalance") or {}).get("events"))
