@@ -903,6 +903,62 @@ def measure_ledger_overhead(reference_cycle_s, iters: int = 20000) -> dict:
     }
 
 
+def measure_lock_overhead(reference_cycle_s, iters: int = 20000) -> dict:
+    """The runtime race detector's honest price — the concurrency-vet
+    acceptance gate: a DISARMED VetLock enter/exit (one arming-flag list
+    read plus delegation to the wrapped stdlib lock) and the ARMED
+    bookkeeping path (thread-local stack + ownership + hold-time
+    histogram), each per-op and against a mean scheduling cycle.  The
+    disarmed path must also register ZERO new metric families (all three
+    karmada_lock_* families register at import) and zero jit compiles —
+    both asserted here, explain-plane style."""
+    from karmada_tpu.analysis import guards
+    from karmada_tpu.ops import solver
+    from karmada_tpu.utils import locks as locks_mod
+    from karmada_tpu.utils.metrics import REGISTRY
+
+    c_before = solver._jit_cache_size()  # noqa: SLF001
+    fam_before = len(REGISTRY.snapshot())
+    lock = locks_mod.VetLock("bench.lock-overhead")
+    was_armed = guards.armed()
+    guards.arm(False)
+    try:
+        with lock:
+            pass  # warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            with lock:
+                pass
+        disarmed_s = (time.perf_counter() - t0) / iters
+        guards.arm(True)
+        with lock:
+            pass  # warm the armed path (thread-local stack init)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            with lock:
+                pass
+        armed_s = (time.perf_counter() - t0) / iters
+    finally:
+        guards.arm(was_armed)
+    fam_after = len(REGISTRY.snapshot())
+    assert fam_after == fam_before, (
+        f"VetLock traffic registered {fam_after - fam_before} new metric "
+        "families; the karmada_lock_* families must register at import")
+    c_after = solver._jit_cache_size()  # noqa: SLF001
+    new_compiles = (None if c_before is None or c_after is None
+                    else c_after - c_before)
+    pct = lambda s: (round(s / reference_cycle_s * 100, 5)
+                     if reference_cycle_s and reference_cycle_s > 0 else None)
+    return {
+        "lock_disarmed_per_op_us": round(disarmed_s * 1e6, 4),
+        "lock_disarmed_overhead_pct": pct(disarmed_s),
+        "lock_armed_per_op_us": round(armed_s * 1e6, 4),
+        "lock_armed_overhead_pct": pct(armed_s),
+        "lock_new_metric_families": fam_after - fam_before,
+        "lock_new_compiles": new_compiles,
+    }
+
+
 def build_rebalance_items(rng: random.Random, items, names):
     """BASELINE config 5's second half: bindings that WERE scheduled now
     need re-assignment (descheduler marks clusters lossy / triggers
@@ -1786,6 +1842,7 @@ def run_soak(args) -> int:
         disarm_telemetry()
     telemetry.update(measure_disarmed_overhead(ref_cycle_s))
     telemetry.update(measure_ledger_overhead(ref_cycle_s))
+    telemetry.update(measure_lock_overhead(ref_cycle_s))
     payload["backend"] = args.soak_backend
     payload["telemetry"] = telemetry
     if args.slo:
@@ -1824,6 +1881,18 @@ def run_soak(args) -> int:
             f"{telemetry['ledger_disarmed_overhead_pct']}% of a cycle")
         assert telemetry["ledger_new_compiles"] in (0, None), (
             "the lifecycle ledger triggered jit compilation")
+        # the concurrency-vet acceptance leg: a disarmed VetLock
+        # enter/exit must be free (< 1% of a mean cycle), register no
+        # new metric families, and never touch the jit cache
+        assert telemetry["lock_disarmed_overhead_pct"] is not None and \
+            telemetry["lock_disarmed_overhead_pct"] < 1.0, (
+            f"disarmed VetLock enter/exit costs "
+            f"{telemetry['lock_disarmed_overhead_pct']}% of a cycle — "
+            "the disarmed serve path must be free (< 1%)")
+        assert telemetry["lock_new_metric_families"] == 0, (
+            "VetLock traffic registered new metric families")
+        assert telemetry["lock_new_compiles"] in (0, None), (
+            "the lock detector triggered jit compilation")
         ledger_stats = payload.get("events") or {}
         assert ledger_stats.get("recorded", 0) > 0, (
             "the soak recorded zero lifecycle events — the ledger was "

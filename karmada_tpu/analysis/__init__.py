@@ -1,26 +1,40 @@
 """Static-analysis subsystem behind `karmadactl vet` (+ armed runtime guards).
 
-Four AST-level passes over the package, each targeting a defect class that
-unit tests on one CPU device cannot see but real multichip topologies and
-threaded serve processes can (the PR-3 s64/s32 wave-scan bug is the type
-specimen):
+Nine AST-level pass families over the package, each targeting a defect
+class that unit tests on one CPU device cannot see but real multichip
+topologies and threaded serve processes can (the PR-3 s64/s32 wave-scan
+bug is the type specimen):
 
-  * trace-safety    — Python control flow on traced values, host syncs, and
-                      dtype-defaulted constructors inside jit-compiled code
-                      (karmada_tpu/analysis/trace_safety.py)
-  * dtype-contract  — SolverBatch/carry construction sites checked against
-                      the canonical per-field dtype table
-                      (ops/tensors.FIELD_DTYPES; dtype_contract.py)
-  * spec-coverage   — every SolverBatch tensor field has a PartitionSpec
-                      entry in ops/meshing.shard_specs (spec_coverage.py)
-  * guarded-by      — `# guarded-by: <lock>` annotated attributes are only
-                      mutated inside the matching `with <lock>:` block
-                      (lock_discipline.py)
+  * trace-safety       — Python control flow on traced values, host syncs,
+                         and dtype-defaulted constructors inside
+                         jit-compiled code (trace_safety.py)
+  * dtype-contract     — SolverBatch/carry/native-ABI construction sites
+                         checked against the canonical per-field dtype
+                         tables (ops/tensors; dtype_contract.py)
+  * spec-coverage      — every SolverBatch/ResidentPlane tensor field has
+                         a PartitionSpec entry in ops/meshing.shard_specs
+                         or is declared host-only (spec_coverage.py)
+  * guarded-by         — `# guarded-by: <lock>` annotated attributes are
+                         only mutated inside the matching `with <lock>:`
+                         block (lock_discipline.py)
+  * metric-naming      — registered metrics are karmada_-prefixed
+                         snake_case with help text (metric_naming.py)
+  * metric-docs        — every registered metric is catalogued in
+                         OBSERVABILITY.md, and vice versa (metric_docs.py)
+  * event-reasons      — lifecycle-ledger emissions pass declared REASON_*
+                         constants, catalogued in the doc (event_reasons.py)
+  * exception-hygiene  — blanket handlers re-raise, record a metric, or
+                         carry a justified waiver (exception_hygiene.py)
+  * lock-order         — inter-procedural lock-acquisition graph: cycles
+                         (`lock-order`) and blocking calls under a held
+                         lock (`lock-blocking-call`) (lock_order.py)
 
 `vet.run_vet` orchestrates the passes; `guards` is the armed RUNTIME mode
 (`serve --check-invariants` / KARMADA_CHECK_INVARIANTS=1): shape/dtype/NaN
-invariant checks at solver entry and d2h boundaries.  All passes are pure
-AST work — no jax import, safe in any environment.
+invariant checks at solver entry and d2h boundaries, plus the
+`utils/locks.VetLock` race detector (ownership, order inversions, hold
+times, deadlock watchdog) sharing the same arming flag.  All passes are
+pure AST work — no jax import, safe in any environment.
 """
 
 from karmada_tpu.analysis.core import Finding, Waiver  # noqa: F401

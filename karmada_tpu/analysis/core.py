@@ -36,6 +36,10 @@ RULES = (
                          # docs/OBSERVABILITY.md catalog
     "exception-hygiene",  # blanket except that neither re-raises nor
                           # records a metric (nor carries a waiver)
+    "lock-order",        # cycle (or non-reentrant re-acquire) in the
+                         # inter-procedural lock-acquisition graph
+    "lock-blocking-call",  # sleep/socket/join/device-sync/estimator RPC
+                           # executed while a lock is held
     "waiver-syntax",     # vet: ignore[...] without a justification
 )
 

@@ -1,0 +1,561 @@
+"""Pass 9 — inter-procedural lock-order analysis (deadlocks + blocking).
+
+Python has no ``go test -race``; this pass is the static half of the
+substitute.  It reuses trace_safety's cross-module call-closure machinery
+to build the lock-ACQUISITION graph over every ``with <lock>:`` region in
+the tree:
+
+  * lock-order         — a cycle in the acquired-while-held graph: thread
+                         1 takes A then B, thread 2 takes B then A, and
+                         the serve plane wedges.  Re-acquiring the SAME
+                         non-reentrant ``threading.Lock`` (directly or
+                         through a called function) is the length-1 cycle
+                         and reported the same way; RLocks are exempt.
+  * lock-blocking-call — a blocking operation (``time.sleep``, socket
+                         accept/connect/recv, ``thread.join()``,
+                         ``.block_until_ready()``, the estimator RPC)
+                         executed while a lock is held, directly or
+                         transitively through the call closure: every
+                         other thread needing that lock stalls for the
+                         full wait.
+
+Locks are identified by their CREATION site — ``threading.Lock()`` /
+``RLock()`` / ``Condition(...)`` (any module alias), plus the runtime
+detector's ``VetLock(...)`` / ``make_lock(...)`` / ``make_rlock(...)``
+wrappers — as ``self.<attr>`` instance state or a module-global name.  A
+``Condition(self._lock)`` shares its wrapped lock's identity (acquiring
+the condition IS acquiring the lock).  ``with`` targets that do not
+resolve to a known creation site (parameters, computed locks) are skipped
+— the analysis is compositional, RacerD-style, no whole-program aliasing.
+
+Call closure: bare-name calls resolve to module-level defs and
+``from ... import`` names (via trace_safety._resolve_module);
+``self.m()`` resolves to methods of the same class.  Nested ``def`` /
+``lambda`` bodies are deferred work — a ``with`` around a ``def`` does
+not guard (or order) the eventual call, so they are analyzed as if the
+surrounding stack were empty and their acquires are NOT charged to the
+enclosing function.
+
+Findings anchor at the acquiring/blocking line (direct) or the call site
+that reaches it (transitive), so the standing `# vet: ignore[rule] why`
+waiver grammar applies per-edge.  ``Condition.wait`` is deliberately NOT
+a blocking call: it releases the lock while waiting — that is the one
+correct way to block under a lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from karmada_tpu.analysis.core import Finding, SourceFile, dotted
+from karmada_tpu.analysis.trace_safety import _resolve_module
+
+#: constructor name (last dotted component) -> lock kind
+_LOCK_CTORS = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "VetLock": "lock",       # utils/locks runtime detector proxy
+    "make_lock": "lock",
+    "make_rlock": "rlock",
+}
+
+#: attribute names that block regardless of receiver (``x.sleep(...)``).
+#: `wait` is NOT here: Condition.wait releases the lock while waiting.
+_BLOCKING_ATTRS = frozenset({
+    "sleep", "_sleep",            # time.sleep + injectable clock sleeps
+    "block_until_ready",          # device sync
+    "accept", "connect", "recv", "recv_into", "sendall", "makefile",
+    "getresponse", "communicate",  # socket / HTTP / subprocess waits
+    "urlopen",                    # urllib.request.urlopen
+    "assign_replicas",            # the estimator RPC (facade/estimator)
+})
+
+#: fully-dotted callables that block (bare-name or module-attr form)
+_BLOCKING_DOTTED = frozenset({
+    "time.sleep", "select.select", "socket.create_connection",
+    "urllib.request.urlopen", "sleep",
+})
+
+
+@dataclass
+class _LockDef:
+    """One lock creation site.  `lock_id` is the graph node identity."""
+
+    lock_id: str       # "<path>::<Class.attr|NAME>" after alias resolution
+    kind: str          # "lock" | "rlock" | "condition"
+    file: str
+    line: int
+    display: str       # short human name for messages
+
+
+@dataclass
+class _FnInfo:
+    """Per-function facts harvested in one lexical walk."""
+
+    # lock ids acquired anywhere in the body (direct `with` regions)
+    acquires: Set[str] = field(default_factory=set)
+    # (held_id, acquired_id, line): direct nesting observed lexically
+    held_edges: List[Tuple[str, str, int]] = field(default_factory=list)
+    # (line, description): blocking ops regardless of held state
+    blocking: List[Tuple[int, str]] = field(default_factory=list)
+    # (line, description, held ids): blocking ops under a held lock
+    held_blocking: List[Tuple[int, str, Tuple[str, ...]]] = \
+        field(default_factory=list)
+    # (callee key, line, held ids) for every resolved call
+    calls: List[Tuple[Tuple[str, str], int, Tuple[str, ...]]] = \
+        field(default_factory=list)
+    # nested def/closure bodies: analyzed as separate functions (their
+    # acquires are NOT charged to the enclosing function — deferred work)
+    nested: List[Tuple[str, "_FnInfo"]] = field(default_factory=list)
+
+
+def _short(path: str) -> str:
+    parts = path.split(os.sep)
+    return os.sep.join(parts[-2:]) if len(parts) > 1 else path
+
+
+def _ctor_kind(call: ast.AST) -> Optional[str]:
+    """Lock kind when `call` is a recognized lock-constructor Call."""
+    if not isinstance(call, ast.Call):
+        return None
+    d = dotted(call.func)
+    if d is None:
+        return None
+    return _LOCK_CTORS.get(d.rsplit(".", 1)[-1])
+
+
+class _Mod:
+    """One module's lock-definition table + call-resolution context."""
+
+    def __init__(self, sf: SourceFile) -> None:
+        self.sf = sf
+        # qualname ("f" or "Class.m") -> FunctionDef, and owning class
+        self.funcs: Dict[str, ast.FunctionDef] = {}
+        self.func_class: Dict[str, Optional[str]] = {}
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs[node.name] = node
+                self.func_class[node.name] = None
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        q = f"{node.name}.{item.name}"
+                        self.funcs[q] = item
+                        self.func_class[q] = node.name
+        # local name -> (source module, original name, relative level)
+        self.imports: Dict[str, Tuple[Optional[str], str, int]] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    self.imports[a.asname or a.name] = (
+                        node.module, a.name, node.level or 0)
+        # lock tables: module globals and per-class instance attrs.
+        # raw entries may alias (Condition(self._lock)); resolved after.
+        self._raw_mod: Dict[str, Tuple[str, int, Optional[str]]] = {}
+        self._raw_cls: Dict[str, Dict[str, Tuple[str, int,
+                                                 Optional[str]]]] = {}
+        self._harvest_locks()
+        self.module_locks: Dict[str, _LockDef] = {}
+        self.class_locks: Dict[str, Dict[str, _LockDef]] = {}
+        self._resolve_lock_defs()
+
+    def _harvest_locks(self) -> None:
+        for node in self.sf.tree.body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                self._harvest_assign(node, self._raw_mod, module=True)
+            elif isinstance(node, ast.ClassDef):
+                table = self._raw_cls.setdefault(node.name, {})
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                        self._harvest_assign(sub, table, module=False)
+
+    def _harvest_assign(self, node, table, module: bool) -> None:
+        value = node.value
+        kind = _ctor_kind(value)
+        if kind is None:
+            return
+        # Condition(self._lock) / Condition(_LOCK) aliases the wrapped
+        # lock; Condition() owns a private lock of its own
+        alias: Optional[str] = None
+        if kind == "condition" and value.args:
+            d = dotted(value.args[0])
+            if d is not None:
+                alias = d[5:] if d.startswith("self.") else d
+                if "." in alias:
+                    alias = None
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if module and isinstance(t, ast.Name):
+                table[t.id] = (kind, node.lineno, alias)
+            elif not module and isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                table[t.attr] = (kind, node.lineno, alias)
+
+    def _resolve_lock_defs(self) -> None:
+        path = self.sf.path
+
+        def build(table, scope: Optional[str]):
+            out: Dict[str, _LockDef] = {}
+            for attr, (kind, line, alias) in table.items():
+                # follow the Condition alias chain within the same scope
+                root, root_kind = attr, kind
+                seen = {attr}
+                while True:
+                    entry = table.get(root)
+                    nxt = entry[2] if entry else None
+                    if nxt is None or nxt not in table or nxt in seen:
+                        break
+                    seen.add(nxt)
+                    root = nxt
+                    root_kind = table[root][0]
+                label = f"{scope}.{root}" if scope else root
+                out[attr] = _LockDef(
+                    lock_id=f"{path}::{label}", kind=root_kind,
+                    file=path, line=line,
+                    display=f"{_short(path)}:{label}")
+            return out
+
+        self.module_locks = build(self._raw_mod, None)
+        for cls, table in self._raw_cls.items():
+            self.class_locks[cls] = build(table, cls)
+
+    def lock_for(self, expr: ast.AST,
+                 cls: Optional[str]) -> Optional[_LockDef]:
+        """The _LockDef a `with` target resolves to, or None (unknown
+        receivers — parameters, computed locks — are skipped)."""
+        d = dotted(expr)
+        if d is None:
+            return None
+        if d.startswith("self."):
+            attr = d[5:]
+            if "." in attr or cls is None:
+                return None
+            return self.class_locks.get(cls, {}).get(attr)
+        if "." in d:
+            return None
+        return self.module_locks.get(d)
+
+
+def _blocking_desc(node: ast.Call) -> Optional[str]:
+    """A short description when `node` is a recognized blocking call."""
+    d = dotted(node.func)
+    if d is not None and (d in _BLOCKING_DOTTED
+                          or d.rsplit(".", 1)[-1] in ("block_until_ready",)):
+        return f"`{d}()`"
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        if attr in _BLOCKING_ATTRS:
+            return f"`.{attr}()`"
+        # thread.join() / thread.join(timeout=...) — zero POSITIONAL
+        # args distinguishes it from str.join(iterable)
+        if attr == "join" and not node.args:
+            return "`.join()`"
+    elif isinstance(node.func, ast.Name) and node.func.id in ("sleep",):
+        return f"`{node.func.id}()`"
+    return None
+
+
+class _Walker:
+    """Lexical walk of one function body carrying the held-lock stack."""
+
+    def __init__(self, mod: _Mod, cls: Optional[str], info: _FnInfo) -> None:
+        self.mod = mod
+        self.cls = cls
+        self.info = info
+
+    def walk(self, fn: ast.FunctionDef) -> None:
+        for stmt in fn.body:
+            self._stmt(stmt, [])
+
+    def _held(self, stack: List[List[_LockDef]]) -> Tuple[str, ...]:
+        out: List[str] = []
+        for frame in stack:
+            for ld in frame:
+                if ld.lock_id not in out:
+                    out.append(ld.lock_id)
+        return tuple(out)
+
+    def _stmt(self, node: ast.stmt, stack: List[List[_LockDef]]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # deferred body: the surrounding with neither guards nor
+            # orders the eventual call, and the closure's own acquires
+            # belong to the eventual caller's context, not this one —
+            # analyze it as a separate (synthetic) function
+            sub = _FnInfo()
+            inner = _Walker(self.mod, self.cls, sub)
+            for stmt in node.body:
+                inner._stmt(stmt, [])
+            self.info.nested.append((node.name, sub))
+            return
+        if isinstance(node, ast.With):
+            frame: List[_LockDef] = []
+            held_before = self._held(stack)
+            for item in node.items:
+                self._expr(item.context_expr, stack)
+                ld = self.mod.lock_for(item.context_expr, self.cls)
+                if ld is None:
+                    continue
+                self.info.acquires.add(ld.lock_id)
+                for h in held_before + self._held([frame]):
+                    self.info.held_edges.append(
+                        (h, ld.lock_id, node.lineno))
+                frame.append(ld)
+            stack.append(frame)
+            for stmt in node.body:
+                self._stmt(stmt, stack)
+            stack.pop()
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._stmt(child, stack)
+            elif isinstance(child, ast.excepthandler):
+                for stmt in child.body:
+                    self._stmt(stmt, stack)
+            elif isinstance(child, ast.expr):
+                self._expr(child, stack)
+
+    def _expr(self, e: ast.AST, stack: List[List[_LockDef]]) -> None:
+        if isinstance(e, ast.Lambda):
+            return  # deferred body
+        if isinstance(e, ast.Call):
+            held = self._held(stack)
+            desc = _blocking_desc(e)
+            if desc is not None:
+                self.info.blocking.append((e.lineno, desc))
+                if held:
+                    self.info.held_blocking.append((e.lineno, desc, held))
+            callee = self._resolve_call(e)
+            if callee is not None:
+                self.info.calls.append((callee, e.lineno, held))
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self._expr(child, stack)
+            else:
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, ast.expr):
+                        self._expr(sub, stack)
+
+    def _resolve_call(self, e: ast.Call) -> Optional[Tuple[str, str]]:
+        mod = self.mod
+        f = e.func
+        if isinstance(f, ast.Name):
+            name = f.id
+            if name in mod.funcs and mod.func_class[name] is None:
+                return (mod.sf.path, name)
+            if name in mod.imports:
+                src_module, orig, level = mod.imports[name]
+                src_path = _resolve_module(
+                    mod.sf.path, src_module, level, _PATHS.get())
+                if src_path is not None:
+                    return (src_path, orig)
+            return None
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self" \
+                and self.cls is not None:
+            q = f"{self.cls}.{f.attr}"
+            if q in mod.funcs:
+                return (mod.sf.path, q)
+        return None
+
+
+class _Paths:
+    """The scanned-path set, visible to call resolution without threading
+    it through every walker (one pass run at a time)."""
+
+    def __init__(self) -> None:
+        self._paths: Dict[str, bool] = {}
+
+    def set(self, paths: Sequence[str]) -> None:
+        self._paths = {p: True for p in paths}
+
+    def get(self) -> Dict[str, bool]:
+        return self._paths
+
+
+_PATHS = _Paths()
+
+
+def _closure(infos: Dict[Tuple[str, str], _FnInfo]) -> Tuple[
+        Dict[Tuple[str, str], Set[str]],
+        Dict[Tuple[str, str], Set[Tuple[int, str, str]]]]:
+    """Fixpoint: transitive acquires and transitive blocking ops per
+    function.  Blocking entries carry their ORIGIN (file, line, desc) so
+    transitive findings can say where the wait actually happens."""
+    acq: Dict[Tuple[str, str], Set[str]] = {
+        k: set(v.acquires) for k, v in infos.items()}
+    blk: Dict[Tuple[str, str], Set[Tuple[int, str, str]]] = {
+        k: {(line, desc, k[0]) for line, desc in v.blocking}
+        for k, v in infos.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, info in infos.items():
+            for callee, _line, _held in info.calls:
+                if callee not in infos:
+                    continue
+                if not acq[callee] <= acq[key]:
+                    acq[key] |= acq[callee]
+                    changed = True
+                if not blk[callee] <= blk[key]:
+                    blk[key] |= blk[callee]
+                    changed = True
+    return acq, blk
+
+
+def _sccs(nodes: Sequence[str],
+          succ: Dict[str, Set[str]]) -> List[List[str]]:
+    """Tarjan SCCs, iterative (analysis code must not recurse on user
+    graph depth)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+    for root in nodes:
+        if root in index:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            v, pi = work[-1]
+            if pi == 0:
+                index[v] = low[v] = counter[0]
+                counter[0] += 1
+                stack.append(v)
+                on_stack.add(v)
+            advanced = False
+            children = sorted(succ.get(v, ()))
+            while pi < len(children):
+                w = children[pi]
+                pi += 1
+                work[-1] = (v, pi)
+                if w not in index:
+                    work.append((w, 0))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            if pi >= len(children):
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[v])
+                if low[v] == index[v]:
+                    comp: List[str] = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == v:
+                            break
+                    out.append(comp)
+    return out
+
+
+def run(files: Sequence[SourceFile]) -> List[Finding]:
+    mods = {sf.path: _Mod(sf) for sf in files}
+    _PATHS.set(list(mods))
+    # union of every module's lock tables, keyed by lock_id
+    lock_defs: Dict[str, _LockDef] = {}
+    for mod in mods.values():
+        for table in ([mod.module_locks] + list(mod.class_locks.values())):
+            for ld in table.values():
+                lock_defs.setdefault(ld.lock_id, ld)
+
+    infos: Dict[Tuple[str, str], _FnInfo] = {}
+
+    def register(path: str, qual: str, info: _FnInfo) -> None:
+        infos[(path, qual)] = info
+        for name, sub in info.nested:
+            register(path, f"{qual}.<locals>.{name}", sub)
+
+    for path, mod in mods.items():
+        for qual, fn in mod.funcs.items():
+            info = _FnInfo()
+            _Walker(mod, mod.func_class[qual], info).walk(fn)
+            register(path, qual, info)
+    acq, blk = _closure(infos)
+
+    findings: List[Finding] = []
+    # edge -> first (file, line, note); deterministic smallest anchor
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    def add_edge(a: str, b: str, file: str, line: int, note: str) -> None:
+        cur = edges.get((a, b))
+        if cur is None or (file, line) < (cur[0], cur[1]):
+            edges[(a, b)] = (file, line, note)
+
+    for (path, qual), info in infos.items():
+        for a, b, line in info.held_edges:
+            add_edge(a, b, path, line, f"in `{qual}`")
+        for callee, line, held in info.calls:
+            if not held or callee not in infos:
+                continue
+            for b in sorted(acq[callee]):
+                for a in held:
+                    add_edge(a, b, path, line,
+                             f"in `{qual}` via `{callee[1]}()`")
+            for bline, desc, bfile in sorted(blk[callee]):
+                held_names = ", ".join(
+                    lock_defs[h].display for h in held if h in lock_defs)
+                findings.append(Finding(
+                    rule="lock-blocking-call", file=path, line=line,
+                    message=f"`{qual}` calls `{callee[1]}()` which "
+                            f"performs {desc} ({_short(bfile)}:{bline}) "
+                            f"while holding {held_names} — every thread "
+                            "needing the lock stalls for the wait",
+                ))
+        for line, desc, held in info.held_blocking:
+            held_names = ", ".join(
+                lock_defs[h].display for h in held if h in lock_defs)
+            findings.append(Finding(
+                rule="lock-blocking-call", file=path, line=line,
+                message=f"{desc} inside `with` holding {held_names} "
+                        f"(in `{qual}`) — every thread needing the lock "
+                        "stalls for the wait",
+            ))
+
+    # self-edges: re-acquiring a held non-reentrant lock IS the deadlock
+    succ: Dict[str, Set[str]] = {}
+    for (a, b), (file, line, note) in sorted(edges.items()):
+        if a == b:
+            ld = lock_defs.get(a)
+            if ld is not None and ld.kind == "rlock":
+                continue
+            findings.append(Finding(
+                rule="lock-order", file=file, line=line,
+                message=f"`{ld.display if ld else a}` re-acquired while "
+                        f"already held ({note}) — non-reentrant "
+                        "threading.Lock self-deadlocks",
+            ))
+            continue
+        succ.setdefault(a, set()).add(b)
+
+    for comp in _sccs(sorted(lock_defs), succ):
+        if len(comp) < 2:
+            continue
+        comp_set = set(comp)
+        cyc_edges = sorted(
+            (edges[(a, b)][0], edges[(a, b)][1], a, b)
+            for (a, b) in edges
+            if a in comp_set and b in comp_set and a != b)
+        file, line = cyc_edges[0][0], cyc_edges[0][1]
+        path_desc = "; ".join(
+            f"{lock_defs[a].display} -> {lock_defs[b].display} "
+            f"({_short(f)}:{ln}, {edges[(a, b)][2]})"
+            for f, ln, a, b in cyc_edges)
+        findings.append(Finding(
+            rule="lock-order", file=file, line=line,
+            message=f"lock-order cycle across {len(comp)} locks — "
+                    f"opposite acquisition orders can deadlock: "
+                    f"{path_desc}",
+        ))
+    return findings

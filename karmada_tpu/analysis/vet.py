@@ -27,6 +27,7 @@ from karmada_tpu.analysis import (
     event_reasons,
     exception_hygiene,
     lock_discipline,
+    lock_order,
     metric_docs,
     metric_naming,
     spec_coverage,
@@ -48,6 +49,7 @@ PASSES = {
     "dtype-contract": (dtype_contract.run, ("dtype-contract",)),
     "spec-coverage": (spec_coverage.run, ("spec-coverage",)),
     "lock-discipline": (lock_discipline.run, ("guarded-by",)),
+    "lock-order": (lock_order.run, ("lock-order", "lock-blocking-call")),
     "metric-naming": (metric_naming.run, ("metric-naming",)),
     "metric-docs": (metric_docs.run, ("metric-docs",)),
     "event-reasons": (event_reasons.run, ("event-reasons",)),

@@ -45,6 +45,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from karmada_tpu.utils.locks import VetLock
 from karmada_tpu.utils.metrics import REGISTRY
 
 INJECTIONS = REGISTRY.counter(
@@ -211,7 +212,7 @@ class ChaosPlane:
 
     def __init__(self, seed: int = 0, log_cap: int = 256) -> None:
         self.seed = seed
-        self._lock = threading.Lock()
+        self._lock = VetLock("chaos.plane")
         self._rules: List[FaultRule] = []  # guarded-by: _lock
         self._next_index = 0  # guarded-by: _lock
         # guarded-by: _lock — bounded fire log (site, mode, seq, ts)

@@ -1261,10 +1261,16 @@ def cmd_serve(args) -> int:
     if args.check_invariants:
         # arm BEFORE the plane loads: rehydration may already run solves
         from karmada_tpu.analysis import guards
+        from karmada_tpu.utils import locks as locks_mod
 
         guards.arm()
+        # the lock watchdog rides the same arming flag: over-threshold
+        # holds trip karmada_lock_watchdog_trips_total and show in the
+        # /debug/state locks block instead of wedging silently
+        locks_mod.start_watchdog()
         print("runtime invariant guards armed "
-              "(solver entry + d2h boundaries; analysis/guards)")
+              "(solver entry + d2h boundaries; analysis/guards) + "
+              "lock race detector / deadlock watchdog (utils/locks)")
     explain_rate = 0.0
     if args.explain:
         try:
@@ -1631,8 +1637,21 @@ def cmd_vet(args) -> int:
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
-    print(report.to_json() if args.format == "json"
-          else report.render_text())
+    if args.format == "github":
+        # GitHub Actions annotation lines: findings become inline
+        # ::error markers on the PR diff; the summary line goes to
+        # stdout unannotated (tools/check.sh + CI share this entry)
+        for f in sorted(report.findings,
+                        key=lambda f: (f.file, f.line, f.rule)):
+            msg = f.message.replace("\n", " ")
+            print(f"::error file={f.file},line={f.line},"
+                  f"title=vet {f.rule}::{msg}")
+        c = report.counts()
+        print(f"vet: {c['findings']} finding(s), {c['waivers']} "
+              f"waiver(s) across {report.files} file(s)")
+    else:
+        print(report.to_json() if args.format == "json"
+              else report.render_text())
     return 0 if report.clean else 1
 
 
@@ -2327,10 +2346,13 @@ def build_parser() -> argparse.ArgumentParser:
     vt.add_argument("paths", nargs="*",
                     help="files/directories to analyze (default: the "
                          "installed karmada_tpu package)")
-    vt.add_argument("--format", choices=["text", "json"], default="text",
+    vt.add_argument("--format", choices=["text", "json", "github"],
+                    default="text",
                     help="json: machine-readable findings/waivers summary "
-                         "(rule, file:line, waiver count); exit code is "
-                         "non-zero on any finding either way")
+                         "(rule, file:line, waiver count); github: "
+                         "::error file=...,line=... annotation lines for "
+                         "Actions; exit code is non-zero on any finding "
+                         "either way")
     vt.add_argument("--rules", default="",
                     help="comma-separated finding-rule filter (e.g. "
                          "trace-branch,dtype-contract); all passes still "

@@ -25,6 +25,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 
 from karmada_tpu import chaos, obs
+from karmada_tpu.utils.locks import VetLock
 from karmada_tpu.utils.metrics import REGISTRY
 from karmada_tpu.estimator.wire import (
     CapacitySnapshotResponse,
@@ -141,7 +142,7 @@ class CircuitBreaker:
         self.failure_threshold = max(1, failure_threshold)
         self.reset_timeout_s = reset_timeout_s
         self.clock = clock if clock is not None else time.monotonic
-        self._lock = threading.Lock()
+        self._lock = VetLock("estimator.breaker")
         self._state: Dict[str, str] = {}  # guarded-by: _lock
         self._failures: Dict[str, int] = {}  # guarded-by: _lock
         self._opened_at: Dict[str, float] = {}  # guarded-by: _lock
@@ -151,12 +152,15 @@ class CircuitBreaker:
 
     def _set(self, cluster: str, state: str) -> None:
         """Transition (call under _lock); metrics + log on real moves."""
+        # the armed runtime detector turns the static waivers below into
+        # an enforced precondition: off-lock callers raise loudly
+        self._lock.require_held("CircuitBreaker._set")
         prev = self._state.get(cluster, CIRCUIT_CLOSED)
         if prev == state:
             return
-        # vet: ignore[guarded-by] _set is a helper invoked only under _lock
+        # vet: ignore[guarded-by] _set is a helper invoked only under _lock (require_held-enforced at runtime)
         self._state[cluster] = state
-        # vet: ignore[guarded-by] _set is a helper invoked only under _lock
+        # vet: ignore[guarded-by] _set is a helper invoked only under _lock (require_held-enforced at runtime)
         self.transitions.append({"cluster": cluster, "from": prev,
                                  "to": state, "ts": self.clock()})
         CIRCUIT_STATE.set(_CIRCUIT_VALUE[state], cluster=cluster)
@@ -291,7 +295,7 @@ class AccurateEstimatorClient:
         self._sleep = sleep
         # deterministic jitter stream (replayable soaks)
         self._retry_rng = random.Random(0xC1A05)
-        self._memo_lock = threading.Lock()
+        self._memo_lock = VetLock("estimator.memo")
         # guarded-by: _memo_lock — per (method, cluster): the cluster
         # resourceVersion the memoized answers were observed at, and the
         # successful answers keyed by request signature.  A cluster whose
@@ -521,7 +525,7 @@ class SnapshotEstimator:
         self.max_age_s = max_age_s if max_age_s is not None else 6 * refresh_interval_s
         self._snapshots: Dict[str, CapacitySnapshotResponse] = {}
         self._fetched_at: Dict[str, float] = {}
-        self._lock = threading.Lock()
+        self._lock = VetLock("estimator.capacity")
 
     def refresh(self, cluster: str, force: bool = False) -> None:
         transport = self.client.transports.get(cluster)

@@ -576,11 +576,20 @@ class TcpTransport(Transport):
         return sock
 
     def call(self, method: str, request: dict) -> dict:
+        # _lock held across the round trip BY DESIGN: it serializes use
+        # of the single persistent socket — releasing it mid-exchange
+        # would let a second caller interleave frames and desync the
+        # length-prefixed stream.  Every socket op below is bounded by
+        # self.timeout (settimeout in _connect), so the hold time is
+        # bounded too; callers queue behind the breaker, never hang.
         with self._lock:
             if self._sock is None:
+                # vet: ignore[lock-blocking-call] _lock IS the per-connection frame serialization; connect is timeout-bounded
                 self._sock = self._connect()
             try:
+                # vet: ignore[lock-blocking-call] _lock IS the per-connection frame serialization; send is timeout-bounded
                 _send_frame(self._sock, {"method": method, "body": request})
+                # vet: ignore[lock-blocking-call] _lock IS the per-connection frame serialization; recv is timeout-bounded
                 resp = _recv_frame(self._sock)
             except (FrameTooLarge, socket.timeout):
                 # protocol desync / stalled peer: the stream cannot be
@@ -593,8 +602,11 @@ class TcpTransport(Transport):
             except (ConnectionError, OSError):
                 # one reconnect attempt (sidecar restarts are routine)
                 self._sock.close()
+                # vet: ignore[lock-blocking-call] reconnect under the same serialization lock; timeout-bounded
                 self._sock = self._connect()
+                # vet: ignore[lock-blocking-call] resend under the same serialization lock; timeout-bounded
                 _send_frame(self._sock, {"method": method, "body": request})
+                # vet: ignore[lock-blocking-call] recv under the same serialization lock; timeout-bounded
                 resp = _recv_frame(self._sock)
         if "error" in resp:
             raise RuntimeError(f"estimator error: {resp['error']}")

@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from karmada_tpu import obs
+from karmada_tpu.utils.locks import VetLock
 from karmada_tpu.estimator import wire
 from karmada_tpu.facade import metrics as facade_metrics
 from karmada_tpu.facade import whatif as whatif_mod
@@ -90,23 +91,25 @@ class FacadeService:
         self.batch_window = int(batch_window or scheduler.batch_window)
         self.batch_deadline_s = float(batch_deadline_s)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = VetLock("facade.state")
         self._cond = threading.Condition(self._lock)
         # _cond wraps _lock, so waiters and counter updates share one
         # mutual exclusion; _pending mutations happen in `with _cond:`
         self._pending: List[_Pending] = []  # guarded-by: _cond
-        self._closed = False
-        self._calls = 0
-        self._batches = 0
-        self._coalesced_calls = 0
-        self._errors = 0
-        self._whatif_counts: Dict[str, int] = {}
-        self._batch_id = 0
-        self._last_batch_size = 0
+        self._closed = False  # guarded-by: _cond
+        self._calls = 0  # guarded-by: _cond
+        self._batch_id = 0  # guarded-by: _cond
+        # post-solve bookkeeping lands under the bare _lock (same mutex
+        # as _cond — Condition(self._lock) — different lexical name)
+        self._batches = 0  # guarded-by: _lock
+        self._coalesced_calls = 0  # guarded-by: _lock
+        self._errors = 0  # guarded-by: _lock
+        self._whatif_counts: Dict[str, int] = {}  # guarded-by: _lock
+        self._last_batch_size = 0  # guarded-by: _lock
         # serializes every detached solve this service issues (assign
         # batches and what-if probes) — detached solves are safe against
         # the live cycle worker but not against each other
-        self._solve_lock = threading.Lock()
+        self._solve_lock = VetLock("facade.solve")
         self._server: Optional[wire.EstimatorTcpServer] = None
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="facade-coalescer")

@@ -103,7 +103,16 @@ def fork_clusters(scheduler, store) -> Tuple[List[Cluster], str]:
     """The copy-on-write fork every hypothetical solve runs against:
     the resident plane's cluster view when armed (and populated), the
     store's deep-copied snapshot otherwise.  Either way the returned
-    objects share nothing mutable with live state."""
+    objects share nothing mutable with live state.
+
+    Concurrency contract (the fork bookkeeping has NO lock of its own):
+    every fork is CALL-LOCAL — this module keeps zero shared mutable
+    state across queries, so concurrent run_query callers each hold a
+    private fork and never observe each other.  The only shared
+    resource is the detached solver itself, serialized by the caller's
+    ``solve_lock`` (FacadeService._solve_lock, a VetLock the armed
+    runtime detector tracks); ``state.fork_clusters()`` is itself safe
+    against the live cycle worker (frozen masters, copy-on-write)."""
     state = getattr(scheduler, "_resident", None)
     if state is not None:
         forked = state.fork_clusters()

@@ -32,6 +32,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from karmada_tpu.loadgen.scenarios import Scenario
+from karmada_tpu.utils.locks import VetLock
 from karmada_tpu.models.cluster import (
     APIEnablement,
     Cluster,
@@ -77,7 +78,7 @@ class VirtualClock:
 
     def __init__(self, start: float = 1_000_000.0) -> None:
         self._t = start  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = VetLock("loadgen.clock")
 
     def now(self) -> float:
         return self._t
@@ -416,7 +417,7 @@ class _Flight:
 
 # -- /debug/load registry -----------------------------------------------------
 _ACTIVE: Optional["LoadDriver"] = None  # guarded-by: _ACTIVE_LOCK
-_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_LOCK = VetLock("loadgen.active")
 
 
 def set_active(driver: Optional["LoadDriver"]) -> None:
@@ -495,7 +496,7 @@ class LoadDriver:
         self._arr_idx = 0
         self._evt_idx = 0
         self._n_injected = 0
-        self._lock = threading.Lock()
+        self._lock = VetLock("loadgen.flight")
         self._flight: Dict[Tuple[str, str], _Flight] = {}  # guarded-by: _lock
         self._max_depth: Dict[str, int] = {}  # guarded-by: _lock
         self._max_oldest: Dict[str, float] = {}  # guarded-by: _lock

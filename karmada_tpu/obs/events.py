@@ -50,6 +50,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from karmada_tpu.utils.locks import VetLock
 from karmada_tpu.utils.metrics import REGISTRY
 
 TYPE_NORMAL = "Normal"
@@ -201,7 +202,7 @@ class EventLedger:
         self.capacity = max(1, int(capacity))
         self.now = now
         self.export_metrics = bool(export_metrics)
-        self._lock = threading.Lock()
+        self._lock = VetLock("obs.events")
         # guarded-by: _lock; mutators: record,link_decision
         self._events: Dict[int, LedgerEvent] = {}
         # guarded-by: _lock — global FIFO of event ids (eviction order)

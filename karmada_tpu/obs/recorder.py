@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import collections
 import threading
+
+from karmada_tpu.utils.locks import VetLock
 from typing import List, Optional
 
 
@@ -31,7 +33,7 @@ class TraceRecorder:
         # guarded-by: _lock (ascending duration; [0] is fastest)
         self._slow: List[dict] = []
         self._dropped = 0  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = VetLock("obs.recorder")
 
     def record(self, trace: dict) -> None:
         with self._lock:

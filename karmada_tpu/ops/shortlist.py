@@ -57,6 +57,7 @@ against ops/tensors.FIELD_DTYPES).
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, Optional, Tuple
@@ -71,6 +72,7 @@ from jax import lax  # noqa: E402
 
 from karmada_tpu.obs import events as ev  # noqa: E402
 from karmada_tpu.ops import tensors as T  # noqa: E402
+from karmada_tpu.utils.locks import VetLock  # noqa: E402
 from karmada_tpu.utils.metrics import REGISTRY  # noqa: E402
 
 # packed score-key geometry: prev-assignment bonus bit above a 34-bit
@@ -269,14 +271,19 @@ def _group_sums(group_id, cap_proxy, n_groups: int):
 # (the solver's device-transfer cache discipline).
 # guarded-by: _AGG_LOCK; mutators: cycle_aggregates,reset_for_tests
 _AGG_MEMO: List[Optional[dict]] = [None]
-_AGG_LOCK = threading.Lock()
+_AGG_LOCK = VetLock("shortlist.agg")
 
 # per-profile tier-1 memo (see _dispatch_profiles): one master-set slot,
-# {(placement, gvk, class, k) -> (cand_row, fcount)} under it.
-# Same pinning discipline as _AGG_MEMO.
+# {(placement, gvk, class, k) -> (cand_row, fcount)} under it.  The rows
+# dict is a BOUNDED LRU (recently-used profile keys survive, cold ones
+# age out at _T1_ROWS_CAP) — a long steady run over a churning profile
+# population must not grow host memory without limit; the master-identity
+# check below already resets the whole slot when the cluster planes
+# change.  Same pinning discipline as _AGG_MEMO.
 # guarded-by: _T1_LOCK; mutators: _dispatch_profiles,reset_for_tests
 _T1_MEMO: List[Optional[dict]] = [None]
-_T1_LOCK = threading.Lock()
+_T1_LOCK = VetLock("shortlist.t1")
+_T1_ROWS_CAP = 4096  # LRU bound on cached profile rows per master epoch
 
 #: the per-cluster capacity aggregate the rebalance detect reuses —
 #: implemented in ops/tensors (jax-free: host-backend planes import it
@@ -464,9 +471,12 @@ def _dispatch_profiles(batch, prof_keys, rep_max, k: int, plan=None):
         if (memo is None or memo["mesh"] is not mesh
                 or len(memo["src"]) != len(masters)
                 or not all(a is b for a, b in zip(memo["src"], masters))):
-            memo = {"src": masters, "mesh": mesh, "rows": {}}
+            memo = {"src": masters, "mesh": mesh, "rows": OrderedDict()}
             _T1_MEMO[0] = memo
         have = {key: memo["rows"].get(key) for key in pkeys}
+        for key in pkeys:  # LRU touch: this cycle's profiles stay warm
+            if have[key] is not None:
+                memo["rows"].move_to_end(key)
     miss = [i for i, key in enumerate(pkeys) if have[key] is None]
     if miss:
         cand_m, fcount_m = _t1_rows(
@@ -476,6 +486,8 @@ def _dispatch_profiles(batch, prof_keys, rep_max, k: int, plan=None):
         have.update(fresh)
         with _T1_LOCK:
             memo["rows"].update(fresh)
+            while len(memo["rows"]) > _T1_ROWS_CAP:
+                memo["rows"].popitem(last=False)  # evict coldest profile
     cand = np.stack([have[key][0] for key in pkeys]) if nprof else \
         np.zeros((0, k), np.int32)
     fcount = np.asarray([have[key][1] for key in pkeys], np.int32)

@@ -22,6 +22,8 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from karmada_tpu.utils.locks import VetLock
+
 from karmada_tpu.rebalance.pacing import EvictionBudget  # noqa: F401
 from karmada_tpu.rebalance.plane import (  # noqa: F401
     PRODUCER,
@@ -30,7 +32,7 @@ from karmada_tpu.rebalance.plane import (  # noqa: F401
 )
 
 _ACTIVE: Optional[RebalancePlane] = None  # guarded-by: _ACTIVE_LOCK
-_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_LOCK = VetLock("rebalance.active")
 
 
 def set_active(plane: Optional[RebalancePlane]) -> None:

@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Callable, Dict
 
+from karmada_tpu.utils.locks import VetLock
 from karmada_tpu.utils.metrics import REGISTRY
 
 BUDGET_SPENT = REGISTRY.counter(
@@ -48,7 +49,7 @@ class EvictionBudget:
         self.per_cluster = max(1, int(per_cluster))
         self.interval_s = float(interval_s)
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = VetLock("rebalance.budget")
         # guarded-by: _lock — current window start (rolled in place by
         # each locked section when the interval elapses)
         self._window_start = clock()

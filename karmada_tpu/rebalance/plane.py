@@ -48,6 +48,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from karmada_tpu import chaos as chaos_mod
+from karmada_tpu.utils.locks import VetLock
 from karmada_tpu import obs
 from karmada_tpu.controllers.failover import evict_cluster
 from karmada_tpu.models.cluster import Cluster
@@ -143,7 +144,7 @@ class RebalancePlane:
         self.budget = budget if budget is not None else EvictionBudget(
             per_cluster=self.cfg.budget_per_cluster,
             interval_s=self.cfg.budget_interval_s, clock=self.clock)
-        self._lock = threading.Lock()
+        self._lock = VetLock("rebalance.plane")
         # guarded-by: _lock — last-cycle snapshot + lifetime totals
         # (readers: /debug/rebalance, the soak report; writer: the one
         # periodic hook)

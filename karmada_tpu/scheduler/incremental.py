@@ -85,6 +85,7 @@ from karmada_tpu.obs import events as ev
 from karmada_tpu.ops import dirty as dirty_mod
 from karmada_tpu.ops import tensors as T
 from karmada_tpu.scheduler import pipeline
+from karmada_tpu.utils.locks import OwnerThread
 from karmada_tpu.utils.metrics import REGISTRY
 
 INC_CYCLES = REGISTRY.counter(
@@ -197,24 +198,31 @@ class IncrementalSolver:
         # never break exactness, they only add barriers.
         self._lane_budget = (8 * shortlist.k) if shortlist else None
 
-        self.ledger: T.CarryState = T.CarryState()
-        self.keys: List[str] = []
-        self.key_pos: Dict[str, int] = {}
-        self.bindings: List = []
-        self.results: Dict[int, object] = {}
+        # the whole carried-ledger/roster/audit block below is
+        # single-threaded BY CONTRACT: one scheduler/bench cycle loop
+        # drives adopt()/cycle()/write_back() in sequence — there is no
+        # lock, the armed runtime detector enforces the contract instead
+        # (utils/locks.OwnerThread: first caller owns the plane, any
+        # other thread raises InvariantViolation).
+        self._owner = OwnerThread("scheduler.incremental")
+        self.ledger: T.CarryState = T.CarryState()  # owner-thread: _owner
+        self.keys: List[str] = []  # owner-thread: _owner
+        self.key_pos: Dict[str, int] = {}  # owner-thread: _owner
+        self.bindings: List = []  # owner-thread: _owner
+        self.results: Dict[int, object] = {}  # owner-thread: _owner
         # pos -> slot-store slot (-1: no cached row); refreshed for rows
         # that re-encode, so the next dirty pass reads live slots
         self._slots: np.ndarray = np.zeros(0, np.int64)
         # keys our own write_back() touched since the last cycle — the
         # watch stream the bench/tests drive may not carry them
-        self._pending: Set[str] = set()
+        self._pending: Set[str] = set()  # owner-thread: _owner
         # pos -> last normalized outcome write_back applied (changed-only
         # patching; repeated identical results never bump an rv)
-        self._applied: Dict[int, tuple] = {}
+        self._applied: Dict[int, tuple] = {}  # owner-thread: _owner
         # positions whose result changed since the last write_back — at a
         # million-row roster write_back must not re-normalize the whole
         # results map to find the ~0.1% that moved
-        self._since_wb: Set[int] = set()
+        self._since_wb: Set[int] = set()  # owner-thread: _owner
         # the caller's roster object, for the identity fast path in
         # cycle(): same list + same length skips the O(n) key rebuild.
         # Assumes the roster is append-only (replacing an element in
@@ -339,6 +347,7 @@ class IncrementalSolver:
     # -- lifecycle ------------------------------------------------------------
     def adopt(self, clusters: Sequence, bindings: Sequence) -> CycleReport:
         """First cycle: full solve, roster + ledger + slot store built."""
+        self._owner.check("adopt()")
         t0 = time.perf_counter()
         self.cycles += 1
         self._rebuild_roster(
@@ -354,6 +363,7 @@ class IncrementalSolver:
         """One watch-driven cycle: apply `deltas` to the plane, re-solve
         the dirty set, audit on cadence.  `bindings` is the full roster
         (append-only vs the previous cycle, or a full solve triggers)."""
+        self._owner.check("cycle()")
         t0 = time.perf_counter()
         self.cycles += 1
         state = self.state
@@ -564,6 +574,7 @@ class IncrementalSolver:
         the number of bindings written.  Visits only positions whose
         result changed since the last write_back (``_since_wb``) — the
         steady-state contract is O(dirty) here too, not O(roster)."""
+        self._owner.check("write_back()")
         changed = 0
         for pos in self._since_wb:
             res = self.results.get(pos)

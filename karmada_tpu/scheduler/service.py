@@ -25,6 +25,7 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from karmada_tpu import chaos as chaos_mod
+from karmada_tpu.utils.locks import VetLock
 from karmada_tpu import obs
 from karmada_tpu.obs import decisions as obs_decisions
 from karmada_tpu.obs import timeseries as obs_timeseries
@@ -285,7 +286,7 @@ class Scheduler:
         self._inflight_keys: set = set()
         # the queue is touched from publisher threads (_on_event) and the
         # worker (_cycle); one lock guards every queue operation
-        self._queue_lock = threading.Lock()
+        self._queue_lock = VetLock("scheduler.queue")
         # guarded-by: _queue_lock — the single pending deferred-cut wakeup
         # (threading.Timer): when batch formation defers an immature
         # trickle and no new push arrives, this re-drives the worker when

@@ -138,7 +138,7 @@ class ObservabilityServer:
     def _state(self) -> dict:
         from karmada_tpu import resident
         from karmada_tpu.ops import aotcache, meshing
-        from karmada_tpu.utils import deviceprobe
+        from karmada_tpu.utils import deviceprobe, locks
 
         from karmada_tpu.obs import events as obs_events
 
@@ -169,6 +169,11 @@ class ObservabilityServer:
                 # Read through sys.modules so a host-backend plane that
                 # never armed the two-tier solve pays no jax import
                 "shortlist": self._shortlist_state(),
+                # the runtime race detector (utils/locks): armed flag,
+                # per-VetLock owner/held-for, single-thread ownership
+                # contracts, order-edge + inversion counts, watchdog —
+                # the first page to pull when a serve process wedges
+                "locks": locks.state_payload(),
                 "traces": rec.stats() if rec is not None else None,
                 "explain": dec.stats() if dec is not None else None}
 

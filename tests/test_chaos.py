@@ -562,8 +562,27 @@ def test_chaos_soak_zero_safety_violations():
     corruption, compressed: the circuit opens and half-open recovers,
     the backend degrades and re-arms, the audit-forced rebuild stays
     bit-exact, and the safety auditor reports ZERO conservation
-    violations."""
-    plane, driver, p = _run_chaos_soak()
+    violations.  Runs with the runtime race detector ARMED (the ISSUE-19
+    acceptance leg): a guarded-by mutation off-lock or an OwnerThread
+    contract breach raises InvariantViolation mid-soak, and the
+    order-inversion and deadlock-watchdog counters must not move."""
+    from karmada_tpu.analysis import guards
+    from karmada_tpu.utils import locks
+
+    was = guards.armed()
+    locks.reset_for_tests()  # clear order edges other tests recorded
+    inv0 = locks._INVERSIONS.total()  # noqa: SLF001
+    trips0 = locks._TRIPS.total()  # noqa: SLF001
+    guards.arm()
+    wd = locks.LockWatchdog(threshold_s=5.0, poll_s=0.2).start()
+    try:
+        plane, driver, p = _run_chaos_soak()
+    finally:
+        wd.stop()
+        guards.arm(was)
+    assert locks._INVERSIONS.total() - inv0 == 0, (  # noqa: SLF001
+        locks.state_payload()["inversions"])
+    assert locks._TRIPS.total() - trips0 == 0  # noqa: SLF001
     audit = p["safety_audit"]
     assert audit["violations"] == [], json.dumps(audit["violations"],
                                                  indent=2)

@@ -471,7 +471,20 @@ def test_chaos_facade_hammer_keeps_the_auditor_clean():
     """A facade client hammered by estimator.rpc faults DURING a soak:
     the typed errors land on the facade callers only — the safety
     auditor over the live plane stays clean (no binding lost or
-    double-placed) and the breaker recovers once the budget is spent."""
+    double-placed) and the breaker recovers once the budget is spent.
+    Runs with the runtime race detector ARMED: the hammer thread and the
+    driver thread exercise facade.state/facade.solve/scheduler.queue
+    concurrently, so off-lock mutations or acquisition-order inversions
+    surface here as hard failures."""
+    from karmada_tpu.analysis import guards
+    from karmada_tpu.utils import locks
+
+    was_armed = guards.armed()
+    locks.reset_for_tests()
+    inv0 = locks._INVERSIONS.total()  # noqa: SLF001
+    trips0 = locks._TRIPS.total()  # noqa: SLF001
+    guards.arm()
+    wd = locks.LockWatchdog(threshold_s=5.0, poll_s=0.2).start()
     scenario = get_scenario("steady")
     clock = VirtualClock()
     model = ServiceModel()
@@ -512,6 +525,11 @@ def test_chaos_facade_hammer_keeps_the_auditor_clean():
     finally:
         stop.set()
         svc.close()
+        wd.stop()
+        guards.arm(was_armed)
+    assert locks._INVERSIONS.total() - inv0 == 0, (  # noqa: SLF001
+        locks.state_payload()["inversions"])
+    assert locks._TRIPS.total() - trips0 == 0  # noqa: SLF001
 
 
 # ---------------------------------------------------------------------------

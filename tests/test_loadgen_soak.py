@@ -442,8 +442,27 @@ def test_unschedulable_leftover_flushes_on_cycle_path():
 def test_steady_soak_no_overload_slo():
     """The no-overload reference point: nothing sheds, every binding
     schedules, p99 dwell stays under the configured deadline, and no
-    binding starves (dwell > deadline x 2)."""
-    scenario, driver, p = run_scenario("steady")
+    binding starves (dwell > deadline x 2).  Runs with the runtime race
+    detector armed — the compressed soak doubles as the lock-detector's
+    steady-traffic acceptance run (zero inversions, zero watchdog
+    trips)."""
+    from karmada_tpu.analysis import guards
+    from karmada_tpu.utils import locks
+
+    was_armed = guards.armed()
+    locks.reset_for_tests()
+    inv0 = locks._INVERSIONS.total()  # noqa: SLF001
+    trips0 = locks._TRIPS.total()  # noqa: SLF001
+    guards.arm()
+    wd = locks.LockWatchdog(threshold_s=5.0, poll_s=0.2).start()
+    try:
+        scenario, driver, p = run_scenario("steady")
+    finally:
+        wd.stop()
+        guards.arm(was_armed)
+    assert locks._INVERSIONS.total() - inv0 == 0, (  # noqa: SLF001
+        locks.state_payload()["inversions"])
+    assert locks._TRIPS.total() - trips0 == 0  # noqa: SLF001
     deadline = scenario.deadline_s(driver.model)
     assert p["admission"]["shed"] == 0
     assert p["admission"]["displaced"] == 0

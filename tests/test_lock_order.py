@@ -1,0 +1,547 @@
+"""Concurrency vet (ISSUE-19): the static lock-order pass over seeded
+fixtures, the waiver grammar, and the armed runtime race detector
+(utils/locks) — scripted cross-thread mutation, A/B-B/A inversion,
+deadlock-watchdog trip, disarmed zero-overhead."""
+
+import textwrap
+import threading
+
+import pytest
+
+from karmada_tpu.analysis import guards
+from karmada_tpu.analysis.vet import run_vet
+from karmada_tpu.utils import locks
+from karmada_tpu.utils.metrics import REGISTRY
+
+
+def _vet(tmp_path, name, src, extra=None):
+    (tmp_path / name).write_text(textwrap.dedent(src))
+    for fname, fsrc in (extra or {}).items():
+        (tmp_path / fname).write_text(textwrap.dedent(fsrc))
+    return run_vet([str(tmp_path)])
+
+
+@pytest.fixture
+def armed():
+    """Arm the detector for one test; restore and clear edge state."""
+    was = guards.armed()
+    locks.reset_for_tests()
+    guards.arm()
+    yield
+    guards.arm(was)
+    locks.reset_for_tests()
+
+
+# -- static: lock-order cycles -----------------------------------------------
+
+CYCLE_BAD = """
+    import threading
+
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def one():
+        with A:
+            with B:
+                pass
+
+    def two():
+        with B:
+            with A:
+                pass
+"""
+
+CYCLE_FIXED = """
+    import threading
+
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def one():
+        with A:
+            with B:
+                pass
+
+    def two():
+        with A:
+            with B:
+                pass
+"""
+
+
+def test_lock_order_catches_two_lock_cycle(tmp_path):
+    rep = _vet(tmp_path, "m.py", CYCLE_BAD)
+    cyc = [f for f in rep.findings if f.rule == "lock-order"]
+    assert len(cyc) == 1, [f.message for f in rep.findings]
+    assert "cycle" in cyc[0].message
+    assert "m.py:A" in cyc[0].message and "m.py:B" in cyc[0].message
+
+
+def test_lock_order_consistent_order_is_clean(tmp_path):
+    rep = _vet(tmp_path, "m.py", CYCLE_FIXED)
+    assert [f for f in rep.findings if f.rule == "lock-order"] == []
+
+
+TRANSITIVE_CYCLE = """
+    import threading
+
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def take_a():
+        with A:
+            pass
+
+    def one():
+        with A:
+            with B:
+                pass
+
+    def two():
+        with B:
+            take_a()
+"""
+
+
+def test_lock_order_follows_call_closure(tmp_path):
+    """The cycle only exists through the called function's acquire."""
+    rep = _vet(tmp_path, "m.py", TRANSITIVE_CYCLE)
+    cyc = [f for f in rep.findings if f.rule == "lock-order"]
+    assert len(cyc) == 1, [f.message for f in rep.findings]
+
+
+CROSS_MODULE = {
+    "helper.py": """
+        import threading
+
+        A = threading.Lock()
+
+        def take_a():
+            with A:
+                pass
+    """,
+}
+
+CROSS_MAIN = """
+    import threading
+    from helper import take_a
+
+    B = threading.Lock()
+
+    def one():
+        import helper
+        with helper.B:  # unknown receiver: skipped, not crashed
+            pass
+
+    def two():
+        with B:
+            take_a()
+"""
+
+
+def test_lock_order_cross_module_edges(tmp_path):
+    """Edges reach through from-imports (trace_safety's resolver); a
+    consistent cross-module order stays clean."""
+    rep = _vet(tmp_path, "main.py", CROSS_MAIN, extra=CROSS_MODULE)
+    assert [f for f in rep.findings if f.rule == "lock-order"] == []
+
+
+SELF_DEADLOCK = """
+    import threading
+
+    L = threading.Lock()
+
+    def helper():
+        with L:
+            pass
+
+    def outer():
+        with L:
+            helper()
+"""
+
+SELF_RLOCK_OK = SELF_DEADLOCK.replace("threading.Lock()",
+                                      "threading.RLock()")
+
+
+def test_lock_order_nonreentrant_self_deadlock(tmp_path):
+    rep = _vet(tmp_path, "m.py", SELF_DEADLOCK)
+    cyc = [f for f in rep.findings if f.rule == "lock-order"]
+    assert len(cyc) == 1
+    assert "re-acquired" in cyc[0].message
+
+
+def test_lock_order_rlock_reacquire_is_fine(tmp_path):
+    rep = _vet(tmp_path, "m.py", SELF_RLOCK_OK)
+    assert [f for f in rep.findings if f.rule == "lock-order"] == []
+
+
+NESTED_DEF_OK = """
+    import threading
+
+    L = threading.Lock()
+
+    def arm_timer():
+        def fire():
+            with L:
+                pass
+        with L:
+            t = threading.Timer(0.1, fire)
+            t.start()
+"""
+
+
+def test_lock_order_nested_def_not_charged_to_parent(tmp_path):
+    """A closure's acquire is deferred work — passing it to a timer
+    under the lock is NOT a self-deadlock (the scheduler/service
+    _arm_cut_timer_locked shape)."""
+    rep = _vet(tmp_path, "m.py", NESTED_DEF_OK)
+    assert [f for f in rep.findings if f.rule == "lock-order"] == []
+
+
+CONDITION_ALIAS = """
+    import threading
+
+    class Svc:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+
+        def a_then_b(self):
+            with self._lock:
+                with self._cond:
+                    pass
+"""
+
+
+def test_lock_order_condition_shares_wrapped_lock_identity(tmp_path):
+    """Condition(self._lock) IS self._lock: nesting them is the length-1
+    self-deadlock, not a benign two-lock edge."""
+    rep = _vet(tmp_path, "m.py", CONDITION_ALIAS)
+    cyc = [f for f in rep.findings if f.rule == "lock-order"]
+    assert len(cyc) == 1
+    assert "re-acquired" in cyc[0].message
+
+
+# -- static: blocking calls under a held lock --------------------------------
+
+BLOCKING_BAD = """
+    import threading
+    import time
+
+    L = threading.Lock()
+
+    def tick(thread):
+        with L:
+            time.sleep(0.5)
+            thread.join()
+
+    def device_wait(arr):
+        with L:
+            arr.block_until_ready()
+"""
+
+BLOCKING_FIXED = """
+    import threading
+    import time
+
+    L = threading.Lock()
+
+    def tick(thread, parts):
+        with L:
+            snapshot = list(parts)
+        time.sleep(0.5)
+        thread.join()
+        return ",".join(snapshot)  # str.join: one positional arg, fine
+"""
+
+
+def test_lock_blocking_call_catches_sleep_join_device_sync(tmp_path):
+    rep = _vet(tmp_path, "m.py", BLOCKING_BAD)
+    blk = [f for f in rep.findings if f.rule == "lock-blocking-call"]
+    descs = " | ".join(f.message for f in blk)
+    assert len(blk) == 3, descs
+    assert ".sleep()" in descs and ".join()" in descs \
+        and "block_until_ready" in descs
+
+
+def test_lock_blocking_call_fixed_is_clean(tmp_path):
+    rep = _vet(tmp_path, "m.py", BLOCKING_FIXED)
+    assert [f for f in rep.findings if f.rule == "lock-blocking-call"] == []
+
+
+TRANSITIVE_BLOCKING = """
+    import threading
+    import time
+
+    L = threading.Lock()
+
+    def slow_path():
+        time.sleep(1.0)
+
+    def fast_path():
+        with L:
+            slow_path()
+"""
+
+
+def test_lock_blocking_call_transitive_anchors_at_call_site(tmp_path):
+    rep = _vet(tmp_path, "m.py", TRANSITIVE_BLOCKING)
+    blk = [f for f in rep.findings if f.rule == "lock-blocking-call"]
+    assert len(blk) == 1
+    # the finding anchors where the lock-holder calls out, so a waiver
+    # at that line covers the edge
+    assert "slow_path" in blk[0].message
+    assert blk[0].line == 12
+
+
+COND_WAIT_OK = """
+    import threading
+
+    class Former:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+
+        def run(self):
+            with self._cond:
+                self._cond.wait(timeout=1.0)
+"""
+
+
+def test_condition_wait_under_its_lock_is_not_blocking(tmp_path):
+    """wait() releases the lock while waiting — the one correct way to
+    block under a lock must stay clean (the facade coalescer shape)."""
+    rep = _vet(tmp_path, "m.py", COND_WAIT_OK)
+    assert [f for f in rep.findings
+            if f.rule == "lock-blocking-call"] == []
+
+
+# -- waiver grammar ----------------------------------------------------------
+
+WAIVED = """
+    import threading
+    import time
+
+    L = threading.Lock()
+
+    def tick():
+        with L:
+            time.sleep(0.5)  # vet: ignore[lock-blocking-call] bounded test stall, lock is test-private
+"""
+
+WAIVED_BARE = """
+    import threading
+    import time
+
+    L = threading.Lock()
+
+    def tick():
+        with L:
+            time.sleep(0.5)  # vet: ignore[lock-blocking-call]
+"""
+
+
+def test_lock_waiver_with_justification_suppresses(tmp_path):
+    rep = _vet(tmp_path, "m.py", WAIVED)
+    assert [f for f in rep.findings
+            if f.rule == "lock-blocking-call"] == []
+    assert any(w.rule == "lock-blocking-call" for w in rep.waivers)
+
+
+def test_lock_waiver_without_justification_is_a_finding(tmp_path):
+    rep = _vet(tmp_path, "m.py", WAIVED_BARE)
+    assert any(f.rule == "waiver-syntax" for f in rep.findings)
+    assert any(f.rule == "lock-blocking-call" for f in rep.findings)
+
+
+# -- runtime: ownership enforcement ------------------------------------------
+
+def test_require_held_catches_cross_thread_unguarded_mutation(armed):
+    """The scripted race: a worker mutates guarded state without taking
+    the owning lock — require_held (the runtime teeth behind the
+    guarded-by annotation) raises InvariantViolation."""
+    lock = locks.VetLock("t.guarded")
+    state = {"n": 0}
+    errors = []
+
+    def mutate_unguarded():
+        try:
+            lock.require_held("t.state")
+            state["n"] += 1
+        except guards.InvariantViolation as e:
+            errors.append(str(e))
+
+    t = threading.Thread(target=mutate_unguarded)
+    t.start()
+    t.join()
+    assert errors and "t.guarded" in errors[0]
+    assert state["n"] == 0
+    # the guarded path is clean
+    with lock:
+        lock.require_held("t.state")
+        state["n"] += 1
+    assert state["n"] == 1
+
+
+def test_require_held_rejects_wrong_thread_even_while_held(armed):
+    """Holding the lock on thread A does not license thread B."""
+    lock = locks.VetLock("t.wrongthread")
+    entered = threading.Event()
+    release = threading.Event()
+    errors = []
+
+    def holder():
+        with lock:
+            entered.set()
+            release.wait(timeout=5)
+
+    def intruder():
+        entered.wait(timeout=5)
+        try:
+            lock.require_held("t.state")
+        except guards.InvariantViolation as e:
+            errors.append(str(e))
+        finally:
+            release.set()
+
+    th, ti = (threading.Thread(target=holder),
+              threading.Thread(target=intruder))
+    th.start(); ti.start()
+    th.join(timeout=5); ti.join(timeout=5)
+    assert errors, "intruder thread must not satisfy require_held"
+
+
+def test_owner_thread_contract(armed):
+    owner = locks.OwnerThread("t.plane")
+    owner.check("cycle()")  # first toucher wins
+    failures = []
+
+    def other():
+        try:
+            owner.check("cycle()")
+        except guards.InvariantViolation as e:
+            failures.append(str(e))
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert failures and "single-threaded by contract" in failures[0]
+    owner.reset()  # hand-off: next toucher owns
+    t = threading.Thread(target=owner.check)
+    t.start()
+    t.join()
+
+
+# -- runtime: order inversions -----------------------------------------------
+
+def test_runtime_detector_counts_ab_ba_inversion(armed):
+    a = locks.VetLock("t.inv.A")
+    b = locks.VetLock("t.inv.B")
+    inv0 = locks._INVERSIONS.total()  # noqa: SLF001
+    with a:
+        with b:
+            pass
+
+    def reversed_order():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=reversed_order)
+    t.start()
+    t.join()
+    assert locks._INVERSIONS.total() - inv0 == 1  # noqa: SLF001
+    recent = locks.state_payload()["inversions"]["recent"]
+    assert recent and recent[-1]["pair"] == "t.inv.A|t.inv.B"
+
+
+def test_runtime_detector_consistent_order_counts_nothing(armed):
+    a = locks.VetLock("t.ok.A")
+    b = locks.VetLock("t.ok.B")
+    inv0 = locks._INVERSIONS.total()  # noqa: SLF001
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert locks._INVERSIONS.total() - inv0 == 0  # noqa: SLF001
+
+
+# -- runtime: deadlock watchdog ----------------------------------------------
+
+def test_watchdog_trips_once_per_injected_stall(armed):
+    now = [100.0]
+    locks.set_clock(lambda: now[0])
+    try:
+        wd = locks.LockWatchdog(threshold_s=5.0)
+        stalled = locks.VetLock("t.stall")
+        trips0 = locks._TRIPS.total()  # noqa: SLF001
+        stalled.acquire()
+        try:
+            assert wd.check() == []  # young hold: quiet
+            now[0] = 106.0  # inject the stall
+            trips = wd.check()
+            assert [t["lock"] for t in trips] == ["t.stall"]
+            assert trips[0]["held_s"] == pytest.approx(6.0)
+            assert wd.check() == []  # once per hold, not per poll
+        finally:
+            stalled.release()
+        now[0] = 120.0
+        assert wd.check() == []  # released: nothing to trip
+        assert locks._TRIPS.total() - trips0 == 1  # noqa: SLF001
+    finally:
+        locks.set_clock()
+
+
+# -- runtime: disarmed path is free ------------------------------------------
+
+def test_disarmed_lock_is_zero_overhead():
+    assert not guards.armed()
+    fam_before = set(REGISTRY.snapshot())
+    locks.reset_for_tests()
+    lock = locks.VetLock("t.disarmed")
+    hold0 = locks._HOLD.count(lock="t.disarmed")  # noqa: SLF001
+    for _ in range(100):
+        with lock:
+            pass
+    # no bookkeeping ran: no ownership, no hold observations, no edges
+    assert lock._owner is None  # noqa: SLF001
+    assert lock._acquired_at is None  # noqa: SLF001
+    assert locks._HOLD.count(lock="t.disarmed") == hold0  # noqa: SLF001
+    assert locks.state_payload()["order_edges"] == 0
+    # and no new metric families appeared (all three karmada_lock_*
+    # families register at import, before any traffic)
+    assert set(REGISTRY.snapshot()) == fam_before
+
+
+def test_state_payload_shape(armed):
+    lock = locks.VetLock("t.payload")
+    with lock:
+        payload = locks.state_payload()
+        row = next(r for r in payload["locks"]
+                   if r["name"] == "t.payload")
+        assert row["owner"] == threading.current_thread().name
+        assert row["held_for_s"] is not None
+    assert payload["armed"] is True
+    assert {"locks", "owner_threads", "order_edges", "inversions",
+            "watchdog"} <= set(payload)
+
+
+# -- CLI: --format github ----------------------------------------------------
+
+def test_vet_format_github_emits_error_annotations(tmp_path, capsys):
+    from karmada_tpu import cli
+
+    (tmp_path / "m.py").write_text(textwrap.dedent(CYCLE_BAD))
+    rc = cli.main(["vet", str(tmp_path), "--format", "github"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    line = next(ln for ln in out.splitlines() if ln.startswith("::error "))
+    assert "file=" in line and "line=" in line \
+        and "title=vet lock-order::" in line
+
+    (tmp_path / "m.py").write_text(textwrap.dedent(CYCLE_FIXED))
+    rc = cli.main(["vet", str(tmp_path), "--format", "github"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "::error" not in out

@@ -125,6 +125,44 @@ def test_vet_covers_incremental_plane():
     assert "_dirty_core" in mod.roots()
 
 
+def test_vet_covers_lock_plane():
+    """The gate runs the lock-order pass over the live tree and the pass
+    actually SEES the serve-plane locks: the VetLock creation sites in
+    the scheduler and facade resolve to lock definitions, and the
+    deliberate lock-held estimator RPC sites in estimator/wire.py are
+    present as APPLIED lock-blocking-call waivers (a waiver only lands
+    in report.waivers when its finding was really produced — if the
+    pass stopped running or stopped recognizing VetLock, this pins the
+    regression)."""
+    from karmada_tpu.analysis import lock_order
+    from karmada_tpu.analysis.core import RULES, collect_files
+    from karmada_tpu.analysis.vet import PASSES
+
+    assert "lock-order" in RULES and "lock-blocking-call" in RULES
+    assert "lock-order" in PASSES
+
+    files = collect_files([PKG])
+    by_tail = {os.path.join(*sf.path.split(os.sep)[-2:]): sf
+               for sf in files}
+    sched = lock_order._Mod(  # noqa: SLF001
+        by_tail[os.path.join("scheduler", "service.py")])
+    facade = lock_order._Mod(  # noqa: SLF001
+        by_tail[os.path.join("facade", "service.py")])
+    sched_locks = {a for t in sched.class_locks.values() for a in t}
+    facade_locks = {a for t in facade.class_locks.values() for a in t}
+    assert "_queue_lock" in sched_locks
+    assert {"_lock", "_solve_lock"} <= facade_locks
+
+    report = run_vet([PKG])
+    wire_waivers = [w for w in report.waivers
+                    if w.rule == "lock-blocking-call"
+                    and w.file.endswith(os.path.join("estimator",
+                                                     "wire.py"))]
+    assert len(wire_waivers) == 6, \
+        [(w.file, w.line) for w in report.waivers
+         if w.rule == "lock-blocking-call"]
+
+
 def test_vet_covers_facade_plane():
     """The gate extends over karmada_tpu/facade/: the analyzer walk must
     reach every module of the subsystem, so its metric names stay inside

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# The one entrypoint builders and CI share: static vet + tier-1 tests,
+# exactly as ROADMAP.md specifies them.  Usage: tools/check.sh [--vet-only]
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== karmadactl vet (static analysis, all passes) =="
+JAX_PLATFORMS=cpu python -m karmada_tpu.cli vet karmada_tpu/ --format "${VET_FORMAT:-text}"
+vet_rc=$?
+if [ "$vet_rc" -ne 0 ]; then
+  echo "vet failed (rc=$vet_rc)" >&2
+  exit "$vet_rc"
+fi
+
+if [ "${1:-}" = "--vet-only" ]; then
+  exit 0
+fi
+
+echo "== tier-1 tests (ROADMAP verify command) =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+  2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit "$rc"
