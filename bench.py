@@ -959,6 +959,57 @@ def measure_lock_overhead(reference_cycle_s, iters: int = 20000) -> dict:
     }
 
 
+def measure_flight_overhead(reference_cycle_s, iters: int = 20000) -> dict:
+    """The incident plane's honest price — the --slo acceptance gate:
+    the ARMED per-cycle flight record (a representative cycle-shaped
+    dict appended to a private ring) and the DISARMED module hook (one
+    global list read), each against a mean scheduling cycle.  Pure host
+    bookkeeping — zero jit compiles (asserted, ledger-plane style)."""
+    from karmada_tpu.obs import incidents as obs_incidents
+    from karmada_tpu.ops import solver
+
+    c_before = solver._jit_cache_size()  # noqa: SLF001
+    rec = obs_incidents.FlightRecorder(capacity=512)
+
+    def one(i):
+        rec.record({"kind": "cycle", "t": float(i), "cycle_id": i,
+                    "trace_id": None, "popped": 32, "batch": 32,
+                    "cut": "window", "backend": "device",
+                    "degraded_from": None, "overload": False,
+                    "fault": None, "scheduled": 32, "unschedulable": 0,
+                    "errors": 0, "elapsed_s": 0.01, "dwell_max_s": 0.02,
+                    "pipeline": None, "shortlist": None,
+                    "depths": {"active": 0, "backoff": 0},
+                    "oldest_s": {"active": 0.0}})
+
+    one(0)  # warm
+    t0 = time.perf_counter()
+    for i in range(iters):
+        one(i)
+    armed_s = (time.perf_counter() - t0) / iters
+    was_armed = obs_incidents.flight_armed()
+    obs_incidents.arm_flight(False)
+    try:
+        t0 = time.perf_counter()
+        for i in range(iters):
+            obs_incidents.record("cycle", cycle_id=i)
+        disarmed_s = (time.perf_counter() - t0) / iters
+    finally:
+        obs_incidents.arm_flight(was_armed)
+    c_after = solver._jit_cache_size()  # noqa: SLF001
+    new_compiles = (None if c_before is None or c_after is None
+                    else c_after - c_before)
+    pct = lambda s: (round(s / reference_cycle_s * 100, 5)
+                     if reference_cycle_s and reference_cycle_s > 0 else None)
+    return {
+        "flight_armed_per_record_us": round(armed_s * 1e6, 4),
+        "flight_armed_overhead_pct": pct(armed_s),
+        "flight_disarmed_per_call_us": round(disarmed_s * 1e6, 4),
+        "flight_disarmed_overhead_pct": pct(disarmed_s),
+        "flight_new_compiles": new_compiles,
+    }
+
+
 def build_rebalance_items(rng: random.Random, items, names):
     """BASELINE config 5's second half: bindings that WERE scheduled now
     need re-assignment (descheduler marks clusters lossy / triggers
@@ -1843,6 +1894,7 @@ def run_soak(args) -> int:
     telemetry.update(measure_disarmed_overhead(ref_cycle_s))
     telemetry.update(measure_ledger_overhead(ref_cycle_s))
     telemetry.update(measure_lock_overhead(ref_cycle_s))
+    telemetry.update(measure_flight_overhead(ref_cycle_s))
     payload["backend"] = args.soak_backend
     payload["telemetry"] = telemetry
     if args.slo:
@@ -1893,6 +1945,20 @@ def run_soak(args) -> int:
             "VetLock traffic registered new metric families")
         assert telemetry["lock_new_compiles"] in (0, None), (
             "the lock detector triggered jit compilation")
+        # the incident plane's acceptance leg: an armed per-cycle flight
+        # record and the disarmed hook must each stay under 1% of a
+        # mean cycle, and neither may touch the jit cache
+        assert telemetry["flight_armed_overhead_pct"] is not None and \
+            telemetry["flight_armed_overhead_pct"] < 1.0, (
+            f"armed flight record costs "
+            f"{telemetry['flight_armed_overhead_pct']}% of a cycle — "
+            "the flight ring must be noise (< 1%)")
+        assert telemetry["flight_disarmed_overhead_pct"] is not None and \
+            telemetry["flight_disarmed_overhead_pct"] < 1.0, (
+            f"disarmed flight hook costs "
+            f"{telemetry['flight_disarmed_overhead_pct']}% of a cycle")
+        assert telemetry["flight_new_compiles"] in (0, None), (
+            "the flight recorder triggered jit compilation")
         ledger_stats = payload.get("events") or {}
         assert ledger_stats.get("recorded", 0) > 0, (
             "the soak recorded zero lifecycle events — the ledger was "

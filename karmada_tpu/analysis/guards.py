@@ -23,7 +23,22 @@ import numpy as np
 
 
 class InvariantViolation(AssertionError):
-    """An armed shape/dtype/value invariant failed at a checked boundary."""
+    """An armed shape/dtype/value invariant failed at a checked boundary.
+
+    Construction fires the incident plane's invariant-violation trigger
+    (obs/incidents): one hook covers every raise site — check_batch /
+    check_used / check_d2h, VetLock.require_held, OwnerThread.check.
+    The trigger is reentrancy-latched and never raises, so building the
+    exception stays safe even mid-capture."""
+
+    def __init__(self, *args) -> None:
+        super().__init__(*args)
+        from karmada_tpu.obs import incidents as obs_incidents
+
+        obs_incidents.trigger(
+            obs_incidents.TRIGGER_INVARIANT_VIOLATION,
+            str(args[0]) if args else "invariant violation",
+            detail={"message": str(args[0]) if args else ""})
 
 
 _ARMED = [os.environ.get("KARMADA_CHECK_INVARIANTS", "") not in ("", "0")]

@@ -73,6 +73,43 @@ def _deltas(baseline: Dict[str, float]) -> Dict[str, float]:
             for name, read in _readers().items()}
 
 
+def surface_violations(violations: List[dict]) -> None:
+    """Violations used to land only in the bench payload — surface each
+    on the lifecycle ledger too (``REASON_SafetyViolation``, keyed by
+    the violated invariant, on the implicated binding's timeline when
+    one is named) and fire the incident trigger per invariant kind so a
+    forensic bundle lands."""
+    if not violations:
+        return
+    by_kind: Dict[str, List[dict]] = {}
+    for v in violations:
+        kind = str(v.get("kind", "unknown"))
+        by_kind.setdefault(kind, []).append(v)
+        msg = (f"safety invariant {kind!r} violated: "
+               f"{v.get('detail', '')}")
+        ref = v.get("binding")
+        if isinstance(ref, str) and "/" in ref:
+            ns, _, nm = ref.partition("/")
+            obs_events.emit_key((ns, nm), obs_events.TYPE_WARNING,
+                                obs_events.REASON_SAFETY_VIOLATION,
+                                msg, origin="chaos-audit")
+        else:
+            obs_events.emit(obs_events.SCHEDULER_REF,
+                            obs_events.TYPE_WARNING,
+                            obs_events.REASON_SAFETY_VIOLATION,
+                            msg, origin="chaos-audit")
+    from karmada_tpu.obs import incidents as obs_incidents
+
+    for kind, vs in sorted(by_kind.items()):
+        obs_incidents.trigger(
+            obs_incidents.TRIGGER_SAFETY_VIOLATION,
+            f"safety auditor: {len(vs)} {kind!r} violation(s)",
+            refs=[v["binding"] for v in vs
+                  if isinstance(v.get("binding"), str)][:16],
+            detail={"kind": kind, "count": len(vs),
+                    "violations": vs[:10]})
+
+
 def audit_soak(driver, baseline: Optional[Dict[str, float]] = None) -> dict:
     """The safety-audit payload for one finished LoadDriver run.  Must be
     called after `_drain` while the plane (store + queues) is intact and
@@ -230,6 +267,8 @@ def audit_soak(driver, baseline: Optional[Dict[str, float]] = None) -> dict:
                 "kind": "recovery-missed",
                 "detail": "estimator outage ended but circuit(s) "
                           f"{stuck} never closed again"})
+
+    surface_violations(violations)
 
     return {
         "violations": violations,

@@ -770,6 +770,20 @@ def cmd_describe(args) -> int:
     view instead: status + the lifecycle-ledger timeline
     (/debug/events/{ns}/{name}) + the last explain verdict."""
     if getattr(args, "endpoint", ""):
+        if (args.kind or "").lower() in ("incident", "incidents"):
+            # `karmadactl describe incident inc-0001-... --endpoint URL`:
+            # dump the one forensic bundle (the incidents-command twin)
+            if not args.name:
+                print("describe incident expects an incident ID "
+                      "(see `karmadactl incidents --endpoint URL`)",
+                      file=sys.stderr)
+                return 1
+            bundle = _fetch_json(args.endpoint,
+                                 f"/debug/incidents/{args.name}")
+            if bundle is None:
+                return 1
+            print(json.dumps(bundle, indent=2, default=str))
+            return 0
         target = args.kind if "/" in (args.kind or "") else (
             f"{args.namespace}/{args.name}"
             if args.name and args.namespace else "")
@@ -1503,6 +1517,20 @@ def cmd_serve(args) -> int:
                   "disabled, so /debug/timeseries and /debug/slo are "
                   "unreachable (the karmada_slo_* gauges still update)",
                   file=sys.stderr)
+    if not args.no_incidents:
+        # incident plane (obs/incidents), armed by default: every
+        # detector's trigger captures a rate-limited forensic bundle
+        # under the plane dir
+        from karmada_tpu.obs import incidents as incidents_mod
+
+        incidents_mod.configure(
+            os.path.join(args.dir, "incidents"),
+            cooldown_s=max(args.incident_cooldown, 0.0))
+        print("incident plane armed: trigger-driven forensic bundles "
+              f"under {os.path.join(args.dir, 'incidents')} "
+              f"(cooldown {max(args.incident_cooldown, 0.0):g}s per "
+              "trigger); index at /debug/incidents, render with "
+              "`karmadactl incidents --endpoint URL`")
     if args.feature_gates:
         cp.gates.set_from_string(args.feature_gates)
     cp.runtime._periodic_interval_s = args.sync_period  # noqa: SLF001
@@ -1613,6 +1641,10 @@ def cmd_serve(args) -> int:
             obs.stop()
         if api is not None:
             api.stop()
+        if not args.no_incidents:
+            from karmada_tpu.obs import incidents as incidents_mod
+
+            incidents_mod.disarm()
         cp.runtime.stop()
         cp.checkpoint()
     return 0
@@ -1845,11 +1877,16 @@ def cmd_estimate(args) -> int:
         resource_request["cpu"] = args.cpu
     if args.memory:
         resource_request["memory"] = args.memory
+    import uuid
+
     req = wire.AssignReplicasRequest(
         namespace=args.namespace, name=args.name,
         replicas=args.replicas, resource_request=resource_request,
         divided=not args.duplicated,
-        cluster_names=[c for c in args.clusters.split(",") if c])
+        cluster_names=[c for c in args.clusters.split(",") if c],
+        # caller-side trace id: lands in the serve process's facade
+        # flight record, stitching this CLI call to its coalesced batch
+        trace_id=f"cli-{uuid.uuid4().hex[:16]}")
     client = FacadeClient(wire.TcpTransport(addr[0], addr[1], timeout=120))
     try:
         resp = client.assign_replicas(req)
@@ -1895,6 +1932,50 @@ def cmd_resident(args) -> int:
         print(f"cannot reach {base}: {e.reason}", file=sys.stderr)
         return 1
     print(render_state(state))
+    return 0
+
+
+def cmd_incidents(args) -> int:
+    """Render a live serve process's incident plane (/debug/incidents):
+    flight-ring stats, capture/suppression totals by trigger, and the
+    bundle index.  With an ID, dump that one forensic bundle as JSON
+    (also available via `karmadactl describe incident ID`)."""
+    if args.id:
+        bundle = _fetch_json(args.endpoint, f"/debug/incidents/{args.id}")
+        if bundle is None:
+            return 1
+        print(json.dumps(bundle, indent=2, default=str))
+        return 0
+    state = _fetch_json(args.endpoint, "/debug/incidents")
+    if state is None:
+        return 1
+    if not state.get("enabled"):
+        flight = state.get("flight") or {}
+        print("incident plane disarmed (serve arms it automatically); "
+              f"flight ring: {flight.get('retained', 0)} record(s) "
+              f"retained of {flight.get('recorded', 0)} recorded")
+        return 0
+    flight = state.get("flight") or {}
+    print(f"captured {state.get('captured', 0)} incident(s), "
+          f"cooldown {state.get('cooldown_s', 0):g}s per trigger; "
+          f"flight ring {flight.get('retained', 0)}/"
+          f"{flight.get('capacity', 0)} record(s)")
+    by_trigger = state.get("by_trigger") or {}
+    suppressed = state.get("suppressed") or {}
+    if by_trigger or suppressed:
+        print("trigger totals:")
+        for kind in sorted(set(by_trigger) | set(suppressed)):
+            print(f"  {kind:<22} captured {by_trigger.get(kind, 0):<4} "
+                  f"suppressed {suppressed.get(kind, 0)}")
+    incidents = state.get("incidents") or []
+    if not incidents:
+        print("no incident bundles captured")
+        return 0
+    print(f"{'ID':<32} {'TRIGGER':<22} {'CAPTURE':>9}  SUMMARY")
+    for e in incidents:
+        print(f"{e.get('id', ''):<32} {e.get('trigger', ''):<22} "
+              f"{e.get('capture_s', 0.0):>8.3f}s  "
+              f"{(e.get('summary') or '')[:60]}")
     return 0
 
 
@@ -2490,6 +2571,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "(the <1s p99 north star); dwell_p99 uses "
                          "twice this bound — deadline-formed batches "
                          "dwell at the batch deadline by design")
+    sv.add_argument("--incident-cooldown", type=float, default=60.0,
+                    metavar="SECONDS",
+                    help="incident plane (obs/incidents, armed by "
+                         "default): minimum spacing between forensic "
+                         "bundle captures per trigger kind; bundles "
+                         "land under DIR/incidents and are indexed at "
+                         "/debug/incidents (`karmadactl incidents`)")
+    sv.add_argument("--no-incidents", action="store_true",
+                    help="disarm the incident store (triggers become "
+                         "no-ops; the per-cycle flight ring stays "
+                         "armed)")
     sv.add_argument("--trace-buffer", type=int, default=0,
                     help="arm the flight recorder: retain the last N "
                          "cross-component traces (scheduler cycles, "
@@ -2694,6 +2786,14 @@ def build_parser() -> argparse.ArgumentParser:
     rs.add_argument("--recent", type=int, default=0, metavar="N",
                     help="also list the last N per-cycle hit/miss records")
 
+    inc = sub.add_parser("incidents")
+    inc.add_argument("id", nargs="?", default="",
+                     help="incident ID: dump that one forensic bundle as "
+                          "JSON (omit to list the bundle index)")
+    inc.add_argument("--endpoint", required=True,
+                     help="observability endpoint URL of a live serve "
+                          "process (serve --metrics-port PORT)")
+
     pf = sub.add_parser("profile")
     pf.add_argument("--endpoint", required=True,
                     help="observability endpoint URL of a live serve "
@@ -2762,6 +2862,7 @@ COMMANDS = {
     "whatif": cmd_whatif,
     "estimate": cmd_estimate,
     "resident": cmd_resident,
+    "incidents": cmd_incidents,
     "profile": cmd_profile,
 }
 
@@ -2812,6 +2913,9 @@ def _dispatch(args) -> int:
     if args.command == "describe" and getattr(args, "endpoint", ""):
         # live timeline view over HTTP; no plane is opened
         return cmd_describe(args)
+    if args.command == "incidents":
+        # talks to a live serve process over HTTP; no plane is opened
+        return cmd_incidents(args)
     if args.command == "profile":
         # talks to a live serve process over HTTP; no plane is opened
         return cmd_profile(args)

@@ -291,13 +291,21 @@ class AssignReplicasRequest:
     resource_request: Dict[str, str] = field(default_factory=dict)
     divided: bool = False
     cluster_names: List[str] = field(default_factory=list)
+    # caller-side trace id: stitches the caller's timeline to the
+    # server-side coalesced-batch flight records (obs/incidents)
+    trace_id: str = ""
 
     def to_json(self) -> dict:
-        return {"namespace": self.namespace, "name": self.name,
-                "replicas": self.replicas,
-                "resourceRequest": self.resource_request,
-                "divided": self.divided,
-                "clusterNames": self.cluster_names}
+        d = {"namespace": self.namespace, "name": self.name,
+             "replicas": self.replicas,
+             "resourceRequest": self.resource_request,
+             "divided": self.divided,
+             "clusterNames": self.cluster_names}
+        if self.trace_id:
+            # emitted only when set: untraced callers keep the exact
+            # frame shape older peers golden-test against
+            d["traceId"] = self.trace_id
+        return d
 
     @staticmethod
     def from_json(d: dict) -> "AssignReplicasRequest":
@@ -308,6 +316,7 @@ class AssignReplicasRequest:
             resource_request=dict(d.get("resourceRequest", {})),
             divided=bool(d.get("divided", False)),
             cluster_names=list(d.get("clusterNames", [])),
+            trace_id=d.get("traceId", ""),
         )
 
 

@@ -64,6 +64,15 @@ class FacadeClient:
     def assign_replicas(
             self,
             req: wire.AssignReplicasRequest) -> wire.AssignReplicasResponse:
+        if not req.trace_id:
+            # stamp the caller's ambient trace id onto the frame so the
+            # server-side flight record of the coalesced batch can
+            # stitch this caller's timeline (obs/incidents)
+            from karmada_tpu import obs
+
+            sp = obs.TRACER.current()
+            if sp is not None:
+                req.trace_id = sp.trace.trace_id
         return wire.AssignReplicasResponse.from_json(
             self._call("AssignReplicas", req.to_json()))
 

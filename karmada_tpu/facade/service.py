@@ -37,6 +37,7 @@ from karmada_tpu.facade.messages import (
 from karmada_tpu.models.cluster import Cluster
 from karmada_tpu.models.work import ResourceBindingStatus
 from karmada_tpu.obs import events as obs_events
+from karmada_tpu.obs import incidents as obs_incidents
 from karmada_tpu.ops import serial
 
 OUTCOME_SCHEDULED = "scheduled"
@@ -259,14 +260,26 @@ class FacadeService:
         clusters = self.store.list(Cluster.KIND)
         tracer = obs.TRACER
         trace_id = ""
+        # caller-side trace ids off the wire frames: a bundle's facade
+        # flight record stitches these to the server-side timeline of
+        # the one coalesced dispatch they shared
+        caller_traces = sorted({p.request.trace_id for p in batch
+                                if p.request.trace_id})
         with tracer.span(obs.SPAN_FACADE_CYCLE, callers=len(batch),
                          batch_id=bid):
             sp = tracer.current()
             if sp is not None:
                 trace_id = sp.trace.trace_id
+                if caller_traces:
+                    sp.set_attr(caller_trace_ids=caller_traces)
             with self._solve_lock:
                 results, _ = self.scheduler.solve_batch(
                     bindings, clusters, detached=True)
+        if obs_incidents.flight_armed():
+            obs_incidents.record(
+                "facade", t=round(time.time(), 6), batch_id=bid,
+                trace_id=trace_id or None, batch=len(batch),
+                caller_trace_ids=caller_traces)
         with self._lock:
             self._batches += 1
             self._coalesced_calls += len(batch)

@@ -217,6 +217,16 @@ def build_soak_report(driver) -> dict:
     # the coalesce ratio (how much the tail-bump saved the ring), and
     # the per-reason tally the timeline summaries key on
     payload["events"] = _ledger_summary(driver)
+    # incident plane (obs/incidents): when a store is armed for this
+    # soak, embed its capture/suppression summary so SOAK/CHAOS payloads
+    # record which triggers fired and whether the cooldown held
+    from karmada_tpu.obs import incidents as obs_incidents
+
+    payload["incidents"] = (obs_incidents.state_payload()
+                            if obs_incidents.active() is not None else None)
+    if payload["incidents"] is not None:
+        # the index alone: full bundles live on disk / /debug/incidents
+        payload["incidents"].pop("flight", None)
     audit = getattr(driver, "safety_audit", None)
     if audit is not None:
         # chaos soak (karmada_tpu/chaos): the fault ledger and the

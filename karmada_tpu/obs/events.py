@@ -93,6 +93,10 @@ REASON_EVICTION_BUDGET_DENIED = "EvictionBudgetDenied"
 REASON_HPA_FAST_PATH = "HpaFastPathPush"
 # chaos plane (karmada_tpu/chaos)
 REASON_CHAOS_FAULT_INJECTED = "ChaosFaultInjected"
+# chaos safety auditor (chaos/audit.py) — keyed by violated invariant
+REASON_SAFETY_VIOLATION = "SafetyViolation"
+# incident plane (obs/incidents.py)
+REASON_INCIDENT_CAPTURED = "IncidentCaptured"
 
 REASON_SHORTLIST_FALLBACK = "ShortlistFallback"
 REASON_SHORTLIST_TRUNCATE = "ShortlistTruncate"

@@ -183,6 +183,11 @@ class SloEvaluator:
         self._lock = threading.Lock()
         self._last: dict = {"enabled": True, "objectives": [],
                             "regression": None}  # guarded-by: _lock; mutators: evaluate
+        # incident-trigger edge state (evaluate-thread owned): triggers
+        # fire on the healthy->unhealthy / watchdog-trip TRANSITIONS
+        # only, never per unhealthy window
+        self._prev_healthy: Optional[bool] = None
+        self._prev_tripped = False
 
     # -- window math --------------------------------------------------------
     def _err_frac(self, obj: Objective, first: dict,
@@ -307,6 +312,27 @@ class SloEvaluator:
                                  if self.watchdog is not None else None)
         with self._lock:
             self._last = payload
+        # incident triggers AFTER _lock releases: the bundle capture
+        # reads last() and must not nest under the evaluator's lock
+        from karmada_tpu.obs import incidents as obs_incidents
+
+        healthy = payload["healthy"]
+        if healthy is False and self._prev_healthy is not False:
+            obs_incidents.trigger(
+                obs_incidents.TRIGGER_SLO_UNHEALTHY,
+                "SLO transitioned healthy -> unhealthy",
+                detail={"unhealthy": [o["name"] for o in
+                                      payload["objectives"]
+                                      if o["healthy"] is False]})
+        self._prev_healthy = healthy
+        tripped = bool(self.watchdog is not None and self.watchdog.tripped)
+        if tripped and not self._prev_tripped:
+            obs_incidents.trigger(
+                obs_incidents.TRIGGER_REGRESSION,
+                "regression watchdog tripped: live throughput under the "
+                "baseline envelope floor",
+                detail=payload["regression"])
+        self._prev_tripped = tripped
         return payload
 
     def last(self) -> dict:

@@ -1414,6 +1414,18 @@ class ResidentState:
                                "ts": time.time()}
         if not mismatches:
             return None
+        # incident trigger (obs/incidents): divergence adoption is a
+        # forensic moment — capture the flight ring + plane state before
+        # the rebuild papers over it.  Lazy import: the resident plane
+        # must stay importable without the obs package loaded.
+        from karmada_tpu.obs import incidents as obs_incidents
+
+        obs_incidents.trigger(
+            obs_incidents.TRIGGER_AUDIT_DIVERGENCE,
+            f"resident audit divergence adopted: {len(mismatches)} "
+            "diverged field(s); plane rebuilt from scratch",
+            detail={"plane": "resident", "fields": mismatches[:8],
+                    "cycle": self.cycles, "items": len(items)})
         self._reset(self.clusters, "audit-mismatch")
         # adopt the fresh encode so the plane is resident again next cycle
         self._adopt(fresh, items, tokens)

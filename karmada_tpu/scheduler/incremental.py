@@ -82,6 +82,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from karmada_tpu.obs import events as ev
+from karmada_tpu.obs import incidents as obs_incidents
 from karmada_tpu.ops import dirty as dirty_mod
 from karmada_tpu.ops import tensors as T
 from karmada_tpu.scheduler import pipeline
@@ -394,6 +395,7 @@ class IncrementalSolver:
             rep = self._full(full_reason, rep)
             self._pending.clear()
             rep.seconds = time.perf_counter() - t0
+            self._flight(rep)
             return rep
 
         appended = self._set_roster(bindings, keys)
@@ -470,7 +472,22 @@ class IncrementalSolver:
         if rep.audited:
             rep.audit_outcome = self._audit(pre)
         rep.seconds = time.perf_counter() - t0
+        self._flight(rep)
         return rep
+
+    def _flight(self, rep: CycleReport) -> None:
+        """One kind="incremental" flight record per cycle: the dirty-set
+        and taint-group stats plus the audit verdict digest the incident
+        bundles snapshot.  Disarmed cost is one list read."""
+        if not obs_incidents.flight_armed():
+            return
+        obs_incidents.record(
+            "incremental", t=round(time.time(), 6), cycle=self.cycles,
+            mode=rep.mode, reason=rep.reason, total=rep.total,
+            dirty=rep.dirty, chunk_groups=rep.chunk_groups,
+            groups=list(rep.groups), host_rows=rep.host_rows,
+            audited=rep.audited, audit_outcome=rep.audit_outcome,
+            seconds=round(rep.seconds, 6))
 
     # -- grouping -------------------------------------------------------------
     def _group(self, dirty_pos: np.ndarray,
@@ -559,6 +576,21 @@ class IncrementalSolver:
                 + (f" ({names})" if names else "")
                 + "; adopting the control's results and ledger",
                 origin="incremental")
+        # incident bundle with the divergence diff (built BEFORE the
+        # adoption below rewrites self.results): row-level incremental vs
+        # control answers, bounded
+        diff = [{"key": self.keys[p],
+                 "incremental": (None if self.results.get(p) is None
+                                 else _norm(self.results[p])),
+                 "control": (None if res.results.get(p) is None
+                             else _norm(res.results[p]))}
+                for p in sorted(bad)[:10]]
+        obs_incidents.trigger(
+            obs_incidents.TRIGGER_AUDIT_DIVERGENCE,
+            f"incremental audit divergence adopted: {what}",
+            refs=[self.keys[p] for p in sorted(bad)[:16]],
+            detail={"rows": diff, "n_bad": len(bad),
+                    "ledger_ok": ledger_ok, "cycle": self.cycles})
         self.results = dict(res.results)
         self._since_wb = set(self.results)
         self.ledger = res.carry

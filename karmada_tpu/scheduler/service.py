@@ -28,6 +28,7 @@ from karmada_tpu import chaos as chaos_mod
 from karmada_tpu.utils.locks import VetLock
 from karmada_tpu import obs
 from karmada_tpu.obs import decisions as obs_decisions
+from karmada_tpu.obs import incidents as obs_incidents
 from karmada_tpu.obs import timeseries as obs_timeseries
 from karmada_tpu.estimator.general import GeneralEstimator
 from karmada_tpu.models.cluster import Cluster
@@ -233,6 +234,11 @@ class Scheduler:
         self._degraded_from: Optional[str] = None
         self._cycles_since_degrade = 0
         self._degrade_streak = 0
+        # incident-plane flight breadcrumbs (cycle-worker owned): the
+        # last device pipeline's dispatch/d2h accounting and the
+        # shortlist counter base the per-cycle deltas difference against
+        self._last_pipeline: Optional[dict] = None
+        self._flight_shortlist_base: Optional[dict] = None
         # capacity-contention waves per solver chunk (ops/solver.py): the
         # chunk is priced in `waves` sequential waves, each seeing the
         # snapshot minus what earlier waves consumed; waves == batch size
@@ -581,19 +587,23 @@ class Scheduler:
                   else [])
         self._update_overload(dwells, popped=len(infos),
                               active_after=active_after_pop)
+        fr: Optional[dict] = None  # this cycle's flight record, if armed
         if todo:
             sched_metrics.BATCH_SIZE.observe(len(todo))
             self._cycle_id += 1
+            batch_n = len(todo)
+            fault_kind: Optional[str] = None
+            cut_reason = ("window" if len(infos) >= self.batch_window else
+                          "deadline" if self.batch_deadline_s is not None
+                          else "drain")
             # batch-formation lifecycle event on the scheduler's own
             # timeline: the THREE stable cut shapes (window-full,
             # deadline-hit, immediate drain) coalesce, so a steady plane
             # keeps one bumping entry while mode flips stay visible
             ev.emit(ev.SCHEDULER_REF, ev.TYPE_NORMAL, ev.REASON_BATCH_FORMED,
-                    ("batch cut at the batch window"
-                     if len(infos) >= self.batch_window else
-                     "batch cut at the formation deadline"
-                     if self.batch_deadline_s is not None else
-                     "batch drained immediately"),
+                    {"window": "batch cut at the batch window",
+                     "deadline": "batch cut at the formation deadline",
+                     "drain": "batch drained immediately"}[cut_reason],
                     origin="scheduler", cycle_id=self._cycle_id)
             # recoverable degrade: the cooldown ticks once per REAL
             # scheduling cycle here — not per _solve call, which the
@@ -635,6 +645,18 @@ class Scheduler:
                     with self._queue_lock:
                         for info, _ in todo:
                             self.queue.push_backoff_if_not_present(info)
+                    fault_kind = type(e).__name__
+                    # incident trigger AFTER the queue lock releases: the
+                    # capture reads locks.state_payload() and must not
+                    # nest under any plane lock
+                    obs_incidents.trigger(
+                        obs_incidents.TRIGGER_CYCLE_FAULT,
+                        f"cycle fault contained ({fault_kind}); popped "
+                        "bindings routed to backoff",
+                        refs=[info.key for info, _ in todo[:16]],
+                        detail={"kind": fault_kind,
+                                "cycle_id": self._cycle_id,
+                                "batch": batch_n})
                     # the routing/metrics tail below runs over the empty
                     # batch: nothing scheduled, nothing double-routed
                     todo, outcomes = [], []
@@ -698,6 +720,35 @@ class Scheduler:
                         dwell_samples=ds, dwell_stride=d_stride,
                         e2e_samples=es, e2e_stride=e_stride,
                         overload=self._overload)
+            # incident plane (obs/incidents): one compact flight record
+            # per batched cycle — the ring incident bundles snapshot.
+            # Field assembly only runs when armed (the obs_events
+            # armed() hoist pattern); disarmed cost is one list read.
+            if obs_incidents.flight_armed():
+                n_unsched = sum(isinstance(r, serial.UnschedulableError)
+                                for r in outcomes)
+                n_exc = sum(isinstance(r, Exception) for r in outcomes)
+                fr = {
+                    "t": round(now, 6),
+                    "cycle_id": self._cycle_id,
+                    "trace_id": cspan.trace.trace_id if cspan else None,
+                    "popped": len(infos),
+                    "batch": batch_n,
+                    "cut": cut_reason,
+                    "backend": self.backend,
+                    "degraded_from": self._degraded_from,
+                    "overload": self._overload,
+                    "fault": fault_kind,
+                    "scheduled": len(outcomes) - n_exc,
+                    "unschedulable": n_unsched,
+                    "errors": n_exc - n_unsched,
+                    "elapsed_s": round(cycle_elapsed, 6),
+                    "dwell_max_s": (round(dwells[-1], 6)
+                                    if dwells else None),
+                    "pipeline": self._last_pipeline,
+                    "shortlist": self._shortlist_flight_delta(),
+                }
+                self._last_pipeline = None  # consumed by this record
         with self._queue_lock:
             depths = self.queue.depths()
             oldest = self.queue.oldest_ages()
@@ -714,6 +765,13 @@ class Scheduler:
         for qname, depth in depths.items():
             sched_metrics.QUEUE_DEPTH.set(depth, queue=qname)
             sched_metrics.QUEUE_OLDEST_AGE.set(oldest[qname], queue=qname)
+        if fr is not None:
+            # complete and land the flight record with post-cycle queue
+            # state; emitted before maybe_sample so a bundle captured off
+            # this cycle's SLO verdict already sees its record
+            fr["depths"] = dict(depths)
+            fr["oldest_s"] = {k: round(v, 6) for k, v in oldest.items()}
+            obs_incidents.record("cycle", **fr)
         # telemetry plane (obs/timeseries, serve --telemetry): one ring
         # sample per scheduling cycle on the QUEUE's clock — the loadgen
         # VirtualClock in compressed soaks, so synthetic hours produce
@@ -1088,7 +1146,39 @@ class Scheduler:
             explain=explain, keys=keys, encode=encode,
             shortlist=shortlist_cfg,
         )
+        if not detached:
+            # flight-record breadcrumb: the live pipeline's dispatch/d2h
+            # accounting (solve_s spans sub-solves + device wait + sparse
+            # D2H); detached what-if solves run off-worker and must not
+            # clobber the cycle's record
+            self._last_pipeline = {
+                "solve_s": round(res.solve_s, 6),
+                "chunks": res.chunks,
+                "cancelled": res.cancelled,
+                "scheduled": res.scheduled,
+                "failures": res.failures,
+            }
         return res.results
+
+    def _shortlist_flight_delta(self) -> Optional[dict]:
+        """Since-last-record deltas of the shortlist tier counters for
+        the flight record; None until ops/shortlist is imported (the
+        tiered path has never dispatched)."""
+        import sys
+
+        mod = sys.modules.get("karmada_tpu.ops.shortlist")
+        if mod is None:
+            return None
+        cur = {
+            "dispatches": mod.SHORTLIST_DISPATCHES.total(),
+            "fallbacks": mod.SHORTLIST_FALLBACKS.total(),
+            "widenings": mod.SHORTLIST_WIDENINGS.total(),
+        }
+        base = self._flight_shortlist_base
+        self._flight_shortlist_base = cur
+        if base is None:
+            return cur
+        return {k: cur[k] - base.get(k, 0) for k in cur}
 
     def _ensure_mesh(self) -> None:
         """One-shot solver-mesh activation (ops/meshing), performed INSIDE
@@ -1213,6 +1303,14 @@ class Scheduler:
                 f"device backend degraded to {self.backend} after a hung "
                 "cycle (mid-serve death guard)", origin="scheduler",
                 cycle_id=self._cycle_id)
+        obs_incidents.trigger(
+            obs_incidents.TRIGGER_BACKEND_DEGRADE,
+            f"device backend degraded to {self.backend} after a hung cycle",
+            detail={"to": self.backend,
+                    "streak": self._degrade_streak,
+                    "timeout_s": self.device_cycle_timeout_s,
+                    "recover_cycles": self.device_recover_cycles,
+                    "cycle_id": self._cycle_id})
         import sys
 
         recover = self.device_recover_cycles

@@ -61,6 +61,17 @@ ThreadingHTTPServer serves:
                          multi-window burn rates, budget remaining, the
                          regression-watchdog verdict; {"enabled": false}
                          when disarmed
+    /debug/incidents     incident plane (obs/incidents): flight-ring
+                         stats, capture/suppression totals by trigger,
+                         the bounded bundle index; {"enabled": false}
+                         when the store is disarmed (the flight ring
+                         itself is armed by default)
+    /debug/incidents/{id}
+                         one self-contained forensic bundle: the flight
+                         ring, MetricRing samples + SLO verdict,
+                         implicated-binding timelines, the locks block,
+                         and the trigger's own detail (e.g. the audit
+                         divergence diff)
     /debug/profile?seconds=N
                          on-demand jax.profiler capture (obs/devprof):
                          opens a bounded trace window, writes
@@ -392,6 +403,23 @@ class ObservabilityServer:
             from karmada_tpu.obs import slo
 
             return (json.dumps(slo.state_payload()).encode(),
+                    "application/json", 200)
+        if path == "/debug/incidents":
+            from karmada_tpu.obs import incidents
+
+            return (json.dumps(incidents.state_payload(),
+                               default=str).encode(),
+                    "application/json", 200)
+        if path.startswith("/debug/incidents/"):
+            from karmada_tpu.obs import incidents
+
+            iid = path[len("/debug/incidents/"):]
+            bundle = incidents.bundle_payload(iid)
+            if bundle is None:
+                return self._json_error(
+                    f"no incident bundle {iid!r} (incident plane "
+                    "disarmed, id unknown, or bundle evicted)", 404)
+            return (json.dumps(bundle, default=str).encode(),
                     "application/json", 200)
         if path == "/debug/profile":
             from karmada_tpu.obs import devprof

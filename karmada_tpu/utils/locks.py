@@ -89,15 +89,28 @@ def _held_stack() -> list:
 def _note_edge(first: str, then: str, thread_name: str) -> None:
     """Record first-seen order edge (first held when `then` acquired);
     count an inversion when the reverse edge was seen earlier."""
+    inv = None
     with _REG_LOCK:
         if (then, first) in _EDGES and (first, then) not in _EDGES:
             pair = "|".join(sorted((first, then)))
             _INVERSIONS.inc(pair=pair)
-            _INV_DETAILS.append({
+            inv = {
                 "pair": pair, "held": first, "acquired": then,
                 "thread": thread_name,
-            })
+            }
+            _INV_DETAILS.append(inv)
         _EDGES[(first, then)] = _EDGES.get((first, then), 0) + 1
+    if inv is not None:
+        # incident trigger AFTER _REG_LOCK releases: the bundle capture
+        # reads state_payload() (which takes _REG_LOCK) — firing under
+        # it would deadlock.  Lazy import: utils must not pull obs at
+        # module load.
+        from karmada_tpu.obs import incidents as obs_incidents
+
+        obs_incidents.trigger(
+            obs_incidents.TRIGGER_LOCK_INVERSION,
+            f"lock order inversion: {inv['acquired']} acquired while "
+            f"{inv['held']} held", detail=inv)
 
 
 class VetLock:
@@ -269,6 +282,15 @@ class LockWatchdog:
             _TRIPS.inc(lock=lock.name)
             trips.append({"lock": lock.name, "held_s": now - t0,
                           "owner": lock._owner_name})  # noqa: SLF001
+        if trips:
+            # _REG_LOCK is NOT held here (released after the _ALL copy);
+            # the capture re-takes it for the locks block
+            from karmada_tpu.obs import incidents as obs_incidents
+
+            obs_incidents.trigger(
+                obs_incidents.TRIGGER_LOCK_WATCHDOG,
+                f"{len(trips)} lock(s) held over {self.threshold_s:g}s",
+                detail={"threshold_s": self.threshold_s, "trips": trips})
         return trips
 
     def start(self) -> "LockWatchdog":

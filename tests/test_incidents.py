@@ -1,0 +1,587 @@
+"""Incident plane (obs/incidents): flight recorder, trigger bus, and
+forensic bundle capture.
+
+Covers the ISSUE-20 acceptance surface: the armed-by-default per-cycle
+flight ring (scheduler + incremental records, bounded, one-list-read
+disarmed), the typed trigger bus with per-kind cooldown rate limiting
+on an injectable clock, self-contained JSON bundles (flight ring +
+telemetry/SLO + implicated timelines + locks block + trigger detail)
+persisted under <dir>/incidents, the /debug/incidents[/{id}] endpoints,
+and the soak contracts: injected faults (degrade, audit divergence,
+lock-watchdog trip, cycle fault) each yield one rate-limited bundle,
+while a healthy steady soak yields none.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karmada_tpu import chaos
+from karmada_tpu.obs import events as obs_events
+from karmada_tpu.obs import incidents
+
+pytestmark = pytest.mark.incidents
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    """Every test gets a fresh flight ring and no armed store; none may
+    leak an armed incident store (or chaos plane) into the suite."""
+    incidents.configure_flight()
+    yield
+    incidents.disarm()
+    incidents.configure_flight()
+    chaos.disarm()
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_bounded_and_disarmable():
+    rec = incidents.configure_flight(capacity=4)
+    for i in range(10):
+        assert incidents.record("cycle", cycle_id=i)
+    st = rec.stats()
+    assert st == {"recorded": 10, "retained": 4, "capacity": 4}
+    snap = rec.snapshot()
+    assert [r["cycle_id"] for r in snap] == [6, 7, 8, 9]  # oldest first
+    assert all(r["kind"] == "cycle" for r in snap)
+    assert rec.snapshot(2) == snap[-2:] and rec.snapshot(0) == []
+    incidents.arm_flight(False)
+    try:
+        assert not incidents.record("cycle", cycle_id=99)
+        assert rec.stats()["recorded"] == 10  # disarmed: nothing lands
+    finally:
+        incidents.arm_flight(True)
+
+
+def test_scheduler_cycle_emits_flight_records():
+    """The live scheduler cycle lands one kind="cycle" record with the
+    batch/cut/backend/queue-depth forensics the bundles snapshot."""
+    import tests.test_chaos as tc
+
+    store, rt, sched = tc._slice(backend="serial")  # noqa: SLF001
+    for i in range(3):
+        store.create(tc.build_binding(f"fl-b{i}"))
+    rt.pump()
+    recs = [r for r in incidents.flight().snapshot()
+            if r["kind"] == "cycle"]
+    assert recs, "scheduler cycle recorded no flight record"
+    fr = recs[-1]
+    assert fr["batch"] == 3 and fr["cut"] in ("window", "deadline", "drain")
+    assert fr["backend"] == "serial" and fr["fault"] is None
+    assert fr["scheduled"] == 3 and fr["errors"] == 0
+    assert fr["cycle_id"] >= 1 and fr["elapsed_s"] >= 0
+    assert "active" in fr["depths"] and "active" in fr["oldest_s"]
+
+
+def test_incremental_cycle_emits_flight_records():
+    import tests.test_incremental_solve as tinc
+    from karmada_tpu.estimator.general import GeneralEstimator
+    from karmada_tpu.resident import ResidentState
+    from karmada_tpu.resident.deltas import CycleDeltas
+    from karmada_tpu.scheduler.incremental import IncrementalSolver
+
+    _rng, clusters, _names, _pls, bindings = tinc._world(  # noqa: SLF001
+        n_clusters=16, n_bindings=48, seed=5)
+    state = ResidentState(audit_interval=0)
+    solver = IncrementalSolver(state, GeneralEstimator(), chunk=32,
+                               audit_every=0)
+    solver.adopt(clusters, bindings)
+    solver.write_back()
+    solver.cycle(clusters, bindings, CycleDeltas(), force_audit=True)
+    recs = [r for r in incidents.flight().snapshot()
+            if r["kind"] == "incremental"]
+    assert recs, "incremental cycle recorded no flight record"
+    fr = recs[-1]
+    assert fr["total"] == 48 and fr["mode"] == "incremental"
+    assert fr["audited"] is True and fr["audit_outcome"] == "ok"
+    assert fr["dirty"] >= 0 and isinstance(fr["groups"], list)
+
+
+# ---------------------------------------------------------------------------
+# trigger bus + bundle capture (the check.sh smoke leg)
+# ---------------------------------------------------------------------------
+
+
+def test_trigger_bundle_smoke(tmp_path):
+    """One trigger end to end: a complete self-contained bundle on disk
+    and in the index, the metrics moved, the cooldown suppressing the
+    repeat, and the capture announced on the lifecycle ledger."""
+    incidents.record("cycle", cycle_id=7, batch=3)
+    obs_events.emit_key(("inc", "b0"), obs_events.TYPE_NORMAL,
+                        obs_events.REASON_BINDING_ENQUEUED, "enqueued")
+    d = str(tmp_path / "incidents")
+    incidents.configure(d, cooldown_s=60.0, clock=_Clock())
+    c0 = incidents.INCIDENTS.total()
+    s0 = incidents.INCIDENTS_SUPPRESSED.total()
+    iid = incidents.trigger(
+        incidents.TRIGGER_CYCLE_FAULT, "cycle fault contained (Boom)",
+        refs=[("inc", "b0")], detail={"kind": "Boom", "cycle_id": 7})
+    assert iid is not None
+    # rate limit: same kind inside the cooldown is suppressed
+    assert incidents.trigger(incidents.TRIGGER_CYCLE_FAULT, "again") is None
+    assert incidents.INCIDENTS.total() == c0 + 1
+    assert incidents.INCIDENTS_SUPPRESSED.total() == s0 + 1
+    bundle = incidents.bundle_payload(iid)
+    assert bundle is not None and "capture_errors" not in bundle
+    assert bundle["trigger"] == "cycle-fault"
+    assert bundle["detail"] == {"kind": "Boom", "cycle_id": 7}
+    # complete artifacts: every forensic section landed
+    assert any(r["cycle_id"] == 7 for r in bundle["flight"]["records"])
+    assert "samples" in bundle["telemetry"]
+    assert "enabled" in bundle["slo"]
+    assert "locks" in bundle["locks"] or "enabled" in bundle["locks"]
+    tl = bundle["timelines"]["inc/b0"]
+    assert any(e["reason"] == obs_events.REASON_BINDING_ENQUEUED
+               for e in tl)
+    assert isinstance(bundle["recent_events"], list)
+    # persisted, self-contained, and announced
+    path = bundle["path"]
+    assert path and os.path.exists(path) and path.startswith(d)
+    with open(path) as f:
+        on_disk = json.load(f)
+    assert on_disk["id"] == iid
+    recent = obs_events.state_payload(n=8)["recent"]
+    assert any(e.get("reason") == obs_events.REASON_INCIDENT_CAPTURED
+               for e in recent), recent
+    # the index reflects both the capture and the suppression
+    state = incidents.state_payload()
+    assert state["enabled"] and state["captured"] == 1
+    assert state["by_trigger"] == {"cycle-fault": 1}
+    assert state["suppressed"] == {"cycle-fault": 1}
+    assert [e["id"] for e in state["incidents"]] == [iid]
+
+
+def test_disarmed_trigger_is_noop_smoke():
+    c0 = incidents.INCIDENTS.total()
+    assert incidents.active() is None
+    assert incidents.trigger(incidents.TRIGGER_BACKEND_DEGRADE, "x") is None
+    assert incidents.INCIDENTS.total() == c0
+    state = incidents.state_payload()
+    assert state["enabled"] is False and "flight" in state
+
+
+def test_unknown_trigger_kind_rejected():
+    store = incidents.configure(None, clock=_Clock())
+    with pytest.raises(AssertionError):
+        store.trigger("not-a-kind", "x")
+
+
+def test_cooldown_is_per_kind_on_injected_clock():
+    clock = _Clock(t=1000.0)
+    incidents.configure(None, cooldown_s=60.0, clock=clock)
+    assert incidents.trigger(incidents.TRIGGER_CYCLE_FAULT, "a")
+    # an unrelated kind has its own cooldown window
+    assert incidents.trigger(incidents.TRIGGER_BACKEND_DEGRADE, "b")
+    assert incidents.trigger(incidents.TRIGGER_CYCLE_FAULT, "c") is None
+    clock.t += 61.0
+    assert incidents.trigger(incidents.TRIGGER_CYCLE_FAULT, "d")
+    state = incidents.state_payload()
+    assert state["by_trigger"] == {"cycle-fault": 2, "backend-degrade": 1}
+    assert state["suppressed"] == {"cycle-fault": 1}
+
+
+def test_bundle_index_bounded_with_disk_fallback(tmp_path):
+    clock = _Clock()
+    incidents.configure(str(tmp_path), cooldown_s=0.0, keep=2,
+                        clock=clock)
+    ids = []
+    for kind in (incidents.TRIGGER_CYCLE_FAULT,
+                 incidents.TRIGGER_BACKEND_DEGRADE,
+                 incidents.TRIGGER_LOCK_WATCHDOG):
+        clock.t += 1.0
+        ids.append(incidents.trigger(kind, "x"))
+    state = incidents.state_payload()
+    assert [e["id"] for e in state["incidents"]] == ids[1:]  # keep=2
+    # the evicted bundle is still readable from its on-disk artifact
+    evicted = incidents.bundle_payload(ids[0])
+    assert evicted is not None and evicted["id"] == ids[0]
+
+
+# ---------------------------------------------------------------------------
+# detector wiring: one injected fault per trigger kind -> one bundle
+# ---------------------------------------------------------------------------
+
+
+def test_cycle_fault_trigger_captures_bundle():
+    import tests.test_chaos as tc
+
+    incidents.configure(None, cooldown_s=3600.0)
+    store, rt, sched = tc._slice()  # noqa: SLF001
+    for i in range(2):
+        store.create(tc.build_binding(f"icf-b{i}"))
+    chaos.configure("device.dispatch:raise#1")
+    rt.pump()
+    rt.tick()
+    state = incidents.state_payload()
+    assert state["by_trigger"].get("cycle-fault") == 1, state["by_trigger"]
+    iid = state["incidents"][-1]["id"]
+    bundle = incidents.bundle_payload(iid)
+    assert bundle["detail"]["kind"] == "ChaosFault"
+    # the implicated bindings' timelines rode along
+    assert any(k.endswith("icf-b0") for k in bundle["timelines"]), (
+        list(bundle["timelines"]))
+
+
+def test_backend_degrade_trigger_captures_bundle():
+    import time
+
+    import tests.test_chaos as tc
+
+    incidents.configure(None, cooldown_s=3600.0)
+    store, rt, sched = tc._slice(device_cycle_timeout_s=None,
+                                 device_recover_cycles=1)
+    store.create(tc.build_binding("idg-warm"))
+    rt.pump()  # unguarded: pays the jit compile
+    sched.device_cycle_timeout_s = 0.5
+    chaos.configure("device.cycle:hang:1.5#1")
+    store.create(tc.build_binding("idg-b1"))
+    rt.pump()
+    rt.tick()
+    state = incidents.state_payload()
+    assert state["by_trigger"].get("backend-degrade") == 1, (
+        state["by_trigger"])
+    bundle = incidents.bundle_payload(state["incidents"][-1]["id"])
+    assert bundle["trigger"] == "backend-degrade"
+    assert bundle["detail"]["to"] in ("native", "serial")
+    time.sleep(1.2)  # give the abandoned zombie its sleep back
+
+
+def test_audit_divergence_trigger_captures_diff_bundle():
+    import tests.test_incremental_solve as tinc
+    from karmada_tpu.estimator.general import GeneralEstimator
+    from karmada_tpu.resident import ResidentState
+    from karmada_tpu.resident.deltas import CycleDeltas
+    from karmada_tpu.scheduler.incremental import IncrementalSolver
+
+    incidents.configure(None, cooldown_s=3600.0)
+    _rng, clusters, _names, _pls, bindings = tinc._world(  # noqa: SLF001
+        n_clusters=32, n_bindings=128, seed=37)
+    state = ResidentState(audit_interval=0)
+    solver = IncrementalSolver(state, GeneralEstimator(), chunk=64,
+                               audit_every=0)
+    tinc._settle(solver, clusters, bindings)  # noqa: SLF001
+    pos = next(p for p, r in solver.results.items()
+               if not isinstance(r, Exception))
+    solver.results[pos] = []  # diverged state (placements dropped)
+    rep = solver.cycle(clusters, bindings, CycleDeltas(),
+                       force_audit=True)
+    assert rep.audit_outcome == "mismatch"
+    st = incidents.state_payload()
+    assert st["by_trigger"].get("audit-divergence") == 1, st["by_trigger"]
+    bundle = incidents.bundle_payload(st["incidents"][-1]["id"])
+    detail = bundle["detail"]
+    assert detail["n_bad"] >= 1 and detail["ledger_ok"] in (True, False)
+    # the divergence diff names the row and both answers
+    row = next(r for r in detail["rows"]
+               if r["key"] == solver.keys[pos])
+    assert not row["incremental"] and row["control"]
+
+
+def test_lock_watchdog_trigger_captures_bundle():
+    from karmada_tpu.analysis import guards
+    from karmada_tpu.utils import locks
+
+    incidents.configure(None, cooldown_s=3600.0)
+    was = guards.armed()
+    guards.arm()
+    lock = locks.VetLock("incidents.wd-test")
+    try:
+        with lock:
+            trips = locks.LockWatchdog(threshold_s=0.0).check()
+    finally:
+        guards.arm(was)
+    assert any(t["lock"] == "incidents.wd-test" for t in trips)
+    st = incidents.state_payload()
+    assert st["by_trigger"].get("lock-watchdog") == 1, st["by_trigger"]
+    bundle = incidents.bundle_payload(st["incidents"][-1]["id"])
+    assert any(t["lock"] == "incidents.wd-test"
+               for t in bundle["detail"]["trips"])
+
+
+def test_lock_inversion_trigger_captures_bundle():
+    from karmada_tpu.analysis import guards
+    from karmada_tpu.utils import locks
+
+    incidents.configure(None, cooldown_s=3600.0)
+    locks.reset_for_tests()
+    was = guards.armed()
+    guards.arm()
+    la = locks.VetLock("incidents.inv-a")
+    lb = locks.VetLock("incidents.inv-b")
+    try:
+        with la:
+            with lb:
+                pass
+        with lb:
+            with la:  # the reverse edge: an order inversion
+                pass
+    finally:
+        guards.arm(was)
+        locks.reset_for_tests()
+    st = incidents.state_payload()
+    assert st["by_trigger"].get("lock-inversion") == 1, st["by_trigger"]
+    bundle = incidents.bundle_payload(st["incidents"][-1]["id"])
+    assert bundle["detail"]["pair"] == "incidents.inv-a|incidents.inv-b"
+
+
+def test_invariant_violation_trigger():
+    from karmada_tpu.analysis.guards import InvariantViolation
+
+    incidents.configure(None, cooldown_s=3600.0)
+    with pytest.raises(InvariantViolation):
+        raise InvariantViolation("bench: d2h poisoned row")
+    st = incidents.state_payload()
+    assert st["by_trigger"].get("invariant-violation") == 1
+    bundle = incidents.bundle_payload(st["incidents"][-1]["id"])
+    assert "poisoned" in bundle["detail"]["message"]
+
+
+def test_slo_unhealthy_edge_triggers_once():
+    """The SLO trigger fires on the healthy->unhealthy TRANSITION, not
+    per unhealthy window (cooldown 0 here, so a refire would capture)."""
+    import tests.test_telemetry as tt
+    from karmada_tpu.obs import slo as obs_slo
+
+    incidents.configure(None, cooldown_s=0.0)
+    obj = obs_slo.Objective("errs", "ratio", target=0.99,
+                            bad=("karmada_test_bad_total", None),
+                            total=("karmada_test_all_total", None))
+    ev = obs_slo.SloEvaluator(objectives=[obj], short_frac=0.25)
+    burning = [(float(i), tt._counter_snap(i * 2.0, i * 100.0))  # noqa: SLF001
+               for i in range(8)]
+    assert ev.evaluate(tt._FakeRing(burning))["healthy"] is False  # noqa: SLF001
+    ev.evaluate(tt._FakeRing(burning))  # still unhealthy: no refire  # noqa: SLF001
+    st = incidents.state_payload()
+    assert st["by_trigger"] == {"slo-unhealthy": 1}, st["by_trigger"]
+    bundle = incidents.bundle_payload(st["incidents"][-1]["id"])
+    assert bundle["detail"]["unhealthy"] == ["errs"]
+
+
+def test_regression_watchdog_trip_edge_triggers():
+    from karmada_tpu.obs import slo as obs_slo
+
+    incidents.configure(None, cooldown_s=0.0)
+    wd = obs_slo.RegressionWatchdog(baseline_bps=1000.0)
+    ev = obs_slo.SloEvaluator(objectives=[], watchdog=wd)
+    ev.evaluate(_EmptyRing())     # not tripped: quiet
+    wd.tripped = True             # injected trip (check() keeps it on
+    ev.evaluate(_EmptyRing())     # a <2-sample window): the edge fires
+    ev.evaluate(_EmptyRing())     # still tripped: no refire
+    st = incidents.state_payload()
+    assert st["by_trigger"] == {"regression-watchdog": 1}, st["by_trigger"]
+    bundle = incidents.bundle_payload(st["incidents"][-1]["id"])
+    assert bundle["detail"]["baseline_bps"] == 1000.0
+
+
+class _EmptyRing:
+    def samples(self, n=None):
+        return []
+
+
+def test_safety_violation_reason_and_trigger():
+    """The satellite fix: SafetyAuditor violations land on the ledger
+    (REASON_SafetyViolation, keyed by invariant) and fire the incident
+    trigger — not only the bench payload."""
+    from karmada_tpu.chaos import audit as chaos_audit
+
+    incidents.configure(None, cooldown_s=3600.0)
+    chaos_audit.surface_violations([
+        {"kind": "double-placed", "binding": "loadgen/dp-b0",
+         "detail": "2 live placements"},
+        {"kind": "double-placed", "binding": "loadgen/dp-b1",
+         "detail": "2 live placements"},
+        {"kind": "recovery-missed",
+         "detail": "the backend degraded and never re-armed"},
+    ])
+    # the implicated binding's own timeline carries the invariant key
+    tl = obs_events.timeline_payload("loadgen", "dp-b0")
+    assert any(e["reason"] == obs_events.REASON_SAFETY_VIOLATION
+               for e in tl["events"]), tl["events"]
+    # the cooldown admits ONE safety-violation bundle; the second
+    # invariant kind inside the window is suppressed, not a storm
+    st = incidents.state_payload()
+    assert st["by_trigger"] == {"safety-violation": 1}
+    assert st["suppressed"] == {"safety-violation": 1}
+    bundle = incidents.bundle_payload(st["incidents"][0]["id"])
+    assert bundle["detail"]["kind"] == "double-placed"
+    assert bundle["detail"]["count"] == 2
+    assert "loadgen/dp-b0" in bundle["timelines"]
+
+
+# ---------------------------------------------------------------------------
+# endpoints + CLI surface
+# ---------------------------------------------------------------------------
+
+
+def _fetch(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_debug_incidents_endpoints(tmp_path):
+    from karmada_tpu.utils.httpserve import ObservabilityServer
+
+    incidents.record("cycle", cycle_id=3)
+    incidents.configure(str(tmp_path / "incidents"), cooldown_s=0.0,
+                        clock=_Clock())
+    iid = incidents.trigger(incidents.TRIGGER_SLO_UNHEALTHY,
+                            "p99 budget burned")
+    srv = ObservabilityServer()
+    base = srv.start()
+    try:
+        status, body = _fetch(base + "/debug/incidents")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["enabled"] and payload["captured"] == 1
+        assert payload["incidents"][0]["id"] == iid
+        status, body = _fetch(base + f"/debug/incidents/{iid}")
+        assert status == 200
+        bundle = json.loads(body)
+        assert bundle["trigger"] == "slo-unhealthy"
+        assert any(r["cycle_id"] == 3 for r in bundle["flight"]["records"])
+        status, body = _fetch(base + "/debug/incidents/nope")
+        assert status == 404 and "nope" in json.loads(body)["error"]
+    finally:
+        srv.stop()
+
+
+def test_debug_incidents_disarmed_payload():
+    from karmada_tpu.utils.httpserve import ObservabilityServer
+
+    srv = ObservabilityServer()
+    base = srv.start()
+    try:
+        status, body = _fetch(base + "/debug/incidents")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["enabled"] is False and "flight" in payload
+    finally:
+        srv.stop()
+
+
+def test_cli_incidents_and_describe_incident(tmp_path, capsys):
+    from karmada_tpu import cli
+    from karmada_tpu.utils.httpserve import ObservabilityServer
+
+    incidents.configure(str(tmp_path / "incidents"), cooldown_s=0.0,
+                        clock=_Clock())
+    iid = incidents.trigger(incidents.TRIGGER_BACKEND_DEGRADE,
+                            "device backend degraded to serial")
+    srv = ObservabilityServer()
+    base = srv.start()
+    try:
+        assert cli.main(["incidents", "--endpoint", base]) == 0
+        out = capsys.readouterr().out
+        assert iid in out and "backend-degrade" in out
+        assert cli.main(["describe", "incident", iid,
+                         "--endpoint", base]) == 0
+        out = capsys.readouterr().out
+        assert json.loads(out)["id"] == iid
+        assert cli.main(["incidents", iid, "--endpoint", base]) == 0
+        assert json.loads(capsys.readouterr().out)["id"] == iid
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# trace_id propagation across the facade wire (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_wire_trace_id_round_trip_and_frame_compat():
+    from karmada_tpu.estimator import wire
+
+    bare = wire.AssignReplicasRequest(namespace="ns", name="b")
+    assert "traceId" not in bare.to_json()  # untraced frame unchanged
+    req = wire.AssignReplicasRequest(namespace="ns", name="b",
+                                     trace_id="t-123")
+    d = req.to_json()
+    assert d["traceId"] == "t-123"
+    assert wire.AssignReplicasRequest.from_json(d).trace_id == "t-123"
+    # default-tolerant: frames from older peers parse
+    assert wire.AssignReplicasRequest.from_json(
+        {"name": "b"}).trace_id == ""
+
+
+def test_facade_batch_stitches_caller_trace_ids():
+    import tests.test_facade as tf
+
+    plane, _, _ = tf._slice()  # noqa: SLF001
+    svc = tf._service(plane, batch_window=1)  # noqa: SLF001
+    try:
+        req = tf._assign_req("inc-tr-caller")  # noqa: SLF001
+        req.trace_id = "caller-abc"
+        resp = svc.assign(req)
+        assert resp.outcome == "scheduled"
+    finally:
+        svc.close()
+    recs = [r for r in incidents.flight().snapshot()
+            if r["kind"] == "facade"]
+    assert recs, "facade dispatch recorded no flight record"
+    assert recs[-1]["caller_trace_ids"] == ["caller-abc"]
+    assert recs[-1]["batch"] == 1
+
+
+# ---------------------------------------------------------------------------
+# soak contracts: chaos yields bundles, healthy steady yields none
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.soak
+def test_chaos_soak_yields_rate_limited_bundles():
+    """The compressed chaos soak's injected faults (device hang ->
+    degrade, device dispatch raise -> contained cycle fault) each yield
+    exactly ONE bundle under a run-spanning cooldown, complete with the
+    flight ring, and the SOAK payload embeds the incident summary."""
+    import tests.test_chaos as tc
+
+    incidents.configure(None, cooldown_s=1e9)
+    plane, driver, p = tc._run_chaos_soak()  # noqa: SLF001
+    by_trigger = incidents.state_payload()["by_trigger"]
+    assert by_trigger.get("backend-degrade") == 1, by_trigger
+    assert by_trigger.get("cycle-fault") == 1, by_trigger
+    # the injected resident corruption forced a dense-audit divergence
+    assert by_trigger.get("audit-divergence") == 1, by_trigger
+    # every bundle is complete: flight ring + telemetry + locks rode
+    for entry in incidents.state_payload()["incidents"]:
+        bundle = incidents.bundle_payload(entry["id"])
+        assert "capture_errors" not in bundle, bundle["capture_errors"]
+        assert bundle["flight"]["records"], entry
+    # the soak report embeds the summary (watch_bench pass-through)
+    assert p["incidents"]["by_trigger"] == by_trigger
+    assert p["incidents"]["captured"] == sum(by_trigger.values())
+
+
+@pytest.mark.soak
+def test_healthy_steady_soak_yields_zero_bundles():
+    import tests.test_loadgen_soak as tls
+
+    incidents.configure(None, cooldown_s=0.0)
+    _scenario, _driver, p = tls.run_scenario("steady")
+    state = incidents.state_payload()
+    assert state["captured"] == 0, state["by_trigger"]
+    assert state["suppressed"] == {}
+    assert p["incidents"]["captured"] == 0
+    # the flight ring still recorded the healthy cycles
+    assert state["flight"]["recorded"] > 0

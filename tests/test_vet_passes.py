@@ -793,3 +793,67 @@ def test_event_reasons_waiver_and_partial_scan(tmp_path):
     assert all("catalogued" not in f.message for f in solo.findings
                if f.rule == "event-reasons")
     assert any(f.rule == "event-reasons" for f in solo.findings)
+
+
+# -- incident-plane seeds (ISSUE-20) ------------------------------------------
+# pin the NEW names through both doc-parity passes: the three
+# karmada_incident* families and the SafetyViolation / IncidentCaptured
+# reasons must stay catalogued in docs/OBSERVABILITY.md — renaming or
+# dropping a row turns these fixtures into real-package findings too
+
+
+def test_metric_docs_incident_families_seeded(tmp_path):
+    doc = """
+        * `karmada_incidents_total{trigger}` — bundles captured
+        * `karmada_incidents_suppressed_total{trigger}` — cooldown drops
+        * `karmada_incident_capture_seconds` — capture wall time
+    """
+    src = """
+        from karmada_tpu.utils.metrics import REGISTRY
+        INCIDENTS = REGISTRY.counter(
+            "karmada_incidents_total", "help", ("trigger",))
+        SUPPRESSED = REGISTRY.counter(
+            "karmada_incidents_suppressed_total", "help", ("trigger",))
+        CAPTURE = REGISTRY.histogram(
+            "karmada_incident_capture_seconds", "help")
+    """
+    report = _docs_tree(tmp_path / "clean", doc, src=src)
+    assert [f for f in report.findings if f.rule == "metric-docs"] == []
+    # dropping the catalog rows turns all three into findings
+    report = _docs_tree(tmp_path / "bare",
+                        "# Metrics\n(no incident rows)\n", src=src)
+    msgs = {f.message for f in report.findings if f.rule == "metric-docs"}
+    for name in ("karmada_incidents_total",
+                 "karmada_incidents_suppressed_total",
+                 "karmada_incident_capture_seconds"):
+        assert any(name in m for m in msgs), (name, msgs)
+
+
+def test_event_reasons_incident_reasons_seeded(tmp_path):
+    taxonomy = """
+        REASON_SAFETY_VIOLATION = "SafetyViolation"
+        REASON_INCIDENT_CAPTURED = "IncidentCaptured"
+    """
+    src = """
+        from karmada_tpu.utils import events as ev
+
+        def go():
+            ev.emit_key(("ns", "b0"), ev.TYPE_WARNING,
+                        ev.REASON_SAFETY_VIOLATION, "invariant violated",
+                        origin="chaos-audit")
+            ev.emit(ev.SCHEDULER_REF, ev.TYPE_WARNING,
+                    ev.REASON_INCIDENT_CAPTURED, "bundle captured",
+                    origin="incidents")
+    """
+    report = _events_tree(tmp_path / "clean", """
+        ## Reason catalog
+        | `SafetyViolation` | chaos auditor invariant breach |
+        | `IncidentCaptured` | incident bundle landed |
+    """, src, taxonomy=taxonomy)
+    assert [f for f in report.findings if f.rule == "event-reasons"] == []
+    # an uncatalogued incident reason is a finding at the taxonomy home
+    report = _events_tree(tmp_path / "bare",
+                          "only `SafetyViolation` is here",
+                          src, taxonomy=taxonomy)
+    bad = [f for f in report.findings if f.rule == "event-reasons"]
+    assert len(bad) == 1 and "IncidentCaptured" in bad[0].message

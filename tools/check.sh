@@ -16,6 +16,15 @@ if [ "${1:-}" = "--vet-only" ]; then
   exit 0
 fi
 
+echo "== incident-plane smoke (obs/incidents: ring + trigger + bundle) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_incidents.py -q -k smoke \
+  -p no:cacheprovider -p no:xdist -p no:randomly
+smoke_rc=$?
+if [ "$smoke_rc" -ne 0 ]; then
+  echo "incident smoke failed (rc=$smoke_rc)" >&2
+  exit "$smoke_rc"
+fi
+
 echo "== tier-1 tests (ROADMAP verify command) =="
 set -o pipefail
 rm -f /tmp/_t1.log

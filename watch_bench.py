@@ -208,6 +208,26 @@ def main() -> int:
                           "coalesce_ratio": led.get("coalesce_ratio"),
                           "evicted": led.get("evicted"),
                           "by_reason": led.get("by_reason")})
+                inc_sum = ((detail.get("soak") or {}).get("incidents")
+                           or (detail.get("chaos") or {}).get("incidents")
+                           or (detail.get("rebalance") or {})
+                           .get("incidents"))
+                if inc_sum:
+                    # incident-plane pass-through (obs/incidents): the
+                    # run's capture/suppression summary as a structured
+                    # line, same contract as ledger/slo
+                    jlog({"event": "incident",
+                          "ts": round(time.time(), 3),
+                          "captured": inc_sum.get("captured"),
+                          "suppressed": inc_sum.get("suppressed"),
+                          "by_trigger": inc_sum.get("by_trigger"),
+                          "cooldown_s": inc_sum.get("cooldown_s"),
+                          "incidents": [
+                              {"id": e.get("id"),
+                               "trigger": e.get("trigger"),
+                               "summary": e.get("summary")}
+                              for e in (inc_sum.get("incidents")
+                                        or [])[:8]]})
                 slo_v = (detail.get("slo")
                          or (detail.get("soak") or {}).get("slo")
                          or ((detail.get("chaos") or {}).get("slo"))
